@@ -1,0 +1,66 @@
+"""Benchmark driver: AlexNet ImageNet-shape training throughput on one chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline: the driver-assigned north star is cxxnet's 4xK40 ImageNet AlexNet
+throughput (BASELINE.md). The reference publishes no number; contemporary
+cxxnet-era measurements put AlexNet at roughly 200 images/sec on one K40, so
+4xK40 with "nearly linear speedup" (README.md:15-17) is taken as ~800
+images/sec. vs_baseline = measured_images_per_sec / 800.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 800.0
+BATCH = 128
+WARMUP_STEPS = 3
+BENCH_STEPS = 12
+
+
+def main() -> int:
+    import jax
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.models import alexnet_config
+    from cxxnet_tpu.utils.config import tokenize
+
+    n_dev = len(jax.devices())
+    batch = BATCH
+    if batch % n_dev:
+        batch = (batch // n_dev + 1) * n_dev
+
+    net = Net(tokenize(alexnet_config(batch_size=batch, dev="",
+                                      precision="bfloat16")))
+    net.init_model()
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch, 3, 227, 227).astype(np.float32)
+    y = rs.randint(0, 1000, (batch, 1)).astype(np.float32)
+    db = DataBatch(x, y)
+
+    for _ in range(WARMUP_STEPS):
+        net.update(db)
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        net.update(db)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BENCH_STEPS * batch / dt
+    print(json.dumps({
+        "metric": "alexnet_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""cxxnet_tpu — a TPU-native deep-learning training framework with the
+capabilities of the reference cxxnet (see SURVEY.md at the repo root).
+
+Public surface:
+- :class:`cxxnet_tpu.nnet.net.Net` — the trainer (INetTrainer equivalent)
+- :func:`cxxnet_tpu.io.create_iterator` — config-driven data pipelines
+- :mod:`cxxnet_tpu.cli` — the ``cxxnet <config> [k=v ...]`` runner
+- :mod:`cxxnet_tpu.wrapper` — the cxxnet.py-compatible Python API
+"""
+
+__version__ = "0.1.0"
+
+from .graph import NetGraph
+from .nnet.net import Net
+from .io import create_iterator
+
+__all__ = ["Net", "NetGraph", "create_iterator", "__version__"]

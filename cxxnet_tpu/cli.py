@@ -1,0 +1,304 @@
+"""Command-line runner — equivalent of the reference CLI
+(/root/reference/src/cxxnet_main.cpp:16-478).
+
+Usage: ``python -m cxxnet_tpu <config> [k=v ...]``
+
+Tasks (``task = ...``): train (default) / finetune / pred / extract.
+Config sections: ``data = <name> ... iter = end`` (training set),
+``eval = <name> ... iter = end`` (eval sets), ``pred = <path> ... iter = end``
+(prediction input). Global pairs outside sections are broadcast to the trainer
+and every iterator, as in CreateIterators (cxxnet_main.cpp:214-264).
+
+Behavioral parity: round loop with progress to stdout and eval lines to stderr
+in ``[round]\\tname-metric:value`` format (cxxnet_main.cpp:390-403); snapshots
+``{model_dir}/%04d.model`` every ``save_model`` rounds; ``continue = 1`` scans
+model_dir for the newest snapshot; ``test_io = 1`` exercises the input pipeline
+without touching the net.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .io import create_iterator
+from .nnet.net import Net
+from .utils.config import load_config, tokenize
+
+Pairs = List[Tuple[str, str]]
+
+
+class LearnTask:
+    def __init__(self) -> None:
+        self.cfg: Pairs = []
+        self.task = "train"
+        self.net_type = 0
+        self.print_step = 100
+        self.continue_training = 0
+        self.save_period = 1      # reference default: snapshot every round
+        self.start_counter = 1
+        self.model_in = "NULL"
+        self.model_dir = "./"
+        self.num_round = 10
+        self.max_round = 1 << 30
+        self.silent = 0
+        self.test_io = 0
+        self.extract_node_name = ""
+        self.output_format = 1
+        self.name_pred = "pred.txt"
+        self.net: Optional[Net] = None
+        self.itr_train = None
+        self.itr_evals = []
+        self.eval_names = []
+        self.itr_pred = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if val == "default":
+            return
+        if name == "print_step":
+            self.print_step = int(val)
+        elif name == "continue":
+            self.continue_training = int(val)
+        elif name == "save_model":
+            self.save_period = int(val)
+        elif name == "start_counter":
+            self.start_counter = int(val)
+        elif name == "model_in":
+            self.model_in = val
+        elif name == "model_dir":
+            self.model_dir = val
+        elif name == "num_round":
+            self.num_round = int(val)
+        elif name == "max_round":
+            self.max_round = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "task":
+            self.task = val
+        elif name == "test_io":
+            self.test_io = int(val)
+        elif name == "extract_node_name":
+            self.extract_node_name = val
+        elif name == "output_format":
+            self.output_format = 1 if val == "txt" else 0
+        self.cfg.append((name, val))
+
+    # ------------------------------------------------------------------
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: python -m cxxnet_tpu <config> [k=v ...]")
+            return 0
+        if not os.path.exists(argv[0]):
+            print("cannot open config file %r" % argv[0], file=sys.stderr)
+            return 1
+        for name, val in load_config(argv[0]):
+            self.set_param(name, val)
+        for arg in argv[1:]:
+            m = re.match(r"^([^=]+)=(.*)$", arg)
+            if m:
+                self.set_param(m.group(1), m.group(2))
+        self.init()
+        if not self.silent:
+            print("initializing end, start working")
+        if self.task in ("train", "finetune"):
+            self.task_train()
+        elif self.task == "pred":
+            self.task_predict()
+        elif self.task == "extract":
+            self.task_extract()
+        else:
+            raise ValueError("unknown task %r" % self.task)
+        return 0
+
+    # ------------------------------------------------------------------
+    def _trainer_cfg(self) -> Pairs:
+        """Global pairs outside iterator sections."""
+        out, flag = [], 0
+        for name, val in self.cfg:
+            if name in ("data", "eval", "pred"):
+                flag = 1
+                continue
+            if name == "iter" and val == "end":
+                flag = 0
+                continue
+            if flag == 0 and name != "iter":
+                out.append((name, val))
+        return out
+
+    def init(self) -> None:
+        if self.task == "train" and self.continue_training:
+            if self._sync_latest_model():
+                print("Init: continue training from round %d"
+                      % self.start_counter)
+                self._create_iterators()
+                return
+            self.continue_training = 0
+        if self.model_in == "NULL":
+            assert self.task == "train", "must specify model_in if not training"
+            self.net = Net(self._trainer_cfg())
+            self.net.init_model()
+        elif self.task == "finetune":
+            old = Net()
+            old.load_model(self.model_in)
+            self.net = Net(self._trainer_cfg())
+            self.net.init_model()
+            self.net.copy_model_from(old)
+        else:
+            self.net = Net(self._trainer_cfg())
+            self.net.load_model(self.model_in)
+        self._create_iterators()
+
+    def _sync_latest_model(self) -> bool:
+        """Scan model_dir for the newest %04d.model (cxxnet_main.cpp:135-157)."""
+        best = -1
+        if os.path.isdir(self.model_dir):
+            for f in os.listdir(self.model_dir):
+                m = re.match(r"^(\d{4})\.model$", f)
+                if m:
+                    best = max(best, int(m.group(1)))
+        if best < 0:
+            return False
+        self.net = Net(self._trainer_cfg())
+        self.net.load_model(os.path.join(self.model_dir, "%04d.model" % best))
+        self.start_counter = best + 1
+        return True
+
+    def _create_iterators(self) -> None:
+        flag = 0
+        evname = ""
+        itcfg: Pairs = []
+        defcfg: Pairs = []
+        sections = []   # (flag, evname, itcfg)
+        for name, val in self.cfg:
+            if name == "data":
+                flag = 1
+                continue
+            if name == "eval":
+                evname = val
+                flag = 2
+                continue
+            if name == "pred":
+                flag = 3
+                self.name_pred = val
+                continue
+            if name == "iter" and val == "end":
+                assert flag != 0, "wrong configuration file"
+                sections.append((flag, evname, list(itcfg)))
+                flag = 0
+                itcfg = []
+                continue
+            (itcfg if flag else defcfg).append((name, val))
+        for sflag, sname, scfg in sections:
+            # section config first, then globals — matching the reference's
+            # CreateIterator-then-InitIter(defcfg) order (cxxnet_main.cpp:254-262)
+            full = scfg + defcfg
+            if sflag == 1 and self.task != "pred":
+                assert self.itr_train is None, "can only have one data section"
+                self.itr_train = create_iterator(full)
+            elif sflag == 2 and self.task != "pred":
+                self.itr_evals.append(create_iterator(full))
+                self.eval_names.append(sname)
+            elif sflag == 3 and self.task in ("pred", "extract"):
+                assert self.itr_pred is None, "can only have one pred section"
+                self.itr_pred = create_iterator(full)
+
+    # ------------------------------------------------------------------
+    def save_model(self) -> None:
+        if self.save_period == 0 or (self.start_counter % self.save_period):
+            return
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.net.save_model(os.path.join(self.model_dir,
+                                         "%04d.model" % self.start_counter))
+
+    def task_train(self) -> None:
+        start = time.time()
+        if self.continue_training == 0 and self.model_in == "NULL":
+            pass      # fresh start
+        else:
+            for itr, name in zip(self.itr_evals, self.eval_names):
+                sys.stderr.write(self.net.evaluate(itr, name))
+            sys.stderr.write("\n")
+            sys.stderr.flush()
+        if self.itr_train is None:
+            return
+        if self.test_io:
+            print("start I/O test")
+        cc = self.max_round
+        while self.start_counter <= self.num_round and cc > 0:
+            cc -= 1
+            if not self.silent:
+                print("update round %d" % (self.start_counter - 1))
+            sample_counter = 0
+            self.net.start_round(self.start_counter)
+            self.itr_train.before_first()
+            while self.itr_train.next():
+                if self.test_io == 0:
+                    self.net.update(self.itr_train.value())
+                sample_counter += 1
+                if sample_counter % self.print_step == 0 and not self.silent:
+                    elapsed = int(time.time() - start)
+                    sys.stdout.write("\r%-63s\r" % "")
+                    sys.stdout.write("round %8d:[%8d] %d sec elapsed"
+                                     % (self.start_counter - 1, sample_counter,
+                                        elapsed))
+                    sys.stdout.flush()
+            if self.test_io == 0:
+                sys.stderr.write("[%d]" % self.start_counter)
+                if not self.itr_evals:
+                    sys.stderr.write(self.net.evaluate(None, "train"))
+                for itr, name in zip(self.itr_evals, self.eval_names):
+                    sys.stderr.write(self.net.evaluate(itr, name))
+                sys.stderr.write("\n")
+                sys.stderr.flush()
+            self.save_model()
+            self.start_counter += 1
+        if not self.silent:
+            print("\nupdating end, %d sec in all" % int(time.time() - start))
+
+    def task_predict(self) -> None:
+        assert self.itr_pred is not None, "must specify a pred iterator"
+        print("start predicting...")
+        with open(self.name_pred, "w") as fo:
+            self.itr_pred.before_first()
+            while self.itr_pred.next():
+                batch = self.itr_pred.value()
+                for v in self.net.predict(batch):
+                    fo.write("%g\n" % v)
+        print("finished prediction, write into %s" % self.name_pred)
+
+    def task_extract(self) -> None:
+        assert self.itr_pred is not None, "must specify a pred iterator"
+        node = self.extract_node_name
+        assert node, "must set extract_node_name"
+        print("start extracting...")
+        rows = []
+        self.itr_pred.before_first()
+        while self.itr_pred.next():
+            batch = self.itr_pred.value()
+            out = self.net.extract_feature(batch, node)
+            rows.append(out.reshape(out.shape[0], -1))
+        feats = np.concatenate(rows, axis=0) if rows else np.zeros((0, 0))
+        if self.output_format == 1:
+            with open(self.name_pred, "w") as fo:
+                for row in feats:
+                    fo.write(" ".join("%g" % v for v in row) + "\n")
+        else:
+            feats.astype("<f4").tofile(self.name_pred)
+            with open(self.name_pred + ".meta", "w") as fo:
+                fo.write("%d %d" % (feats.shape[0], feats.shape[1]))
+        print("finished extraction, write into %s" % self.name_pred)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    return LearnTask().run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,286 @@
+"""Network graph IR — the model structure parsed from the ``netconfig`` DSL.
+
+Capability parity with the reference model IR (/root/reference/src/nnet/nnet_config.h:26-411):
+an ordered list of layers over a set of named nodes. Grammar accepted for layer
+declarations (nnet_config.h:303-360):
+
+- ``layer[+1:tag] = type:name``  — input is the previous top node, output is a
+  new node named ``tag``
+- ``layer[+1] = type``           — output is a fresh anonymous node
+- ``layer[+0] = type``           — self-loop layer (in == out), e.g. dropout, losses
+- ``layer[a,b->c] = type``       — explicit node names/indices, comma-separated fan-in/out
+- ``layer[...] = share[tag]``    — weight sharing with the primary layer named ``tag``
+- node 0 is named ``in``; ``extra_data_num = k`` adds nodes ``in_1..in_k``
+
+Config scoping (nnet_config.h:207-289): lines before/after the net block are
+global (``defcfg``); non-layer lines after a ``layer[...]`` declaration attach
+to that layer (``layercfg``). ``label_vec[a,b) = name`` registers named label
+fields (nnet_config.h:192-203); field ``label`` -> column 0 by default.
+
+The IR is framework-neutral: execution happens in :mod:`cxxnet_tpu.nnet` by
+walking ``layers`` in order (forward) — functional JAX, no mutation.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .utils.config import ConfigError
+
+Pairs = List[Tuple[str, str]]
+
+# layer types with no factory case in the reference (dead enums, layer.h:304/:290):
+# 'maxout' and 'softplus' parse but error at creation — we implement softplus
+# (trivial in JAX) and reject maxout with the same "unknown/unsupported" contract.
+KNOWN_LAYER_TYPES = frozenset([
+    "fullc", "fixconn", "bias", "softmax", "relu", "sigmoid", "tanh", "softplus",
+    "flatten", "dropout", "conv", "relu_max_pooling", "max_pooling", "sum_pooling",
+    "avg_pooling", "lrn", "concat", "xelu", "split", "insanity",
+    "insanity_max_pooling", "l2_loss", "multi_logistic", "ch_concat", "prelu",
+    "batch_norm", "share",
+])
+
+
+@dataclass
+class LayerSpec:
+    """One layer declaration: type + node wiring + scoped config."""
+    type: str                      # canonical type string ("conv", "fullc", ...)
+    name: str                      # user-given name ("" if anonymous)
+    inputs: List[int]
+    outputs: List[int]
+    primary: int = -1              # index of primary layer when type == "share"
+    cfg: Pairs = field(default_factory=list)
+    # for pairtest-master-slave differential testing (layer.h:354-358)
+    pairtest: Optional[Tuple[str, str]] = None
+
+    def key(self) -> str:
+        """Parameter-tree key for this layer (stable across runs)."""
+        return self.name if self.name else "!layer-%s" % "_".join(
+            map(str, self.outputs))
+
+    def struct_eq(self, other: "LayerSpec") -> bool:
+        return (self.type == other.type and self.name == other.name
+                and self.inputs == other.inputs and self.outputs == other.outputs
+                and self.primary == other.primary)
+
+
+_LAYER_PLUS = re.compile(r"^layer\[\+(\d+)(?::([^\]]+))?\]$")
+_LAYER_ARROW = re.compile(r"^layer\[([^\]>]+)->([^\]]+)\]$")
+_LABEL_VEC = re.compile(r"^label_vec\[(\d+),(\d+)\)$")
+_SHARE = re.compile(r"^share\[([^\]]+)\]$")
+
+
+class NetGraph:
+    """Parsed network structure + scoped configuration."""
+
+    def __init__(self) -> None:
+        self.node_names: List[str] = ["in"]
+        self.node_map: Dict[str, int] = {"in": 0, "0": 0}
+        self.layers: List[LayerSpec] = []
+        self.layer_name_map: Dict[str, int] = {}
+        self.defcfg: Pairs = []
+        self.input_shape: Optional[Tuple[int, int, int]] = None  # (c, y, x)
+        self.extra_data_num: int = 0
+        self.extra_shapes: List[Tuple[int, int, int]] = []
+        # label fields: name -> index into label_range; default field "label" is col [0,1)
+        self.label_name_map: Dict[str, int] = {"label": 0}
+        self.label_range: List[Tuple[int, int]] = [(0, 1)]
+        self.updater_type: str = "sgd"
+
+    # ---------------------------------------------------------------- parsing
+    def _node_index(self, name: str, alloc_unknown: bool) -> int:
+        name = name.strip()
+        if name in self.node_map:
+            return self.node_map[name]
+        if not alloc_unknown:
+            raise ConfigError(
+                "undefined node name %r: input of a layer must be the output of "
+                "an earlier layer" % name)
+        idx = len(self.node_names)
+        self.node_names.append(name)
+        self.node_map[name] = idx
+        return idx
+
+    def _parse_layer_decl(self, key: str, val: str, top_node: int,
+                          layer_index: int) -> LayerSpec:
+        m = _LAYER_PLUS.match(key)
+        if m:
+            inc, tag = int(m.group(1)), m.group(2)
+            if top_node < 0:
+                raise ConfigError(
+                    "layer[+%d] used but previous layer has multiple outputs; "
+                    "use layer[in->out] instead" % inc)
+            inputs = [top_node]
+            if tag is not None and inc == 1:
+                outputs = [self._node_index(tag, True)]
+            elif inc == 0:
+                outputs = [top_node]
+            else:
+                outputs = [self._node_index("!node-after-%d" % top_node, True)]
+        else:
+            m = _LAYER_ARROW.match(key)
+            if not m:
+                raise ConfigError("invalid layer declaration %r" % key)
+            inputs = [self._node_index(s, False) for s in m.group(1).split(",")]
+            outputs = [self._node_index(s, True) for s in m.group(2).split(",")]
+
+        # value: "type" or "type:name"; share[tag] / pairtest-a-b special forms
+        if ":" in val:
+            ltype, lname = val.split(":", 1)
+        else:
+            ltype, lname = val, ""
+        pairtest = None
+        sm = _SHARE.match(ltype)
+        if ltype.startswith("share"):
+            if sm is None:
+                raise ConfigError("shared layer must specify share[tag]: %r" % val)
+            tag = sm.group(1)
+            if tag not in self.layer_name_map:
+                raise ConfigError("shared layer tag %r not defined before" % tag)
+            return LayerSpec("share", "", inputs, outputs,
+                             primary=self.layer_name_map[tag])
+        if ltype.startswith("pairtest-"):
+            parts = ltype[len("pairtest-"):].split("-")
+            if len(parts) != 2:
+                raise ConfigError("pairtest layer must be pairtest-master-slave")
+            for p in parts:
+                if p not in KNOWN_LAYER_TYPES:
+                    raise ConfigError("unknown layer type %r" % p)
+            pairtest = (parts[0], parts[1])
+            ltype = "pairtest"
+        elif ltype not in KNOWN_LAYER_TYPES:
+            raise ConfigError("unknown layer type %r" % ltype)
+        if lname:
+            if lname in self.layer_name_map:
+                if self.layer_name_map[lname] != layer_index:
+                    raise ConfigError(
+                        "layer name %r does not match the stored network" % lname)
+            else:
+                self.layer_name_map[lname] = layer_index
+        return LayerSpec(ltype, lname, inputs, outputs, pairtest=pairtest)
+
+    def configure(self, cfg: Pairs) -> "NetGraph":
+        """Parse an ordered (name, value) list. Re-configuring an already-built
+        graph validates structural equality instead of rebuilding
+        (nnet_config.h:267-271)."""
+        first_time = not self.layers
+        netcfg_mode = 0      # 0 global, 1 inside netconfig, 2 after a layer decl
+        top_node = 0
+        layer_index = 0
+        if not first_time:
+            for lyr in self.layers:
+                lyr.cfg = []
+            self.defcfg = []
+        for name, val in cfg:
+            if name == "extra_data_num":
+                self.extra_data_num = int(val)
+                for i in range(self.extra_data_num):
+                    nm = "in_%d" % (i + 1)
+                    if nm not in self.node_map:
+                        # extra-data nodes get indices 1..k (nnet_config.h:224-235)
+                        self.node_names.insert(i + 1, nm)
+                        self.node_map = {n: j for j, n in enumerate(self.node_names)}
+                        self.node_map["0"] = 0
+            m = re.match(r"^extra_data_shape\[(\d+)\]$", name)
+            if m:
+                dims = tuple(int(x) for x in val.split(","))
+                if len(dims) != 3:
+                    raise ConfigError("extra_data_shape must be c,y,x")
+                self.extra_shapes.append(dims)
+            if name == "input_shape" and first_time:
+                dims = tuple(int(x) for x in val.split(","))
+                if len(dims) != 3:
+                    raise ConfigError(
+                        "input_shape must be three comma-separated ints, e.g. 1,1,784")
+                self.input_shape = dims    # (c, y, x)
+            if netcfg_mode != 2:
+                self._set_global(name, val)
+            if name == "netconfig" and val == "start":
+                netcfg_mode = 1
+            if name == "netconfig" and val == "end":
+                netcfg_mode = 0
+            if name.startswith("layer["):
+                info = self._parse_layer_decl(name, val, top_node, layer_index)
+                netcfg_mode = 2
+                if first_time:
+                    self.layers.append(info)
+                else:
+                    if layer_index >= len(self.layers):
+                        raise ConfigError("config layer index exceeds stored network")
+                    if not info.struct_eq(self.layers[layer_index]):
+                        raise ConfigError(
+                            "config does not match existing network structure at "
+                            "layer %d" % layer_index)
+                top_node = info.outputs[0] if len(info.outputs) == 1 else -1
+                layer_index += 1
+                continue
+            if netcfg_mode == 2:
+                if self.layers[layer_index - 1].type == "share":
+                    raise ConfigError(
+                        "do not set parameters on a shared layer; set them on "
+                        "the primary layer")
+                self.layers[layer_index - 1].cfg.append((name, val))
+            else:
+                self.defcfg.append((name, val))
+        return self
+
+    def _set_global(self, name: str, val: str) -> None:
+        if name == "updater":
+            self.updater_type = val
+        m = _LABEL_VEC.match(name)
+        if m:
+            self.label_range.append((int(m.group(1)), int(m.group(2))))
+            self.label_name_map[val] = len(self.label_range) - 1
+
+    # --------------------------------------------------------------- queries
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+    def layer_index(self, name: str) -> int:
+        if name not in self.layer_name_map:
+            raise KeyError("unknown layer name %r" % name)
+        return self.layer_name_map[name]
+
+    def label_field(self, name: str) -> Tuple[int, int]:
+        """Column range [a, b) of a named label field in the label matrix."""
+        return self.label_range[self.label_name_map[name]]
+
+    # --------------------------------------------------------- serialization
+    def structure_state(self) -> dict:
+        """JSON-serializable network structure (the SaveNet/LoadNet analogue,
+        nnet_config.h:126-191). Training params (defcfg/layercfg) are NOT
+        saved — they are re-read from the config each run."""
+        return {
+            "node_names": self.node_names,
+            "input_shape": list(self.input_shape) if self.input_shape else None,
+            "extra_data_num": self.extra_data_num,
+            "extra_shapes": [list(s) for s in self.extra_shapes],
+            "layers": [
+                {"type": l.type, "name": l.name, "inputs": l.inputs,
+                 "outputs": l.outputs, "primary": l.primary}
+                for l in self.layers
+            ],
+        }
+
+    @classmethod
+    def from_structure_state(cls, state: dict) -> "NetGraph":
+        g = cls()
+        g.node_names = list(state["node_names"])
+        g.node_map = {n: i for i, n in enumerate(g.node_names)}
+        g.node_map["0"] = 0
+        if state.get("input_shape"):
+            g.input_shape = tuple(state["input_shape"])
+        g.extra_data_num = state.get("extra_data_num", 0)
+        g.extra_shapes = [tuple(s) for s in state.get("extra_shapes", [])]
+        for i, l in enumerate(state["layers"]):
+            spec = LayerSpec(l["type"], l["name"], list(l["inputs"]),
+                             list(l["outputs"]), primary=l.get("primary", -1))
+            g.layers.append(spec)
+            if spec.name:
+                if spec.name in g.layer_name_map:
+                    raise ConfigError("duplicated layer name %r" % spec.name)
+                g.layer_name_map[spec.name] = i
+        return g

@@ -1,0 +1,9 @@
+"""Data pipeline package. Importing registers all iterator types."""
+
+from .data import (DataBatch, DataInst, IIterator, create_iterator,
+                   register_base_iterator, register_proc_iterator)
+from . import mnist    # noqa: F401
+from . import batch    # noqa: F401
+
+__all__ = ["DataBatch", "DataInst", "IIterator", "create_iterator",
+           "register_base_iterator", "register_proc_iterator"]

@@ -1,0 +1,249 @@
+"""Batching and buffering processor iterators.
+
+Reference (/root/reference/src/io/iter_batch_proc-inl.hpp, iter_mem_buffer-inl.hpp):
+- BatchAdaptIterator (16-133): packs DataInst -> DataBatch; tail handling:
+  ``round_batch=1`` wraps to the start of the epoch and counts the wrapped
+  instances as ``num_batch_padd`` (for eval correctness), else short-pads and
+  sets num_batch_padd = missing count; ``test_skipread`` freezes one batch for
+  compute-throughput benchmarking.
+- ThreadBufferIterator (136-224): background-thread prefetch of whole batches
+  (the ThreadBuffer double-buffer pipeline, utils/thread_buffer.h) — here a
+  bounded-queue producer thread, which is the idiomatic Python equivalent.
+- DenseBufferIterator (17-77): caches the first max_nbatch batches in RAM and
+  replays them (dataset-in-memory mode).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .data import (DataBatch, DataInst, IIterator, register_proc_iterator)
+
+
+class BatchAdaptIterator(IIterator):
+    """DataInst iterator -> DataBatch iterator of fixed batch_size."""
+
+    def __init__(self, base: IIterator) -> None:
+        self.base = base
+        self.batch_size = 0
+        self.label_width = 1
+        self.round_batch = 0
+        self.num_overflow = 0
+        self.test_skipread = 0
+        self.silent = 0
+        self.head = 1
+        self._value: Optional[DataBatch] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "round_batch":
+            self.round_batch = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "test_skipread":
+            self.test_skipread = int(val)
+
+    def init(self) -> None:
+        assert self.batch_size > 0, "batch_size must be set"
+        self.base.init()
+
+    def before_first(self) -> None:
+        if self.round_batch == 0 or self.num_overflow == 0:
+            self.base.before_first()
+        else:
+            self.num_overflow = 0
+        self.head = 1
+
+    def _collect(self, insts: List[DataInst]) -> DataBatch:
+        data = np.stack([d.data for d in insts]).astype(np.float32)
+        label = np.zeros((len(insts), self.label_width), np.float32)
+        for i, d in enumerate(insts):
+            lab = np.asarray(d.label, np.float32).reshape(-1)
+            label[i, :min(self.label_width, lab.shape[0])] = \
+                lab[:self.label_width]
+        index = np.array([d.index for d in insts], np.uint32)
+        extra = []
+        if insts[0].extra_data:
+            for k in range(len(insts[0].extra_data)):
+                extra.append(np.stack([d.extra_data[k] for d in insts]))
+        return DataBatch(data, label, index, 0, extra)
+
+    def next(self) -> bool:
+        if self.test_skipread and self.head == 0 and self._value is not None:
+            return True
+        self.head = 0
+        if self.num_overflow != 0:
+            return False
+        insts: List[DataInst] = []
+        while self.base.next():
+            insts.append(self.base.value())
+            if len(insts) >= self.batch_size:
+                self._value = self._collect(insts)
+                return True
+        if insts:
+            if self.round_batch != 0:
+                self.num_overflow = 0
+                self.base.before_first()
+                while len(insts) < self.batch_size:
+                    assert self.base.next(), \
+                        "number of inputs must exceed batch size"
+                    insts.append(self.base.value())
+                    self.num_overflow += 1
+                batch = self._collect(insts)
+                batch.num_batch_padd = self.num_overflow
+                batch.pad_mode = "wrap"    # real wrapped instances: trained on
+            else:
+                missing = self.batch_size - len(insts)
+                # short batch: pad by repeating the last instance to keep
+                # shapes static (XLA), and mark the padding count
+                insts.extend([insts[-1]] * missing)
+                batch = self._collect(insts)
+                batch.num_batch_padd = missing
+                batch.pad_mode = "short"   # duplicates: masked out of the loss
+            self._value = batch
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        return self._value
+
+
+@register_proc_iterator("threadbuffer")
+class ThreadBufferIterator(IIterator):
+    """Background-thread prefetch with a bounded queue (double-buffer analogue)."""
+
+    _STOP = object()
+    _END = object()
+
+    def __init__(self, base: IIterator, buffer_size: int = 2) -> None:
+        self.base = base
+        self.buffer_size = buffer_size
+        self.silent = 0
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._reset = threading.Event()
+        self._value = None
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "buffer_size":
+            self.buffer_size = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self) -> None:
+        self.base.init()
+        self._queue = queue.Queue(maxsize=self.buffer_size)
+        self._cmd: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        self._started = False
+        self._epoch_done = True
+        self.before_first()
+
+    def _producer(self) -> None:
+        while True:
+            cmd = self._cmd.get()
+            if cmd is self._STOP:
+                return
+            # cmd == "epoch": produce one full epoch then signal end
+            self.base.before_first()
+            while self.base.next():
+                v = self.base.value()
+                # deep-copy: the base may reuse buffers (CopyFromDense analogue)
+                self._queue.put(DataBatch(np.array(v.data), np.array(v.label),
+                                          None if v.inst_index is None
+                                          else np.array(v.inst_index),
+                                          v.num_batch_padd,
+                                          [np.array(e) for e in v.extra_data],
+                                          v.pad_mode))
+            self._queue.put(self._END)
+
+    def before_first(self) -> None:
+        # drain the rest of an in-flight epoch before starting a new one
+        if self._started and not self._epoch_done:
+            while self._queue.get() is not self._END:
+                pass
+        self._cmd.put("epoch")
+        self._started = True
+        self._epoch_done = False
+
+    def next(self) -> bool:
+        if self._epoch_done:
+            return False
+        item = self._queue.get()
+        if item is self._END:
+            self._epoch_done = True
+            return False
+        self._value = item
+        return True
+
+    def value(self):
+        return self._value
+
+    def __del__(self):
+        try:
+            if self._thread is not None:
+                self._cmd.put(self._STOP)
+        except Exception:
+            pass
+
+
+@register_proc_iterator("membuffer")
+class DenseBufferIterator(IIterator):
+    """Caches the first max_nbatch batches in RAM and replays them."""
+
+    def __init__(self, base: IIterator) -> None:
+        self.base = base
+        self.max_nbatch = 1 << 30
+        self.silent = 0
+        self._cache: List[DataBatch] = []
+        self._filled = False
+        self._pos = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "max_nbatch":
+            self.max_nbatch = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def init(self) -> None:
+        self.base.init()
+
+    def before_first(self) -> None:
+        # the base is consumed exactly once, sequentially, into the cache;
+        # rewinding it mid-fill would duplicate batches in the replay cache
+        self._pos = 0
+
+    def next(self) -> bool:
+        if self._pos < len(self._cache):
+            self._value = self._cache[self._pos]
+            self._pos += 1
+            return True
+        if not self._filled and len(self._cache) < self.max_nbatch \
+                and self.base.next():
+            v = self.base.value()
+            batch = DataBatch(np.array(v.data), np.array(v.label),
+                              None if v.inst_index is None
+                              else np.array(v.inst_index),
+                              v.num_batch_padd,
+                              [np.array(e) for e in v.extra_data],
+                              v.pad_mode)
+            self._cache.append(batch)
+            self._pos += 1
+            self._value = batch
+            return True
+        self._filled = True
+        return False
+
+    def value(self):
+        return self._value

@@ -1,0 +1,123 @@
+"""Data pipeline core: instance/batch types, iterator interface, chain factory.
+
+Reference (/root/reference/src/io/data.h:18-186, data.cpp:23-75): chainable
+iterators configured by ordered ``iter = X`` lines; settings after an ``iter``
+line are broadcast to every iterator already in the chain. Base iterators
+(mnist/img/imgbin) cannot chain over others; processor iterators
+(threadbuffer/membuffer/attachtxt) wrap the chain built so far.
+
+Host-side batches are numpy, NCHW ``(n, c, y, x)`` float32 — the reference's
+node layout — and the trainer transposes to the TPU-native NHWC once per step
+on device entry.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Pairs = Sequence[Tuple[str, str]]
+
+
+class DataBatch:
+    """One mini-batch (data.h:96-181, dense path)."""
+
+    def __init__(self, data: np.ndarray, label: np.ndarray,
+                 inst_index: Optional[np.ndarray] = None,
+                 num_batch_padd: int = 0,
+                 extra_data: Optional[List[np.ndarray]] = None,
+                 pad_mode: str = "wrap") -> None:
+        self.data = data                    # (n, c, y, x) float32
+        self.label = label                  # (n, label_width) float32
+        self.inst_index = inst_index
+        self.num_batch_padd = num_batch_padd
+        self.extra_data = extra_data or []
+        # how the padded tail was produced: "wrap" = real wrapped instances
+        # (trained on, excluded from eval); "short" = duplicated filler
+        # (masked out of the loss too)
+        self.pad_mode = pad_mode
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class DataInst:
+    """One instance (data.h:41-56)."""
+
+    def __init__(self, data: np.ndarray, label: np.ndarray, index: int,
+                 extra_data: Optional[List[np.ndarray]] = None) -> None:
+        self.data = data                    # (c, y, x) float32
+        self.label = label                  # (label_width,) float32
+        self.index = index
+        self.extra_data = extra_data or []
+
+
+class IIterator:
+    """Iterator contract (data.h:18-38): set_param / init / before_first /
+    next / value. ``next`` returns bool; ``value`` the current element."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    def value(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value()
+
+
+# base iterators produce DataBatch directly (mnist) or DataInst (img family);
+# the factory composes processors exactly as data.cpp:23-75 does.
+_BASE_FACTORIES: Dict[str, Callable[[], "IIterator"]] = {}
+_PROC_FACTORIES: Dict[str, Callable[["IIterator"], "IIterator"]] = {}
+
+
+def register_base_iterator(name: str):
+    def deco(factory):
+        _BASE_FACTORIES[name] = factory
+        return factory
+    return deco
+
+
+def register_proc_iterator(name: str):
+    def deco(factory):
+        _PROC_FACTORIES[name] = factory
+        return factory
+    return deco
+
+
+def create_iterator(cfg: Pairs) -> IIterator:
+    """Build an iterator chain from ordered config pairs (data.cpp:23-75)."""
+    it: Optional[IIterator] = None
+    for name, val in cfg:
+        if name == "iter":
+            if val in _BASE_FACTORIES:
+                if it is not None:
+                    raise ValueError("%s cannot chain over another iterator" % val)
+                it = _BASE_FACTORIES[val]()
+            elif val in _PROC_FACTORIES:
+                if it is None:
+                    raise ValueError("must specify input of %s" % val)
+                it = _PROC_FACTORIES[val](it)
+            else:
+                raise ValueError("unknown iterator type %r" % val)
+            continue
+        if it is not None:
+            it.set_param(name, val)
+    if it is None:
+        raise ValueError("must specify iterator by iter=itername")
+    it.init()
+    return it

@@ -1,0 +1,101 @@
+"""MNIST idx-format iterator (reference: /root/reference/src/io/iter_mnist-inl.hpp:14-158).
+
+Loads gz (or raw) idx images/labels wholly into memory, scales pixels by 1/256,
+optional flatten to (1,1,784) (``input_flat``, default on), in-memory shuffle
+with a seeded RNG, and drops the tail partial batch (Next at :62-73).
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from .data import DataBatch, IIterator, register_base_iterator
+
+_RAND_MAGIC = 121
+
+
+def _open_maybe_gz(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Read an idx-format array (images: magic 2051, labels: 2049)."""
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">i", f.read(4))[0]
+        ndim = magic % 256
+        dims = [struct.unpack(">i", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+@register_base_iterator("mnist")
+class MNISTIterator(IIterator):
+    def __init__(self) -> None:
+        self.mode = 1            # input_flat
+        self.silent = 0
+        self.shuffle = 0
+        self.inst_offset = 0
+        self.batch_size = 0
+        self.path_img = ""
+        self.path_label = ""
+        self.seed = _RAND_MAGIC
+        self.loc = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "silent":
+            self.silent = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "input_flat":
+            self.mode = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "index_offset":
+            self.inst_offset = int(val)
+        elif name == "path_img":
+            self.path_img = val
+        elif name == "path_label":
+            self.path_label = val
+        elif name == "seed_data":
+            self.seed = _RAND_MAGIC + int(val)
+
+    def init(self) -> None:
+        img = read_idx(self.path_img).astype(np.float32) * (1.0 / 256.0)
+        label = read_idx(self.path_label).astype(np.float32)
+        assert img.shape[0] == label.shape[0]
+        n, rows, cols = img.shape
+        if self.mode == 1:
+            self.img = img.reshape(n, 1, 1, rows * cols)
+        else:
+            self.img = img.reshape(n, 1, rows, cols)
+        self.labels = label.reshape(n, 1)
+        self.inst = np.arange(n, dtype=np.uint32) + self.inst_offset
+        if self.shuffle:
+            order = np.random.RandomState(self.seed).permutation(n)
+            self.img = self.img[order]
+            self.labels = self.labels[order]
+            self.inst = self.inst[order]
+        self.loc = 0
+        if self.silent == 0:
+            print("MNISTIterator: load %d images, shuffle=%d, shape=%s"
+                  % (n, self.shuffle, (self.batch_size,) + self.img.shape[1:]))
+
+    def before_first(self) -> None:
+        self.loc = 0
+
+    def next(self) -> bool:
+        if self.loc + self.batch_size <= self.img.shape[0]:
+            i, b = self.loc, self.batch_size
+            self._value = DataBatch(self.img[i:i + b], self.labels[i:i + b],
+                                    self.inst[i:i + b])
+            self.loc += b
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        return self._value

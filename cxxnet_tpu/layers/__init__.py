@@ -1,0 +1,11 @@
+"""Layer library: functional TPU-native equivalents of the reference layer zoo
+(/root/reference/src/layer/). Importing this package populates the registry."""
+
+from .base import (ApplyContext, Layer, LayerParam, LAYER_REGISTRY,
+                   create_layer, register_layer)
+from . import simple   # noqa: F401  (registers dense/activation/structural layers)
+from . import conv     # noqa: F401  (registers conv/pooling/lrn/batch_norm)
+from . import loss     # noqa: F401  (registers softmax/l2_loss/multi_logistic)
+
+__all__ = ["ApplyContext", "Layer", "LayerParam", "LAYER_REGISTRY",
+           "create_layer", "register_layer"]

@@ -1,0 +1,224 @@
+"""Layer base classes, parameter parsing, weight init, and the type registry.
+
+TPU-first redesign of the reference layer system (/root/reference/src/layer/layer.h:161-279):
+layers here are *pure functions* — ``apply(params, inputs, ctx) -> outputs`` — so the
+whole graph executes inside one jitted, differentiable train step. There are no
+gradient buffers and no Backprop methods: JAX autodiff replaces the hand-derived
+backward passes, and XLA fuses what mshadow expression templates used to fuse.
+
+Runtime node layout is **NHWC** ``(batch, y, x, channel)`` — the layout the TPU
+MXU/XLA prefers — while config-level shapes remain the reference's logical
+``(channel, y, x)`` triples (layer.h:30-71 uses NCHW). Matrix nodes are
+``(batch, 1, 1, length)`` in both conventions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph import LayerSpec
+from ..utils.config import ConfigError
+
+Shape3 = Tuple[int, int, int]          # logical (c, y, x)
+Params = Dict[str, jnp.ndarray]
+
+
+class LayerParam:
+    """Common hyper-parameters, same names/defaults as the reference
+    (/root/reference/src/layer/param.h:15-111)."""
+
+    def __init__(self) -> None:
+        self.init_sigma = 0.01
+        self.init_uniform = -1.0
+        self.init_sparse = 10
+        self.init_bias = 0.0
+        self.random_type = 0           # 0 gaussian, 1 uniform/xavier, 2 kaiming
+        self.num_hidden = 0
+        self.num_channel = 0
+        self.num_group = 1
+        self.kernel_width = 0
+        self.kernel_height = 0
+        self.stride = 1
+        self.pad_x = 0
+        self.pad_y = 0
+        self.no_bias = 0
+        self.silent = 0
+        self.num_input_channel = 0
+        self.num_input_node = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "init_sigma":
+            self.init_sigma = float(val)
+        elif name == "init_uniform":
+            self.init_uniform = float(val)
+        elif name == "init_bias":
+            self.init_bias = float(val)
+        elif name == "init_sparse":
+            self.init_sparse = int(val)
+        elif name == "random_type":
+            if val == "gaussian":
+                self.random_type = 0
+            elif val in ("uniform", "xavier"):
+                self.random_type = 1
+            elif val == "kaiming":
+                self.random_type = 2
+            else:
+                raise ConfigError("invalid random_type %r" % val)
+        elif name == "nhidden":
+            self.num_hidden = int(val)
+        elif name == "nchannel":
+            self.num_channel = int(val)
+        elif name == "ngroup":
+            self.num_group = int(val)
+        elif name == "kernel_size":
+            self.kernel_width = self.kernel_height = int(val)
+        elif name == "kernel_height":
+            self.kernel_height = int(val)
+        elif name == "kernel_width":
+            self.kernel_width = int(val)
+        elif name == "stride":
+            self.stride = int(val)
+        elif name == "pad":
+            self.pad_x = self.pad_y = int(val)
+        elif name == "pad_x":
+            self.pad_x = int(val)
+        elif name == "pad_y":
+            self.pad_y = int(val)
+        elif name == "no_bias":
+            self.no_bias = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+
+    def rand_init(self, key: jax.Array, shape: Sequence[int],
+                  in_num: int, out_num: int) -> jnp.ndarray:
+        """Weight init with the reference's schemes (param.h:113-138):
+        gaussian(init_sigma) | xavier-uniform sqrt(3/(in+out)) | kaiming."""
+        if self.random_type == 0:
+            return self.init_sigma * jax.random.normal(key, shape, jnp.float32)
+        if self.random_type == 1:
+            a = math.sqrt(3.0 / (in_num + out_num))
+            if self.init_uniform > 0:
+                a = self.init_uniform
+            return jax.random.uniform(key, shape, jnp.float32, -a, a)
+        if self.random_type == 2:
+            if self.num_hidden > 0:
+                sigma = math.sqrt(2.0 / self.num_hidden)
+            else:
+                sigma = math.sqrt(
+                    2.0 / (self.num_channel * self.kernel_width * self.kernel_height))
+            return sigma * jax.random.normal(key, shape, jnp.float32)
+        raise ConfigError("unsupported random_type %d" % self.random_type)
+
+
+class ApplyContext:
+    """Per-step execution context threaded through layer ``apply`` calls.
+
+    Replaces the reference's LabelInfo plumbing + per-layer RNG + loss-layer
+    batch scaling (loss_layer_base-inl.hpp:61-63). ``losses`` collects scalar
+    loss contributions; autodiff of their sum reproduces the reference's
+    hand-written loss gradients.
+    """
+
+    def __init__(self, train: bool, rng: Optional[jax.Array],
+                 labels: Optional[Dict[str, jnp.ndarray]] = None,
+                 sample_mask: Optional[jnp.ndarray] = None,
+                 batch_size: int = 0, update_period: int = 1,
+                 epoch=0, states: Optional[dict] = None) -> None:
+        self.train = train
+        self._rng = rng
+        self._rng_count = 0
+        self.labels = labels or {}
+        self.sample_mask = sample_mask    # (batch,) 1.0 = real sample, 0.0 = pad
+        self.batch_size = batch_size      # configured *global* batch size
+        self.update_period = update_period
+        self.epoch = epoch                # update-step counter (traced scalar ok)
+        self.losses: List[jnp.ndarray] = []
+        # mutable per-layer state (e.g. BN running stats), keyed by layer key:
+        # read from `states`, updates land in `new_states` (functional pytree)
+        self.states: dict = states or {}
+        self.new_states: dict = dict(self.states)
+
+    def next_key(self) -> jax.Array:
+        if self._rng is None:
+            raise RuntimeError("layer requested randomness but no rng was provided")
+        self._rng_count += 1
+        return jax.random.fold_in(self._rng, self._rng_count)
+
+    def mask4(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Broadcast the sample mask against a (b, ...) tensor."""
+        if self.sample_mask is None:
+            return jnp.ones((x.shape[0],) + (1,) * (x.ndim - 1), x.dtype)
+        return self.sample_mask.astype(x.dtype).reshape(
+            (x.shape[0],) + (1,) * (x.ndim - 1))
+
+
+class Layer:
+    """Base class. Subclasses define shape inference, parameter init, and the
+    pure forward function. ``cfg`` is the merged global+scoped config."""
+
+    type_name: str = ""
+    uses_rng = False          # needs ctx rng at train time
+    is_loss = False
+
+    def __init__(self, spec: LayerSpec, cfg: Sequence[Tuple[str, str]]):
+        self.spec = spec
+        self.param = LayerParam()
+        self.cfg = list(cfg)
+        for k, v in self.cfg:
+            self.param.set_param(k, v)
+            self.set_param(k, v)
+
+    # hooks ------------------------------------------------------------
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        raise NotImplementedError
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
+        return {}
+
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        raise NotImplementedError
+
+    # helpers ----------------------------------------------------------
+    def check_one_to_one(self, in_shapes: List[Shape3]) -> Shape3:
+        if len(in_shapes) != 1:
+            raise ConfigError("%s: only supports 1-1 connection" % self.type_name)
+        return in_shapes[0]
+
+
+# ----------------------------------------------------------------------------
+# registry
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls: type) -> type:
+    LAYER_REGISTRY[cls.type_name] = cls
+    return cls
+
+
+def create_layer(spec: LayerSpec, global_cfg: Sequence[Tuple[str, str]]) -> Layer:
+    """Factory (layer_impl-inl.hpp:36-76 analogue). Config merge order mirrors
+    the reference: global defcfg first, then the layer-scoped block."""
+    if spec.type not in LAYER_REGISTRY:
+        raise ConfigError("unknown or unsupported layer type %r" % spec.type)
+    merged = list(global_cfg) + list(spec.cfg)
+    return LAYER_REGISTRY[spec.type](spec, merged)
+
+
+def logical_to_runtime(shape: Shape3) -> Tuple[int, int, int]:
+    """(c, y, x) logical -> (y, x, c) runtime NHWC order."""
+    c, y, x = shape
+    return (y, x, c)
+
+
+def flat_dim(shape: Shape3) -> int:
+    c, y, x = shape
+    return c * y * x

@@ -1,0 +1,135 @@
+"""Loss layers: softmax, l2_loss, multi_logistic.
+
+Reference semantics (/root/reference/src/layer/loss/):
+- loss layers are *self-loop* layers whose forward writes the prediction into
+  the node and whose backward writes the loss gradient scaled by
+  ``grad_scale / (batch_size * update_period)`` (loss_layer_base-inl.hpp:61-63)
+  — the global-batch normalization happens in the loss, not the updater.
+- ``target`` selects a named label field (loss_layer_base-inl.hpp:31-45).
+
+Here each loss layer both emits its forward output (so prediction/extraction
+see probabilities, as in the reference) and records a scalar loss contribution
+in the ApplyContext; ``d(total_loss)/d(input)`` under autodiff equals the
+reference's hand-written gradients exactly:
+- softmax  (softmax_layer-inl.hpp:23-32): grad = p - onehot  -> loss = sum CE
+- l2_loss  (l2_loss_layer-inl.hpp):       grad = pred - label -> loss = sum 0.5*(pred-label)^2
+- multi_logistic (multi_logistic_layer-inl.hpp): out = sigmoid(in),
+  grad = out - label -> loss = sum BCE(in, label)
+
+Padded samples (round_batch tail) are masked out of the loss and therefore
+out of the gradient — the static-shape answer to the reference's dynamic
+last-batch resizing (neural_net-inl.hpp:266-277).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.config import ConfigError
+from .base import ApplyContext, Layer, Params, Shape3, register_layer
+
+
+class LossLayer(Layer):
+    is_loss = True
+
+    def __init__(self, spec, cfg):
+        self.grad_scale = 1.0
+        self.target = "label"
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "grad_scale":
+            self.grad_scale = float(val)
+        elif name == "target":
+            self.target = val
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = self.check_one_to_one(in_shapes)
+        if self.spec.inputs != self.spec.outputs:
+            raise ConfigError("%s is a self-loop layer (layer[+0])"
+                              % self.type_name)
+        return [shape]
+
+    def scale(self, ctx: ApplyContext):
+        if ctx.batch_size <= 0:
+            raise ConfigError("loss layer requires batch_size to be configured")
+        return self.grad_scale / (ctx.batch_size * ctx.update_period)
+
+    def get_label(self, ctx: ApplyContext) -> jnp.ndarray:
+        if self.target not in ctx.labels:
+            raise ConfigError("loss target label field %r not found (have %r)"
+                              % (self.target, sorted(ctx.labels)))
+        return ctx.labels[self.target]
+
+    def mask1(self, ctx: ApplyContext, b: int) -> jnp.ndarray:
+        if ctx.sample_mask is None:
+            return jnp.ones((b,), jnp.float32)
+        return ctx.sample_mask.astype(jnp.float32)
+
+
+@register_layer
+class SoftmaxLayer(LossLayer):
+    """Forward: softmax over the flattened feature dim; loss: cross-entropy
+    against an integer class label (first column of the target field)."""
+    type_name = "softmax"
+
+    def apply(self, params: Params, inputs, ctx: ApplyContext):
+        x = inputs[0]
+        logits = x.reshape(x.shape[0], -1)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if ctx.train:
+            label = self.get_label(ctx)[:, 0].astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ce = -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+            mask = self.mask1(ctx, x.shape[0])
+            ctx.losses.append(jnp.sum(ce * mask) * self.scale(ctx))
+        return [probs.reshape(x.shape)]
+
+
+@register_layer
+class L2LossLayer(LossLayer):
+    """Identity forward; loss 0.5*||pred - label||^2 per sample."""
+    type_name = "l2_loss"
+
+    def apply(self, params: Params, inputs, ctx: ApplyContext):
+        x = inputs[0]
+        if ctx.train:
+            pred = x.reshape(x.shape[0], -1)
+            label = self.get_label(ctx).astype(pred.dtype)
+            if label.shape[1] != pred.shape[1]:
+                raise ConfigError(
+                    "l2_loss: label width %d != prediction width %d"
+                    % (label.shape[1], pred.shape[1]))
+            diff = pred - label
+            mask = self.mask1(ctx, x.shape[0])
+            ctx.losses.append(
+                0.5 * jnp.sum(jnp.sum(diff * diff, axis=-1) * mask)
+                * self.scale(ctx))
+        return [x]
+
+
+@register_layer
+class MultiLogisticLayer(LossLayer):
+    """Forward: elementwise sigmoid; loss: multi-label binary cross-entropy."""
+    type_name = "multi_logistic"
+
+    def apply(self, params: Params, inputs, ctx: ApplyContext):
+        x = inputs[0]
+        logits = x.reshape(x.shape[0], -1)
+        out = jax.nn.sigmoid(logits)
+        if ctx.train:
+            label = self.get_label(ctx).astype(logits.dtype)
+            if label.shape[1] != logits.shape[1]:
+                raise ConfigError(
+                    "multi_logistic: label width %d != prediction width %d"
+                    % (label.shape[1], logits.shape[1]))
+            # stable BCE on logits: max(z,0) - z*y + log(1+exp(-|z|))
+            bce = (jnp.maximum(logits, 0.0) - logits * label
+                   + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+            mask = self.mask1(ctx, x.shape[0])
+            ctx.losses.append(
+                jnp.sum(jnp.sum(bce, axis=-1) * mask) * self.scale(ctx))
+        return [out.reshape(x.shape)]

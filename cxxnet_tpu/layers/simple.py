@@ -1,0 +1,375 @@
+"""Dense, activation, and structural layers.
+
+Reference semantics (file:line cites are into /root/reference):
+- fullc     src/layer/fullc_layer-inl.hpp:13-146
+- act fns   src/layer/activation_layer-inl.hpp:11-41 + op.h:13-101
+- xelu      src/layer/xelu_layer-inl.hpp:14-55 (leaky: a>0 ? a : a/b)
+- insanity  src/layer/insanity_layer-inl.hpp:13-106 (RReLU, random divisor in [lb,ub])
+- prelu     src/layer/prelu_layer-inl.hpp:45-177 (learned per-channel slope)
+- dropout   src/layer/dropout_layer-inl.hpp:11-66 (self-loop, mask/pkeep)
+- flatten   src/layer/flatten_layer-inl.hpp ((b,c,y,x)->(b,1,1,cyx))
+- split     src/layer/split_layer-inl.hpp:12-47 (1->N copy; autodiff sums grads)
+- concat    src/layer/concat_layer-inl.hpp:11-80 (dim 3 features / dim 1 channels)
+- bias      src/layer/bias_layer-inl.hpp:14-86 (self-loop add bias)
+- fixconn   src/layer/fixconn_layer-inl.hpp:14-96 (fixed sparse weight matmul)
+
+All matmuls run in the MXU-friendly path: inputs flattened to (b, d) 2-D and
+kept in float32 params with optional bf16 compute (see nnet.precision).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.config import ConfigError
+from .base import (ApplyContext, Layer, Params, Shape3, flat_dim,
+                   register_layer)
+
+
+def _flatten2d(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape(x.shape[0], -1)
+
+
+def _as_matrix_node(x: jnp.ndarray) -> jnp.ndarray:
+    """(b, d) -> (b, 1, 1, d) node form."""
+    return x.reshape(x.shape[0], 1, 1, x.shape[1])
+
+
+@register_layer
+class FullcLayer(Layer):
+    """out = in @ W.T + bias; W is (nhidden, in_dim) as in the reference."""
+    type_name = "fullc"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        self.check_one_to_one(in_shapes)
+        if self.param.num_hidden <= 0:
+            raise ConfigError("fullc %r: must set nhidden" % self.spec.key())
+        self.in_dim = flat_dim(in_shapes[0])
+        return [(1, 1, self.param.num_hidden)]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
+        kw, _ = jax.random.split(key)
+        p: Params = {
+            "wmat": self.param.rand_init(
+                kw, (self.param.num_hidden, self.in_dim),
+                in_num=self.in_dim, out_num=self.param.num_hidden),
+        }
+        if not self.param.no_bias:
+            p["bias"] = jnp.full((self.param.num_hidden,), self.param.init_bias,
+                                 jnp.float32)
+        return p
+
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        x = _flatten2d(inputs[0])
+        out = x @ params["wmat"].astype(x.dtype).T
+        if "bias" in params:
+            out = out + params["bias"].astype(out.dtype)
+        return [_as_matrix_node(out)]
+
+
+@register_layer
+class FixconnLayer(Layer):
+    """fullc with a fixed (non-learned) sparse weight from a text file:
+    each line ``row col value``; first line ``nrow ncol nnz``."""
+    type_name = "fixconn"
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "weight_file":
+            self.weight_file = val
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        self.check_one_to_one(in_shapes)
+        if not hasattr(self, "weight_file"):
+            raise ConfigError("fixconn: must set weight_file")
+        rows = []
+        with open(self.weight_file) as f:
+            header = f.readline().split()
+            nrow, ncol = int(header[0]), int(header[1])
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 3:
+                    rows.append((int(parts[0]), int(parts[1]), float(parts[2])))
+        w = np.zeros((nrow, ncol), np.float32)
+        for r, c, v in rows:
+            w[r, c] = v
+        self.wmat = jnp.asarray(w)   # (out, in), constant — closed over, not a param
+        if flat_dim(in_shapes[0]) != ncol:
+            raise ConfigError("fixconn: weight ncol %d != input dim %d"
+                              % (ncol, flat_dim(in_shapes[0])))
+        return [(1, 1, nrow)]
+
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        x = _flatten2d(inputs[0])
+        out = x @ self.wmat.astype(x.dtype).T
+        return [_as_matrix_node(out)]
+
+
+class _ActLayer(Layer):
+    """Elementwise activation; shape preserved."""
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        return [self.check_one_to_one(in_shapes)]
+
+    def fn(self, x: jnp.ndarray, ctx: ApplyContext) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def apply(self, params, inputs, ctx):
+        return [self.fn(inputs[0], ctx)]
+
+
+@register_layer
+class ReluLayer(_ActLayer):
+    type_name = "relu"
+
+    def fn(self, x, ctx):
+        return jnp.maximum(x, 0.0)
+
+
+@register_layer
+class SigmoidLayer(_ActLayer):
+    type_name = "sigmoid"
+
+    def fn(self, x, ctx):
+        return jax.nn.sigmoid(x)
+
+
+@register_layer
+class TanhLayer(_ActLayer):
+    type_name = "tanh"
+
+    def fn(self, x, ctx):
+        return jnp.tanh(x)
+
+
+@register_layer
+class SoftplusLayer(_ActLayer):
+    # enum exists in the reference (layer.h:290) but its factory case is missing;
+    # we implement it properly rather than reproducing the dead-enum error.
+    type_name = "softplus"
+
+    def fn(self, x, ctx):
+        return jax.nn.softplus(x)
+
+
+def xelu(x: jnp.ndarray, b) -> jnp.ndarray:
+    """op.h xelu: a > 0 ? a : a / b  (divisor-form leaky relu)."""
+    return jnp.where(x > 0, x, x / b)
+
+
+@register_layer
+class XeluLayer(_ActLayer):
+    type_name = "xelu"
+
+    def __init__(self, spec, cfg):
+        self.b = 5.0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "b":
+            self.b = float(val)
+
+    def fn(self, x, ctx):
+        return xelu(x, self.b)
+
+
+@register_layer
+class InsanityLayer(_ActLayer):
+    """Randomized leaky ReLU: divisor drawn uniform in [lb, ub] per element at
+    train time, mean divisor at eval. Slope annealing via calm_start/calm_end
+    narrows [lb, ub] toward the midpoint over training steps."""
+    type_name = "insanity"
+    uses_rng = True
+
+    def __init__(self, spec, cfg):
+        self.lb, self.ub = 5.0, 10.0
+        self.calm_start, self.calm_end = 0, 0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "lb":
+            self.lb = float(val)
+        elif name == "ub":
+            self.ub = float(val)
+        elif name == "calm_start":
+            self.calm_start = int(val)
+        elif name == "calm_end":
+            self.calm_end = int(val)
+
+    def _bounds(self, ctx: ApplyContext):
+        lb, ub = self.lb, self.ub
+        if self.calm_end > self.calm_start:
+            mid = (lb + ub) / 2.0
+            frac = jnp.clip(
+                (jnp.asarray(ctx.epoch, jnp.float32) - self.calm_start)
+                / (self.calm_end - self.calm_start), 0.0, 1.0)
+            return lb + (mid - lb) * frac, ub - (ub - mid) * frac
+        return lb, ub
+
+    def fn(self, x, ctx):
+        if ctx.train:
+            lb, ub = self._bounds(ctx)
+            u = jax.random.uniform(ctx.next_key(), x.shape, x.dtype)
+            return xelu(x, u * (ub - lb) + lb)
+        return xelu(x, (self.lb + self.ub) / 2.0)
+
+
+@register_layer
+class PReluLayer(Layer):
+    """Learned per-channel negative slope (multiplier form: a>0 ? a : slope*a);
+    optional multiplicative uniform noise on the slope at train time."""
+    type_name = "prelu"
+    uses_rng = True
+
+    def __init__(self, spec, cfg):
+        self.init_slope = 0.25
+        self.init_random = 0
+        self.random = 0.0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "init_slope":
+            self.init_slope = float(val)
+        elif name == "random_slope":
+            self.init_random = int(val)
+        elif name == "random":
+            self.random = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = self.check_one_to_one(in_shapes)
+        c, y, x = shape
+        # fc node (c==1, y==1): per-feature slope; conv node: per-channel slope
+        self.channel = x if (c == 1 and y == 1) else c
+        self.is_fc = (c == 1 and y == 1)
+        return [shape]
+
+    def init_params(self, key, in_shapes):
+        if self.init_random:
+            slope = self.init_slope * jax.random.uniform(
+                key, (self.channel,), jnp.float32)
+        else:
+            slope = jnp.full((self.channel,), self.init_slope, jnp.float32)
+        return {"bias": slope}   # exposed under tag "bias", as in the reference
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        slope = params["bias"]
+        # runtime layout NHWC: channel is the last axis for both fc and conv
+        slope = slope.reshape((1,) * (x.ndim - 1) + (self.channel,))
+        if ctx.train and self.random > 0:
+            noise = 1.0 + (jax.random.uniform(ctx.next_key(), x.shape, x.dtype)
+                           * 2.0 - 1.0) * self.random
+            slope = slope * noise
+        return [jnp.where(x > 0, x, slope * x)]
+
+
+@register_layer
+class FlattenLayer(Layer):
+    type_name = "flatten"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        return [(1, 1, flat_dim(self.check_one_to_one(in_shapes)))]
+
+    def apply(self, params, inputs, ctx):
+        return [_as_matrix_node(_flatten2d(inputs[0]))]
+
+
+@register_layer
+class DropoutLayer(Layer):
+    """Self-loop; mask = (uniform < pkeep) / pkeep at train, identity at eval."""
+    type_name = "dropout"
+    uses_rng = True
+
+    def __init__(self, spec, cfg):
+        self.threshold = 0.0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "threshold":
+            self.threshold = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = self.check_one_to_one(in_shapes)
+        if self.spec.inputs != self.spec.outputs:
+            raise ConfigError("dropout is a self-loop layer (layer[+0])")
+        if not (0.0 <= self.threshold < 1.0):
+            raise ConfigError("dropout: invalid threshold %g" % self.threshold)
+        return [shape]
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        if not ctx.train or self.threshold == 0.0:
+            return [x]
+        pkeep = 1.0 - self.threshold
+        mask = jax.random.bernoulli(ctx.next_key(), pkeep, x.shape)
+        return [x * mask.astype(x.dtype) / pkeep]
+
+
+@register_layer
+class SplitLayer(Layer):
+    """1 -> N copy; gradients sum automatically under autodiff."""
+    type_name = "split"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        if len(in_shapes) != 1:
+            raise ConfigError("split: takes exactly one input")
+        return [in_shapes[0]] * len(self.spec.outputs)
+
+    def apply(self, params, inputs, ctx):
+        return [inputs[0]] * len(self.spec.outputs)
+
+
+@register_layer
+class ConcatLayer(Layer):
+    """N -> 1 concat along the feature axis (reference dim 3)."""
+    type_name = "concat"
+    axis_logical = 2        # x of (c, y, x)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        if not in_shapes:
+            raise ConfigError("concat: needs at least one input")
+        base = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            for d in range(3):
+                if d != self.axis_logical and s[d] != base[d]:
+                    raise ConfigError("%s: non-concat dims must agree"
+                                      % self.type_name)
+            total += s[self.axis_logical]
+        base[self.axis_logical] = total
+        return [tuple(base)]
+
+    def apply(self, params, inputs, ctx):
+        # NHWC runtime: feature/channel axis is -1 in both cases; y-axis concat
+        # never occurs in the reference (only dim 3 and dim 1 variants exist).
+        return [jnp.concatenate(inputs, axis=-1)]
+
+
+@register_layer
+class ChConcatLayer(ConcatLayer):
+    """N -> 1 concat along channels (reference dim 1) — also axis -1 in NHWC."""
+    type_name = "ch_concat"
+    axis_logical = 0        # c of (c, y, x)
+
+
+@register_layer
+class BiasLayer(Layer):
+    """Self-loop: adds a learned per-feature bias on the flattened node."""
+    type_name = "bias"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = self.check_one_to_one(in_shapes)
+        self.dim = flat_dim(shape)
+        return [shape]
+
+    def init_params(self, key, in_shapes):
+        return {"bias": jnp.full((self.dim,), self.param.init_bias, jnp.float32)}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        return [(x.reshape(x.shape[0], -1) + params["bias"]).reshape(x.shape)]

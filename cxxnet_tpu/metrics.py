@@ -1,0 +1,172 @@
+"""Evaluation metrics with reference semantics (/root/reference/src/utils/metric.h).
+
+- error   (metric.h:92-110): top-1 argmax mismatch; scalar preds threshold at 0
+- rmse    (metric.h:73-89):  mean per-instance sum of squared differences
+- logloss (metric.h:113-132): -log p[target], probs clipped to [1e-15, 1-1e-15];
+  scalar preds use binary logloss
+- rec@n   (metric.h:135-172): fraction of true labels in the top-n predictions
+  (ties broken by a random shuffle before the stable sort, as in the reference)
+
+Accumulators are numpy-side: predictions arrive as host arrays copied out of
+the jitted step (the eval_req path, nnet_impl-inl.hpp:152-180). Batched
+vectorized math replaces the reference's per-instance loops.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class Metric:
+    name = ""
+
+    def __init__(self) -> None:
+        self.clear()
+
+    def clear(self) -> None:
+        self.sum_metric = 0.0
+        self.cnt_inst = 0
+
+    def add_eval(self, pred: np.ndarray, label: np.ndarray) -> None:
+        """pred: (n, d); label: (n, label_width)."""
+        vals = self.calc(np.asarray(pred), np.asarray(label))
+        self.sum_metric += float(np.sum(vals))
+        self.cnt_inst += pred.shape[0]
+
+    def get(self) -> float:
+        return self.sum_metric / max(self.cnt_inst, 1)
+
+    def calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class MetricError(Metric):
+    name = "error"
+
+    def calc(self, pred, label):
+        if pred.shape[1] != 1:
+            maxidx = np.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(np.int64)
+        return (maxidx != label[:, 0].astype(np.int64)).astype(np.float64)
+
+
+class MetricRMSE(Metric):
+    name = "rmse"
+
+    def calc(self, pred, label):
+        if pred.shape[1] != label.shape[1]:
+            raise ValueError("rmse: prediction and label size must match")
+        return np.sum((pred - label) ** 2, axis=1)
+
+
+class MetricLogloss(Metric):
+    name = "logloss"
+
+    def calc(self, pred, label):
+        eps = 1e-15
+        if pred.shape[1] != 1:
+            target = label[:, 0].astype(np.int64)
+            p = np.clip(pred[np.arange(pred.shape[0]), target], eps, 1 - eps)
+            return -np.log(p)
+        p = np.clip(pred[:, 0], eps, 1 - eps)
+        y = label[:, 0]
+        res = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        if np.any(np.isnan(res)):
+            raise FloatingPointError("logloss: NaN detected")
+        return res
+
+
+class MetricRecall(Metric):
+    def __init__(self, name: str) -> None:
+        m = re.match(r"^rec@(\d+)$", name)
+        if not m:
+            raise ValueError("must specify n for rec@n")
+        self.topn = int(m.group(1))
+        self.name = name
+        # private seeded RNG for tie-breaks: deterministic evals, and no
+        # perturbation of global np.random state (reference uses a private
+        # seeded RandomSampler, metric.h:165)
+        self._rng = np.random.RandomState(131)
+        super().__init__()
+
+    def calc(self, pred, label):
+        n, d = pred.shape
+        if d < self.topn:
+            raise ValueError("rec@%d on prediction list of length %d"
+                             % (self.topn, d))
+        # random tie-break then stable sort by descending score (metric.h:148-151)
+        perm = self._rng.permutation(d)
+        order = perm[np.argsort(-pred[:, perm], axis=1, kind="stable")]
+        top = order[:, :self.topn]                       # (n, topn) class indices
+        hits = (top[:, :, None] == label[:, None, :].astype(np.int64)).any(axis=1)
+        return hits.sum(axis=1).astype(np.float64) / label.shape[1]
+
+
+def create_metric(name: str) -> Metric:
+    if name == "error":
+        return MetricError()
+    if name == "rmse":
+        return MetricRMSE()
+    if name == "logloss":
+        return MetricLogloss()
+    if name.startswith("rec@"):
+        return MetricRecall(name)
+    raise ValueError("unknown metric name %r" % name)
+
+
+class MetricSet:
+    """Set of metrics, each bound to a label field (and optionally a node).
+
+    Config forms (nnet_impl-inl.hpp:57-67):
+      ``metric = error``                 — label field "label", default out node
+      ``metric[label] = error``          — explicit label field
+      ``metric[label,node] = error``     — bind to a named node's output
+    """
+
+    def __init__(self) -> None:
+        self.metrics: List[Metric] = []
+        self.label_fields: List[str] = []
+        self.node_names: List[str] = []    # "" = default output node
+
+    def add_metric(self, name: str, field: str = "label",
+                   node: str = "") -> None:
+        self.metrics.append(create_metric(name))
+        self.label_fields.append(field)
+        self.node_names.append(node)
+
+    def configure(self, key: str, val: str) -> bool:
+        """Handle a ``metric...`` config pair; returns True if consumed."""
+        if key == "metric":
+            self.add_metric(val)
+            return True
+        m = re.match(r"^metric\[([^\],]+)(?:,([^\]]+))?\]$", key)
+        if m:
+            self.add_metric(val, m.group(1), m.group(2) or "")
+            return True
+        return False
+
+    def clear(self) -> None:
+        for m in self.metrics:
+            m.clear()
+
+    def add_eval(self, predscores: List[np.ndarray],
+                 labels: Dict[str, np.ndarray]) -> None:
+        if len(predscores) != len(self.metrics):
+            raise ValueError("MetricSet: #predictions != #metrics")
+        for metric, field, pred in zip(self.metrics, self.label_fields,
+                                       predscores):
+            if field not in labels:
+                raise KeyError("Metric: unknown target %r" % field)
+            metric.add_eval(pred, labels[field])
+
+    def print(self, evname: str) -> str:
+        out = []
+        for metric, field in zip(self.metrics, self.label_fields):
+            tag = metric.name if field == "label" else "%s[%s]" % (metric.name,
+                                                                   field)
+            out.append("\t%s-%s:%g" % (evname, tag, metric.get()))
+        return "".join(out)

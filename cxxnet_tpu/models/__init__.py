@@ -1,0 +1,10 @@
+"""Built-in model config texts (the framework's example zoo).
+
+These are authored in the framework's netconfig DSL; they correspond to the
+workloads that define parity with the reference (BASELINE.md): MNIST MLP /
+LeNet-style conv, kaggle-bowl CNN, ImageNet AlexNet, Inception-BN, VGG-16.
+"""
+
+from .alexnet import ALEXNET_NETCONFIG, alexnet_config
+
+__all__ = ["ALEXNET_NETCONFIG", "alexnet_config"]

@@ -1,0 +1,100 @@
+"""AlexNet (Krizhevsky et al. 2012) in the netconfig DSL — the flagship/bench
+model, matching the reference's ImageNet example workload (grouped convs, LRN,
+dropout; cf. /root/reference/example/ImageNet/ImageNet.conf structure)."""
+
+ALEXNET_NETCONFIG = """
+netconfig=start
+layer[0->1] = conv:conv1
+  kernel_size = 11
+  stride = 4
+  nchannel = 96
+  random_type = gaussian
+  init_sigma = 0.01
+layer[1->2] = relu
+layer[2->3] = lrn
+  local_size = 5
+  alpha = 0.0001
+  beta = 0.75
+layer[3->4] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[4->5] = conv:conv2
+  kernel_size = 5
+  pad = 2
+  ngroup = 2
+  nchannel = 256
+  init_sigma = 0.01
+  init_bias = 1.0
+layer[5->6] = relu
+layer[6->7] = lrn
+  local_size = 5
+  alpha = 0.0001
+  beta = 0.75
+layer[7->8] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[8->9] = conv:conv3
+  kernel_size = 3
+  pad = 1
+  nchannel = 384
+  init_sigma = 0.01
+layer[9->10] = relu
+layer[10->11] = conv:conv4
+  kernel_size = 3
+  pad = 1
+  ngroup = 2
+  nchannel = 384
+  init_sigma = 0.01
+  init_bias = 1.0
+layer[11->12] = relu
+layer[12->13] = conv:conv5
+  kernel_size = 3
+  pad = 1
+  ngroup = 2
+  nchannel = 256
+  init_sigma = 0.01
+  init_bias = 1.0
+layer[13->14] = relu
+layer[14->15] = max_pooling
+  kernel_size = 3
+  stride = 2
+layer[15->16] = flatten
+layer[16->16] = dropout
+  threshold = 0.5
+layer[16->17] = fullc:fc6
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[17->18] = relu
+layer[18->18] = dropout
+  threshold = 0.5
+layer[18->19] = fullc:fc7
+  nhidden = 4096
+  init_sigma = 0.005
+  init_bias = 1.0
+layer[19->20] = relu
+layer[20->21] = fullc:fc8
+  nhidden = 1000
+  init_sigma = 0.01
+layer[21->21] = softmax
+netconfig=end
+input_shape = 3,227,227
+"""
+
+
+def alexnet_config(batch_size: int = 128, dev: str = "tpu",
+                   precision: str = "bfloat16", num_classes: int = 1000,
+                   eta: float = 0.01) -> str:
+    cfg = ALEXNET_NETCONFIG
+    if num_classes != 1000:
+        cfg = cfg.replace("nhidden = 1000", "nhidden = %d" % num_classes)
+    dev_line = ("dev = %s\n" % dev) if dev else ""
+    return cfg + """
+batch_size = %d
+%sprecision = %s
+eta = %g
+momentum = 0.9
+wd = 0.0005
+metric = error
+metric = rec@5
+""" % (batch_size, dev_line, precision, eta)

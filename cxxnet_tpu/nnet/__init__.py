@@ -1,0 +1,5 @@
+"""Network runtime/trainer package."""
+
+from .net import Net
+
+__all__ = ["Net"]

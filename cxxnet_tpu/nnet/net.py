@@ -1,0 +1,521 @@
+"""The network trainer — TPU-native equivalent of the reference nnet runtime.
+
+Reference surface (/root/reference/src/nnet/nnet.h:18-92 INetTrainer):
+SetParam / InitModel / SaveModel / LoadModel / CopyModelFrom / StartRound /
+Update(batch) / Evaluate / Predict / ExtractFeature / SetWeight / GetWeight.
+
+Architecture (vs. reference CXXNetThreadTrainer + NeuralNetThread,
+nnet_impl-inl.hpp:15-455, neural_net-inl.hpp:22-628): there are no per-device
+worker threads, no replica broadcast, and no parameter server. One jitted SPMD
+train step runs over a ``jax.sharding.Mesh``; the batch is sharded along the
+``data`` axis, parameters are replicated, and XLA inserts/overlaps the gradient
+all-reduce that mshadow-ps Push/PullReq performed (SURVEY §5.8). Gradient
+accumulation (``update_period``) and per-tag optimizers keep capability parity.
+
+Key jit facts: the step is traced once per (shapes, do-update-phase); learning
+-rate schedules are computed inside the step from the traced epoch scalar, so
+no recompilation across epochs. Host batches arrive NCHW (reference layout)
+and are transposed to NHWC on device entry — the single-transpose cost is
+fused by XLA into the first conv.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph import NetGraph
+from ..layers import ApplyContext, create_layer
+from ..layers.base import Layer
+from ..metrics import MetricSet
+from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
+from ..updaters import create_updater
+from ..utils.config import ConfigError
+
+_CKPT_MAGIC = b"CXTPU001"
+
+
+class Net:
+    """Config-driven trainer (INetTrainer equivalent)."""
+
+    def __init__(self, cfg: Optional[List[Tuple[str, str]]] = None) -> None:
+        self.cfg: List[Tuple[str, str]] = list(cfg) if cfg else []
+        self.graph: Optional[NetGraph] = None
+        self.layers: List[Layer] = []
+        self.params: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self.states: Dict[str, dict] = {}
+        self.opt_state: Dict[str, Dict[str, dict]] = {}
+        self.gsum: Optional[dict] = None
+        self.epoch_counter = 0
+        self.round = 0
+        self.sample_counter = 0
+        self._initialized = False
+
+    # ------------------------------------------------------------ config
+    def set_param(self, name: str, val: str) -> None:
+        self.cfg.append((str(name), str(val)))
+
+    def _parse_trainer_cfg(self) -> None:
+        g = self.graph
+        self.batch_size = 0
+        self.update_period = 1
+        self.eval_train = 1
+        self.seed = 0
+        self.dev = ""
+        self.model_parallel = 1
+        self.precision = "float32"
+        self.train_metrics = MetricSet()
+        self.eval_metrics = MetricSet()
+        for k, v in g.defcfg:
+            if k == "batch_size":
+                self.batch_size = int(v)
+            elif k == "update_period":
+                self.update_period = int(v)
+            elif k == "eval_train":
+                self.eval_train = int(v)
+            elif k == "seed":
+                self.seed = int(v)
+            elif k == "dev":
+                self.dev = v
+            elif k == "model_parallel":
+                self.model_parallel = int(v)
+            elif k == "precision":
+                self.precision = v
+            elif k.startswith("metric"):
+                self.train_metrics.configure(k, v)
+                self.eval_metrics.configure(k, v)
+        if self.batch_size <= 0:
+            raise ConfigError("batch_size must be set")
+        if not self.train_metrics.metrics:
+            self.train_metrics.add_metric("error")
+            self.eval_metrics.add_metric("error")
+
+    # -------------------------------------------------------------- build
+    def _build(self, from_loaded_graph: bool = False) -> None:
+        """Parse config into graph + layers + shapes (InitNet analogue)."""
+        if not from_loaded_graph:
+            self.graph = NetGraph().configure(self.cfg)
+        else:
+            self.graph.configure(self.cfg)
+        g = self.graph
+        if g.input_shape is None:
+            raise ConfigError("input_shape must be set")
+        self._parse_trainer_cfg()
+
+        # instantiate layers; shared layers reuse the primary's object+params
+        self.layers = []
+        for spec in g.layers:
+            if spec.type == "share":
+                self.layers.append(self.layers[spec.primary])
+            else:
+                self.layers.append(create_layer(spec, g.defcfg))
+
+        # shape inference over logical (c, y, x) node shapes
+        self.node_shapes: List[Optional[Tuple[int, int, int]]] = \
+            [None] * g.num_nodes
+        self.node_shapes[0] = g.input_shape
+        for i in range(g.extra_data_num):
+            self.node_shapes[1 + i] = g.extra_shapes[i]
+        for spec, layer in zip(g.layers, self.layers):
+            in_shapes = []
+            for ni in spec.inputs:
+                if self.node_shapes[ni] is None:
+                    raise ConfigError("node %r used before it is produced"
+                                      % g.node_names[ni])
+                in_shapes.append(self.node_shapes[ni])
+            out_shapes = layer.infer_shapes(in_shapes)
+            for ni, s in zip(spec.outputs, out_shapes):
+                self.node_shapes[ni] = s
+
+        # mesh for SPMD execution
+        self.mesh = make_mesh(self.dev, self.model_parallel)
+        self.n_data_shards = self.mesh.shape["data"]
+        if self.batch_size % self.n_data_shards:
+            raise ConfigError(
+                "batch_size %d must divide the %d-way data mesh"
+                % (self.batch_size, self.n_data_shards))
+
+        # metric -> node binding (default: the final node's output)
+        self._metric_nodes: List[int] = []
+        for node_name in self.train_metrics.node_names:
+            if node_name:
+                self._metric_nodes.append(self.graph.node_map[node_name])
+            else:
+                self._metric_nodes.append(g.num_nodes - 1)
+        self._out_node = g.num_nodes - 1
+
+        self._compile_steps()
+        self._initialized = True
+
+    def _compile_steps(self) -> None:
+        donate = (0, 1, 2)
+        self._jit_update = jax.jit(self._step_update, donate_argnums=donate)
+        self._jit_accum = jax.jit(self._step_accum, donate_argnums=(0,))
+        self._jit_apply = jax.jit(self._step_apply, donate_argnums=(0, 1, 2))
+        # node_ids is static: each distinct request set compiles a forward
+        # that materializes only those nodes (XLA fuses the rest away)
+        self._jit_forward = jax.jit(self._forward_eval, static_argnums=(4,))
+
+    # ------------------------------------------------------ initialization
+    def init_model(self) -> None:
+        """Random-init weights + optimizer state (InitModel, nnet_impl:70)."""
+        self._build()
+        key = jax.random.PRNGKey(self.seed)
+        self.params = {}
+        self.states = {}
+        for i, (spec, layer) in enumerate(zip(self.graph.layers, self.layers)):
+            if spec.type == "share":
+                continue
+            lkey = spec.key()
+            in_shapes = [self.node_shapes[n] for n in spec.inputs]
+            p = layer.init_params(jax.random.fold_in(key, i), in_shapes)
+            if p:
+                self.params[lkey] = p
+            if hasattr(layer, "init_state"):
+                st = layer.init_state()
+                if st:
+                    self.states[lkey] = st
+        self._init_updaters()
+        self.epoch_counter = 0
+        self.sample_counter = 0
+        self._rng = jax.random.PRNGKey(self.seed + 777)
+        self._place_state()
+
+    def _init_updaters(self) -> None:
+        """One updater per weight tensor, per-tag config (updater_impl:49-108)."""
+        self.updaters = {}
+        self.opt_state = {}
+        g = self.graph
+        for spec, layer in zip(g.layers, self.layers):
+            if spec.type == "share":
+                continue
+            lkey = spec.key()
+            if lkey not in self.params or lkey in self.opt_state:
+                continue
+            self.updaters[lkey] = {}
+            self.opt_state[lkey] = {}
+            for tag, w in self.params[lkey].items():
+                upd = create_updater(g.updater_type, tag,
+                                     list(g.defcfg) + list(spec.cfg))
+                self.updaters[lkey][tag] = upd
+                self.opt_state[lkey][tag] = upd.init_state(w)
+        self.gsum = jax.tree.map(jnp.zeros_like, self.params) \
+            if self.update_period > 1 else None
+
+    def _place_state(self) -> None:
+        """Place params/opt state replicated over the mesh."""
+        rep = replicated_sharding(self.mesh)
+        self.params = jax.device_put(self.params, rep)
+        self.opt_state = jax.device_put(self.opt_state, rep)
+        if self.states:
+            self.states = jax.device_put(self.states, rep)
+        if self.gsum is not None:
+            self.gsum = jax.device_put(self.gsum, rep)
+
+    # ------------------------------------------------------------ executor
+    def _layer_params(self, params, idx: int):
+        spec = self.graph.layers[idx]
+        if spec.type == "share":
+            spec = self.graph.layers[spec.primary]
+        return params.get(spec.key(), {})
+
+    def _run_graph(self, params, nodes: Dict[int, jnp.ndarray],
+                   ctx: ApplyContext) -> Dict[int, jnp.ndarray]:
+        for i, (spec, layer) in enumerate(zip(self.graph.layers, self.layers)):
+            inputs = [nodes[n] for n in spec.inputs]
+            outs = layer.apply(self._layer_params(params, i), inputs, ctx)
+            for n, o in zip(spec.outputs, outs):
+                nodes[n] = o
+        return nodes
+
+    def _entry_nodes(self, data: jnp.ndarray,
+                     extras: List[jnp.ndarray]) -> Dict[int, jnp.ndarray]:
+        """NCHW host batch -> NHWC device nodes."""
+        nodes = {0: jnp.transpose(data, (0, 2, 3, 1))}
+        for i, e in enumerate(extras):
+            nodes[1 + i] = jnp.transpose(e, (0, 2, 3, 1))
+        return nodes
+
+    def _split_labels(self, label: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {name: label[:, a:b]
+                for name, (a, b) in
+                ((n, self.graph.label_range[i])
+                 for n, i in self.graph.label_name_map.items())}
+
+    def _loss_and_outputs(self, params, states, data, extras, label, mask,
+                          rng, epoch):
+        ctx = ApplyContext(
+            train=True, rng=rng, labels=self._split_labels(label),
+            sample_mask=mask, batch_size=self.batch_size,
+            update_period=self.update_period, epoch=epoch, states=states)
+        nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
+        if not ctx.losses:
+            raise ConfigError("network has no loss layer")
+        total = sum(ctx.losses[1:], ctx.losses[0])
+        metric_outs = [nodes[n].reshape(nodes[n].shape[0], -1)
+                       for n in sorted(set(self._metric_nodes))]
+        return total, (metric_outs, ctx.new_states)
+
+    # ------------------------------------------------------------- steps
+    def _step_update(self, params, opt_state, states, data, extras, label,
+                     mask, rng, epoch):
+        """Fused grad + optimizer apply (update_period == 1 fast path)."""
+        (loss, (mouts, new_states)), grads = jax.value_and_grad(
+            self._loss_and_outputs, has_aux=True)(
+                params, states, data, extras, label, mask, rng, epoch)
+        params, opt_state = self._apply_grads(params, opt_state, grads, epoch)
+        return params, opt_state, new_states, loss, mouts
+
+    def _step_accum(self, gsum, params, states, data, extras, label, mask,
+                    rng, epoch):
+        (loss, (mouts, new_states)), grads = jax.value_and_grad(
+            self._loss_and_outputs, has_aux=True)(
+                params, states, data, extras, label, mask, rng, epoch)
+        gsum = jax.tree.map(jnp.add, gsum, grads)
+        return gsum, new_states, loss, mouts
+
+    def _step_apply(self, params, opt_state, gsum, epoch):
+        params, opt_state = self._apply_grads(params, opt_state, gsum, epoch)
+        gsum = jax.tree.map(jnp.zeros_like, gsum)
+        return params, opt_state, gsum
+
+    def _apply_grads(self, params, opt_state, grads, epoch):
+        new_params = {}
+        new_opt = {}
+        for lkey, tensors in params.items():
+            new_params[lkey] = {}
+            new_opt[lkey] = {}
+            for tag, w in tensors.items():
+                upd = self.updaters[lkey][tag]
+                g = grads[lkey][tag]
+                w2, s2 = upd.update(w, g, opt_state[lkey][tag], epoch)
+                new_params[lkey][tag] = w2
+                new_opt[lkey][tag] = s2
+        return new_params, new_opt
+
+    def _forward_eval(self, params, states, data, extras, node_ids):
+        """Inference forward; returns only the requested nodes' outputs."""
+        ctx = ApplyContext(train=False, rng=None, states=states)
+        nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
+        return tuple(nodes[n] for n in node_ids)
+
+    # ------------------------------------------------------------- train
+    def start_round(self, r: int) -> None:
+        self.round = r
+
+    def _device_batch(self, batch):
+        """Move a host DataBatch to the mesh (data-axis sharded)."""
+        sh = batch_sharding(self.mesh)
+        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
+        data = jax.device_put(np.asarray(batch.data, np.float32), sh)
+        if self.precision == "bfloat16":
+            data = data.astype(dtype)
+        label = jax.device_put(np.asarray(batch.label, np.float32), sh)
+        extras = [jax.device_put(np.asarray(e, np.float32), sh)
+                  for e in batch.extra_data]
+        return data, extras, label
+
+    def _train_mask(self, batch) -> Optional[jnp.ndarray]:
+        """Mask out short-pad duplicates; round_batch wrap instances are real
+        and trained on, as in the reference."""
+        if batch.num_batch_padd and getattr(batch, "pad_mode", "wrap") == "short":
+            b = batch.data.shape[0]
+            mask = np.ones((b,), np.float32)
+            mask[b - batch.num_batch_padd:] = 0.0
+            return jax.device_put(mask, batch_sharding(self.mesh))
+        return None
+
+    def update(self, batch) -> None:
+        """One training step on a host DataBatch (Update, nnet_impl:141-184)."""
+        if not self._initialized:
+            raise RuntimeError("call init_model() or load_model() first")
+        data, extras, label = self._device_batch(batch)
+        mask = self._train_mask(batch)
+        rng = jax.random.fold_in(self._rng, self.epoch_counter)
+        epoch = jnp.asarray(self.epoch_counter, jnp.int32)
+        self.sample_counter += 1
+        if self.update_period == 1:
+            (self.params, self.opt_state, self.states, loss,
+             mouts) = self._jit_update(self.params, self.opt_state, self.states,
+                                       data, extras, label, mask, rng, epoch)
+        else:
+            self.gsum, self.states, loss, mouts = self._jit_accum(
+                self.gsum, self.params, self.states, data, extras, label,
+                mask, rng, epoch)
+            if self.sample_counter % self.update_period == 0:
+                self.params, self.opt_state, self.gsum = self._jit_apply(
+                    self.params, self.opt_state, self.gsum, epoch)
+        self.epoch_counter += 1
+        if self.eval_train:
+            self._accumulate_train_metrics(batch, mouts)
+        self._last_loss = loss
+
+    def _accumulate_train_metrics(self, batch, mouts) -> None:
+        uniq = sorted(set(self._metric_nodes))
+        node_to_out = {n: np.asarray(o) for n, o in zip(uniq, mouts)}
+        labels = self._host_labels(batch.label)
+        preds = [node_to_out[n] for n in self._metric_nodes]
+        self.train_metrics.add_eval(preds, labels)
+
+    def _host_labels(self, label: np.ndarray) -> Dict[str, np.ndarray]:
+        return {name: label[:, a:b]
+                for name, (a, b) in
+                ((n, self.graph.label_range[i])
+                 for n, i in self.graph.label_name_map.items())}
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, data_iter, name: str) -> str:
+        """Run metrics over an iterator; excludes padded tails. Prints (and
+        clears) accumulated train metrics first when eval_train is on, exactly
+        like the reference (Evaluate, nnet_impl:224-245)."""
+        ret = ""
+        if self.eval_train:
+            ret += self.train_metrics.print("train")
+            self.train_metrics.clear()
+        if data_iter is None:
+            return ret
+        self.eval_metrics.clear()
+        uniq = tuple(sorted(set(self._metric_nodes)))
+        data_iter.before_first()
+        while data_iter.next():
+            batch = data_iter.value()
+            data, extras, _ = self._device_batch(batch)
+            outs = self._jit_forward(self.params, self.states, data, extras,
+                                     uniq)
+            node_to_out = dict(zip(uniq, outs))
+            n_valid = batch.data.shape[0] - batch.num_batch_padd
+            labels = {k: v[:n_valid]
+                      for k, v in self._host_labels(batch.label).items()}
+            preds = []
+            for n in self._metric_nodes:
+                out = np.asarray(node_to_out[n])
+                preds.append(out.reshape(out.shape[0], -1)[:n_valid])
+            self.eval_metrics.add_eval(preds, labels)
+        return ret + self.eval_metrics.print(name)
+
+    # ------------------------------------------------------------ predict
+    def predict(self, batch) -> np.ndarray:
+        """argmax of the final node if it is a vector, else the raw scalar
+        (nnet_impl:286-299)."""
+        out = self._forward_node(batch, self._out_node)
+        n_valid = batch.data.shape[0] - batch.num_batch_padd
+        out = out.reshape(out.shape[0], -1)[:n_valid]
+        if out.shape[1] == 1:
+            return out[:, 0]
+        return np.argmax(out, axis=1).astype(np.float32)
+
+    def extract_feature(self, batch, node: str) -> np.ndarray:
+        """Node output by name, or ``top[-k]`` counting back from the output
+        (nnet_impl:200-223)."""
+        if node.startswith("top[-"):
+            k = int(node[len("top[-"):-1])
+            nid = self.graph.num_nodes - k
+        else:
+            nid = self.graph.node_map[node]
+        out = self._forward_node(batch, nid)
+        n_valid = batch.data.shape[0] - batch.num_batch_padd
+        return out[:n_valid]
+
+    def _forward_node(self, batch, node_id: int) -> np.ndarray:
+        data, extras, _ = self._device_batch(batch)
+        outs = self._jit_forward(self.params, self.states, data, extras,
+                                 (node_id,))
+        return np.asarray(outs[0])
+
+    # ------------------------------------------------------- weight access
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        idx = self.graph.layer_index(layer_name)
+        lkey = self.graph.layers[idx].key()
+        if lkey not in self.params or tag not in self.params[lkey]:
+            return np.zeros((0,), np.float32)
+        return np.asarray(self.params[lkey][tag])
+
+    def set_weight(self, layer_name: str, tag: str, value: np.ndarray) -> None:
+        idx = self.graph.layer_index(layer_name)
+        lkey = self.graph.layers[idx].key()
+        cur = self.params[lkey][tag]
+        value = np.asarray(value, np.float32).reshape(cur.shape)
+        self.params[lkey][tag] = jax.device_put(
+            jnp.asarray(value), replicated_sharding(self.mesh))
+
+    # --------------------------------------------------------- checkpoint
+    def save_model(self, path: str) -> None:
+        """Binary checkpoint: structure + epoch + weights (+ layer states).
+        Optimizer state is NOT saved, as in the reference (nnet_impl:82-99)."""
+        params_np = jax.tree.map(np.asarray, self.params)
+        states_np = jax.tree.map(np.asarray, self.states)
+        tensors: List[Tuple[str, np.ndarray]] = []
+        for lkey in sorted(params_np):
+            for tag in sorted(params_np[lkey]):
+                tensors.append(("p/%s/%s" % (lkey, tag), params_np[lkey][tag]))
+        for lkey in sorted(states_np):
+            for tag in sorted(states_np[lkey]):
+                tensors.append(("s/%s/%s" % (lkey, tag), states_np[lkey][tag]))
+        header = {
+            "graph": self.graph.structure_state(),
+            "epoch": self.epoch_counter,
+            "round": self.round,
+            "tensors": [{"name": n, "shape": list(t.shape),
+                         "dtype": str(t.dtype)} for n, t in tensors],
+        }
+        hbytes = json.dumps(header).encode()
+        with open(path, "wb") as f:
+            f.write(_CKPT_MAGIC)
+            f.write(struct.pack("<q", len(hbytes)))
+            f.write(hbytes)
+            for _, t in tensors:
+                f.write(np.ascontiguousarray(t).tobytes())
+
+    def load_model(self, path: str) -> None:
+        with open(path, "rb") as f:
+            if f.read(8) != _CKPT_MAGIC:
+                raise IOError("invalid model file %r" % path)
+            hlen = struct.unpack("<q", f.read(8))[0]
+            header = json.loads(f.read(hlen))
+            self.graph = NetGraph.from_structure_state(header["graph"])
+            self._build(from_loaded_graph=True)
+            self.params = {}
+            self.states = {}
+            for meta in header["tensors"]:
+                t = np.frombuffer(
+                    f.read(int(np.prod(meta["shape"]) *
+                               np.dtype(meta["dtype"]).itemsize)),
+                    dtype=meta["dtype"]).reshape(meta["shape"])
+                kind, lkey, tag = meta["name"].split("/", 2)
+                dst = self.params if kind == "p" else self.states
+                dst.setdefault(lkey, {})[tag] = jnp.asarray(t)
+        self.epoch_counter = header["epoch"]
+        self.round = header["round"]
+        self._init_updaters()
+        self._rng = jax.random.PRNGKey(self.seed + 777)
+        self._place_state()
+
+    def copy_model_from(self, other: "Net") -> None:
+        """Finetune warm-start: copy layers whose names match, reset epoch
+        (CopyModelFrom, nnet_impl:101-134)."""
+        if not self._initialized:
+            self.init_model()
+        copied = []
+        for name, idx in self.graph.layer_name_map.items():
+            if name in other.graph.layer_name_map:
+                lkey = self.graph.layers[idx].key()
+                okey = other.graph.layers[
+                    other.graph.layer_name_map[name]].key()
+                if okey in other.params:
+                    src = jax.tree.map(np.asarray, other.params[okey])
+                    dst = self.params.get(lkey, {})
+                    for tag in dst:
+                        if tag in src and src[tag].shape == \
+                                tuple(dst[tag].shape):
+                            dst[tag] = jnp.asarray(src[tag])
+                            copied.append("%s.%s" % (name, tag))
+        self.epoch_counter = 0
+        self.sample_counter = 0
+        self._place_state()
+        print("CopyModelFrom: copied %d tensors" % len(copied))

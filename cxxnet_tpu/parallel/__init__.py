@@ -1,0 +1,16 @@
+"""Device mesh + sharding utilities — the TPU-native replacement for the
+reference's device lists and mshadow-ps parameter server (SURVEY §5.8).
+
+The reference's ``dev = gpu:0-3`` (nnet_impl-inl.hpp:32-51) becomes a 1-D
+``jax.sharding.Mesh`` over a ``data`` axis; batch tensors are sharded along it
+and parameters are replicated, so XLA inserts the gradient all-reduce (psum)
+that Push/PullReq used to perform, overlapping it with backprop automatically.
+Higher-dimensional meshes (data x model) are built here too for the tensor/
+pipeline-parallel paths.
+"""
+
+from .mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
+                   replicated_sharding)
+
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "batch_sharding", "make_mesh",
+           "replicated_sharding"]

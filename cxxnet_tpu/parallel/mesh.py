@@ -1,0 +1,65 @@
+"""Mesh construction and common shardings.
+
+``parse_devices`` understands the reference's device-list syntax
+(``dev = gpu:0-3`` / ``dev = gpu:0,1,2``, nnet_impl-inl.hpp:32-51) mapped onto
+TPU: ``dev = tpu`` (all chips), ``dev = tpu:0-3``, ``dev = cpu``. The device
+count becomes the size of the 1-D ``data`` mesh axis; an optional
+``model_parallel = k`` splits a second ``model`` axis for tensor-parallel
+layers (the fullc_gather descendant).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def parse_devices(dev: str) -> Sequence[jax.Device]:
+    """Device-list string -> list of jax devices."""
+    dev = dev.strip()
+    if dev in ("", "cpu", "gpu", "tpu"):
+        return jax.devices()
+    m = re.match(r"^[a-z]+:([\d,\-]+)$", dev)
+    if not m:
+        raise ValueError("invalid device spec %r" % dev)
+    ids = []
+    for part in m.group(1).split(","):
+        if "-" in part:
+            a, b = part.split("-")
+            ids.extend(range(int(a), int(b) + 1))
+        else:
+            ids.append(int(part))
+    all_devices = jax.devices()
+    if max(ids) >= len(all_devices):
+        raise ValueError("device id %d out of range (%d devices available)"
+                         % (max(ids), len(all_devices)))
+    return [all_devices[i] for i in ids]
+
+
+def make_mesh(dev: str = "", model_parallel: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, model) mesh; model axis size 1 means pure DP."""
+    if devices is None:
+        devices = parse_devices(dev)
+    n = len(devices)
+    if n % model_parallel:
+        raise ValueError("model_parallel=%d must divide device count %d"
+                         % (model_parallel, n))
+    arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

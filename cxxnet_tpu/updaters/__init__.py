@@ -1,0 +1,220 @@
+"""Optimizers ("updaters") with the reference's math and schedules.
+
+Reference (/root/reference/src/updater/):
+- UpdaterParam schedules  param.h:13-133 — lr schedules constant/expdecay/
+  polydecay/factor (integer-division quirks preserved), momentum ramp,
+  lr_minimum floor, start_epoch freeze, per-tag hyperparams (``wmat:lr``)
+- SGD   sgd_updater-inl.hpp:25-85 — m = mu*m - lr*(clip(g) + wd*w); w += m;
+  ``clip`` maps NaN -> 0 (sgd_updater-inl.hpp:14-22)
+- NAG   nag_updater-inl.hpp:15-73 — w += (1+mu)*m_new - mu*m_old
+- Adam  adam_updater-inl.hpp:16-83 — one-minus convention (decay1=0.1 means
+  beta1=0.9); weight decay applied as ``grad -= wd*w`` (sign quirk kept)
+
+TPU-first design: each weight tensor gets an updater whose hyperparameters are
+static Python floats and whose (lr, momentum) schedule is computed *inside* the
+jitted train step from the traced epoch scalar — one compiled step serves the
+whole run, no per-epoch recompilation. ``epoch`` counts update steps, as in the
+reference (CXXNetThreadTrainer passes epoch_counter++ per Update).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.config import ConfigError
+
+Pairs = Sequence[Tuple[str, str]]
+
+
+class UpdaterParam:
+    """Hyper-parameters + schedules for one weight tensor (tag 'wmat'/'bias')."""
+
+    def __init__(self, tag: str) -> None:
+        self.tag = tag
+        self.base_lr = 0.01
+        self.wd = 0.0
+        self.momentum = 0.9
+        self.lr_schedule = 0
+        self.momentum_schedule = 0
+        self.lr_step = 1
+        self.lr_gamma = 0.5
+        self.lr_alpha = 0.5
+        self.lr_factor = 0.1
+        self.lr_minimum = 1e-5
+        self.start_epoch = 0
+        self.base_momentum = 0.5
+        self.final_momentum = 0.9
+        self.saturation_epoch = 0
+        self.clip_gradient = 0.0
+
+    def set_param(self, name: str, val: str) -> None:
+        # tag-scoped override: "bias:wd" applies only when tag == "bias"
+        if name.startswith(self.tag + ":"):
+            name = name[len(self.tag) + 1:]
+        elif ":" in name and not (name.startswith("lr:") or name.startswith("eta:")):
+            other = name.split(":", 1)[0]
+            if other in ("wmat", "bias"):
+                return          # scoped to a different tag
+        if name in ("lr", "eta"):
+            self.base_lr = float(val)
+        elif name == "wd":
+            self.wd = float(val)
+        elif name == "momentum":
+            self.momentum = float(val)
+        elif name == "momentum_schedule":
+            self.momentum_schedule = int(val)
+        elif name == "clip_gradient":
+            self.clip_gradient = float(val)
+        elif name == "final_momentum":
+            self.final_momentum = float(val)
+        elif name == "base_momentum":
+            self.base_momentum = float(val)
+        elif name == "saturation_epoch":
+            self.saturation_epoch = int(val)
+        elif name.startswith("lr:") or name.startswith("eta:"):
+            sub = name.split(":", 1)[1]
+            if sub == "schedule":
+                table = {"constant": 0, "expdecay": 1, "polydecay": 2, "factor": 3}
+                if val not in table:
+                    raise ConfigError("unknown lr schedule %r" % val)
+                self.lr_schedule = table[val]
+            elif sub == "gamma":
+                self.lr_gamma = float(val)
+            elif sub == "alpha":
+                self.lr_alpha = float(val)
+            elif sub == "step":
+                self.lr_step = int(val)
+            elif sub == "factor":
+                self.lr_factor = float(val)
+            elif sub == "minimum_lr":
+                self.lr_minimum = float(val)
+            elif sub == "start_epoch":
+                self.start_epoch = int(val)
+
+    def schedule(self, epoch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(learning_rate, momentum) at update-step ``epoch`` (traced scalar)."""
+        e = jnp.asarray(epoch, jnp.float32)
+        e_div = jnp.floor(e / self.lr_step)   # the reference's integer division
+        if self.lr_schedule == 0:
+            lr = jnp.asarray(self.base_lr, jnp.float32)
+        elif self.lr_schedule == 1:
+            lr = self.base_lr * jnp.power(self.lr_gamma, e / self.lr_step)
+        elif self.lr_schedule == 2:
+            lr = self.base_lr * jnp.power(1.0 + e_div * self.lr_gamma,
+                                          -self.lr_alpha)
+        else:
+            lr = self.base_lr * jnp.power(self.lr_factor, e_div)
+        lr = jnp.maximum(lr, self.lr_minimum)
+        lr = jnp.where(e < self.start_epoch, self.base_lr, lr)
+        mom = jnp.asarray(self.momentum, jnp.float32)
+        if self.momentum_schedule and self.saturation_epoch:
+            # the reference accumulates the ramp in-place each step, so momentum
+            # reaches final_momentum almost immediately; the clipped closed form:
+            ramp = (self.momentum + self.base_momentum
+                    + (self.final_momentum - self.base_momentum)
+                    / self.saturation_epoch * e)
+            mom = jnp.minimum(ramp, self.final_momentum)
+        return lr, mom
+
+
+def clip_grad(grad: jnp.ndarray, bound: float) -> jnp.ndarray:
+    """Reference ``clip`` functor: NaN -> 0, then clamp to [-bound, bound]."""
+    grad = jnp.where(jnp.isnan(grad), 0.0, grad)
+    return jnp.clip(grad, -bound, bound)
+
+
+class Updater:
+    """Per-tensor optimizer; state is a dict pytree of arrays."""
+    type_name = ""
+
+    def __init__(self, tag: str, cfg: Pairs) -> None:
+        self.param = UpdaterParam(tag)
+        for k, v in cfg:
+            self.param.set_param(k, v)
+            self.set_param(k, v)
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+    def update(self, w: jnp.ndarray, grad: jnp.ndarray,
+               state: Dict[str, jnp.ndarray], epoch):
+        raise NotImplementedError
+
+    def _prep_grad(self, grad, w):
+        if self.param.clip_gradient != 0.0:
+            grad = clip_grad(grad, self.param.clip_gradient)
+        return grad
+
+
+class SGDUpdater(Updater):
+    type_name = "sgd"
+
+    def init_state(self, w):
+        return {"m": jnp.zeros_like(w)}
+
+    def update(self, w, grad, state, epoch):
+        lr, mom = self.param.schedule(epoch)
+        grad = self._prep_grad(grad, w)
+        m = mom * state["m"] - lr * (grad + self.param.wd * w)
+        return w + m, {"m": m}
+
+
+class NAGUpdater(Updater):
+    type_name = "nag"
+
+    def init_state(self, w):
+        return {"m": jnp.zeros_like(w)}
+
+    def update(self, w, grad, state, epoch):
+        lr, mom = self.param.schedule(epoch)
+        grad = self._prep_grad(grad, w)
+        m_old = state["m"]
+        m = mom * m_old - lr * (grad + self.param.wd * w)
+        return w + (1 + mom) * m - mom * m_old, {"m": m}
+
+
+class AdamUpdater(Updater):
+    type_name = "adam"
+
+    def __init__(self, tag, cfg):
+        self.decay1 = 0.1
+        self.decay2 = 0.001
+        super().__init__(tag, cfg)
+
+    def set_param(self, name, val):
+        if name == "beta1":
+            self.decay1 = float(val)
+        elif name == "beta2":
+            self.decay2 = float(val)
+
+    def init_state(self, w):
+        return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
+
+    def update(self, w, grad, state, epoch):
+        grad = self._prep_grad(grad, w)
+        if self.param.wd > 0.0:
+            grad = grad - self.param.wd * w   # reference sign quirk
+        e = jnp.asarray(epoch, jnp.float32)
+        fix1 = 1.0 - jnp.power(1.0 - self.decay1, e + 1)
+        fix2 = 1.0 - jnp.power(1.0 - self.decay2, e + 1)
+        lr_t = self.param.base_lr * jnp.sqrt(fix2) / fix1
+        m1 = state["m1"] + self.decay1 * (grad - state["m1"])
+        m2 = state["m2"] + self.decay2 * (jnp.square(grad) - state["m2"])
+        w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
+        return w, {"m1": m1, "m2": m2}
+
+
+UPDATER_REGISTRY = {c.type_name: c for c in (SGDUpdater, NAGUpdater, AdamUpdater)}
+
+
+def create_updater(kind: str, tag: str, cfg: Pairs) -> Updater:
+    """Factory (updater.h:117-127 analogue)."""
+    if kind not in UPDATER_REGISTRY:
+        raise ConfigError("unknown updater %r" % kind)
+    return UPDATER_REGISTRY[kind](tag, cfg)

@@ -1,0 +1,5 @@
+"""Foundation utilities (config tokenizer, etc.)."""
+
+from .config import ConfigError, load_config, tokenize
+
+__all__ = ["ConfigError", "load_config", "tokenize"]

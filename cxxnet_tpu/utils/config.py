@@ -1,0 +1,94 @@
+"""Config tokenizer: ``name = value`` pairs with comments and quoted values.
+
+Capability parity with the reference tokenizer (/root/reference/src/utils/config.h:40-189):
+- ``#`` starts a comment running to end of line (outside quotes)
+- tokens are split on ``=`` with arbitrary whitespace
+- values may be single- or double-quoted; quoted values may span multiple
+  lines and contain ``=``/whitespace/escapes (\\" \\' \\\\ \\n \\t)
+- later occurrences of a key do NOT override earlier ones at the tokenizer
+  level: the config is an ordered list of (name, value) pairs, because order
+  is meaningful to the netconfig DSL (scoped layer/iterator blocks).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+
+class ConfigError(ValueError):
+    pass
+
+
+_ESCAPES = {'"': '"', "'": "'", "\\": "\\", "n": "\n", "t": "\t", "r": "\r"}
+
+
+def tokenize(text: str) -> List[Tuple[str, str]]:
+    """Tokenize config text into an ordered list of (name, value) pairs."""
+    pairs: List[Tuple[str, str]] = []
+    i, n = 0, len(text)
+
+    def skip_ws_comments(i: int) -> int:
+        while i < n:
+            c = text[i]
+            if c == "#":
+                while i < n and text[i] != "\n":
+                    i += 1
+            elif c.isspace():
+                i += 1
+            else:
+                break
+        return i
+
+    def read_token(i: int, stop_at_eq: bool) -> Tuple[str, int]:
+        c = text[i]
+        if c in "\"'":
+            quote = c
+            i += 1
+            out = []
+            while True:
+                if i >= n:
+                    raise ConfigError("unterminated quoted string in config")
+                c = text[i]
+                if c == "\\" and i + 1 < n and text[i + 1] in _ESCAPES:
+                    out.append(_ESCAPES[text[i + 1]])
+                    i += 2
+                elif c == quote:
+                    i += 1
+                    break
+                else:
+                    out.append(c)
+                    i += 1
+            return "".join(out), i
+        out = []
+        while i < n:
+            c = text[i]
+            if c.isspace() or c == "#" or (stop_at_eq and c == "="):
+                break
+            out.append(c)
+            i += 1
+        return "".join(out), i
+
+    while True:
+        i = skip_ws_comments(i)
+        if i >= n:
+            break
+        name, i = read_token(i, stop_at_eq=True)
+        i = skip_ws_comments(i)
+        if i >= n or text[i] != "=":
+            raise ConfigError("expected '=' after config key %r" % name)
+        i += 1
+        i = skip_ws_comments(i)
+        if i >= n:
+            raise ConfigError("expected value after '%s ='" % name)
+        value, i = read_token(i, stop_at_eq=False)
+        pairs.append((name, value))
+    return pairs
+
+
+def load_config(path: str) -> List[Tuple[str, str]]:
+    with open(path, "r") as f:
+        return tokenize(f.read())
+
+
+def iter_config(path: str) -> Iterator[Tuple[str, str]]:
+    yield from load_config(path)

@@ -1,0 +1,32 @@
+"""Test harness config: force an 8-virtual-device CPU mesh.
+
+The container's sitecustomize (PYTHONPATH=/root/.axon_site) eagerly registers
+the axon TPU PJRT plugin at interpreter start; once that has happened, setting
+JAX_PLATFORMS=cpu in-process hangs the axon client. So before anything imports
+jax we re-exec pytest with PYTHONPATH dropped and the CPU platform forced —
+giving every test the 8-device virtual mesh the sharding tests need.
+"""
+
+import os
+import sys
+
+_SENTINEL = "CXXNET_TPU_TEST_REEXEC"
+
+if os.environ.get(_SENTINEL) != "1" and "jax" not in sys.modules:
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env[_SENTINEL] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla:
+        env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,47 @@
+"""Config tokenizer tests (reference grammar: src/utils/config.h)."""
+
+import pytest
+
+from cxxnet_tpu.utils.config import ConfigError, tokenize
+
+
+def test_basic_pairs():
+    assert tokenize("a = 1\nb=2\n c =3") == [("a", "1"), ("b", "2"), ("c", "3")]
+
+
+def test_comments():
+    text = "# header\na = 1  # trailing\n# full line\nb = 2"
+    assert tokenize(text) == [("a", "1"), ("b", "2")]
+
+
+def test_quoted_values():
+    assert tokenize('p = "./data/x y.gz"') == [("p", "./data/x y.gz")]
+    assert tokenize("p = 'a=b # not comment'") == [("p", "a=b # not comment")]
+
+
+def test_multiline_quoted():
+    assert tokenize("p = 'line1\nline2'") == [("p", "line1\nline2")]
+
+
+def test_escapes():
+    assert tokenize(r'p = "a\"b\n"') == [("p", 'a"b\n')]
+
+
+def test_layer_decl_keys():
+    pairs = tokenize("layer[+1:fc1] = fullc:fc1\n  nhidden = 100")
+    assert pairs == [("layer[+1:fc1]", "fullc:fc1"), ("nhidden", "100")]
+
+
+def test_ordered_not_deduped():
+    assert tokenize("metric = error\nmetric = logloss") == [
+        ("metric", "error"), ("metric", "logloss")]
+
+
+def test_missing_equals():
+    with pytest.raises(ConfigError):
+        tokenize("novalue\n")
+
+
+def test_unterminated_quote():
+    with pytest.raises(ConfigError):
+        tokenize("a = 'oops")

@@ -1,0 +1,144 @@
+"""netconfig DSL / graph IR tests (reference grammar: nnet_config.h:207-360)."""
+
+import pytest
+
+from cxxnet_tpu.graph import NetGraph
+from cxxnet_tpu.utils.config import ConfigError, tokenize
+
+
+def build(text):
+    return NetGraph().configure(tokenize(text))
+
+
+MLP = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,784
+"""
+
+
+def test_mlp_structure():
+    g = build(MLP)
+    assert g.input_shape == (1, 1, 784)
+    assert [l.type for l in g.layers] == ["fullc", "sigmoid", "fullc", "softmax"]
+    # node 0 = in; fc1 -> node "fc1"; sigmoid -> "sg1"; fullc -> "fc2";
+    # softmax self-loop on fc2
+    assert g.layers[0].inputs == [0]
+    assert g.node_names[g.layers[0].outputs[0]] == "fc1"
+    assert g.layers[2].inputs == [g.node_map["sg1"]]
+    assert g.layers[3].inputs == g.layers[3].outputs
+    assert g.layer_name_map == {"fc1": 0, "se1": 1, "fc2": 2}
+
+
+def test_layer_scoped_config():
+    g = build(MLP)
+    assert ("nhidden", "100") in g.layers[0].cfg
+    assert ("nhidden", "10") in g.layers[2].cfg
+    assert all(k != "nhidden" for k, _ in g.defcfg)
+
+
+def test_numeric_nodes_and_self_loop():
+    g = build("""
+netconfig=start
+layer[0->1] = conv:cv1
+  kernel_size = 3
+  nchannel = 8
+layer[1->1] = relu
+layer[1->2] = flatten
+netconfig=end
+input_shape = 3,8,8
+""")
+    assert g.layers[0].inputs == [0]
+    assert g.layers[1].inputs == g.layers[1].outputs
+    assert g.num_nodes == 3
+
+
+def test_split_concat_multi_node():
+    g = build("""
+netconfig=start
+layer[0->1,2] = split
+layer[1->3] = fullc:a
+  nhidden = 4
+layer[2->4] = fullc:b
+  nhidden = 4
+layer[3,4->5] = concat
+netconfig=end
+input_shape = 1,1,8
+""")
+    assert g.layers[0].outputs == [1, 2]
+    assert g.layers[3].inputs == [3, 4]
+
+
+def test_share_layer():
+    g = build("""
+netconfig=start
+layer[+1:h1] = fullc:enc
+  nhidden = 8
+layer[+1:h2] = sigmoid
+layer[+1:h3] = share[enc]
+netconfig=end
+input_shape = 1,1,8
+""")
+    assert g.layers[2].type == "share"
+    assert g.layers[2].primary == 0
+
+
+def test_share_param_rejected():
+    with pytest.raises(ConfigError):
+        build("""
+netconfig=start
+layer[+1:h1] = fullc:enc
+  nhidden = 8
+layer[+1:h2] = share[enc]
+  nhidden = 4
+netconfig=end
+""")
+
+
+def test_undefined_input_node():
+    with pytest.raises(ConfigError):
+        build("netconfig=start\nlayer[nope->out] = relu\nnetconfig=end")
+
+
+def test_unknown_layer_type():
+    with pytest.raises(ConfigError):
+        build("netconfig=start\nlayer[+1] = warp9\nnetconfig=end")
+
+
+def test_label_vec_registry():
+    g = build("label_vec[0,1) = label\nlabel_vec[1,4) = extra\n" + MLP)
+    assert g.label_field("extra") == (1, 4)
+    assert g.label_field("label") == (0, 1)
+
+
+def test_structure_roundtrip():
+    g = build(MLP)
+    g2 = NetGraph.from_structure_state(g.structure_state())
+    assert g2.node_names == g.node_names
+    assert [l.type for l in g2.layers] == [l.type for l in g.layers]
+    assert g2.layer_name_map == g.layer_name_map
+
+
+def test_reconfigure_validates_structure():
+    g = build(MLP)
+    g.configure(tokenize(MLP))     # same structure ok
+    with pytest.raises(ConfigError):
+        g.configure(tokenize(MLP.replace("sigmoid", "tanh")))
+
+
+def test_pairtest_decl():
+    g = build("""
+netconfig=start
+layer[+1:c1] = pairtest-fullc-fullc:p1
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,8
+""")
+    assert g.layers[0].type == "pairtest"
+    assert g.layers[0].pairtest == ("fullc", "fullc")

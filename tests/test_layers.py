@@ -1,0 +1,279 @@
+"""Layer numerics — differential tests against independent oracles (numpy /
+torch-cpu), the moral equivalent of the reference's PairTestLayer harness
+(SURVEY §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.graph import LayerSpec
+from cxxnet_tpu.layers import create_layer
+from cxxnet_tpu.layers.base import ApplyContext
+
+
+def make_layer(ltype, cfg, inputs=(0,), outputs=(1,), name="t"):
+    spec = LayerSpec(ltype, name, list(inputs), list(outputs))
+    spec.cfg = list(cfg)
+    return create_layer(spec, [])
+
+
+def ctx_train(rng_seed=0, labels=None, batch_size=4):
+    return ApplyContext(train=True, rng=jax.random.PRNGKey(rng_seed),
+                        labels=labels or {}, batch_size=batch_size)
+
+
+def ctx_eval():
+    return ApplyContext(train=False, rng=None)
+
+
+# ---------------------------------------------------------------- fullc
+def test_fullc_matmul(rng):
+    layer = make_layer("fullc", [("nhidden", "8"), ("init_sigma", "0.1")])
+    assert layer.infer_shapes([(1, 1, 16)]) == [(1, 1, 8)]
+    params = layer.init_params(jax.random.PRNGKey(0), [(1, 1, 16)])
+    x = rng.randn(4, 1, 1, 16).astype(np.float32)
+    out = layer.apply(params, [jnp.asarray(x)], ctx_eval())[0]
+    expected = x.reshape(4, 16) @ np.asarray(params["wmat"]).T \
+        + np.asarray(params["bias"])
+    np.testing.assert_allclose(np.asarray(out).reshape(4, 8), expected,
+                               rtol=1e-5)
+
+
+def test_fullc_no_bias():
+    layer = make_layer("fullc", [("nhidden", "8"), ("no_bias", "1")])
+    layer.infer_shapes([(1, 1, 16)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(1, 1, 16)])
+    assert "bias" not in params
+
+
+# ---------------------------------------------------------------- conv vs torch
+@pytest.mark.parametrize("groups,pad,stride", [(1, 0, 1), (1, 1, 2), (2, 2, 1)])
+def test_conv_matches_torch(rng, groups, pad, stride):
+    torch = pytest.importorskip("torch")
+    cin, cout, k = 4, 6, 3
+    layer = make_layer("conv", [("nchannel", str(cout)), ("kernel_size", str(k)),
+                                ("pad", str(pad)), ("stride", str(stride)),
+                                ("ngroup", str(groups))])
+    out_shape = layer.infer_shapes([(cin, 9, 9)])[0]
+    params = layer.init_params(jax.random.PRNGKey(1), [(cin, 9, 9)])
+    x = rng.randn(2, cin, 9, 9).astype(np.float32)
+
+    x_nhwc = jnp.asarray(x.transpose(0, 2, 3, 1))
+    out = layer.apply(params, [x_nhwc], ctx_eval())[0]
+    out_nchw = np.asarray(out).transpose(0, 3, 1, 2)
+    assert out_nchw.shape[1:] == out_shape
+
+    w = np.asarray(params["wmat"])          # HWIO
+    w_oihw = w.transpose(3, 2, 0, 1)        # OIHW for torch
+    tout = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w_oihw),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=stride, padding=pad, groups=groups)
+    np.testing.assert_allclose(out_nchw, tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- pooling
+def test_max_pooling_matches_torch(rng):
+    torch = pytest.importorskip("torch")
+    layer = make_layer("max_pooling", [("kernel_size", "3"), ("stride", "2")])
+    out_shape = layer.infer_shapes([(2, 7, 7)])[0]
+    x = rng.randn(2, 2, 7, 7).astype(np.float32)
+    out = layer.apply({}, [jnp.asarray(x.transpose(0, 2, 3, 1))], ctx_eval())[0]
+    out_nchw = np.asarray(out).transpose(0, 3, 1, 2)
+    # ceil-mode pooling with partial edge windows == torch ceil_mode=True
+    tout = torch.nn.functional.max_pool2d(
+        torch.from_numpy(x), 3, stride=2, ceil_mode=True)
+    assert out_nchw.shape == tuple(tout.shape)
+    assert out_nchw.shape[1:] == out_shape
+    np.testing.assert_allclose(out_nchw, tout.numpy(), rtol=1e-6)
+
+
+def test_avg_pooling_divides_by_full_window(rng):
+    # reference avg pooling always divides by ky*kx, even for partial
+    # edge windows (pooling_layer-inl.hpp:33-86)
+    layer = make_layer("avg_pooling", [("kernel_size", "2"), ("stride", "2")])
+    layer.infer_shapes([(1, 3, 3)])
+    x = np.ones((1, 1, 3, 3), np.float32)
+    out = layer.apply({}, [jnp.asarray(x.transpose(0, 2, 3, 1))], ctx_eval())[0]
+    out = np.asarray(out).transpose(0, 3, 1, 2)
+    # edge windows see a single 1 but still divide by 4
+    np.testing.assert_allclose(out[0, 0], [[1.0, 0.5], [0.5, 0.25]])
+
+
+def test_sum_pooling(rng):
+    layer = make_layer("sum_pooling", [("kernel_size", "2"), ("stride", "1")])
+    layer.infer_shapes([(1, 3, 3)])
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out = layer.apply({}, [jnp.asarray(x.transpose(0, 2, 3, 1))], ctx_eval())[0]
+    out = np.asarray(out).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(out[0, 0], [[8, 12], [20, 24]])
+
+
+# ---------------------------------------------------------------- activations
+def test_activations(rng):
+    x = rng.randn(3, 1, 1, 5).astype(np.float32)
+    xj = jnp.asarray(x)
+    assert np.allclose(
+        np.asarray(make_layer("relu", []).apply({}, [xj], ctx_eval())[0]),
+        np.maximum(x, 0))
+    assert np.allclose(
+        np.asarray(make_layer("sigmoid", []).apply({}, [xj], ctx_eval())[0]),
+        1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert np.allclose(
+        np.asarray(make_layer("tanh", []).apply({}, [xj], ctx_eval())[0]),
+        np.tanh(x), rtol=1e-5)
+    # xelu: a>0 ? a : a/b
+    out = make_layer("xelu", [("b", "4.0")]).apply({}, [xj], ctx_eval())[0]
+    assert np.allclose(np.asarray(out), np.where(x > 0, x, x / 4.0), rtol=1e-6)
+
+
+def test_insanity_eval_uses_mean_divisor(rng):
+    x = rng.randn(3, 1, 1, 5).astype(np.float32)
+    layer = make_layer("insanity", [("lb", "4"), ("ub", "8")])
+    out = layer.apply({}, [jnp.asarray(x)], ctx_eval())[0]
+    assert np.allclose(np.asarray(out), np.where(x > 0, x, x / 6.0), rtol=1e-6)
+
+
+def test_prelu(rng):
+    layer = make_layer("prelu", [("init_slope", "0.3")])
+    layer.infer_shapes([(4, 3, 3)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(4, 3, 3)])
+    assert params["bias"].shape == (4,)
+    x = rng.randn(2, 3, 3, 4).astype(np.float32)    # NHWC
+    out = layer.apply(params, [jnp.asarray(x)], ctx_eval())[0]
+    assert np.allclose(np.asarray(out), np.where(x > 0, x, 0.3 * x), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- dropout
+def test_dropout_train_scaling(rng):
+    spec_in_out = ((1,), (1,))
+    layer = make_layer("dropout", [("threshold", "0.5")],
+                       inputs=(1,), outputs=(1,))
+    layer.infer_shapes([(1, 1, 1000)])
+    x = np.ones((2, 1, 1, 1000), np.float32)
+    out = np.asarray(layer.apply({}, [jnp.asarray(x)], ctx_train())[0])
+    kept = out != 0
+    assert 0.3 < kept.mean() < 0.7
+    assert np.allclose(out[kept], 2.0)
+    # eval = identity
+    oute = np.asarray(layer.apply({}, [jnp.asarray(x)], ctx_eval())[0])
+    assert np.allclose(oute, x)
+
+
+# ---------------------------------------------------------------- lrn vs torch
+def test_lrn_matches_torch(rng):
+    torch = pytest.importorskip("torch")
+    layer = make_layer("lrn", [("local_size", "5"), ("alpha", "0.001"),
+                               ("beta", "0.75"), ("knorm", "1.0")])
+    layer.infer_shapes([(8, 6, 6)])
+    x = rng.randn(2, 8, 6, 6).astype(np.float32)
+    out = layer.apply({}, [jnp.asarray(x.transpose(0, 2, 3, 1))], ctx_eval())[0]
+    out_nchw = np.asarray(out).transpose(0, 3, 1, 2)
+    tout = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), 5, alpha=0.001, beta=0.75, k=1.0)
+    np.testing.assert_allclose(out_nchw, tout.numpy(), rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------- batch norm
+def test_batch_norm_normalizes(rng):
+    layer = make_layer("batch_norm", [])
+    layer.infer_shapes([(4, 5, 5)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(4, 5, 5)])
+    x = (rng.randn(8, 5, 5, 4) * 3 + 7).astype(np.float32)
+    out = np.asarray(layer.apply(params, [jnp.asarray(x)], ctx_train())[0])
+    assert np.allclose(out.mean(axis=(0, 1, 2)), 0, atol=1e-4)
+    assert np.allclose(out.std(axis=(0, 1, 2)), 1, atol=1e-3)
+    # reference quirk: eval also uses batch stats
+    oute = np.asarray(layer.apply(params, [jnp.asarray(x)], ctx_eval())[0])
+    assert np.allclose(oute.mean(axis=(0, 1, 2)), 0, atol=1e-4)
+
+
+def test_batch_norm_fc_mode(rng):
+    layer = make_layer("batch_norm", [])
+    layer.infer_shapes([(1, 1, 16)])
+    assert layer.channel == 16
+
+
+# ---------------------------------------------------------------- structural
+def test_flatten_concat_split(rng):
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)   # NHWC: (b,y=3? ...)
+    flat = make_layer("flatten", [])
+    flat.infer_shapes([(5, 3, 4)])
+    out = flat.apply({}, [jnp.asarray(x)], ctx_eval())[0]
+    assert out.shape == (2, 1, 1, 60)
+
+    sp = make_layer("split", [], outputs=(1, 2))
+    assert sp.infer_shapes([(5, 3, 4)]) == [(5, 3, 4)] * 2
+
+    cc = make_layer("concat", [], inputs=(1, 2), outputs=(3,))
+    assert cc.infer_shapes([(1, 1, 4), (1, 1, 6)]) == [(1, 1, 10)]
+    a = rng.randn(2, 1, 1, 4).astype(np.float32)
+    b = rng.randn(2, 1, 1, 6).astype(np.float32)
+    out = cc.apply({}, [jnp.asarray(a), jnp.asarray(b)], ctx_eval())[0]
+    assert np.allclose(np.asarray(out), np.concatenate([a, b], axis=-1))
+
+    ch = make_layer("ch_concat", [], inputs=(1, 2), outputs=(3,))
+    assert ch.infer_shapes([(3, 5, 5), (2, 5, 5)]) == [(5, 5, 5)]
+
+
+def test_bias_layer(rng):
+    layer = make_layer("bias", [("init_bias", "0.5")])
+    layer.infer_shapes([(1, 1, 6)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(1, 1, 6)])
+    x = rng.randn(2, 1, 1, 6).astype(np.float32)
+    out = layer.apply(params, [jnp.asarray(x)], ctx_eval())[0]
+    assert np.allclose(np.asarray(out), x + 0.5)
+
+
+# ---------------------------------------------------------------- losses
+def test_softmax_loss_grad_is_p_minus_onehot(rng):
+    layer = make_layer("softmax", [], inputs=(1,), outputs=(1,))
+    layer.infer_shapes([(1, 1, 5)])
+    x = rng.randn(4, 1, 1, 5).astype(np.float32)
+    labels = {"label": jnp.asarray(rng.randint(0, 5, (4, 1)).astype(np.float32))}
+
+    def loss_fn(xj):
+        ctx = ApplyContext(train=True, rng=None, labels=labels, batch_size=4)
+        layer.apply({}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 5)
+    p = np.exp(x.reshape(4, 5))
+    p /= p.sum(axis=1, keepdims=True)
+    onehot = np.eye(5)[np.asarray(labels["label"])[:, 0].astype(int)]
+    # reference grad: (p - onehot) * grad_scale / batch_size
+    np.testing.assert_allclose(g, (p - onehot) / 4.0, rtol=1e-4, atol=1e-6)
+
+
+def test_l2_loss_grad(rng):
+    layer = make_layer("l2_loss", [], inputs=(1,), outputs=(1,))
+    layer.infer_shapes([(1, 1, 3)])
+    x = rng.randn(4, 1, 1, 3).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    labels = {"label": jnp.asarray(y)}
+
+    def loss_fn(xj):
+        ctx = ApplyContext(train=True, rng=None, labels=labels, batch_size=4)
+        layer.apply({}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 3)
+    np.testing.assert_allclose(g, (x.reshape(4, 3) - y) / 4.0, rtol=1e-5)
+
+
+def test_multi_logistic_grad(rng):
+    layer = make_layer("multi_logistic", [], inputs=(1,), outputs=(1,))
+    layer.infer_shapes([(1, 1, 3)])
+    x = rng.randn(4, 1, 1, 3).astype(np.float32)
+    y = rng.randint(0, 2, (4, 3)).astype(np.float32)
+    labels = {"label": jnp.asarray(y)}
+
+    def loss_fn(xj):
+        ctx = ApplyContext(train=True, rng=None, labels=labels, batch_size=4)
+        layer.apply({}, [xj], ctx)
+        return ctx.losses[0]
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 3)
+    sig = 1 / (1 + np.exp(-x.reshape(4, 3)))
+    np.testing.assert_allclose(g, (sig - y) / 4.0, rtol=1e-4, atol=1e-6)

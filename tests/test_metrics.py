@@ -1,0 +1,73 @@
+"""Metric tests (reference: src/utils/metric.h)."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.metrics import MetricSet, create_metric
+
+
+def test_error_metric():
+    m = create_metric("error")
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = np.array([[1.0], [1.0], [1.0]])
+    m.add_eval(pred, label)
+    np.testing.assert_allclose(m.get(), 1.0 / 3.0)
+
+
+def test_error_metric_scalar_threshold():
+    m = create_metric("error")
+    pred = np.array([[0.5], [-0.5]])
+    label = np.array([[1.0], [0.0]])
+    m.add_eval(pred, label)
+    assert m.get() == 0.0
+
+
+def test_rmse():
+    m = create_metric("rmse")
+    pred = np.array([[1.0, 2.0]])
+    label = np.array([[0.0, 0.0]])
+    m.add_eval(pred, label)
+    np.testing.assert_allclose(m.get(), 5.0)
+
+
+def test_logloss():
+    m = create_metric("logloss")
+    pred = np.array([[0.25, 0.75]])
+    label = np.array([[1.0]])
+    m.add_eval(pred, label)
+    np.testing.assert_allclose(m.get(), -np.log(0.75), rtol=1e-6)
+
+
+def test_logloss_clips():
+    m = create_metric("logloss")
+    m.add_eval(np.array([[1.0, 0.0]]), np.array([[1.0]]))
+    assert np.isfinite(m.get())
+
+
+def test_rec_at_n():
+    m = create_metric("rec@2")
+    pred = np.array([[0.1, 0.5, 0.4], [0.9, 0.06, 0.04]])
+    label = np.array([[2.0], [2.0]])
+    m.add_eval(pred, label)
+    np.testing.assert_allclose(m.get(), 0.5)
+
+
+def test_metric_set_print_format():
+    ms = MetricSet()
+    assert ms.configure("metric", "error")
+    assert ms.configure("metric[label]", "logloss")
+    assert not ms.configure("batch_size", "10")
+    pred = np.array([[0.2, 0.8]])
+    ms.add_eval([pred, pred], {"label": np.array([[1.0]])})
+    out = ms.print("test")
+    assert out.startswith("\ttest-error:")
+    assert "test-logloss:" in out
+
+
+def test_metric_set_multi_field():
+    ms = MetricSet()
+    ms.configure("metric[aux]", "rmse")
+    ms.add_eval([np.array([[1.0]])], {"aux": np.array([[3.0]]),
+                                      "label": np.array([[0.0]])})
+    np.testing.assert_allclose(ms.metrics[0].get(), 4.0)
+    assert "[aux]" in ms.print("e")

@@ -1,0 +1,195 @@
+"""End-to-end: synthetic MNIST-format data -> config -> CLI train -> eval
+improves -> checkpoint/resume -> predict/extract. The examples-as-integration-
+tests strategy of the reference (SURVEY §4.5), runnable hermetically."""
+
+import gzip
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import Net
+from cxxnet_tpu.cli import LearnTask
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.utils.config import tokenize
+
+
+def write_idx_images(path, images):
+    """images: (n, rows, cols) uint8."""
+    n, r, c = images.shape
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, r, c))
+        f.write(images.tobytes())
+
+
+def write_idx_labels(path, labels):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, labels.shape[0]))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+@pytest.fixture(scope="module")
+def synth_mnist(tmp_path_factory):
+    """Linearly-separable 10-class 8x8 'digits'."""
+    d = tmp_path_factory.mktemp("mnist")
+    rs = np.random.RandomState(42)
+    protos = rs.rand(10, 8, 8) * 255
+    n_train, n_test = 512, 128
+
+    def gen(n):
+        y = rs.randint(0, 10, n)
+        x = protos[y] + rs.randn(n, 8, 8) * 20
+        return np.clip(x, 0, 255).astype(np.uint8), y
+
+    xtr, ytr = gen(n_train)
+    xte, yte = gen(n_test)
+    write_idx_images(str(d / "train-img.gz"), xtr)
+    write_idx_labels(str(d / "train-lab.gz"), ytr)
+    write_idx_images(str(d / "test-img.gz"), xte)
+    write_idx_labels(str(d / "test-lab.gz"), yte)
+    return d
+
+
+CONF = """
+data = train
+iter = mnist
+    path_img = "{d}/train-img.gz"
+    path_label = "{d}/train-lab.gz"
+    shuffle = 1
+iter = end
+eval = test
+iter = mnist
+    path_img = "{d}/test-img.gz"
+    path_label = "{d}/test-lab.gz"
+iter = end
+
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 64
+  init_sigma = 0.05
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,64
+batch_size = 64
+dev = cpu
+save_model = 2
+max_round = 4
+num_round = 4
+train_eval = 1
+random_type = gaussian
+eta = 0.2
+momentum = 0.9
+wd  = 0.0
+metric = error
+eval_train = 1
+model_dir = {md}
+"""
+
+
+def test_cli_train_and_resume(synth_mnist, tmp_path, capfd):
+    md = tmp_path / "models"
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=md))
+
+    task = LearnTask()
+    assert task.run([str(conf)]) == 0
+    err = capfd.readouterr().err
+    lines = [l for l in err.splitlines() if l.startswith("[")]
+    assert len(lines) == 4
+    # eval error should drop well below chance (0.9) by round 4
+    last_err = float(lines[-1].split("test-error:")[1].split()[0])
+    assert last_err < 0.3, "training did not converge: %s" % lines
+    # snapshots written every save_model=2 rounds
+    assert sorted(os.listdir(md)) == ["0002.model", "0004.model"]
+
+    # resume with continue=1 runs rounds 5..6
+    task2 = LearnTask()
+    assert task2.run([str(conf), "continue=1", "num_round=6"]) == 0
+    err2 = capfd.readouterr().err
+    lines2 = [l for l in err2.splitlines() if l.startswith("[")]
+    assert lines2 and lines2[0].startswith("[5]")
+
+
+def test_predict_and_extract(synth_mnist, tmp_path, capfd):
+    md = tmp_path / "models"
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=md))
+    LearnTask().run([str(conf), "num_round=3", "max_round=3", "save_model=3"])
+    capfd.readouterr()
+
+    pred_file = tmp_path / "pred.txt"
+    pred_cfg = tmp_path / "pred.conf"
+    pred_cfg.write_text(
+        CONF.format(d=synth_mnist, md=md) +
+        "\npred = %s\niter = mnist\npath_img = \"%s/test-img.gz\"\n"
+        "path_label = \"%s/test-lab.gz\"\niter = end\n"
+        % (pred_file, synth_mnist, synth_mnist))
+    LearnTask().run([str(pred_cfg), "task=pred",
+                     "model_in=%s" % (md / "0003.model")])
+    preds = np.loadtxt(pred_file)
+    assert preds.shape[0] == 128
+    assert set(np.unique(preds)).issubset(set(range(10)))
+
+    # extract features from the hidden node by name
+    ex_file = tmp_path / "feat.txt"
+    ex_cfg = tmp_path / "ex.conf"
+    ex_cfg.write_text(
+        CONF.format(d=synth_mnist, md=md) +
+        "\npred = %s\niter = mnist\npath_img = \"%s/test-img.gz\"\n"
+        "path_label = \"%s/test-lab.gz\"\niter = end\n"
+        % (ex_file, synth_mnist, synth_mnist))
+    LearnTask().run([str(ex_cfg), "task=extract", "extract_node_name=sg1",
+                     "model_in=%s" % (md / "0003.model")])
+    feats = np.loadtxt(ex_file)
+    assert feats.shape == (128, 64)
+
+
+def test_checkpoint_roundtrip(synth_mnist, tmp_path):
+    cfg = tokenize(CONF.format(d=synth_mnist, md=tmp_path))
+    net = Net([p for p in cfg if p[0] not in ("data", "eval", "iter",
+                                              "path_img", "path_label",
+                                              "shuffle")])
+    net.init_model()
+    w0 = net.get_weight("fc1", "wmat")
+    path = str(tmp_path / "m.model")
+    net.save_model(path)
+
+    net2 = Net([p for p in cfg if p[0] not in ("data", "eval", "iter",
+                                               "path_img", "path_label",
+                                               "shuffle")])
+    net2.load_model(path)
+    np.testing.assert_allclose(net2.get_weight("fc1", "wmat"), w0)
+
+
+def test_finetune_copy(synth_mnist, tmp_path):
+    base_cfg = [p for p in tokenize(CONF.format(d=synth_mnist, md=tmp_path))
+                if p[0] not in ("data", "eval", "iter", "path_img",
+                                "path_label", "shuffle")]
+    a = Net(base_cfg)
+    a.init_model()
+    b = Net(base_cfg)
+    b.init_model()
+    b.copy_model_from(a)
+    np.testing.assert_allclose(b.get_weight("fc1", "wmat"),
+                               a.get_weight("fc1", "wmat"))
+    assert b.epoch_counter == 0
+
+
+def test_set_get_weight(synth_mnist, tmp_path):
+    base_cfg = [p for p in tokenize(CONF.format(d=synth_mnist, md=tmp_path))
+                if p[0] not in ("data", "eval", "iter", "path_img",
+                                "path_label", "shuffle")]
+    net = Net(base_cfg)
+    net.init_model()
+    w = net.get_weight("fc2", "wmat")
+    new = np.zeros_like(w)
+    net.set_weight("fc2", "wmat", new)
+    np.testing.assert_allclose(net.get_weight("fc2", "wmat"), new)

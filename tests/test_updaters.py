@@ -1,0 +1,103 @@
+"""Optimizer math + schedule tests (reference: src/updater/)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cxxnet_tpu.updaters import (AdamUpdater, NAGUpdater, SGDUpdater,
+                                 UpdaterParam, clip_grad, create_updater)
+
+
+def test_sgd_momentum_math():
+    upd = SGDUpdater("wmat", [("eta", "0.1"), ("momentum", "0.9"),
+                              ("wd", "0.01")])
+    w = jnp.asarray(np.ones((3,), np.float32))
+    g = jnp.asarray(np.full((3,), 2.0, np.float32))
+    s = upd.init_state(w)
+    w1, s1 = upd.update(w, g, s, 0)
+    # m = 0.9*0 - 0.1*(2 + 0.01*1) = -0.201 ; w = 1 - 0.201
+    np.testing.assert_allclose(np.asarray(w1), 1 - 0.201, rtol=1e-6)
+    w2, s2 = upd.update(w1, g, s1, 1)
+    m2 = 0.9 * -0.201 - 0.1 * (2 + 0.01 * float(w1[0]))
+    np.testing.assert_allclose(np.asarray(w2), float(w1[0]) + m2, rtol=1e-6)
+
+
+def test_sgd_nan_grad_clipped_to_zero():
+    upd = SGDUpdater("wmat", [("eta", "0.1"), ("momentum", "0.0"),
+                              ("clip_gradient", "1.0")])
+    w = jnp.zeros((3,))
+    g = jnp.asarray(np.array([np.nan, 5.0, -5.0], np.float32))
+    w1, _ = upd.update(w, g, upd.init_state(w), 0)
+    np.testing.assert_allclose(np.asarray(w1), [0.0, -0.1, 0.1], rtol=1e-6)
+
+
+def test_nag_math():
+    upd = NAGUpdater("wmat", [("eta", "0.1"), ("momentum", "0.9")])
+    w = jnp.ones((2,))
+    g = jnp.full((2,), 1.0)
+    s = upd.init_state(w)
+    w1, s1 = upd.update(w, g, s, 0)
+    # m_new = -0.1; w += 1.9*(-0.1) - 0.9*0 = -0.19
+    np.testing.assert_allclose(np.asarray(w1), 1 - 0.19, rtol=1e-6)
+
+
+def test_adam_math():
+    upd = AdamUpdater("wmat", [("eta", "0.001")])
+    w = jnp.ones((2,))
+    g = jnp.full((2,), 3.0)
+    s = upd.init_state(w)
+    w1, s1 = upd.update(w, g, s, 0)
+    fix1 = 1 - 0.9 ** 1
+    fix2 = 1 - 0.999 ** 1
+    lr_t = 0.001 * np.sqrt(fix2) / fix1
+    m1 = 0.1 * 3.0
+    m2 = 0.001 * 9.0
+    expect = 1 - lr_t * (m1 / (np.sqrt(m2) + 1e-8))
+    np.testing.assert_allclose(np.asarray(w1), expect, rtol=1e-5)
+
+
+def test_lr_schedules():
+    p = UpdaterParam("wmat")
+    p.set_param("eta", "0.5")
+    p.set_param("lr:schedule", "expdecay")
+    p.set_param("lr:gamma", "0.5")
+    p.set_param("lr:step", "10")
+    lr, _ = p.schedule(10)
+    np.testing.assert_allclose(float(lr), 0.25, rtol=1e-5)
+    lr, _ = p.schedule(5)
+    np.testing.assert_allclose(float(lr), 0.5 * 0.5 ** 0.5, rtol=1e-5)
+
+    p2 = UpdaterParam("wmat")
+    p2.set_param("eta", "0.5")
+    p2.set_param("lr:schedule", "factor")
+    p2.set_param("lr:factor", "0.1")
+    p2.set_param("lr:step", "10")
+    # integer division: epochs 0-9 -> 0.5, 10-19 -> 0.05
+    np.testing.assert_allclose(float(p2.schedule(9)[0]), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(p2.schedule(10)[0]), 0.05, rtol=1e-5)
+
+    p3 = UpdaterParam("wmat")
+    p3.set_param("eta", "0.5")
+    p3.set_param("lr:schedule", "factor")
+    p3.set_param("lr:factor", "1e-9")
+    p3.set_param("lr:step", "1")
+    # lr_minimum floor (default 1e-5)
+    np.testing.assert_allclose(float(p3.schedule(5)[0]), 1e-5, rtol=1e-5)
+
+
+def test_tag_scoped_params():
+    upd_w = SGDUpdater("wmat", [("wd", "0.01"), ("bias:wd", "0.0")])
+    upd_b = SGDUpdater("bias", [("wd", "0.01"), ("bias:wd", "0.0")])
+    assert upd_w.param.wd == 0.01
+    assert upd_b.param.wd == 0.0
+
+
+def test_factory():
+    assert isinstance(create_updater("sgd", "wmat", []), SGDUpdater)
+    assert isinstance(create_updater("nag", "wmat", []), NAGUpdater)
+    assert isinstance(create_updater("adam", "wmat", []), AdamUpdater)
+
+
+def test_clip_grad():
+    g = jnp.asarray(np.array([np.nan, 10.0, -10.0, 0.5], np.float32))
+    out = np.asarray(clip_grad(g, 2.0))
+    np.testing.assert_allclose(out, [0.0, 2.0, -2.0, 0.5])
