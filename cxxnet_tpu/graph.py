@@ -63,7 +63,8 @@ class LayerSpec:
     def struct_eq(self, other: "LayerSpec") -> bool:
         return (self.type == other.type and self.name == other.name
                 and self.inputs == other.inputs and self.outputs == other.outputs
-                and self.primary == other.primary)
+                and self.primary == other.primary
+                and self.pairtest == other.pairtest)
 
 
 _LAYER_PLUS = re.compile(r"^layer\[\+(\d+)(?::([^\]]+))?\]$")
@@ -260,7 +261,8 @@ class NetGraph:
             "extra_shapes": [list(s) for s in self.extra_shapes],
             "layers": [
                 {"type": l.type, "name": l.name, "inputs": l.inputs,
-                 "outputs": l.outputs, "primary": l.primary}
+                 "outputs": l.outputs, "primary": l.primary,
+                 "pairtest": list(l.pairtest) if l.pairtest else None}
                 for l in self.layers
             ],
         }
@@ -276,8 +278,10 @@ class NetGraph:
         g.extra_data_num = state.get("extra_data_num", 0)
         g.extra_shapes = [tuple(s) for s in state.get("extra_shapes", [])]
         for i, l in enumerate(state["layers"]):
+            pt = l.get("pairtest")
             spec = LayerSpec(l["type"], l["name"], list(l["inputs"]),
-                             list(l["outputs"]), primary=l.get("primary", -1))
+                             list(l["outputs"]), primary=l.get("primary", -1),
+                             pairtest=tuple(pt) if pt else None)
             g.layers.append(spec)
             if spec.name:
                 if spec.name in g.layer_name_map:

@@ -6,6 +6,7 @@ from .base import (ApplyContext, Layer, LayerParam, LAYER_REGISTRY,
 from . import simple   # noqa: F401  (registers dense/activation/structural layers)
 from . import conv     # noqa: F401  (registers conv/pooling/lrn/batch_norm)
 from . import loss     # noqa: F401  (registers softmax/l2_loss/multi_logistic)
+from . import pairtest  # noqa: F401  (registers the differential-test layer)
 
 __all__ = ["ApplyContext", "Layer", "LayerParam", "LAYER_REGISTRY",
            "create_layer", "register_layer"]

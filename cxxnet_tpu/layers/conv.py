@@ -85,12 +85,20 @@ class ConvLayer(Layer):
         return [out]
 
 
-def _pool_out_dim(in_dim: int, k: int, stride: int) -> int:
-    return min(in_dim - k + stride - 1, in_dim - 1) // stride + 1
+def _pool_out_dim(in_dim: int, k: int, stride: int, max_start: int) -> int:
+    """Ceil-mode output size; ``max_start`` bounds the last window's start so
+    every window overlaps real data (or at worst the left padding) — with
+    pad=0 this reduces to the reference clamp ``min(..., in-1)``."""
+    return min(in_dim - k + stride - 1, max_start) // stride + 1
 
 
 class _PoolingLayer(Layer):
-    """Shared machinery for the pooling trio (ceil-mode partial edge windows)."""
+    """Shared machinery for the pooling trio (ceil-mode partial edge windows).
+
+    Extension over the reference: ``pad`` / ``pad_y`` / ``pad_x`` apply
+    symmetric identity-element padding before pooling (the reference pooling
+    ignores pad; default 0 keeps exact parity). Needed for 'same'-size pooling
+    branches in inception-style modules."""
     reducer = "max"          # "max" | "sum" | "avg"
 
     def pre_activation(self, x: jnp.ndarray, ctx: ApplyContext) -> jnp.ndarray:
@@ -101,11 +109,17 @@ class _PoolingLayer(Layer):
         p = self.param
         if p.kernel_height <= 0 or p.kernel_width <= 0:
             raise ConfigError("pooling: must set kernel_size")
-        if p.kernel_height > y or p.kernel_width > x:
+        y_eff, x_eff = y + 2 * p.pad_y, x + 2 * p.pad_x
+        if p.kernel_height > y_eff or p.kernel_width > x_eff:
             raise ConfigError("pooling: kernel size exceeds input")
-        self.out_y = _pool_out_dim(y, p.kernel_height, p.stride)
-        self.out_x = _pool_out_dim(x, p.kernel_width, p.stride)
-        self.in_y, self.in_x = y, x
+        # last window must start at or before the last real row/col (in padded
+        # coords: y + pad - 1), else a window could cover only padding and a
+        # max pool would emit its -inf identity
+        self.out_y = _pool_out_dim(y_eff, p.kernel_height, p.stride,
+                                   y + p.pad_y - 1)
+        self.out_x = _pool_out_dim(x_eff, p.kernel_width, p.stride,
+                                   x + p.pad_x - 1)
+        self.in_y, self.in_x = y_eff, x_eff
         return [(c, self.out_y, self.out_x)]
 
     def apply(self, params: Params, inputs: List[jnp.ndarray],
@@ -116,7 +130,8 @@ class _PoolingLayer(Layer):
         pad_x = max(0, (self.out_x - 1) * p.stride + p.kernel_width - self.in_x)
         window = (1, p.kernel_height, p.kernel_width, 1)
         strides = (1, p.stride, p.stride, 1)
-        padding = ((0, 0), (0, pad_y), (0, pad_x), (0, 0))
+        padding = ((0, 0), (p.pad_y, p.pad_y + pad_y),
+                   (p.pad_x, p.pad_x + pad_x), (0, 0))
         if self.reducer == "max":
             init = -jnp.inf
             out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides,

@@ -1,0 +1,125 @@
+"""PairTest layer — differential testing of two layer implementations.
+
+Reference (/root/reference/src/layer/pairtest_layer-inl.hpp:14-200): config
+``layer[...] = pairtest-master-slave`` wraps two implementations; the master
+drives the real nodes while the slave runs on shadow state with weights synced
+from the master, and every Forward/Backprop compares outputs within tolerance,
+reporting the max-diff element. This is how the custom conv was validated
+against cuDNN/Caffe.
+
+Functional redesign: both layers share one parameter set (their param shapes
+must agree — e.g. ``pairtest-conv-conv``, or an XLA layer vs. its Pallas
+variant). ``apply`` computes both outputs inside the jitted graph, emits the
+max abs diff via ``jax.debug.print`` when it exceeds ``pairtest_tol``, and
+returns the master's outputs; because autodiff flows only through the master's
+result, training behavior is identical to running the master alone.
+``master:`` / ``slave:`` config-key prefixes scope settings to one side
+(pairtest_layer-inl.hpp:127-135).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..graph import LayerSpec
+from ..utils.config import ConfigError
+from .base import ApplyContext, Layer, Params, Shape3, register_layer
+
+
+def _scoped_cfg(cfg, side: str):
+    """Split ``master:``/``slave:`` prefixed keys; unprefixed go to both."""
+    out = []
+    for k, v in cfg:
+        if k.startswith("master:"):
+            if side == "master":
+                out.append((k[len("master:"):], v))
+        elif k.startswith("slave:"):
+            if side == "slave":
+                out.append((k[len("slave:"):], v))
+        else:
+            out.append((k, v))
+    return out
+
+
+@register_layer
+class PairTestLayer(Layer):
+    type_name = "pairtest"
+    uses_rng = True
+
+    def __init__(self, spec: LayerSpec, cfg):
+        from .base import LAYER_REGISTRY       # late: registry fully populated
+        self.tol = 1e-5
+        super().__init__(spec, cfg)
+        if spec.pairtest is None:
+            raise ConfigError("pairtest layer missing master/slave types")
+        mtype, stype = spec.pairtest
+        for t in (mtype, stype):
+            if t not in LAYER_REGISTRY:
+                raise ConfigError("pairtest: unknown layer type %r" % t)
+        mspec = LayerSpec(mtype, spec.name, spec.inputs, spec.outputs)
+        sspec = LayerSpec(stype, spec.name, spec.inputs, spec.outputs)
+        self.master = LAYER_REGISTRY[mtype](mspec, _scoped_cfg(cfg, "master"))
+        self.slave = LAYER_REGISTRY[stype](sspec, _scoped_cfg(cfg, "slave"))
+        if self.master.is_loss or self.slave.is_loss:
+            # pairing loss layers would double-count ctx.losses and route
+            # gradient through both copies
+            raise ConfigError("pairtest cannot wrap loss layers")
+
+    def set_param(self, name, val):
+        if name == "pairtest_tol":
+            self.tol = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        mshape = self.master.infer_shapes(in_shapes)
+        sshape = self.slave.infer_shapes(in_shapes)
+        if mshape != sshape:
+            raise ConfigError(
+                "pairtest: master %r and slave %r disagree on output shape "
+                "(%r vs %r)" % (self.master.type_name, self.slave.type_name,
+                                mshape, sshape))
+        return mshape
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
+        mp = self.master.init_params(key, in_shapes)
+        sp = self.slave.init_params(key, in_shapes)
+        if jax.tree.structure(mp) != jax.tree.structure(sp) or any(
+                mp[t].shape != sp[t].shape for t in mp):
+            raise ConfigError(
+                "pairtest: master and slave parameter shapes differ — pair "
+                "only implementations of the same op")
+        return mp        # single shared parameter set (slave "synced" by construction)
+
+    def init_state(self):
+        if hasattr(self.master, "init_state"):
+            return self.master.init_state()
+        return {}
+
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        mouts = self.master.apply(params, inputs, ctx)
+        # the slave runs in an isolated context (own rng stream, discarded
+        # losses/state) so the master's behavior is bit-identical to running
+        # it alone; stop_gradient keeps autodiff on the master path only
+        slave_ctx = ApplyContext(
+            train=ctx.train,
+            rng=ctx.next_key() if self.slave.uses_rng and ctx.train else None,
+            labels=ctx.labels, sample_mask=ctx.sample_mask,
+            batch_size=ctx.batch_size, update_period=ctx.update_period,
+            epoch=ctx.epoch, states=ctx.states)
+        souts = self.slave.apply(params, [jax.lax.stop_gradient(x)
+                                          for x in inputs], slave_ctx)
+        for i, (m, s) in enumerate(zip(mouts, souts)):
+            # relative-absolute error as in CmpResult (pairtest:172-199)
+            err = jax.lax.stop_gradient(
+                jnp.max(jnp.abs(m - s) / (jnp.abs(m) + 1e-6)))
+            jax.lax.cond(
+                err > self.tol,
+                lambda e: jax.debug.print(
+                    "PairTest[" + self.spec.key() + " out" + str(i) +
+                    "]: max rel-abs diff {e} exceeds tol", e=e),
+                lambda e: None,
+                err)
+        return mouts

@@ -1,0 +1,123 @@
+"""Inception-BN (Ioffe & Szegedy 2015, GoogLeNet-v2 style) netconfig generator.
+
+Exercises the full structural layer set: split / ch_concat / batch_norm /
+grouped pooling branches — the workload BASELINE.md lists as
+"Inception-BN-style nets (split/concat/batch-norm layers exist for this)".
+The factorized 5x5->double-3x3 towers follow the BN-Inception paper.
+"""
+
+from __future__ import annotations
+
+
+def _conv_bn_relu(lines, src, dst, name, nch, k, pad=0, stride=1):
+    lines.append("layer[%s->%s] = conv:%s" % (src, dst, name))
+    lines.append("  kernel_size = %d" % k)
+    if pad:
+        lines.append("  pad = %d" % pad)
+    if stride != 1:
+        lines.append("  stride = %d" % stride)
+    lines.append("  nchannel = %d" % nch)
+    lines.append("  random_type = xavier")
+    lines.append("  no_bias = 1")
+    lines.append("layer[%s->%s] = batch_norm:%s_bn" % (dst, dst, name))
+    lines.append("layer[%s->%s] = relu" % (dst, dst))
+    return dst
+
+
+def _inception(lines, src, prefix, n1, n3r, n3, nd3r, nd3, pool, npool,
+               stride=1):
+    """One inception module; returns the output node name."""
+    branches = []
+    # branch tags
+    b1 = "%s_b1" % prefix
+    b3a, b3b = "%s_b3r" % prefix, "%s_b3" % prefix
+    bd1, bd2, bd3 = "%s_bd3r" % prefix, "%s_bd3a" % prefix, "%s_bd3b" % prefix
+    bp, bpc = "%s_pool" % prefix, "%s_proj" % prefix
+    fan = []
+    if n1 > 0:
+        fan.append(b1)
+    fan.extend([b3a, bd1, bp])
+    lines.append("layer[%s->%s] = split" % (src, ",".join(fan)))
+    if n1 > 0:
+        _conv_bn_relu(lines, b1, b1, "%s_1x1" % prefix, n1, 1)
+        branches.append(b1)
+    _conv_bn_relu(lines, b3a, b3a, "%s_3x3r" % prefix, n3r, 1)
+    _conv_bn_relu(lines, b3a, b3b, "%s_3x3" % prefix, n3, 3, pad=1,
+                  stride=stride)
+    branches.append(b3b)
+    _conv_bn_relu(lines, bd1, bd1, "%s_d3r" % prefix, nd3r, 1)
+    _conv_bn_relu(lines, bd1, bd2, "%s_d3a" % prefix, nd3, 3, pad=1)
+    _conv_bn_relu(lines, bd2, bd3, "%s_d3b" % prefix, nd3, 3, pad=1,
+                  stride=stride)
+    branches.append(bd3)
+    lines.append("layer[%s->%s] = %s_pooling" % (bp, bp, pool))
+    if stride == 1:
+        # 'same'-size pooling branch: k3 s1 with symmetric pad 1
+        lines.append("  kernel_size = 3")
+        lines.append("  pad = 1")
+    else:
+        # reduction: k2 s2 matches the stride-2 pad-1 3x3 conv branches'
+        # floor((H-1)/2)+1 output under our ceil-mode formula
+        lines.append("  kernel_size = 2")
+    lines.append("  stride = %d" % stride)
+    if npool > 0:
+        _conv_bn_relu(lines, bp, bpc, "%s_proj" % prefix, npool, 1)
+        branches.append(bpc)
+    else:
+        branches.append(bp)
+    out = "%s_out" % prefix
+    lines.append("layer[%s->%s] = ch_concat" % (",".join(branches), out))
+    return out
+
+
+def inception_bn_config(batch_size: int = 128, num_classes: int = 1000,
+                        dev: str = "tpu", precision: str = "bfloat16") -> str:
+    """Full-size BN-Inception stem + 3a/3b towers + reduction + 4a + head.
+
+    NOTE on fidelity: pooling-branch padding differs from the paper (our
+    pooling layer is pad-free ceil-mode, as in the reference framework), so
+    modules use stride-2 reductions where spatial dims must align.
+    """
+    L = []
+    L.append("netconfig=start")
+    # stem: 7x7/2 conv, pool, 3x3 conv, pool
+    _conv_bn_relu(L, "0", "stem1", "conv1", 64, 7, pad=3, stride=2)
+    L.append("layer[stem1->stem1p] = max_pooling")
+    L.append("  kernel_size = 3")
+    L.append("  stride = 2")
+    _conv_bn_relu(L, "stem1p", "stem2r", "conv2r", 64, 1)
+    _conv_bn_relu(L, "stem2r", "stem2", "conv2", 192, 3, pad=1)
+    L.append("layer[stem2->stem2p] = max_pooling")
+    L.append("  kernel_size = 3")
+    L.append("  stride = 2")
+    n = _inception(L, "stem2p", "i3a", 64, 64, 64, 64, 96, "avg", 32)
+    n = _inception(L, n, "i3b", 64, 64, 96, 64, 96, "avg", 64)
+    n = _inception(L, n, "i3c", 0, 128, 160, 64, 96, "max", 0, stride=2)
+    n = _inception(L, n, "i4a", 224, 64, 96, 96, 128, "avg", 128)
+    n = _inception(L, n, "i4b", 192, 96, 128, 96, 128, "avg", 128)
+    n = _inception(L, n, "i4c", 160, 128, 160, 128, 160, "avg", 128)
+    n = _inception(L, n, "i4d", 96, 128, 192, 160, 192, "avg", 128)
+    n = _inception(L, n, "i4e", 0, 128, 192, 192, 256, "max", 0, stride=2)
+    n = _inception(L, n, "i5a", 352, 192, 320, 160, 224, "avg", 128)
+    n = _inception(L, n, "i5b", 352, 192, 320, 192, 224, "max", 128)
+    # global average pool + classifier
+    L.append("layer[%s->gap] = avg_pooling" % n)
+    L.append("  kernel_size = 7")
+    L.append("  stride = 1")
+    L.append("layer[gap->flat] = flatten")
+    L.append("layer[flat->fc] = fullc:fc1")
+    L.append("  nhidden = %d" % num_classes)
+    L.append("  init_sigma = 0.01")
+    L.append("layer[fc->fc] = softmax")
+    L.append("netconfig=end")
+    L.append("input_shape = 3,224,224")
+    L.append("batch_size = %d" % batch_size)
+    if dev:
+        L.append("dev = %s" % dev)
+    L.append("precision = %s" % precision)
+    L.append("eta = 0.05")
+    L.append("momentum = 0.9")
+    L.append("wd = 0.0001")
+    L.append("metric = error")
+    L.append("metric = rec@5")
+    return "\n".join(L) + "\n"

@@ -1,0 +1,53 @@
+"""VGG-16 (Simonyan & Zisserman 2014) netconfig generator — the data-parallel
+parity workload from BASELINE.md ("VGG-16 data-parallel across the TPU mesh")."""
+
+from __future__ import annotations
+
+_VGG16_PLAN = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+def vgg16_config(batch_size: int = 64, num_classes: int = 1000,
+                 dev: str = "tpu", precision: str = "bfloat16") -> str:
+    L = ["netconfig=start"]
+    src = "0"
+    node = 0
+    for block, (nch, reps) in enumerate(_VGG16_PLAN, start=1):
+        for r in range(1, reps + 1):
+            dst = "c%d_%d" % (block, r)
+            L.append("layer[%s->%s] = conv:conv%d_%d" % (src, dst, block, r))
+            L.append("  kernel_size = 3")
+            L.append("  pad = 1")
+            L.append("  nchannel = %d" % nch)
+            L.append("  random_type = xavier")
+            L.append("layer[%s->%s] = relu" % (dst, dst))
+            src = dst
+        dst = "p%d" % block
+        L.append("layer[%s->%s] = max_pooling" % (src, dst))
+        L.append("  kernel_size = 2")
+        L.append("  stride = 2")
+        src = dst
+    L.append("layer[%s->flat] = flatten" % src)
+    for i, nh in ((6, 4096), (7, 4096)):
+        L.append("layer[%s->fc%d] = fullc:fc%d" % ("flat" if i == 6
+                                                   else "fc6", i, i))
+        L.append("  nhidden = %d" % nh)
+        L.append("  random_type = xavier")
+        L.append("layer[fc%d->fc%d] = relu" % (i, i))
+        L.append("layer[fc%d->fc%d] = dropout" % (i, i))
+        L.append("  threshold = 0.5")
+    L.append("layer[fc7->fc8] = fullc:fc8")
+    L.append("  nhidden = %d" % num_classes)
+    L.append("  init_sigma = 0.01")
+    L.append("layer[fc8->fc8] = softmax")
+    L.append("netconfig=end")
+    L.append("input_shape = 3,224,224")
+    L.append("batch_size = %d" % batch_size)
+    if dev:
+        L.append("dev = %s" % dev)
+    L.append("precision = %s" % precision)
+    L.append("eta = 0.01")
+    L.append("momentum = 0.9")
+    L.append("wd = 0.0005")
+    L.append("metric = error")
+    L.append("metric = rec@5")
+    return "\n".join(L) + "\n"

@@ -101,6 +101,26 @@ def test_avg_pooling_divides_by_full_window(rng):
     np.testing.assert_allclose(out[0, 0], [[1.0, 0.5], [0.5, 0.25]])
 
 
+def test_padded_max_pooling_no_inf(rng):
+    # regression: ceil-mode + symmetric pad must never create windows that
+    # cover only padding (whose max would be the -inf identity)
+    layer = make_layer("max_pooling", [("kernel_size", "2"), ("stride", "2"),
+                                       ("pad", "1")])
+    out_shape = layer.infer_shapes([(1, 3, 3)])[0]
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out = np.asarray(layer.apply({}, [jnp.asarray(x.transpose(0, 2, 3, 1))],
+                                 ctx_eval())[0])
+    assert np.isfinite(out).all()
+    assert out_shape == (1, 2, 2)
+
+
+def test_same_size_padded_pooling():
+    # k3 s1 pad1 keeps spatial dims (inception 'same' pooling branch)
+    layer = make_layer("max_pooling", [("kernel_size", "3"), ("stride", "1"),
+                                       ("pad", "1")])
+    assert layer.infer_shapes([(4, 14, 14)]) == [(4, 14, 14)]
+
+
 def test_sum_pooling(rng):
     layer = make_layer("sum_pooling", [("kernel_size", "2"), ("stride", "1")])
     layer.infer_shapes([(1, 3, 3)])

@@ -1,0 +1,169 @@
+"""Model-zoo configs build, shape-infer, and (for a small inception-style
+block) train — integration coverage for split/ch_concat/batch_norm graphs."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import Net
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.models.alexnet import alexnet_config
+from cxxnet_tpu.models.inception_bn import inception_bn_config
+from cxxnet_tpu.models.vgg import vgg16_config
+from cxxnet_tpu.utils.config import tokenize
+
+
+def build_graph_only(cfg_text, batch=8):
+    net = Net(tokenize(cfg_text))
+    net.set_param("batch_size", str(batch))
+    net.set_param("dev", "cpu:0")
+    net._build()
+    return net
+
+
+def test_alexnet_shapes():
+    net = build_graph_only(alexnet_config(dev=""))
+    # conv1: (227-11)/4+1 = 55
+    c1 = net.graph.layers[0].outputs[0]
+    assert net.node_shapes[c1] == (96, 55, 55)
+    out = net.node_shapes[net._out_node]
+    assert out == (1, 1, 1000)
+
+
+def test_vgg16_shapes():
+    net = build_graph_only(vgg16_config(dev=""))
+    assert net.node_shapes[net._out_node] == (1, 1, 1000)
+    # 5 pooling halvings: 224 -> 7
+    p5 = net.graph.node_map["p5"]
+    assert net.node_shapes[p5] == (512, 7, 7)
+
+
+def test_inception_bn_shapes():
+    net = build_graph_only(inception_bn_config(dev=""))
+    assert net.node_shapes[net._out_node] == (1, 1, 1000)
+    gap = net.graph.node_map["gap"]
+    assert net.node_shapes[gap][1:] == (1, 1)
+
+
+MINI_INCEPTION = """
+netconfig=start
+layer[0->s1,s2,s3] = split
+layer[s1->b1] = conv:c1
+  kernel_size = 1
+  nchannel = 8
+  random_type = xavier
+  no_bias = 1
+layer[b1->b1] = batch_norm:bn1
+layer[b1->b1] = relu
+layer[s2->b2] = conv:c2
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  random_type = xavier
+layer[b2->b2] = relu
+layer[s3->b3] = max_pooling
+  kernel_size = 3
+  pad = 1
+  stride = 1
+layer[b1,b2,b3->cat] = ch_concat
+layer[cat->pool] = avg_pooling
+  kernel_size = 16
+  stride = 1
+layer[pool->flat] = flatten
+layer[flat->out] = fullc:fc
+  nhidden = 5
+  init_sigma = 0.1
+layer[out->out] = softmax
+netconfig=end
+input_shape = 4,16,16
+batch_size = 16
+dev = cpu
+eta = 0.1
+momentum = 0.9
+metric = error
+"""
+
+
+def test_mini_inception_trains():
+    net = Net(tokenize(MINI_INCEPTION))
+    net.init_model()
+    # ch_concat output: 8 + 8 + 4 channels
+    cat = net.graph.node_map["cat"]
+    assert net.node_shapes[cat] == (20, 16, 16)
+    rs = np.random.RandomState(0)
+    losses = []
+    for i in range(30):
+        x = rs.randn(16, 4, 16, 16).astype(np.float32)
+        y = (x[:, 0].mean(axis=(1, 2)) > 0).astype(np.float32)
+        net.update(DataBatch(x, y.reshape(16, 1)))
+        losses.append(float(net._last_loss))
+    assert losses[-1] < losses[0], "loss did not decrease: %s" % losses[:3]
+
+
+def test_pairtest_layer_runs():
+    cfg = """
+netconfig=start
+layer[0->1] = pairtest-conv-conv:pt1
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.05
+layer[1->2] = flatten
+layer[2->3] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[3->3] = softmax
+netconfig=end
+input_shape = 2,8,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+"""
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 2, 8, 8).astype(np.float32)
+    y = rs.randint(0, 4, (8, 1)).astype(np.float32)
+    net.update(DataBatch(x, y))   # identical impls -> no diff report, no crash
+
+
+def test_pairtest_checkpoint_roundtrip(tmp_path):
+    cfg = """
+netconfig=start
+layer[0->1] = pairtest-fullc-fullc:pt1
+  nhidden = 4
+  init_sigma = 0.1
+layer[1->1] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+"""
+    from cxxnet_tpu.utils.config import tokenize as tk
+    net = Net(tk(cfg))
+    net.init_model()
+    path = str(tmp_path / "pt.model")
+    net.save_model(path)
+    net2 = Net(tk(cfg))
+    net2.load_model(path)     # regression: pairtest survives the roundtrip
+    np.testing.assert_allclose(net2.get_weight("pt1", "wmat"),
+                               net.get_weight("pt1", "wmat"))
+
+
+def test_pairtest_rejects_loss_layers():
+    from cxxnet_tpu.utils.config import ConfigError, tokenize as tk
+    cfg = """
+netconfig=start
+layer[+1:a] = fullc:fc
+  nhidden = 4
+layer[+0] = pairtest-softmax-softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu
+"""
+    net = Net(tk(cfg))
+    with pytest.raises(ConfigError, match="loss"):
+        net.init_model()
