@@ -1,0 +1,67 @@
+"""attachtxt — joins per-instance extra feature vectors into batches.
+
+Reference (/root/reference/src/io/iter_attach_txt-inl.hpp:15-101): a text file
+whose first token is the feature dim, followed by ``instance_id v1 .. vdim``
+records; at Next() the vector for each instance in the batch (matched by
+inst_index) lands in ``batch.extra_data[0]`` shaped (batch, 1, 1, dim) —
+feeding multi-input networks' ``in_1..in_k`` nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataBatch, IIterator, register_proc_iterator
+
+
+@register_proc_iterator("attachtxt")
+class AttachTxtIterator(IIterator):
+    def __init__(self, base: IIterator) -> None:
+        self.base = base
+        self.filename = ""
+        self.batch_size = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "filename":
+            self.filename = val
+        elif name == "batch_size":
+            self.batch_size = int(val)
+
+    def init(self) -> None:
+        self.base.init()
+        assert self.filename, "attachtxt: must set filename"
+        with open(self.filename) as f:
+            tokens = f.read().split()
+        self.dim = int(tokens[0])
+        rec = 1 + self.dim
+        body = tokens[1:]
+        assert len(body) % rec == 0, \
+            "attachtxt: data do not match the dimension specified"
+        self.id_map = {}
+        vecs = []
+        for i in range(len(body) // rec):
+            self.id_map[int(float(body[i * rec]))] = i
+            vecs.append([float(v) for v in body[i * rec + 1:(i + 1) * rec]])
+        self.vectors = np.asarray(vecs, np.float32)
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        v = self.base.value()
+        b = v.data.shape[0]
+        extra = np.zeros((b, 1, 1, self.dim), np.float32)
+        if v.inst_index is not None:
+            for top in range(b):
+                row = self.id_map.get(int(v.inst_index[top]))
+                if row is not None:
+                    extra[top, 0, 0, :] = self.vectors[row]
+        self._value = DataBatch(v.data, v.label, v.inst_index,
+                                v.num_batch_padd, [extra], v.pad_mode)
+        return True
+
+    def value(self) -> DataBatch:
+        return self._value

@@ -1,0 +1,329 @@
+"""Per-instance augmentation: affine warp, crop, mirror, scaling, mean
+subtraction, contrast/illumination jitter.
+
+Reference semantics (/root/reference/src/io/):
+- AugmentIterator (iter_augment_proc-inl.hpp:21-246): crop to input_shape
+  (random / center / fixed crop_y_start), rand_mirror / mirror, ``divideby`` /
+  ``scale``, mean subtraction by a per-pixel mean-image file (auto-generated
+  by a full dataset pass when missing, ``CreateMeanImg`` :171-198) or by
+  per-channel ``mean_value``, random contrast (x in [1-c, 1+c]) and
+  illumination (+ in [-i, i]) applied before scaling.
+- ImageAugmenter (image_augmenter-inl.hpp:13-204): affine warp combining
+  rotation (max angle / fixed ``rotate`` / ``rotate_list``), shear, scale
+  range, aspect-ratio jitter, min/max image size and fill_value, followed by
+  random/center crop; active only when rotation/shear/crop-size params are
+  set (``NeedProcess``).
+
+Channel-order note: the reference decodes BGR (OpenCV) and ``mean_value`` is
+given as ``b,g,r``; this framework decodes RGB, and ``mean_value`` is applied
+positionally to channels 0,1,2 as given. Mean-image files are ``.npy``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .data import DataInst, IIterator
+
+_RAND_MAGIC = 0
+
+
+class ImageAugmenter:
+    """Affine-warp augmenter (rotation/shear/scale/aspect + crop)."""
+
+    def __init__(self) -> None:
+        self.rand_crop = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.max_rotate_angle = 0.0
+        self.max_aspect_ratio = 0.0
+        self.max_shear_ratio = 0.0
+        self.min_crop_size = -1
+        self.max_crop_size = -1
+        self.rotate = -1.0
+        self.max_random_scale = 1.0
+        self.min_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.fill_value = 255
+        self.rotate_list = []
+        self.shape = None          # (c, y, x)
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "input_shape":
+            self.shape = tuple(int(v) for v in val.split(","))
+        elif name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        elif name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        elif name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        elif name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        elif name == "min_crop_size":
+            self.min_crop_size = int(val)
+        elif name == "max_crop_size":
+            self.max_crop_size = int(val)
+        elif name == "min_random_scale":
+            self.min_random_scale = float(val)
+        elif name == "max_random_scale":
+            self.max_random_scale = float(val)
+        elif name == "min_img_size":
+            self.min_img_size = float(val)
+        elif name == "max_img_size":
+            self.max_img_size = float(val)
+        elif name == "fill_value":
+            self.fill_value = int(val)
+        elif name == "rotate":
+            self.rotate = float(val)
+        elif name == "rotate_list":
+            self.rotate_list = [int(v) for v in val.split(",") if v]
+
+    def need_process(self) -> bool:
+        if (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate > 0 or self.rotate_list):
+            return True
+        if self.min_crop_size > 0 and self.max_crop_size > 0:
+            return True
+        return False
+
+    def process(self, chw: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        """float32 CHW (0..255) -> warped+cropped CHW at self.shape[1:]."""
+        if not self.need_process():
+            return chw
+        from PIL import Image
+        c, h, w = chw.shape
+        # random crop-of-random-size mode: crop a square of random side then
+        # the affine/crop below resizes to the target
+        if self.min_crop_size > 0 and self.max_crop_size > 0:
+            side = rng.randint(self.min_crop_size, self.max_crop_size + 1)
+            side = min(side, h, w)
+            yy = rng.randint(0, h - side + 1)
+            xx = rng.randint(0, w - side + 1)
+            chw = chw[:, yy:yy + side, xx:xx + side]
+            c, h, w = chw.shape
+        angle = 0.0
+        if self.max_rotate_angle > 0:
+            angle = rng.randint(0, int(self.max_rotate_angle * 2) + 1) \
+                - self.max_rotate_angle
+        if self.rotate > 0:
+            angle = self.rotate
+        if self.rotate_list:
+            angle = self.rotate_list[rng.randint(0, len(self.rotate_list))]
+        shear = rng.rand() * self.max_shear_ratio * 2 - self.max_shear_ratio
+        scale = rng.rand() * (self.max_random_scale - self.min_random_scale) \
+            + self.min_random_scale
+        ratio = rng.rand() * self.max_aspect_ratio * 2 \
+            - self.max_aspect_ratio + 1
+        hs = 2 * scale / (1 + ratio)
+        ws = ratio * hs
+        a = math.cos(angle / 180.0 * math.pi)
+        b = math.sin(angle / 180.0 * math.pi)
+        new_w = int(max(self.min_img_size, min(self.max_img_size, scale * w)))
+        new_h = int(max(self.min_img_size, min(self.max_img_size, scale * h)))
+        # forward affine (output <- input), same matrix construction as the
+        # reference warp (image_augmenter-inl.hpp:95-105)
+        m00 = hs * a - shear * b * ws
+        m01 = hs * b + shear * a * ws
+        m10 = -b * ws
+        m11 = a * ws
+        t0 = (new_w - (m00 * w + m01 * h)) / 2.0
+        t1 = (new_h - (m10 * w + m11 * h)) / 2.0
+        # PIL wants the inverse map (input <- output)
+        det = m00 * m11 - m01 * m10
+        if abs(det) < 1e-8:
+            det = 1e-8
+        i00, i01 = m11 / det, -m01 / det
+        i10, i11 = -m10 / det, m00 / det
+        it0 = -(i00 * t0 + i01 * t1)
+        it1 = -(i10 * t0 + i11 * t1)
+        hwc = np.clip(chw, 0, 255).astype(np.uint8).transpose(1, 2, 0)
+        img = Image.fromarray(hwc[:, :, 0] if c == 1 else hwc,
+                              mode="L" if c == 1 else "RGB")
+        warped = img.transform(
+            (new_w, new_h), Image.AFFINE,
+            (i00, i01, it0, i10, i11, it1),
+            resample=Image.BICUBIC,
+            fillcolor=(self.fill_value if c == 1
+                       else (self.fill_value,) * 3))
+        arr = np.asarray(warped, np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        out_y, out_x = self.shape[1], self.shape[2]
+        yy = max(0, arr.shape[0] - out_y)
+        xx = max(0, arr.shape[1] - out_x)
+        if self.rand_crop:
+            yy = rng.randint(0, yy + 1)
+            xx = rng.randint(0, xx + 1)
+        else:
+            yy //= 2
+            xx //= 2
+        if arr.shape[0] < out_y or arr.shape[1] < out_x:
+            # pad with fill_value if the warp came out smaller than the target
+            pad = np.full((max(out_y, arr.shape[0]), max(out_x, arr.shape[1]),
+                           arr.shape[2]), float(self.fill_value), np.float32)
+            pad[:arr.shape[0], :arr.shape[1]] = arr
+            arr = pad
+        arr = arr[yy:yy + out_y, xx:xx + out_x]
+        return np.ascontiguousarray(arr.transpose(2, 0, 1))
+
+
+class AugmentIterator(IIterator):
+    """DataInst processor applying the full augmentation suite."""
+
+    def __init__(self, base: IIterator) -> None:
+        self.base = base
+        self.rand_crop = 0
+        self.rand_mirror = 0
+        self.mirror = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.scale = 1.0
+        self.silent = 0
+        self.name_meanimg = ""
+        self.mean_value: Optional[np.ndarray] = None
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        self.shape = None
+        self.rng = np.random.RandomState(_RAND_MAGIC)
+        self.aug = ImageAugmenter()
+        self.meanimg: Optional[np.ndarray] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        self.aug.set_param(name, val)
+        if name == "input_shape":
+            self.shape = tuple(int(v) for v in val.split(","))
+        elif name == "seed_data":
+            self.rng = np.random.RandomState(_RAND_MAGIC + int(val))
+        elif name == "rand_crop":
+            self.rand_crop = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "divideby":
+            self.scale = 1.0 / float(val)
+        elif name == "scale":
+            self.scale = float(val)
+        elif name == "image_mean":
+            self.name_meanimg = val
+        elif name == "crop_y_start":
+            self.crop_y_start = int(val)
+        elif name == "crop_x_start":
+            self.crop_x_start = int(val)
+        elif name == "rand_mirror":
+            self.rand_mirror = int(val)
+        elif name == "mirror":
+            self.mirror = int(val)
+        elif name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        elif name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        elif name == "mean_value":
+            self.mean_value = np.array([float(v) for v in val.split(",")],
+                                       np.float32)
+
+    def init(self) -> None:
+        self.base.init()
+        if self.name_meanimg:
+            if os.path.exists(self.name_meanimg):
+                if self.silent == 0:
+                    print("loading mean image from %s" % self.name_meanimg)
+                self.meanimg = np.load(self.name_meanimg)
+            else:
+                self._create_mean_img()
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    def _process(self, d: DataInst) -> DataInst:
+        data = self.aug.process(d.data, self.rng)
+        c, y, x = self.shape
+        if y == 1:       # flat input: scale only
+            return DataInst(np.ascontiguousarray(data) * self.scale,
+                            d.label, d.index, d.extra_data)
+        dy, dx = data.shape[1] - y, data.shape[2] - x
+        assert dy >= 0 and dx >= 0, \
+            "data size must be at least the network input size"
+        if self.rand_crop and (dy or dx):
+            yy = self.rng.randint(0, dy + 1)
+            xx = self.rng.randint(0, dx + 1)
+        else:
+            yy, xx = dy // 2, dx // 2
+        if dy and self.crop_y_start != -1:
+            yy = self.crop_y_start
+        if dx and self.crop_x_start != -1:
+            xx = self.crop_x_start
+        contrast = 1.0
+        illumination = 0.0
+        if self.max_random_contrast > 0:
+            contrast = self.rng.rand() * self.max_random_contrast * 2 \
+                - self.max_random_contrast + 1
+        if self.max_random_illumination > 0:
+            illumination = self.rng.rand() * self.max_random_illumination * 2 \
+                - self.max_random_illumination
+        do_mirror = self.mirror == 1 or \
+            (self.rand_mirror and self.rng.rand() < 0.5)
+
+        img = data
+        if self.mean_value is not None:
+            img = img - self.mean_value[:img.shape[0], None, None]
+            img = img * contrast + illumination
+            img = img[:, yy:yy + y, xx:xx + x]
+        elif self.meanimg is not None:
+            if img.shape == self.meanimg.shape:
+                img = (img - self.meanimg) * contrast + illumination
+                img = img[:, yy:yy + y, xx:xx + x]
+            else:
+                img = img[:, yy:yy + y, xx:xx + x]
+                img = (img - self.meanimg) * contrast + illumination
+        else:
+            img = img[:, yy:yy + y, xx:xx + x]
+        if do_mirror:
+            img = img[:, :, ::-1]
+        return DataInst(np.ascontiguousarray(img, np.float32) * self.scale,
+                        d.label, d.index, d.extra_data)
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        self._value = self._process(self.base.value())
+        return True
+
+    def value(self) -> DataInst:
+        return self._value
+
+    def _create_mean_img(self) -> None:
+        """Full dataset pass averaging the *cropped* images, then save and
+        rewind (CreateMeanImg, iter_augment_proc-inl.hpp:171-198)."""
+        if self.silent == 0:
+            print("cannot find %s: creating mean image, this will take some "
+                  "time..." % self.name_meanimg)
+        start = time.time()
+        total = None
+        count = 0
+        saved_scale, self.scale = self.scale, 1.0   # mean is in raw 0..255 units
+        self.base.before_first()
+        while self.base.next():
+            img = self._process(self.base.value()).data
+            total = img.astype(np.float64) if total is None else total + img
+            count += 1
+            if count % 1000 == 0 and self.silent == 0:
+                print("\r[%8d] images processed, %d sec elapsed"
+                      % (count, int(time.time() - start)), end="")
+        self.scale = saved_scale
+        assert count > 0, "input iterator produced no data"
+        self.meanimg = (total / count).astype(np.float32)
+        with open(self.name_meanimg, "wb") as f:
+            np.save(f, self.meanimg)
+        if self.silent == 0:
+            print("\nsave mean image to %s" % self.name_meanimg)
+        self.base.before_first()

@@ -1,0 +1,133 @@
+"""BinaryPage — 64 MB fixed-size packed-object pages, the imgbin on-disk
+dataset format.
+
+Format-compatible with the reference (/root/reference/src/utils/io.h:254-326)
+so existing cxxnet .bin datasets work unchanged: a page is kPageSize int32
+words; word 0 is the object count N, words 1..N+1 are cumulative byte
+end-offsets, and object payloads are packed backward from the end of the page
+(object r spans bytes [pagesize - end[r+1], pagesize - end[r]) from the page
+start). Pages are always written at full size.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Iterator, List, Optional
+
+import numpy as np
+
+K_PAGE_WORDS = 64 << 18                 # page size in int32 words
+K_PAGE_BYTES = K_PAGE_WORDS * 4         # 64 MB
+
+
+class BinaryPage:
+    """One in-memory page; supports reading and building."""
+
+    def __init__(self, buf: Optional[bytes] = None) -> None:
+        if buf is None:
+            self._buf = bytearray(K_PAGE_BYTES)
+            self._count = 0
+            self._ends = [0]            # cumulative end offsets
+        else:
+            if len(buf) != K_PAGE_BYTES:
+                raise IOError("BinaryPage: truncated page (%d bytes)" % len(buf))
+            self._buf = bytearray(buf)
+            head = np.frombuffer(buf, dtype="<i4", count=1)[0]
+            self._count = int(head)
+            self._ends = np.frombuffer(buf, dtype="<i4", offset=4,
+                                       count=self._count + 1).tolist()
+
+    @property
+    def size(self) -> int:
+        return self._count
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __getitem__(self, r: int) -> memoryview:
+        if not (0 <= r < self._count):
+            raise IndexError("BinaryPage index out of bounds")
+        lo = K_PAGE_BYTES - self._ends[r + 1]
+        hi = K_PAGE_BYTES - self._ends[r]
+        return memoryview(self._buf)[lo:hi]
+
+    def free_bytes(self) -> int:
+        return (K_PAGE_WORDS - (self._count + 2)) * 4 - self._ends[-1]
+
+    def push(self, data: bytes) -> bool:
+        """Append one object; False if the page is full."""
+        if self.free_bytes() < len(data) + 4:
+            return False
+        new_end = self._ends[-1] + len(data)
+        self._buf[K_PAGE_BYTES - new_end:K_PAGE_BYTES - self._ends[-1]] = data
+        self._ends.append(new_end)
+        self._count += 1
+        return True
+
+    def clear(self) -> None:
+        self._buf = bytearray(K_PAGE_BYTES)
+        self._count = 0
+        self._ends = [0]
+
+    def tobytes(self) -> bytes:
+        header = np.zeros(self._count + 2, dtype="<i4")
+        header[0] = self._count
+        header[1:] = self._ends
+        hb = header.tobytes()
+        self._buf[:len(hb)] = hb
+        return bytes(self._buf)
+
+    def save(self, f: BinaryIO) -> None:
+        f.write(self.tobytes())
+
+    @classmethod
+    def load(cls, f: BinaryIO) -> Optional["BinaryPage"]:
+        buf = f.read(K_PAGE_BYTES)
+        if len(buf) == 0:
+            return None
+        return cls(buf)
+
+
+def iter_pages(path: str) -> Iterator[BinaryPage]:
+    with open(path, "rb") as f:
+        while True:
+            page = BinaryPage.load(f)
+            if page is None:
+                return
+            yield page
+
+
+class BinaryPageWriter:
+    """Streams objects into consecutive pages of a .bin file (im2bin core)."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "wb")
+        self._page = BinaryPage()
+        self.n_pages = 0
+        self.n_objects = 0
+
+    def push(self, data: bytes) -> None:
+        if len(data) + 12 > K_PAGE_BYTES:
+            raise ValueError("object of %d bytes exceeds the 64MB page size"
+                             % len(data))
+        if not self._page.push(data):
+            self._flush_page()
+            if not self._page.push(data):
+                raise ValueError("object does not fit in an empty page")
+        self.n_objects += 1
+
+    def _flush_page(self) -> None:
+        self._page.save(self._f)
+        self._page.clear()
+        self.n_pages += 1
+
+    def close(self) -> None:
+        if self._page.size:
+            self._flush_page()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

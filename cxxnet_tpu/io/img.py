@@ -1,0 +1,83 @@
+"""img — plain image-file iterator (reference: src/io/iter_img-inl.hpp:16-137).
+
+Reads ``image_list`` (``index<TAB>label...<TAB>filename``) rooted at
+``image_root``, decodes each image at Next() time, optional order shuffle.
+Composed as BatchAdapt(Augment(Image)) by the factory (data.cpp:46-50).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataInst, IIterator, register_base_iterator
+from .decoder import decode_image_chw
+from .imgbin import read_list_file
+
+_RAND_MAGIC = 121
+
+
+class ImageIterator(IIterator):
+    def __init__(self) -> None:
+        self.image_list = ""
+        self.image_root = ""
+        self.shuffle = 0
+        self.label_width = 1
+        self.silent = 0
+        self.seed = _RAND_MAGIC
+        self.gray_to_rgb = True
+        self.loc = 0
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "image_list":
+            self.image_list = val
+        elif name == "image_root":
+            self.image_root = val
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "label_width":
+            self.label_width = int(val)
+        elif name == "silent":
+            self.silent = int(val)
+        elif name == "seed_data":
+            self.seed = _RAND_MAGIC + int(val)
+        elif name == "input_shape":
+            self.gray_to_rgb = int(val.split(",")[0]) == 3
+
+    def init(self) -> None:
+        if not self.image_list:
+            raise ValueError("img iterator: must set image_list")
+        self.idx, self.labels, self.names = read_list_file(
+            self.image_list, self.label_width)
+        self.order = np.arange(len(self.idx))
+        self.rng = np.random.RandomState(self.seed)
+        if self.silent == 0:
+            print("ImageIterator: %d images, shuffle=%d"
+                  % (len(self.idx), self.shuffle))
+        self.before_first()
+
+    def before_first(self) -> None:
+        self.loc = 0
+        if self.shuffle:
+            self.rng.shuffle(self.order)
+
+    def next(self) -> bool:
+        if self.loc >= len(self.order):
+            return False
+        i = self.order[self.loc]
+        self.loc += 1
+        with open(self.image_root + self.names[i], "rb") as f:
+            data = decode_image_chw(f.read(), self.gray_to_rgb)
+        self._value = DataInst(data, self.labels[i], int(self.idx[i]))
+        return True
+
+    def value(self) -> DataInst:
+        return self._value
+
+
+def _make_img() -> IIterator:
+    from .augment import AugmentIterator
+    from .batch import BatchAdaptIterator
+    return BatchAdaptIterator(AugmentIterator(ImageIterator()))
+
+
+register_base_iterator("img")(_make_img)

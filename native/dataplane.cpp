@@ -1,0 +1,128 @@
+// Native data plane: JPEG decode + fused decode/crop/mirror-to-float.
+//
+// TPU-native equivalent of the reference's host-side decode path
+// (/root/reference/src/utils/decoder.h JpegDecoder + the per-instance copy
+// loops in iter_thread_imbin_x-inl.hpp:269-387). The TPU does the math; this
+// library keeps the *host* fast: libjpeg decode and the uint8->float CHW
+// conversion are the input-pipeline hot path when feeding a chip at line
+// rate. Exposed as a C ABI for ctypes (no pybind11 in this image); all entry
+// points are GIL-free by construction so a Python thread pool scales.
+//
+// Build: make -C native   (produces libcxnetdata.so)
+
+#include <cstdio>
+#include <cstring>
+#include <csetjmp>
+#include <cstdlib>
+
+#include <jpeglib.h>
+#include <jerror.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr base;
+  jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(mgr->jump, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a JPEG byte buffer to interleaved RGB (or grayscale) HWC uint8.
+// Returns 0 on success; fills *w,*h,*c. out may be null to only query dims
+// (two-call protocol). out_cap is the byte capacity of out.
+int cxn_jpeg_decode(const unsigned char* src, long len,
+                    unsigned char* out, long out_cap,
+                    int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.base);
+  jerr.base.error_exit = error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(src),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  *w = static_cast<int>(cinfo.image_width);
+  *h = static_cast<int>(cinfo.image_height);
+  *c = cinfo.num_components >= 3 ? 3 : 1;
+  if (out == nullptr) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  cinfo.out_color_space = (*c == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+  const long row_bytes = static_cast<long>(cinfo.output_width) *
+                         cinfo.output_components;
+  if (row_bytes * static_cast<long>(cinfo.output_height) > out_cap) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out + static_cast<long>(cinfo.output_scanline) *
+                                   row_bytes;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// HWC uint8 (rgb or gray) -> CHW float32 with channel replication for gray
+// inputs (iter_thread_imbin_x grayscale->3-channel behavior), optional
+// horizontal mirror, and crop at (crop_y, crop_x) of size (out_h, out_w).
+// src dims (src_h, src_w, src_c); out has 3*out_h*out_w floats when
+// src_c==1&&gray_to_rgb else src_c*out_h*out_w.
+int cxn_hwc_to_chw_float(const unsigned char* src, int src_h, int src_w,
+                         int src_c, int crop_y, int crop_x,
+                         int out_h, int out_w, int mirror, int gray_to_rgb,
+                         float* out) {
+  if (crop_y < 0 || crop_x < 0 || crop_y + out_h > src_h ||
+      crop_x + out_w > src_w)
+    return -1;
+  const int out_c = (src_c == 1 && gray_to_rgb) ? 3 : src_c;
+  for (int cc = 0; cc < out_c; ++cc) {
+    const int sc = (src_c == 1) ? 0 : cc;
+    float* dst = out + static_cast<long>(cc) * out_h * out_w;
+    for (int y = 0; y < out_h; ++y) {
+      const unsigned char* row =
+          src + (static_cast<long>(crop_y + y) * src_w + crop_x) * src_c + sc;
+      float* drow = dst + static_cast<long>(y) * out_w;
+      if (mirror) {
+        for (int x = 0; x < out_w; ++x)
+          drow[x] = static_cast<float>(row[(out_w - 1 - x) * src_c]);
+      } else {
+        for (int x = 0; x < out_w; ++x)
+          drow[x] = static_cast<float>(row[x * src_c]);
+      }
+    }
+  }
+  return out_c;
+}
+
+// Fused decode -> full-frame CHW float (no crop), the imgbin page-decode hot
+// path. out must hold 3*h*w (gray replicated) or c*h*w floats; call
+// cxn_jpeg_decode(out=null) first for dims. scratch must hold h*w*c bytes.
+int cxn_decode_chw(const unsigned char* src, long len, unsigned char* scratch,
+                   long scratch_cap, float* out, int gray_to_rgb,
+                   int* w, int* h, int* c) {
+  int rc = cxn_jpeg_decode(src, len, scratch, scratch_cap, w, h, c);
+  if (rc != 0) return rc;
+  return cxn_hwc_to_chw_float(scratch, *h, *w, *c, 0, 0, *h, *w, 0,
+                              gray_to_rgb, out);
+}
+
+}  // extern "C"

@@ -1,0 +1,301 @@
+"""Data-plane tests: BinaryPage format, decoders (native vs PIL differential),
+im2bin tool, imgbin/img iterators, augmentation, attachtxt."""
+
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.binpage import (BinaryPage, BinaryPageWriter, K_PAGE_BYTES,
+                                   iter_pages)
+from cxxnet_tpu.io.decoder import decode_image_chw, decode_jpeg_hwc, have_native
+from cxxnet_tpu.io.augment import AugmentIterator, ImageAugmenter
+from cxxnet_tpu.io.data import DataInst, IIterator
+
+
+def make_jpeg(rng, w=32, h=24, gray=False, quality=95):
+    from PIL import Image
+    arr = (rng.rand(h, w) * 255 if gray else rng.rand(h, w, 3) * 255) \
+        .astype(np.uint8)
+    img = Image.fromarray(arr, mode="L" if gray else "RGB")
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+# ------------------------------------------------------------ binary page
+def test_binary_page_roundtrip():
+    page = BinaryPage()
+    objs = [b"hello", b"x" * 1000, b"", b"world"]
+    for o in objs:
+        assert page.push(o)
+    raw = page.tobytes()
+    assert len(raw) == K_PAGE_BYTES
+    page2 = BinaryPage(raw)
+    assert page2.size == 4
+    assert [bytes(page2[i]) for i in range(4)] == objs
+
+
+def test_binary_page_disk_format():
+    # verify the exact reference layout: int32 count, cumulative end-offsets,
+    # payloads packed backward from the page end (io.h:254-326)
+    page = BinaryPage()
+    page.push(b"abc")
+    page.push(b"de")
+    raw = page.tobytes()
+    head = np.frombuffer(raw, "<i4", count=4)
+    assert list(head) == [2, 0, 3, 5]
+    assert raw[K_PAGE_BYTES - 3:] == b"abc"
+    assert raw[K_PAGE_BYTES - 5:K_PAGE_BYTES - 3] == b"de"
+
+
+def test_binary_page_writer_multi_page(tmp_path):
+    path = str(tmp_path / "multi.bin")
+    big = b"B" * (K_PAGE_BYTES // 2 - 100)
+    with BinaryPageWriter(path) as w:
+        for _ in range(5):
+            w.push(big)
+    pages = list(iter_pages(path))
+    assert sum(p.size for p in pages) == 5
+    assert len(pages) == 3
+    assert os.path.getsize(path) == 3 * K_PAGE_BYTES
+
+
+# ------------------------------------------------------------ decoder
+def test_native_decoder_available():
+    assert have_native(), "native libcxnetdata.so should be built (make -C native)"
+
+
+def test_decode_native_matches_pil(rng):
+    buf = make_jpeg(rng)
+    native = decode_jpeg_hwc(buf)            # native path when available
+    from PIL import Image
+    pil = np.asarray(Image.open(io.BytesIO(buf)), np.uint8)
+    # independent libjpeg decoders may differ by a few ULP of IDCT rounding
+    assert native.shape == pil.shape
+    diff = np.abs(native.astype(int) - pil.astype(int))
+    assert diff.mean() < 1.0 and diff.max() <= 2
+
+
+def test_decode_chw_gray_replication(rng):
+    buf = make_jpeg(rng, gray=True)
+    chw = decode_image_chw(buf, gray_to_rgb=True)
+    assert chw.shape[0] == 3
+    np.testing.assert_allclose(chw[0], chw[1])
+    chw1 = decode_image_chw(buf, gray_to_rgb=False)
+    assert chw1.shape[0] == 1
+
+
+# ------------------------------------------------------------ im2bin + imgbin
+@pytest.fixture(scope="module")
+def imgbin_dataset(tmp_path_factory):
+    """3-class dataset where class = dominant channel; 64 jpegs."""
+    d = tmp_path_factory.mktemp("imgbin")
+    rng = np.random.RandomState(3)
+    from PIL import Image
+    lines = []
+    os.makedirs(d / "img", exist_ok=True)
+    for i in range(64):
+        cls = i % 3
+        arr = (rng.rand(32, 32, 3) * 60).astype(np.uint8)
+        arr[:, :, cls] += 180
+        Image.fromarray(arr, "RGB").save(d / "img" / ("%03d.jpg" % i),
+                                         quality=95)
+        lines.append("%d\t%d\timg/%03d.jpg\n" % (i, cls, i))
+    with open(d / "train.lst", "w") as f:
+        f.writelines(lines)
+    rc = subprocess.call([sys.executable,
+                          os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "im2bin.py"),
+                          str(d / "train.lst"), str(d) + os.sep,
+                          str(d / "train.bin")])
+    assert rc == 0
+    return d
+
+
+def test_imgbin_iterator(imgbin_dataset):
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,28,28"),
+        ("batch_size", "16"),
+        ("rand_crop", "1"),
+        ("rand_mirror", "1"),
+        ("silent", "1"),
+    ])
+    batches = list(it)
+    assert len(batches) == 4
+    b0 = batches[0]
+    assert b0.data.shape == (16, 3, 28, 28)
+    assert b0.label.shape == (16, 1)
+    assert b0.data.max() > 100      # 0..255 scale before divideby
+    # labels follow the lst: class = dominant channel of the decoded image
+    for i in range(16):
+        dom = np.argmax(b0.data[i].mean(axis=(1, 2)))
+        assert dom == int(b0.label[i, 0])
+    # second epoch works
+    assert len(list(it)) == 4
+
+
+def test_imgbin_shuffle_and_threadbuffer(imgbin_dataset):
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("iter", "threadbuffer"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "16"),
+        ("shuffle", "1"),
+        ("silent", "1"),
+    ])
+    b1 = [b.inst_index.copy() for b in it]
+    b2 = [b.inst_index.copy() for b in it]
+    assert not all(np.array_equal(a, b) for a, b in zip(b1, b2)), \
+        "shuffle should change instance order between epochs"
+    assert sorted(np.concatenate(b1).tolist()) == list(range(64))
+
+
+def test_img_iterator(imgbin_dataset):
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "img"),
+        ("image_list", str(d / "train.lst")),
+        ("image_root", str(d) + os.sep),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "32"),
+        ("silent", "1"),
+    ])
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data.shape == (32, 3, 32, 32)
+
+
+def test_imgbin_round_batch_tail(imgbin_dataset):
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "48"),
+        ("round_batch", "1"),
+        ("silent", "1"),
+    ])
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].num_batch_padd == 32      # 64 = 48 + 16 (+32 wrapped)
+    assert batches[1].pad_mode == "wrap"
+
+
+# ------------------------------------------------------------ augmentation
+class _ListInstIterator(IIterator):
+    def __init__(self, insts):
+        self.insts = insts
+        self.loc = 0
+
+    def before_first(self):
+        self.loc = 0
+
+    def next(self):
+        if self.loc >= len(self.insts):
+            return False
+        self._v = self.insts[self.loc]
+        self.loc += 1
+        return True
+
+    def value(self):
+        return self._v
+
+
+def _augment(params, insts):
+    it = AugmentIterator(_ListInstIterator(insts))
+    for k, v in params:
+        it.set_param(k, v)
+    it.init()
+    return list(it)
+
+
+def test_augment_center_crop_and_scale(rng):
+    data = np.arange(3 * 8 * 8, dtype=np.float32).reshape(3, 8, 8)
+    out = _augment([("input_shape", "3,4,4"), ("divideby", "2"),
+                    ("silent", "1")],
+                   [DataInst(data, np.zeros(1, np.float32), 0)])
+    np.testing.assert_allclose(out[0].data, data[:, 2:6, 2:6] / 2.0)
+
+
+def test_augment_fixed_crop_and_mirror(rng):
+    data = np.arange(1 * 4 * 6, dtype=np.float32).reshape(1, 4, 6)
+    out = _augment([("input_shape", "1,4,4"), ("crop_x_start", "0"),
+                    ("mirror", "1"), ("silent", "1")],
+                   [DataInst(data, np.zeros(1, np.float32), 0)])
+    np.testing.assert_allclose(out[0].data, data[:, :, 0:4][:, :, ::-1])
+
+
+def test_augment_mean_value(rng):
+    data = np.full((3, 4, 4), 100.0, np.float32)
+    out = _augment([("input_shape", "3,4,4"),
+                    ("mean_value", "10,20,30"), ("silent", "1")],
+                   [DataInst(data, np.zeros(1, np.float32), 0)])
+    np.testing.assert_allclose(out[0].data[0], 90.0)
+    np.testing.assert_allclose(out[0].data[1], 80.0)
+    np.testing.assert_allclose(out[0].data[2], 70.0)
+
+
+def test_augment_mean_image_generation(tmp_path, rng):
+    meanfile = str(tmp_path / "mean.npy")
+    insts = [DataInst(np.full((3, 4, 4), float(v), np.float32),
+                      np.zeros(1, np.float32), i)
+             for i, v in enumerate([10, 20, 30])]
+    out = _augment([("input_shape", "3,4,4"), ("image_mean", meanfile),
+                    ("silent", "1")], insts)
+    assert os.path.exists(meanfile)
+    mean = np.load(meanfile)
+    np.testing.assert_allclose(mean, 20.0)
+    np.testing.assert_allclose(out[0].data, -10.0)
+
+
+def test_affine_rotate_180(rng):
+    aug = ImageAugmenter()
+    aug.set_param("input_shape", "3,8,8")
+    aug.set_param("rotate", "180")
+    aug.set_param("max_rotate_angle", "1")   # activates need_process
+    data = np.zeros((3, 8, 8), np.float32)
+    data[:, 0, 0] = 200.0
+    out = aug.process(data, np.random.RandomState(0))
+    assert out.shape == (3, 8, 8)
+    # the hot corner moved to the opposite corner (within interpolation blur)
+    assert out[0, -2:, -2:].max() > 50
+    assert out[0, :2, :2].max() < 50
+
+
+def test_attachtxt(imgbin_dataset, tmp_path):
+    d = imgbin_dataset
+    attach = tmp_path / "extra.txt"
+    with open(attach, "w") as f:
+        f.write("4\n")
+        for i in range(64):
+            f.write("%d %d %d %d %d\n" % (i, i, i + 1, i + 2, i + 3))
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("iter", "attachtxt"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("filename", str(attach)),
+        ("input_shape", "3,32,32"),
+        ("batch_size", "16"),
+        ("silent", "1"),
+    ])
+    b = next(iter(it))
+    assert len(b.extra_data) == 1
+    assert b.extra_data[0].shape == (16, 1, 1, 4)
+    for row in range(16):
+        i = int(b.inst_index[row])
+        np.testing.assert_allclose(b.extra_data[0][row, 0, 0],
+                                   [i, i + 1, i + 2, i + 3])

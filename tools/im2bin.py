@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""im2bin — pack images listed in a .lst file into a BinaryPage .bin dataset.
+
+Equivalent of the reference tool (/root/reference/tools/im2bin.cpp:1-67);
+output is format-compatible with reference .bin files (64MB pages).
+
+Usage: python tools/im2bin.py image.lst image_root_dir output_file
+.lst line format: index<TAB>label[<TAB>more labels]<TAB>relative/path.jpg
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.io.binpage import BinaryPageWriter  # noqa: E402
+
+
+def main(argv):
+    if len(argv) != 4:
+        sys.stderr.write(
+            "Usage: im2bin.py image.lst image_root_dir output_file\n")
+        return 1
+    lst, root, out = argv[1], argv[2], argv[3]
+    start = time.time()
+    print("creating image binary pack from %s..." % lst)
+    w = BinaryPageWriter(out)
+    with open(lst) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 2:
+                parts = line.split()
+            if len(parts) < 2:
+                continue
+            path = os.path.join(root, parts[-1])
+            with open(path, "rb") as img:
+                w.push(img.read())
+            if w.n_objects % 1000 == 0:
+                print("\r[%8d] images processed to %d pages, %d sec elapsed"
+                      % (w.n_objects, w.n_pages, int(time.time() - start)),
+                      end="")
+                sys.stdout.flush()
+    w.close()    # flushes the final partial page; n_pages is now exact
+    print("\nfinished [%8d] images packed to %d pages, %d sec elapsed"
+          % (w.n_objects, w.n_pages, int(time.time() - start)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Shard a .lst file into N partitions and pack each with im2bin — the
+multi-file/distributed dataset layout (reference:
+tools/imgbin-partition-maker.py, which emitted a Makefile; this version does
+the work directly).
+
+Usage: python tools/imgbin_partition_maker.py image.lst image_root out_prefix N [--shuffle]
+Produces out_prefix{1..N}.lst / out_prefix{1..N}.bin for
+``image_conf_prefix = out_prefix`` + ``image_conf_ids = 1-N``.
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cxxnet_tpu.io.binpage import BinaryPageWriter  # noqa: E402
+
+
+def main(argv):
+    if len(argv) < 5:
+        sys.stderr.write("Usage: imgbin_partition_maker.py image.lst "
+                         "image_root out_prefix N [--shuffle]\n")
+        return 1
+    lst, root, prefix, n = argv[1], argv[2], argv[3], int(argv[4])
+    shuffle = "--shuffle" in argv[5:]
+    with open(lst) as f:
+        lines = [l for l in f if l.strip()]
+    if shuffle:
+        random.Random(10).shuffle(lines)
+    per = (len(lines) + n - 1) // n
+    for i in range(n):
+        part = lines[i * per:(i + 1) * per]
+        with open("%s%d.lst" % (prefix, i + 1), "w") as f:
+            f.writelines(part)
+        with BinaryPageWriter("%s%d.bin" % (prefix, i + 1)) as w:
+            for line in part:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) < 2:
+                    parts = line.split()
+                with open(os.path.join(root, parts[-1]), "rb") as img:
+                    w.push(img.read())
+        print("partition %d/%d: %d images" % (i + 1, n, len(part)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
