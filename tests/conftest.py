@@ -1,27 +1,23 @@
 """Test harness config: force an 8-virtual-device CPU mesh.
 
-The container's sitecustomize (PYTHONPATH=/root/.axon_site) eagerly registers
-the axon TPU PJRT plugin at interpreter start; once that has happened, setting
-JAX_PLATFORMS=cpu in-process hangs the axon client. So before anything imports
-jax we re-exec pytest with PYTHONPATH dropped and the CPU platform forced —
-giving every test the 8-device virtual mesh the sharding tests need.
+The container's sitecustomize (PYTHONPATH=/root/.axon_site) pre-imports jax
+and registers the axon TPU PJRT plugin at interpreter start, but the backend
+itself initializes lazily — so switching the platform to CPU in-process works
+as long as it happens before anything touches `jax.devices()`. conftest.py is
+imported before any test module, which is early enough.
 """
 
 import os
-import sys
 
-_SENTINEL = "CXXNET_TPU_TEST_REEXEC"
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] = (
+        os.environ["XLA_FLAGS"] + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
-if os.environ.get(_SENTINEL) != "1" and "jax" not in sys.modules:
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env[_SENTINEL] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    xla = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in xla:
-        env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
-    os.execve(sys.executable,
-              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
