@@ -259,8 +259,14 @@ class AugmentIterator(IIterator):
         else:
             yy, xx = dy // 2, dx // 2
         if dy and self.crop_y_start != -1:
+            assert self.crop_y_start <= dy, \
+                "crop_y_start=%d exceeds crop margin %d" % (self.crop_y_start,
+                                                            dy)
             yy = self.crop_y_start
         if dx and self.crop_x_start != -1:
+            assert self.crop_x_start <= dx, \
+                "crop_x_start=%d exceeds crop margin %d" % (self.crop_x_start,
+                                                            dx)
             xx = self.crop_x_start
         contrast = 1.0
         illumination = 0.0
@@ -301,6 +307,9 @@ class AugmentIterator(IIterator):
     def value(self) -> DataInst:
         return self._value
 
+    def close(self) -> None:
+        self.base.close()
+
     def _create_mean_img(self) -> None:
         """Full dataset pass averaging the *cropped* images, then save and
         rewind (CreateMeanImg, iter_augment_proc-inl.hpp:171-198)."""
@@ -326,4 +335,7 @@ class AugmentIterator(IIterator):
             np.save(f, self.meanimg)
         if self.silent == 0:
             print("\nsave mean image to %s" % self.name_meanimg)
+        # rewind so non-rewinding consumers (DenseBufferIterator never rewinds
+        # its base) see the data; imgbin treats a rewind on an unconsumed
+        # epoch as a no-op, so the consumer's own before_first costs nothing
         self.base.before_first()

@@ -15,13 +15,12 @@ Reference (/root/reference/src/io/iter_batch_proc-inl.hpp, iter_mem_buffer-inl.h
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import List, Optional
 
 import numpy as np
 
-from .data import (DataBatch, DataInst, IIterator, register_proc_iterator)
+from .data import (DataBatch, DataInst, IIterator, PrefetchProducerMixin,
+                   register_proc_iterator)
 
 
 class BatchAdaptIterator(IIterator):
@@ -115,21 +114,18 @@ class BatchAdaptIterator(IIterator):
     def value(self) -> DataBatch:
         return self._value
 
+    def close(self) -> None:
+        self.base.close()
+
 
 @register_proc_iterator("threadbuffer")
-class ThreadBufferIterator(IIterator):
+class ThreadBufferIterator(PrefetchProducerMixin, IIterator):
     """Background-thread prefetch with a bounded queue (double-buffer analogue)."""
-
-    _STOP = object()
-    _END = object()
 
     def __init__(self, base: IIterator, buffer_size: int = 2) -> None:
         self.base = base
         self.buffer_size = buffer_size
         self.silent = 0
-        self._queue: Optional[queue.Queue] = None
-        self._thread: Optional[threading.Thread] = None
-        self._reset = threading.Event()
         self._value = None
 
     def set_param(self, name: str, val: str) -> None:
@@ -141,47 +137,29 @@ class ThreadBufferIterator(IIterator):
 
     def init(self) -> None:
         self.base.init()
-        self._queue = queue.Queue(maxsize=self.buffer_size)
-        self._cmd: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(target=self._producer, daemon=True)
-        self._thread.start()
-        self._started = False
-        self._epoch_done = True
+        self._init_producer(self.buffer_size)
         self.before_first()
 
-    def _producer(self) -> None:
-        while True:
-            cmd = self._cmd.get()
-            if cmd is self._STOP:
+    def _produce_epoch(self) -> None:
+        self.base.before_first()
+        while self.base.next():
+            v = self.base.value()
+            # deep-copy: the base may reuse buffers (CopyFromDense analogue)
+            if not self._put(DataBatch(
+                    np.array(v.data), np.array(v.label),
+                    None if v.inst_index is None else np.array(v.inst_index),
+                    v.num_batch_padd,
+                    [np.array(e) for e in v.extra_data],
+                    v.pad_mode)):
                 return
-            # cmd == "epoch": produce one full epoch then signal end
-            self.base.before_first()
-            while self.base.next():
-                v = self.base.value()
-                # deep-copy: the base may reuse buffers (CopyFromDense analogue)
-                self._queue.put(DataBatch(np.array(v.data), np.array(v.label),
-                                          None if v.inst_index is None
-                                          else np.array(v.inst_index),
-                                          v.num_batch_padd,
-                                          [np.array(e) for e in v.extra_data],
-                                          v.pad_mode))
-            self._queue.put(self._END)
+        self._put(self._END)
 
     def before_first(self) -> None:
-        # drain the rest of an in-flight epoch before starting a new one
-        if self._started and not self._epoch_done:
-            while self._queue.get() is not self._END:
-                pass
-        self._cmd.put("epoch")
-        self._started = True
-        self._epoch_done = False
+        self._rewind_producer()
 
     def next(self) -> bool:
-        if self._epoch_done:
-            return False
-        item = self._queue.get()
-        if item is self._END:
-            self._epoch_done = True
+        item = self._next_item()
+        if item is None:
             return False
         self._value = item
         return True
@@ -189,10 +167,13 @@ class ThreadBufferIterator(IIterator):
     def value(self):
         return self._value
 
+    def close(self) -> None:
+        self._close_producer()
+        self.base.close()
+
     def __del__(self):
         try:
-            if self._thread is not None:
-                self._cmd.put(self._STOP)
+            self.close()
         except Exception:
             pass
 
@@ -247,3 +228,6 @@ class DenseBufferIterator(IIterator):
 
     def value(self):
         return self._value
+
+    def close(self) -> None:
+        self.base.close()

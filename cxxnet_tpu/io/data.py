@@ -13,6 +13,8 @@ on device entry.
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,6 +66,11 @@ class IIterator:
     def init(self) -> None:
         pass
 
+    def close(self) -> None:
+        """Release background threads/files; wrappers delegate to their base.
+        Idempotent; calling any other method after close is undefined."""
+        pass
+
     def before_first(self) -> None:
         raise NotImplementedError
 
@@ -77,6 +84,105 @@ class IIterator:
         self.before_first()
         while self.next():
             yield self.value()
+
+
+class PrefetchProducerMixin:
+    """Shared plumbing for iterators that produce epochs on a background
+    thread into a bounded queue (the ThreadBuffer analogue, reference
+    utils/thread_buffer.h). Subclasses implement ``_produce_epoch`` — pushing
+    items via ``self._put`` (aborting when it returns False) and finishing
+    with ``self._put(self._END)`` — and call:
+
+    - ``_init_producer(queue_size)`` from init()
+    - ``_rewind_producer()`` from before_first()
+    - ``_next_item()`` from next(): returns the item, or None at epoch end;
+      re-raises exceptions forwarded from the producer
+    - ``_close_producer()`` from close(): responsive even when the producer
+      is blocked on a full queue (timed puts observe the stop event)
+    """
+
+    _END = object()
+
+    def _init_producer(self, queue_size: int) -> None:
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._cmd: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = threading.Thread(
+            target=self._produce_loop, daemon=True)
+        self._thread.start()
+        # no epoch queued yet: the first before_first() starts production
+        # (queuing at init would produce a throwaway epoch)
+        self._started = False
+        self._epoch_done = True
+        self._fresh = False
+
+    def _produce_epoch(self) -> None:
+        raise NotImplementedError
+
+    def _put(self, item) -> bool:
+        """Blocking queue put that stays responsive to close(); returns False
+        when the iterator is being torn down."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce_loop(self) -> None:
+        while not self._stop.is_set():
+            cmd = self._cmd.get()
+            if cmd == "stop":
+                return
+            try:
+                self._produce_epoch()
+            except Exception as e:      # surface errors to the consumer
+                self._put(e)
+
+    def _rewind_producer(self) -> None:
+        pending_error = None
+        if self._started and not self._epoch_done:
+            if self._fresh:
+                # an epoch is queued but nothing consumed yet: rewinding is a
+                # no-op (lets callers rewind defensively — e.g. augment after
+                # mean-image creation — without a wasted production pass)
+                return
+            while True:
+                item = self._queue.get()
+                if item is self._END:
+                    break
+                if isinstance(item, Exception):
+                    pending_error = item
+                    break
+        if pending_error is not None:
+            self._epoch_done = True
+            raise pending_error
+        self._cmd.put("epoch")
+        self._started = True
+        self._epoch_done = False
+        self._fresh = True
+
+    def _next_item(self):
+        if self._epoch_done:
+            return None
+        self._fresh = False
+        item = self._queue.get()
+        if item is self._END:
+            self._epoch_done = True
+            return None
+        if isinstance(item, Exception):
+            self._epoch_done = True
+            raise item
+        return item
+
+    def _close_producer(self) -> None:
+        if getattr(self, "_thread", None) is None:
+            return
+        self._stop.set()
+        self._cmd.put("stop")
+        self._thread.join(timeout=5)
+        self._thread = None
 
 
 # base iterators produce DataBatch directly (mnist) or DataInst (img family);

@@ -20,15 +20,15 @@ instances.
 from __future__ import annotations
 
 import os
-import queue
-import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from .binpage import BinaryPage
-from .data import DataInst, IIterator, register_base_iterator
+from .data import (DataInst, IIterator, PrefetchProducerMixin,
+                   register_base_iterator)
 from .decoder import decode_image_chw
 
 _RAND_MAGIC = 111
@@ -49,16 +49,27 @@ def parse_id_range(spec: str) -> List[int]:
     return out
 
 
+def parse_list_line(line: str) -> Optional[List[str]]:
+    """Parse one .lst line: ``index<TAB>label...<TAB>filename`` (whitespace
+    split as fallback). Returns the fields, or None for blank/malformed
+    (<2 fields) lines — the single definition shared by the iterator and the
+    im2bin/partition tools so all agree on what lines are skipped."""
+    parts = line.rstrip("\n").split("\t")
+    if len(parts) < 2:
+        parts = line.split()
+    if len(parts) < 2:
+        return None
+    return parts
+
+
 def read_list_file(path: str, label_width: int):
-    """.lst lines: ``index<TAB>label...<TAB>filename``; returns
-    (indices uint32, labels float32 (n, label_width), filenames)."""
+    """.lst file -> (indices uint32, labels float32 (n, label_width),
+    filenames)."""
     idx, labels, names = [], [], []
     with open(path) as f:
         for line in f:
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) < 2:
-                parts = line.split()
-            if len(parts) < 2:
+            parts = parse_list_line(line)
+            if parts is None:
                 continue
             idx.append(int(float(parts[0])))
             lab = [float(v) for v in parts[1:1 + label_width]]
@@ -70,11 +81,9 @@ def read_list_file(path: str, label_width: int):
             np.asarray(labels, np.float32), names)
 
 
-class ImageBinIterator(IIterator):
+class ImageBinIterator(PrefetchProducerMixin, IIterator):
     """Produces decoded DataInst; wrapped by Augment+BatchAdapt at creation
     (see data.py factory wiring)."""
-
-    _END = object()
 
     def __init__(self) -> None:
         self.image_list = ""
@@ -93,7 +102,6 @@ class ImageBinIterator(IIterator):
         # holding gigabytes of host RAM
         self.queue_size = 64
         self.gray_to_rgb = True
-        self._producer: Optional[threading.Thread] = None
 
     def set_param(self, name: str, val: str) -> None:
         if name == "image_list":
@@ -173,19 +181,16 @@ class ImageBinIterator(IIterator):
             print("ImageBinIterator: %d shards, %d images, shuffle=%d"
                   % (len(self.shards), total, self.shuffle))
         self.rng = np.random.RandomState(self.seed)
-        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
-        self._cmd: "queue.Queue" = queue.Queue()
         self._pool = ThreadPoolExecutor(max_workers=self.decode_threads)
-        self._producer = threading.Thread(target=self._produce_loop,
-                                          daemon=True)
-        self._producer.start()
-        # no epoch is queued here: the consumer's first before_first() starts
-        # production (queuing at init would decode a throwaway epoch)
-        self._started = False
-        self._epoch_done = True
+        self._init_producer(self.queue_size)
 
     # ------------------------------------------------------------- producer
     def _produce_epoch(self) -> None:
+        # decode submissions ride a sliding window so at most ~2x the pool
+        # width of decoded full-frame floats is in flight beyond the bounded
+        # queue (a whole 64MB page decoded at once is gigabytes at ImageNet
+        # source sizes)
+        window = max(2 * self.decode_threads, 4)
         order = list(range(len(self.shards)))
         if self.shuffle:
             self.rng.shuffle(order)
@@ -194,76 +199,61 @@ class ImageBinIterator(IIterator):
             bin_path = self.shards[si][1]
             pos = 0   # instance cursor within the shard (page objs follow .lst order)
             with open(bin_path, "rb") as f:
-                while True:
+                while not self._stop.is_set():
                     page = BinaryPage.load(f)
                     if page is None:
                         break
                     n = page.size
-                    objs = [bytes(page[i]) for i in range(n)]
-                    futures = [self._pool.submit(decode_image_chw, o,
-                                                 self.gray_to_rgb)
-                               for o in objs]
                     inst_order = list(range(n))
                     if self.shuffle:
                         self.rng.shuffle(inst_order)
-                    results = [f.result() for f in futures]
+                    pending: deque = deque()
+
+                    def emit_oldest() -> bool:
+                        gi, fut = pending.popleft()
+                        return self._put(DataInst(
+                            fut.result(), lst_label[gi], int(lst_idx[gi])))
+
                     for i in inst_order:
                         gi = pos + i
                         if gi >= len(lst_idx):
-                            continue   # unmatched trailing object; keep the rest
-                        self._queue.put(DataInst(
-                            results[i], lst_label[gi], int(lst_idx[gi])))
+                            continue   # unmatched trailing object; keep rest
+                        pending.append((gi, self._pool.submit(
+                            decode_image_chw, bytes(page[i]),
+                            self.gray_to_rgb)))
+                        if len(pending) >= window and not emit_oldest():
+                            return
+                    while pending:
+                        if not emit_oldest():
+                            return
                     pos += n
-        self._queue.put(self._END)
-
-    def _produce_loop(self) -> None:
-        while True:
-            cmd = self._cmd.get()
-            if cmd == "stop":
-                return
-            try:
-                self._produce_epoch()
-            except Exception as e:      # surface errors to the consumer
-                self._queue.put(e)
+        self._put(self._END)
 
     # ------------------------------------------------------------- consumer
     def before_first(self) -> None:
-        pending_error = None
-        if self._started and not self._epoch_done:
-            while True:
-                item = self._queue.get()
-                if item is self._END:
-                    break
-                if isinstance(item, Exception):
-                    pending_error = item
-                    break
-        if pending_error is not None:
-            self._epoch_done = True
-            raise pending_error
-        self._cmd.put("epoch")
-        self._started = True
-        self._epoch_done = False
+        self._rewind_producer()
 
     def next(self) -> bool:
-        if self._epoch_done:
+        item = self._next_item()
+        if item is None:
             return False
-        item = self._queue.get()
-        if item is self._END:
-            self._epoch_done = True
-            return False
-        if isinstance(item, Exception):
-            self._epoch_done = True
-            raise item
         self._value = item
         return True
 
     def value(self) -> DataInst:
         return self._value
 
+    def close(self) -> None:
+        """Tear down the producer thread and decode pool. Safe to call on a
+        partially-consumed iterator."""
+        had_thread = getattr(self, "_thread", None) is not None
+        self._close_producer()
+        if had_thread:
+            self._pool.shutdown(wait=False)
+
     def __del__(self):
         try:
-            if self._producer is not None:
-                self._cmd.put("stop")
+            self.close()
         except Exception:
             pass
 

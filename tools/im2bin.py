@@ -15,6 +15,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from cxxnet_tpu.io.binpage import BinaryPageWriter  # noqa: E402
+from cxxnet_tpu.io.imgbin import parse_list_line  # noqa: E402
 
 
 def main(argv):
@@ -28,10 +29,8 @@ def main(argv):
     w = BinaryPageWriter(out)
     with open(lst) as f:
         for line in f:
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) < 2:
-                parts = line.split()
-            if len(parts) < 2:
+            parts = parse_list_line(line)
+            if parts is None:
                 continue
             path = os.path.join(root, parts[-1])
             with open(path, "rb") as img:

@@ -16,6 +16,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from cxxnet_tpu.io.binpage import BinaryPageWriter  # noqa: E402
+from cxxnet_tpu.io.imgbin import parse_list_line  # noqa: E402
 
 
 def main(argv):
@@ -26,7 +27,7 @@ def main(argv):
     lst, root, prefix, n = argv[1], argv[2], argv[3], int(argv[4])
     shuffle = "--shuffle" in argv[5:]
     with open(lst) as f:
-        lines = [l for l in f if l.strip()]
+        lines = [l for l in f if parse_list_line(l) is not None]
     if shuffle:
         random.Random(10).shuffle(lines)
     per = (len(lines) + n - 1) // n
@@ -36,9 +37,7 @@ def main(argv):
             f.writelines(part)
         with BinaryPageWriter("%s%d.bin" % (prefix, i + 1)) as w:
             for line in part:
-                parts = line.rstrip("\n").split("\t")
-                if len(parts) < 2:
-                    parts = line.split()
+                parts = parse_list_line(line)
                 with open(os.path.join(root, parts[-1]), "rb") as img:
                     w.push(img.read())
         print("partition %d/%d: %d images" % (i + 1, n, len(part)))
