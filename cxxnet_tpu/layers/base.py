@@ -183,6 +183,13 @@ class Layer:
     def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
         return {}
 
+    def param_axes(self, tag: str) -> Optional[Tuple[Optional[str], ...]]:
+        """Logical mesh-axis names per dim of weight ``tag`` for tensor
+        parallelism (None = replicate). The sharding resolver degrades any
+        axis that doesn't divide evenly back to replication, so layers can
+        declare intent unconditionally. Default: fully replicated."""
+        return None
+
     def apply(self, params: Params, inputs: List[jnp.ndarray],
               ctx: ApplyContext) -> List[jnp.ndarray]:
         raise NotImplementedError

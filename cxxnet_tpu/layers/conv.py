@@ -71,6 +71,15 @@ class ConvLayer(Layer):
             out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
         return out
 
+    def param_axes(self, tag):
+        # shard output channels over the `model` axis (ungrouped convs only:
+        # splitting grouped filters across shards would break group alignment)
+        from ..parallel.mesh import MODEL_AXIS
+        if self.param.num_group != 1:
+            return None
+        return {"wmat": (None, None, None, MODEL_AXIS),
+                "bias": (MODEL_AXIS,)}.get(tag)
+
     def apply(self, params: Params, inputs: List[jnp.ndarray],
               ctx: ApplyContext) -> List[jnp.ndarray]:
         p = self.param

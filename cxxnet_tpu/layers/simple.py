@@ -63,6 +63,12 @@ class FullcLayer(Layer):
                                  jnp.float32)
         return p
 
+    def param_axes(self, tag):
+        # tensor parallelism: shard the output-feature dim over the `model`
+        # mesh axis (the fullc_gather descendant, async_updater-inl.hpp:67-92)
+        from ..parallel.mesh import MODEL_AXIS
+        return {"wmat": (MODEL_AXIS, None), "bias": (MODEL_AXIS,)}.get(tag)
+
     def apply(self, params: Params, inputs: List[jnp.ndarray],
               ctx: ApplyContext) -> List[jnp.ndarray]:
         x = _flatten2d(inputs[0])

@@ -35,6 +35,7 @@ from ..layers import ApplyContext, create_layer
 from ..layers.base import Layer
 from ..metrics import MetricSet
 from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
+from ..parallel.sharding import resolve_shardings
 from ..updaters import create_updater
 from ..utils.config import ConfigError
 
@@ -69,6 +70,7 @@ class Net:
         self.seed = 0
         self.dev = ""
         self.model_parallel = 1
+        self.shard_optimizer = 0
         self.precision = "float32"
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
@@ -85,6 +87,8 @@ class Net:
                 self.dev = v
             elif k == "model_parallel":
                 self.model_parallel = int(v)
+            elif k == "shard_optimizer":
+                self.shard_optimizer = int(v)
             elif k == "precision":
                 self.precision = v
             elif k.startswith("metric"):
@@ -209,14 +213,25 @@ class Net:
             if self.update_period > 1 else None
 
     def _place_state(self) -> None:
-        """Place params/opt state replicated over the mesh."""
-        rep = replicated_sharding(self.mesh)
-        self.params = jax.device_put(self.params, rep)
-        self.opt_state = jax.device_put(self.opt_state, rep)
+        """Place params / optimizer state on the mesh. Weights follow each
+        layer's declared tensor-parallel axes (replicated on a pure-DP mesh);
+        optimizer state additionally shards over the data axis under
+        ``shard_optimizer = 1`` (ZeRO-1). XLA GSPMD derives the collectives
+        that mshadow-ps Push/PullReq performed by hand (SURVEY §5.8)."""
+        param_sh, opt_sh = resolve_shardings(
+            self.mesh, self.graph, self.layers, self.params,
+            zero=bool(self.shard_optimizer))
+        self._param_shardings = param_sh
+        self._opt_shardings = opt_sh
+        self.params = jax.device_put(self.params, param_sh)
+        # opt_sh is a pytree *prefix*: one sharding per weight covers every
+        # tensor of that weight's optimizer state (all weight-shaped)
+        self.opt_state = jax.device_put(self.opt_state, opt_sh)
         if self.states:
-            self.states = jax.device_put(self.states, rep)
+            self.states = jax.device_put(self.states,
+                                         replicated_sharding(self.mesh))
         if self.gsum is not None:
-            self.gsum = jax.device_put(self.gsum, rep)
+            self.gsum = jax.device_put(self.gsum, param_sh)
 
     # ------------------------------------------------------------ executor
     def _layer_params(self, params, idx: int):
@@ -288,6 +303,7 @@ class Net:
     def _apply_grads(self, params, opt_state, grads, epoch):
         new_params = {}
         new_opt = {}
+        constrain = jax.lax.with_sharding_constraint
         for lkey, tensors in params.items():
             new_params[lkey] = {}
             new_opt[lkey] = {}
@@ -295,8 +311,15 @@ class Net:
                 upd = self.updaters[lkey][tag]
                 g = grads[lkey][tag]
                 w2, s2 = upd.update(w, g, opt_state[lkey][tag], epoch)
-                new_params[lkey][tag] = w2
-                new_opt[lkey][tag] = s2
+                # pin the resolved shardings so the update step's outputs keep
+                # the layout they were placed with (no GSPMD drift between
+                # steps; under ZeRO this is where the weight re-gather and the
+                # opt-state reduce-scatter materialize)
+                new_params[lkey][tag] = constrain(
+                    w2, self._param_shardings[lkey][tag])
+                new_opt[lkey][tag] = jax.tree.map(
+                    lambda t, s=self._opt_shardings[lkey][tag]: constrain(t, s),
+                    s2)
         return new_params, new_opt
 
     def _forward_eval(self, params, states, data, extras, node_ids):
@@ -442,7 +465,7 @@ class Net:
         cur = self.params[lkey][tag]
         value = np.asarray(value, np.float32).reshape(cur.shape)
         self.params[lkey][tag] = jax.device_put(
-            jnp.asarray(value), replicated_sharding(self.mesh))
+            jnp.asarray(value), self._param_shardings[lkey][tag])
 
     # --------------------------------------------------------- checkpoint
     def save_model(self, path: str) -> None:

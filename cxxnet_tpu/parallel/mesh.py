@@ -49,6 +49,8 @@ def make_mesh(dev: str = "", model_parallel: int = 1,
     if devices is None:
         devices = parse_devices(dev)
     n = len(devices)
+    if model_parallel <= 0:
+        raise ValueError("model_parallel must be >= 1, got %d" % model_parallel)
     if n % model_parallel:
         raise ValueError("model_parallel=%d must divide device count %d"
                          % (model_parallel, n))
