@@ -40,6 +40,8 @@ KNOWN_LAYER_TYPES = frozenset([
     "avg_pooling", "lrn", "concat", "xelu", "split", "insanity",
     "insanity_max_pooling", "l2_loss", "multi_logistic", "ch_concat", "prelu",
     "batch_norm", "share",
+    # sequence/long-context extensions (no reference counterpart, SURVEY §5.7)
+    "attention", "layer_norm", "add", "embedding",
 ])
 
 

@@ -1,0 +1,192 @@
+"""Sequence-model layers: attention, layer_norm, add (residual), embedding.
+
+The reference is a pure CNN/MLP framework with no attention (SURVEY §5.7);
+these layers extend the same config DSL to transformer-style networks, with
+long-context support built in: when the trainer's mesh has a ``seq`` axis
+(``seq_parallel = k``), the attention layer automatically switches from exact
+attention to ring attention (K/V rotation over ICI, online softmax — see
+cxxnet_tpu/ops/attention.py).
+
+Sequence node convention: a sequence of length N with F features is the node
+shape (batch, y=N, x=1, c=F) — logical (F, N, 1) in config terms. Token-id
+inputs for ``embedding`` are matrix nodes (batch, 1, 1, N) holding float ids,
+as produced by the standard label/data pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import full_attention, ring_attention
+from ..parallel.mesh import MODEL_AXIS, SEQ_AXIS
+from ..utils.config import ConfigError
+from .base import ApplyContext, Layer, Params, Shape3, register_layer
+
+
+@register_layer
+class LayerNormLayer(Layer):
+    """Per-position layer norm over the feature (channel) dim; learned
+    scale ("wmat") and shift ("bias"), same tag names as batch_norm."""
+    type_name = "layer_norm"
+
+    def __init__(self, spec, cfg):
+        self.eps = 1e-5
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "eps":
+            self.eps = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = self.check_one_to_one(in_shapes)
+        self.channel = shape[0]
+        return [shape]
+
+    def init_params(self, key, in_shapes):
+        return {"wmat": jnp.ones((self.channel,), jnp.float32),
+                "bias": jnp.zeros((self.channel,), jnp.float32)}
+
+    def apply(self, params, inputs, ctx):
+        x = inputs[0]
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=-1, keepdims=True)
+        var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        out = out * params["wmat"] + params["bias"]
+        return [out.astype(x.dtype)]
+
+
+@register_layer
+class AddLayer(Layer):
+    """N->1 elementwise sum — the residual connection. Dual of ``split``."""
+    type_name = "add"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        for s in in_shapes[1:]:
+            if s != in_shapes[0]:
+                raise ConfigError("add: mismatched input shapes %r" % in_shapes)
+        return [in_shapes[0]]
+
+    def apply(self, params, inputs, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]
+
+
+@register_layer
+class EmbeddingLayer(Layer):
+    """Token + learned positional embedding: (b,1,1,N) float ids ->
+    (b, N, 1, nhidden). Weights: "wmat" (vocab, nhidden), "pos" (N, nhidden).
+    """
+    type_name = "embedding"
+
+    def __init__(self, spec, cfg):
+        self.vocab_size = 0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "vocab_size":
+            self.vocab_size = int(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        c, y, x = self.check_one_to_one(in_shapes)
+        if self.vocab_size <= 0 or self.param.num_hidden <= 0:
+            raise ConfigError("embedding %r: set vocab_size and nhidden"
+                              % self.spec.key())
+        self.seq_len = c * y * x
+        return [(self.param.num_hidden, self.seq_len, 1)]
+
+    def init_params(self, key, in_shapes):
+        kw, kp = jax.random.split(key)
+        f = self.param.num_hidden
+        return {
+            "wmat": self.param.rand_init(kw, (self.vocab_size, f),
+                                         in_num=self.vocab_size, out_num=f),
+            "pos": self.param.rand_init(kp, (self.seq_len, f),
+                                        in_num=self.seq_len, out_num=f),
+        }
+
+    def param_axes(self, tag):
+        return {"wmat": (None, MODEL_AXIS), "pos": (None, MODEL_AXIS)}.get(tag)
+
+    def apply(self, params, inputs, ctx):
+        ids = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.int32)
+        emb = jnp.take(params["wmat"], ids, axis=0) + params["pos"]
+        return [emb[:, :, None, :]]     # (b, N, 1, F)
+
+
+@register_layer
+class AttentionLayer(Layer):
+    """Multi-head self-attention on (b, N, 1, F) nodes.
+
+    Weights: "qkv" (3F, F), "proj" (F, F) (+ "qkv_bias"/"proj_bias" unless
+    no_bias). ``nhead`` heads; ``causal = 1`` for autoregressive masking.
+    Ring attention engages when the trainer mesh's ``seq`` axis is > 1.
+    """
+    type_name = "attention"
+    uses_rng = False
+
+    def __init__(self, spec, cfg):
+        self.nhead = 1
+        self.causal = 0
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "nhead":
+            self.nhead = int(val)
+        elif name == "causal":
+            self.causal = int(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        c, y, x = self.check_one_to_one(in_shapes)
+        if x != 1:
+            raise ConfigError("attention %r: expects (feat, seq, 1) nodes, "
+                              "got %r" % (self.spec.key(), (c, y, x)))
+        if c % self.nhead:
+            raise ConfigError("attention %r: nhead %d must divide feature "
+                              "dim %d" % (self.spec.key(), self.nhead, c))
+        self.feat = c
+        return [(c, y, x)]
+
+    def init_params(self, key, in_shapes):
+        kq, kp = jax.random.split(key)
+        f = self.feat
+        p: Params = {
+            "qkv": self.param.rand_init(kq, (3 * f, f), in_num=f, out_num=f),
+            "proj": self.param.rand_init(kp, (f, f), in_num=f, out_num=f),
+        }
+        if not self.param.no_bias:
+            p["qkv_bias"] = jnp.zeros((3 * f,), jnp.float32)
+            p["proj_bias"] = jnp.zeros((f,), jnp.float32)
+        return p
+
+    def param_axes(self, tag):
+        return {"qkv": (MODEL_AXIS, None), "qkv_bias": (MODEL_AXIS,),
+                "proj": (None, MODEL_AXIS)}.get(tag)
+
+    def apply(self, params, inputs, ctx: ApplyContext):
+        x = inputs[0]                       # (b, N, 1, F)
+        b, n, _, f = x.shape
+        h = self.nhead
+        xs = x.reshape(b, n, f)
+        qkv = xs @ params["qkv"].astype(xs.dtype).T
+        if "qkv_bias" in params:
+            qkv = qkv + params["qkv_bias"].astype(qkv.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, n, h, f // h)
+        k = k.reshape(b, n, h, f // h)
+        v = v.reshape(b, n, h, f // h)
+        mesh = ctx.mesh
+        if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
+            out = ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS,
+                                 causal=bool(self.causal))
+        else:
+            out = full_attention(q, k, v, causal=bool(self.causal))
+        out = out.reshape(b, n, f) @ params["proj"].astype(x.dtype).T
+        if "proj_bias" in params:
+            out = out + params["proj_bias"].astype(out.dtype)
+        return [out.reshape(b, n, 1, f)]

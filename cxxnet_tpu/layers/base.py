@@ -128,8 +128,11 @@ class ApplyContext:
                  labels: Optional[Dict[str, jnp.ndarray]] = None,
                  sample_mask: Optional[jnp.ndarray] = None,
                  batch_size: int = 0, update_period: int = 1,
-                 epoch=0, states: Optional[dict] = None) -> None:
+                 epoch=0, states: Optional[dict] = None,
+                 mesh=None) -> None:
         self.train = train
+        self.mesh = mesh    # device mesh (static); lets layers pick
+                            # sequence-parallel implementations
         self._rng = rng
         self._rng_count = 0
         self.labels = labels or {}

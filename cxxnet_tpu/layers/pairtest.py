@@ -108,7 +108,7 @@ class PairTestLayer(Layer):
             rng=ctx.next_key() if self.slave.uses_rng and ctx.train else None,
             labels=ctx.labels, sample_mask=ctx.sample_mask,
             batch_size=ctx.batch_size, update_period=ctx.update_period,
-            epoch=ctx.epoch, states=ctx.states)
+            epoch=ctx.epoch, states=ctx.states, mesh=ctx.mesh)
         souts = self.slave.apply(params, [jax.lax.stop_gradient(x)
                                           for x in inputs], slave_ctx)
         for i, (m, s) in enumerate(zip(mouts, souts)):

@@ -1,0 +1,88 @@
+"""Transformer encoder expressed in the config DSL — the long-context
+flagship.
+
+The reference has no attention at all (SURVEY §5.7); this model family shows
+the framework's first-class long-context path: pre-LN encoder blocks built
+from the attention / layer_norm / add / split layers, sequence-parallel via
+``seq_parallel = k`` (ring attention over the mesh's ``seq`` axis) and
+tensor-parallel via ``model_parallel``.
+
+Graph per block (pre-LN):
+    x -> split -> [ln1 -> attention] -> add(x) -> split -> [ln2 -> fullc
+    -> relu -> fullc] -> add -> out
+
+The default net is a sequence *classifier* (mean-pool head + softmax) so it
+trains against the standard label pipeline; ``causal=1`` turns the attention
+masks autoregressive.
+"""
+
+from __future__ import annotations
+
+
+def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
+                      causal: int, mlp_ratio: int = 4) -> None:
+    # position-wise MLP = 1x1 conv on the (b, N, 1, F) node
+    a, b = "b%da" % i, "b%db" % i
+    L.append("layer[%s->%s,%s_r] = split" % (src, a, a))
+    L.append("layer[%s->%s] = layer_norm:ln%da" % (a, a, i))
+    L.append("layer[%s->%s] = attention:att%d" % (a, a, i))
+    L.append("  nhead = %d" % nhead)
+    if causal:
+        L.append("  causal = 1")
+    L.append("layer[%s,%s_r->%s] = add" % (a, a, b))
+    L.append("layer[%s->%s,%s_r] = split" % (b, b, b))
+    L.append("layer[%s->%s] = layer_norm:ln%db" % (b, b, i))
+    L.append("layer[%s->%s] = conv:mlp%da" % (b, b, i))
+    L.append("  kernel_size = 1")
+    L.append("  nchannel = %d" % (feat * mlp_ratio))
+    L.append("layer[%s->%s] = relu" % (b, b))
+    L.append("layer[%s->%s] = conv:mlp%db" % (b, b, i))
+    L.append("  kernel_size = 1")
+    L.append("  nchannel = %d" % feat)
+    L.append("layer[%s,%s_r->%s] = add" % (b, b, out))
+
+
+def transformer_config(seq_len: int = 128, vocab_size: int = 256,
+                       feat: int = 64, nhead: int = 4, nblock: int = 2,
+                       num_classes: int = 10, causal: int = 0,
+                       batch_size: int = 16, dev: str = "",
+                       seq_parallel: int = 1, model_parallel: int = 1,
+                       precision: str = "float32",
+                       eta: float = 0.05) -> str:
+    L = ["netconfig=start"]
+    L.append("layer[0->emb] = embedding:emb")
+    L.append("  vocab_size = %d" % vocab_size)
+    L.append("  nhidden = %d" % feat)
+    src = "emb"
+    for i in range(nblock):
+        out = "blk%d" % i
+        transformer_block(L, src, out, i, feat, nhead, causal)
+        src = out
+    L.append("layer[%s->%s] = layer_norm:lnf" % (src, src))
+    # mean-pool over the sequence -> (b, 1, 1, feat) -> classifier head
+    L.append("layer[%s->pool] = avg_pooling" % src)
+    L.append("  kernel_height = %d" % seq_len)
+    L.append("  kernel_width = 1")
+    L.append("  stride = %d" % seq_len)
+    L.append("layer[pool->flat] = flatten")
+    L.append("layer[flat->out] = fullc:head")
+    L.append("  nhidden = %d" % num_classes)
+    L.append("  init_sigma = 0.02")
+    L.append("layer[out->out] = softmax")
+    L.append("netconfig=end")
+    dev_line = ("dev = %s" % dev) if dev else ""
+    L.append("""
+input_shape = 1,1,%d
+batch_size = %d
+%s
+seq_parallel = %d
+model_parallel = %d
+precision = %s
+random_type = gaussian
+init_sigma = 0.02
+eta = %g
+momentum = 0.9
+metric = error
+""" % (seq_len, batch_size, dev_line, seq_parallel, model_parallel,
+       precision, eta))
+    return "\n".join(L)

@@ -70,6 +70,7 @@ class Net:
         self.seed = 0
         self.dev = ""
         self.model_parallel = 1
+        self.seq_parallel = 1
         self.shard_optimizer = 0
         self.precision = "float32"
         self.train_metrics = MetricSet()
@@ -87,6 +88,8 @@ class Net:
                 self.dev = v
             elif k == "model_parallel":
                 self.model_parallel = int(v)
+            elif k == "seq_parallel":
+                self.seq_parallel = int(v)
             elif k == "shard_optimizer":
                 self.shard_optimizer = int(v)
             elif k == "precision":
@@ -138,7 +141,8 @@ class Net:
                 self.node_shapes[ni] = s
 
         # mesh for SPMD execution
-        self.mesh = make_mesh(self.dev, self.model_parallel)
+        self.mesh = make_mesh(self.dev, self.model_parallel,
+                              self.seq_parallel)
         self.n_data_shards = self.mesh.shape["data"]
         if self.batch_size % self.n_data_shards:
             raise ConfigError(
@@ -268,7 +272,8 @@ class Net:
         ctx = ApplyContext(
             train=True, rng=rng, labels=self._split_labels(label),
             sample_mask=mask, batch_size=self.batch_size,
-            update_period=self.update_period, epoch=epoch, states=states)
+            update_period=self.update_period, epoch=epoch, states=states,
+            mesh=self.mesh)
         nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
         if not ctx.losses:
             raise ConfigError("network has no loss layer")
@@ -324,7 +329,8 @@ class Net:
 
     def _forward_eval(self, params, states, data, extras, node_ids):
         """Inference forward; returns only the requested nodes' outputs."""
-        ctx = ApplyContext(train=False, rng=None, states=states)
+        ctx = ApplyContext(train=False, rng=None, states=states,
+                           mesh=self.mesh)
         nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
         return tuple(nodes[n] for n in node_ids)
 

@@ -1,0 +1,13 @@
+"""TPU kernel-level ops: attention (full / ring), fused primitives.
+
+The reference framework predates attention entirely (SURVEY §5.7) — its
+long-context analogues were im2col chunking and the fullc_gather activation
+shipping trick. This package supplies the modern capability: exact multi-head
+attention, and ring attention for sequence/context parallelism where the
+KV shards rotate around the mesh's ``seq`` axis via ``ppermute`` while each
+device accumulates its queries' output with an online softmax.
+"""
+
+from .attention import full_attention, ring_attention  # noqa: F401
+
+__all__ = ["full_attention", "ring_attention"]

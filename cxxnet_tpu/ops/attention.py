@@ -1,0 +1,140 @@
+"""Exact and ring (sequence-parallel) multi-head attention.
+
+Design (TPU-first):
+- ``full_attention`` is the reference math: one fused softmax(QK^T)V — XLA
+  maps the two matmuls onto the MXU; fine whenever the whole sequence fits.
+- ``ring_attention`` shards the sequence over a mesh axis. Each device holds
+  one Q/K/V shard; K/V shards rotate around the ring with
+  ``jax.lax.ppermute`` while a numerically-stable *online softmax*
+  (max/sum carries, flash-attention style) accumulates each query block's
+  output. Peak memory per device is O((N/P)^2) scores instead of O(N^2),
+  and the P permute steps overlap with the block matmuls (ICI and MXU run
+  concurrently). Causal masking uses global positions derived from the ring
+  step, so block (i, j) with no unmasked entries still costs one fused
+  masked-matmul but no extra softmax pass.
+
+All accumulation is float32 regardless of input dtype (bfloat16 inputs stay
+bfloat16 on the matmul operands — MXU native — with f32 accumulators).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = False,
+                   q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
+    """Exact attention. q,k,v: (batch, seq, heads, head_dim) -> same shape.
+
+    ``q_offset``/``k_offset`` are the global positions of element 0 (used by
+    the ring to mask across shards; traced values are fine).
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+def _block(q, k, v, o, m, l, causal, q_off, k_off):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: (b, nq, h, d); k/v: (b, nk, h, d); o: (b, nq, h, d) f32;
+    m/l: (b, h, nq) f32 running max / normalizer.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])[:, None]
+        kpos = k_off + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])            # (b,h,q,k) f32
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool, vary_axes=()):
+    """shard_map body: q,k,v are the local (b, n_local, h, d) shards."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    n_local = q.shape[1]
+    b, _, h, dd = q.shape
+
+    # carries must enter the loop with the same varying-axes type they exit
+    # with (they become device-varying after the first block accumulation)
+    o0 = lax.pcast(jnp.zeros((b, n_local, h, dd), jnp.float32), vary_axes, to='varying')
+    m0 = lax.pcast(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), vary_axes, to='varying')
+    l0 = lax.pcast(jnp.zeros((b, h, n_local), jnp.float32), vary_axes, to='varying')
+
+    def step(i, carry):
+        o, m, l, kk, vv = carry
+        # after i left-rotations we hold the K/V shard of rank (my_idx + i)
+        k_shard = (my_idx + i) % axis_size
+        o, m, l = _block(q, kk, vv, o, m, l, causal,
+                         q_off=my_idx * n_local, k_off=k_shard * n_local)
+        perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return o, m, l, kk, vv
+
+    # the last block is peeled out of the loop so its (discarded) rotation
+    # is never issued: axis_size-1 permutes move the ring full circle
+    o, m, l, kk, vv = lax.fori_loop(0, axis_size - 1, step,
+                                    (o0, m0, l0, k, v))
+    last_shard = (my_idx + axis_size - 1) % axis_size
+    o, m, l = _block(q, kk, vv, o, m, l, causal,
+                     q_off=my_idx * n_local, k_off=last_shard * n_local)
+    norm = jnp.transpose(l, (0, 2, 1))[..., None]      # (b, nq, h, 1)
+    return (o / jnp.maximum(norm, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis_name: str = "seq",
+                   causal: bool = False,
+                   batch_axis: Optional[str] = "data") -> jnp.ndarray:
+    """Sequence-parallel attention: seq dim sharded over ``axis_name``.
+
+    q,k,v: (batch, seq, heads, head_dim), seq divisible by the axis size.
+    Works under jit (shard_map nests); on a size-1 axis it degenerates to one
+    local exact-attention block.
+    """
+    n_seq = mesh.shape.get(axis_name, 1)
+    if q.shape[1] % n_seq:
+        raise ValueError(
+            "ring_attention: sequence length %d is not divisible by the "
+            "%r mesh axis (size %d)" % (q.shape[1], axis_name, n_seq))
+    batch_ax = batch_axis if (batch_axis and
+                              mesh.shape.get(batch_axis, 1) > 1 and
+                              q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(batch_ax, axis_name, None, None)
+    vary_axes = tuple(a for a in (batch_ax, axis_name) if a)
+    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                             vary_axes=vary_axes)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+__all__ = ["full_attention", "ring_attention"]

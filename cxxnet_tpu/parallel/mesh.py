@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def parse_devices(dev: str) -> Sequence[jax.Device]:
@@ -43,19 +44,29 @@ def parse_devices(dev: str) -> Sequence[jax.Device]:
     return [all_devices[i] for i in ids]
 
 
-def make_mesh(dev: str = "", model_parallel: int = 1,
+def make_mesh(dev: str = "", model_parallel: int = 1, seq_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a (data, model) mesh; model axis size 1 means pure DP."""
+    """Build a (data, seq, model) mesh; size-1 axes cost nothing.
+
+    ``seq`` (sequence/context parallelism, ring attention) sits between
+    ``data`` and ``model`` so K/V ring permutes ride adjacent-chip ICI links
+    while tensor-parallel collectives stay innermost (the scaling-book axis
+    ordering).
+    """
     if devices is None:
         devices = parse_devices(dev)
     n = len(devices)
     if model_parallel <= 0:
         raise ValueError("model_parallel must be >= 1, got %d" % model_parallel)
-    if n % model_parallel:
-        raise ValueError("model_parallel=%d must divide device count %d"
-                         % (model_parallel, n))
-    arr = np.asarray(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    if seq_parallel <= 0:
+        raise ValueError("seq_parallel must be >= 1, got %d" % seq_parallel)
+    if n % (model_parallel * seq_parallel):
+        raise ValueError(
+            "model_parallel=%d * seq_parallel=%d must divide device count %d"
+            % (model_parallel, seq_parallel, n))
+    arr = np.asarray(devices).reshape(
+        n // (model_parallel * seq_parallel), seq_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
