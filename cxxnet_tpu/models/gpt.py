@@ -1,0 +1,211 @@
+"""GPT-style causal LM — the 4D-parallel flagship (dp x pp x sp x tp).
+
+The reference tops out at data parallelism over a parameter server
+(SURVEY §2.7); this model demonstrates the framework's full modern scaling
+stack in ONE jitted train step:
+
+- **dp**   batch sharded over ``data`` (gradient psum by GSPMD)
+- **pp**   transformer blocks pipelined over ``pipe`` (gpipe microbatches)
+- **sp**   sequence sharded over ``seq`` (ring attention K/V rotation)
+- **tp**   megatron-style tensor parallelism over ``model``: QKV/MLP-in
+           column-sharded, proj/MLP-out row-sharded with an explicit psum —
+           written with manual collectives because the block body executes
+           inside the gpipe shard_map where GSPMD does not reach.
+
+Everything outside the pipelined blocks (embedding, final norm, LM head,
+loss) is plain jnp under jit, partitioned automatically from the argument
+shardings.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import full_attention, ring_attention_inner
+from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                             batch_sharding)
+from ..parallel.pipeline import gpipe
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 256
+    seq_len: int = 128
+    n_layer: int = 4
+    n_head: int = 4
+    feat: int = 64
+    mlp_ratio: int = 4
+    n_microbatch: int = 2
+    dtype: str = "float32"      # activation dtype ("bfloat16" on real chips)
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    return ((xf - mean) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
+           use_ring: bool) -> jnp.ndarray:
+    """Pre-LN transformer block on local shards (b, n_local, F)."""
+    b, n, f = h.shape
+    x = _layernorm(h, p["ln1_g"], p["ln1_b"])
+    # separate Q/K/V projections so the model-axis shard of each is a whole
+    # set of heads (a fused (F,3F) weight sharded on its last dim would hand
+    # rank 0 all of Q and half of K instead)
+    q = x @ p["w_q"].astype(x.dtype) + p["b_q"].astype(x.dtype)
+    k = x @ p["w_k"].astype(x.dtype) + p["b_k"].astype(x.dtype)
+    v = x @ p["w_v"].astype(x.dtype) + p["b_v"].astype(x.dtype)
+    d = q.shape[-1] // n_head_local
+    q = q.reshape(b, n, n_head_local, d)
+    k = k.reshape(b, n, n_head_local, d)
+    v = v.reshape(b, n, n_head_local, d)
+    if use_ring:
+        att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
+    else:
+        att = full_attention(q, k, v, causal=True)
+    o = att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype)
+    # row-sharded matmul: psum combines the per-rank partial sums; on a
+    # size-1 model axis this is the identity (and demotes the vma type)
+    o = lax.psum(o, MODEL_AXIS)
+    h = h + o + p["b_proj"].astype(x.dtype)
+    x = _layernorm(h, p["ln2_g"], p["ln2_b"])
+    m = jax.nn.relu(x @ p["w_mlp1"].astype(x.dtype) + p["b_mlp1"].astype(x.dtype))
+    m = m @ p["w_mlp2"].astype(x.dtype)
+    m = lax.psum(m, MODEL_AXIS)
+    return h + m + p["b_mlp2"].astype(x.dtype)
+
+
+def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
+    """Random init; blocks stacked along a leading n_layer dim."""
+    f, l = cfg.feat, cfg.n_layer
+    mf = cfg.mlp_ratio * f
+    k = iter(jax.random.split(key, 16))
+
+    def norm(kk, shape, scale):
+        return scale * jax.random.normal(kk, shape, jnp.float32)
+
+    blocks = {
+        "ln1_g": jnp.ones((l, f)), "ln1_b": jnp.zeros((l, f)),
+        "ln2_g": jnp.ones((l, f)), "ln2_b": jnp.zeros((l, f)),
+        "w_q": norm(next(k), (l, f, f), 0.02),
+        "w_k": norm(next(k), (l, f, f), 0.02),
+        "w_v": norm(next(k), (l, f, f), 0.02),
+        "b_q": jnp.zeros((l, f)),
+        "b_k": jnp.zeros((l, f)),
+        "b_v": jnp.zeros((l, f)),
+        "w_proj": norm(next(k), (l, f, f), 0.02 / max(1, l) ** 0.5),
+        "b_proj": jnp.zeros((l, f)),
+        "w_mlp1": norm(next(k), (l, f, mf), 0.02),
+        "b_mlp1": jnp.zeros((l, mf)),
+        "w_mlp2": norm(next(k), (l, mf, f), 0.02 / max(1, l) ** 0.5),
+        "b_mlp2": jnp.zeros((l, f)),
+    }
+    return {
+        "emb": norm(next(k), (cfg.vocab_size, f), 0.02),
+        "pos": norm(next(k), (cfg.seq_len, f), 0.01),
+        "lnf_g": jnp.ones((f,)), "lnf_b": jnp.zeros((f,)),
+        "head": norm(next(k), (f, cfg.vocab_size), 0.02),
+        "blocks": blocks,
+    }
+
+
+def gpt_param_shardings(mesh: Mesh) -> Dict:
+    """Placement: blocks pipe-sharded on dim0 + tp-sharded on the megatron
+    dims (derived from the same spec table gpipe uses, so placement and
+    shard_map in_specs cannot diverge); embeddings/head replicated (small at
+    these scales)."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+    blocks = {k: NamedSharding(mesh, s)
+              for k, s in _block_param_specs().items()}
+    return {"emb": ns(), "pos": ns(), "lnf_g": ns(), "lnf_b": ns(),
+            "head": ns(), "blocks": blocks}
+
+
+def _block_param_specs() -> Dict:
+    return {
+        "ln1_g": P(PIPE_AXIS), "ln1_b": P(PIPE_AXIS),
+        "ln2_g": P(PIPE_AXIS), "ln2_b": P(PIPE_AXIS),
+        "w_q": P(PIPE_AXIS, None, MODEL_AXIS),
+        "w_k": P(PIPE_AXIS, None, MODEL_AXIS),
+        "w_v": P(PIPE_AXIS, None, MODEL_AXIS),
+        "b_q": P(PIPE_AXIS, MODEL_AXIS),
+        "b_k": P(PIPE_AXIS, MODEL_AXIS),
+        "b_v": P(PIPE_AXIS, MODEL_AXIS),
+        "w_proj": P(PIPE_AXIS, MODEL_AXIS, None),
+        "b_proj": P(PIPE_AXIS),
+        "w_mlp1": P(PIPE_AXIS, None, MODEL_AXIS),
+        "b_mlp1": P(PIPE_AXIS, MODEL_AXIS),
+        "w_mlp2": P(PIPE_AXIS, MODEL_AXIS, None),
+        "b_mlp2": P(PIPE_AXIS),
+    }
+
+
+def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
+               mesh: Mesh) -> jnp.ndarray:
+    """ids (batch, seq_len) int32 -> logits (batch, seq_len, vocab)."""
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    n_tp = mesh.shape.get(MODEL_AXIS, 1)
+    n_sp = mesh.shape.get(SEQ_AXIS, 1)
+    if cfg.n_head % max(n_tp, 1):
+        raise ValueError("n_head %d must divide over model axis %d"
+                         % (cfg.n_head, n_tp))
+    if cfg.seq_len % max(n_sp, 1):
+        raise ValueError("seq_len %d must be divisible by the seq axis "
+                         "(seq_parallel=%d)" % (cfg.seq_len, n_sp))
+    h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
+    block = functools.partial(
+        _block, n_head_local=cfg.n_head // max(n_tp, 1),
+        use_ring=n_sp > 1)
+    h = gpipe(block, params["blocks"], h, mesh, cfg.n_microbatch,
+              extra_spec_axes=(SEQ_AXIS,), param_specs=_block_param_specs())
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    return (h @ params["head"].astype(h.dtype)).astype(jnp.float32)
+
+
+def gpt_loss(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
+             mesh: Mesh) -> jnp.ndarray:
+    """Next-token cross-entropy (last position predicts nothing)."""
+    logits = gpt_logits(params, ids, cfg, mesh)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = ids[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
+                    momentum: float = 0.9):
+    """Jitted SGD-momentum train step; donates params/opt state."""
+    shardings = gpt_param_shardings(mesh)
+
+    def step(params, mom, ids):
+        loss, grads = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
+        new_mom = jax.tree.map(lambda m, g: momentum * m - eta * g, mom, grads)
+        new_params = jax.tree.map(jnp.add, params, new_mom)
+        # keep placements stable step-over-step
+        new_params = jax.lax.with_sharding_constraint(new_params, shardings)
+        new_mom = jax.lax.with_sharding_constraint(new_mom, shardings)
+        return new_params, new_mom, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def gpt_place(params: Dict, mesh: Mesh) -> Dict:
+    return jax.device_put(params, gpt_param_shardings(mesh))
+
+
+def gpt_data_sharding(mesh: Mesh) -> NamedSharding:
+    return batch_sharding(mesh)
+
+
+__all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss",
+           "make_train_step", "gpt_place", "gpt_param_shardings"]

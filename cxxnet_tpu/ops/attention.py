@@ -75,15 +75,22 @@ def _block(q, k, v, o, m, l, causal, q_off, k_off):
     return o_new, m_new, l_new
 
 
-def _ring_body(q, k, v, axis_name: str, causal: bool, vary_axes=()):
-    """shard_map body: q,k,v are the local (b, n_local, h, d) shards."""
+def ring_attention_inner(q, k, v, axis_name: str = "seq",
+                         causal: bool = False):
+    """Ring attention for use INSIDE an existing shard_map (e.g. a gpipe
+    block): q,k,v are the local (b, n_local, h, d) shards of a sequence
+    sharded over ``axis_name``. ``ring_attention`` wraps this in its own
+    shard_map for standalone use."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     n_local = q.shape[1]
     b, _, h, dd = q.shape
 
     # carries must enter the loop with the same varying-axes type they exit
-    # with (they become device-varying after the first block accumulation)
+    # with (they become device-varying after the first block accumulation);
+    # the varying set is derived from the inputs so this works under any
+    # enclosing shard_map
+    vary_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma | {axis_name})
     o0 = lax.pcast(jnp.zeros((b, n_local, h, dd), jnp.float32), vary_axes, to='varying')
     m0 = lax.pcast(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), vary_axes, to='varying')
     l0 = lax.pcast(jnp.zeros((b, h, n_local), jnp.float32), vary_axes, to='varying')
@@ -130,11 +137,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               q.shape[0] % mesh.shape[batch_axis] == 0) \
         else None
     spec = P(batch_ax, axis_name, None, None)
-    vary_axes = tuple(a for a in (batch_ax, axis_name) if a)
-    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                             vary_axes=vary_axes)
+    body = functools.partial(ring_attention_inner, axis_name=axis_name,
+                             causal=causal)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
 
 
-__all__ = ["full_attention", "ring_attention"]
+__all__ = ["full_attention", "ring_attention", "ring_attention_inner"]

@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 def parse_devices(dev: str) -> Sequence[jax.Device]:
@@ -45,28 +46,32 @@ def parse_devices(dev: str) -> Sequence[jax.Device]:
 
 
 def make_mesh(dev: str = "", model_parallel: int = 1, seq_parallel: int = 1,
+              pipeline_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a (data, seq, model) mesh; size-1 axes cost nothing.
+    """Build a (data, pipe, seq, model) mesh; size-1 axes cost nothing.
 
-    ``seq`` (sequence/context parallelism, ring attention) sits between
-    ``data`` and ``model`` so K/V ring permutes ride adjacent-chip ICI links
-    while tensor-parallel collectives stay innermost (the scaling-book axis
-    ordering).
+    Axis order is outermost-to-innermost communication intensity (the
+    scaling-book ordering): ``pipe`` stages exchange one activation per tick,
+    ``seq`` rings K/V shards, ``model`` all-reduces every layer — so the
+    chattiest axes map to the most adjacent chips.
     """
     if devices is None:
         devices = parse_devices(dev)
     n = len(devices)
-    if model_parallel <= 0:
-        raise ValueError("model_parallel must be >= 1, got %d" % model_parallel)
-    if seq_parallel <= 0:
-        raise ValueError("seq_parallel must be >= 1, got %d" % seq_parallel)
-    if n % (model_parallel * seq_parallel):
+    for name, k in (("model_parallel", model_parallel),
+                    ("seq_parallel", seq_parallel),
+                    ("pipeline_parallel", pipeline_parallel)):
+        if k <= 0:
+            raise ValueError("%s must be >= 1, got %d" % (name, k))
+    prod = model_parallel * seq_parallel * pipeline_parallel
+    if n % prod:
         raise ValueError(
-            "model_parallel=%d * seq_parallel=%d must divide device count %d"
-            % (model_parallel, seq_parallel, n))
+            "pipeline_parallel=%d * seq_parallel=%d * model_parallel=%d "
+            "must divide device count %d"
+            % (pipeline_parallel, seq_parallel, model_parallel, n))
     arr = np.asarray(devices).reshape(
-        n // (model_parallel * seq_parallel), seq_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        n // prod, pipeline_parallel, seq_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,114 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+No reference counterpart (cxxnet predates pipeline parallelism; SURVEY §2.7
+lists it as to-be-designed-fresh). TPU-first design: the repeated block's
+parameters are *stacked* along a leading layer dim and sharded over the
+``pipe`` axis — each device owns ``L/P`` consecutive blocks. Microbatches
+flow through the ring with ``ppermute``; each tick every stage applies its
+local blocks (a ``lax.scan`` over the stacked params, so the block body
+compiles once) and hands its activation to the next stage. The classic GPipe
+bubble is ``(P-1)/(M+P-1)``; gradients flow through the schedule because
+``scan``/``ppermute``/``where`` are all differentiable — no special backward
+schedule is needed under XLA.
+
+Composition: the body runs inside ``shard_map`` spanning ALL mesh axes, so
+block functions may freely use collectives over the other axes — e.g.
+``ring_attention_inner`` (sequence parallelism) or ``psum`` over ``model``
+(megatron-style tensor parallelism) — giving dp x pp x sp x tp in one jitted
+step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, PIPE_AXIS
+
+
+def _gpipe_body(params_local, x_local, block_fn: Callable, n_microbatch: int,
+                axis_name: str):
+    my = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    b_local = x_local.shape[0]
+    mb = b_local // n_microbatch
+    xs = x_local.reshape((n_microbatch, mb) + x_local.shape[1:])
+
+    def run_local(h):
+        return lax.scan(lambda a, p: (block_fn(p, a), None),
+                        h, params_local)[0]
+
+    # the zeros inherit xs's varying axes (data/seq); only the pipe axis —
+    # over which xs is replicated but the carries diverge — needs casting
+    state = lax.pcast(jnp.zeros_like(xs[0]), axis_name, to="varying")
+    outbuf = lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
+
+    def tick(carry, t):
+        state, outbuf = carry
+        # stage 0 injects microbatch t (clamped: post-M injections never
+        # reach the output buffer before the schedule ends)
+        inp = jnp.where(my == 0, xs[jnp.minimum(t, n_microbatch - 1)], state)
+        out = run_local(inp)
+        idx = t - (n_stage - 1)
+        valid = (my == n_stage - 1) & (idx >= 0)
+        safe = jnp.clip(idx, 0, n_microbatch - 1)
+        outbuf = outbuf.at[safe].set(jnp.where(valid, out, outbuf[safe]))
+        state = lax.ppermute(out, axis_name,
+                             [(i, i + 1) for i in range(n_stage - 1)])
+        return (state, outbuf), None
+
+    n_tick = n_microbatch + n_stage - 1
+    (state, outbuf), _ = lax.scan(tick, (state, outbuf), jnp.arange(n_tick))
+    # only the last stage wrote outputs; share them around the ring
+    outbuf = lax.psum(outbuf, axis_name)
+    return outbuf.reshape((b_local,) + x_local.shape[1:])
+
+
+def gpipe(block_fn: Callable, stacked_params, x: jnp.ndarray, mesh: Mesh,
+          n_microbatch: int, axis_name: str = PIPE_AXIS,
+          batch_axis: Optional[str] = DATA_AXIS,
+          extra_spec_axes=(), param_specs=None) -> jnp.ndarray:
+    """Run ``x`` through ``L`` stacked blocks pipelined over ``axis_name``.
+
+    ``block_fn(params_one_block, h) -> h`` must preserve ``h``'s shape.
+    ``stacked_params`` leaves have leading dim ``L`` divisible by the axis
+    size. ``x`` is ``(batch, ...)`` with batch divisible by ``n_microbatch``
+    (after data-axis sharding). ``extra_spec_axes`` optionally assigns mesh
+    axes to trailing activation dims, e.g. ``("seq",)`` to shard dim 1 for
+    ring attention inside the blocks. ``param_specs`` optionally gives a
+    pytree (matching ``stacked_params`` or a prefix) of PartitionSpecs whose
+    first entry must be the pipe axis — used to additionally shard weight
+    dims over ``model`` for megatron-style tensor parallelism inside blocks.
+    """
+    n_stage = mesh.shape.get(axis_name, 1)
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead % n_stage:
+        raise ValueError("gpipe: %d blocks not divisible by %r axis size %d"
+                         % (lead, axis_name, n_stage))
+    batch_ax = batch_axis if (batch_axis and
+                              mesh.shape.get(batch_axis, 1) > 1 and
+                              x.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    b_local = x.shape[0] // (mesh.shape[batch_ax] if batch_ax else 1)
+    if b_local % n_microbatch:
+        raise ValueError(
+            "gpipe: per-data-shard batch %d not divisible by n_microbatch %d"
+            % (b_local, n_microbatch))
+
+    x_spec = P(batch_ax, *extra_spec_axes)
+    if param_specs is None:
+        param_specs = P(axis_name)
+    body = functools.partial(
+        _gpipe_body, block_fn=block_fn, n_microbatch=n_microbatch,
+        axis_name=axis_name)
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(param_specs, x_spec),
+                         out_specs=x_spec)(stacked_params, x)
+
+
+__all__ = ["gpipe", "PIPE_AXIS"]
