@@ -210,6 +210,10 @@ def create_iterator(cfg: Pairs) -> IIterator:
     it: Optional[IIterator] = None
     for name, val in cfg:
         if name == "iter":
+            if val == "end":
+                # block terminator (CLI section grammar); later pairs are
+                # globals that still apply to the chain (e.g. batch_size)
+                continue
             if val in _BASE_FACTORIES:
                 if it is not None:
                     raise ValueError("%s cannot chain over another iterator" % val)

@@ -1,0 +1,180 @@
+"""User-facing Python API mirroring the reference wrapper
+(/root/reference/wrapper/cxxnet.py:64-307 DataIter/Net/train).
+
+The reference routes every call through a C ABI into the C++ trainer; here
+the trainer IS Python/JAX, so this module is a thin semantic adapter giving
+reference users the same call surface: config-string-constructed iterators,
+``Net(dev, cfg)``, numpy-in/numpy-out update/predict/extract/evaluate, and
+the ``train()`` convenience loop. The C ABI itself (CXNNet*/CXNIO*,
+cxxnet_wrapper.h:36-232) is provided for other languages by
+``native/capi.cpp`` which embeds CPython and calls into this module.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .io import create_iterator
+from .io.data import DataBatch
+from .nnet.net import Net as _CoreNet
+from .utils.config import tokenize
+
+Array = np.ndarray
+
+
+def _cfg_pairs(cfg: str) -> List[Tuple[str, str]]:
+    return tokenize(cfg)
+
+
+class DataIter:
+    """Config-string data iterator (cxxnet.py:64-103 semantics).
+
+    The config uses the same ``iter = <type> ... iter = end`` block grammar
+    as the CLI; global pairs outside the block are also applied.
+    """
+
+    def __init__(self, cfg: str):
+        self._iter = create_iterator(_cfg_pairs(cfg))   # factory inits it
+        self._valid = False
+
+    def next(self) -> bool:
+        self._valid = self._iter.next()
+        return self._valid
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self._valid = False
+
+    def check_valid(self) -> None:
+        if not self._valid:
+            raise RuntimeError("DataIter: no valid batch "
+                               "(call next() and check its result)")
+
+    @property
+    def batch(self) -> DataBatch:
+        self.check_valid()
+        return self._iter.value()
+
+    def get_data(self) -> Array:
+        return np.asarray(self.batch.data)
+
+    def get_label(self) -> Array:
+        return np.asarray(self.batch.label)
+
+
+def _as_batch(data: Union[DataIter, DataBatch, Array],
+              label: Optional[Array] = None) -> DataBatch:
+    if isinstance(data, DataIter):
+        return data.batch
+    if isinstance(data, DataBatch):
+        return data
+    data = np.asarray(data, np.float32)
+    if data.ndim == 2:            # (batch, feat) -> (batch, 1, 1, feat)
+        data = data.reshape(data.shape[0], 1, 1, data.shape[1])
+    if label is None:
+        label = np.zeros((data.shape[0], 1), np.float32)
+    label = np.asarray(label, np.float32)
+    if label.ndim == 1:
+        label = label.reshape(-1, 1)
+    return DataBatch(data, label)
+
+
+class Net:
+    """Reference-compatible trainer facade (cxxnet.py:105-279).
+
+    ``dev`` follows the reference device-string syntax mapped to TPU
+    (``dev='tpu'``/``'cpu'``/``'tpu:0-3'``); ``cfg`` is the full config text
+    including the ``netconfig`` block.
+    """
+
+    def __init__(self, dev: str = "", cfg: str = ""):
+        self._net = _CoreNet(_cfg_pairs(cfg))
+        if dev:
+            self._net.set_param("dev", dev)
+
+    # -- lifecycle ----------------------------------------------------
+    def set_param(self, name: str, value) -> None:
+        self._net.set_param(str(name), str(value))
+
+    def init_model(self) -> None:
+        self._net.init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._net.load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._net.save_model(fname)
+
+    def start_round(self, round_counter: int) -> None:
+        self._net.start_round(round_counter)
+
+    # -- training -----------------------------------------------------
+    def update(self, data, label: Optional[Array] = None) -> None:
+        """One step on a DataIter batch, a DataBatch, or a numpy pair
+        (cxxnet.py:152-180)."""
+        self._net.update(_as_batch(data, label))
+
+    def evaluate(self, data: Optional[DataIter], name: str) -> str:
+        """Metric line '[round] name-metric:value...' (cxxnet.py:182-194)."""
+        it = data._iter if isinstance(data, DataIter) else data
+        return self._net.evaluate(it, name)
+
+    # -- inference ----------------------------------------------------
+    def predict(self, data, label: Optional[Array] = None) -> Array:
+        """Label prediction; argmax for vector outputs (cxxnet.py:196-217).
+        Accepts a DataIter (whole-epoch prediction) or one batch."""
+        if isinstance(data, DataIter):
+            outs = []
+            data.before_first()
+            while data.next():
+                outs.append(self._net.predict(data.batch))
+            return np.concatenate(outs) if outs else np.zeros((0,), np.float32)
+        return self._net.predict(_as_batch(data, label))
+
+    def extract(self, data, name: str, label: Optional[Array] = None) -> Array:
+        """Feature extraction by node name or 'top[-k]' (cxxnet.py:219-242)."""
+        if isinstance(data, DataIter):
+            outs = []
+            data.before_first()
+            while data.next():
+                outs.append(self._net.extract_feature(data.batch, name))
+            return np.concatenate(outs) if outs else np.zeros((0,), np.float32)
+        return self._net.extract_feature(_as_batch(data, label), name)
+
+    # -- weight surgery -----------------------------------------------
+    def set_weight(self, weight: Array, layer_name: str, tag: str) -> None:
+        self._net.set_weight(layer_name, tag, np.asarray(weight, np.float32))
+
+    def get_weight(self, layer_name: str, tag: str) -> Array:
+        return self._net.get_weight(layer_name, tag)
+
+    # escape hatch to the full trainer (superset of the reference ABI)
+    @property
+    def core(self) -> _CoreNet:
+        return self._net
+
+
+def train(cfg: str, data: DataIter, num_round: int,
+          param: Dict[str, object],
+          eval_data: Optional[DataIter] = None) -> Net:
+    """Convenience training loop (cxxnet.py:281-307): build Net from config,
+    apply ``param`` overrides, run ``num_round`` epochs over ``data``,
+    printing eval lines per round."""
+    net = Net(cfg=cfg)
+    for k, v in param.items():
+        net.set_param(k, v)
+    net.init_model()
+    for r in range(num_round):
+        net.start_round(r)
+        data.before_first()
+        while data.next():
+            net.update(data)
+        line = net.evaluate(eval_data, "eval")
+        if line:
+            print("[%d]%s" % (r, line))
+    return net
+
+
+__all__ = ["DataIter", "Net", "train"]

@@ -1,0 +1,343 @@
+// C ABI implementation: embeds CPython and adapts cxxnet_tpu.wrapper.
+//
+// Counterpart of the reference's wrapper/cxxnet_wrapper.cpp (which adapted
+// the C++ INetTrainer); here the trainer is Python/JAX, so the adapter goes
+// the other direction. Each handle owns a Python object plus a pinned
+// "last result" buffer so returned pointers outlive the call (same lifetime
+// contract as the reference wrapper's temp tensors).
+//
+// Build: make -C native capi   (produces libcxnettpu.so)
+
+#include "capi.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Wrapped python object + buffers backing the most recent returned pointer.
+struct Handle {
+  PyObject *obj = nullptr;       // wrapper.Net or wrapper.DataIter
+  PyObject *last = nullptr;      // numpy array pinning the returned memory
+  std::string last_str;
+  ~Handle() {
+    Py_XDECREF(last);
+    Py_XDECREF(obj);
+  }
+};
+
+PyObject *g_wrapper_module = nullptr;
+PyObject *g_np_module = nullptr;
+
+// Call obj.method(*args) with a new reference result (nullptr on error).
+PyObject *call(PyObject *obj, const char *method, PyObject *args) {
+  PyObject *fn = PyObject_GetAttrString(obj, method);
+  if (!fn) { set_error_from_python(); Py_XDECREF(args); return nullptr; }
+  PyObject *ret = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (!ret) set_error_from_python();
+  return ret;
+}
+
+// float32 C-contiguous numpy array from raw floats.
+PyObject *np_from_floats(const cxn_real_t *data, const cxn_uint64 *shape,
+                         int ndim) {
+  cxn_uint64 size = 1;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    size *= shape[i];
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLongLong(shape[i]));
+  }
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(cxn_real_t));
+  PyObject *ret = PyObject_CallMethod(g_np_module, "frombuffer", "Os", bytes,
+                                      "float32");
+  Py_DECREF(bytes);
+  if (ret) {
+    PyObject *reshaped = PyObject_CallMethod(ret, "reshape", "O", shp);
+    Py_DECREF(ret);
+    ret = reshaped;
+  }
+  Py_DECREF(shp);
+  if (!ret) set_error_from_python();
+  return ret;
+}
+
+// Expose a numpy array's data: pin it on the handle, return pointer+shape.
+const cxn_real_t *expose(Handle *h, PyObject *arr, cxn_uint64 *oshape,
+                         cxn_uint64 *ondim, int max_dim) {
+  if (!arr) return nullptr;
+  PyObject *contig = PyObject_CallMethod(
+      g_np_module, "ascontiguousarray", "Os", arr, "float32");
+  Py_DECREF(arr);
+  if (!contig) { set_error_from_python(); return nullptr; }
+  Py_XDECREF(h->last);
+  h->last = contig;
+  Py_buffer view;
+  if (PyObject_GetBuffer(contig, &view, PyBUF_CONTIG_RO) != 0) {
+    set_error_from_python();
+    return nullptr;
+  }
+  if (oshape != nullptr) {
+    for (int i = 0; i < max_dim; ++i)
+      oshape[i] = i < view.ndim ? static_cast<cxn_uint64>(view.shape[i]) : 1;
+  }
+  if (ondim != nullptr) *ondim = view.ndim;
+  const cxn_real_t *ptr = static_cast<const cxn_real_t *>(view.buf);
+  PyBuffer_Release(&view);   // memory stays alive via h->last
+  return ptr;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *CXNGetLastError(void) { return g_last_error.c_str(); }
+
+int CXNInit(const char *repo_path) {
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  Gil gil;
+  if (g_wrapper_module != nullptr) return 0;
+  if (repo_path != nullptr && repo_path[0] != '\0') {
+    PyObject *sys_path = PySys_GetObject("path");   // borrowed
+    PyObject *p = PyUnicode_FromString(repo_path);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  g_np_module = PyImport_ImportModule("numpy");
+  if (!g_np_module) { set_error_from_python(); return -1; }
+  g_wrapper_module = PyImport_ImportModule("cxxnet_tpu.wrapper");
+  if (!g_wrapper_module) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+/* ---------------- iterators ---------------- */
+
+void *CXNIOCreateFromConfig(const char *cfg) {
+  Gil gil;
+  PyObject *obj = call(g_wrapper_module, "DataIter",
+                       Py_BuildValue("(s)", cfg));
+  if (!obj) return nullptr;
+  Handle *h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+int CXNIONext(void *handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = call(h->obj, "next", nullptr);
+  if (!r) return -1;
+  int ret = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return ret;
+}
+
+void CXNIOBeforeFirst(void *handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "before_first", nullptr));
+}
+
+const cxn_real_t *CXNIOGetData(void *handle, cxn_uint64 *oshape) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  return expose(h, call(h->obj, "get_data", nullptr), oshape, nullptr, 4);
+}
+
+const cxn_real_t *CXNIOGetLabel(void *handle, cxn_uint64 *oshape) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  return expose(h, call(h->obj, "get_label", nullptr), oshape, nullptr, 2);
+}
+
+void CXNIOFree(void *handle) {
+  Gil gil;
+  delete static_cast<Handle *>(handle);
+}
+
+/* ---------------- trainer ---------------- */
+
+void *CXNNetCreate(const char *device, const char *cfg) {
+  Gil gil;
+  PyObject *obj = call(g_wrapper_module, "Net",
+                       Py_BuildValue("(ss)", device ? device : "", cfg));
+  if (!obj) return nullptr;
+  Handle *h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+void CXNNetFree(void *handle) {
+  Gil gil;
+  delete static_cast<Handle *>(handle);
+}
+
+void CXNNetSetParam(void *handle, const char *name, const char *val) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "set_param", Py_BuildValue("(ss)", name, val)));
+}
+
+void CXNNetInitModel(void *handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "init_model", nullptr));
+}
+
+void CXNNetSaveModel(void *handle, const char *fname) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "save_model", Py_BuildValue("(s)", fname)));
+}
+
+void CXNNetLoadModel(void *handle, const char *fname) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "load_model", Py_BuildValue("(s)", fname)));
+}
+
+void CXNNetStartRound(void *handle, int round_counter) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "start_round", Py_BuildValue("(i)", round_counter)));
+}
+
+void CXNNetUpdateIter(void *handle, void *data_handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Handle *d = static_cast<Handle *>(data_handle);
+  Py_XDECREF(call(h->obj, "update", Py_BuildValue("(O)", d->obj)));
+}
+
+void CXNNetUpdateBatch(void *handle, const cxn_real_t *pdata,
+                       const cxn_uint64 dshape[4], const cxn_real_t *plabel,
+                       const cxn_uint64 lshape[2]) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *data = np_from_floats(pdata, dshape, 4);
+  PyObject *label = np_from_floats(plabel, lshape, 2);
+  if (!data || !label) { Py_XDECREF(data); Py_XDECREF(label); return; }
+  Py_XDECREF(call(h->obj, "update", Py_BuildValue("(NN)", data, label)));
+}
+
+const cxn_real_t *CXNNetPredictBatch(void *handle, const cxn_real_t *pdata,
+                                     const cxn_uint64 dshape[4],
+                                     cxn_uint64 *out_size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *data = np_from_floats(pdata, dshape, 4);
+  if (!data) return nullptr;
+  cxn_uint64 shp[4] = {0, 1, 1, 1};
+  const cxn_real_t *p = expose(
+      h, call(h->obj, "predict", Py_BuildValue("(N)", data)), shp, nullptr, 4);
+  if (out_size != nullptr) *out_size = shp[0] * shp[1] * shp[2] * shp[3];
+  return p;
+}
+
+const cxn_real_t *CXNNetPredictIter(void *handle, void *data_handle,
+                                    cxn_uint64 *out_size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Handle *d = static_cast<Handle *>(data_handle);
+  cxn_uint64 shp[4] = {0, 1, 1, 1};
+  const cxn_real_t *p = expose(
+      h, call(h->obj, "predict", Py_BuildValue("(O)", d->obj)), shp, nullptr,
+      4);
+  if (out_size != nullptr) *out_size = shp[0] * shp[1] * shp[2] * shp[3];
+  return p;
+}
+
+const cxn_real_t *CXNNetExtractBatch(void *handle, const cxn_real_t *pdata,
+                                     const cxn_uint64 dshape[4],
+                                     const char *node_name,
+                                     cxn_uint64 *out_size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *data = np_from_floats(pdata, dshape, 4);
+  if (!data) return nullptr;
+  cxn_uint64 shp[4] = {0, 1, 1, 1};
+  const cxn_real_t *p = expose(
+      h, call(h->obj, "extract", Py_BuildValue("(Ns)", data, node_name)),
+      shp, nullptr, 4);
+  if (out_size != nullptr) *out_size = shp[0] * shp[1] * shp[2] * shp[3];
+  return p;
+}
+
+const cxn_real_t *CXNNetExtractIter(void *handle, void *data_handle,
+                                    const char *node_name,
+                                    cxn_uint64 *out_size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Handle *d = static_cast<Handle *>(data_handle);
+  cxn_uint64 shp[4] = {0, 1, 1, 1};
+  const cxn_real_t *p = expose(
+      h, call(h->obj, "extract", Py_BuildValue("(Os)", d->obj, node_name)),
+      shp, nullptr, 4);
+  if (out_size != nullptr) *out_size = shp[0] * shp[1] * shp[2] * shp[3];
+  return p;
+}
+
+const char *CXNNetEvaluate(void *handle, void *data_handle,
+                           const char *name) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *arg = data_handle
+      ? Py_BuildValue("(Os)", static_cast<Handle *>(data_handle)->obj, name)
+      : Py_BuildValue("(Os)", Py_None, name);
+  PyObject *r = call(h->obj, "evaluate", arg);
+  if (!r) return nullptr;
+  const char *s = PyUnicode_AsUTF8(r);
+  h->last_str = s ? s : "";
+  Py_DECREF(r);
+  return h->last_str.c_str();
+}
+
+void CXNNetSetWeight(void *handle, const cxn_real_t *pdata, cxn_uint64 size,
+                     const char *layer_name, const char *tag) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  cxn_uint64 shape[1] = {size};
+  PyObject *arr = np_from_floats(pdata, shape, 1);
+  if (!arr) return;
+  Py_XDECREF(call(h->obj, "set_weight",
+                  Py_BuildValue("(Nss)", arr, layer_name, tag)));
+}
+
+const cxn_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *tag, cxn_uint64 *oshape,
+                                  cxn_uint64 *out_ndim) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  return expose(h, call(h->obj, "get_weight",
+                        Py_BuildValue("(ss)", layer_name, tag)),
+                oshape, out_ndim, 4);
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+/* C ABI for the cxxnet_tpu framework — language-binding surface.
+ *
+ * TPU-native equivalent of the reference C wrapper
+ * (/root/reference/wrapper/cxxnet_wrapper.h:36-232): the same CXNNet* /
+ * CXNIO* entry points, but backed by an embedded CPython interpreter running
+ * the JAX trainer instead of the C++ thread trainer. Handles are opaque;
+ * returned buffers stay valid until the next call on the same handle.
+ */
+#ifndef CXXNET_TPU_CAPI_H_
+#define CXXNET_TPU_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef float cxn_real_t;
+typedef unsigned long long cxn_uint64;
+
+/* global interpreter bootstrap; safe to call more than once. repo_path may
+ * be NULL if cxxnet_tpu is already importable. Returns 0 on success. */
+int CXNInit(const char *repo_path);
+/* last error message ("" if none) */
+const char *CXNGetLastError(void);
+
+/* ---- data iterators ---- */
+void *CXNIOCreateFromConfig(const char *cfg);
+int   CXNIONext(void *handle);
+void  CXNIOBeforeFirst(void *handle);
+const cxn_real_t *CXNIOGetData(void *handle, cxn_uint64 *oshape /*[4]*/);
+const cxn_real_t *CXNIOGetLabel(void *handle, cxn_uint64 *oshape /*[2]*/);
+void  CXNIOFree(void *handle);
+
+/* ---- trainer ---- */
+void *CXNNetCreate(const char *device, const char *cfg);
+void  CXNNetFree(void *handle);
+void  CXNNetSetParam(void *handle, const char *name, const char *val);
+void  CXNNetInitModel(void *handle);
+void  CXNNetSaveModel(void *handle, const char *fname);
+void  CXNNetLoadModel(void *handle, const char *fname);
+void  CXNNetStartRound(void *handle, int round_counter);
+void  CXNNetUpdateIter(void *handle, void *data_handle);
+/* batch: row-major (nbatch, c, y, x) data + (nbatch, label_width) labels */
+void  CXNNetUpdateBatch(void *handle, const cxn_real_t *pdata,
+                        const cxn_uint64 dshape[4],
+                        const cxn_real_t *plabel,
+                        const cxn_uint64 lshape[2]);
+const cxn_real_t *CXNNetPredictBatch(void *handle, const cxn_real_t *pdata,
+                                     const cxn_uint64 dshape[4],
+                                     cxn_uint64 *out_size);
+const cxn_real_t *CXNNetPredictIter(void *handle, void *data_handle,
+                                    cxn_uint64 *out_size);
+const cxn_real_t *CXNNetExtractBatch(void *handle, const cxn_real_t *pdata,
+                                     const cxn_uint64 dshape[4],
+                                     const char *node_name,
+                                     cxn_uint64 *out_size);
+const cxn_real_t *CXNNetExtractIter(void *handle, void *data_handle,
+                                    const char *node_name,
+                                    cxn_uint64 *out_size);
+const char *CXNNetEvaluate(void *handle, void *data_handle, const char *name);
+void  CXNNetSetWeight(void *handle, const cxn_real_t *pdata,
+                      cxn_uint64 size, const char *layer_name,
+                      const char *tag);
+const cxn_real_t *CXNNetGetWeight(void *handle, const char *layer_name,
+                                  const char *tag, cxn_uint64 *oshape /*[4]*/,
+                                  cxn_uint64 *out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* CXXNET_TPU_CAPI_H_ */

@@ -1,0 +1,85 @@
+/* Smoke test driving the C ABI end-to-end from pure C: build a tiny MLP,
+ * train it on synthetic separable data, check prediction accuracy and
+ * weight round-tripping. Run from the repo root (or pass repo path):
+ *   ./native/capi_test [repo_path]
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "capi.h"
+
+static const char *CFG =
+    "netconfig=start\n"
+    "layer[+1:fc1] = fullc:fc1\n"
+    "  nhidden = 32\n"
+    "  init_sigma = 0.1\n"
+    "layer[+1] = relu\n"
+    "layer[+1:fc2] = fullc:fc2\n"
+    "  nhidden = 2\n"
+    "  init_sigma = 0.1\n"
+    "layer[+0] = softmax\n"
+    "netconfig=end\n"
+    "input_shape = 1,1,8\n"
+    "batch_size = 32\n"
+    "eta = 0.2\n"
+    "momentum = 0.9\n"
+    "dev = cpu\n";
+
+static void make_batch(unsigned seed, cxn_real_t *data, cxn_real_t *label) {
+  unsigned s = seed * 2654435761u + 1;
+  for (int i = 0; i < 32; ++i) {
+    s = s * 1664525u + 1013904223u;
+    int cls = (s >> 16) & 1;
+    label[i] = (cxn_real_t)cls;
+    for (int j = 0; j < 8; ++j) {
+      s = s * 1664525u + 1013904223u;
+      float noise = ((s >> 8) & 0xffff) / 65536.0f - 0.5f;
+      data[i * 8 + j] = (cls ? 1.0f : -1.0f) + noise;
+    }
+  }
+}
+
+int main(int argc, char **argv) {
+  if (CXNInit(argc > 1 ? argv[1] : ".") != 0) {
+    fprintf(stderr, "CXNInit failed: %s\n", CXNGetLastError());
+    return 1;
+  }
+  void *net = CXNNetCreate("cpu", CFG);
+  if (net == NULL) {
+    fprintf(stderr, "CXNNetCreate failed: %s\n", CXNGetLastError());
+    return 1;
+  }
+  CXNNetInitModel(net);
+
+  cxn_real_t data[32 * 8], label[32];
+  cxn_uint64 dshape[4] = {32, 1, 1, 8}, lshape[2] = {32, 1};
+  for (int step = 0; step < 30; ++step) {
+    make_batch(step, data, label);
+    CXNNetUpdateBatch(net, data, dshape, label, lshape);
+  }
+
+  make_batch(999, data, label);
+  cxn_uint64 n = 0;
+  const cxn_real_t *pred = CXNNetPredictBatch(net, data, dshape, &n);
+  if (pred == NULL || n != 32) {
+    fprintf(stderr, "predict failed (%s)\n", CXNGetLastError());
+    return 1;
+  }
+  int correct = 0;
+  for (int i = 0; i < 32; ++i) correct += (pred[i] == label[i]);
+  printf("capi_test: accuracy %d/32\n", correct);
+  if (correct < 30) return 1;
+
+  cxn_uint64 wshape[4], ndim = 0;
+  const cxn_real_t *w = CXNNetGetWeight(net, "fc1", "wmat", wshape, &ndim);
+  if (w == NULL || ndim != 2 || wshape[0] != 32 || wshape[1] != 8) {
+    fprintf(stderr, "get_weight failed (%s)\n", CXNGetLastError());
+    return 1;
+  }
+  printf("capi_test: fc1 wmat %llu x %llu OK\n",
+         (unsigned long long)wshape[0], (unsigned long long)wshape[1]);
+
+  CXNNetFree(net);
+  printf("capi_test: PASS\n");
+  return 0;
+}

@@ -1,0 +1,128 @@
+"""Wrapper API parity tests (reference wrapper/cxxnet.py:64-307 semantics)
+plus the C ABI smoke test (native/capi_test.c) when a toolchain is present.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu import wrapper
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NET_CFG = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 2
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 32
+eta = 0.2
+momentum = 0.9
+dev = cpu:0
+"""
+
+
+def _xy(seed, n=32):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 2, n)
+    x = (2.0 * y[:, None] - 1.0) + rs.randn(n, 8) * 0.5
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_net_numpy_update_predict_weights(tmp_path):
+    net = wrapper.Net(cfg=NET_CFG)
+    net.init_model()
+    for i in range(30):
+        x, y = _xy(i)
+        net.update(x, y)          # 2-D numpy auto-reshaped to (b,1,1,feat)
+    x, y = _xy(999)
+    pred = net.predict(x)
+    assert (pred == y).mean() > 0.9
+
+    w = net.get_weight("fc1", "wmat")
+    assert w.shape == (32, 8)
+    net.set_weight(np.zeros_like(w), "fc1", "wmat")
+    assert np.all(net.get_weight("fc1", "wmat") == 0)
+
+    # save/load round-trip through the wrapper facade
+    p = str(tmp_path / "m.model")
+    net.save_model(p)
+    net2 = wrapper.Net(cfg=NET_CFG)
+    net2.load_model(p)
+    assert np.all(net2.get_weight("fc1", "wmat") == 0)
+
+
+def test_train_loop_with_mnist_iter(tmp_path, synth_mnist=None):
+    # synthetic idx.gz files via the e2e helpers
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    from test_train_e2e import write_idx_images, write_idx_labels
+    rs = np.random.RandomState(0)
+    protos = rs.rand(4, 64) * 255
+    lab = rs.randint(0, 4, 256)
+    img = np.clip(protos[lab] + rs.randn(256, 64) * 10, 0, 255)
+    write_idx_images(str(tmp_path / "img.gz"),
+                     img.astype(np.uint8).reshape(-1, 8, 8))
+    write_idx_labels(str(tmp_path / "lab.gz"), lab.astype(np.uint8))
+
+    it_cfg = """
+iter = mnist
+    path_img = "%s"
+    path_label = "%s"
+    shuffle = 1
+iter = end
+batch_size = 32
+input_flat = 1
+""" % (tmp_path / "img.gz", tmp_path / "lab.gz")
+    data = wrapper.DataIter(it_cfg)
+    assert data.next()
+    assert data.get_data().shape == (32, 1, 1, 64)
+    assert data.get_label().shape[0] == 32
+
+    net_cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.1
+layer[+1] = sigmoid
+layer[+1:fc2] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 32
+dev = cpu:0
+metric = error
+"""
+    net = wrapper.train(net_cfg, data, 6, {"eta": 0.25, "momentum": 0.9},
+                        eval_data=data)
+    pred = net.predict(data)
+    assert pred.shape[0] == 256
+    feats = net.extract(data, "top[-2]")
+    assert feats.shape[0] == 256
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or shutil.which("cc") is None,
+                    reason="no C toolchain")
+def test_c_abi_end_to_end():
+    native = os.path.join(ROOT, "native")
+    r = subprocess.run(["make", "-C", native, "capi_test"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([os.path.join(native, "capi_test"), ROOT],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
