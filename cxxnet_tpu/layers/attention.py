@@ -120,6 +120,70 @@ class EmbeddingLayer(Layer):
 
 
 @register_layer
+class MoELayer(Layer):
+    """Switch-MoE position-wise FFN on (b, N, 1, F) nodes (ops/moe.py).
+
+    Config: ``nexpert``, ``nhidden`` (per-expert hidden width),
+    ``capacity_factor``, ``moe_aux_weight`` (load-balance loss weight).
+    Weights: "gate" (F, E), "w_up" (E, F, H), "w_down" (E, H, F) — the
+    expert dim is sharded over the ``model`` mesh axis (expert parallelism).
+    """
+    type_name = "moe"
+
+    def __init__(self, spec, cfg):
+        self.nexpert = 0
+        self.capacity_factor = 1.25
+        self.aux_weight = 0.01
+        super().__init__(spec, cfg)
+
+    def set_param(self, name, val):
+        if name == "nexpert":
+            self.nexpert = int(val)
+        elif name == "capacity_factor":
+            self.capacity_factor = float(val)
+        elif name == "moe_aux_weight":
+            self.aux_weight = float(val)
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        c, y, x = self.check_one_to_one(in_shapes)
+        if self.nexpert <= 0 or self.param.num_hidden <= 0:
+            raise ConfigError("moe %r: set nexpert and nhidden"
+                              % self.spec.key())
+        self.feat = c
+        return [(c, y, x)]
+
+    def init_params(self, key, in_shapes):
+        kg, ku, kd = jax.random.split(key, 3)
+        f, e, hid = self.feat, self.nexpert, self.param.num_hidden
+        return {
+            "gate": self.param.rand_init(kg, (f, e), in_num=f, out_num=e),
+            "w_up": self.param.rand_init(ku, (e, f, hid), in_num=f,
+                                         out_num=hid),
+            "w_down": self.param.rand_init(kd, (e, hid, f), in_num=hid,
+                                           out_num=f),
+        }
+
+    def param_axes(self, tag):
+        return {"w_up": (MODEL_AXIS, None, None),
+                "w_down": (MODEL_AXIS, None, None)}.get(tag)
+
+    def apply(self, params, inputs, ctx: ApplyContext):
+        from ..ops.moe import switch_moe
+        x = inputs[0]
+        b, n, _, f = x.shape
+        out, aux = switch_moe(x.reshape(b * n, f), params["gate"],
+                              params["w_up"], params["w_down"],
+                              self.capacity_factor)
+        if ctx.train and self.aux_weight > 0:
+            # divide by update_period so gradient accumulation keeps the
+            # aux:data loss ratio fixed (the CE loss carries the same factor,
+            # loss_layer_base-inl.hpp:61-63 parity in loss.py)
+            ctx.losses.append(self.aux_weight * aux
+                              / max(ctx.update_period, 1))
+        return [out.reshape(b, n, 1, f)]
+
+
+@register_layer
 class AttentionLayer(Layer):
     """Multi-head self-attention on (b, N, 1, F) nodes.
 

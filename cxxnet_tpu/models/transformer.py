@@ -20,8 +20,10 @@ from __future__ import annotations
 
 
 def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
-                      causal: int, mlp_ratio: int = 4) -> None:
-    # position-wise MLP = 1x1 conv on the (b, N, 1, F) node
+                      causal: int, mlp_ratio: int = 4,
+                      moe_experts: int = 0) -> None:
+    # position-wise MLP = 1x1 conv on the (b, N, 1, F) node; with
+    # moe_experts > 0 the MLP becomes a switch-MoE (expert parallelism)
     a, b = "b%da" % i, "b%db" % i
     L.append("layer[%s->%s,%s_r] = split" % (src, a, a))
     L.append("layer[%s->%s] = layer_norm:ln%da" % (a, a, i))
@@ -32,13 +34,18 @@ def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
     L.append("layer[%s,%s_r->%s] = add" % (a, a, b))
     L.append("layer[%s->%s,%s_r] = split" % (b, b, b))
     L.append("layer[%s->%s] = layer_norm:ln%db" % (b, b, i))
-    L.append("layer[%s->%s] = conv:mlp%da" % (b, b, i))
-    L.append("  kernel_size = 1")
-    L.append("  nchannel = %d" % (feat * mlp_ratio))
-    L.append("layer[%s->%s] = relu" % (b, b))
-    L.append("layer[%s->%s] = conv:mlp%db" % (b, b, i))
-    L.append("  kernel_size = 1")
-    L.append("  nchannel = %d" % feat)
+    if moe_experts > 0:
+        L.append("layer[%s->%s] = moe:moe%d" % (b, b, i))
+        L.append("  nexpert = %d" % moe_experts)
+        L.append("  nhidden = %d" % (feat * mlp_ratio))
+    else:
+        L.append("layer[%s->%s] = conv:mlp%da" % (b, b, i))
+        L.append("  kernel_size = 1")
+        L.append("  nchannel = %d" % (feat * mlp_ratio))
+        L.append("layer[%s->%s] = relu" % (b, b))
+        L.append("layer[%s->%s] = conv:mlp%db" % (b, b, i))
+        L.append("  kernel_size = 1")
+        L.append("  nchannel = %d" % feat)
     L.append("layer[%s,%s_r->%s] = add" % (b, b, out))
 
 
@@ -47,7 +54,7 @@ def transformer_config(seq_len: int = 128, vocab_size: int = 256,
                        num_classes: int = 10, causal: int = 0,
                        batch_size: int = 16, dev: str = "",
                        seq_parallel: int = 1, model_parallel: int = 1,
-                       precision: str = "float32",
+                       moe_experts: int = 0, precision: str = "float32",
                        eta: float = 0.05) -> str:
     L = ["netconfig=start"]
     L.append("layer[0->emb] = embedding:emb")
@@ -56,7 +63,8 @@ def transformer_config(seq_len: int = 128, vocab_size: int = 256,
     src = "emb"
     for i in range(nblock):
         out = "blk%d" % i
-        transformer_block(L, src, out, i, feat, nhead, causal)
+        transformer_block(L, src, out, i, feat, nhead, causal,
+                          moe_experts=moe_experts)
         src = out
     L.append("layer[%s->%s] = layer_norm:lnf" % (src, src))
     # mean-pool over the sequence -> (b, 1, 1, feat) -> classifier head

@@ -34,6 +34,7 @@ from ..graph import NetGraph
 from ..layers import ApplyContext, create_layer
 from ..layers.base import Layer
 from ..metrics import MetricSet
+from ..parallel.distributed import global_batch, init_distributed
 from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
 from ..parallel.sharding import resolve_shardings
 from ..updaters import create_updater
@@ -140,7 +141,9 @@ class Net:
             for ni, s in zip(spec.outputs, out_shapes):
                 self.node_shapes[ni] = s
 
-        # mesh for SPMD execution
+        # join the multi-host runtime first (no-op single-host), then build
+        # the mesh over the now-global device set
+        init_distributed()
         self.mesh = make_mesh(self.dev, self.model_parallel,
                               self.seq_parallel)
         self.n_data_shards = self.mesh.shape["data"]
@@ -339,14 +342,16 @@ class Net:
         self.round = r
 
     def _device_batch(self, batch):
-        """Move a host DataBatch to the mesh (data-axis sharded)."""
+        """Move a host DataBatch to the mesh (data-axis sharded). Multi-host:
+        each process contributes its local slice of the global batch
+        (parallel/distributed.py)."""
         sh = batch_sharding(self.mesh)
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        data = jax.device_put(np.asarray(batch.data, np.float32), sh)
+        data = global_batch(self.mesh, sh, np.asarray(batch.data, np.float32))
         if self.precision == "bfloat16":
             data = data.astype(dtype)
-        label = jax.device_put(np.asarray(batch.label, np.float32), sh)
-        extras = [jax.device_put(np.asarray(e, np.float32), sh)
+        label = global_batch(self.mesh, sh, np.asarray(batch.label, np.float32))
+        extras = [global_batch(self.mesh, sh, np.asarray(e, np.float32))
                   for e in batch.extra_data]
         return data, extras, label
 
@@ -357,7 +362,7 @@ class Net:
             b = batch.data.shape[0]
             mask = np.ones((b,), np.float32)
             mask[b - batch.num_batch_padd:] = 0.0
-            return jax.device_put(mask, batch_sharding(self.mesh))
+            return global_batch(self.mesh, batch_sharding(self.mesh), mask)
         return None
 
     def update(self, batch) -> None:

@@ -1,0 +1,64 @@
+"""Switch-style mixture-of-experts with expert parallelism.
+
+No reference counterpart (SURVEY §2.7 lists expert parallelism as
+to-be-designed-fresh). TPU-first shape: the GShard dispatch/combine einsum
+formulation — top-1 routing, bounded per-expert capacity, overflow tokens
+dropped (pass through the residual), auxiliary load-balancing loss. The
+expert dim of every tensor is sharded over a mesh axis (default ``model``)
+with ordinary NamedShardings; GSPMD partitions the dispatch/combine einsums
+into the all-to-all exchanges that a hand-written expert-parallel backend
+would issue, and the per-expert FFN batch rides the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def switch_moe(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
+               w_down: jnp.ndarray, capacity_factor: float = 1.25,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 MoE FFN.
+
+    x: (S, D) tokens; w_gate: (D, E); w_up: (E, D, H); w_down: (E, H, D).
+    Returns (out (S, D), aux_loss scalar). Tokens beyond an expert's
+    capacity ``ceil(S/E * capacity_factor)`` contribute zero (caller keeps
+    the residual path).
+    """
+    s, d = x.shape
+    e = w_gate.shape[1]
+    capacity = max(1, math.ceil(s / e * capacity_factor))
+
+    logits = (x @ w_gate.astype(x.dtype)).astype(jnp.float32)   # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                     # (S,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)   # (S, E)
+    gate = (probs * onehot).sum(-1)                             # (S,)
+
+    # position of each token within its expert's queue; >= capacity -> drop
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # (S, E)
+    keep = (pos < capacity) * onehot
+    pos = jnp.clip(pos.sum(-1).astype(jnp.int32), 0, capacity - 1)  # (S,)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)   # (S, C)
+
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]            # (S, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    xin = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin,
+                               w_up.astype(x.dtype)))
+    out_e = jnp.einsum("ech,ehd->ecd", h, w_down.astype(x.dtype))
+    out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_e)
+
+    # switch-transformer load-balancing loss: E * sum_e f_e * p_e
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out, aux
+
+
+__all__ = ["switch_moe"]

@@ -1,0 +1,82 @@
+"""Multi-host (multi-process) runtime — the dist-PS replacement.
+
+Reference counterpart: the ps-lite worker/server deployment
+(/root/reference/src/nnet/nnet_ps_server.cpp, mpi.conf) where each worker
+process trained on its dataset shard and gradients met on parameter servers.
+TPU-native shape: one JAX process per host, all chips joined into one global
+mesh by ``jax.distributed``; gradients meet in XLA collectives over ICI/DCN
+(no servers). The data side keeps the reference's contract — each process
+reads only its shard (``dist_worker_rank``/``dist_num_worker``, imgbin.py) —
+and per-host batches are assembled into one global sharded array with
+``jax.make_array_from_process_local_data``.
+
+Environment variables (launcher-agnostic, the mpi.conf analogue):
+  CXXNET_COORDINATOR  host:port of process 0
+  CXXNET_NUM_WORKER   total process count
+  CXXNET_RANK         this process's index
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the global runtime. No-op when single-process (nothing
+    configured) or already initialized. Arguments fall back to the
+    CXXNET_* environment variables."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("CXXNET_COORDINATOR", "")
+    if num_processes is None:
+        num_processes = int(os.environ.get("CXXNET_NUM_WORKER", "0") or 0)
+    if process_id is None:
+        pid = os.environ.get("CXXNET_RANK", "")
+        process_id = int(pid) if pid else None
+    if not coordinator or num_processes <= 1:
+        return                      # single-host run, nothing to join
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def global_batch(mesh: Mesh, sharding: NamedSharding,
+                 host_local: np.ndarray) -> jax.Array:
+    """Assemble one *global* array from this process's local batch slice.
+
+    Single-process: plain ``device_put``. Multi-host: each process passes its
+    local rows (global batch = concat over processes in process order) and
+    gets back a handle to the global array, with only local shards resident
+    — the input-pipeline contract of the reference's per-rank .lst shards
+    (iter_thread_imbin_x-inl.hpp:119-130) mapped onto jax process semantics.
+    """
+    if not is_multi_host():
+        return jax.device_put(host_local, sharding)
+    return jax.make_array_from_process_local_data(sharding, host_local)
+
+
+__all__ = ["init_distributed", "process_index", "process_count",
+           "is_multi_host", "global_batch"]

@@ -52,6 +52,19 @@ class DataIter:
             raise RuntimeError("DataIter: no valid batch "
                                "(call next() and check its result)")
 
+    def close(self) -> None:
+        """Stop prefetch threads and release buffers (safe to call twice)."""
+        if self._iter is not None and hasattr(self._iter, "close"):
+            self._iter.close()
+        self._iter = None
+        self._valid = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     @property
     def batch(self) -> DataBatch:
         self.check_valid()

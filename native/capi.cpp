@@ -121,19 +121,30 @@ extern "C" {
 const char *CXNGetLastError(void) { return g_last_error.c_str(); }
 
 int CXNInit(const char *repo_path) {
-  if (!Py_IsInitialized()) Py_InitializeEx(0);
-  Gil gil;
-  if (g_wrapper_module != nullptr) return 0;
-  if (repo_path != nullptr && repo_path[0] != '\0') {
-    PyObject *sys_path = PySys_GetObject("path");   // borrowed
-    PyObject *p = PyUnicode_FromString(repo_path);
-    PyList_Insert(sys_path, 0, p);
-    Py_DECREF(p);
+  bool fresh = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    fresh = true;
   }
-  g_np_module = PyImport_ImportModule("numpy");
-  if (!g_np_module) { set_error_from_python(); return -1; }
-  g_wrapper_module = PyImport_ImportModule("cxxnet_tpu.wrapper");
-  if (!g_wrapper_module) { set_error_from_python(); return -1; }
+  {
+    Gil gil;
+    if (g_wrapper_module == nullptr) {
+      if (repo_path != nullptr && repo_path[0] != '\0') {
+        PyObject *sys_path = PySys_GetObject("path");   // borrowed
+        PyObject *p = PyUnicode_FromString(repo_path);
+        PyList_Insert(sys_path, 0, p);
+        Py_DECREF(p);
+      }
+      g_np_module = PyImport_ImportModule("numpy");
+      if (!g_np_module) { set_error_from_python(); return -1; }
+      g_wrapper_module = PyImport_ImportModule("cxxnet_tpu.wrapper");
+      if (!g_wrapper_module) { set_error_from_python(); return -1; }
+    }
+  }
+  // Py_InitializeEx leaves this thread holding the GIL; release it so other
+  // threads' PyGILState_Ensure calls can proceed (the embedder never needs
+  // the GIL between CXN* calls)
+  if (fresh) PyEval_SaveThread();
   return 0;
 }
 
@@ -179,7 +190,9 @@ const cxn_real_t *CXNIOGetLabel(void *handle, cxn_uint64 *oshape) {
 
 void CXNIOFree(void *handle) {
   Gil gil;
-  delete static_cast<Handle *>(handle);
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(call(h->obj, "close", nullptr));  // stop prefetch threads
+  delete h;
 }
 
 /* ---------------- trainer ---------------- */
