@@ -48,6 +48,7 @@ class LearnTask:
         self.max_round = 1 << 30
         self.silent = 0
         self.test_io = 0
+        self.profile_dir = ""     # 'profile = <dir>': xplane trace dir
         self.extract_node_name = ""
         self.output_format = 1
         self.name_pred = "pred.txt"
@@ -82,6 +83,8 @@ class LearnTask:
             self.task = val
         elif name == "test_io":
             self.test_io = int(val)
+        elif name == "profile":
+            self.profile_dir = val
         elif name == "extract_node_name":
             self.extract_node_name = val
         elif name == "output_format":
@@ -216,6 +219,21 @@ class LearnTask:
                                          "%04d.model" % self.start_counter))
 
     def task_train(self) -> None:
+        # real tracing is the SURVEY §5.1 upgrade over the reference's
+        # wall-clock prints: 'profile = <dir>' captures an xplane trace of
+        # the training task, viewable in TensorBoard/XProf
+        if self.profile_dir:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+        try:
+            self._task_train()
+        finally:
+            if self.profile_dir:
+                import jax
+                jax.profiler.stop_trace()
+                print("profile: xplane trace written to %s" % self.profile_dir)
+
+    def _task_train(self) -> None:
         start = time.time()
         if self.continue_training == 0 and self.model_in == "NULL":
             pass      # fresh start

@@ -20,7 +20,7 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import full_attention, ring_attention
+from ..ops.attention import local_attention, ring_attention
 from ..parallel.mesh import MODEL_AXIS, SEQ_AXIS
 from ..utils.config import ConfigError
 from .base import ApplyContext, Layer, Params, Shape3, register_layer
@@ -249,7 +249,7 @@ class AttentionLayer(Layer):
             out = ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS,
                                  causal=bool(self.causal))
         else:
-            out = full_attention(q, k, v, causal=bool(self.causal))
+            out = local_attention(q, k, v, causal=bool(self.causal))
         out = out.reshape(b, n, f) @ params["proj"].astype(x.dtype).T
         if "proj_bias" in params:
             out = out + params["proj_bias"].astype(out.dtype)

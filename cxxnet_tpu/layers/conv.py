@@ -229,8 +229,16 @@ class LRNLayer(Layer):
         return [self.check_one_to_one(in_shapes)]
 
     def apply(self, params, inputs, ctx):
+        # the Pallas fused LRN is opt-in (CXN_PALLAS_LRN=1): measured on
+        # v5e, XLA's reduce_window fusion wins for AlexNet's 96/256-channel
+        # maps (50.8k vs 41.9k img/s) because the channel dim misaligns the
+        # 128-lane tiles; the kernel pays off only for 128-multiple channels
+        import os
+        from ..ops.pallas_kernels import lrn_fused, use_pallas
         x = inputs[0]
         n = self.nsize
+        if use_pallas() and os.environ.get("CXN_PALLAS_LRN", "") == "1":
+            return [lrn_fused(x, n, self.alpha, self.beta, self.knorm)]
         pad_lo = (n - 1) // 2
         sq_sum = jax.lax.reduce_window(
             x * x, 0.0, jax.lax.add, (1, 1, 1, n), (1, 1, 1, 1),

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import full_attention, ring_attention_inner
+from ..ops.attention import local_attention, ring_attention_inner
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                              batch_sharding)
 from ..parallel.pipeline import gpipe
@@ -71,7 +71,7 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
     if use_ring:
         att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
     else:
-        att = full_attention(q, k, v, causal=True)
+        att = local_attention(q, k, v, causal=True)
     o = att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype)
     # row-sharded matmul: psum combines the per-rank partial sums; on a
     # size-1 model axis this is the identity (and demotes the vma type)

@@ -52,6 +52,17 @@ def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(v.dtype)
 
 
+def local_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
+    """Single-device attention dispatch: the Pallas flash kernel (O(N) memory,
+    ops/pallas_kernels.py) for long block-aligned sequences on TPU, else the
+    exact XLA formulation."""
+    from .pallas_kernels import flash_attention, use_pallas
+    n = q.shape[1]
+    if use_pallas() and n >= 512 and n % 256 == 0:
+        return flash_attention(q, k, v, causal)
+    return full_attention(q, k, v, causal=causal)
+
+
 def _block(q, k, v, o, m, l, causal, q_off, k_off):
     """One online-softmax accumulation step over a K/V block.
 
@@ -143,4 +154,5 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          out_specs=spec)(q, k, v)
 
 
-__all__ = ["full_attention", "ring_attention", "ring_attention_inner"]
+__all__ = ["full_attention", "local_attention", "ring_attention",
+           "ring_attention_inner"]

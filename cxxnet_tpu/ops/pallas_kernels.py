@@ -1,0 +1,224 @@
+"""Pallas TPU kernels for the hot ops.
+
+Two kernels, both with CPU interpret-mode fallback for differential testing
+(the PairTest philosophy, SURVEY §4.1 — Pallas vs XLA-reference numerics):
+
+- **fused LRN** (reference chpool LRN, lrn_layer-inl.hpp:46-57): one VMEM
+  pass computes x², the cross-channel window sum (lane-dim shifts — the
+  window is tiny, n<=7 in practice), the power, and the product. XLA's
+  reduce_window formulation round-trips HBM between the squaring, window
+  reduction, and scaling; the fused kernel is one read + one write.
+- **flash attention** (forward): O(N) memory exact attention for a single
+  device — the in-chip complement of ring attention (which bounds memory
+  *across* chips). Online softmax over K/V tiles held in VMEM, queries
+  blocked over the grid. Backward uses the standard recompute-by-block
+  custom VJP.
+
+Use ``use_pallas()`` to gate: True on TPU backends, else the jnp reference
+paths in the callers stay active.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_INTERPRET = False      # flipped by tests on CPU
+
+
+def use_pallas() -> bool:
+    if _INTERPRET:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fused LRN
+# ---------------------------------------------------------------------------
+
+def _lrn_kernel(x_ref, o_ref, *, n: int, alpha: float, beta: float,
+                knorm: float):
+    x = x_ref[:].astype(jnp.float32)            # (TR, C)
+    sq = x * x
+    c = x.shape[-1]
+    pad_lo = (n - 1) // 2
+    acc = sq
+    # window sum via lane shifts; window offsets relative to pad_lo-centering
+    for off in range(n):
+        d = off - pad_lo
+        if d == 0:
+            continue    # the center term is the initial acc
+        shifted = jnp.roll(sq, -d, axis=-1)
+        # zero the wrapped lanes
+        idx = jax.lax.broadcasted_iota(jnp.int32, sq.shape, 1)
+        if d > 0:
+            mask = idx < (c - d)
+        else:
+            mask = idx >= (-d)
+        acc = acc + jnp.where(mask, shifted, 0.0)
+    norm = knorm + (alpha / n) * acc
+    o_ref[:] = (x * norm ** (-beta)).astype(o_ref.dtype)
+
+
+def _lrn_reference(x, n, alpha, beta, knorm):
+    """XLA reduce_window formulation (the differentiable reference)."""
+    pad_lo = (n - 1) // 2
+    sq = jax.lax.reduce_window(
+        x * x, 0.0, jax.lax.add, (1,) * (x.ndim - 1) + (n,),
+        (1,) * x.ndim, ((0, 0),) * (x.ndim - 1) + ((pad_lo, n - 1 - pad_lo),))
+    return x * (knorm + (alpha / n) * sq) ** (-beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_fused(x: jnp.ndarray, n: int, alpha: float, beta: float,
+              knorm: float, row_tile: int = 256) -> jnp.ndarray:
+    """Fused LRN over the channel (last) dim of NHWC ``x``. Forward is one
+    Pallas VMEM pass; backward autodiffs the reference formula (recompute —
+    LRN inputs are activations the caller usually keeps anyway)."""
+    return _lrn_fused_impl(x, n, alpha, beta, knorm, row_tile)
+
+
+def _lrn_fwd(x, n, alpha, beta, knorm, row_tile):
+    return _lrn_fused_impl(x, n, alpha, beta, knorm, row_tile), x
+
+
+def _lrn_bwd(n, alpha, beta, knorm, row_tile, x, g):
+    _, vjp = jax.vjp(lambda a: _lrn_reference(a, n, alpha, beta, knorm), x)
+    return vjp(g)
+
+
+def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
+                    knorm: float, row_tile: int = 256) -> jnp.ndarray:
+    shape = x.shape
+    c = shape[-1]
+    rows = 1
+    for d in shape[:-1]:
+        rows *= d
+    x2 = x.reshape(rows, c)
+    tile = min(row_tile, rows)
+    # pad rows to a tile multiple (XLA pads/unpads around the call)
+    pad = (-rows) % tile
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    kern = functools.partial(_lrn_kernel, n=n, alpha=alpha, beta=beta,
+                             knorm=knorm)
+    out = pl.pallas_call(
+        kern,
+        grid=((rows + pad) // tile,),
+        in_specs=[pl.BlockSpec((tile, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(((rows + pad), c), x.dtype),
+        interpret=_INTERPRET,
+    )(x2)
+    if pad:
+        out = out[:rows]
+    return out.reshape(shape)
+
+
+lrn_fused.defvjp(_lrn_fwd, _lrn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# flash attention (forward kernel + recompute VJP)
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    # q_ref: (1, 1, TQ, D) one (batch*head, q-block); k/v: (1, 1, N, D)
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # (TQ, D)
+    tq, d = q.shape
+    n = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q0 = qi * tq
+
+    def body(s, carry):
+        o, m, l = carry
+        k = k_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        sc = q @ k.T                                   # (TQ, BK)
+        if causal:
+            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+            kpos = s * block_k + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+        m_new = jnp.maximum(m, sc.max(-1))
+        p = jnp.exp(sc - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[:, None] + p @ v
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((tq, d), jnp.float32)
+    m0 = jnp.full((tq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((tq,), jnp.float32)
+    n_blocks = n // block_k
+    if causal:
+        # skip fully-masked K blocks past the diagonal
+        n_run = jnp.minimum(n_blocks, (q0 + tq + block_k - 1) // block_k)
+    else:
+        n_run = n_blocks
+    o, m, l = jax.lax.fori_loop(0, n_run, body, (o0, m0, l0))
+    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    # (b, h, n, d) layout: the kernel grid walks (batch, head, q-block)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    bq = min(block_q, n)
+    bk = min(block_k, n)
+    kern = functools.partial(_flash_kernel, block_k=bk, causal=causal,
+                             scale=scale)
+    out = pl.pallas_call(
+        kern,
+        grid=(b, h, n // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        interpret=_INTERPRET,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
+                    block_k: int = 256):
+    """Exact attention, O(N) memory. q,k,v: (batch, seq, heads, head_dim);
+    seq must divide by the block sizes (clamped to seq)."""
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    # recompute-based backward through the reference math; still O(N^2) time
+    # but the forward's O(N) memory is what matters at inference/activation-
+    # checkpointed training (the checkpointed recompute IS this)
+    from .attention import full_attention
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: full_attention(a, b, c, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+__all__ = ["use_pallas", "lrn_fused", "flash_attention"]

@@ -1,0 +1,98 @@
+"""Pallas kernels vs XLA reference numerics (interpret mode on CPU) —
+the PairTest idea applied to custom kernels (SURVEY §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import cxxnet_tpu.ops.pallas_kernels as pk
+from cxxnet_tpu.ops.attention import full_attention
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode():
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    yield
+    pk._INTERPRET = old
+
+
+def _lrn_ref(x, n, alpha, beta, knorm):
+    pad_lo = (n - 1) // 2
+    sq = jax.lax.reduce_window(
+        x * x, 0.0, jax.lax.add, (1, 1, 1, n), (1, 1, 1, 1),
+        ((0, 0), (0, 0), (0, 0), (pad_lo, n - 1 - pad_lo)))
+    return x * (knorm + (alpha / n) * sq) ** (-beta)
+
+
+@pytest.mark.parametrize("n", [3, 5])
+def test_lrn_fused_matches_reduce_window(n):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 4, 4, 16).astype(np.float32))
+    ref = _lrn_ref(x, n, 1e-4, 0.75, 1.0)
+    out = pk.lrn_fused(x, n, 1e-4, 0.75, 1.0, row_tile=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_fused_row_padding():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(3, 5, 7, 8).astype(np.float32))  # 105 rows
+    ref = _lrn_ref(x, 5, 2e-4, 0.5, 2.0)
+    out = pk.lrn_fused(x, 5, 2e-4, 0.5, 2.0, row_tile=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_full(causal):
+    rs = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rs.randn(2, 32, 2, 8).astype(np.float32))
+               for _ in range(3))
+    ref = full_attention(q, k, v, causal=causal)
+    out = pk.flash_attention(q, k, v, causal, 8, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_gradients():
+    rs = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
+               for _ in range(3))
+    g_ref = jax.grad(lambda a, b, c: (
+        full_attention(a, b, c, causal=True) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda a, b, c: (
+        pk.flash_attention(a, b, c, True, 8, 8) ** 2).sum(), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_lrn_fused_gradients_match_reference():
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 3, 3, 8).astype(np.float32))
+    g_ref = jax.grad(lambda a: (_lrn_ref(a, 5, 1e-4, 0.75, 2.0) ** 2).sum())(x)
+    g_out = jax.grad(lambda a: (pk.lrn_fused(a, 5, 1e-4, 0.75, 2.0, 8) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_out), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_layer_uses_pallas_when_enabled():
+    """The lrn layer must route through the fused kernel under the gate and
+    still produce reference numerics (PairTest-style)."""
+    from cxxnet_tpu.layers import create_layer
+    from cxxnet_tpu.graph import LayerSpec
+    from cxxnet_tpu.layers.base import ApplyContext
+    spec = LayerSpec("lrn", "l", [0], [1])
+    layer = create_layer(spec, [("local_size", "5"), ("alpha", "0.001"),
+                                ("beta", "0.75"), ("knorm", "2.0")])
+    layer.infer_shapes([(8, 4, 4)])
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(2, 4, 4, 8).astype(np.float32))
+    ctx = ApplyContext(train=False, rng=None)
+    out_pallas = layer.apply({}, [x], ctx)[0]       # _INTERPRET fixture on
+    pk._INTERPRET = False                            # force jnp path on CPU
+    out_ref = layer.apply({}, [x], ctx)[0]
+    np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(out_ref),
+                               rtol=1e-5, atol=1e-6)
