@@ -42,6 +42,8 @@ KNOWN_LAYER_TYPES = frozenset([
     "batch_norm", "share",
     # sequence/long-context extensions (no reference counterpart, SURVEY §5.7)
     "attention", "layer_norm", "add", "embedding", "moe",
+    # external-framework adapter plugin (caffe_adapter-inl.hpp analogue)
+    "torch",
 ])
 
 

@@ -8,6 +8,8 @@ from . import conv     # noqa: F401  (registers conv/pooling/lrn/batch_norm)
 from . import loss     # noqa: F401  (registers softmax/l2_loss/multi_logistic)
 from . import pairtest  # noqa: F401  (registers the differential-test layer)
 from . import attention  # noqa: F401  (registers attention/layer_norm/add/embedding)
+from . import plugin_torch  # noqa: F401  (registers the torch adapter plugin;
+#                             torch itself is imported lazily on first use)
 
 __all__ = ["ApplyContext", "Layer", "LayerParam", "LAYER_REGISTRY",
            "create_layer", "register_layer"]

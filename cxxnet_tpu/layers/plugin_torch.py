@@ -1,0 +1,224 @@
+"""External-framework plugin layer: wrap a ``torch.nn`` module as a Layer.
+
+Capability parity with the reference's caffe adapter plugin
+(/root/reference/src/plugin/caffe_adapter-inl.hpp:26-231): there, a
+``caffe::Layer`` built from a prototxt config string runs inside an ILayer
+with node data copied into caffe Blobs and its weights exposed as ``blob%d``
+through the visitor, so an external framework's op can serve as a production
+layer or as a pairtest oracle. Here the external framework is torch (CPU):
+the module is built from a ``module = <expr>`` config string evaluated in
+the ``torch.nn`` namespace, its forward/backward run on the host through
+``jax.pure_callback`` under a ``custom_vjp`` (backward = ``torch.autograd``),
+and its parameters surface in the param tree as ``blob0..blobN``.
+
+Layout bridging: runtime nodes are NHWC (matrix nodes ``(b,1,1,len)``); the
+adapter hands torch NCHW (or 2-D) tensors and converts back, like the
+adapter's Blob copies (caffe_adapter-inl.hpp:96-148).
+
+Pairtest interop (§4.1/§4.2 of SURVEY.md — external oracle): by default
+parameters are named ``blob%d``; ``param_names = wmat,bias`` renames them in
+``named_parameters()`` order and ``hwio = 1`` exposes 4-D weights in HWIO
+(converting to torch's OIHW internally), so ``pairtest-fullc-torch`` and
+``pairtest-conv-torch`` share one parameter set with the native layer.
+
+Limits (documented deviations): the module must be deterministic for
+training (torch's own RNG is invisible to JAX, and backward re-runs the
+forward — modules like nn.Dropout would resample); buffers (e.g. BN running
+stats) live as host-side module state, not in the functional state tree.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.config import ConfigError
+from .base import ApplyContext, Layer, Params, Shape3, register_layer
+
+_TORCH_LOCK = threading.Lock()
+
+
+def _import_torch():
+    try:
+        import torch
+        return torch
+    except Exception as e:                      # pragma: no cover
+        raise ConfigError("torch plugin layer requires torch: %s" % e)
+
+
+def _build_module(expr: str):
+    torch = _import_torch()
+    ns = {"torch": torch, "nn": torch.nn}
+    ns.update({k: v for k, v in vars(torch.nn).items()
+               if not k.startswith("_")})
+    try:
+        module = eval(expr, {"__builtins__": {}}, ns)   # config-author's code,
+    except Exception as e:                              # like the prototxt string
+        raise ConfigError("torch plugin: cannot build module from %r: %s"
+                          % (expr, e))
+    if not isinstance(module, torch.nn.Module):
+        raise ConfigError("torch plugin: %r is not an nn.Module" % expr)
+    return module.float()
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _torch_call(layer, train, x, *blobs):
+    y, _ = _torch_call_fwd(layer, train, x, *blobs)
+    return y
+
+
+def _torch_call_fwd(layer, train, x, *blobs):
+    out_sd = jax.ShapeDtypeStruct(
+        (x.shape[0],) + layer._out_torch_tail, jnp.float32)
+    y = jax.pure_callback(partial(layer._host_forward, train), out_sd,
+                          x, *blobs, vmap_method="sequential")
+    return y, (x, blobs)
+
+
+def _torch_call_bwd(layer, train, res, gy):
+    x, blobs = res
+    out_sd = tuple(jax.ShapeDtypeStruct(t.shape, jnp.float32)
+                   for t in (x,) + blobs)
+    grads = jax.pure_callback(partial(layer._host_backward, train), out_sd,
+                              x, gy, *blobs, vmap_method="sequential")
+    return grads
+
+
+_torch_call.defvjp(_torch_call_fwd, _torch_call_bwd)
+
+
+@register_layer
+class TorchPluginLayer(Layer):
+    type_name = "torch"
+
+    def __init__(self, spec, cfg):
+        self.module_expr = ""
+        self.custom_names: List[str] = []
+        self.hwio = 0
+        super().__init__(spec, cfg)
+        if not self.module_expr:
+            raise ConfigError("torch layer %r: must set module" % spec.key())
+        self.module = _build_module(self.module_expr)
+        self._names = [n for n, _ in self.module.named_parameters()]
+        if self.custom_names:
+            if len(self.custom_names) != len(self._names):
+                raise ConfigError(
+                    "torch layer %r: param_names has %d names, module has %d "
+                    "parameters" % (spec.key(), len(self.custom_names),
+                                    len(self._names)))
+            self._exposed = list(self.custom_names)
+        else:
+            self._exposed = ["blob%d" % i for i in range(len(self._names))]
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "module":
+            self.module_expr = val
+        elif name == "param_names":
+            self.custom_names = [s.strip() for s in val.split(",") if s.strip()]
+        elif name == "hwio":
+            self.hwio = int(val)
+
+    # ------------------------------------------------------------ shapes
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        torch = _import_torch()
+        c, y, x = self.check_one_to_one(in_shapes)
+        # matrix nodes are logically (1, 1, len) (layer.h:30-71 convention)
+        self._matrix_in = (c == 1 and y == 1)
+        tin = (x,) if self._matrix_in else (c, y, x)
+        with torch.no_grad():
+            self.module.eval()
+            try:
+                out = self.module(torch.zeros((2,) + tin))
+            except Exception as e:
+                raise ConfigError(
+                    "torch layer %r: dry forward on input %r failed: %s"
+                    % (self.spec.key(), (2,) + tin, e))
+        if out.dim() == 4:
+            _, oc, oy, ox = out.shape
+            out_shape = (int(oc), int(oy), int(ox))
+        elif out.dim() == 2:
+            out_shape = (1, 1, int(out.shape[1]))
+        else:
+            raise ConfigError("torch layer %r: unsupported output rank %d"
+                              % (self.spec.key(), out.dim()))
+        self._matrix_out = out.dim() == 2
+        # callback-result tail in torch layout (batch prepended at trace time)
+        self._out_torch_tail = ((out_shape[2],) if self._matrix_out
+                                else tuple(int(d) for d in out.shape[1:]))
+        return [out_shape]
+
+    def init_params(self, key: jax.Array, in_shapes: List[Shape3]) -> Params:
+        torch = _import_torch()
+        seed = int(np.asarray(jax.random.randint(key, (), 0, 2**31 - 1)))
+        with _TORCH_LOCK:
+            torch.manual_seed(seed)
+            for m in self.module.modules():
+                if hasattr(m, "reset_parameters"):
+                    m.reset_parameters()
+            blobs = [p.detach().numpy().copy()
+                     for _, p in self.module.named_parameters()]
+        out: Params = {}
+        for name, b in zip(self._exposed, blobs):
+            if self.hwio and b.ndim == 4:
+                b = b.transpose(2, 3, 1, 0)      # OIHW -> HWIO exposure
+            out[name] = jnp.asarray(b, jnp.float32)
+        return out
+
+    # ------------------------------------------------------------ forward
+    def apply(self, params: Params, inputs: List[jnp.ndarray],
+              ctx: ApplyContext) -> List[jnp.ndarray]:
+        x = inputs[0]
+        dtype = x.dtype
+        if self._matrix_in:
+            tx = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        else:
+            tx = jnp.transpose(x, (0, 3, 1, 2)).astype(jnp.float32)  # NHWC->NCHW
+        blobs = []
+        for name in self._exposed:
+            b = params[name].astype(jnp.float32)
+            if self.hwio and b.ndim == 4:
+                b = jnp.transpose(b, (3, 2, 0, 1))   # HWIO -> torch OIHW
+            blobs.append(b)
+        y = _torch_call(self, bool(ctx.train), tx, *blobs)
+        if self._matrix_out:
+            y = y.reshape(y.shape[0], 1, 1, -1)
+        else:
+            y = jnp.transpose(y, (0, 2, 3, 1))       # NCHW -> NHWC
+        return [y.astype(dtype)]
+
+    # ------------------------------------------------------------ host side
+    def _functional_forward(self, train: bool, x_np, blob_nps, need_grad: bool):
+        torch = _import_torch()
+        xt = torch.from_numpy(np.ascontiguousarray(x_np, np.float32))
+        xt.requires_grad_(need_grad)
+        pdict = {}
+        for name, b in zip(self._names, blob_nps):
+            t = torch.from_numpy(np.ascontiguousarray(b, np.float32))
+            t.requires_grad_(need_grad)
+            pdict[name] = t
+        self.module.train(bool(train))
+        y = torch.func.functional_call(self.module, pdict, (xt,))
+        return xt, pdict, y
+
+    def _host_forward(self, train, x_np, *blob_nps):
+        torch = _import_torch()
+        with _TORCH_LOCK, torch.no_grad():
+            _, _, y = self._functional_forward(train, x_np, blob_nps, False)
+        return np.asarray(y.detach().numpy(), np.float32)
+
+    def _host_backward(self, train, x_np, gy_np, *blob_nps):
+        torch = _import_torch()
+        with _TORCH_LOCK:
+            xt, pdict, y = self._functional_forward(train, x_np, blob_nps, True)
+            gy = torch.from_numpy(np.ascontiguousarray(gy_np, np.float32))
+            leaves = [xt] + list(pdict.values())
+            grads = torch.autograd.grad(y, leaves, grad_outputs=gy,
+                                        allow_unused=True)
+        return tuple(np.zeros(l.shape, np.float32) if g is None
+                     else np.asarray(g.detach().numpy(), np.float32)
+                     for l, g in zip(leaves, grads))

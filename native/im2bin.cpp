@@ -1,0 +1,160 @@
+// im2bin — pack images listed in a .lst file into a BinaryPage .bin dataset.
+//
+// Native counterpart of tools/im2bin.py, capability parity with the
+// reference tool (/root/reference/tools/im2bin.cpp:1-67). Output is
+// format-compatible with cxxnet_tpu.io.binpage and reference .bin files:
+// 64MB pages of little-endian int32 words, word 0 = object count N,
+// words 1..N+1 = cumulative byte end-offsets, payloads packed backward
+// from the end of the page.
+//
+// Usage: im2bin image.lst image_root_dir output_file
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kPageWords = 64 << 18;          // 1 << 24 int32 words
+constexpr int64_t kPageBytes = kPageWords * 4;    // 64 MB
+
+class PageWriter {
+ public:
+  explicit PageWriter(FILE* out)
+      : out_(out), buf_(kPageBytes, 0), ends_(1, 0) {}
+
+  bool Push(const std::vector<unsigned char>& obj) {
+    const int64_t len = static_cast<int64_t>(obj.size());
+    if (len + 12 > kPageBytes) return false;      // can never fit
+    if (FreeBytes() < len + 4) Flush();
+    const int32_t new_end = ends_.back() + static_cast<int32_t>(len);
+    if (len > 0)
+      std::memcpy(buf_.data() + kPageBytes - new_end, obj.data(), obj.size());
+    ends_.push_back(new_end);
+    ++n_objects;
+    return true;
+  }
+
+  void Close() {
+    if (ends_.size() > 1) Flush();
+  }
+
+  int64_t n_objects = 0;
+  int64_t n_pages = 0;
+
+ private:
+  int64_t FreeBytes() const {
+    // header = count word + (N existing + 1 new + 1 sentinel) offset words
+    const int64_t n = static_cast<int64_t>(ends_.size()) - 1;
+    return (kPageWords - (n + 2)) * 4 - ends_.back();
+  }
+
+  void Flush() {
+    int32_t head[1] = {static_cast<int32_t>(ends_.size() - 1)};
+    std::memcpy(buf_.data(), head, 4);
+    std::memcpy(buf_.data() + 4, ends_.data(), ends_.size() * 4);
+    if (std::fwrite(buf_.data(), 1, kPageBytes, out_) !=
+        static_cast<size_t>(kPageBytes)) {
+      std::fprintf(stderr, "im2bin: short write to output file\n");
+      std::exit(1);
+    }
+    std::fill(buf_.begin(), buf_.end(), 0);
+    ends_.assign(1, 0);
+    ++n_pages;
+  }
+
+  FILE* out_;
+  std::vector<char> buf_;
+  std::vector<int32_t> ends_;
+};
+
+// .lst line: index<TAB>label[<TAB>more labels]<TAB>relative/path; the
+// filename is the last field. Same accept/skip rules as parse_list_line
+// (cxxnet_tpu/io/imgbin.py): tab-split first, any-whitespace split as
+// fallback, skip lines with fewer than two fields.
+bool FileNameOfLine(const std::string& line, std::string* fname) {
+  size_t end = line.find_last_not_of(" \t\r\n");
+  if (end == std::string::npos) return false;
+  size_t sep = line.find_last_of('\t', end);
+  if (sep == std::string::npos ||
+      line.find_first_of('\t') == std::string::npos)
+    sep = line.find_last_of(" \t", end);
+  if (sep == std::string::npos) return false;  // single field: malformed
+  *fname = line.substr(sep + 1, end - sep);
+  return true;
+}
+
+bool ReadWhole(const std::string& path, std::vector<unsigned char>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  std::fseek(f, 0, SEEK_END);
+  long len = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(len < 0 ? 0 : static_cast<size_t>(len));
+  size_t got = out->empty() ? 0 : std::fread(out->data(), 1, out->size(), f);
+  std::fclose(f);
+  return got == out->size();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "Usage: im2bin image.lst image_root_dir output_file\n");
+    return 1;
+  }
+  FILE* lst = std::fopen(argv[1], "r");
+  if (lst == nullptr) {
+    std::fprintf(stderr, "im2bin: cannot open list file %s\n", argv[1]);
+    return 1;
+  }
+  FILE* out = std::fopen(argv[3], "wb");
+  if (out == nullptr) {
+    std::fprintf(stderr, "im2bin: cannot open output file %s\n", argv[3]);
+    std::fclose(lst);
+    return 1;
+  }
+  const std::time_t start = std::time(nullptr);
+  std::printf("creating image binary pack from %s...\n", argv[1]);
+
+  PageWriter writer(out);
+  std::string root(argv[2]);
+  if (!root.empty() && root.back() != '/') root += '/';
+
+  char linebuf[1 << 16];
+  std::vector<unsigned char> obj;
+  while (std::fgets(linebuf, sizeof(linebuf), lst) != nullptr) {
+    std::string fname;
+    if (!FileNameOfLine(linebuf, &fname)) continue;
+    const std::string path = root + fname;
+    if (!ReadWhole(path, &obj)) {
+      std::fprintf(stderr, "im2bin: cannot read image %s\n", path.c_str());
+      return 1;
+    }
+    if (!writer.Push(obj)) {
+      std::fprintf(stderr, "im2bin: image %s exceeds the 64MB page size\n",
+                   path.c_str());
+      return 1;
+    }
+    if (writer.n_objects % 1000 == 0) {
+      std::printf("\r[%8ld] images processed to %ld pages, %ld sec elapsed",
+                  static_cast<long>(writer.n_objects),
+                  static_cast<long>(writer.n_pages),
+                  static_cast<long>(std::time(nullptr) - start));
+      std::fflush(stdout);
+    }
+  }
+  writer.Close();
+  std::fclose(lst);
+  std::fclose(out);
+  std::printf("\nfinished [%8ld] images packed to %ld pages, %ld sec elapsed\n",
+              static_cast<long>(writer.n_objects),
+              static_cast<long>(writer.n_pages),
+              static_cast<long>(std::time(nullptr) - start));
+  return 0;
+}

@@ -65,6 +65,39 @@ def test_binary_page_writer_multi_page(tmp_path):
     assert os.path.getsize(path) == 3 * K_PAGE_BYTES
 
 
+def test_native_im2bin_matches_python(imgbin_dataset, tmp_path):
+    """The C++ im2bin tool (native/im2bin.cpp) must emit byte-identical
+    .bin output to tools/im2bin.py on the same .lst."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = os.path.join(root, "native", "im2bin")
+    try:
+        # always invoke make: its dependency tracking rebuilds a stale binary
+        # and no-ops when current
+        r = subprocess.run(["make", "-C", os.path.join(root, "native"),
+                            "im2bin"], capture_output=True, text=True)
+    except OSError as e:
+        pytest.skip("no make for native im2bin: %s" % e)
+    if r.returncode != 0 or not os.path.exists(exe):
+        pytest.skip("no toolchain for native im2bin: %s" % r.stderr[-300:])
+    d = imgbin_dataset
+    out = str(tmp_path / "native.bin")
+    rc = subprocess.call([exe, str(d / "train.lst"), str(d), out])
+    assert rc == 0
+    with open(out, "rb") as fa, open(d / "train.bin", "rb") as fb:
+        assert fa.read() == fb.read()
+
+    # whitespace-separated .lst (parse_list_line fallback) must agree too
+    with open(d / "train.lst") as f:
+        ws_lines = [l.replace("\t", " ") for l in f]
+    with open(tmp_path / "ws.lst", "w") as f:
+        f.writelines(ws_lines)
+    out_ws = str(tmp_path / "native_ws.bin")
+    rc = subprocess.call([exe, str(tmp_path / "ws.lst"), str(d), out_ws])
+    assert rc == 0
+    with open(out_ws, "rb") as fa, open(d / "train.bin", "rb") as fb:
+        assert fa.read() == fb.read()
+
+
 # ------------------------------------------------------------ decoder
 @pytest.fixture(scope="session")
 def native_lib():

@@ -1,0 +1,174 @@
+"""Torch adapter plugin layer (the caffe-adapter analogue, SURVEY.md §2.2):
+an external framework's op as a production layer and as a pairtest oracle."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cxxnet_tpu import Net  # noqa: E402
+from cxxnet_tpu.io.data import DataBatch  # noqa: E402
+from cxxnet_tpu.utils.config import tokenize  # noqa: E402
+from cxxnet_tpu.graph import LayerSpec  # noqa: E402
+from cxxnet_tpu.layers import ApplyContext, create_layer  # noqa: E402
+
+
+def make_layer(module, extra=(), in_shape=(3, 8, 8)):
+    spec = LayerSpec("torch", "t0", [0], [1])
+    lay = create_layer(spec, [("module", module)] + list(extra))
+    out_shape = lay.infer_shapes([in_shape])
+    params = lay.init_params(jax.random.PRNGKey(0), [in_shape])
+    return lay, params, out_shape[0]
+
+
+def test_forward_matches_torch_conv():
+    lay, params, out_shape = make_layer("Conv2d(3, 6, 3, padding=1)")
+    assert out_shape == (6, 8, 8)
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8, 8, 3).astype(np.float32)          # NHWC runtime node
+    ctx = ApplyContext(train=False, rng=None)
+    (y,) = lay.apply(params, [jnp.asarray(x)], ctx)
+    # oracle: same module, same blobs, NCHW
+    w = torch.from_numpy(np.asarray(params["blob0"]))
+    b = torch.from_numpy(np.asarray(params["blob1"]))
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), w, b, padding=1)
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 3, 1, 2),
+                               ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_torch_autograd():
+    lay, params, _ = make_layer("Linear(12, 5)", in_shape=(1, 1, 12))
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, 1, 1, 12).astype(np.float32))
+    ctx = ApplyContext(train=True, rng=jax.random.PRNGKey(0))
+
+    def loss(p, x):
+        (y,) = lay.apply(p, [x], ctx)
+        return jnp.sum(y ** 2)
+
+    gp, gx = jax.grad(loss, argnums=(0, 1))(params, x)
+    # oracle
+    xt = torch.from_numpy(np.asarray(x).reshape(4, 12)).requires_grad_(True)
+    wt = torch.from_numpy(np.asarray(params["blob0"])).requires_grad_(True)
+    bt = torch.from_numpy(np.asarray(params["blob1"])).requires_grad_(True)
+    (torch.nn.functional.linear(xt, wt, bt) ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(gp["blob0"]), wt.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gp["blob1"]), bt.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx).reshape(4, 12), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pairtest_fullc_vs_torch(capfd):
+    """The torch Linear is the oracle slave of the native fullc: one shared
+    parameter set (param_names renames blobs), any divergence would print a
+    PairTest report."""
+    cfg = """
+netconfig=start
+layer[0->1] = flatten
+layer[1->2] = pairtest-fullc-torch:pt
+  nhidden = 16
+  init_sigma = 0.05
+  slave:module = "Linear(48, 16)"
+  slave:param_names = wmat,bias
+layer[2->3] = relu
+layer[3->4] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[4->4] = softmax
+netconfig=end
+input_shape = 3,4,4
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+"""
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    for _ in range(2):
+        x = rs.randn(8, 3, 4, 4).astype(np.float32)
+        y = rs.randint(0, 4, (8, 1)).astype(np.float32)
+        net.update(DataBatch(x, y))
+    jax.effects_barrier()
+    assert "PairTest" not in capfd.readouterr().out
+
+
+def test_pairtest_conv_vs_torch(capfd):
+    """Native conv (HWIO weights) against torch Conv2d via hwio=1 exposure."""
+    cfg = """
+netconfig=start
+layer[0->1] = pairtest-conv-torch:pt
+  kernel_size = 3
+  pad = 1
+  nchannel = 8
+  init_sigma = 0.05
+  slave:module = "Conv2d(2, 8, 3, padding=1)"
+  slave:param_names = wmat,bias
+  slave:hwio = 1
+layer[1->2] = flatten
+layer[2->3] = fullc:fc
+  nhidden = 4
+  init_sigma = 0.1
+layer[3->3] = softmax
+netconfig=end
+input_shape = 2,8,8
+batch_size = 8
+dev = cpu
+eta = 0.1
+metric = error
+"""
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 2, 8, 8).astype(np.float32)
+    y = rs.randint(0, 4, (8, 1)).astype(np.float32)
+    net.update(DataBatch(x, y))
+    jax.effects_barrier()
+    assert "PairTest" not in capfd.readouterr().out
+
+
+def test_torch_layer_trains_in_net():
+    """A torch module as a production layer: the whole net still trains
+    (grads flow through the callback's custom_vjp)."""
+    cfg = """
+netconfig=start
+layer[0->1] = torch:tc1
+  module = "Sequential(Conv2d(1, 4, 3, padding=1), ReLU())"
+layer[1->2] = flatten
+layer[2->3] = fullc:fc
+  nhidden = 2
+  init_sigma = 0.1
+layer[3->3] = softmax
+netconfig=end
+input_shape = 1,6,6
+batch_size = 16
+dev = cpu
+eta = 0.5
+metric = error
+"""
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 1, 6, 6).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.float32).reshape(16, 1)
+    losses = []
+    for _ in range(15):
+        net.update(DataBatch(x, y))
+        losses.append(float(net._last_loss))
+    assert losses[-1] < 0.5 * losses[0], \
+        "loss did not decrease: %s" % losses
+
+
+def test_module_expr_errors():
+    from cxxnet_tpu.utils.config import ConfigError
+    with pytest.raises(ConfigError):
+        make_layer("not_a_module(")
+    with pytest.raises(ConfigError):
+        make_layer("Linear(3, 4)", extra=[("param_names", "only_one")],
+                   in_shape=(1, 1, 3))
