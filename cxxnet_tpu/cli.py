@@ -18,6 +18,7 @@ without touching the net.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import sys
@@ -28,6 +29,7 @@ import numpy as np
 
 from .io import create_iterator
 from .nnet.net import Net
+from .utils import profiler
 from .utils.config import load_config, tokenize
 
 Pairs = List[Tuple[str, str]]
@@ -49,6 +51,11 @@ class LearnTask:
         self.silent = 0
         self.test_io = 0
         self.profile_dir = ""     # 'profile = <dir>': xplane trace dir
+        self.step_stats = 0       # 'step_stats = 1': per-round phase timing
+        self.nan_check = 0        # 'nan_check = N': check loss every N steps
+        self.nan_recover = 0      # 'nan_recover = 1': reload newest snapshot
+        self.loss_bound = 0.0     # 'loss_bound = X': |loss| > X also diverged
+        self.check_consistency = 0  # per-round replica weight check
         self.extract_node_name = ""
         self.output_format = 1
         self.name_pred = "pred.txt"
@@ -85,6 +92,16 @@ class LearnTask:
             self.test_io = int(val)
         elif name == "profile":
             self.profile_dir = val
+        elif name == "step_stats":
+            self.step_stats = int(val)
+        elif name == "nan_check":
+            self.nan_check = int(val)
+        elif name == "nan_recover":
+            self.nan_recover = int(val)
+        elif name == "loss_bound":
+            self.loss_bound = float(val)
+        elif name == "check_consistency":
+            self.check_consistency = int(val)
         elif name == "extract_node_name":
             self.extract_node_name = val
         elif name == "output_format":
@@ -222,16 +239,30 @@ class LearnTask:
         # real tracing is the SURVEY §5.1 upgrade over the reference's
         # wall-clock prints: 'profile = <dir>' captures an xplane trace of
         # the training task, viewable in TensorBoard/XProf
-        if self.profile_dir:
-            import jax
-            jax.profiler.start_trace(self.profile_dir)
-        try:
+        with profiler.trace(self.profile_dir):
             self._task_train()
-        finally:
-            if self.profile_dir:
-                import jax
-                jax.profiler.stop_trace()
-                print("profile: xplane trace written to %s" % self.profile_dir)
+        if self.profile_dir:
+            print("profile: xplane trace written to %s" % self.profile_dir)
+
+    def _diverged(self, loss: float) -> bool:
+        """Non-finite loss always counts; saturating nets can diverge to a
+        huge-but-finite loss, so 'loss_bound = X' flags |loss| > X too."""
+        if not np.isfinite(loss):
+            return True
+        return self.loss_bound > 0 and abs(loss) > self.loss_bound
+
+    def _recover_from_divergence(self, step: int) -> bool:
+        """nan_recover=1: non-finite loss → reload the newest snapshot
+        (checkpoint-based recovery is the reference's only failure story,
+        cxxnet_main.cpp:135-157; we add the *detection*, SURVEY §5.3)."""
+        sys.stderr.write("[%d] step %d: divergent loss detected\n"
+                         % (self.start_counter, step))
+        if not self.nan_recover or not self._sync_latest_model():
+            raise RuntimeError("training diverged at round "
+                               "%d step %d" % (self.start_counter, step))
+        sys.stderr.write("[%d] recovered from snapshot, resuming at round %d\n"
+                         % (self.start_counter, self.start_counter))
+        return True
 
     def _task_train(self) -> None:
         start = time.time()
@@ -254,10 +285,34 @@ class LearnTask:
             sample_counter = 0
             self.net.start_round(self.start_counter)
             self.itr_train.before_first()
-            while self.itr_train.next():
+            stats = profiler.StepStats(batch_size=self.net.batch_size) \
+                if self.step_stats else None
+            restart_round = False
+            while True:
+                if stats:
+                    with stats.phase("data"):
+                        has_next = self.itr_train.next()
+                else:
+                    has_next = self.itr_train.next()
+                if not has_next:
+                    break
                 if self.test_io == 0:
-                    self.net.update(self.itr_train.value())
+                    with contextlib.ExitStack() as es:
+                        if stats:
+                            es.enter_context(stats.phase("step"))
+                        if self.profile_dir:
+                            es.enter_context(
+                                profiler.step_annotation(self.net.epoch_counter))
+                        self.net.update(self.itr_train.value())
+                    if self.nan_check and \
+                            (sample_counter + 1) % self.nan_check == 0 and \
+                            self._diverged(self.net.last_loss()):
+                        restart_round = self._recover_from_divergence(
+                            sample_counter + 1)
+                        break
                 sample_counter += 1
+                if stats:
+                    stats.end_step()
                 if sample_counter % self.print_step == 0 and not self.silent:
                     elapsed = int(time.time() - start)
                     sys.stdout.write("\r%-63s\r" % "")
@@ -265,6 +320,16 @@ class LearnTask:
                                      % (self.start_counter - 1, sample_counter,
                                         elapsed))
                     sys.stdout.flush()
+            if restart_round:
+                continue
+            if stats and not self.silent:
+                print("\nround %d: %s" % (self.start_counter - 1,
+                                          stats.summary()))
+            if self.check_consistency and self.test_io == 0:
+                diff, worst = self.net.check_replica_consistency()
+                sys.stderr.write("[%d] replica-consistency max|Δ|=%g%s\n"
+                                 % (self.start_counter, diff,
+                                    " at %s.%s" % worst if worst else ""))
             if self.test_io == 0:
                 sys.stderr.write("[%d]" % self.start_counter)
                 if not self.itr_evals:

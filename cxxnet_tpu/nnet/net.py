@@ -403,6 +403,42 @@ class Net:
                 ((n, self.graph.label_range[i])
                  for n, i in self.graph.label_name_map.items())}
 
+    # ---------------------------------------------------- failure detection
+    def last_loss(self) -> float:
+        """Fetch the most recent step loss (forces a device sync). SURVEY §5.3
+        upgrade: the reference has no runtime failure detection (every error
+        is exit(-1), utils.h:60-80); we expose the loss so the driver can
+        detect divergence (NaN/Inf) and recover from a checkpoint."""
+        if not hasattr(self, "_last_loss"):
+            return float("nan")
+        return float(self._last_loss)
+
+    def check_replica_consistency(self) -> Tuple[float, Optional[Tuple[str, str]]]:
+        """Verify every device's copy of each weight shard is identical —
+        the test_on_server analogue (async_updater-inl.hpp:144-154 had each
+        worker CheckWeight_ against the server's copy each round). Shards are
+        grouped by their index into the global array: shards covering the
+        same slice (replicas) must match bit-for-bit; ZeRO/tensor-parallel
+        shards with distinct indices are legitimately different and are not
+        compared. Returns (max_abs_diff, (layer, tag) of the worst weight)."""
+        max_diff, worst = 0.0, None
+        for lname, tags in self.params.items():
+            for tag, w in tags.items():
+                groups: Dict[str, list] = {}
+                for s in w.addressable_shards:
+                    groups.setdefault(str(s.index), []).append(
+                        np.asarray(s.data))
+                for arrs in groups.values():
+                    ref = arrs[0]
+                    for a in arrs[1:]:
+                        if ref.size == 0:
+                            continue
+                        d = float(np.max(np.abs(a.astype(np.float32)
+                                                - ref.astype(np.float32))))
+                        if d > max_diff:
+                            max_diff, worst = d, (lname, tag)
+        return max_diff, worst
+
     # ----------------------------------------------------------- evaluate
     def evaluate(self, data_iter, name: str) -> str:
         """Run metrics over an iterator; excludes padded tails. Prints (and

@@ -1,0 +1,143 @@
+"""Tracing & profiling — the SURVEY §5.1 first-class upgrade.
+
+The reference's observability is a wall-clock elapsed-seconds print every
+``print_step`` batches (/root/reference/src/cxxnet_main.cpp:371-387) plus a
+bare ``GetTime()`` helper (/root/reference/src/utils/timer.h:16-31). The TPU
+build provides three levels:
+
+1. **StepStats** — host-side per-step phase timers (data wait vs. step
+   dispatch) with percentile summaries and throughput. Cheap enough to stay
+   on by default; surfaces the classic "input-bound vs compute-bound"
+   question the reference answered with ``test_io=1``.
+2. **XPlane tracing** — :func:`trace` wraps ``jax.profiler`` so a whole task
+   (or any region) is captured for TensorBoard/XProf, with per-step
+   boundaries marked via :func:`step_annotation`.
+3. **Annotations** — :func:`annotate` names host regions so custom pipeline
+   stages show up in the trace alongside XLA ops.
+
+Host-side step times measure *dispatch* latency, not device execution — JAX
+dispatch is async. Round-level wall time (which amortizes the final sync)
+and the XPlane trace are the ground truth for device time; StepStats'
+data-wait fraction is accurate because the iterator runs on the host.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time"]
+
+
+def get_time() -> float:
+    """High-resolution wall clock (GetTime, timer.h:16-31)."""
+    return time.perf_counter()
+
+
+class StepStats:
+    """Accumulates named per-step phase durations; summarizes a round.
+
+    Usage::
+
+        stats = StepStats(batch_size=128)
+        with stats.phase("data"):
+            has_next = itr.next()
+        with stats.phase("step"):
+            net.update(itr.value())
+        stats.end_step()
+        ...
+        print(stats.summary())   # then stats.clear() for the next round
+    """
+
+    def __init__(self, batch_size: int = 0, max_steps: int = 100000) -> None:
+        self.batch_size = batch_size
+        self.max_steps = max_steps
+        self._phases: Dict[str, List[float]] = {}
+        self._current: Dict[str, float] = {}
+        self._round_start = get_time()
+        self.num_steps = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = get_time()
+        try:
+            yield
+        finally:
+            self._current[name] = self._current.get(name, 0.0) + get_time() - t0
+
+    def end_step(self) -> None:
+        for name, dt in self._current.items():
+            lst = self._phases.setdefault(name, [])
+            if len(lst) < self.max_steps:
+                lst.append(dt)
+        self._current.clear()
+        self.num_steps += 1
+
+    def clear(self) -> None:
+        self._phases.clear()
+        self._current.clear()
+        self.num_steps = 0
+        self._round_start = get_time()
+
+    # ------------------------------------------------------------- summary
+    @staticmethod
+    def _pct(sorted_vals: List[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[i]
+
+    def phase_totals(self) -> Dict[str, float]:
+        return {k: sum(v) for k, v in self._phases.items()}
+
+    def summary(self) -> str:
+        """One human line: wall, throughput, per-phase mean/p95, data-wait %."""
+        wall = get_time() - self._round_start
+        if self.num_steps == 0:
+            return "no steps recorded"
+        parts = ["%d steps in %.1fs (%.1f steps/s"
+                 % (self.num_steps, wall, self.num_steps / max(wall, 1e-9))]
+        if self.batch_size:
+            parts[-1] += ", %.0f samples/s" % (self.num_steps * self.batch_size
+                                               / max(wall, 1e-9))
+        parts[-1] += ")"
+        totals = self.phase_totals()
+        for name in sorted(self._phases):
+            vals = sorted(self._phases[name])
+            mean = totals[name] / len(vals)
+            parts.append("%s %.1fms/p95 %.1fms"
+                         % (name, mean * 1e3, self._pct(vals, 0.95) * 1e3))
+        if "data" in totals and wall > 0:
+            parts.append("data-wait %.0f%%" % (100.0 * totals["data"] / wall))
+        return "; ".join(parts)
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]):
+    """Capture an XPlane trace of the enclosed region into ``logdir``
+    (viewable in TensorBoard / XProf). No-op when logdir is falsy."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host region, visible in the XPlane trace."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_annotation(step: int):
+    """Mark a training-step boundary so XProf groups device ops per step."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)

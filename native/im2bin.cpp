@@ -81,8 +81,7 @@ bool FileNameOfLine(const std::string& line, std::string* fname) {
   size_t end = line.find_last_not_of(" \t\r\n");
   if (end == std::string::npos) return false;
   size_t sep = line.find_last_of('\t', end);
-  if (sep == std::string::npos ||
-      line.find_first_of('\t') == std::string::npos)
+  if (sep == std::string::npos)
     sep = line.find_last_of(" \t", end);
   if (sep == std::string::npos) return false;  // single field: malformed
   *fname = line.substr(sep + 1, end - sep);

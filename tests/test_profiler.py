@@ -1,0 +1,120 @@
+"""Tracing/profiling (SURVEY §5.1 upgrade) and failure detection (§5.3):
+StepStats timers, replica-consistency check, NaN watchdog recovery."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.cli import LearnTask
+from cxxnet_tpu.utils import profiler
+from cxxnet_tpu.utils.config import load_config
+
+from test_train_e2e import CONF, synth_mnist  # noqa: F401 (fixture)
+
+
+def test_step_stats_phases_and_summary():
+    stats = profiler.StepStats(batch_size=32)
+    for _ in range(5):
+        with stats.phase("data"):
+            time.sleep(0.001)
+        with stats.phase("step"):
+            time.sleep(0.002)
+        stats.end_step()
+    assert stats.num_steps == 5
+    totals = stats.phase_totals()
+    assert totals["data"] >= 0.005
+    assert totals["step"] >= 0.010
+    s = stats.summary()
+    assert "5 steps" in s and "data" in s and "step" in s
+    assert "data-wait" in s
+    stats.clear()
+    assert stats.num_steps == 0
+    assert stats.summary() == "no steps recorded"
+
+
+def test_step_stats_empty_phase_is_cheap():
+    stats = profiler.StepStats()
+    stats.end_step()
+    assert "1 steps" in stats.summary()
+
+
+def test_trace_noop_without_logdir():
+    with profiler.trace(None):
+        pass
+    with profiler.trace(""):
+        pass
+
+
+def test_cli_step_stats_and_consistency(synth_mnist, tmp_path, capfd):  # noqa: F811
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=tmp_path / "models"))
+    task = LearnTask()
+    assert task.run([str(conf), "num_round=1", "max_round=1",
+                     "step_stats=1", "check_consistency=1"]) == 0
+    out = capfd.readouterr()
+    assert "round 0:" in out.out and "steps/s" in out.out
+    # replicated weights must be identical on all 8 virtual devices
+    line = [l for l in out.err.splitlines() if "replica-consistency" in l]
+    assert line, out.err
+    diff = float(line[0].split("max|Δ|=")[1].split()[0].split(" at")[0])
+    assert diff == 0.0
+
+
+def test_last_loss_and_consistency_api(synth_mnist, tmp_path):  # noqa: F811
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=tmp_path / "models"))
+    task = LearnTask()
+    task.run([str(conf), "num_round=1", "max_round=1", "save_model=0"])
+    assert np.isfinite(task.net.last_loss())
+    diff, worst = task.net.check_replica_consistency()
+    assert diff == 0.0
+
+
+def test_nan_recovery_from_snapshot(synth_mnist, tmp_path, capfd):  # noqa: F811
+    md = tmp_path / "models"
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=md))
+    # produce a snapshot to recover from
+    LearnTask().run([str(conf), "num_round=1", "max_round=1", "save_model=1"])
+    capfd.readouterr()
+
+    task = LearnTask()
+    for name, val in load_config(str(conf)):
+        task.set_param(name, val)
+    task.set_param("nan_recover", "1")
+    assert task._recover_from_divergence(7) is True
+    assert task.start_counter == 2          # resumes after snapshot 0001
+    assert task.net is not None
+    err = capfd.readouterr().err
+    assert "divergent loss" in err and "recovered from snapshot" in err
+
+
+def test_live_divergence_recovery(synth_mnist, tmp_path, capfd):  # noqa: F811
+    """eta=1e10 explodes the loss (finite, saturating net) -> loss_bound
+    triggers recovery from the snapshot; max_round bounds the retries."""
+    md = tmp_path / "models"
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=md))
+    LearnTask().run([str(conf), "num_round=1", "max_round=1", "save_model=1"])
+    capfd.readouterr()
+
+    task = LearnTask()
+    assert task.run([str(conf), "eta=1e10", "nan_check=2", "nan_recover=1",
+                     "loss_bound=100", "max_round=2", "num_round=20",
+                     "save_model=0", "silent=1"]) == 0
+    err = capfd.readouterr().err
+    assert err.count("divergent loss") == 2
+    assert err.count("recovered from snapshot") == 2
+
+
+def test_nan_halt_without_snapshot(tmp_path, capfd):
+    task = LearnTask()
+    task.set_param("model_dir", str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="diverged"):
+        task._recover_from_divergence(3)
+    task2 = LearnTask()
+    task2.set_param("nan_recover", "1")
+    task2.set_param("model_dir", str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="diverged"):
+        task2._recover_from_divergence(3)
