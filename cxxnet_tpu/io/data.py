@@ -39,6 +39,25 @@ class DataBatch:
         # (trained on, excluded from eval); "short" = duplicated filler
         # (masked out of the loss too)
         self.pad_mode = pad_mode
+        # sparse CSR view (data.h:96-180): the reference carries these fields
+        # but no dense NN path consumes them; kept for surface parity —
+        # set_sparse fills them, sparse_row(i) reads one instance back
+        self.sparse_values: Optional[np.ndarray] = None
+        self.sparse_indices: Optional[np.ndarray] = None
+        self.sparse_indptr: Optional[np.ndarray] = None
+
+    def set_sparse(self, values: np.ndarray, indices: np.ndarray,
+                   indptr: np.ndarray) -> None:
+        assert indptr.shape[0] == self.batch_size + 1
+        assert values.shape[0] == indices.shape[0] == indptr[-1]
+        self.sparse_values = values
+        self.sparse_indices = indices
+        self.sparse_indptr = indptr
+
+    def sparse_row(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(indices, values) of instance i, as SparseInst (data.h:62-76)."""
+        a, b = self.sparse_indptr[i], self.sparse_indptr[i + 1]
+        return self.sparse_indices[a:b], self.sparse_values[a:b]
 
     @property
     def batch_size(self) -> int:

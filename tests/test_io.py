@@ -427,3 +427,22 @@ def test_attachtxt(imgbin_dataset, tmp_path):
         i = int(b.inst_index[row])
         np.testing.assert_allclose(b.extra_data[0][row, 0, 0],
                                    [i, i + 1, i + 2, i + 3])
+
+
+def test_databatch_sparse_csr():
+    """Surface parity for the CSR fields (data.h:96-180) — carried but not
+    consumed by the dense path, same as the reference."""
+    from cxxnet_tpu.io.data import DataBatch
+    b = DataBatch(np.zeros((3, 1, 1, 4), np.float32),
+                  np.zeros((3, 1), np.float32))
+    values = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([0, 2, 1], np.int64)
+    indptr = np.array([0, 2, 2, 3], np.int64)
+    b.set_sparse(values, indices, indptr)
+    idx, val = b.sparse_row(0)
+    np.testing.assert_array_equal(idx, [0, 2])
+    np.testing.assert_array_equal(val, [1.0, 2.0])
+    idx, val = b.sparse_row(1)
+    assert idx.size == 0
+    idx, val = b.sparse_row(2)
+    np.testing.assert_array_equal(val, [3.0])
