@@ -1,0 +1,73 @@
+"""Sharded checkpointing for mesh-distributed state (SURVEY §5.4 upgrade).
+
+The reference's checkpoint is a single-host binary blob (nnet_impl-inl.hpp:
+82-99), which `Net.save_model` mirrors for config-DSL nets. For the modern
+stack (GPT flagship with ZeRO/tensor-parallel shardings) gathering to one
+host defeats the point of sharding — so this module wraps orbax: every host
+writes its own shards, and restore places each leaf directly onto its target
+sharding (including *resharding* restores onto a different mesh layout).
+
+API:
+    save(path, tree)                      # blocking, atomic directory write
+    restore(path, like=tree)              # target shardings = like's
+    restore(path, shardings=tree_of_NamedSharding, dtypes=...)
+
+``like`` may be the live state tree (arrays) or a tree of
+jax.ShapeDtypeStruct with `.sharding` set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["save", "restore"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, tree: Any) -> None:
+    """Write ``tree`` (pytree of jax.Array / np.ndarray / scalars) to the
+    directory ``path``. Atomic: a partial write never looks like a valid
+    checkpoint. Multi-host: every process must call this collectively; each
+    writes only its addressable shards."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(os.fspath(path)), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore(path: str, like: Any = None, shardings: Any = None) -> Any:
+    """Read a checkpoint written by :func:`save`.
+
+    - ``like=tree``: restore with each leaf's shape/dtype/sharding taken
+      from the corresponding leaf of ``tree`` (live arrays or
+      ShapeDtypeStruct). This is also how you *reshard* on restore: pass a
+      target tree placed on the new mesh.
+    - ``shardings=tree``: restore with stored shapes/dtypes but the given
+      jax.sharding.Sharding per leaf.
+    - neither: restore fully replicated on the default device order.
+    """
+    ckptr = _checkpointer()
+    apath = os.path.abspath(os.fspath(path))
+    if like is not None:
+        target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding",
+                                                            None)), like)
+        return ckptr.restore(apath, target)
+    if shardings is not None:
+        import orbax.checkpoint as ocp
+
+        meta = ckptr.metadata(apath)
+        target = jax.tree.map(
+            lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=s),
+            meta, shardings)
+        return ckptr.restore(apath, target)
+    return ckptr.restore(apath)

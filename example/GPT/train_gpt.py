@@ -39,6 +39,10 @@ def main() -> int:
     ap.add_argument("--microbatch", type=int, default=2)
     ap.add_argument("--eta", type=float, default=0.1)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--ckpt", default="",
+                    help="checkpoint dir: resume from it if present, save "
+                         "into it at the end (sharded orbax format; works "
+                         "across different mesh layouts)")
     args = ap.parse_args()
 
     import jax
@@ -63,6 +67,12 @@ def main() -> int:
 
     params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
     mom = gpt_place(jax.tree.map(jax.numpy.zeros_like, params), mesh)
+    if args.ckpt and os.path.isdir(args.ckpt):
+        from cxxnet_tpu.utils import checkpoint
+        state = checkpoint.restore(args.ckpt,
+                                   like={"params": params, "mom": mom})
+        params, mom = state["params"], state["mom"]
+        print("resumed from %s" % args.ckpt)
     step = make_train_step(cfg, mesh, eta=args.eta)
 
     rs = np.random.RandomState(0)
@@ -80,6 +90,11 @@ def main() -> int:
             dt = time.perf_counter() - t0
             tps = n_tok * (i + 1) / dt
             print("step %4d  loss %.3f  (%.0f tok/s)" % (i, float(loss), tps))
+
+    if args.ckpt:
+        from cxxnet_tpu.utils import checkpoint
+        checkpoint.save(args.ckpt, {"params": params, "mom": mom})
+        print("checkpoint saved to %s" % args.ckpt)
 
     # greedy sampling from a corpus prompt (batch padded to the training
     # batch: the pipeline's microbatch split needs the same divisibility)
