@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,35 +53,46 @@ def _layernorm(x, g, b, eps=1e-5):
     return ((xf - mean) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
 
 
-def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
-           use_ring: bool) -> jnp.ndarray:
-    """Pre-LN transformer block on local shards (b, n_local, F)."""
-    b, n, f = h.shape
+def _block_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
+                attn, reduce):
+    """Pre-LN transformer block body — the ONE copy of the block math.
+
+    ``attn(q4, k4, v4) -> (att4, aux)`` supplies the attention variant
+    (full-causal, ring, or KV-cached); ``reduce`` combines row-sharded
+    matmul partials (lax.psum inside shard_map, identity under GSPMD jit).
+    Separate Q/K/V projections so the model-axis shard of each is a whole
+    set of heads (a fused (F,3F) weight sharded on its last dim would hand
+    rank 0 all of Q and half of K instead).
+    """
+    b, n, _ = h.shape
     x = _layernorm(h, p["ln1_g"], p["ln1_b"])
-    # separate Q/K/V projections so the model-axis shard of each is a whole
-    # set of heads (a fused (F,3F) weight sharded on its last dim would hand
-    # rank 0 all of Q and half of K instead)
     q = x @ p["w_q"].astype(x.dtype) + p["b_q"].astype(x.dtype)
     k = x @ p["w_k"].astype(x.dtype) + p["b_k"].astype(x.dtype)
     v = x @ p["w_v"].astype(x.dtype) + p["b_v"].astype(x.dtype)
-    d = q.shape[-1] // n_head_local
-    q = q.reshape(b, n, n_head_local, d)
-    k = k.reshape(b, n, n_head_local, d)
-    v = v.reshape(b, n, n_head_local, d)
-    if use_ring:
-        att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
-    else:
-        att = local_attention(q, k, v, causal=True)
-    o = att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype)
-    # row-sharded matmul: psum combines the per-rank partial sums; on a
-    # size-1 model axis this is the identity (and demotes the vma type)
-    o = lax.psum(o, MODEL_AXIS)
+    d = q.shape[-1] // n_head
+    att, aux = attn(q.reshape(b, n, n_head, d), k.reshape(b, n, n_head, d),
+                    v.reshape(b, n, n_head, d))
+    o = reduce(att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype))
     h = h + o + p["b_proj"].astype(x.dtype)
     x = _layernorm(h, p["ln2_g"], p["ln2_b"])
     m = jax.nn.relu(x @ p["w_mlp1"].astype(x.dtype) + p["b_mlp1"].astype(x.dtype))
-    m = m @ p["w_mlp2"].astype(x.dtype)
-    m = lax.psum(m, MODEL_AXIS)
-    return h + m + p["b_mlp2"].astype(x.dtype)
+    m = reduce(m @ p["w_mlp2"].astype(x.dtype))
+    return h + m + p["b_mlp2"].astype(x.dtype), aux
+
+
+def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
+           use_ring: bool) -> jnp.ndarray:
+    """Training block on local shards (b, n_local, F), inside gpipe's
+    shard_map: explicit psum combines row-sharded partials (on a size-1
+    model axis it is the identity, and demotes the vma type)."""
+    def attn(q, k, v):
+        if use_ring:
+            return ring_attention_inner(q, k, v, SEQ_AXIS, causal=True), None
+        return local_attention(q, k, v, causal=True), None
+
+    out, _ = _block_core(p, h, n_head_local, attn,
+                         lambda t: lax.psum(t, MODEL_AXIS))
+    return out
 
 
 def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
@@ -203,9 +214,139 @@ def gpt_place(params: Dict, mesh: Mesh) -> Dict:
     return jax.device_put(params, gpt_param_shardings(mesh))
 
 
+# ---------------------------------------------------------------------------
+# autoregressive decode with a KV cache
+# ---------------------------------------------------------------------------
+# Inference analogue of the reference's `pred` task for the flagship: one
+# forward per generated token instead of a full-sequence forward per token.
+# Runs under plain jit (GSPMD partitions dp over the batch and tp over the
+# head/feature dims automatically — the explicit psum in `_block` exists only
+# because gpipe's shard_map needs it; here XLA inserts the collectives).
+# Pipeline-sharded (pipe>1) block params are scanned layer-by-layer, which
+# GSPMD resolves with per-layer collective-permutes; decode is latency-bound,
+# so microbatched pipelining would not help anyway.
+
+
+def _attn_cached(q, ck, cv, pos):
+    """q (b,1,H,d) against cache (b,S,H,d); positions > pos are masked."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / (d ** 0.5)
+    mask = jnp.arange(ck.shape[1])[None, None, None, :] <= pos
+    w = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w,
+                      cv.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
+               temperature: float):
+    """Build (and cache) the jitted prefill+decode program for one
+    (config, prompt length, generation length, temperature) signature —
+    repeated gpt_decode calls hit jit's cache instead of retracing."""
+    cfg = GPTConfig(*cfg_key)
+    total = n_prompt + max_new
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    n_head = cfg.n_head
+    hd = cfg.feat // n_head
+    identity = lambda t: t          # GSPMD inserts the tp collectives
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, -1)
+        return jnp.argmax(logits, -1)
+
+    def run(params, prompt, rng):
+        b = prompt.shape[0]
+
+        # ---- prefill: full forward over the prompt, emitting k/v caches
+        h = (params["emb"][prompt]
+             + params["pos"][None, :n_prompt]).astype(dtype)
+
+        def prefill_layer(carry, p):
+            def attn(q, k, v):
+                return local_attention(q, k, v, causal=True), (k, v)
+            out, (k, v) = _block_core(p, carry, n_head, attn, identity)
+            pad = ((0, 0), (0, total - n_prompt), (0, 0), (0, 0))
+            return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+        h, (cache_k, cache_v) = lax.scan(prefill_layer, h, params["blocks"])
+        hl = _layernorm(h[:, -1:], params["lnf_g"], params["lnf_b"])
+        logits = hl[:, 0] @ params["head"].astype(hl.dtype)
+
+        ids = jnp.zeros((b, total), jnp.int32)
+        ids = lax.dynamic_update_slice(ids, prompt, (0, 0))
+        ids = ids.at[:, n_prompt].set(
+            pick(logits, jax.random.fold_in(rng, 0)).astype(jnp.int32))
+
+        # ---- decode: one token per step against the caches
+        def step(carry, i):
+            ids, cache_k, cache_v = carry
+            pos = n_prompt + i                     # position being processed
+            tok = lax.dynamic_slice_in_dim(ids, pos, 1, axis=1)   # (b, 1)
+            h = (params["emb"][tok]
+                 + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                            axis=0)[None]).astype(dtype)
+
+            def layer(carry_h, xs):
+                p, ck, cv = xs
+
+                def attn(q, k, v):
+                    ck2 = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+                    cv2 = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+                    return _attn_cached(q, ck2, cv2, pos), (ck2, cv2)
+
+                out, (ck, cv) = _block_core(p, carry_h, n_head, attn,
+                                            identity)
+                return out, (ck, cv)
+
+            h, (cache_k, cache_v) = lax.scan(
+                layer, h, (params["blocks"], cache_k, cache_v))
+            hl = _layernorm(h, params["lnf_g"], params["lnf_b"])
+            logits = hl[:, 0] @ params["head"].astype(hl.dtype)
+            nxt = pick(logits, jax.random.fold_in(rng, i + 1))
+            ids = lax.dynamic_update_slice(
+                ids, nxt[:, None].astype(jnp.int32), (0, pos + 1))
+            return (ids, cache_k, cache_v), None
+
+        if max_new > 1:
+            (ids, _, _), _ = lax.scan(step, (ids, cache_k, cache_v),
+                                      jnp.arange(max_new - 1))
+        return ids
+
+    return jax.jit(run)
+
+
+def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
+               cfg: GPTConfig, mesh: Optional[Mesh] = None,
+               temperature: float = 0.0,
+               rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Generate ``max_new`` (>= 1) tokens after ``prompt`` (b, n_prompt)
+    int32. temperature 0 = greedy; else categorical sampling with ``rng``.
+    Returns (b, n_prompt + max_new). n_prompt + max_new <= cfg.seq_len.
+
+    ``mesh`` is accepted for API symmetry with gpt_logits but unused:
+    decode partitioning follows the placements of ``params`` via GSPMD.
+    """
+    n_prompt = int(prompt.shape[1])
+    if max_new < 1:
+        raise ValueError("max_new must be >= 1, got %d" % max_new)
+    if n_prompt + max_new > cfg.seq_len:
+        raise ValueError("prompt+max_new %d exceeds seq_len %d"
+                         % (n_prompt + max_new, cfg.seq_len))
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    import dataclasses
+    fn = _decode_fn(dataclasses.astuple(cfg), n_prompt, max_new,
+                    float(temperature))
+    return fn(params, prompt, rng)
+
+
 def gpt_data_sharding(mesh: Mesh) -> NamedSharding:
     return batch_sharding(mesh)
 
 
-__all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss",
+__all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss", "gpt_decode",
            "make_train_step", "gpt_place", "gpt_param_shardings"]

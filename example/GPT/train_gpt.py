@@ -48,7 +48,7 @@ def main() -> int:
     import jax
     import numpy as np
 
-    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_init, gpt_logits,
+    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_decode, gpt_init,
                                        gpt_place, make_train_step)
     from cxxnet_tpu.parallel.mesh import make_mesh
 
@@ -96,15 +96,13 @@ def main() -> int:
         checkpoint.save(args.ckpt, {"params": params, "mom": mom})
         print("checkpoint saved to %s" % args.ckpt)
 
-    # greedy sampling from a corpus prompt (batch padded to the training
-    # batch: the pipeline's microbatch split needs the same divisibility)
-    prompt = raw[:32].astype(np.int32)
-    ids = np.zeros((args.batch, args.seq), np.int32)
-    ids[:, :32] = prompt
-    for pos in range(32, min(args.seq, 32 + 96)):
-        logits = gpt_logits(params, jax.numpy.asarray(ids), cfg, mesh)
-        ids[:, pos] = int(np.argmax(np.asarray(logits)[0, pos - 1]))
-    txt = bytes(ids[0, :pos + 1].astype(np.uint8)).decode("utf-8", "replace")
+    # greedy generation with the KV-cache decoder (one forward per token;
+    # batch padded to the training batch for sharding divisibility)
+    prompt = np.tile(raw[:32].astype(np.int32), (args.batch, 1))
+    max_new = min(args.seq - 32, 96)
+    out = gpt_decode(params, jax.numpy.asarray(prompt), max_new, cfg, mesh)
+    txt = bytes(np.asarray(out)[0].astype(np.uint8)).decode("utf-8",
+                                                            "replace")
     print("--- greedy sample ---")
     print(txt)
     return 0

@@ -49,3 +49,92 @@ def test_gpt_4d_parallel_matches_single_device(axes):
     for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, par_params)),
                     jax.tree.leaves(jax.tree.map(np.asarray, ref_params))):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_decode_matches_naive_greedy():
+    """KV-cache decode == position-by-position full-forward greedy."""
+    from cxxnet_tpu.models.gpt import gpt_decode, gpt_logits
+    cfg = GPTConfig(vocab_size=32, seq_len=24, n_layer=2, n_head=4, feat=32,
+                    n_microbatch=1)
+    mesh = make_mesh("cpu:0-7")
+    params = gpt_place(gpt_init(jax.random.PRNGKey(5), cfg), mesh)
+    rs = np.random.RandomState(3)
+    prompt = jax.numpy.asarray(rs.randint(0, 32, (8, 8)).astype(np.int32))
+
+    out = np.asarray(gpt_decode(params, prompt, 10, cfg, mesh))
+    assert out.shape == (8, 18)
+
+    # naive: full forward each step, argmax at the last filled position
+    ids = np.zeros((8, cfg.seq_len), np.int32)
+    ids[:, :8] = np.asarray(prompt)
+    for pos in range(8, 18):
+        logits = gpt_logits(params, jax.numpy.asarray(ids[:, :pos]), cfg,
+                            mesh)
+        ids[:, pos] = np.argmax(np.asarray(logits)[:, pos - 1], axis=-1)
+    np.testing.assert_array_equal(out, ids[:, :18])
+
+
+def test_decode_tp_matches_single_device():
+    from cxxnet_tpu.models.gpt import gpt_decode
+    cfg = GPTConfig(vocab_size=32, seq_len=24, n_layer=2, n_head=4, feat=32,
+                    n_microbatch=1)
+    params0 = gpt_init(jax.random.PRNGKey(6), cfg)
+    rs = np.random.RandomState(4)
+    prompt = jax.numpy.asarray(rs.randint(0, 32, (4, 6)).astype(np.int32))
+
+    mesh1 = make_mesh("cpu:0")
+    ref = np.asarray(gpt_decode(gpt_place(params0, mesh1), prompt, 8, cfg,
+                                mesh1))
+    mesh2 = make_mesh("cpu:0-7", model_parallel=2)
+    out = np.asarray(gpt_decode(gpt_place(params0, mesh2), prompt, 8, cfg,
+                                mesh2))
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_decode_sampling_reproducible():
+    from cxxnet_tpu.models.gpt import gpt_decode
+    cfg = GPTConfig(vocab_size=32, seq_len=24, n_layer=2, n_head=4, feat=32,
+                    n_microbatch=1)
+    mesh = make_mesh("cpu:0")
+    params = gpt_place(gpt_init(jax.random.PRNGKey(7), cfg), mesh)
+    prompt = jax.numpy.asarray(np.zeros((2, 4), np.int32))
+    key = jax.random.PRNGKey(42)
+    a = np.asarray(gpt_decode(params, prompt, 6, cfg, mesh, temperature=1.0,
+                              rng=key))
+    b = np.asarray(gpt_decode(params, prompt, 6, cfg, mesh, temperature=1.0,
+                              rng=key))
+    np.testing.assert_array_equal(a, b)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="rng"):
+        gpt_decode(params, prompt, 6, cfg, mesh, temperature=1.0)
+
+
+def test_decode_validates_max_new():
+    from cxxnet_tpu.models.gpt import gpt_decode
+    cfg = GPTConfig(vocab_size=32, seq_len=24, n_layer=2, n_head=4, feat=32,
+                    n_microbatch=1)
+    mesh = make_mesh("cpu:0")
+    params = gpt_place(gpt_init(jax.random.PRNGKey(8), cfg), mesh)
+    prompt = jax.numpy.asarray(np.zeros((2, 4), np.int32))
+    with pytest.raises(ValueError, match="max_new"):
+        gpt_decode(params, prompt, 0, cfg, mesh)
+    with pytest.raises(ValueError, match="max_new"):
+        gpt_decode(params, prompt, -2, cfg, mesh)
+    with pytest.raises(ValueError, match="exceeds"):
+        gpt_decode(params, prompt, 100, cfg, mesh)
+
+
+def test_decode_jit_cache_reused():
+    import time
+    from cxxnet_tpu.models.gpt import gpt_decode
+    cfg = GPTConfig(vocab_size=32, seq_len=24, n_layer=2, n_head=4, feat=32,
+                    n_microbatch=1)
+    mesh = make_mesh("cpu:0")
+    params = gpt_place(gpt_init(jax.random.PRNGKey(9), cfg), mesh)
+    prompt = jax.numpy.asarray(np.zeros((2, 4), np.int32))
+    out1 = gpt_decode(params, prompt, 8, cfg, mesh)
+    t0 = time.perf_counter()
+    out2 = gpt_decode(params, prompt, 8, cfg, mesh)
+    dt = time.perf_counter() - t0
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert dt < 0.5, "second decode call should hit the jit cache (%.2fs)" % dt
