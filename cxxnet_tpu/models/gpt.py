@@ -44,6 +44,10 @@ class GPTConfig:
     mlp_ratio: int = 4
     n_microbatch: int = 2
     dtype: str = "float32"      # activation dtype ("bfloat16" on real chips)
+    remat: bool = False         # rematerialize blocks in backward: trades
+    #                             ~1/3 more FLOPs for O(layers) less HBM —
+    #                             the long-context/deep-model memory lever
+    #                             (jax.checkpoint per transformer block)
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -177,6 +181,8 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     block = functools.partial(
         _block, n_head_local=cfg.n_head // max(n_tp, 1),
         use_ring=n_sp > 1)
+    if cfg.remat:
+        block = jax.checkpoint(block)
     h = gpipe(block, params["blocks"], h, mesh, cfg.n_microbatch,
               extra_spec_axes=(SEQ_AXIS,), param_specs=_block_param_specs())
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])
@@ -193,19 +199,56 @@ def gpt_loss(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     return nll.mean()
 
 
+def gpt_opt_init(params: Dict, mesh: Mesh, optimizer: str = "sgd") -> Dict:
+    """Optimizer state placed like the params: sgd -> momentum tree;
+    adam -> {m, v, t} (same math as updaters.AdamUpdater, one-minus
+    decay convention not used here — betas are the usual 0.9/0.999)."""
+    zeros = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+    if optimizer == "sgd":
+        return zeros
+    if optimizer == "adam":
+        return {"m": zeros,
+                "v": gpt_place(jax.tree.map(jnp.zeros_like, params), mesh),
+                "t": jnp.zeros((), jnp.int32)}
+    raise ValueError("unknown optimizer %r" % optimizer)
+
+
 def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
-                    momentum: float = 0.9):
-    """Jitted SGD-momentum train step; donates params/opt state."""
+                    momentum: float = 0.9, optimizer: str = "sgd",
+                    beta2: float = 0.999, eps: float = 1e-8):
+    """Jitted train step; donates params/opt state. ``optimizer``: "sgd"
+    (momentum; opt state = momentum tree, the original signature) or
+    "adam" (opt state from gpt_opt_init(..., "adam"))."""
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError("unknown optimizer %r" % optimizer)
     shardings = gpt_param_shardings(mesh)
 
-    def step(params, mom, ids):
+    def constrain(tree):
+        return jax.lax.with_sharding_constraint(tree, shardings)
+
+    def step(params, opt, ids):
         loss, grads = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
-        new_mom = jax.tree.map(lambda m, g: momentum * m - eta * g, mom, grads)
-        new_params = jax.tree.map(jnp.add, params, new_mom)
+        if optimizer == "sgd":
+            new_opt = jax.tree.map(lambda m, g: momentum * m - eta * g,
+                                   opt, grads)
+            new_params = jax.tree.map(jnp.add, params, new_opt)
+            new_opt = constrain(new_opt)
+        else:
+            t = opt["t"] + 1
+            m = jax.tree.map(lambda m, g: momentum * m + (1 - momentum) * g,
+                             opt["m"], grads)
+            v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
+                             opt["v"], grads)
+            # bias-corrected step size, computed once from the traced count
+            a = eta * jnp.sqrt(1 - beta2 ** t.astype(jnp.float32)) \
+                / (1 - momentum ** t.astype(jnp.float32))
+            new_params = jax.tree.map(
+                lambda p, mm, vv: p - a * mm / (jnp.sqrt(vv) + eps),
+                params, m, v)
+            new_opt = {"m": constrain(m), "v": constrain(v), "t": t}
         # keep placements stable step-over-step
-        new_params = jax.lax.with_sharding_constraint(new_params, shardings)
-        new_mom = jax.lax.with_sharding_constraint(new_mom, shardings)
-        return new_params, new_mom, loss
+        new_params = constrain(new_params)
+        return new_params, new_opt, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 
@@ -349,4 +392,5 @@ def gpt_data_sharding(mesh: Mesh) -> NamedSharding:
 
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss", "gpt_decode",
-           "make_train_step", "gpt_place", "gpt_param_shardings"]
+           "gpt_opt_init", "make_train_step", "gpt_place",
+           "gpt_param_shardings"]

@@ -39,6 +39,10 @@ def main() -> int:
     ap.add_argument("--microbatch", type=int, default=2)
     ap.add_argument("--eta", type=float, default=0.1)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--adam", action="store_true",
+                    help="Adam instead of momentum SGD")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize blocks in backward (less HBM)")
     ap.add_argument("--ckpt", default="",
                     help="checkpoint dir: resume from it if present, save "
                          "into it at the end (sharded orbax format; works "
@@ -49,7 +53,8 @@ def main() -> int:
     import numpy as np
 
     from cxxnet_tpu.models.gpt import (GPTConfig, gpt_decode, gpt_init,
-                                       gpt_place, make_train_step)
+                                       gpt_opt_init, gpt_place,
+                                       make_train_step)
     from cxxnet_tpu.parallel.mesh import make_mesh
 
     raw = np.frombuffer(open(args.text, "rb").read(), np.uint8)
@@ -59,21 +64,23 @@ def main() -> int:
     cfg = GPTConfig(vocab_size=vocab, seq_len=args.seq, n_layer=args.layers,
                     n_head=args.heads, feat=args.feat,
                     n_microbatch=args.microbatch,
-                    dtype="bfloat16" if args.bf16 else "float32")
+                    dtype="bfloat16" if args.bf16 else "float32",
+                    remat=args.remat)
+    optname = "adam" if args.adam else "sgd"
 
     mesh = make_mesh(devices=jax.devices(), pipeline_parallel=args.pp,
                      seq_parallel=args.sp, model_parallel=args.tp)
     print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
 
     params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
-    mom = gpt_place(jax.tree.map(jax.numpy.zeros_like, params), mesh)
+    opt = gpt_opt_init(params, mesh, optname)
     if args.ckpt and os.path.isdir(args.ckpt):
         from cxxnet_tpu.utils import checkpoint
         state = checkpoint.restore(args.ckpt,
-                                   like={"params": params, "mom": mom})
-        params, mom = state["params"], state["mom"]
+                                   like={"params": params, "opt": opt})
+        params, opt = state["params"], state["opt"]
         print("resumed from %s" % args.ckpt)
-    step = make_train_step(cfg, mesh, eta=args.eta)
+    step = make_train_step(cfg, mesh, eta=args.eta, optimizer=optname)
 
     rs = np.random.RandomState(0)
     n_tok = args.batch * args.seq
@@ -85,7 +92,7 @@ def main() -> int:
 
     t0 = time.perf_counter()
     for i in range(args.steps):
-        params, mom, loss = step(params, mom, sample_batch())
+        params, opt, loss = step(params, opt, sample_batch())
         if i % 20 == 0 or i == args.steps - 1:
             dt = time.perf_counter() - t0
             tps = n_tok * (i + 1) / dt
@@ -93,7 +100,7 @@ def main() -> int:
 
     if args.ckpt:
         from cxxnet_tpu.utils import checkpoint
-        checkpoint.save(args.ckpt, {"params": params, "mom": mom})
+        checkpoint.save(args.ckpt, {"params": params, "opt": opt})
         print("checkpoint saved to %s" % args.ckpt)
 
     # greedy generation with the KV-cache decoder (one forward per token;
