@@ -240,12 +240,16 @@ class LRNLayer(Layer):
         if use_pallas() and os.environ.get("CXN_PALLAS_LRN", "") == "1":
             return [lrn_fused(x, n, self.alpha, self.beta, self.knorm)]
         c_dim = x.shape[-1]
-        if c_dim >= n and os.environ.get("CXN_LRN_REDUCE_WINDOW", "") != "1":
+        if (n <= c_dim <= 4096
+                and os.environ.get("CXN_LRN_REDUCE_WINDOW", "") != "1"):
             # band-matmul windowed sum: the cross-channel window rides the
             # MXU as x^2 @ B (C x C 0/1 band), instead of a reduce_window
             # along the 128-lane minor dim (measured on one v5e chip, bf16
-            # fwd+bwd: 7.3ms vs 52.4ms @ 512x55x55x96, 11.3 vs 29.7 @
-            # 512x27x27x256 — bit-identical output)
+            # fwd+bwd, bit-identical output: 7.3ms vs 52.4ms @
+            # 512x55x55x96, 11.3 vs 29.7 @ 512x27x27x256, and still ahead
+            # at every width tried up to 6.1 vs 7.6 @ 64x7x7x4096). Beyond
+            # C=4096 the O(C^2) dense band is unmeasured, so fall back;
+            # CXN_LRN_REDUCE_WINDOW=1 forces the fallback at any width.
             sq_sum = jax.lax.dot_general(
                 x * x, self._band_matrix(c_dim, x.dtype),
                 (((x.ndim - 1,), (0,)), ((), ())),

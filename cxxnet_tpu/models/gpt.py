@@ -207,9 +207,15 @@ def gpt_opt_init(params: Dict, mesh: Mesh, optimizer: str = "sgd") -> Dict:
     if optimizer == "sgd":
         return zeros
     if optimizer == "adam":
+        # t is mesh-replicated (not an uncommitted host scalar) so a
+        # checkpoint restore places it compatibly with the mesh-resident
+        # params instead of committing it to one device
+        from jax.sharding import NamedSharding, PartitionSpec
+        t = jax.device_put(jnp.zeros((), jnp.int32),
+                           NamedSharding(mesh, PartitionSpec()))
         return {"m": zeros,
                 "v": gpt_place(jax.tree.map(jnp.zeros_like, params), mesh),
-                "t": jnp.zeros((), jnp.int32)}
+                "t": t}
     raise ValueError("unknown optimizer %r" % optimizer)
 
 

@@ -37,7 +37,9 @@ def main() -> int:
     ap.add_argument("--sp", type=int, default=1, help="sequence shards")
     ap.add_argument("--tp", type=int, default=1, help="tensor shards")
     ap.add_argument("--microbatch", type=int, default=2)
-    ap.add_argument("--eta", type=float, default=0.1)
+    ap.add_argument("--eta", type=float, default=None,
+                    help="learning rate (default: 0.1 for sgd, 0.003 for "
+                         "--adam)")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--adam", action="store_true",
                     help="Adam instead of momentum SGD")
@@ -67,6 +69,8 @@ def main() -> int:
                     dtype="bfloat16" if args.bf16 else "float32",
                     remat=args.remat)
     optname = "adam" if args.adam else "sgd"
+    if args.eta is None:
+        args.eta = 0.003 if args.adam else 0.1
 
     mesh = make_mesh(devices=jax.devices(), pipeline_parallel=args.pp,
                      seq_parallel=args.sp, model_parallel=args.tp)
@@ -76,8 +80,17 @@ def main() -> int:
     opt = gpt_opt_init(params, mesh, optname)
     if args.ckpt and os.path.isdir(args.ckpt):
         from cxxnet_tpu.utils import checkpoint
-        state = checkpoint.restore(args.ckpt,
-                                   like={"params": params, "opt": opt})
+        try:
+            state = checkpoint.restore(args.ckpt,
+                                       like={"params": params, "opt": opt})
+        except Exception as e:
+            raise SystemExit(
+                "cannot resume from %s:\n  %s\n"
+                "(if the stored tree structure differs, common causes are a "
+                "different --layers/--feat/--tp than the checkpoint was "
+                "written with, or an optimizer mismatch: --%s here vs the "
+                "checkpoint's; checkpoints from before the --adam flag "
+                "stored the key 'mom')" % (args.ckpt, e, optname)) from e
         params, opt = state["params"], state["opt"]
         print("resumed from %s" % args.ckpt)
     step = make_train_step(cfg, mesh, eta=args.eta, optimizer=optname)
