@@ -195,6 +195,26 @@ def test_lrn_matches_torch(rng):
     np.testing.assert_allclose(out_nchw, tout.numpy(), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("nsize,c_dim", [(3, 7), (4, 8), (5, 5), (5, 96)])
+def test_lrn_band_matmul_matches_reduce_window(rng, monkeypatch, nsize, c_dim):
+    """The MXU band-matmul windowed sum must agree with the reduce_window
+    formulation it replaced (both paths stay selectable; conv.py:apply)."""
+    x = rng.randn(2, 4, 4, c_dim).astype(np.float32)
+
+    def run():
+        layer = make_layer("lrn", [("local_size", str(nsize)),
+                                   ("alpha", "0.001"), ("beta", "0.75")])
+        layer.infer_shapes([(c_dim, 4, 4)])
+        return np.asarray(layer.apply({}, [jnp.asarray(x)], ctx_eval())[0])
+
+    monkeypatch.delenv("CXN_PALLAS_LRN", raising=False)
+    monkeypatch.delenv("CXN_LRN_REDUCE_WINDOW", raising=False)
+    out_mm = run()
+    monkeypatch.setenv("CXN_LRN_REDUCE_WINDOW", "1")
+    out_rw = run()
+    np.testing.assert_allclose(out_mm, out_rw, rtol=1e-6, atol=1e-6)
+
+
 # ---------------------------------------------------------------- batch norm
 def test_batch_norm_normalizes(rng):
     layer = make_layer("batch_norm", [])
