@@ -8,11 +8,13 @@ Two kernels, both with CPU interpret-mode fallback for differential testing
   window is tiny, n<=7 in practice), the power, and the product. XLA's
   reduce_window formulation round-trips HBM between the squaring, window
   reduction, and scaling; the fused kernel is one read + one write.
-- **flash attention** (forward): O(N) memory exact attention for a single
-  device — the in-chip complement of ring attention (which bounds memory
-  *across* chips). Online softmax over K/V tiles held in VMEM, queries
-  blocked over the grid. Backward uses the standard recompute-by-block
-  custom VJP.
+- **flash attention** (forward + backward): O(N) memory exact attention for
+  a single device — the in-chip complement of ring attention (which bounds
+  memory *across* chips). Forward: online softmax over K/V tiles held in
+  VMEM, queries blocked over the grid, saving the per-row log-sum-exp.
+  Backward: FlashAttention-2-style blockwise kernels — one pass over
+  q-blocks for dq, one over k-blocks for dk/dv, probabilities recomputed
+  from the saved lse (never materializing the N x N matrix).
 
 Use ``use_pallas()`` to gate: True on TPU backends, else the jnp reference
 paths in the callers stay active.
@@ -28,6 +30,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _INTERPRET = False      # flipped by tests on CPU
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct for pallas_call that survives a ``check_vma``
+    shard_map: when tracing inside one (e.g. the gpipe body), the output
+    must carry the same varying-mesh-axes set as the input, or shard_map
+    rejects it (JAX >= 0.9)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def use_pallas() -> bool:
@@ -114,7 +127,7 @@ def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
         grid=((rows + pad) // tile,),
         in_specs=[pl.BlockSpec((tile, c), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((tile, c), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct(((rows + pad), c), x.dtype),
+        out_shape=_out_struct(((rows + pad), c), x.dtype, x),
         interpret=_INTERPRET,
     )(x2)
     if pad:
@@ -126,14 +139,14 @@ lrn_fused.defvjp(_lrn_fwd, _lrn_bwd)
 
 
 # ---------------------------------------------------------------------------
-# flash attention (forward kernel + recompute VJP)
+# flash attention (forward + blockwise backward kernels)
 # ---------------------------------------------------------------------------
 
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float):
     # q_ref: (1, 1, TQ, D) one (batch*head, q-block); k/v: (1, 1, N, D)
     q = q_ref[0, 0].astype(jnp.float32) * scale       # (TQ, D)
     tq, d = q.shape
@@ -168,9 +181,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         n_run = n_blocks
     o, m, l = jax.lax.fori_loop(0, n_run, body, (o0, m0, l0))
     o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # log-sum-exp of the scaled logits per row — the backward's residual
+    # (trailing singleton dim keeps the TPU block-tiling rule happy)
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
 def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Returns (out (b,n,h,d), lse (b,h,n,1)) — lse kept for the backward;
+    the trailing singleton dim satisfies the TPU block-tiling rule."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     # (b, h, n, d) layout: the kernel grid walks (batch, head, q-block)
@@ -181,7 +199,7 @@ def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
     bk = min(block_k, n)
     kern = functools.partial(_flash_kernel, block_k=bk, causal=causal,
                              scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(b, h, n // bq),
         in_specs=[
@@ -189,11 +207,131 @@ def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
             pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
             pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0)),
+        ],
+        out_shape=[
+            _out_struct((b, h, n, d), q.dtype, q),
+            _out_struct((b, h, n, 1), jnp.float32, q),
+        ],
         interpret=_INTERPRET,
     )(qt, kt, vt)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
+                     block_k: int, causal: bool, scale: float):
+    """dq for one (batch, head, q-block): dq = sum_s ds_s @ k_s * scale,
+    ds = p * (do @ v^T - delta), p = exp(q k^T scale - lse)."""
+    q = q_ref[0, 0].astype(jnp.float32) * scale        # (TQ, D) pre-scaled
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]                          # (TQ,)
+    delta = dl_ref[0, 0, :, 0]                         # (TQ,) rowsum(do*o)
+    tq, d = q.shape
+    n = k_ref.shape[2]
+    q0 = pl.program_id(2) * tq
+
+    def body(s, dq):
+        k = k_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        sc = q @ k.T                                   # (TQ, BK) scaled logits
+        if causal:
+            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+            kpos = s * block_k + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+        p = jnp.exp(sc - lse[:, None])
+        ds = p * (do @ v.T - delta[:, None])
+        return dq + ds @ k
+
+    n_blocks = n // block_k
+    n_run = jnp.minimum(n_blocks, (q0 + tq + block_k - 1) // block_k) \
+        if causal else n_blocks
+    dq = jax.lax.fori_loop(0, n_run, body, jnp.zeros((tq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
+                      dk_ref, dv_ref, *, block_q: int, causal: bool,
+                      scale: float):
+    """dk, dv for one (batch, head, k-block): dv = sum_i p_i^T @ do_i,
+    dk = sum_i ds_i^T @ q_i * scale."""
+    k = k_ref[0, 0].astype(jnp.float32)                # (TK, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    tk, d = k.shape
+    n = q_ref.shape[2]
+    k0 = pl.program_id(2) * tk
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
+        delta = dl_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
+        sc = q @ k.T                                   # (BQ, TK)
+        if causal:
+            qpos = i * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+            kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+        p = jnp.exp(sc - lse[:, None])
+        ds = p * (do @ v.T - delta[:, None])
+        return dk + ds.T @ q, dv + p.T @ do
+
+    n_blocks = n // block_q
+    # causal: q-blocks strictly before this k-block contribute nothing
+    lo = jnp.minimum(n_blocks, k0 // block_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lo, n_blocks, body,
+        (jnp.zeros((tk, d), jnp.float32), jnp.zeros((tk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)             # q pre-scaled => *scale done
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k):
+    b, n, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+    # delta[b,h,i,1] = rowsum(dO * O) — the softmax-grad correction term
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * jnp.transpose(o, (0, 2, 1, 3)).astype(jnp.float32), -1,
+                    keepdims=True)
+    bq = min(block_q, n)
+    bk = min(block_k, n)
+    blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
+    blk_kd = pl.BlockSpec((1, 1, bk, d), lambda i, j, s: (i, j, s, 0))
+    full_nd = pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0))
+    blk_q1 = pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0))
+    full_n1 = pl.BlockSpec((1, 1, n, 1), lambda i, j, s: (i, j, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_k=bk, causal=causal,
+                          scale=scale),
+        grid=(b, h, n // bq),
+        in_specs=[blk_qd, full_nd, full_nd, blk_qd, blk_q1, blk_q1],
+        out_specs=blk_qd,
+        out_shape=_out_struct((b, h, n, d), q.dtype, q),
+        interpret=_INTERPRET,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=bq, causal=causal,
+                          scale=scale),
+        grid=(b, h, n // bk),
+        in_specs=[blk_kd, blk_kd, full_nd, full_nd, full_n1, full_n1],
+        out_specs=[blk_kd, blk_kd],
+        out_shape=[_out_struct((b, h, n, d), k.dtype, k),
+                   _out_struct((b, h, n, d), v.dtype, v)],
+        interpret=_INTERPRET,
+    )(kt, vt, qt, dot, lse, delta)
+
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -201,22 +339,20 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 256):
     """Exact attention, O(N) memory. q,k,v: (batch, seq, heads, head_dim);
     seq must divide by the block sizes (clamped to seq)."""
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
-    # recompute-based backward through the reference math; still O(N^2) time
-    # but the forward's O(N) memory is what matters at inference/activation-
-    # checkpointed training (the checkpointed recompute IS this)
-    from .attention import full_attention
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: full_attention(a, b, c, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    # blockwise flash backward (FlashAttention-2 style): recompute p from
+    # the saved log-sum-exp, two pallas passes (dq; dk+dv), O(N) memory
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -106,9 +106,13 @@ def gpipe(block_fn: Callable, stacked_params, x: jnp.ndarray, mesh: Mesh,
     body = functools.partial(
         _gpipe_body, block_fn=block_fn, n_microbatch=n_microbatch,
         axis_name=axis_name)
+    # check_vma=False: pallas_call inside the body (flash attention for
+    # long sequences) trips shard_map's varying-mesh-axes checker (JAX 0.9
+    # errors out and itself suggests this flag); semantics are unchanged
     return jax.shard_map(body, mesh=mesh,
                          in_specs=(param_specs, x_spec),
-                         out_specs=x_spec)(stacked_params, x)
+                         out_specs=x_spec,
+                         check_vma=False)(stacked_params, x)
 
 
 __all__ = ["gpipe", "PIPE_AXIS"]

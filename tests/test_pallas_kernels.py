@@ -56,14 +56,21 @@ def test_flash_attention_matches_full(causal):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_attention_gradients():
+@pytest.mark.parametrize("causal,bq,bk", [
+    (True, 8, 8),      # causal, square blocks
+    (False, 8, 8),     # non-causal: n_run=n_blocks / lo=0 branches
+    (True, 16, 8),     # asymmetric blocks in both backward kernels
+    (False, 8, 16),
+])
+def test_flash_attention_gradients(causal, bq, bk):
     rs = np.random.RandomState(3)
     q, k, v = (jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
                for _ in range(3))
     g_ref = jax.grad(lambda a, b, c: (
-        full_attention(a, b, c, causal=True) ** 2).sum(), (0, 1, 2))(q, k, v)
+        full_attention(a, b, c, causal=causal) ** 2).sum(), (0, 1, 2))(q, k, v)
     g_out = jax.grad(lambda a, b, c: (
-        pk.flash_attention(a, b, c, True, 8, 8) ** 2).sum(), (0, 1, 2))(q, k, v)
+        pk.flash_attention(a, b, c, causal, bq, bk) ** 2).sum(),
+        (0, 1, 2))(q, k, v)
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
