@@ -86,25 +86,24 @@ def _block(q, k, v, o, m, l, causal, q_off, k_off):
     return o_new, m_new, l_new
 
 
-def ring_attention_inner(q, k, v, axis_name: str = "seq",
-                         causal: bool = False):
-    """Ring attention for use INSIDE an existing shard_map (e.g. a gpipe
-    block): q,k,v are the local (b, n_local, h, d) shards of a sequence
-    sharded over ``axis_name``. ``ring_attention`` wraps this in its own
-    shard_map for standalone use."""
+def _ring_vary(x, q, k, axis_name):
+    """Enter a ring loop with device-varying type (under check_vma
+    shard_map the carries become varying after the first accumulation)."""
+    vary_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma | {axis_name})
+    return lax.pcast(x, vary_axes, to='varying')
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal):
+    """One forward ring rotation. Returns (out (b,n,h,d), lse (b,h,n)) —
+    lse = max + log(sum) of the scaled logits, the backward's residual."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     n_local = q.shape[1]
     b, _, h, dd = q.shape
 
-    # carries must enter the loop with the same varying-axes type they exit
-    # with (they become device-varying after the first block accumulation);
-    # the varying set is derived from the inputs so this works under any
-    # enclosing shard_map
-    vary_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma | {axis_name})
-    o0 = lax.pcast(jnp.zeros((b, n_local, h, dd), jnp.float32), vary_axes, to='varying')
-    m0 = lax.pcast(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), vary_axes, to='varying')
-    l0 = lax.pcast(jnp.zeros((b, h, n_local), jnp.float32), vary_axes, to='varying')
+    o0 = _ring_vary(jnp.zeros((b, n_local, h, dd), jnp.float32), q, k, axis_name)
+    m0 = _ring_vary(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), q, k, axis_name)
+    l0 = _ring_vary(jnp.zeros((b, h, n_local), jnp.float32), q, k, axis_name)
 
     def step(i, carry):
         o, m, l, kk, vv = carry
@@ -125,7 +124,96 @@ def ring_attention_inner(q, k, v, axis_name: str = "seq",
     o, m, l = _block(q, kk, vv, o, m, l, causal,
                      q_off=my_idx * n_local, k_off=last_shard * n_local)
     norm = jnp.transpose(l, (0, 2, 1))[..., None]      # (b, nq, h, 1)
-    return (o / jnp.maximum(norm, 1e-30)).astype(q.dtype)
+    out = (o / jnp.maximum(norm, 1e-30)).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_inner(q, k, v, axis_name, causal):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_inner_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_inner_bwd(axis_name, causal, res, g):
+    """Backward ring: a second rotation recomputing each chunk's
+    probabilities from the saved lse (flash-style). dK/dV partials rotate
+    in lockstep with their K/V chunks, so after a full circle every chunk's
+    gradient has collected contributions from every query shard and is back
+    on its home device. O(n_local) residual memory — reverse-mode AD
+    through the forward loop would instead save every rotated chunk and
+    every per-step probability matrix (O(P * n_local^2))."""
+    q, k, v, out, lse = res
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    n_local = q.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    do = g.astype(jnp.float32)                         # (b, nq, h, d)
+    # softmax-grad correction: rowsum(dO * O), in lse's (b, h, nq) layout
+    delta = jnp.einsum("bqhd,bqhd->bhq", do, out.astype(jnp.float32))
+
+    dq0 = _ring_vary(jnp.zeros(q.shape, jnp.float32), q, k, axis_name)
+    dk0 = _ring_vary(jnp.zeros(k.shape, jnp.float32), q, k, axis_name)
+    dv0 = _ring_vary(jnp.zeros(v.shape, jnp.float32), q, k, axis_name)
+
+    def accumulate(i, dq, kk, vv, dk, dv):
+        k_shard = (my_idx + i) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = my_idx * n_local + jnp.arange(n_local)[:, None]
+            kpos = k_shard * n_local + jnp.arange(n_local)[None, :]
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                # exact probabilities
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, vv,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kk,
+                             preferred_element_type=jnp.float32)
+        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+                             preferred_element_type=jnp.float32)
+        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, do,
+                             preferred_element_type=jnp.float32)
+        return dq, dk, dv
+
+    perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+
+    def step(i, carry):
+        dq, kk, vv, dk, dv = carry
+        dq, dk, dv = accumulate(i, dq, kk, vv, dk, dv)
+        # rotate the chunk and its gradient together (full circle = home)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return dq, kk, vv, dk, dv
+
+    # last step peeled (like the forward): its kk/vv rotation would be
+    # discarded — only dk/dv still need one final hop to get home
+    dq, kk, vv, dk, dv = lax.fori_loop(0, axis_size - 1, step,
+                                       (dq0, k, v, dk0, dv0))
+    dq, dk, dv = accumulate(axis_size - 1, dq, kk, vv, dk, dv)
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_inner.defvjp(_ring_inner_fwd, _ring_inner_bwd)
+
+
+def ring_attention_inner(q, k, v, axis_name: str = "seq",
+                         causal: bool = False):
+    """Ring attention for use INSIDE an existing shard_map (e.g. a gpipe
+    block): q,k,v are the local (b, n_local, h, d) shards of a sequence
+    sharded over ``axis_name``. ``ring_attention`` wraps this in its own
+    shard_map for standalone use. Custom VJP: the backward is a second
+    ring pass recomputing probabilities from the saved log-sum-exp."""
+    return _ring_inner(q, k, v, axis_name, causal)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
