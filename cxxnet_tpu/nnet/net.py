@@ -34,7 +34,8 @@ from ..graph import NetGraph
 from ..layers import ApplyContext, create_layer
 from ..layers.base import Layer
 from ..metrics import MetricSet
-from ..parallel.distributed import global_batch, init_distributed
+from ..parallel.distributed import (global_batch, init_distributed,
+                                    local_rows)
 from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
 from ..parallel.sharding import resolve_shardings
 from ..updaters import create_updater
@@ -392,7 +393,7 @@ class Net:
 
     def _accumulate_train_metrics(self, batch, mouts) -> None:
         uniq = sorted(set(self._metric_nodes))
-        node_to_out = {n: np.asarray(o) for n, o in zip(uniq, mouts)}
+        node_to_out = {n: local_rows(o) for n, o in zip(uniq, mouts)}
         labels = self._host_labels(batch.label)
         preds = [node_to_out[n] for n in self._metric_nodes]
         self.train_metrics.add_eval(preds, labels)
@@ -464,7 +465,7 @@ class Net:
                       for k, v in self._host_labels(batch.label).items()}
             preds = []
             for n in self._metric_nodes:
-                out = np.asarray(node_to_out[n])
+                out = local_rows(node_to_out[n])
                 preds.append(out.reshape(out.shape[0], -1)[:n_valid])
             self.eval_metrics.add_eval(preds, labels)
         return ret + self.eval_metrics.print(name)
@@ -496,7 +497,7 @@ class Net:
         data, extras, _ = self._device_batch(batch)
         outs = self._jit_forward(self.params, self.states, data, extras,
                                  (node_id,))
-        return np.asarray(outs[0])
+        return local_rows(outs[0])
 
     # ------------------------------------------------------- weight access
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:

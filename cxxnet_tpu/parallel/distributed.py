@@ -78,5 +78,23 @@ def global_batch(mesh: Mesh, sharding: NamedSharding,
     return jax.make_array_from_process_local_data(sharding, host_local)
 
 
+def local_rows(arr) -> np.ndarray:
+    """This process's rows of a batch-sharded global array, in global row
+    order. Single-process: the whole array. Multi-host: a global array's
+    value cannot be fetched (its shards span other processes); each process
+    reads back exactly the rows it contributed via ``global_batch``, so
+    per-process metrics/predictions line up with its local labels — the
+    per-worker accounting of the reference's dist mode."""
+    if not is_multi_host():
+        return np.asarray(arr)
+    # one shard per distinct dim-0 slice: replicas across other mesh axes
+    # (model/pipe) or GSPMD replication hold duplicate rows
+    by_start = {}
+    for s in arr.addressable_shards:
+        by_start.setdefault(s.index[0].start or 0, s)
+    return np.concatenate(
+        [np.asarray(by_start[st].data) for st in sorted(by_start)], axis=0)
+
+
 __all__ = ["init_distributed", "process_index", "process_count",
-           "is_multi_host", "global_batch"]
+           "is_multi_host", "global_batch", "local_rows"]

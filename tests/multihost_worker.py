@@ -1,0 +1,48 @@
+"""Worker process for test_multihost.py — NOT a test module.
+
+Rank ``argv[1]`` of 2 joins the jax.distributed runtime (gloo CPU
+collectives, 2 local virtual devices => 4 global), trains a small MLP
+data-parallel for 3 steps feeding only its half of each global batch
+(the per-process shard contract of the reference's dist workers,
+iter_thread_imbin_x-inl.hpp:119-130), and dumps the resulting params.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    rank, port, outdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from cxxnet_tpu.parallel.distributed import (init_distributed,
+                                                 is_multi_host,
+                                                 process_count)
+    init_distributed("127.0.0.1:" + port, 2, rank)
+    assert is_multi_host() and process_count() == 2
+
+    import numpy as np
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.utils.config import tokenize
+    from tests.test_multihost import CONF, make_batches, flat_params
+
+    net = Net(tokenize(CONF))
+    net.init_model()
+    for xb, yb in make_batches():
+        lo, hi = rank * 8, (rank + 1) * 8
+
+        class B:
+            data, label, extra_data = xb[lo:hi], yb[lo:hi], []
+            num_batch_padd = 0
+
+        net.update(B)
+    np.savez(os.path.join(outdir, "params_rank%d.npz" % rank),
+             **flat_params(net))
+    print("rank", rank, "done")
+
+
+if __name__ == "__main__":
+    main()
