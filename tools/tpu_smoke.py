@@ -1,0 +1,120 @@
+"""On-chip smoke test for TPU-only dispatch paths.
+
+The CPU test suite cannot see code that only runs on a real TPU backend
+(``use_pallas()`` gates, Mosaic lowering of the flash kernels, pallas
+inside the gpipe shard_map): the GPT seq>=512 path once compiled fine on
+CPU and crashed on TPU. Run this after touching kernels, attention
+dispatch, or shard_map code:
+
+    python tools/tpu_smoke.py          # ambient env (axon TPU), ~2-3 min
+
+Exit code 0 = every path compiled and executed on the chip.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def smoke_alexnet():
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.models import alexnet_config
+    from cxxnet_tpu.utils.config import tokenize
+
+    net = Net(tokenize(alexnet_config(batch_size=64, dev="",
+                                      precision="bfloat16")))
+    net.init_model()
+    rs = np.random.RandomState(0)
+
+    class _B:
+        data = rs.rand(64, 3, 227, 227).astype(np.float32)
+        label = rs.randint(0, 1000, (64, 1)).astype(np.float32)
+        extra_data = []
+        num_batch_padd = 0
+
+    net.update(_B)
+    loss = float(net._last_loss)
+    assert np.isfinite(loss), loss
+    print("alexnet train step (band-matmul LRN): loss %.3f" % loss)
+
+
+def smoke_flash_attention():
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pallas_kernels import flash_attention
+    from cxxnet_tpu.ops.attention import full_attention
+
+    rs = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rs.randn(2, 1024, 4, 64), jnp.bfloat16)
+               for _ in range(3))
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 3e-2, err          # bf16 tolerance
+    g = jax.jit(jax.grad(lambda q: flash_attention(q, k, v, True)
+                         .astype(jnp.float32).sum()))(q)
+    assert np.isfinite(float(jnp.abs(g).max()))
+    print("flash attention fwd+bwd kernels @1024: max fwd err %.1e" % err)
+
+
+def smoke_gpt_long_seq():
+    """The path that once crashed TPU-only: flash dispatch inside gpipe."""
+    import jax
+    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_init, gpt_opt_init,
+                                       gpt_place, make_train_step)
+    from cxxnet_tpu.parallel.mesh import make_mesh
+
+    cfg = GPTConfig(vocab_size=256, seq_len=512, n_layer=2, n_head=4,
+                    feat=256, n_microbatch=2, dtype="bfloat16")
+    mesh = make_mesh(devices=jax.devices())
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    opt = gpt_opt_init(params, mesh, "adam")
+    step = make_train_step(cfg, mesh, eta=1e-3, optimizer="adam")
+    rs = np.random.RandomState(2)
+    ids = jax.numpy.asarray(rs.randint(0, 256, (4, 512)).astype(np.int32))
+    params, opt, loss = step(params, opt, ids)
+    assert np.isfinite(float(loss)), float(loss)
+    print("GPT seq-512 train step (flash in gpipe shard_map): loss %.3f"
+          % float(loss))
+
+
+def smoke_decode():
+    import jax
+    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_decode, gpt_init,
+                                       gpt_place)
+    from cxxnet_tpu.parallel.mesh import make_mesh
+
+    cfg = GPTConfig(vocab_size=256, seq_len=128, n_layer=2, n_head=4,
+                    feat=128, dtype="bfloat16")
+    mesh = make_mesh(devices=jax.devices())
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    prompt = jax.numpy.asarray(np.array([[1, 2, 3]], np.int32))
+    out = gpt_decode(params, prompt, 16, cfg, mesh)
+    assert out.shape[1] == 3 + 16
+    print("KV-cache decode: %d tokens" % out.shape[1])
+
+
+def main() -> int:
+    import jax
+    from cxxnet_tpu.ops import pallas_kernels
+
+    backend = jax.default_backend()
+    assert backend in ("tpu", "axon") and not pallas_kernels._INTERPRET, (
+        "not on a TPU backend (got %r) — this script exists to exercise "
+        "TPU-only dispatch paths; exit-0 off-chip would be meaningless"
+        % backend)
+    t0 = time.time()
+    for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
+               smoke_decode):
+        fn()
+    print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
