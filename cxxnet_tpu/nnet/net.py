@@ -74,6 +74,7 @@ class Net:
         self.model_parallel = 1
         self.seq_parallel = 1
         self.shard_optimizer = 0
+        self.dist_feed = "replicated"
         self.precision = "float32"
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
@@ -94,6 +95,11 @@ class Net:
                 self.seq_parallel = int(v)
             elif k == "shard_optimizer":
                 self.shard_optimizer = int(v)
+            elif k == "dist_feed":
+                if v not in ("replicated", "sharded"):
+                    raise ConfigError(
+                        "dist_feed must be 'replicated' or 'sharded'")
+                self.dist_feed = v
             elif k == "precision":
                 self.precision = v
             elif k.startswith("metric"):
@@ -145,6 +151,11 @@ class Net:
         # join the multi-host runtime first (no-op single-host), then build
         # the mesh over the now-global device set
         init_distributed()
+        if jax.process_count() > 1 and \
+                self.batch_size % jax.process_count():
+            raise ConfigError(
+                "batch_size %d must divide the %d-process run"
+                % (self.batch_size, jax.process_count()))
         self.mesh = make_mesh(self.dev, self.model_parallel,
                               self.seq_parallel)
         self.n_data_shards = self.mesh.shape["data"]
@@ -345,16 +356,60 @@ class Net:
     def _device_batch(self, batch):
         """Move a host DataBatch to the mesh (data-axis sharded). Multi-host:
         each process contributes its local slice of the global batch
-        (parallel/distributed.py)."""
+        (parallel/distributed.py). Iterators that shard their dataset per
+        rank (imgbin dist_worker_rank) yield batch_size/P rows which pass
+        through as-is; non-sharded iterators (mnist/img with identical
+        seeds on every process) yield the full global batch, from which
+        each process contributes only its own row range — the replicated-
+        reader mode for datasets without rank sharding."""
         sh = batch_sharding(self.mesh)
         dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        data = global_batch(self.mesh, sh, np.asarray(batch.data, np.float32))
+        data = global_batch(self.mesh, sh, self._local_slice(batch.data))
         if self.precision == "bfloat16":
             data = data.astype(dtype)
-        label = global_batch(self.mesh, sh, np.asarray(batch.label, np.float32))
-        extras = [global_batch(self.mesh, sh, np.asarray(e, np.float32))
+        label = global_batch(self.mesh, sh, self._local_slice(batch.label))
+        extras = [global_batch(self.mesh, sh, self._local_slice(e))
                   for e in batch.extra_data]
         return data, extras, label
+
+    def _local_slice(self, x) -> np.ndarray:
+        """This process's row range of a host batch array.
+
+        ``dist_feed = replicated`` (default): every process's iterator
+        yields the full global batch (deterministic shuffle, same seed);
+        each rank keeps only its row range. ``dist_feed = sharded``: the
+        iterator chain is configured to yield batch_size/P rows per
+        process (dataset rank-sharded, e.g. imgbin dist_worker_rank with a
+        per-section ``batch_size = global/P``); rows pass through as-is.
+        Single-process: unchanged."""
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return np.asarray(x, np.float32)
+        step = self.batch_size // nproc
+        if self.dist_feed == "sharded":
+            if x.shape[0] != step:
+                raise ValueError(
+                    "dist_feed=sharded expects %d rows/process (global "
+                    "batch %d over %d processes), got %d — configure the "
+                    "data section's batch_size accordingly"
+                    % (step, self.batch_size, nproc, x.shape[0]))
+            return np.asarray(x, np.float32)
+        if x.shape[0] != self.batch_size:
+            raise ValueError(
+                "dist_feed=replicated expects the full global batch %d "
+                "per process, got %d rows" % (self.batch_size, x.shape[0]))
+        rank = jax.process_index()
+        return np.asarray(x[rank * step:(rank + 1) * step], np.float32)
+
+    def _rank_valid(self, batch) -> int:
+        """Number of this rank's local rows that are real instances (the
+        short-pad tail occupies the end of the *global* batch)."""
+        n_valid = batch.data.shape[0] - batch.num_batch_padd
+        nproc = jax.process_count()
+        if nproc <= 1 or self.dist_feed == "sharded":
+            return n_valid
+        step = self.batch_size // nproc
+        return int(np.clip(n_valid - jax.process_index() * step, 0, step))
 
     def _train_mask(self, batch) -> Optional[jnp.ndarray]:
         """Mask out short-pad duplicates; round_batch wrap instances are real
@@ -363,7 +418,8 @@ class Net:
             b = batch.data.shape[0]
             mask = np.ones((b,), np.float32)
             mask[b - batch.num_batch_padd:] = 0.0
-            return global_batch(self.mesh, batch_sharding(self.mesh), mask)
+            return global_batch(self.mesh, batch_sharding(self.mesh),
+                                self._local_slice(mask))
         return None
 
     def update(self, batch) -> None:
@@ -394,7 +450,7 @@ class Net:
     def _accumulate_train_metrics(self, batch, mouts) -> None:
         uniq = sorted(set(self._metric_nodes))
         node_to_out = {n: local_rows(o) for n, o in zip(uniq, mouts)}
-        labels = self._host_labels(batch.label)
+        labels = self._host_labels(self._local_slice(batch.label))
         preds = [node_to_out[n] for n in self._metric_nodes]
         self.train_metrics.add_eval(preds, labels)
 
@@ -460,9 +516,10 @@ class Net:
             outs = self._jit_forward(self.params, self.states, data, extras,
                                      uniq)
             node_to_out = dict(zip(uniq, outs))
-            n_valid = batch.data.shape[0] - batch.num_batch_padd
+            local_label = self._local_slice(batch.label)
+            n_valid = self._rank_valid(batch)
             labels = {k: v[:n_valid]
-                      for k, v in self._host_labels(batch.label).items()}
+                      for k, v in self._host_labels(local_label).items()}
             preds = []
             for n in self._metric_nodes:
                 out = local_rows(node_to_out[n])
@@ -475,8 +532,7 @@ class Net:
         """argmax of the final node if it is a vector, else the raw scalar
         (nnet_impl:286-299)."""
         out = self._forward_node(batch, self._out_node)
-        n_valid = batch.data.shape[0] - batch.num_batch_padd
-        out = out.reshape(out.shape[0], -1)[:n_valid]
+        out = out.reshape(out.shape[0], -1)[:self._rank_valid(batch)]
         if out.shape[1] == 1:
             return out[:, 0]
         return np.argmax(out, axis=1).astype(np.float32)
@@ -490,8 +546,7 @@ class Net:
         else:
             nid = self.graph.node_map[node]
         out = self._forward_node(batch, nid)
-        n_valid = batch.data.shape[0] - batch.num_batch_padd
-        return out[:n_valid]
+        return out[:self._rank_valid(batch)]
 
     def _forward_node(self, batch, node_id: int) -> np.ndarray:
         data, extras, _ = self._device_batch(batch)

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Multi-host training demo — the analogue of the reference's mpi.conf
+# (/root/reference/example/MNIST/mpi.conf: num_servers/num_workers + ps-lite
+# launcher). Here there are no parameter servers: each process joins one
+# global device mesh via jax.distributed (CXXNET_* env vars, read by
+# cxxnet_tpu.parallel.distributed.init_distributed) and gradients meet in
+# XLA collectives. Each process feeds its own shard of every global batch.
+#
+# This demo runs 2 processes on localhost with 2 virtual CPU devices each
+# (a 4-device global mesh) — on real TPU pods, run one process per host
+# with no XLA_FLAGS/JAX_PLATFORMS overrides and point CXXNET_COORDINATOR
+# at host 0.
+#
+#   ./multihost.sh MNIST.conf
+set -e
+CONF="${1:-MNIST.conf}"
+PORT="${PORT:-9876}"
+
+run_rank() {
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  CXXNET_COORDINATOR="127.0.0.1:${PORT}" \
+  CXXNET_NUM_WORKER=2 \
+  CXXNET_RANK="$1" \
+  python -m cxxnet_tpu "${CONF}" "${@:2}"
+}
+
+trap 'kill $PID0 $PID1 2>/dev/null || true' EXIT INT TERM
+run_rank 0 "$@" &
+PID0=$!
+run_rank 1 "$@" > /dev/null 2>&1 &
+PID1=$!
+wait $PID0
+wait $PID1
+trap - EXIT
+echo "multihost run finished"

@@ -27,6 +27,7 @@ layer[+0] = softmax
 netconfig=end
 input_shape = 1,1,8
 batch_size = 16
+dist_feed = sharded
 eta = 0.1
 momentum = 0.9
 seed = 5
