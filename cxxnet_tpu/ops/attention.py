@@ -56,9 +56,8 @@ def local_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
     """Single-device attention dispatch: the Pallas flash kernel (O(N) memory,
     ops/pallas_kernels.py) for long block-aligned sequences on TPU, else the
     exact XLA formulation."""
-    from .pallas_kernels import flash_attention, use_pallas
-    n = q.shape[1]
-    if use_pallas() and n >= 512 and n % 256 == 0:
+    from .pallas_kernels import flash_attention
+    if _ring_chunk_kernels(q.shape[1]):
         return flash_attention(q, k, v, causal)
     return full_attention(q, k, v, causal=causal)
 
@@ -86,6 +85,31 @@ def _block(q, k, v, o, m, l, causal, q_off, k_off):
     return o_new, m_new, l_new
 
 
+# Pallas dispatch threshold, shared by local_attention and the ring's
+# chunk path (monkeypatched down by the interpret-mode tests): sequences /
+# per-device chunks at least this long and aligned run their blockwise
+# math in the flash kernels, making memory O(n) instead of an O(n^2) f32
+# score matrix
+_RING_PALLAS_MIN = 512
+_RING_PALLAS_ALIGN = 256
+
+
+def _ring_chunk_kernels(n_local: int) -> bool:
+    from .pallas_kernels import use_pallas
+    return (use_pallas() and n_local >= _RING_PALLAS_MIN
+            and n_local % _RING_PALLAS_ALIGN == 0)
+
+
+def _chunk_case(causal, k_shard, my_idx, full_fn, diag_fn, skip_fn):
+    """Whole-chunk causal-mask cases of a ring step: chunks strictly
+    earlier than this device's queries are fully visible, the home chunk
+    is standard causal, later chunks are fully masked."""
+    if not causal:
+        return full_fn(None)
+    idx = jnp.clip(k_shard - my_idx, -1, 1) + 1
+    return lax.switch(idx, (full_fn, diag_fn, skip_fn), None)
+
+
 def _ring_vary(x, q, k, axis_name):
     """Enter a ring loop with device-varying type (under check_vma
     shard_map the carries become varying after the first accumulation)."""
@@ -105,12 +129,45 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
     m0 = _ring_vary(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), q, k, axis_name)
     l0 = _ring_vary(jnp.zeros((b, h, n_local), jnp.float32), q, k, axis_name)
 
+    use_kernels = _ring_chunk_kernels(n_local)
+
+    def accumulate(k_shard, o, m, l, kk, vv):
+        if not use_kernels:
+            return _block(q, kk, vv, o, m, l, causal,
+                          q_off=my_idx * n_local, k_off=k_shard * n_local)
+        # flash-kernel chunk: compute (o_c, lse_c) for this (q, chunk)
+        # pair and fold it into the running (o, m, l) accumulators. The
+        # causal mask across chunks is one of three whole-chunk cases.
+        from .pallas_kernels import flash_fwd_with_lse
+
+        def chunk_full(_):
+            return flash_fwd_with_lse(q, kk, vv, False)
+
+        def chunk_diag(_):
+            return flash_fwd_with_lse(q, kk, vv, True)
+
+        def chunk_skip(_):
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full((b, h, n_local), _NEG_INF, jnp.float32))
+
+        o_c, lse_c = _chunk_case(causal, k_shard, my_idx,
+                                 chunk_full, chunk_diag, chunk_skip)
+        # exact partial-softmax merge; lse_c = -1e30 (skip) only ever
+        # combines after the diagonal chunk (step 0) made m finite, so
+        # exp(lse_c - M) underflows to 0 rather than exp(0)
+        m_new = jnp.maximum(m, lse_c)
+        w_acc = jnp.exp(m - m_new)                    # (b, h, nq)
+        w_c = jnp.exp(lse_c - m_new)
+        o = (o * jnp.transpose(w_acc, (0, 2, 1))[..., None]
+             + o_c.astype(jnp.float32)
+             * jnp.transpose(w_c, (0, 2, 1))[..., None])
+        return o, m_new, l * w_acc + w_c
+
     def step(i, carry):
         o, m, l, kk, vv = carry
         # after i left-rotations we hold the K/V shard of rank (my_idx + i)
         k_shard = (my_idx + i) % axis_size
-        o, m, l = _block(q, kk, vv, o, m, l, causal,
-                         q_off=my_idx * n_local, k_off=k_shard * n_local)
+        o, m, l = accumulate(k_shard, o, m, l, kk, vv)
         perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
@@ -121,8 +178,7 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
     o, m, l, kk, vv = lax.fori_loop(0, axis_size - 1, step,
                                     (o0, m0, l0, k, v))
     last_shard = (my_idx + axis_size - 1) % axis_size
-    o, m, l = _block(q, kk, vv, o, m, l, causal,
-                     q_off=my_idx * n_local, k_off=last_shard * n_local)
+    o, m, l = accumulate(last_shard, o, m, l, kk, vv)
     norm = jnp.transpose(l, (0, 2, 1))[..., None]      # (b, nq, h, 1)
     out = (o / jnp.maximum(norm, 1e-30)).astype(q.dtype)
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
@@ -161,8 +217,34 @@ def _ring_inner_bwd(axis_name, causal, res, g):
     dk0 = _ring_vary(jnp.zeros(k.shape, jnp.float32), q, k, axis_name)
     dv0 = _ring_vary(jnp.zeros(v.shape, jnp.float32), q, k, axis_name)
 
+    use_kernels = _ring_chunk_kernels(n_local)
+    g_in = g.astype(q.dtype)
+
     def accumulate(i, dq, kk, vv, dk, dv):
         k_shard = (my_idx + i) % axis_size
+        if use_kernels:
+            # blockwise kernels with the *global* lse/delta: p = exp(s -
+            # lse) is globally normalized, so each chunk's grads are its
+            # exact contribution (pallas_kernels.flash_bwd_blocks)
+            from .pallas_kernels import flash_bwd_blocks
+
+            def chunk_full(_):
+                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, False)
+
+            def chunk_diag(_):
+                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, True)
+
+            def chunk_skip(_):
+                return (jnp.zeros(q.shape, q.dtype),
+                        jnp.zeros(kk.shape, kk.dtype),
+                        jnp.zeros(vv.shape, vv.dtype))
+
+            dq_c, dk_c, dv_c = _chunk_case(causal, k_shard, my_idx,
+                                           chunk_full, chunk_diag,
+                                           chunk_skip)
+            return (dq + dq_c.astype(jnp.float32),
+                    dk + dk_c.astype(jnp.float32),
+                    dv + dv_c.astype(jnp.float32))
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
                        preferred_element_type=jnp.float32) * scale
         if causal:
@@ -238,8 +320,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = P(batch_ax, axis_name, None, None)
     body = functools.partial(ring_attention_inner, axis_name=axis_name,
                              causal=causal)
+    # disable the varying-axes checker only when the chunks are long enough
+    # that the body will dispatch to the Pallas flash kernels, which the
+    # checker rejects inside shard_map (JAX 0.9)
+    vma_ok = not _ring_chunk_kernels(q.shape[1] // max(n_seq, 1))
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         out_specs=spec, check_vma=vma_ok)(q, k, v)
 
 
 __all__ = ["full_attention", "local_attention", "ring_attention",

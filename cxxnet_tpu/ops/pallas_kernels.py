@@ -291,16 +291,38 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k):
+    dot = jnp.transpose(g, (0, 2, 1, 3))
+    # delta[b,h,i] = rowsum(dO * O) — the softmax-grad correction term
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * jnp.transpose(o, (0, 2, 1, 3)).astype(jnp.float32), -1)
+    return flash_bwd_blocks(q, k, v, lse[..., 0], delta, g, causal,
+                            block_q, block_k)
+
+
+def flash_fwd_with_lse(q, k, v, causal: bool, block_q: int = 256,
+                       block_k: int = 256):
+    """Forward kernel returning (out (b,n,h,d), lse (b,h,n)) for callers
+    that combine partial softmaxes themselves (ring attention chunks)."""
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out, lse[..., 0]
+
+
+def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
+                     block_q: int = 256, block_k: int = 256):
+    """Blockwise dq/dk/dv given the softmax row statistics.
+
+    q,k,v,g: (b, n, h, d); lse/delta: (b, h, n) f32 — lse may come from a
+    *global* softmax spanning more chunks than k (ring attention): then
+    p = exp(s - lse) are the globally-normalized probabilities and the
+    returned grads are this chunk's exact contribution."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     dot = jnp.transpose(g, (0, 2, 1, 3))
-    # delta[b,h,i,1] = rowsum(dO * O) — the softmax-grad correction term
-    delta = jnp.sum(dot.astype(jnp.float32)
-                    * jnp.transpose(o, (0, 2, 1, 3)).astype(jnp.float32), -1,
-                    keepdims=True)
+    lse = lse[..., None]
+    delta = delta[..., None]
     bq = min(block_q, n)
     bk = min(block_k, n)
     blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
@@ -357,4 +379,5 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
-__all__ = ["use_pallas", "lrn_fused", "flash_attention"]
+__all__ = ["use_pallas", "lrn_fused", "flash_attention",
+           "flash_fwd_with_lse", "flash_bwd_blocks"]

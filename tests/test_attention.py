@@ -69,3 +69,37 @@ def test_causal_first_token_attends_only_itself():
     out = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(v[0, 0]),
                                rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_chunk_kernels_match_full(causal, monkeypatch):
+    """The Pallas chunk-kernel path inside the ring (forward lse-merge +
+    blockwise backward with the global lse) must match exact attention.
+    Interpret mode + lowered threshold so the path runs on CPU."""
+    import cxxnet_tpu.ops.attention as att
+    import cxxnet_tpu.ops.pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    monkeypatch.setattr(att, "_RING_PALLAS_MIN", 8)
+    monkeypatch.setattr(att, "_RING_PALLAS_ALIGN", 8)
+
+    rs = np.random.RandomState(3)
+    q, k, v = _qkv(rs, n=32, d=16)
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+    assert att._ring_chunk_kernels(32 // 4)
+
+    ref = full_attention(q, k, v, causal=causal)
+    out = jax.jit(lambda a, b_, c: ring_attention(
+        a, b_, c, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda a, b_, c: (
+        full_attention(a, b_, c, causal=causal) ** 2).sum(),
+        (0, 1, 2))(q, k, v)
+    g_out = jax.jit(jax.grad(lambda a, b_, c: (
+        ring_attention(a, b_, c, mesh, causal=causal) ** 2).sum(),
+        (0, 1, 2)))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
