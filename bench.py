@@ -24,11 +24,11 @@ import time
 import numpy as np
 
 BASELINE_IMAGES_PER_SEC = 800.0
-# 512 = the reference's ImageNet batch 256 (ImageNet.conf) rounded up to the
-# chip's throughput sweet spot (measured: 8.5k img/s @128, 13.5k @512,
-# 14.0k @1024 with reduce_window LRN; 16.6k @512 with the band-matmul LRN —
-# the MXU wants the larger GEMMs; 512 keeps memory modest)
-BATCH = 512
+# 1024 = the reference's ImageNet batch 256 (ImageNet.conf) scaled to the
+# chip's throughput sweet spot (measured with the band-matmul LRN: ~16k
+# img/s @512, ~17k @1024 repeatably — the MXU wants the larger GEMMs;
+# 2048 ran out of HBM headroom for the im2col temporaries)
+BATCH = 1024
 WARMUP_STEPS = 3
 BENCH_STEPS = 50
 

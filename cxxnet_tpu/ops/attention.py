@@ -147,7 +147,8 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
             return flash_fwd_with_lse(q, kk, vv, True)
 
         def chunk_skip(_):
-            return (jnp.zeros(q.shape, q.dtype),
+            # f32 to match the kernels' f32 partial outputs across branches
+            return (jnp.zeros(q.shape, jnp.float32),
                     jnp.full((b, h, n_local), _NEG_INF, jnp.float32))
 
         o_c, lse_c = _chunk_case(causal, k_shard, my_idx,
@@ -229,22 +230,22 @@ def _ring_inner_bwd(axis_name, causal, res, g):
             from .pallas_kernels import flash_bwd_blocks
 
             def chunk_full(_):
-                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, False)
+                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, False,
+                                        out_dtype=jnp.float32)
 
             def chunk_diag(_):
-                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, True)
+                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, True,
+                                        out_dtype=jnp.float32)
 
             def chunk_skip(_):
-                return (jnp.zeros(q.shape, q.dtype),
-                        jnp.zeros(kk.shape, kk.dtype),
-                        jnp.zeros(vv.shape, vv.dtype))
+                return (jnp.zeros(q.shape, jnp.float32),
+                        jnp.zeros(kk.shape, jnp.float32),
+                        jnp.zeros(vv.shape, jnp.float32))
 
             dq_c, dk_c, dv_c = _chunk_case(causal, k_shard, my_idx,
                                            chunk_full, chunk_diag,
                                            chunk_skip)
-            return (dq + dq_c.astype(jnp.float32),
-                    dk + dk_c.astype(jnp.float32),
-                    dv + dv_c.astype(jnp.float32))
+            return dq + dq_c, dk + dk_c, dv + dv_c
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
                        preferred_element_type=jnp.float32) * scale
         if causal:

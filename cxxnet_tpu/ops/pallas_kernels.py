@@ -186,7 +186,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
-def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
+def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int,
+                    out_dtype=None):
     """Returns (out (b,n,h,d), lse (b,h,n,1)) — lse kept for the backward;
     the trailing singleton dim satisfies the TPU block-tiling rule."""
     b, n, h, d = q.shape
@@ -212,7 +213,7 @@ def _flash_fwd_impl(q, k, v, causal: bool, block_q: int, block_k: int):
             pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0)),
         ],
         out_shape=[
-            _out_struct((b, h, n, d), q.dtype, q),
+            _out_struct((b, h, n, d), out_dtype or q.dtype, q),
             _out_struct((b, h, n, 1), jnp.float32, q),
         ],
         interpret=_INTERPRET,
@@ -291,24 +292,27 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k):
-    dot = jnp.transpose(g, (0, 2, 1, 3))
     # delta[b,h,i] = rowsum(dO * O) — the softmax-grad correction term
-    delta = jnp.sum(dot.astype(jnp.float32)
-                    * jnp.transpose(o, (0, 2, 1, 3)).astype(jnp.float32), -1)
+    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
+                       o.astype(jnp.float32))
     return flash_bwd_blocks(q, k, v, lse[..., 0], delta, g, causal,
                             block_q, block_k)
 
 
 def flash_fwd_with_lse(q, k, v, causal: bool, block_q: int = 256,
                        block_k: int = 256):
-    """Forward kernel returning (out (b,n,h,d), lse (b,h,n)) for callers
-    that combine partial softmaxes themselves (ring attention chunks)."""
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+    """Forward kernel returning (out (b,n,h,d) f32, lse (b,h,n)) for
+    callers that combine partial softmaxes themselves (ring attention
+    chunks). The partial output stays f32 so the caller's merge does not
+    accumulate per-chunk bf16 rounding."""
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                               out_dtype=jnp.float32)
     return out, lse[..., 0]
 
 
 def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
-                     block_q: int = 256, block_k: int = 256):
+                     block_q: int = 256, block_k: int = 256,
+                     out_dtype=None):
     """Blockwise dq/dk/dv given the softmax row statistics.
 
     q,k,v,g: (b, n, h, d); lse/delta: (b, h, n) f32 — lse may come from a
@@ -337,7 +341,7 @@ def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
         grid=(b, h, n // bq),
         in_specs=[blk_qd, full_nd, full_nd, blk_qd, blk_q1, blk_q1],
         out_specs=blk_qd,
-        out_shape=_out_struct((b, h, n, d), q.dtype, q),
+        out_shape=_out_struct((b, h, n, d), out_dtype or q.dtype, q),
         interpret=_INTERPRET,
     )(qt, kt, vt, dot, lse, delta)
 
@@ -347,8 +351,8 @@ def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
         grid=(b, h, n // bk),
         in_specs=[blk_kd, blk_kd, full_nd, full_nd, full_n1, full_n1],
         out_specs=[blk_kd, blk_kd],
-        out_shape=[_out_struct((b, h, n, d), k.dtype, k),
-                   _out_struct((b, h, n, d), v.dtype, v)],
+        out_shape=[_out_struct((b, h, n, d), out_dtype or k.dtype, k),
+                   _out_struct((b, h, n, d), out_dtype or v.dtype, v)],
         interpret=_INTERPRET,
     )(kt, vt, qt, dot, lse, delta)
 
