@@ -38,7 +38,7 @@ from ..parallel.distributed import (global_batch, init_distributed,
                                     local_rows)
 from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
 from ..parallel.sharding import resolve_shardings
-from ..updaters import create_updater
+from ..updaters import create_updater, global_norm_scale
 from ..utils.config import ConfigError
 
 _CKPT_MAGIC = b"CXTPU001"
@@ -75,6 +75,7 @@ class Net:
         self.seq_parallel = 1
         self.shard_optimizer = 0
         self.dist_feed = "replicated"
+        self.clip_norm = 0.0
         self.precision = "float32"
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
@@ -95,6 +96,8 @@ class Net:
                 self.seq_parallel = int(v)
             elif k == "shard_optimizer":
                 self.shard_optimizer = int(v)
+            elif k == "clip_norm":
+                self.clip_norm = float(v)
             elif k == "dist_feed":
                 if v not in ("replicated", "sharded"):
                     raise ConfigError(
@@ -321,6 +324,14 @@ class Net:
         return params, opt_state, gsum
 
     def _apply_grads(self, params, opt_state, grads, epoch):
+        if self.clip_norm > 0.0:
+            # global-norm clipping across every weight tensor (config
+            # ``clip_norm``) — the whole-model complement of the
+            # reference's per-element clip_gradient; NaNs are zeroed
+            # first (the reference clip functor's NaN -> 0 behavior)
+            scale = global_norm_scale(grads, self.clip_norm)
+            grads = jax.tree.map(
+                lambda g: jnp.nan_to_num(g) * scale, grads)
         new_params = {}
         new_opt = {}
         constrain = jax.lax.with_sharding_constraint

@@ -196,21 +196,61 @@ class AdamUpdater(Updater):
     def init_state(self, w):
         return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
 
+    def _adam_step(self, grad, state, epoch, lr):
+        """Shared bias-corrected moment update; returns (delta, new_state)."""
+        e = jnp.asarray(epoch, jnp.float32)
+        fix1 = 1.0 - jnp.power(1.0 - self.decay1, e + 1)
+        fix2 = 1.0 - jnp.power(1.0 - self.decay2, e + 1)
+        lr_t = lr * jnp.sqrt(fix2) / fix1
+        m1 = state["m1"] + self.decay1 * (grad - state["m1"])
+        m2 = state["m2"] + self.decay2 * (jnp.square(grad) - state["m2"])
+        return -lr_t * (m1 / (jnp.sqrt(m2) + 1e-8)), {"m1": m1, "m2": m2}
+
     def update(self, w, grad, state, epoch):
         grad = self._prep_grad(grad, w)
         if self.param.wd > 0.0:
             grad = grad - self.param.wd * w   # reference sign quirk
-        e = jnp.asarray(epoch, jnp.float32)
-        fix1 = 1.0 - jnp.power(1.0 - self.decay1, e + 1)
-        fix2 = 1.0 - jnp.power(1.0 - self.decay2, e + 1)
-        lr_t = self.param.base_lr * jnp.sqrt(fix2) / fix1
-        m1 = state["m1"] + self.decay1 * (grad - state["m1"])
-        m2 = state["m2"] + self.decay2 * (jnp.square(grad) - state["m2"])
-        w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
-        return w, {"m1": m1, "m2": m2}
+        # reference adam ignores the lr schedule (adam_updater-inl.hpp
+        # recomputes from base lr every step) — reproduced deliberately
+        delta, new_state = self._adam_step(grad, state, epoch,
+                                           self.param.base_lr)
+        return w + delta, new_state
 
 
-UPDATER_REGISTRY = {c.type_name: c for c in (SGDUpdater, NAGUpdater, AdamUpdater)}
+class AdamWUpdater(AdamUpdater):
+    """Decoupled weight decay (AdamW): wd scales the weight directly by
+    lr*wd per step instead of entering the gradient moments — the modern
+    extension beyond the reference's ``grad -= wd*w`` Adam quirk
+    (adam_updater-inl.hpp:73-82). Betas use the same one-minus ("decay")
+    convention as the reference Adam."""
+    type_name = "adamw"
+
+    def update(self, w, grad, state, epoch):
+        grad = self._prep_grad(grad, w)
+        # the scheduled lr scales both the step and the decay, like
+        # torch.optim.AdamW (unlike the reference adam, adamw honors
+        # lr:schedule — it is a modern extension, not a parity op)
+        lr, _ = self.param.schedule(epoch)
+        delta, new_state = self._adam_step(grad, state, epoch, lr)
+        return w - lr * self.param.wd * w + delta, new_state
+
+
+UPDATER_REGISTRY = {c.type_name: c
+                    for c in (SGDUpdater, NAGUpdater, AdamUpdater,
+                              AdamWUpdater)}
+
+
+def global_norm_scale(grads, max_norm: float):
+    """Scale factor for global-norm gradient clipping over a pytree of
+    grads: min(1, max_norm / ||g||_2). NaN entries are excluded from the
+    norm; the caller is responsible for zeroing them in the gradients
+    themselves (Net._apply_grads does) — scaling alone leaves NaN*scale
+    = NaN."""
+    leaves = jax.tree.leaves(grads)
+    sq = sum(jnp.sum(jnp.square(jnp.nan_to_num(g.astype(jnp.float32))))
+             for g in leaves)
+    norm = jnp.sqrt(sq)
+    return jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
 
 
 def create_updater(kind: str, tag: str, cfg: Pairs) -> Updater:

@@ -1,6 +1,7 @@
 """Model-zoo configs build, shape-infer, and (for a small inception-style
 block) train — integration coverage for split/ch_concat/batch_norm graphs."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -167,3 +168,22 @@ dev = cpu
     net = Net(tk(cfg))
     with pytest.raises(ConfigError, match="loss"):
         net.init_model()
+
+
+def test_clip_norm_and_adamw_train():
+    """clip_norm + updater=adamw wired through the trainer: loss decreases
+    and no step produces non-finite params."""
+    cfg = MINI_INCEPTION + "\nclip_norm = 1.0\nupdater = adamw\nwd = 0.01\n"
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(1)
+    losses = []
+    for i in range(20):
+        x = rs.randn(16, 4, 16, 16).astype(np.float32)
+        y = (x[:, 0].mean(axis=(1, 2)) > 0).astype(np.float32)
+        net.update(DataBatch(x, y.reshape(16, 1)))
+        losses.append(float(net._last_loss))
+    assert losses[-1] < losses[0], "loss did not decrease: %s" % losses
+    for tags in net.params.values():
+        for w in tags.values():
+            assert bool(jnp.isfinite(w).all())

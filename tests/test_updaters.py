@@ -101,3 +101,65 @@ def test_clip_grad():
     g = jnp.asarray(np.array([np.nan, 10.0, -10.0, 0.5], np.float32))
     out = np.asarray(clip_grad(g, 2.0))
     np.testing.assert_allclose(out, [0.0, 2.0, -2.0, 0.5])
+
+
+def test_adamw_decoupled_decay():
+    """AdamW: wd shrinks weights by lr*wd directly; the moment estimates see
+    the raw gradient (unlike adam, whose wd enters the gradient)."""
+    from cxxnet_tpu.updaters import AdamWUpdater
+    cfg = [("eta", "0.1"), ("wd", "0.5"), ("beta1", "0.1"), ("beta2", "0.001")]
+    upd_w = AdamWUpdater("wmat", cfg)
+    upd_a = AdamUpdater("wmat", cfg)
+    w = jnp.asarray(np.full((3,), 2.0, np.float32))
+    g = jnp.asarray(np.full((3,), 1.0, np.float32))
+    w1, s1 = upd_w.update(w, g, upd_w.init_state(w), 0)
+    # moments identical to wd=0 adam; decay term = lr*wd*w on top
+    upd_0 = AdamUpdater("wmat", [("eta", "0.1"), ("wd", "0"),
+                                 ("beta1", "0.1"), ("beta2", "0.001")])
+    w_ref, s_ref = upd_0.update(w, g, upd_0.init_state(w), 0)
+    np.testing.assert_allclose(np.asarray(w1),
+                               np.asarray(w_ref) - 0.1 * 0.5 * np.asarray(w),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1["m1"]), np.asarray(s_ref["m1"]))
+    # and differs from the reference adam's coupled wd
+    w_a, _ = upd_a.update(w, g, upd_a.init_state(w), 0)
+    assert not np.allclose(np.asarray(w1), np.asarray(w_a))
+
+
+def test_adamw_matches_torch():
+    """Cross-framework oracle: one AdamW step vs torch.optim.AdamW (betas
+    converted from the one-minus convention)."""
+    import pytest
+    torch = pytest.importorskip("torch")
+    from cxxnet_tpu.updaters import AdamWUpdater
+
+    lr, wd, d1, d2 = 0.05, 0.2, 0.1, 0.001
+    w0 = np.array([1.5, -2.0, 0.5], np.float32)
+    g0 = np.array([0.3, -0.7, 1.1], np.float32)
+
+    upd = AdamWUpdater("wmat", [("eta", str(lr)), ("wd", str(wd)),
+                                ("beta1", str(d1)), ("beta2", str(d2))])
+    w1, _ = upd.update(jnp.asarray(w0), jnp.asarray(g0),
+                       upd.init_state(jnp.asarray(w0)), 0)
+
+    tw = torch.tensor(w0, requires_grad=True)
+    opt = torch.optim.AdamW([tw], lr=lr, betas=(1 - d1, 1 - d2),
+                            weight_decay=wd, eps=1e-8)
+    tw.grad = torch.tensor(g0)
+    opt.step()
+    np.testing.assert_allclose(np.asarray(w1), tw.detach().numpy(),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_global_norm_scale():
+    from cxxnet_tpu.updaters import global_norm_scale
+    grads = {"a": {"w": jnp.asarray(np.array([3.0, 0.0], np.float32))},
+             "b": {"w": jnp.asarray(np.array([0.0, 4.0], np.float32))}}
+    # ||g|| = 5; clip to 2.5 -> scale 0.5
+    np.testing.assert_allclose(float(global_norm_scale(grads, 2.5)), 0.5,
+                               rtol=1e-6)
+    # under the bound -> no scaling
+    np.testing.assert_allclose(float(global_norm_scale(grads, 10.0)), 1.0)
+    # NaN leaves are excluded, not poisoning the norm
+    grads["a"]["w"] = jnp.asarray(np.array([np.nan, 3.0], np.float32))
+    assert np.isfinite(float(global_norm_scale(grads, 2.5)))
