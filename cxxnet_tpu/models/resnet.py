@@ -1,0 +1,106 @@
+"""ResNet (He et al. 2015) netconfig generator — bottleneck residual nets.
+
+Beyond the reference's model era (cxxnet predates ResNet; its layer zoo has
+concat joins but no residual nets), but entirely expressible in the same
+config DSL: the ``add`` N->1 elementwise-sum layer (layers/attention.py)
+plays the shortcut join, ``batch_norm`` with ``moving_average = 1`` provides
+modern eval-time statistics, and strided 1x1 projection convs downsample the
+identity path. Depths: 50 = [3,4,6,3], 101 = [3,4,23,3] bottlenecks.
+"""
+
+from __future__ import annotations
+
+_PLANS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3)}
+
+
+def _bn(L, src, name):
+    L.append("layer[%s->%s] = batch_norm:%s" % (src, src, name))
+    L.append("  moving_average = 1")
+
+
+def resnet_config(depth: int = 50, batch_size: int = 256,
+                  num_classes: int = 1000, dev: str = "tpu",
+                  precision: str = "bfloat16") -> str:
+    if depth not in _PLANS:
+        raise ValueError("supported depths: %s" % sorted(_PLANS))
+    plan = _PLANS[depth]
+    L = ["netconfig=start"]
+
+    # stem: 7x7/2 conv + BN + relu + 3x3/2 max pool
+    L.append("layer[0->stem] = conv:conv1")
+    L.append("  kernel_size = 7")
+    L.append("  stride = 2")
+    L.append("  pad = 3")
+    L.append("  nchannel = 64")
+    L.append("  random_type = kaiming")
+    L.append("  no_bias = 1")
+    _bn(L, "stem", "bn1")
+    L.append("layer[stem->stem] = relu")
+    # ceil-mode pooling (the reference's formula): k3/s2 unpadded on 112
+    # lands on 56, dimensionally equal to torch's pad-1 floor-mode stem
+    L.append("layer[stem->p1] = max_pooling")
+    L.append("  kernel_size = 3")
+    L.append("  stride = 2")
+
+    src = "p1"
+    for stage, reps in enumerate(plan, start=2):
+        width = 64 * 2 ** (stage - 2)          # bottleneck inner width
+        for r in range(1, reps + 1):
+            stride = 2 if (r == 1 and stage > 2) else 1
+            base = "s%dr%d" % (stage, r)
+            # main path: 1x1 (stride) -> 3x3 -> 1x1 (4x width), BN each
+            specs = [(1, stride, width), (3, 1, width), (1, 1, 4 * width)]
+            inner = src
+            for i, (k, st, ch) in enumerate(specs, start=1):
+                dst = "%s_c%d" % (base, i)
+                L.append("layer[%s->%s] = conv:%s" % (inner, dst, dst))
+                L.append("  kernel_size = %d" % k)
+                if k == 3:
+                    L.append("  pad = 1")
+                if st != 1:
+                    L.append("  stride = %d" % st)
+                L.append("  nchannel = %d" % ch)
+                L.append("  random_type = kaiming")
+                L.append("  no_bias = 1")
+                _bn(L, dst, dst + "_bn")
+                if i < 3:
+                    L.append("layer[%s->%s] = relu" % (dst, dst))
+                inner = dst
+            # shortcut: identity, or strided 1x1 projection on stage entry
+            if r == 1:
+                sc = base + "_sc"
+                L.append("layer[%s->%s] = conv:%s" % (src, sc, sc))
+                L.append("  kernel_size = 1")
+                if stride != 1:
+                    L.append("  stride = %d" % stride)
+                L.append("  nchannel = %d" % (4 * width))
+                L.append("  random_type = kaiming")
+                L.append("  no_bias = 1")
+                _bn(L, sc, sc + "_bn")
+            else:
+                sc = src
+            out = base
+            L.append("layer[%s,%s->%s] = add" % (inner, sc, out))
+            L.append("layer[%s->%s] = relu" % (out, out))
+            src = out
+
+    L.append("layer[%s->gap] = avg_pooling" % src)
+    L.append("  kernel_size = 7")
+    L.append("  stride = 7")
+    L.append("layer[gap->flat] = flatten")
+    L.append("layer[flat->fc] = fullc:fc%d" % num_classes)
+    L.append("  nhidden = %d" % num_classes)
+    L.append("  init_sigma = 0.01")
+    L.append("layer[fc->fc] = softmax")
+    L.append("netconfig=end")
+    L.append("input_shape = 3,224,224")
+    L.append("batch_size = %d" % batch_size)
+    if dev:
+        L.append("dev = %s" % dev)
+    L.append("precision = %s" % precision)
+    L.append("eta = 0.1")
+    L.append("momentum = 0.9")
+    L.append("wd = 0.0001")
+    L.append("metric = error")
+    L.append("metric = rec@5")
+    return "\n".join(L) + "\n"

@@ -185,6 +185,7 @@ class AdamUpdater(Updater):
     def __init__(self, tag, cfg):
         self.decay1 = 0.1
         self.decay2 = 0.001
+        self.eps = 1e-8
         super().__init__(tag, cfg)
 
     def set_param(self, name, val):
@@ -192,6 +193,8 @@ class AdamUpdater(Updater):
             self.decay1 = float(val)
         elif name == "beta2":
             self.decay2 = float(val)
+        elif name == "eps":
+            self.eps = float(val)
 
     def init_state(self, w):
         return {"m1": jnp.zeros_like(w), "m2": jnp.zeros_like(w)}
@@ -204,7 +207,7 @@ class AdamUpdater(Updater):
         lr_t = lr * jnp.sqrt(fix2) / fix1
         m1 = state["m1"] + self.decay1 * (grad - state["m1"])
         m2 = state["m2"] + self.decay2 * (jnp.square(grad) - state["m2"])
-        return -lr_t * (m1 / (jnp.sqrt(m2) + 1e-8)), {"m1": m1, "m2": m2}
+        return -lr_t * (m1 / (jnp.sqrt(m2) + self.eps)), {"m1": m1, "m2": m2}
 
     def update(self, w, grad, state, epoch):
         grad = self._prep_grad(grad, w)

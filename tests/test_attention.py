@@ -103,3 +103,27 @@ def test_ring_flash_chunk_kernels_match_full(causal, monkeypatch):
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_attention_matches_torch_sdpa():
+    """Cross-framework oracle (PairTest-with-Caffe spirit, SURVEY §4.2):
+    our exact attention and the ring implementation vs torch's
+    scaled_dot_product_attention."""
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(5)
+    b, n, h, d = 2, 32, 4, 16
+    q, k, v = (rs.randn(b, n, h, d).astype(np.float32) for _ in range(3))
+
+    tq, tk, tv = (torch.from_numpy(x.transpose(0, 2, 1, 3)) for x in (q, k, v))
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        tq, tk, tv, is_causal=True).numpy().transpose(0, 2, 1, 3)
+
+    ours = np.asarray(full_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=True))
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-5)
+
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+    ring = np.asarray(jax.jit(lambda a, b_, c: ring_attention(
+        a, b_, c, mesh, causal=True))(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v)))
+    np.testing.assert_allclose(ring, ref, rtol=2e-5, atol=2e-5)
