@@ -1,7 +1,8 @@
 """Pallas TPU kernels for the hot ops.
 
-Two kernels, both with CPU interpret-mode fallback for differential testing
-(the PairTest philosophy, SURVEY §4.1 — Pallas vs XLA-reference numerics):
+Kernel families, all with CPU interpret-mode fallback for differential
+testing (the PairTest philosophy, SURVEY §4.1 — Pallas vs XLA-reference
+numerics):
 
 - **fused LRN** (reference chpool LRN, lrn_layer-inl.hpp:46-57): one VMEM
   pass computes x², the cross-channel window sum (lane-dim shifts — the
