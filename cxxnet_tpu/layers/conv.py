@@ -82,16 +82,62 @@ class ConvLayer(Layer):
 
     def apply(self, params: Params, inputs: List[jnp.ndarray],
               ctx: ApplyContext) -> List[jnp.ndarray]:
+        import os
         p = self.param
-        out = jax.lax.conv_general_dilated(
-            inputs[0], params["wmat"].astype(inputs[0].dtype),
-            window_strides=(p.stride, p.stride),
-            padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=p.num_group)
+        x = inputs[0]
+        w = params["wmat"].astype(x.dtype)
+        # opt-in (CXN_S2D=1): measured no gain on one v5e chip — 17.8k
+        # img/s with vs 18.0k without on the AlexNet bench (tunnel noise
+        # band); XLA's own conv lowering already handles the 3-channel
+        # stem well. Kept as an exact, tested lever for other topologies.
+        if (self.in_channel <= 4 and p.stride >= 2 and p.num_group == 1
+                and os.environ.get("CXN_S2D", "") == "1"):
+            out = self._space_to_depth_conv(x, w, p)
+        else:
+            out = jax.lax.conv_general_dilated(
+                x, w,
+                window_strides=(p.stride, p.stride),
+                padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p.num_group)
         if "bias" in params:
             out = out + params["bias"].astype(out.dtype)
         return [out]
+
+    @staticmethod
+    def _space_to_depth_conv(x, w, p):
+        """Stem convs with <=4 input channels starve the MXU's 128-deep
+        contraction (and their dW pass was 7.4% of the AlexNet step in the
+        op profile). Exact rewrite: stride-s conv == stride-1 conv on the
+        space-to-depth input (s x s x C blocks -> one pixel of s^2*C
+        channels) with the kernel rearranged the same way —
+        out(y,x) = sum w[ps+a, qs+b, c] * in[ys+p*s+a, ...] regrouped over
+        (p, q) x (a, b, c). Same sums, same order of magnitude better
+        channel depth (3 -> 48 for AlexNet conv1)."""
+        s = p.stride
+        kh, kw, ic, oc = w.shape
+        b, hh, ww_, _ = x.shape
+        # explicit conv padding first, then right-pad H/W to block multiples
+        # and the kernel taps to block multiples (zero taps read only the
+        # zero-padded tail, so the result is unchanged)
+        x = jnp.pad(x, ((0, 0), (p.pad_y, (-(hh + 2 * p.pad_y)) % s + p.pad_y),
+                        (p.pad_x, (-(ww_ + 2 * p.pad_x)) % s + p.pad_x),
+                        (0, 0)))
+        kh2, kw2 = -(-kh // s), -(-kw // s)
+        w = jnp.pad(w, ((0, kh2 * s - kh), (0, kw2 * s - kw), (0, 0), (0, 0)))
+        hb, wb = x.shape[1] // s, x.shape[2] // s
+        x = x.reshape(b, hb, s, wb, s, ic).transpose(0, 1, 3, 2, 4, 5) \
+             .reshape(b, hb, wb, s * s * ic)
+        w = w.reshape(kh2, s, kw2, s, ic, oc).transpose(0, 2, 1, 3, 4, 5) \
+             .reshape(kh2, kw2, s * s * ic, oc)
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # ceil-padding can add one extra block row/col of pure padding;
+        # crop to the true conv output size
+        oy = (hh + 2 * p.pad_y - kh) // s + 1
+        ox = (ww_ + 2 * p.pad_x - kw) // s + 1
+        return out[:, :oy, :ox]
 
 
 def _pool_out_dim(in_dim: int, k: int, stride: int, max_start: int) -> int:

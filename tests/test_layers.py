@@ -317,3 +317,31 @@ def test_multi_logistic_grad(rng):
     g = np.asarray(jax.grad(loss_fn)(jnp.asarray(x))).reshape(4, 3)
     sig = 1 / (1 + np.exp(-x.reshape(4, 3)))
     np.testing.assert_allclose(g, (sig - y) / 4.0, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("k,s,pad,h", [(11, 4, 0, 227), (7, 2, 3, 224),
+                                       (5, 2, 2, 33)])
+def test_conv_space_to_depth_matches_direct(rng, monkeypatch, k, s, pad, h):
+    """The low-channel strided-conv space-to-depth rewrite is exact."""
+    layer = make_layer("conv", [("kernel_size", str(k)), ("stride", str(s)),
+                                ("pad", str(pad)), ("nchannel", "16"),
+                                ("random_type", "gaussian"),
+                                ("init_sigma", "0.1")])
+    layer.infer_shapes([(3, h, h)])
+    params = layer.init_params(jax.random.PRNGKey(0), [(3, h, h)])
+    x = jnp.asarray(rng.randn(2, h, h, 3).astype(np.float32))
+
+    from cxxnet_tpu.layers.conv import ConvLayer
+    calls = []
+    real = ConvLayer.__dict__["_space_to_depth_conv"].__func__
+    monkeypatch.setattr(
+        ConvLayer, "_space_to_depth_conv",
+        staticmethod(lambda *a: (calls.append(1), real(*a))[1]))
+    monkeypatch.setenv("CXN_S2D", "1")
+    out_s2d = np.asarray(layer.apply(params, [x], ctx_eval())[0])
+    assert calls, "space-to-depth path was not taken (guard regressed?)"
+    monkeypatch.delenv("CXN_S2D", raising=False)
+    out_dir = np.asarray(layer.apply(params, [x], ctx_eval())[0])
+    assert len(calls) == 1, "direct path unexpectedly used the rewrite"
+    assert out_s2d.shape == out_dir.shape
+    np.testing.assert_allclose(out_s2d, out_dir, rtol=1e-5, atol=1e-5)
