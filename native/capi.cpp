@@ -12,6 +12,9 @@
 
 #include <Python.h>
 
+#include <unistd.h>
+
+#include <cstdlib>
 #include <cstring>
 #include <string>
 
@@ -123,7 +126,41 @@ const char *CXNGetLastError(void) { return g_last_error.c_str(); }
 int CXNInit(const char *repo_path) {
   bool fresh = false;
   if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
+    // Point the embedded runtime at a specific interpreter so its
+    // environment (a venv's site-packages, via pyvenv.cfg discovery) is
+    // adopted: CXN_PYTHON=<path/to/python> explicitly, else an active
+    // VIRTUAL_ENV. Without either, the bare libpython prefix is used,
+    // which may lack numpy/jax.
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    config.install_signal_handlers = 0;
+    std::string exe;
+    if (const char *p = getenv("CXN_PYTHON")) {
+      exe = p;
+    } else if (const char *ve = getenv("VIRTUAL_ENV")) {
+      exe = std::string(ve) + "/bin/python3";
+    }
+    PyStatus st;
+    if (!exe.empty()) {
+      if (access(exe.c_str(), X_OK) != 0) {
+        PyConfig_Clear(&config);
+        g_last_error = "CXNInit: CXN_PYTHON/VIRTUAL_ENV interpreter not "
+                       "executable: " + exe;
+        return -1;
+      }
+      st = PyConfig_SetBytesString(&config, &config.executable, exe.c_str());
+      if (PyStatus_Exception(st)) {
+        PyConfig_Clear(&config);
+        g_last_error = "CXNInit: bad CXN_PYTHON/VIRTUAL_ENV path";
+        return -1;
+      }
+    }
+    st = Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    if (PyStatus_Exception(st)) {
+      g_last_error = st.err_msg ? st.err_msg : "Py_InitializeFromConfig failed";
+      return -1;
+    }
     fresh = true;
   }
   {

@@ -122,6 +122,10 @@ def test_c_abi_end_to_end():
     assert r.returncode == 0, r.stderr
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env["JAX_PLATFORMS"] = "cpu"
+    # the embedded CPython must see THIS interpreter's packages (venv or
+    # PYTHONPATH installs) — capi.cpp adopts the environment of the python
+    # named by CXN_PYTHON
+    env["CXN_PYTHON"] = sys.executable
     r = subprocess.run([os.path.join(native, "capi_test"), ROOT],
                        capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
