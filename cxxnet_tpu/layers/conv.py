@@ -275,15 +275,19 @@ class LRNLayer(Layer):
         return [self.check_one_to_one(in_shapes)]
 
     def apply(self, params, inputs, ctx):
-        # the Pallas fused LRN is opt-in (CXN_PALLAS_LRN=1): measured on
-        # v5e, XLA's reduce_window fusion wins for AlexNet's 96/256-channel
-        # maps (50.8k vs 41.9k img/s) because the channel dim misaligns the
-        # 128-lane tiles; the kernel pays off only for 128-multiple channels
+        # the Pallas fused LRN is opt-in (CXN_PALLAS_LRN=1): measured on one
+        # v5e chip the XLA band-matmul path below still wins at every width
+        # tried (fwd+bwd bf16: 10.9 vs 18.9 ms @ 1024x55x55x96, 8.0 vs 11.5
+        # @ 1024x27x27x256, 5.4 vs 5.8 @ 256x14x14x1024) — sub-128 channel
+        # widths halve the kernel's DMA efficiency, and XLA's pow/scale
+        # fusion is already near the HBM floor
         import os
-        from ..ops.pallas_kernels import lrn_fused, use_pallas
+        from ..ops.pallas_kernels import (LRN_MAX_CHANNELS, lrn_fused,
+                                          use_pallas)
         x = inputs[0]
         n = self.nsize
-        if use_pallas() and os.environ.get("CXN_PALLAS_LRN", "") == "1":
+        if (use_pallas() and os.environ.get("CXN_PALLAS_LRN", "") == "1"
+                and n <= x.shape[-1] <= LRN_MAX_CHANNELS):
             return [lrn_fused(x, n, self.alpha, self.beta, self.knorm)]
         c_dim = x.shape[-1]
         if (n <= c_dim <= 4096

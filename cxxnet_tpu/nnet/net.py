@@ -273,8 +273,13 @@ class Net:
 
     def _entry_nodes(self, data: jnp.ndarray,
                      extras: List[jnp.ndarray]) -> Dict[int, jnp.ndarray]:
-        """NCHW host batch -> NHWC device nodes."""
-        nodes = {0: jnp.transpose(data, (0, 2, 3, 1))}
+        """NCHW host batch -> NHWC device nodes. The data node is cast to
+        the compute dtype (fused no-op when _device_batch already delivered
+        bf16); extra-data nodes keep their f32 entry dtype, as always."""
+        data = jnp.transpose(data, (0, 2, 3, 1))
+        if self.precision == "bfloat16":
+            data = data.astype(jnp.bfloat16)
+        nodes = {0: data}
         for i, e in enumerate(extras):
             nodes[1 + i] = jnp.transpose(e, (0, 2, 3, 1))
         return nodes
@@ -374,10 +379,16 @@ class Net:
         each process contributes only its own row range — the replicated-
         reader mode for datasets without rank sharding."""
         sh = batch_sharding(self.mesh)
-        dtype = jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
-        data = global_batch(self.mesh, sh, self._local_slice(batch.data))
+        data_np = self._local_slice(batch.data)
         if self.precision == "bfloat16":
-            data = data.astype(dtype)
+            # host-side compute-dtype conversion: halves the host->device
+            # bytes and removes the separate on-device convert pass
+            # (measured 1.5 ms at batch 1024). In the prefetching pipeline
+            # this runs in the producer thread, off the step's critical
+            # path; the jitted step's own cast (_entry_nodes) then no-ops.
+            import ml_dtypes
+            data_np = data_np.astype(ml_dtypes.bfloat16)
+        data = global_batch(self.mesh, sh, data_np)
         label = global_batch(self.mesh, sh, self._local_slice(batch.label))
         extras = [global_batch(self.mesh, sh, self._local_slice(e))
                   for e in batch.extra_data]

@@ -4,11 +4,15 @@ Kernel families, all with CPU interpret-mode fallback for differential
 testing (the PairTest philosophy, SURVEY §4.1 — Pallas vs XLA-reference
 numerics):
 
-- **fused LRN** (reference chpool LRN, lrn_layer-inl.hpp:46-57): one VMEM
-  pass computes x², the cross-channel window sum (lane-dim shifts — the
-  window is tiny, n<=7 in practice), the power, and the product. XLA's
-  reduce_window formulation round-trips HBM between the squaring, window
-  reduction, and scaling; the fused kernel is one read + one write.
+- **fused LRN** (reference chpool LRN, lrn_layer-inl.hpp:46-57): forward and
+  backward are each ONE VMEM pass; the cross-channel window sum is an
+  in-kernel band matmul on the MXU and the backward recomputes it from x
+  (residual: x only). Opt-in (CXN_PALLAS_LRN=1): measured on one v5e chip
+  the XLA band-matmul formulation in layers/conv.py still wins (fwd+bwd
+  bf16: 10.9 vs 18.9 ms @ 1024x55x55x96, 8.0 vs 11.5 @ 1024x27x27x256,
+  5.4 vs 5.8 @ 256x14x14x1024) — sub-128 channel widths halve the
+  kernel's effective DMA bandwidth, and XLA's fusion of the pow/scale
+  passes is already near the traffic floor.
 - **flash attention** (forward + backward): O(N) memory exact attention for
   a single device — the in-chip complement of ring attention (which bounds
   memory *across* chips). Forward: online softmax over K/V tiles held in
@@ -57,28 +61,51 @@ def use_pallas() -> bool:
 # fused LRN
 # ---------------------------------------------------------------------------
 
+def _lrn_band(c: int, n: int, transpose: bool = False):
+    """(C, C) 0/1 band matrix in-kernel: B[j, c] = 1 iff channel j is in the
+    size-n window (left-biased center, reference chpool) of channel c.
+    Generated from iotas in VMEM — never touches HBM."""
+    pad_lo = (n - 1) // 2
+    j = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1 if transpose else 0)
+    cc = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0 if transpose else 1)
+    band = (j >= cc - pad_lo) & (j <= cc + n - 1 - pad_lo)
+    return band.astype(jnp.float32)
+
+
 def _lrn_kernel(x_ref, o_ref, *, n: int, alpha: float, beta: float,
                 knorm: float):
-    x = x_ref[:].astype(jnp.float32)            # (TR, C)
-    sq = x * x
-    c = x.shape[-1]
-    pad_lo = (n - 1) // 2
-    acc = sq
-    # window sum via lane shifts; window offsets relative to pad_lo-centering
-    for off in range(n):
-        d = off - pad_lo
-        if d == 0:
-            continue    # the center term is the initial acc
-        shifted = jnp.roll(sq, -d, axis=-1)
-        # zero the wrapped lanes
-        idx = jax.lax.broadcasted_iota(jnp.int32, sq.shape, 1)
-        if d > 0:
-            mask = idx < (c - d)
-        else:
-            mask = idx >= (-d)
-        acc = acc + jnp.where(mask, shifted, 0.0)
-    norm = knorm + (alpha / n) * acc
-    o_ref[:] = (x * norm ** (-beta)).astype(o_ref.dtype)
+    """One-pass fwd: the cross-channel window sum rides the MXU as
+    x^2 @ band inside the kernel — one HBM read, one write. Dot operands
+    stay in the input dtype (bf16 on the fast MXU path, like the XLA band
+    formulation); only the accumulator and the pow are f32."""
+    xb = x_ref[:]                               # (TR, C), input dtype
+    c = xb.shape[-1]
+    s = jax.lax.dot(xb * xb, _lrn_band(c, n).astype(xb.dtype),
+                    preferred_element_type=jnp.float32)
+    x = xb.astype(jnp.float32)
+    norm = knorm + (alpha / n) * s
+    o_ref[:] = (x * jnp.exp(-beta * jnp.log(norm))).astype(o_ref.dtype)
+
+
+def _lrn_bwd_kernel(x_ref, g_ref, dx_ref, *, n: int, alpha: float,
+                    beta: float, knorm: float):
+    """One-pass bwd: recompute the window sum (MXU, free vs an extra HBM
+    round-trip), then
+      dx = g * norm^-b - (2ab/n) * x * ((g * x * norm^(-b-1)) @ band^T).
+    """
+    xb = x_ref[:]
+    c = xb.shape[-1]
+    s = jax.lax.dot(xb * xb, _lrn_band(c, n).astype(xb.dtype),
+                    preferred_element_type=jnp.float32)
+    x = xb.astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    norm = knorm + (alpha / n) * s
+    p = jnp.exp(-beta * jnp.log(norm))          # norm^-beta
+    t = g * x * (p / norm)                      # g*x*norm^(-beta-1)
+    u = jax.lax.dot(t.astype(xb.dtype), _lrn_band(c, n, transpose=True)
+                    .astype(xb.dtype), preferred_element_type=jnp.float32)
+    dx_ref[:] = (g * p - (2.0 * alpha * beta / n) * x * u).astype(
+        dx_ref.dtype)
 
 
 def _lrn_reference(x, n, alpha, beta, knorm):
@@ -90,12 +117,43 @@ def _lrn_reference(x, n, alpha, beta, knorm):
     return x * (knorm + (alpha / n) * sq) ** (-beta)
 
 
+LRN_MAX_CHANNELS = 512     # in-kernel (C, C) band + iotas must fit VMEM
+
+
+def _lrn_row_tile(c: int, row_tile: int) -> int:
+    """Bound VMEM: ~6 live (tile, C) f32 buffers plus the in-kernel (C, C)
+    band and its iota intermediates (~12 bytes/element, reserved first).
+    Callers must keep C <= LRN_MAX_CHANNELS."""
+    budget_bytes = 6 * 1024 * 1024 - 12 * c * c
+    budget = max(budget_bytes, 8 * 6 * 4 * c) // (6 * 4 * max(c, 1))
+    tile = min(row_tile, max(8, budget // 8 * 8))
+    return tile
+
+
+def _lrn_call(kern, args, shape, dtype, like, c, tile, n_in):
+    rows = shape[0]
+    pad = (-rows) % tile
+    if pad:
+        args = [jnp.pad(a, ((0, pad), (0, 0))) for a in args]
+    out = pl.pallas_call(
+        kern,
+        grid=((rows + pad) // tile,),
+        in_specs=[pl.BlockSpec((tile, c), lambda i: (i, 0))] * n_in,
+        out_specs=pl.BlockSpec((tile, c), lambda i: (i, 0)),
+        out_shape=_out_struct(((rows + pad), c), dtype, like),
+        interpret=_INTERPRET,
+    )(*args)
+    return out[:rows] if pad else out
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
 def lrn_fused(x: jnp.ndarray, n: int, alpha: float, beta: float,
-              knorm: float, row_tile: int = 256) -> jnp.ndarray:
-    """Fused LRN over the channel (last) dim of NHWC ``x``. Forward is one
-    Pallas VMEM pass; backward autodiffs the reference formula (recompute —
-    LRN inputs are activations the caller usually keeps anyway)."""
+              knorm: float, row_tile: int = 512) -> jnp.ndarray:
+    """Fused LRN over the channel (last) dim of NHWC ``x``. Forward and
+    backward are each ONE Pallas VMEM pass; the windowed channel sum is an
+    in-kernel (C, C)-band matmul on the MXU (the band never touches HBM),
+    and the backward recomputes it instead of saving norm (an MXU dot is
+    cheaper than 2x the activation's HBM traffic). Residual: x only."""
     return _lrn_fused_impl(x, n, alpha, beta, knorm, row_tile)
 
 
@@ -104,35 +162,31 @@ def _lrn_fwd(x, n, alpha, beta, knorm, row_tile):
 
 
 def _lrn_bwd(n, alpha, beta, knorm, row_tile, x, g):
-    _, vjp = jax.vjp(lambda a: _lrn_reference(a, n, alpha, beta, knorm), x)
-    return vjp(g)
-
-
-def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
-                    knorm: float, row_tile: int = 256) -> jnp.ndarray:
     shape = x.shape
     c = shape[-1]
     rows = 1
     for d in shape[:-1]:
         rows *= d
-    x2 = x.reshape(rows, c)
-    tile = min(row_tile, rows)
-    # pad rows to a tile multiple (XLA pads/unpads around the call)
-    pad = (-rows) % tile
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    tile = _lrn_row_tile(c, row_tile)
+    kern = functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta,
+                             knorm=knorm)
+    dx = _lrn_call(kern, [x.reshape(rows, c), g.reshape(rows, c)],
+                   (rows, c), x.dtype, x, c, tile, n_in=2)
+    return (dx.reshape(shape),)
+
+
+def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
+                    knorm: float, row_tile: int = 512) -> jnp.ndarray:
+    shape = x.shape
+    c = shape[-1]
+    rows = 1
+    for d in shape[:-1]:
+        rows *= d
+    tile = _lrn_row_tile(c, row_tile)
     kern = functools.partial(_lrn_kernel, n=n, alpha=alpha, beta=beta,
                              knorm=knorm)
-    out = pl.pallas_call(
-        kern,
-        grid=((rows + pad) // tile,),
-        in_specs=[pl.BlockSpec((tile, c), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((tile, c), lambda i: (i, 0)),
-        out_shape=_out_struct(((rows + pad), c), x.dtype, x),
-        interpret=_INTERPRET,
-    )(x2)
-    if pad:
-        out = out[:rows]
+    out = _lrn_call(kern, [x.reshape(rows, c)], (rows, c), x.dtype, x, c,
+                    tile, n_in=1)
     return out.reshape(shape)
 
 
