@@ -35,6 +35,7 @@ class BatchAdaptIterator(IIterator):
         self.test_skipread = 0
         self.silent = 0
         self.head = 1
+        self._dtype = np.float32
         self._value: Optional[DataBatch] = None
 
     def set_param(self, name: str, val: str) -> None:
@@ -49,6 +50,19 @@ class BatchAdaptIterator(IIterator):
             self.silent = int(val)
         elif name == "test_skipread":
             self.test_skipread = int(val)
+        elif name == "data_dtype":
+            # compute-dtype batches straight from the pipeline: with
+            # "bfloat16" (under a `threadbuffer` chain) the cast runs in
+            # the prefetch producer thread, halving host->device bytes and
+            # letting the jitted step's own input cast no-op. Labels stay
+            # float32.
+            if val not in ("float32", "bfloat16"):
+                raise ValueError("data_dtype must be float32 or bfloat16")
+            if val == "bfloat16":
+                import ml_dtypes
+                self._dtype = ml_dtypes.bfloat16
+            else:
+                self._dtype = np.float32
 
     def init(self) -> None:
         assert self.batch_size > 0, "batch_size must be set"
@@ -62,7 +76,7 @@ class BatchAdaptIterator(IIterator):
         self.head = 1
 
     def _collect(self, insts: List[DataInst]) -> DataBatch:
-        data = np.stack([d.data for d in insts]).astype(np.float32)
+        data = np.stack([d.data for d in insts]).astype(self._dtype)
         label = np.zeros((len(insts), self.label_width), np.float32)
         for i, d in enumerate(insts):
             lab = np.asarray(d.label, np.float32).reshape(-1)

@@ -26,6 +26,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import local_attention, ring_attention_inner
@@ -48,6 +49,14 @@ class GPTConfig:
     #                             ~1/3 more FLOPs for O(layers) less HBM —
     #                             the long-context/deep-model memory lever
     #                             (jax.checkpoint per transformer block)
+    remat_save_attn: bool = False  # under remat, also save each block's
+    #                             attention output (checkpoint_name policy)
+    #                             instead of re-running the kernel in the
+    #                             backward. Off by default: measured SLOWER
+    #                             on one v5e chip (85M flagship, 32x1024:
+    #                             330 vs 312 ms/step) — the extra HBM
+    #                             writes/reads of the saved activations
+    #                             cost more than the flash-kernel recompute
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -91,8 +100,12 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
     model axis it is the identity, and demotes the vma type)."""
     def attn(q, k, v):
         if use_ring:
-            return ring_attention_inner(q, k, v, SEQ_AXIS, causal=True), None
-        return local_attention(q, k, v, causal=True), None
+            att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
+        else:
+            att = local_attention(q, k, v, causal=True)
+        # tagged for the remat policy: save the attention output instead of
+        # re-running the kernel in the backward (gpt_logits, remat_save_attn)
+        return checkpoint_name(att, "attn_out"), None
 
     out, _ = _block_core(p, h, n_head_local, attn,
                          lambda t: lax.psum(t, MODEL_AXIS))
@@ -182,7 +195,9 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
         _block, n_head_local=cfg.n_head // max(n_tp, 1),
         use_ring=n_sp > 1)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if cfg.remat_save_attn else None)
+        block = jax.checkpoint(block, policy=policy)
     h = gpipe(block, params["blocks"], h, mesh, cfg.n_microbatch,
               extra_spec_axes=(SEQ_AXIS,), param_specs=_block_param_specs())
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])

@@ -277,8 +277,11 @@ class Net:
         the compute dtype (fused no-op when _device_batch already delivered
         bf16); extra-data nodes keep their f32 entry dtype, as always."""
         data = jnp.transpose(data, (0, 2, 3, 1))
-        if self.precision == "bfloat16":
-            data = data.astype(jnp.bfloat16)
+        # force the net's compute dtype both ways: a bf16 pipeline feed
+        # into a float32 net must not silently downgrade the forward pass
+        # (layers derive their compute dtype from the data node's dtype)
+        data = data.astype(jnp.bfloat16 if self.precision == "bfloat16"
+                           else jnp.float32)
         nodes = {0: data}
         for i, e in enumerate(extras):
             nodes[1 + i] = jnp.transpose(e, (0, 2, 3, 1))
@@ -379,16 +382,13 @@ class Net:
         each process contributes only its own row range — the replicated-
         reader mode for datasets without rank sharding."""
         sh = batch_sharding(self.mesh)
-        data_np = self._local_slice(batch.data)
-        if self.precision == "bfloat16":
-            # host-side compute-dtype conversion: halves the host->device
-            # bytes and removes the separate on-device convert pass
-            # (measured 1.5 ms at batch 1024). In the prefetching pipeline
-            # this runs in the producer thread, off the step's critical
-            # path; the jitted step's own cast (_entry_nodes) then no-ops.
-            import ml_dtypes
-            data_np = data_np.astype(ml_dtypes.bfloat16)
-        data = global_batch(self.mesh, sh, data_np)
+        # batch.data arrives float32, or already bfloat16 when the pipeline
+        # converts in its producer thread (`data_dtype = bfloat16` on the
+        # batcher): bf16 passes through, halving host->device bytes, and
+        # the jitted step's input cast (_entry_nodes) no-ops; f32 feeds are
+        # cast inside the step, fused into the first transpose/conv (no
+        # separate device pass, and no host-side cast on this thread).
+        data = global_batch(self.mesh, sh, self._local_slice(batch.data))
         label = global_batch(self.mesh, sh, self._local_slice(batch.label))
         extras = [global_batch(self.mesh, sh, self._local_slice(e))
                   for e in batch.extra_data]
@@ -406,7 +406,7 @@ class Net:
         Single-process: unchanged."""
         nproc = jax.process_count()
         if nproc <= 1:
-            return np.asarray(x, np.float32)
+            return self._host_array(x)
         step = self.batch_size // nproc
         if self.dist_feed == "sharded":
             if x.shape[0] != step:
@@ -415,13 +415,22 @@ class Net:
                     "batch %d over %d processes), got %d — configure the "
                     "data section's batch_size accordingly"
                     % (step, self.batch_size, nproc, x.shape[0]))
-            return np.asarray(x, np.float32)
+            return self._host_array(x)
         if x.shape[0] != self.batch_size:
             raise ValueError(
                 "dist_feed=replicated expects the full global batch %d "
                 "per process, got %d rows" % (self.batch_size, x.shape[0]))
         rank = jax.process_index()
-        return np.asarray(x[rank * step:(rank + 1) * step], np.float32)
+        return self._host_array(x[rank * step:(rank + 1) * step])
+
+    @staticmethod
+    def _host_array(x) -> np.ndarray:
+        """Normalize a host batch array: bfloat16 pipeline output passes
+        through unchanged (ml_dtypes view), anything else goes to f32."""
+        x = np.asarray(x)
+        if x.dtype.name == "bfloat16":
+            return x
+        return np.asarray(x, np.float32)
 
     def _rank_valid(self, batch) -> int:
         """Number of this rank's local rows that are real instances (the

@@ -10,9 +10,11 @@ numerics):
   (residual: x only). Opt-in (CXN_PALLAS_LRN=1): measured on one v5e chip
   the XLA band-matmul formulation in layers/conv.py still wins (fwd+bwd
   bf16: 10.9 vs 18.9 ms @ 1024x55x55x96, 8.0 vs 11.5 @ 1024x27x27x256,
-  5.4 vs 5.8 @ 256x14x14x1024) — sub-128 channel widths halve the
-  kernel's effective DMA bandwidth, and XLA's fusion of the pow/scale
-  passes is already near the traffic floor.
+  5.4 vs 5.8 @ 256x14x14x1024, measured before the width cap) — sub-128
+  channel widths halve the kernel's effective DMA bandwidth, and XLA's
+  fusion of the pow/scale passes is already near the traffic floor.
+  Supported domain: n <= channels <= LRN_MAX_CHANNELS (the in-kernel
+  (C, C) band must fit VMEM); wider LRN uses the XLA paths.
 - **flash attention** (forward + backward): O(N) memory exact attention for
   a single device — the in-chip complement of ring attention (which bounds
   memory *across* chips). Forward: online softmax over K/V tiles held in
@@ -120,14 +122,15 @@ def _lrn_reference(x, n, alpha, beta, knorm):
 LRN_MAX_CHANNELS = 512     # in-kernel (C, C) band + iotas must fit VMEM
 
 
-def _lrn_row_tile(c: int, row_tile: int) -> int:
-    """Bound VMEM: ~6 live (tile, C) f32 buffers plus the in-kernel (C, C)
-    band and its iota intermediates (~12 bytes/element, reserved first).
-    Callers must keep C <= LRN_MAX_CHANNELS."""
+def _lrn_row_tile(c: int, rows: int, row_tile: int) -> int:
+    """Bound VMEM for the worst case (the backward kernel): ~10 live
+    (tile, C) f32 temporaries plus double-buffered I/O blocks, after
+    reserving the in-kernel (C, C) band and its iota intermediates
+    (~12 bytes/element). Callers must keep C <= LRN_MAX_CHANNELS."""
     budget_bytes = 6 * 1024 * 1024 - 12 * c * c
-    budget = max(budget_bytes, 8 * 6 * 4 * c) // (6 * 4 * max(c, 1))
+    budget = max(budget_bytes, 8 * 10 * 4 * c) // (10 * 4 * max(c, 1))
     tile = min(row_tile, max(8, budget // 8 * 8))
-    return tile
+    return min(tile, max(8, -(-rows // 8) * 8))
 
 
 def _lrn_call(kern, args, shape, dtype, like, c, tile, n_in):
@@ -167,7 +170,7 @@ def _lrn_bwd(n, alpha, beta, knorm, row_tile, x, g):
     rows = 1
     for d in shape[:-1]:
         rows *= d
-    tile = _lrn_row_tile(c, row_tile)
+    tile = _lrn_row_tile(c, rows, row_tile)
     kern = functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta,
                              knorm=knorm)
     dx = _lrn_call(kern, [x.reshape(rows, c), g.reshape(rows, c)],
@@ -179,10 +182,16 @@ def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
                     knorm: float, row_tile: int = 512) -> jnp.ndarray:
     shape = x.shape
     c = shape[-1]
+    if not n <= c <= LRN_MAX_CHANNELS:
+        raise ValueError(
+            "lrn_fused supports n <= channels <= %d (got channels=%d): the "
+            "in-kernel (C, C) band must fit VMEM — use the XLA band/"
+            "reduce_window formulation in layers/conv.py beyond that"
+            % (LRN_MAX_CHANNELS, c))
     rows = 1
     for d in shape[:-1]:
         rows *= d
-    tile = _lrn_row_tile(c, row_tile)
+    tile = _lrn_row_tile(c, rows, row_tile)
     kern = functools.partial(_lrn_kernel, n=n, alpha=alpha, beta=beta,
                              knorm=knorm)
     out = _lrn_call(kern, [x.reshape(rows, c)], (rows, c), x.dtype, x, c,

@@ -446,3 +446,36 @@ def test_databatch_sparse_csr():
     assert idx.size == 0
     idx, val = b.sparse_row(2)
     np.testing.assert_array_equal(val, [3.0])
+
+
+def test_data_dtype_bfloat16_pipeline(imgbin_dataset):
+    """`data_dtype = bfloat16` packs batch data in the compute dtype inside
+    the pipeline (producer thread under threadbuffer); labels stay f32."""
+    import ml_dtypes
+
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,28,28"),
+        ("batch_size", "16"),
+        ("data_dtype", "bfloat16"),
+        ("iter", "threadbuffer"),
+        ("silent", "1"),
+    ])
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data.dtype == ml_dtypes.bfloat16
+    assert batches[0].label.dtype == np.float32
+    it.close()
+
+    with pytest.raises(ValueError):
+        create_iterator([
+            ("iter", "imgbin"),
+            ("image_list", str(d / "train.lst")),
+            ("image_bin", str(d / "train.bin")),
+            ("input_shape", "3,28,28"),
+            ("batch_size", "16"),
+            ("data_dtype", "float16"),
+        ])

@@ -193,3 +193,27 @@ def test_set_get_weight(synth_mnist, tmp_path):
     new = np.zeros_like(w)
     net.set_weight("fc2", "wmat", new)
     np.testing.assert_allclose(net.get_weight("fc2", "wmat"), new)
+
+
+def test_bf16_feed_into_f32_net_stays_f32():
+    """A `data_dtype = bfloat16` pipeline feeding a float32 net must not
+    downgrade the compute dtype (layers derive it from the data node)."""
+    import ml_dtypes
+    import jax.numpy as jnp
+
+    net = Net(tokenize("""
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 4
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,8
+batch_size = 8
+dev = cpu
+"""))
+    net.init_model()
+    bf16 = np.zeros((8, 1, 1, 8), ml_dtypes.bfloat16)
+    f32 = net._host_array(bf16)
+    assert f32.dtype == ml_dtypes.bfloat16     # passthrough at the feed...
+    nodes = net._entry_nodes(jnp.asarray(bf16), [])
+    assert nodes[0].dtype == jnp.float32       # ...forced back in the step
