@@ -217,9 +217,10 @@ class LearnTask:
                 itcfg = []
                 continue
             (itcfg if flag else defcfg).append((name, val))
-        # bf16 nets get compute-dtype batches from the pipeline by default
-        # (conversion in the prefetch producer thread, half the host->device
-        # bytes); an explicit data_dtype in the config wins
+        # bf16 nets get compute-dtype batches from every pipeline (train,
+        # eval, and pred sections) by default — conversion in the prefetch
+        # producer thread, half the host->device bytes; an explicit
+        # data_dtype in the config wins
         extra: Pairs = []
         if any(k == "precision" and v == "bfloat16" for k, v in defcfg) \
                 and not any(k == "data_dtype"
@@ -229,7 +230,7 @@ class LearnTask:
         for sflag, sname, scfg in sections:
             # section config first, then globals — matching the reference's
             # CreateIterator-then-InitIter(defcfg) order (cxxnet_main.cpp:254-262)
-            full = scfg + defcfg + (extra if sflag == 1 else [])
+            full = scfg + defcfg + extra
             if sflag == 1 and self.task != "pred":
                 assert self.itr_train is None, "can only have one data section"
                 self.itr_train = create_iterator(full)

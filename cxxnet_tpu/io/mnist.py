@@ -45,6 +45,7 @@ class MNISTIterator(IIterator):
         self.path_label = ""
         self.seed = _RAND_MAGIC
         self.loc = 0
+        self._dtype = np.float32
 
     def set_param(self, name: str, val: str) -> None:
         if name == "silent":
@@ -63,9 +64,21 @@ class MNISTIterator(IIterator):
             self.path_label = val
         elif name == "seed_data":
             self.seed = _RAND_MAGIC + int(val)
+        elif name == "data_dtype":
+            # whole-dataset batch iterator: convert once at load, so every
+            # batch view is already compute-dtype (batch.py's batcher does
+            # the same per batch for instance pipelines)
+            if val not in ("float32", "bfloat16"):
+                raise ValueError("data_dtype must be float32 or bfloat16")
+            if val == "bfloat16":
+                import ml_dtypes
+                self._dtype = ml_dtypes.bfloat16
+            else:
+                self._dtype = np.float32
 
     def init(self) -> None:
         img = read_idx(self.path_img).astype(np.float32) * (1.0 / 256.0)
+        img = img.astype(self._dtype)
         label = read_idx(self.path_label).astype(np.float32)
         assert img.shape[0] == label.shape[0]
         n, rows, cols = img.shape

@@ -122,13 +122,13 @@ def _lrn_reference(x, n, alpha, beta, knorm):
 LRN_MAX_CHANNELS = 512     # in-kernel (C, C) band + iotas must fit VMEM
 
 
-def _lrn_row_tile(c: int, rows: int, row_tile: int) -> int:
-    """Bound VMEM for the worst case (the backward kernel): ~10 live
-    (tile, C) f32 temporaries plus double-buffered I/O blocks, after
-    reserving the in-kernel (C, C) band and its iota intermediates
-    (~12 bytes/element). Callers must keep C <= LRN_MAX_CHANNELS."""
+def _lrn_row_tile(c: int, rows: int, row_tile: int, n_bufs: int) -> int:
+    """Bound VMEM: ``n_bufs`` live (tile, C) f32 buffers (~6 for the
+    forward kernel, ~10 for the backward's larger temporary set) plus the
+    in-kernel (C, C) band and its iota intermediates (~12 bytes/element,
+    reserved first). Callers must keep C <= LRN_MAX_CHANNELS."""
     budget_bytes = 6 * 1024 * 1024 - 12 * c * c
-    budget = max(budget_bytes, 8 * 10 * 4 * c) // (10 * 4 * max(c, 1))
+    budget = max(budget_bytes, 8 * n_bufs * 4 * c) // (n_bufs * 4 * max(c, 1))
     tile = min(row_tile, max(8, budget // 8 * 8))
     return min(tile, max(8, -(-rows // 8) * 8))
 
@@ -170,7 +170,7 @@ def _lrn_bwd(n, alpha, beta, knorm, row_tile, x, g):
     rows = 1
     for d in shape[:-1]:
         rows *= d
-    tile = _lrn_row_tile(c, rows, row_tile)
+    tile = _lrn_row_tile(c, rows, row_tile, n_bufs=10)
     kern = functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta,
                              knorm=knorm)
     dx = _lrn_call(kern, [x.reshape(rows, c), g.reshape(rows, c)],
@@ -191,7 +191,7 @@ def _lrn_fused_impl(x: jnp.ndarray, n: int, alpha: float, beta: float,
     rows = 1
     for d in shape[:-1]:
         rows *= d
-    tile = _lrn_row_tile(c, rows, row_tile)
+    tile = _lrn_row_tile(c, rows, row_tile, n_bufs=6)
     kern = functools.partial(_lrn_kernel, n=n, alpha=alpha, beta=beta,
                              knorm=knorm)
     out = _lrn_call(kern, [x.reshape(rows, c)], (rows, c), x.dtype, x, c,

@@ -1,0 +1,66 @@
+"""Train the MNIST MLP through the Python wrapper API.
+
+The wrapper (`cxxnet_tpu.wrapper`) mirrors the reference's Python module
+(wrapper/cxxnet.py): config-string iterators, Net, and a train() loop —
+this script is the reference example/MNIST/mnist.py workflow on the TPU
+framework. Fetch the idx.gz files first (see run.sh), then:
+
+    python example/MNIST/mnist.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from cxxnet_tpu import wrapper as cxxnet  # noqa: E402
+
+data = cxxnet.DataIter("""
+iter = mnist
+    path_img = "./data/train-images-idx3-ubyte.gz"
+    path_label = "./data/train-labels-idx1-ubyte.gz"
+    shuffle = 1
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""")
+print("init data iter")
+
+deval = cxxnet.DataIter("""
+iter = mnist
+    path_img = "./data/t10k-images-idx3-ubyte.gz"
+    path_label = "./data/t10k-labels-idx1-ubyte.gz"
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""")
+print("init eval iter")
+
+cfg = """
+netconfig=start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 100
+  init_sigma = 0.01
+layer[+1:sg1] = sigmoid:se1
+layer[sg1->fc2] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+"""
+
+param = {
+    "eta": 0.1,
+    "momentum": 0.9,
+    "wd": 0.0,
+    "metric": "error",
+}
+
+net = cxxnet.train(cfg, data, 15, param, eval_data=deval)
+
+# weights are numpy in / numpy out, as in the reference wrapper
+w = net.get_weight("fc1", "wmat")
+print("fc1 weight shape:", w.shape)

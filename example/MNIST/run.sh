@@ -1,0 +1,13 @@
+#!/bin/bash
+# Fetch MNIST and train the MLP config end-to-end.
+set -e
+cd "$(dirname "$0")"
+
+mkdir -p data models
+for f in train-images-idx3-ubyte.gz train-labels-idx1-ubyte.gz \
+         t10k-images-idx3-ubyte.gz t10k-labels-idx1-ubyte.gz; do
+    [ -f "data/$f" ] || wget -O "data/$f" \
+        "https://ossci-datasets.s3.amazonaws.com/mnist/$f"
+done
+
+python -m cxxnet_tpu MNIST.conf "$@"

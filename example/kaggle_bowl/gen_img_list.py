@@ -1,0 +1,55 @@
+"""Generate an image list (`index \t label \t path`) for the bowl dataset.
+
+Usage:
+    python gen_img_list.py train <sampleSubmission.csv> <img_root> train.lst
+    python gen_img_list.py test  <sampleSubmission.csv> <img_root> test.lst
+
+The class order (label index 0..120) is the column order of the sample
+submission header, so probabilities extracted with pred.conf line up with
+the submission columns. Train mode expects <img_root>/<class_name>/*.jpg;
+test mode lists <img_root>/*.jpg with label 0.
+"""
+
+import csv
+import os
+import random
+import sys
+
+
+def class_order(sample_csv):
+    with open(sample_csv) as f:
+        header = next(csv.reader(f))
+    return header[1:]          # first column is "image"
+
+
+def main(argv):
+    if len(argv) != 5:
+        sys.stderr.write(__doc__)
+        return 1
+    mode, sample_csv, root, out = argv[1:]
+    classes = class_order(sample_csv)
+    rows = []
+    if mode == "train":
+        for li, cname in enumerate(classes):
+            d = os.path.join(root, cname)
+            if not os.path.isdir(d):
+                continue
+            for fname in sorted(os.listdir(d)):
+                rows.append((li, os.path.join(d, fname)))
+        random.seed(888)
+        random.shuffle(rows)
+    elif mode == "test":
+        for fname in sorted(os.listdir(root)):
+            rows.append((0, os.path.join(root, fname)))
+    else:
+        raise SystemExit("mode must be train or test")
+    with open(out, "w") as fo:
+        for i, (label, path) in enumerate(rows):
+            fo.write("%d\t%d\t%s\n" % (i, label, path))
+    print("wrote %d entries to %s (%d classes)" % (len(rows), out,
+                                                   len(classes)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Split a shuffled list into tr.lst (96%) and va.lst (4%).
+[ -n "$1" ] || { echo "usage: $0 train.lst"; exit 1; }
+n=$(wc -l < "$1")
+nva=$((n / 25))
+head -n "$nva" "$1" > va.lst
+tail -n +"$((nva + 1))" "$1" > tr.lst
+echo "split $n -> $(wc -l < tr.lst) train / $(wc -l < va.lst) val"

@@ -1,0 +1,43 @@
+"""Assemble the Kaggle submission CSV from extracted probabilities.
+
+Usage:
+    python make_submission.py sampleSubmission.csv test.lst test.txt out.csv
+
+`test.txt` is the output of pred.conf (one row of 121 softmax values per
+test instance, in test.lst order); the sample submission supplies the
+header and the expected image-name column.
+"""
+
+import csv
+import os
+import sys
+
+
+def main(argv):
+    if len(argv) != 5:
+        sys.stderr.write(__doc__)
+        return 1
+    sample_csv, lst_path, prob_path, out = argv[1:]
+    with open(sample_csv) as f:
+        header = next(csv.reader(f))
+    names = []
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            names.append(os.path.basename(parts[2]))
+    with open(prob_path) as f, open(out, "w", newline="") as fo:
+        w = csv.writer(fo)
+        w.writerow(header)
+        for i, line in enumerate(f):
+            probs = line.split()
+            if len(probs) != len(header) - 1:
+                raise SystemExit(
+                    "row %d has %d probabilities, expected %d"
+                    % (i, len(probs), len(header) - 1))
+            w.writerow([names[i]] + probs)
+    print("wrote %s (%d rows)" % (out, len(names)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
