@@ -103,3 +103,16 @@ def test_lrn_layer_uses_pallas_when_enabled():
     out_ref = layer.apply({}, [x], ctx)[0]
     np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(out_ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_flash_block_selection():
+    """Adaptive default: 512-blocks only when the sequence is a multiple
+    of 512; explicit requests clamp to the sequence."""
+    from cxxnet_tpu.ops.pallas_kernels import _flash_block
+
+    assert _flash_block(1024, None) == 512
+    assert _flash_block(4096, None) == 512
+    assert _flash_block(768, None) == 256       # 256-aligned but not 512
+    assert _flash_block(128, None) == 128       # tiny ring chunks clamp
+    assert _flash_block(1024, 8) == 8           # explicit wins
+    assert _flash_block(4, 8) == 4              # explicit clamps to n

@@ -108,6 +108,26 @@ def smoke_ring_kernels():
           % err)
 
 
+def smoke_pallas_lrn():
+    """The opt-in one-pass LRN kernels (CXN_PALLAS_LRN=1) must keep
+    compiling under Mosaic and matching the default XLA band path."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pallas_kernels import _lrn_reference, lrn_fused
+
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.rand(64, 7, 7, 96), jnp.bfloat16)
+    ref, vjp_ref = jax.vjp(lambda a: _lrn_reference(a, 5, 1e-4, 0.75, 1.0), x)
+    out, vjp_out = jax.vjp(lambda a: lrn_fused(a, 5, 1e-4, 0.75, 1.0), x)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    g = jnp.ones_like(x)
+    gerr = float(jnp.max(jnp.abs(vjp_out(g)[0].astype(jnp.float32)
+                                 - vjp_ref(g)[0].astype(jnp.float32))))
+    assert err < 3e-2 and gerr < 3e-2, (err, gerr)
+    print("pallas LRN fwd+bwd kernels: maxdiff %.3g / %.3g" % (err, gerr))
+
+
 def smoke_decode():
     import jax
     from cxxnet_tpu.models.gpt import (GPTConfig, gpt_decode, gpt_init,
@@ -135,7 +155,7 @@ def main() -> int:
         % backend)
     t0 = time.time()
     for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
-               smoke_ring_kernels, smoke_decode):
+               smoke_ring_kernels, smoke_pallas_lrn, smoke_decode):
         fn()
     print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
     return 0
