@@ -90,11 +90,12 @@ def _block(q, k, v, o, m, l, causal, q_off, k_off):
 # per-device chunks at least this long and aligned run their blockwise
 # math in the flash kernels, making memory O(n) instead of an O(n^2) f32
 # score matrix. NOTE the isolated micro-benchmark is misleading here: XLA
-# exact wins the standalone fwd+bwd at seq 1024 (8.6 vs 9.6 ms), but in
-# the full rematerialized GPT step the flash path is 36% faster end to end
-# (104.5k vs 76.7k tok/s measured at batch 32 x 1024 on one v5e chip) —
-# the O(n^2) f32 scores XLA materializes per microbatch per layer cost
-# more HBM traffic during remat than the kernels' layout copies.
+# exact wins the standalone fwd+bwd at seq 1024 (8.6 vs 9.6 ms with
+# 256-blocks), but in the full rematerialized GPT step the flash path is
+# ~50% faster end to end (117k vs 76.7k tok/s at batch 32 x 1024 on one
+# v5e chip, adaptive 512-blocks; doc/performance.md) — the O(n^2) f32
+# scores XLA materializes per microbatch per layer cost more HBM traffic
+# during remat than the kernels' layout copies.
 _RING_PALLAS_MIN = 512
 _RING_PALLAS_ALIGN = 256
 

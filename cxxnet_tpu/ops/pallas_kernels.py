@@ -35,6 +35,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _INTERPRET = False      # flipped by tests on CPU
 
@@ -209,7 +210,72 @@ lrn_fused.defvjp(_lrn_fwd, _lrn_bwd)
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+def _causal_mask(sc, q0, k0):
+    """Mask score block ``sc`` (rows = queries at global offset q0, cols =
+    keys at k0) to the causal lower triangle."""
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+    return jnp.where(qpos >= kpos, sc, _NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, causal: bool, scale: float):
+    """Online-softmax accumulation for one (batch, head, q-block, k-block)
+    grid step. K/V stream through VMEM one block at a time (grid innermost
+    dim) — VMEM use is O(block), so sequence length is bounded by HBM, not
+    VMEM. The (q-block)-persistent accumulators live in scratch and are
+    normalized into the output at the last k-block."""
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    tq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    q0 = pl.program_id(2) * tq
+    k0 = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # (TQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)               # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        sc = q @ k.T                                      # (TQ, BK)
+        if causal:
+            sc = _causal_mask(sc, q0, k0)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, sc.max(-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + p @ v
+        m_ref[:, 0] = m_new
+
+    if causal:
+        # skip fully-masked K blocks past the diagonal (no compute; the
+        # block DMA still happens — grids are rectangular)
+        pl.when(q0 + tq - 1 >= k0)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        # log-sum-exp of the scaled logits per row — the backward residual
+        # (trailing singleton dim keeps the TPU block-tiling rule happy)
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l))[:, None]
+
+
+# --- VMEM-resident kernel family: K/V (or Q/dO) held fully in VMEM per
+# (batch, head); fastest for seq <= _FLASH_RESIDENT_MAX, where they fit.
+# Beyond that the streaming family above (K/V blocks as a grid dim with
+# scratch accumulators) keeps VMEM O(block) at some per-step overhead
+# (measured ~3x on short seqs, hence the split).
+
+def _flash_kernel_res(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   causal: bool, scale: float):
     # q_ref: (1, 1, TQ, D) one (batch*head, q-block); k/v: (1, 1, N, D)
     q = q_ref[0, 0].astype(jnp.float32) * scale       # (TQ, D)
@@ -224,9 +290,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         v = v_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
         sc = q @ k.T                                   # (TQ, BK)
         if causal:
-            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-            kpos = s * block_k + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+            sc = _causal_mask(sc, q0, s * block_k)
         m_new = jnp.maximum(m, sc.max(-1))
         p = jnp.exp(sc - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -249,6 +313,86 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     # (trailing singleton dim keeps the TPU block-tiling rule happy)
     lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
+
+
+
+def _flash_dq_kernel_res(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
+                     block_k: int, causal: bool, scale: float):
+    """dq for one (batch, head, q-block): dq = sum_s ds_s @ k_s * scale,
+    ds = p * (do @ v^T - delta), p = exp(q k^T scale - lse)."""
+    q = q_ref[0, 0].astype(jnp.float32) * scale        # (TQ, D) pre-scaled
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]                          # (TQ,)
+    delta = dl_ref[0, 0, :, 0]                         # (TQ,) rowsum(do*o)
+    tq, d = q.shape
+    n = k_ref.shape[2]
+    q0 = pl.program_id(2) * tq
+
+    def body(s, dq):
+        k = k_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        sc = q @ k.T                                   # (TQ, BK) scaled logits
+        if causal:
+            sc = _causal_mask(sc, q0, s * block_k)
+        p = jnp.exp(sc - lse[:, None])
+        ds = p * (do @ v.T - delta[:, None])
+        return dq + ds @ k
+
+    n_blocks = n // block_k
+    n_run = jnp.minimum(n_blocks, (q0 + tq + block_k - 1) // block_k) \
+        if causal else n_blocks
+    dq = jax.lax.fori_loop(0, n_run, body, jnp.zeros((tq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+
+def _flash_dkv_kernel_res(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
+                      dk_ref, dv_ref, *, block_q: int, causal: bool,
+                      scale: float):
+    """dk, dv for one (batch, head, k-block): dv = sum_i p_i^T @ do_i,
+    dk = sum_i ds_i^T @ q_i * scale."""
+    k = k_ref[0, 0].astype(jnp.float32)                # (TK, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    tk, d = k.shape
+    n = q_ref.shape[2]
+    k0 = pl.program_id(2) * tk
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
+        delta = dl_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
+        sc = q @ k.T                                   # (BQ, TK)
+        if causal:
+            sc = _causal_mask(sc, i * block_q, k0)
+        p = jnp.exp(sc - lse[:, None])
+        ds = p * (do @ v.T - delta[:, None])
+        return dk + ds.T @ q, dv + p.T @ do
+
+    n_blocks = n // block_q
+    # causal: q-blocks strictly before this k-block contribute nothing
+    lo = jnp.minimum(n_blocks, k0 // block_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lo, n_blocks, body,
+        (jnp.zeros((tk, d), jnp.float32), jnp.zeros((tk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)             # q pre-scaled => *scale done
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+
+_FLASH_RESIDENT_MAX = 4096       # at head_dim 64; scaled by 64/d below
+
+
+def _flash_resident(n: int, d: int) -> bool:
+    """True when the VMEM-resident kernel family may hold full-sequence
+    K/V (and Q/dO) blocks: its footprint scales with n*d, measured to fit
+    up to n=4096 at d=64 (doc/performance.md). Wider heads shrink the
+    budget proportionally; beyond it the streaming family keeps VMEM
+    O(block)."""
+    return n * max(d, 1) <= _FLASH_RESIDENT_MAX * 64
 
 
 def _flash_block(n: int, req) -> int:
@@ -275,97 +419,143 @@ def _flash_fwd_impl(q, k, v, causal: bool, block_q, block_k,
     vt = jnp.transpose(v, (0, 2, 1, 3))
     bq = _flash_block(n, block_q)
     bk = _flash_block(n, block_k)
-    kern = functools.partial(_flash_kernel, block_k=bk, causal=causal,
-                             scale=scale)
+    if _flash_resident(n, d):
+        kern = functools.partial(_flash_kernel_res, block_k=bk,
+                                 causal=causal, scale=scale)
+        out, lse = pl.pallas_call(
+            kern,
+            grid=(b, h, n // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
+                pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
+                pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0)),
+            ],
+            out_shape=[
+                _out_struct((b, h, n, d), out_dtype or q.dtype, q),
+                _out_struct((b, h, n, 1), jnp.float32, q),
+            ],
+            interpret=_INTERPRET,
+        )(qt, kt, vt)
+        return jnp.transpose(out, (0, 2, 1, 3)), lse
+    kern = functools.partial(_flash_kernel, causal=causal, scale=scale)
     out, lse = pl.pallas_call(
         kern,
-        grid=(b, h, n // bq),
+        grid=(b, h, n // bq, n // bk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
-            pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, s, t: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, s, t: (i, j, t, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, s, t: (i, j, t, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, s, t: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda i, j, s, t: (i, j, s, 0)),
         ],
         out_shape=[
             _out_struct((b, h, n, d), out_dtype or q.dtype, q),
             _out_struct((b, h, n, 1), jnp.float32, q),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),      # acc
+            pltpu.VMEM((bq, 1), jnp.float32),      # running max
+            pltpu.VMEM((bq, 1), jnp.float32),      # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_INTERPRET,
     )(qt, kt, vt)
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
-                     block_k: int, causal: bool, scale: float):
-    """dq for one (batch, head, q-block): dq = sum_s ds_s @ k_s * scale,
-    ds = p * (do @ v^T - delta), p = exp(q k^T scale - lse)."""
-    q = q_ref[0, 0].astype(jnp.float32) * scale        # (TQ, D) pre-scaled
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]                          # (TQ,)
-    delta = dl_ref[0, 0, :, 0]                         # (TQ,) rowsum(do*o)
-    tq, d = q.shape
-    n = k_ref.shape[2]
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+                     acc_ref, *, causal: bool, scale: float):
+    """dq accumulation for one (batch, head, q-block, k-block) grid step:
+    dq += ds @ k, ds = p * (do @ v^T - delta), p = exp(q k^T scale - lse).
+    K/V stream per k-block (grid innermost); dq lives in scratch and is
+    written (scaled) at the last k-block."""
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    tq = q_ref.shape[2]
+    bk = k_ref.shape[2]
     q0 = pl.program_id(2) * tq
+    k0 = ki * bk
 
-    def body(s, dq):
-        k = k_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # (TQ, D) pre-scaled
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]                      # (TQ,)
+        delta = dl_ref[0, 0, :, 0]                     # (TQ,) rowsum(do*o)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)
         sc = q @ k.T                                   # (TQ, BK) scaled logits
         if causal:
-            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-            kpos = s * block_k + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+            sc = _causal_mask(sc, q0, k0)
         p = jnp.exp(sc - lse[:, None])
         ds = p * (do @ v.T - delta[:, None])
-        return dq + ds @ k
+        acc_ref[:] = acc_ref[:] + ds @ k
 
-    n_blocks = n // block_k
-    n_run = jnp.minimum(n_blocks, (q0 + tq + block_k - 1) // block_k) \
-        if causal else n_blocks
-    dq = jax.lax.fori_loop(0, n_run, body, jnp.zeros((tq, d), jnp.float32))
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+    if causal:
+        pl.when(q0 + tq - 1 >= k0)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
-                      dk_ref, dv_ref, *, block_q: int, causal: bool,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
                       scale: float):
-    """dk, dv for one (batch, head, k-block): dv = sum_i p_i^T @ do_i,
-    dk = sum_i ds_i^T @ q_i * scale."""
-    k = k_ref[0, 0].astype(jnp.float32)                # (TK, D)
-    v = v_ref[0, 0].astype(jnp.float32)
-    tk, d = k.shape
-    n = q_ref.shape[2]
+    """dk/dv accumulation for one (batch, head, k-block, q-block) grid
+    step: dv += p^T @ do, dk += ds^T @ q (q pre-scaled). Q/dO stream per
+    q-block (grid innermost); dk/dv live in scratch and are written at the
+    last q-block."""
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    tk = k_ref.shape[2]
+    bq = q_ref.shape[2]
     k0 = pl.program_id(2) * tk
+    q0 = qi * bq
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
-            .astype(jnp.float32) * scale
-        do = do_ref[0, 0, pl.dslice(i * block_q, block_q), :] \
-            .astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
-        delta = dl_ref[0, 0, pl.dslice(i * block_q, block_q), 0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        k = k_ref[0, 0].astype(jnp.float32)            # (TK, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # (BQ, D)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = dl_ref[0, 0, :, 0]
         sc = q @ k.T                                   # (BQ, TK)
         if causal:
-            qpos = i * block_q + \
-                jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-            kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-            sc = jnp.where(qpos >= kpos, sc, _NEG_INF)
+            sc = _causal_mask(sc, q0, k0)
         p = jnp.exp(sc - lse[:, None])
         ds = p * (do @ v.T - delta[:, None])
-        return dk + ds.T @ q, dv + p.T @ do
+        dk_acc[:] = dk_acc[:] + ds.T @ q
+        dv_acc[:] = dv_acc[:] + p.T @ do
 
-    n_blocks = n // block_q
-    # causal: q-blocks strictly before this k-block contribute nothing
-    lo = jnp.minimum(n_blocks, k0 // block_q) if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        lo, n_blocks, body,
-        (jnp.zeros((tk, d), jnp.float32), jnp.zeros((tk, d), jnp.float32)))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)             # q pre-scaled => *scale done
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q-blocks strictly before this k-block contribute nothing
+        pl.when(q0 + bq - 1 >= k0)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)  # q pre-scaled
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k):
@@ -406,30 +596,72 @@ def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
     delta = delta[..., None]
     bq = _flash_block(n, block_q)
     bk = _flash_block(n, block_k)
-    blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
-    blk_kd = pl.BlockSpec((1, 1, bk, d), lambda i, j, s: (i, j, s, 0))
-    full_nd = pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0))
-    blk_q1 = pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0))
-    full_n1 = pl.BlockSpec((1, 1, n, 1), lambda i, j, s: (i, j, 0, 0))
+    if _flash_resident(n, d):
+        blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
+        blk_kd = pl.BlockSpec((1, 1, bk, d), lambda i, j, s: (i, j, s, 0))
+        full_nd = pl.BlockSpec((1, 1, n, d), lambda i, j, s: (i, j, 0, 0))
+        blk_q1 = pl.BlockSpec((1, 1, bq, 1), lambda i, j, s: (i, j, s, 0))
+        full_n1 = pl.BlockSpec((1, 1, n, 1), lambda i, j, s: (i, j, 0, 0))
+
+        dq = pl.pallas_call(
+            functools.partial(_flash_dq_kernel_res, block_k=bk,
+                              causal=causal, scale=scale),
+            grid=(b, h, n // bq),
+            in_specs=[blk_qd, full_nd, full_nd, blk_qd, blk_q1, blk_q1],
+            out_specs=blk_qd,
+            out_shape=_out_struct((b, h, n, d), out_dtype or q.dtype, q),
+            interpret=_INTERPRET,
+        )(qt, kt, vt, dot, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_dkv_kernel_res, block_q=bq,
+                              causal=causal, scale=scale),
+            grid=(b, h, n // bk),
+            in_specs=[blk_kd, blk_kd, full_nd, full_nd, full_n1, full_n1],
+            out_specs=[blk_kd, blk_kd],
+            out_shape=[_out_struct((b, h, n, d), out_dtype or k.dtype, k),
+                       _out_struct((b, h, n, d), out_dtype or v.dtype, v)],
+            interpret=_INTERPRET,
+        )(kt, vt, qt, dot, lse, delta)
+
+        tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+        return tr(dq), tr(dk), tr(dv)
+
+    # dq: grid (b, h, q-block, k-block) — K/V stream per innermost step
+    q_by_q = pl.BlockSpec((1, 1, bq, d), lambda i, j, s, t: (i, j, s, 0))
+    k_by_k = pl.BlockSpec((1, 1, bk, d), lambda i, j, s, t: (i, j, t, 0))
+    q1_by_q = pl.BlockSpec((1, 1, bq, 1), lambda i, j, s, t: (i, j, s, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, block_k=bk, causal=causal,
-                          scale=scale),
-        grid=(b, h, n // bq),
-        in_specs=[blk_qd, full_nd, full_nd, blk_qd, blk_q1, blk_q1],
-        out_specs=blk_qd,
+        functools.partial(_flash_dq_kernel, causal=causal, scale=scale),
+        grid=(b, h, n // bq, n // bk),
+        in_specs=[q_by_q, k_by_k, k_by_k, q_by_q, q1_by_q, q1_by_q],
+        out_specs=q_by_q,
         out_shape=_out_struct((b, h, n, d), out_dtype or q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_INTERPRET,
     )(qt, kt, vt, dot, lse, delta)
 
+    # dk/dv: grid (b, h, k-block, q-block) — Q/dO stream per innermost step
+    k_by_k2 = pl.BlockSpec((1, 1, bk, d), lambda i, j, s, t: (i, j, s, 0))
+    q_by_q2 = pl.BlockSpec((1, 1, bq, d), lambda i, j, s, t: (i, j, t, 0))
+    q1_by_q2 = pl.BlockSpec((1, 1, bq, 1), lambda i, j, s, t: (i, j, t, 0))
+
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, block_q=bq, causal=causal,
-                          scale=scale),
-        grid=(b, h, n // bk),
-        in_specs=[blk_kd, blk_kd, full_nd, full_nd, full_n1, full_n1],
-        out_specs=[blk_kd, blk_kd],
+        functools.partial(_flash_dkv_kernel, causal=causal, scale=scale),
+        grid=(b, h, n // bk, n // bq),
+        in_specs=[k_by_k2, k_by_k2, q_by_q2, q_by_q2, q1_by_q2, q1_by_q2],
+        out_specs=[k_by_k2, k_by_k2],
         out_shape=[_out_struct((b, h, n, d), out_dtype or k.dtype, k),
                    _out_struct((b, h, n, d), out_dtype or v.dtype, v)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_INTERPRET,
     )(kt, vt, qt, dot, lse, delta)
 

@@ -108,6 +108,27 @@ def smoke_ring_kernels():
           % err)
 
 
+def smoke_flash_streaming():
+    """Sequences past _FLASH_RESIDENT_MAX dispatch to the streaming kernel
+    family (K/V blocks on the grid) — must compile and run on-chip."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pallas_kernels import (_FLASH_RESIDENT_MAX,
+                                               flash_attention)
+
+    s = 2 * _FLASH_RESIDENT_MAX
+    rs = np.random.RandomState(4)
+    q, k, v = (jnp.asarray(rs.randn(1, s, 2, 64), jnp.bfloat16)
+               for _ in range(3))
+    g = jnp.ones_like(q)
+    out, vjp = jax.vjp(lambda q, k, v: flash_attention(q, k, v, True),
+                       q, k, v)
+    dq, dk, dv = vjp(g)
+    for t in (out, dq, dk, dv):
+        assert bool(jnp.isfinite(t.astype(jnp.float32)).all())
+    print("streaming flash fwd+bwd @%d: OK" % s)
+
+
 def smoke_pallas_lrn():
     """The opt-in one-pass LRN kernels (CXN_PALLAS_LRN=1) must keep
     compiling under Mosaic and matching the default XLA band path."""
@@ -155,7 +176,8 @@ def main() -> int:
         % backend)
     t0 = time.time()
     for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
-               smoke_ring_kernels, smoke_pallas_lrn, smoke_decode):
+               smoke_ring_kernels, smoke_flash_streaming, smoke_pallas_lrn,
+               smoke_decode):
         fn()
     print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
     return 0
