@@ -217,3 +217,28 @@ dev = cpu
     assert f32.dtype == ml_dtypes.bfloat16     # passthrough at the feed...
     nodes = net._entry_nodes(jnp.asarray(bf16), [])
     assert nodes[0].dtype == jnp.float32       # ...forced back in the step
+
+
+def test_cli_bf16_injects_pipeline_dtype(synth_mnist, tmp_path, capfd):
+    """precision=bfloat16 configs get `data_dtype = bfloat16` injected into
+    their iterator sections (conversion in the pipeline, CLI _create_
+    iterators) and still converge."""
+    import ml_dtypes
+
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=tmp_path / "m"))
+    task = LearnTask()
+    assert task.run([str(conf), "precision=bfloat16", "num_round=3",
+                     "max_round=3"]) == 0
+    err = capfd.readouterr().err
+    lines = [l for l in err.splitlines() if l.startswith("[")]
+    last_err = float(lines[-1].split("test-error:")[1].split()[0])
+    assert last_err < 0.3, lines
+    # the train iterator's batches really are compute-dtype
+    task.itr_train.before_first()
+    assert task.itr_train.next()
+    assert task.itr_train.value().data.dtype == ml_dtypes.bfloat16
+    # eval section got the injection too
+    task.itr_evals[0].before_first()
+    assert task.itr_evals[0].next()
+    assert task.itr_evals[0].value().data.dtype == ml_dtypes.bfloat16
