@@ -345,3 +345,78 @@ def test_conv_space_to_depth_matches_direct(rng, monkeypatch, k, s, pad, h):
     assert len(calls) == 1, "direct path unexpectedly used the rewrite"
     assert out_s2d.shape == out_dir.shape
     np.testing.assert_allclose(out_s2d, out_dir, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------- backward vs torch oracles
+
+@pytest.mark.parametrize("groups,pad,stride", [(1, 0, 1), (2, 1, 2)])
+def test_conv_backward_matches_torch(rng, groups, pad, stride):
+    """dX, dW, db against torch autograd (the reference validated its conv
+    backprop the same way, via pairtest vs caffe/cudnn)."""
+    torch = pytest.importorskip("torch")
+    cin, cout, k = 4, 6, 3
+    layer = make_layer("conv", [("nchannel", str(cout)),
+                                ("kernel_size", str(k)), ("pad", str(pad)),
+                                ("stride", str(stride)),
+                                ("ngroup", str(groups))])
+    layer.infer_shapes([(cin, 9, 9)])
+    params = layer.init_params(jax.random.PRNGKey(1), [(cin, 9, 9)])
+    x = rng.randn(2, cin, 9, 9).astype(np.float32)
+    x_nhwc = jnp.asarray(x.transpose(0, 2, 3, 1))
+
+    def f(p, a):
+        return layer.apply(p, [a], ctx_eval())[0].astype(jnp.float32).sum()
+
+    (dp, dx) = jax.grad(f, argnums=(0, 1))(params, x_nhwc)
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    wt = torch.from_numpy(
+        np.asarray(params["wmat"]).transpose(3, 2, 0, 1)).requires_grad_(True)
+    bt = torch.from_numpy(np.asarray(params["bias"])).requires_grad_(True)
+    torch.nn.functional.conv2d(xt, wt, bt, stride=stride, padding=pad,
+                               groups=groups).sum().backward()
+    np.testing.assert_allclose(np.asarray(dx).transpose(0, 3, 1, 2),
+                               xt.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["wmat"]).transpose(3, 2, 0, 1),
+                               wt.grad.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dp["bias"]), bt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_max_pooling_backward_matches_torch(rng):
+    # 8x8 input: ceil-mode emits a partial edge window (last window starts
+    # at row 6, covering one padded row) — the gradient path where the
+    # -inf padding could plausibly diverge from torch
+    torch = pytest.importorskip("torch")
+    layer = make_layer("max_pooling", [("kernel_size", "3"), ("stride", "2")])
+    layer.infer_shapes([(4, 8, 8)])
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    x_nhwc = jnp.asarray(x.transpose(0, 2, 3, 1))
+
+    dx = jax.grad(lambda a: layer.apply({}, [a], ctx_eval())[0]
+                  .astype(jnp.float32).sum())(x_nhwc)
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    torch.nn.functional.max_pool2d(xt, 3, stride=2,
+                                   ceil_mode=True).sum().backward()
+    np.testing.assert_allclose(np.asarray(dx).transpose(0, 3, 1, 2),
+                               xt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_backward_matches_torch(rng):
+    torch = pytest.importorskip("torch")
+    n, alpha, beta, knorm = 5, 1e-4, 0.75, 1.0
+    layer = make_layer("lrn", [("local_size", str(n)), ("alpha", str(alpha)),
+                               ("beta", str(beta)), ("knorm", str(knorm))])
+    layer.infer_shapes([(8, 5, 5)])
+    x = rng.randn(2, 8, 5, 5).astype(np.float32)
+    x_nhwc = jnp.asarray(x.transpose(0, 2, 3, 1))
+
+    dx = jax.grad(lambda a: layer.apply({}, [a], ctx_eval())[0]
+                  .astype(jnp.float32).sum())(x_nhwc)
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    torch.nn.functional.local_response_norm(
+        xt, n, alpha=alpha, beta=beta, k=knorm).sum().backward()
+    np.testing.assert_allclose(np.asarray(dx).transpose(0, 3, 1, 2),
+                               xt.grad.numpy(), rtol=1e-4, atol=1e-5)
