@@ -479,3 +479,49 @@ def test_data_dtype_bfloat16_pipeline(imgbin_dataset):
             ("batch_size", "16"),
             ("data_dtype", "float16"),
         ])
+
+
+def test_pred_excludes_tail_padding(imgbin_dataset, tmp_path):
+    """The tail batch is padded to batch_size; task=pred must write one
+    line per real instance (cxxnet_main.cpp:276-277), and task=extract one
+    row per real instance — 64 images at batch 24 = 2 full batches plus a
+    tail of 16 real instances padded with 8 duplicates."""
+    from cxxnet_tpu.cli import LearnTask
+
+    d = imgbin_dataset
+    conf = tmp_path / "c.conf"
+    conf.write_text("""
+data = train
+iter = imgbin
+    image_list = "%(d)s/train.lst"
+    image_bin = "%(d)s/train.bin"
+iter = end
+netconfig=start
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc
+  nhidden = 3
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,32,32
+batch_size = 24
+dev = cpu
+num_round = 1
+max_round = 1
+model_dir = %(md)s
+pred = %(out)s
+iter = imgbin
+    image_list = "%(d)s/train.lst"
+    image_bin = "%(d)s/train.bin"
+iter = end
+""" % {"d": d, "md": tmp_path, "out": tmp_path / "out.txt"})
+    assert LearnTask().run([str(conf)]) == 0
+    assert LearnTask().run([str(conf), "task=pred",
+                            "model_in=%s" % (tmp_path / "0001.model")]) == 0
+    preds = np.loadtxt(tmp_path / "out.txt")
+    assert preds.shape[0] == 64          # not 72 (3 x 24)
+
+    assert LearnTask().run([str(conf), "task=extract",
+                            "extract_node_name=top[-1]",
+                            "model_in=%s" % (tmp_path / "0001.model")]) == 0
+    feats = np.loadtxt(tmp_path / "out.txt")
+    assert feats.shape == (64, 3)
