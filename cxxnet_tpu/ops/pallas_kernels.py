@@ -559,11 +559,16 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k):
-    # delta[b,h,i] = rowsum(dO * O) — the softmax-grad correction term
+    # delta[b,h,i,1] = rowsum(dO * O) — the softmax-grad correction term.
+    # lse stays in the forward kernel's (b, h, n, 1) shape all the way to
+    # the backward kernels (no squeeze/unsqueeze round-trip). NB the lse
+    # layout copies visible in step profiles come from layout assignment
+    # at the pallas custom-call boundary, not from this reshape — removing
+    # the round-trip measured within noise on the 32x1024 flagship.
     delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
-                       o.astype(jnp.float32))
-    return flash_bwd_blocks(q, k, v, lse[..., 0], delta, g, causal,
-                            block_q, block_k)
+                       o.astype(jnp.float32))[..., None]
+    return _flash_bwd_blocks4(q, k, v, lse, delta, g, causal,
+                              block_q, block_k, None)
 
 
 def flash_fwd_with_lse(q, k, v, causal: bool, block_q=None,
@@ -586,14 +591,20 @@ def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
     *global* softmax spanning more chunks than k (ring attention): then
     p = exp(s - lse) are the globally-normalized probabilities and the
     returned grads are this chunk's exact contribution."""
+    return _flash_bwd_blocks4(q, k, v, lse[..., None], delta[..., None], g,
+                              causal, block_q, block_k, out_dtype)
+
+
+def _flash_bwd_blocks4(q, k, v, lse, delta, g, causal, block_q, block_k,
+                       out_dtype):
+    """flash_bwd_blocks with lse/delta already in the kernels' native
+    (b, h, n, 1) shape (no squeeze/unsqueeze round-trip)."""
     b, n, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
     dot = jnp.transpose(g, (0, 2, 1, 3))
-    lse = lse[..., None]
-    delta = delta[..., None]
     bq = _flash_block(n, block_q)
     bk = _flash_block(n, block_k)
     if _flash_resident(n, d):
