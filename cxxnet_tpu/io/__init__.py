@@ -3,6 +3,7 @@
 from .data import (DataBatch, DataInst, IIterator, create_iterator,
                    register_base_iterator, register_proc_iterator)
 from . import mnist      # noqa: F401
+from . import cifar      # noqa: F401
 from . import batch      # noqa: F401
 from . import imgbin     # noqa: F401  (imgbin/imgbinx/imgbinold)
 from . import img        # noqa: F401

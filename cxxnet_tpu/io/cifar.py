@@ -1,0 +1,67 @@
+"""CIFAR-10 binary-format iterator.
+
+The reference documents ``iter = cifar`` among its basic iterators
+(doc/io.md:4, example/MNIST/README.md:12) although the shipped src tree
+dropped the implementation; this provides the documented capability. Reads
+the standard CIFAR-10/100 binary batches: each record is ``label_bytes``
+label byte(s) followed by a 3x32x32 uint8 image (3072 bytes, CHW, RGB).
+
+Whole-dataset-in-memory with optional shuffle (io/inmem.py base, the
+mnist-iterator pattern); batches are views into the preloaded tensor and
+the tail partial batch is dropped. Wrap with ``threadbuffer``/``batchadapt``
+chains for padding semantics instead.
+
+Config keys (besides the inmem base's shuffle/seed_data/batch_size/
+index_offset/data_dtype):
+  path_data    comma-separated .bin files (e.g. the five train batches)
+  label_bytes  1 (CIFAR-10; default). CIFAR-100's coarse+fine = 2, the
+               LAST label byte is used (the fine label)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import register_base_iterator
+from .inmem import InMemoryIterator
+from .mnist import _open_maybe_gz
+
+
+@register_base_iterator("cifar")
+class CIFARIterator(InMemoryIterator):
+    REC_IMG = 3 * 32 * 32
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.label_bytes = 1
+        self.path_data = ""
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "label_bytes":
+            self.label_bytes = int(val)
+            if self.label_bytes < 1:
+                raise ValueError("cifar: label_bytes must be >= 1")
+        elif name == "path_data":
+            self.path_data = val
+        else:
+            super().set_param(name, val)
+
+    def _read_file(self, path: str) -> np.ndarray:
+        with _open_maybe_gz(path) as f:
+            raw = np.frombuffer(f.read(), np.uint8)
+        rec = self.label_bytes + self.REC_IMG
+        if raw.size == 0 or raw.size % rec:
+            raise ValueError(
+                "%s: size %d is not a multiple of the %d-byte CIFAR record "
+                "(label_bytes=%d + 3072)" % (path, raw.size, rec,
+                                             self.label_bytes))
+        return raw.reshape(-1, rec)
+
+    def init(self) -> None:
+        assert self.path_data, "cifar: must set path_data"
+        recs = np.concatenate([self._read_file(p.strip())
+                               for p in self.path_data.split(",") if p.strip()])
+        labels = recs[:, self.label_bytes - 1]         # fine label last
+        img = recs[:, self.label_bytes:].reshape(-1, 3, 32, 32)
+        self._finalize_load(img.astype(np.float32) * (1.0 / 256.0), labels,
+                            "CIFARIterator")

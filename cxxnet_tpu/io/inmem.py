@@ -1,0 +1,86 @@
+"""Shared base for whole-dataset-in-memory batch iterators (mnist, cifar).
+
+These load the full dataset at init and serve batch-sized *views* of the
+preloaded tensors (the reference MNISTIterator pattern,
+iter_mnist-inl.hpp:14-158): optional seeded shuffle, tail partial batch
+dropped, `data_dtype` conversion applied once at load.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data import DataBatch, IIterator
+
+RAND_MAGIC = 111
+
+
+class InMemoryIterator(IIterator):
+    """Common config keys + batch serving; subclasses implement ``init``
+    and call :meth:`_finalize_load` with the raw f32 data/labels."""
+
+    def __init__(self) -> None:
+        self.silent = 0
+        self.shuffle = 0
+        self.batch_size = 0
+        self.inst_offset = 0
+        self.seed = RAND_MAGIC
+        self.loc = 0
+        self._dtype = np.float32
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "silent":
+            self.silent = int(val)
+        elif name == "batch_size":
+            self.batch_size = int(val)
+        elif name == "shuffle":
+            self.shuffle = int(val)
+        elif name == "index_offset":
+            self.inst_offset = int(val)
+        elif name == "seed_data":
+            self.seed = RAND_MAGIC + int(val)
+        elif name == "data_dtype":
+            # convert once at load, so every batch view is already
+            # compute-dtype (batch.py's batcher does the same per batch
+            # for instance pipelines)
+            if val not in ("float32", "bfloat16"):
+                raise ValueError("data_dtype must be float32 or bfloat16")
+            if val == "bfloat16":
+                import ml_dtypes
+                self._dtype = ml_dtypes.bfloat16
+            else:
+                self._dtype = np.float32
+
+    def _finalize_load(self, img: np.ndarray, labels: np.ndarray,
+                       tag: str) -> None:
+        """Apply dtype/shuffle/instance-index bookkeeping to the loaded
+        dataset and report, then rewind."""
+        self.img = img.astype(self._dtype)
+        self.labels = labels.astype(np.float32).reshape(img.shape[0], 1)
+        n = img.shape[0]
+        self.inst = np.arange(n, dtype=np.uint32) + self.inst_offset
+        if self.shuffle:
+            order = np.random.RandomState(self.seed).permutation(n)
+            self.img = self.img[order]
+            self.labels = self.labels[order]
+            self.inst = self.inst[order]
+        self.loc = 0
+        if self.silent == 0:
+            print("%s: load %d images, shuffle=%d, shape=%s"
+                  % (tag, n, self.shuffle,
+                     (self.batch_size,) + self.img.shape[1:]))
+
+    def before_first(self) -> None:
+        self.loc = 0
+
+    def next(self) -> bool:
+        if self.loc + self.batch_size <= self.img.shape[0]:
+            i, b = self.loc, self.batch_size
+            self._value = DataBatch(self.img[i:i + b], self.labels[i:i + b],
+                                    self.inst[i:i + b])
+            self.loc += b
+            return True
+        return False
+
+    def value(self) -> DataBatch:
+        return self._value

@@ -12,7 +12,8 @@ import struct
 
 import numpy as np
 
-from .data import DataBatch, IIterator, register_base_iterator
+from .data import register_base_iterator
+from .inmem import InMemoryIterator
 
 _RAND_MAGIC = 121
 
@@ -34,81 +35,33 @@ def read_idx(path: str) -> np.ndarray:
 
 
 @register_base_iterator("mnist")
-class MNISTIterator(IIterator):
+class MNISTIterator(InMemoryIterator):
     def __init__(self) -> None:
+        super().__init__()
         self.mode = 1            # input_flat
-        self.silent = 0
-        self.shuffle = 0
-        self.inst_offset = 0
-        self.batch_size = 0
         self.path_img = ""
         self.path_label = ""
-        self.seed = _RAND_MAGIC
-        self.loc = 0
-        self._dtype = np.float32
+        self.seed = _RAND_MAGIC  # mnist's historical default shuffle seed
 
     def set_param(self, name: str, val: str) -> None:
-        if name == "silent":
-            self.silent = int(val)
-        elif name == "batch_size":
-            self.batch_size = int(val)
-        elif name == "input_flat":
+        if name == "input_flat":
             self.mode = int(val)
-        elif name == "shuffle":
-            self.shuffle = int(val)
-        elif name == "index_offset":
-            self.inst_offset = int(val)
         elif name == "path_img":
             self.path_img = val
         elif name == "path_label":
             self.path_label = val
         elif name == "seed_data":
             self.seed = _RAND_MAGIC + int(val)
-        elif name == "data_dtype":
-            # whole-dataset batch iterator: convert once at load, so every
-            # batch view is already compute-dtype (batch.py's batcher does
-            # the same per batch for instance pipelines)
-            if val not in ("float32", "bfloat16"):
-                raise ValueError("data_dtype must be float32 or bfloat16")
-            if val == "bfloat16":
-                import ml_dtypes
-                self._dtype = ml_dtypes.bfloat16
-            else:
-                self._dtype = np.float32
+        else:
+            super().set_param(name, val)
 
     def init(self) -> None:
         img = read_idx(self.path_img).astype(np.float32) * (1.0 / 256.0)
-        img = img.astype(self._dtype)
         label = read_idx(self.path_label).astype(np.float32)
         assert img.shape[0] == label.shape[0]
         n, rows, cols = img.shape
         if self.mode == 1:
-            self.img = img.reshape(n, 1, 1, rows * cols)
+            img = img.reshape(n, 1, 1, rows * cols)
         else:
-            self.img = img.reshape(n, 1, rows, cols)
-        self.labels = label.reshape(n, 1)
-        self.inst = np.arange(n, dtype=np.uint32) + self.inst_offset
-        if self.shuffle:
-            order = np.random.RandomState(self.seed).permutation(n)
-            self.img = self.img[order]
-            self.labels = self.labels[order]
-            self.inst = self.inst[order]
-        self.loc = 0
-        if self.silent == 0:
-            print("MNISTIterator: load %d images, shuffle=%d, shape=%s"
-                  % (n, self.shuffle, (self.batch_size,) + self.img.shape[1:]))
-
-    def before_first(self) -> None:
-        self.loc = 0
-
-    def next(self) -> bool:
-        if self.loc + self.batch_size <= self.img.shape[0]:
-            i, b = self.loc, self.batch_size
-            self._value = DataBatch(self.img[i:i + b], self.labels[i:i + b],
-                                    self.inst[i:i + b])
-            self.loc += b
-            return True
-        return False
-
-    def value(self) -> DataBatch:
-        return self._value
+            img = img.reshape(n, 1, rows, cols)
+        self._finalize_load(img, label, "MNISTIterator")

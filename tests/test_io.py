@@ -525,3 +525,72 @@ iter = end
                             "model_in=%s" % (tmp_path / "0001.model")]) == 0
     feats = np.loadtxt(tmp_path / "out.txt")
     assert feats.shape == (64, 3)
+
+
+def test_cifar_iterator(tmp_path):
+    """CIFAR-10 binary format (documented `iter = cifar`, doc/io.md:4):
+    1 label byte + 3072 CHW uint8 bytes per record; multi-file loads,
+    shuffle determinism, bf16 option, and the CIFAR-100 2-byte label mode."""
+    rs = np.random.RandomState(7)
+    labels = rs.randint(0, 10, 50).astype(np.uint8)
+    imgs = rs.randint(0, 255, (50, 3, 32, 32)).astype(np.uint8)
+    recs = np.concatenate([labels[:, None], imgs.reshape(50, -1)], axis=1)
+    (tmp_path / "b1.bin").write_bytes(recs[:30].tobytes())
+    (tmp_path / "b2.bin").write_bytes(recs[30:].tobytes())
+
+    it = create_iterator([
+        ("iter", "cifar"),
+        ("path_data", "%s,%s" % (tmp_path / "b1.bin", tmp_path / "b2.bin")),
+        ("batch_size", "16"),
+        ("silent", "1"),
+    ])
+    batches = list(it)
+    assert len(batches) == 3                       # 50 // 16, tail dropped
+    assert batches[0].data.shape == (16, 3, 32, 32)
+    np.testing.assert_allclose(np.asarray(batches[0].label[:, 0], np.uint8),
+                               labels[:16])
+    np.testing.assert_allclose(batches[0].data[0],
+                               imgs[0].astype(np.float32) / 256.0, rtol=1e-6)
+
+    # shuffle is deterministic per seed and a permutation of the data
+    it2 = create_iterator([
+        ("iter", "cifar"),
+        ("path_data", str(tmp_path / "b1.bin")),
+        ("batch_size", "30"), ("shuffle", "1"), ("silent", "1"),
+    ])
+    it3 = create_iterator([
+        ("iter", "cifar"),
+        ("path_data", str(tmp_path / "b1.bin")),
+        ("batch_size", "30"), ("shuffle", "1"), ("silent", "1"),
+    ])
+    assert it2.next() and it3.next()
+    np.testing.assert_array_equal(it2.value().label, it3.value().label)
+    assert sorted(it2.value().label[:, 0]) == sorted(labels[:30])
+
+    # bf16 pipeline dtype
+    import ml_dtypes
+    it4 = create_iterator([
+        ("iter", "cifar"), ("path_data", str(tmp_path / "b1.bin")),
+        ("batch_size", "8"), ("data_dtype", "bfloat16"), ("silent", "1"),
+    ])
+    assert it4.next()
+    assert it4.value().data.dtype == ml_dtypes.bfloat16
+
+    # CIFAR-100 style: coarse+fine label bytes, fine label (last) is used
+    recs100 = np.concatenate([labels[:10, None] // 2, labels[:10, None],
+                              imgs[:10].reshape(10, -1)], axis=1)
+    (tmp_path / "c100.bin").write_bytes(recs100.tobytes())
+    it5 = create_iterator([
+        ("iter", "cifar"), ("path_data", str(tmp_path / "c100.bin")),
+        ("label_bytes", "2"), ("batch_size", "10"), ("silent", "1"),
+    ])
+    assert it5.next()
+    np.testing.assert_allclose(np.asarray(it5.value().label[:, 0], np.uint8),
+                               labels[:10])
+
+    # corrupt size -> clear error
+    (tmp_path / "bad.bin").write_bytes(b"123")
+    with pytest.raises(ValueError):
+        create_iterator([("iter", "cifar"),
+                         ("path_data", str(tmp_path / "bad.bin")),
+                         ("batch_size", "1")])
