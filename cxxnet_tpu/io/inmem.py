@@ -55,6 +55,10 @@ class InMemoryIterator(IIterator):
                        tag: str) -> None:
         """Apply dtype/shuffle/instance-index bookkeeping to the loaded
         dataset and report, then rewind."""
+        if self.batch_size <= 0:
+            raise ValueError(
+                "%s iterator: batch_size must be set > 0 before init "
+                "(got %d)" % (tag, self.batch_size))
         self.img = img.astype(self._dtype)
         self.labels = labels.astype(np.float32).reshape(img.shape[0], 1)
         n = img.shape[0]

@@ -407,6 +407,17 @@ def _flash_block(n: int, req) -> int:
     return 512 if n >= 512 and n % 512 == 0 else min(256, n)
 
 
+def _check_flash_divisible(n: int, bq: int, bk: int) -> None:
+    """The kernel grids use floor division, so a sequence that is not a
+    multiple of the resolved block size would silently leave tail rows
+    uninitialized.  Fail loudly instead."""
+    if n % bq or n % bk:
+        raise ValueError(
+            "flash attention: seq length %d must be divisible by the "
+            "resolved block sizes (block_q=%d, block_k=%d); pass "
+            "block_q/block_k that divide the sequence" % (n, bq, bk))
+
+
 def _flash_fwd_impl(q, k, v, causal: bool, block_q, block_k,
                     out_dtype=None):
     """Returns (out (b,n,h,d), lse (b,h,n,1)) — lse kept for the backward;
@@ -419,6 +430,7 @@ def _flash_fwd_impl(q, k, v, causal: bool, block_q, block_k,
     vt = jnp.transpose(v, (0, 2, 1, 3))
     bq = _flash_block(n, block_q)
     bk = _flash_block(n, block_k)
+    _check_flash_divisible(n, bq, bk)
     if _flash_resident(n, d):
         kern = functools.partial(_flash_kernel_res, block_k=bk,
                                  causal=causal, scale=scale)
@@ -607,6 +619,7 @@ def _flash_bwd_blocks4(q, k, v, lse, delta, g, causal, block_q, block_k,
     dot = jnp.transpose(g, (0, 2, 1, 3))
     bq = _flash_block(n, block_q)
     bk = _flash_block(n, block_k)
+    _check_flash_divisible(n, bq, bk)
     if _flash_resident(n, d):
         blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
         blk_kd = pl.BlockSpec((1, 1, bk, d), lambda i, j, s: (i, j, s, 0))

@@ -138,7 +138,12 @@ int CXNInit(const char *repo_path) {
     if (const char *p = getenv("CXN_PYTHON")) {
       exe = p;
     } else if (const char *ve = getenv("VIRTUAL_ENV")) {
+      // Some venvs ship only bin/python; try python3 first, then python.
       exe = std::string(ve) + "/bin/python3";
+      if (access(exe.c_str(), X_OK) != 0) {
+        std::string alt = std::string(ve) + "/bin/python";
+        if (access(alt.c_str(), X_OK) == 0) exe = alt;
+      }
     }
     PyStatus st;
     if (!exe.empty()) {

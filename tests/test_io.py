@@ -594,3 +594,17 @@ def test_cifar_iterator(tmp_path):
         create_iterator([("iter", "cifar"),
                          ("path_data", str(tmp_path / "bad.bin")),
                          ("batch_size", "1")])
+
+
+def test_inmem_iterator_requires_batch_size(tmp_path):
+    """batch_size=0 previously made next() return an empty batch forever
+    (an infinite loop for any consumer); init must reject it."""
+    rs = np.random.RandomState(9)
+    imgs = rs.randint(0, 256, size=(4, 3, 32, 32), dtype=np.uint8)
+    labels = rs.randint(0, 10, size=4).astype(np.uint8)
+    recs = np.concatenate([labels[:, None], imgs.reshape(4, -1)], axis=1)
+    (tmp_path / "nb.bin").write_bytes(recs.tobytes())
+    with pytest.raises(ValueError, match="batch_size"):
+        create_iterator([("iter", "cifar"),
+                         ("path_data", str(tmp_path / "nb.bin")),
+                         ("silent", "1")])

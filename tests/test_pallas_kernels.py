@@ -145,3 +145,15 @@ def test_flash_streaming_family_matches_reference(monkeypatch):
         g = jnp.asarray(rs.randn(*q.shape).astype(np.float32))
         for a, b in zip(vjp_out(g), vjp_ref(g)):
             assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+
+
+def test_flash_attention_rejects_unaligned_seq():
+    """Grids use floor division — a sequence not divisible by the block
+    size must raise rather than silently leave tail rows uninitialized."""
+    rs = np.random.RandomState(11)
+    q, k, v = (jnp.asarray(rs.randn(1, 24, 2, 8).astype(np.float32))
+               for _ in range(3))
+    with pytest.raises(ValueError, match="divisible"):
+        pk.flash_attention(q, k, v, False, 16, 8)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.grad(lambda a: pk.flash_attention(a, k, v, False, 8, 16).sum())(q)
