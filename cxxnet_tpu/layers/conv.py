@@ -86,10 +86,13 @@ class ConvLayer(Layer):
         p = self.param
         x = inputs[0]
         w = params["wmat"].astype(x.dtype)
-        # opt-in (CXN_S2D=1): measured no gain on one v5e chip — 17.8k
-        # img/s with vs 18.0k without on the AlexNet bench (tunnel noise
-        # band); XLA's own conv lowering already handles the 3-channel
-        # stem well. Kept as an exact, tested lever for other topologies.
+        # opt-in (CXN_S2D=1): measured a small LOSS on one v5e chip —
+        # 17.4k img/s with vs 17.7k without on the AlexNet bench (r2
+        # back-to-back A/B; r1 measured 17.8k vs 18.0k) — the
+        # space-to-depth transpose of the 1024x227x227x3 input costs a
+        # full HBM pass that the better-shaped stem convs don't win back.
+        # XLA's own conv lowering handles the 3-channel stem well. Kept
+        # as an exact, tested lever for other topologies.
         if (self.in_channel <= 4 and p.stride >= 2 and p.num_group == 1
                 and os.environ.get("CXN_S2D", "") == "1"):
             out = self._space_to_depth_conv(x, w, p)

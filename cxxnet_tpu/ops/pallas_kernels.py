@@ -22,6 +22,17 @@ numerics):
   Backward: FlashAttention-2-style blockwise kernels — one pass over
   q-blocks for dq, one over k-blocks for dk/dv, probabilities recomputed
   from the saved lse (never materializing the N x N matrix).
+- **fused relu->LRN->maxpool** (the AlexNet head-of-block chain): one pass
+  per direction, saving (u, norm) as training residuals. NOT the default
+  path — measured on one v5e chip it loses to the XLA chain ~2.8x
+  (fwd+bwd bf16: 53.6 vs 19.5 ms @ 1024x55x55x96, 27.1 vs 11.5 @
+  1024x27x27x256): the unaligned spatial shapes make every in-kernel
+  pad/reshape/slice a vreg relayout, so the kernel is VPU-bound while
+  XLA's fusions run at the HBM floor. Kept as the *reference-semantics
+  oracle* for pooling gradients: its backward credits every tied maximum
+  with the full window gradient (mshadow unpool, pooling_layer-inl.hpp
+  backprop expression), which XLA's select-and-scatter (first-max-only)
+  cannot express — the PairTest role, not the hot path.
 
 Use ``use_pallas()`` to gate: True on TPU backends, else the jnp reference
 paths in the callers stay active.
@@ -719,4 +730,288 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 __all__ = ["use_pallas", "lrn_fused", "flash_attention",
-           "flash_fwd_with_lse", "flash_bwd_blocks"]
+           "flash_fwd_with_lse", "flash_bwd_blocks",
+           "fused_relu_lrn_maxpool", "fused_relu_lrn_maxpool_supported"]
+
+
+# ---------------------------------------------------------------------------
+# fused relu -> LRN -> max-pool (the AlexNet head-of-block chain)
+# ---------------------------------------------------------------------------
+#
+# The reference runs these as three layers (activation_layer-inl.hpp,
+# lrn_layer-inl.hpp:46-77, pooling_layer-inl.hpp:33-86); as separate XLA
+# ops the chain costs ~5 full HBM round-trips of the conv activation per
+# step (band-matmul + pow/mul forward passes, a backward mega-fusion, and
+# a select-and-scatter for the pool gradient).  This kernel family fuses
+# the chain into one pass per direction:
+#
+#   forward (inference):  read x            -> write pooled
+#   forward (training):   read x            -> write pooled, u, norm
+#   backward:             read u, norm, g   -> write dx
+#
+# where u = lrn(relu(x)) and norm is the LRN denominator.  Saving (u,
+# norm) instead of x lets the backward run without any re-derivation
+# chain: r·p == u recovers every term (t = du·u/norm, r = u/p, and the
+# relu mask is u > 0), so each pass stays a single whole-image VMEM
+# block with a small live set — no halo banding, no manual DMA.
+#
+# Pool-gradient semantics: every element equal to its window's max gets
+# the full window gradient, summed over covering windows — exactly the
+# reference's unpool expression ((src == pooled) * grad, mshadow), unlike
+# XLA's select-and-scatter which credits only the first maximum.
+
+def _rlp_win_sum(v, n, transpose=False):
+    """Windowed sum over the channel (lane) dim via static lane rotates +
+    iota edge masks (f32 accumulation; bf16 terms like the XLA band
+    path). Window: reference left-biased center (chpool); ``transpose``
+    flips the offset range (the band-matrix transpose of the backward)."""
+    pad_lo = (n - 1) // 2
+    c = v.shape[-1]
+    offs = range(-(n - 1 - pad_lo), pad_lo + 1) if transpose \
+        else range(-pad_lo, n - pad_lo)
+    lane = jax.lax.broadcasted_iota(
+        jnp.int32, (1,) * (v.ndim - 1) + (c,), v.ndim - 1)
+    acc = None
+    for d in offs:
+        rolled = v if d == 0 else jnp.roll(v, -d, axis=-1)
+        ok = (lane + d >= 0) & (lane + d < c)
+        term = jnp.where(ok, rolled, jnp.zeros((), v.dtype))
+        acc = term.astype(jnp.float32) if acc is None \
+            else acc + term.astype(jnp.float32)
+    return acc
+
+
+def _rlp_u_norm_p(x, relu, n, alpha, beta, knorm):
+    """u = lrn(relu(x)), norm (input dtype — the XLA band path's bf16
+    cast), p = norm^-beta (f32)."""
+    r = jnp.maximum(x, 0) if relu else x
+    sq = _rlp_win_sum(r * r, n)
+    norm = (knorm + (alpha / n) * sq).astype(x.dtype)
+    p = jnp.exp(-beta * jnp.log(norm.astype(jnp.float32)))
+    u = (r.astype(jnp.float32) * p).astype(x.dtype)
+    return u, norm, p
+
+
+def _pool_slice3(u, oy, ox, a, b, stride):
+    """(IB, H, W, C) -> the (a, b) window-offset plane u[:, a+s*wy, b+s*wx].
+
+    Mosaic only lowers unit-stride vector slices, so the stride is taken
+    by pad -> reshape (rows, s, ...) -> index 0; the zero padding is never
+    selected (index 0 of each s-block stays in-bounds)."""
+    ib, h, w, c = u.shape
+    s = stride
+    if s == 1:
+        return jax.lax.slice(u, (0, a, b, 0), (ib, a + oy, b + ox, c))
+    v = u[:, a:]
+    pad_y = oy * s - v.shape[1]
+    if pad_y > 0:
+        v = jnp.pad(v, ((0, 0), (0, pad_y), (0, 0), (0, 0)))
+    v = v[:, :oy * s].reshape(ib, oy, s, v.shape[2], c)[:, :, 0]
+    v = v[:, :, b:]
+    pad_x = ox * s - v.shape[2]
+    if pad_x > 0:
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_x), (0, 0)))
+    return v[:, :, :ox * s].reshape(ib, oy, ox, s, c)[:, :, :, 0]
+
+
+def _rlp_pool(u, oy, ox, kernel, stride):
+    pooled = _pool_slice3(u, oy, ox, 0, 0, stride)
+    for a in range(kernel):
+        for b in range(kernel):
+            if a == 0 and b == 0:
+                continue
+            pooled = jnp.maximum(pooled,
+                                 _pool_slice3(u, oy, ox, a, b, stride))
+    return pooled
+
+
+def _rlp_sub(v, ry, rx, ny, nx, stride, c):
+    """Strided sub-grid read: v[:, ry + s*i, rx + s*j, :] padded (zeros)
+    to (1, ny, nx, c).  Same pad -> reshape -> index-0 trick as
+    :func:`_pool_slice3` (unit-stride slices only; splits of the sublane
+    dim lower, merges do not)."""
+    return _pool_slice3(v, ny, nx, ry, rx, stride)
+
+
+def _shift_win(v, da, db, fill):
+    """result[:, i, j] = v[:, i - da, j - db] (``fill`` outside)."""
+    h, w = v.shape[1], v.shape[2]
+    if da or db:
+        v = jnp.pad(v[:, :h - da, :w - db],
+                    ((0, 0), (da, 0), (db, 0), (0, 0)),
+                    constant_values=fill)
+    return v
+
+
+def _rlp_infer_kernel(x_ref, o_ref, *, relu, n, alpha, beta, knorm,
+                      kernel, stride, oy, ox):
+    u, _, _ = _rlp_u_norm_p(x_ref[:], relu, n, alpha, beta, knorm)
+    o_ref[:] = _rlp_pool(u, oy, ox, kernel, stride)
+
+
+def _rlp_train_kernel(x_ref, o_ref, u_ref, norm_ref, *, relu, n, alpha,
+                      beta, knorm, kernel, stride, oy, ox):
+    u, norm, _ = _rlp_u_norm_p(x_ref[:], relu, n, alpha, beta, knorm)
+    u_ref[:] = u
+    norm_ref[:] = norm
+    o_ref[:] = _rlp_pool(u, oy, ox, kernel, stride)
+
+
+def _rlp_bwd_kernel(u_ref, norm_ref, g_ref, *dx_refs, relu, n, alpha,
+                    beta, kernel, stride, oy, ox, ny, nx):
+    """Backward over the s x s stride-residue sub-grids.
+
+    Interleaving sub-grids back onto the input grid is a sublane-minor
+    relayout Mosaic cannot lower, so each residue (ry, rx) — input rows
+    y = s*i + ry, cols x = s*j + rx — is computed independently (the LRN
+    and relu parts are per-pixel, and the pool windows covering a
+    position map to plain shifts in window space) and written to its own
+    (1, ny, nx, C) output; the caller re-interleaves in XLA.
+
+    Tie test: window maxima are matched by f32 value equality (bf16
+    compares don't lower on this target; the f32 cast of a bf16 value is
+    exact, so every element equal to its window's max matches — the
+    mshadow ``(src == pooled)`` reference semantics)."""
+    u = u_ref[:]
+    g = g_ref[:]
+    s = stride
+    c = u.shape[-1]
+    pooled = _rlp_pool(u, oy, ox, kernel, s)
+    # pad the window grid to the sub-grid size: indices past the last
+    # window contribute nothing (-inf never matches finite data); the
+    # tie test runs in f32 (bf16/i16 compares don't lower on this target)
+    pooled_pad = jnp.pad(
+        pooled.astype(jnp.float32),
+        ((0, 0), (0, ny - oy), (0, nx - ox), (0, 0)),
+        constant_values=-jnp.inf)
+    g_pad = jnp.pad(g, ((0, 0), (0, ny - oy), (0, nx - ox), (0, 0)))
+    for ry in range(s):
+        for rx in range(s):
+            u_sub = _rlp_sub(u, ry, rx, ny, nx, s, c)
+            u_f32 = u_sub.astype(jnp.float32)
+            du = jnp.zeros(u_sub.shape, u.dtype)
+            # windows covering y = s*i + ry have offset a ≡ ry (mod s):
+            # window row i - da with da = (a - ry) // s
+            for a in range(ry, kernel, s):
+                for b in range(rx, kernel, s):
+                    da, db = (a - ry) // s, (b - rx) // s
+                    eq = _shift_win(pooled_pad, da, db, -jnp.inf) == u_f32
+                    du = du + jnp.where(eq, _shift_win(g_pad, da, db, 0),
+                                        jnp.zeros((), u.dtype))
+            # LRN backward from the saved (u, norm): with r·p == u,
+            #   t  = du·r·p/norm = du·u/norm
+            #   dx = du·p − (2αβ/n)·(u/p)·Σ_T(t)
+            # (pad rows carry norm == 0 -> NaNs, discarded by the caller's
+            # final slice)
+            nf = _rlp_sub(norm_ref[:], ry, rx, ny, nx, s, c) \
+                .astype(jnp.float32)
+            p = jnp.exp(-beta * jnp.log(nf))
+            duf = du.astype(jnp.float32)
+            uf = u_sub.astype(jnp.float32)
+            t = (duf * uf / nf).astype(u.dtype)
+            s2 = _rlp_win_sum(t, n, transpose=True)
+            dr = duf * p - (2.0 * (alpha / n) * beta) * (uf / p) * s2
+            if relu:
+                # u > 0 <=> r > 0 <=> x > 0 (p is strictly positive)
+                dr = jnp.where(uf > 0, dr, 0.0)
+            dx_refs[ry * s + rx][:] = dr.astype(u.dtype)
+
+
+def _rlp_pool_shape(h: int, w: int, kernel: int, stride: int):
+    oy = (h - kernel) // stride + 1
+    ox = (w - kernel) // stride + 1
+    return oy, ox
+
+
+def fused_relu_lrn_maxpool_supported(shape, n: int, kernel: int,
+                                     stride: int, pad: int,
+                                     pool_out) -> bool:
+    """True iff the fused kernel reproduces the unfused chain exactly:
+    in-bounds pool windows (ceil-mode never pads) and a whole image +
+    intermediates within the VMEM budget."""
+    b, h, w, c = shape
+    if pad != 0 or n > c or kernel > h or kernel > w:
+        return False
+    oy, ox = _rlp_pool_shape(h, w, kernel, stride)
+    if pool_out is not None and (oy, ox) != tuple(pool_out):
+        return False
+    return h * w * c * 30 < 12 * 1024 * 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
+def fused_relu_lrn_maxpool(x: jnp.ndarray, relu: bool, n: int, alpha: float,
+                           beta: float, knorm: float, kernel: int,
+                           stride: int) -> jnp.ndarray:
+    """maxpool(lrn(relu(x))) in one VMEM pass over NHWC ``x``.
+
+    Under differentiation the forward additionally saves (u, norm) so the
+    backward is also a single pass.  Call
+    :func:`fused_relu_lrn_maxpool_supported` first."""
+    b, h, w, c = x.shape
+    oy, ox = _rlp_pool_shape(h, w, kernel, stride)
+    kern = functools.partial(_rlp_infer_kernel, relu=relu, n=n, alpha=alpha,
+                             beta=beta, knorm=knorm, kernel=kernel,
+                             stride=stride, oy=oy, ox=ox)
+    return pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))],
+        out_specs=pl.BlockSpec((1, oy, ox, c), lambda i: (i, 0, 0, 0)),
+        out_shape=_out_struct((b, oy, ox, c), x.dtype, x),
+        interpret=_INTERPRET,
+    )(x)
+
+
+def _rlp_fwd(x, relu, n, alpha, beta, knorm, kernel, stride):
+    b, h, w, c = x.shape
+    oy, ox = _rlp_pool_shape(h, w, kernel, stride)
+    kern = functools.partial(_rlp_train_kernel, relu=relu, n=n, alpha=alpha,
+                             beta=beta, knorm=knorm, kernel=kernel,
+                             stride=stride, oy=oy, ox=ox)
+    img = pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))
+    pooled, u, norm = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[img],
+        out_specs=[pl.BlockSpec((1, oy, ox, c), lambda i: (i, 0, 0, 0)),
+                   img, img],
+        out_shape=[_out_struct((b, oy, ox, c), x.dtype, x),
+                   _out_struct((b, h, w, c), x.dtype, x),
+                   _out_struct((b, h, w, c), x.dtype, x)],
+        interpret=_INTERPRET,
+    )(x)
+    return pooled, (u, norm)
+
+
+def _rlp_bwd(relu, n, alpha, beta, knorm, kernel, stride, res, g):
+    u, norm = res
+    b, h, w, c = u.shape
+    s = stride
+    oy, ox = _rlp_pool_shape(h, w, kernel, s)
+    ny, nx = -(-h // s), -(-w // s)
+    kern = functools.partial(_rlp_bwd_kernel, relu=relu, n=n, alpha=alpha,
+                             beta=beta, kernel=kernel, stride=s,
+                             oy=oy, ox=ox, ny=ny, nx=nx)
+    img = pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))
+    sub = pl.BlockSpec((1, ny, nx, c), lambda i: (i, 0, 0, 0))
+    parts = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[img, img,
+                  pl.BlockSpec((1, oy, ox, c), lambda i: (i, 0, 0, 0))],
+        out_specs=[sub] * (s * s),
+        out_shape=[_out_struct((b, ny, nx, c), u.dtype, u)] * (s * s),
+        interpret=_INTERPRET,
+    )(u, norm, g)
+    if s == 1:
+        return (parts[0][:, :h, :w],)
+    # re-interleave the stride-residue sub-grids: (b, ny, nx, c) x s^2
+    # -> (b, ny, s, nx, s, c) -> (b, ny*s, nx*s, c) -> crop.  Pure
+    # stack/transpose/reshape: one XLA copy fusion.
+    stacked = jnp.stack(parts, axis=1).reshape(b, s, s, ny, nx, c)
+    dx = jnp.transpose(stacked, (0, 3, 1, 4, 2, 5)) \
+        .reshape(b, ny * s, nx * s, c)[:, :h, :w]
+    return (dx,)
+
+
+fused_relu_lrn_maxpool.defvjp(_rlp_fwd, _rlp_bwd)

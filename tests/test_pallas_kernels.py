@@ -157,3 +157,52 @@ def test_flash_attention_rejects_unaligned_seq():
         pk.flash_attention(q, k, v, False, 16, 8)
     with pytest.raises(ValueError, match="divisible"):
         jax.grad(lambda a: pk.flash_attention(a, k, v, False, 8, 16).sum())(q)
+
+
+def _unfused_rlp(x, n, alpha, beta, knorm, k, s, relu=True):
+    r = jnp.maximum(x, 0) if relu else x
+    pad_lo = (n - 1) // 2
+    sq = jax.lax.reduce_window(r * r, 0.0, jax.lax.add, (1, 1, 1, n),
+                               (1, 1, 1, 1),
+                               ((0, 0), (0, 0), (0, 0),
+                                (pad_lo, n - 1 - pad_lo)))
+    norm = knorm + (alpha / n) * sq
+    u = r * norm ** (-beta)
+    return jax.lax.reduce_window(u, -jnp.inf, jax.lax.max, (1, k, k, 1),
+                                 (1, s, s, 1),
+                                 ((0, 0), (0, 0), (0, 0), (0, 0)))
+
+
+@pytest.mark.parametrize("shape,k,s", [
+    ((4, 13, 13, 16), 3, 2),    # AlexNet-style overlap, odd size
+    ((2, 9, 9, 8), 3, 2),
+    ((2, 8, 8, 8), 2, 2),       # non-overlapping
+])
+def test_fused_relu_lrn_maxpool_matches_chain(shape, k, s):
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+    args = (5, 1e-4, 0.75, 1.0)
+    assert pk.fused_relu_lrn_maxpool_supported(shape, 5, k, s, 0, None)
+    out_f = pk.fused_relu_lrn_maxpool(x, True, *args, k, s)
+    out_r = _unfused_rlp(x, *args, k, s)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=1e-5, atol=1e-5)
+    g_f = jax.grad(lambda a: (
+        pk.fused_relu_lrn_maxpool(a, True, *args, k, s) ** 2).sum())(x)
+    g_r = jax.grad(lambda a: (_unfused_rlp(a, *args, k, s) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_relu_lrn_maxpool_tie_semantics():
+    """On ties the fused backward credits EVERY maximal element with the
+    full window gradient — the reference unpool expression
+    ((src == pooled) * grad, mshadow pooling backward), which XLA's
+    select-and-scatter (first-max-only) does not reproduce."""
+    # constant input, no lrn effect (alpha=0): pure relu+maxpool chain
+    x = jnp.ones((1, 4, 4, 8), jnp.float32)
+    k, s = 2, 2
+    g = jax.grad(lambda a: pk.fused_relu_lrn_maxpool(
+        a, True, 1, 0.0, 0.75, 1.0, k, s).sum())(x)
+    # every element ties in its (non-overlapping) window -> grad 1 each
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(np.asarray(g)))
