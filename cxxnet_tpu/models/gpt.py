@@ -29,7 +29,8 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import local_attention, ring_attention_inner
+from ..ops.attention import (local_attention, local_attention_bhnd,
+                             ring_attention_inner)
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                              batch_sharding)
 from ..parallel.pipeline import gpipe
@@ -49,34 +50,58 @@ class GPTConfig:
     #                             ~1/3 more FLOPs for O(layers) less HBM —
     #                             the long-context/deep-model memory lever
     #                             (jax.checkpoint per transformer block)
-    remat_save_attn: bool = False  # under remat, also save each block's
-    #                             attention output (checkpoint_name policy)
-    #                             instead of re-running the kernel in the
-    #                             backward. Off by default: measured SLOWER
-    #                             on one v5e chip (85M flagship, 32x1024:
-    #                             330 vs 312 ms/step) — the extra HBM
-    #                             writes/reads of the saved activations
-    #                             cost more than the flash-kernel recompute
+    remat_save_attn: bool = False  # under remat_mode="block", also save
+    #                             each block's attention output
+    #                             (checkpoint_name policy). Measured SLOWER
+    #                             both at 85M (330 vs 312 ms/step, 32x1024)
+    #                             and 303M (439 vs 423, 16x1024): the flash
+    #                             custom-vjp re-runs its forward for its
+    #                             internal residuals regardless, so the
+    #                             saved output is pure extra HBM traffic.
+    #                             Kept for the measurement; prefer
+    #                             remat_mode="attn_saved".
+    remat_mode: str = "block"   # "block": whole-block remat (max memory
+    #                             savings — the long-context mode) — the
+    #                             DEFAULT, and measured fastest or tied at
+    #                             every scale tried. "attn_saved": remat
+    #                             only the MLP half; the attention half's
+    #                             residuals (packed head-major qo/kv +
+    #                             lse) stay saved, so the flash forward
+    #                             never re-runs in the backward. Measured
+    #                             on one v5e chip: 85M @ 32x1024 within
+    #                             noise (283 vs 286 ms/step); 303M @
+    #                             16x1024 SLOWER (481 vs 423) — the saved
+    #                             attention activations push HBM pressure
+    #                             into XLA's own rematerialization/
+    #                             compression passes, which cost more than
+    #                             the avoided recompute. Kept as the
+    #                             measured option switch.
 
 
 def _layernorm(x, g, b, eps=1e-5):
+    # plain jnp: XLA's LN fusions fold the stats and scale/shift into the
+    # neighboring residual/projection fusions. The Pallas layernorm_fused
+    # kernel (one pass per direction, f32 row stats saved) measured
+    # NEUTRAL-to-slightly-slower swapped in here (427 vs 422 ms/step on
+    # the 303M flagship) — what the op-level trace attributes to "LN
+    # fusions" is shared with neighbors, so a standalone kernel just
+    # un-fuses those. Kept in ops/pallas_kernels.py as the measured
+    # alternative.
     xf = x.astype(jnp.float32)
     mean = xf.mean(-1, keepdims=True)
     var = ((xf - mean) ** 2).mean(-1, keepdims=True)
     return ((xf - mean) * lax.rsqrt(var + eps) * g + b).astype(x.dtype)
 
 
-def _block_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
-                attn, reduce):
-    """Pre-LN transformer block body — the ONE copy of the block math.
-
-    ``attn(q4, k4, v4) -> (att4, aux)`` supplies the attention variant
-    (full-causal, ring, or KV-cached); ``reduce`` combines row-sharded
-    matmul partials (lax.psum inside shard_map, identity under GSPMD jit).
-    Separate Q/K/V projections so the model-axis shard of each is a whole
-    set of heads (a fused (F,3F) weight sharded on its last dim would hand
-    rank 0 all of Q and half of K instead).
-    """
+def _attn_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
+               attn, reduce):
+    """Attention half of the pre-LN block (LN1 -> QKV -> attn -> proj ->
+    residual). ``attn(q4, k4, v4) -> (att4, aux)`` supplies the attention
+    variant (full-causal, ring, or KV-cached); ``reduce`` combines
+    row-sharded matmul partials (lax.psum inside shard_map, identity under
+    GSPMD jit). Separate Q/K/V projections so the model-axis shard of each
+    is a whole set of heads (a fused (F,3F) weight sharded on its last dim
+    would hand rank 0 all of Q and half of K instead)."""
     b, n, _ = h.shape
     x = _layernorm(h, p["ln1_g"], p["ln1_b"])
     q = x @ p["w_q"].astype(x.dtype) + p["b_q"].astype(x.dtype)
@@ -86,11 +111,40 @@ def _block_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     att, aux = attn(q.reshape(b, n, n_head, d), k.reshape(b, n, n_head, d),
                     v.reshape(b, n, n_head, d))
     o = reduce(att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype))
-    h = h + o + p["b_proj"].astype(x.dtype)
+    return h + o + p["b_proj"].astype(x.dtype), aux
+
+
+def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce):
+    """MLP half of the pre-LN block (LN2 -> up -> relu -> down ->
+    residual)."""
     x = _layernorm(h, p["ln2_g"], p["ln2_b"])
     m = jax.nn.relu(x @ p["w_mlp1"].astype(x.dtype) + p["b_mlp1"].astype(x.dtype))
     m = reduce(m @ p["w_mlp2"].astype(x.dtype))
-    return h + m + p["b_mlp2"].astype(x.dtype), aux
+    return h + m + p["b_mlp2"].astype(x.dtype)
+
+
+def _block_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
+                attn, reduce):
+    """Pre-LN transformer block body — the ONE copy of the block math
+    (attention half + MLP half; split so the train path can draw the
+    remat boundary between them)."""
+    h, aux = _attn_core(p, h, n_head, attn, reduce)
+    return _mlp_core(p, h, reduce), aux
+
+
+def _train_attn(q, k, v, use_ring: bool):
+    """Training-time attention variant: ring over the seq axis, else the
+    head-major flash path (residuals saved (b,h,n,d), so under
+    remat_mode="attn_saved" the backward re-reads them with zero layout
+    copies)."""
+    if use_ring:
+        att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
+    else:
+        tr = lambda t: jnp.transpose(t, (0, 2, 1, 3))
+        att = tr(local_attention_bhnd(tr(q), tr(k), tr(v), causal=True))
+    # tagged for the remat policy: save the attention output instead of
+    # re-running the kernel in the backward (gpt_logits, remat_save_attn)
+    return checkpoint_name(att, "attn_out"), None
 
 
 def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
@@ -98,18 +152,40 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
     """Training block on local shards (b, n_local, F), inside gpipe's
     shard_map: explicit psum combines row-sharded partials (on a size-1
     model axis it is the identity, and demotes the vma type)."""
-    def attn(q, k, v):
-        if use_ring:
-            att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
-        else:
-            att = local_attention(q, k, v, causal=True)
-        # tagged for the remat policy: save the attention output instead of
-        # re-running the kernel in the backward (gpt_logits, remat_save_attn)
-        return checkpoint_name(att, "attn_out"), None
-
-    out, _ = _block_core(p, h, n_head_local, attn,
+    out, _ = _block_core(p, h, n_head_local,
+                         lambda q, k, v: _train_attn(q, k, v, use_ring),
                          lambda t: lax.psum(t, MODEL_AXIS))
     return out
+
+
+def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
+                     n_head_local: int, use_ring: bool) -> jnp.ndarray:
+    """Training block with the remat boundary between the halves: the
+    attention half runs un-rematted (the flash custom-vjp's residuals —
+    q/k/v/out head-major + log-sum-exp — stay saved, so its backward does
+    NOT re-run the forward kernel), while the MLP half is rematerialized.
+
+    Motivation: whole-block jax.checkpoint re-runs the flash forward in
+    the backward (~28 ms/step at 303M) plus the LN1/QKV projections and
+    the (b,n,h,d)<->(b,h,n,d) layout copies around the kernels (~36
+    ms/step of pure copies). Saving only the attention *output*
+    (remat_save_attn) cannot avoid that: the custom-vjp still needs its
+    internal residuals, so the forward re-runs anyway and the saved copy
+    is pure extra HBM traffic (measured SLOWER, 439 vs 423 ms/step).
+
+    Measured outcome (one v5e chip): the avoided recompute does NOT beat
+    whole-block remat in practice — 85M @ 32x1024 within noise (283 vs
+    286 ms/step), 303M @ 16x1024 slower (481 vs 423) because the
+    O(layers) saved attention activations (even lane-packed, see
+    _flash_pack_res) push HBM occupancy into XLA's own remat/compression
+    passes. XLA overlaps the block-remat recompute well enough that the
+    boundary move buys nothing; kept as a config switch because the
+    trade-off is scale-dependent."""
+    reduce = lambda t: lax.psum(t, MODEL_AXIS)
+    h, _ = _attn_core(p, h, n_head_local,
+                      lambda q, k, v: _train_attn(q, k, v, use_ring),
+                      reduce)
+    return jax.checkpoint(lambda pp, hh: _mlp_core(pp, hh, reduce))(p, h)
 
 
 def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
@@ -190,14 +266,21 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     if cfg.seq_len % max(n_sp, 1):
         raise ValueError("seq_len %d must be divisible by the seq axis "
                          "(seq_parallel=%d)" % (cfg.seq_len, n_sp))
+    if cfg.remat_mode not in ("block", "attn_saved"):
+        raise ValueError("remat_mode must be 'block' or 'attn_saved', got %r"
+                         % (cfg.remat_mode,))
     h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
-    block = functools.partial(
-        _block, n_head_local=cfg.n_head // max(n_tp, 1),
-        use_ring=n_sp > 1)
-    if cfg.remat:
-        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
-                  if cfg.remat_save_attn else None)
-        block = jax.checkpoint(block, policy=policy)
+    kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=n_sp > 1)
+    if cfg.remat and cfg.remat_mode == "attn_saved":
+        # remat boundary between the block halves — see _block_mlp_remat
+        block = functools.partial(_block_mlp_remat, **kw)
+    else:
+        block = functools.partial(_block, **kw)
+        if cfg.remat:
+            policy = (
+                jax.checkpoint_policies.save_only_these_names("attn_out")
+                if cfg.remat_save_attn else None)
+            block = jax.checkpoint(block, policy=policy)
     h = gpipe(block, params["blocks"], h, mesh, cfg.n_microbatch,
               extra_spec_axes=(SEQ_AXIS,), param_specs=_block_param_specs())
     h = _layernorm(h, params["lnf_g"], params["lnf_b"])

@@ -1,6 +1,7 @@
 """4D-parallel GPT flagship: dp x pp x sp x tp in one jitted train step."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -186,3 +187,41 @@ def test_make_train_step_rejects_unknown_optimizer():
     params = gpt_place(gpt_init(jax.random.PRNGKey(2), CFG), mesh)
     with pytest.raises(ValueError, match="optimizer"):
         gpt_opt_init(params, mesh, "rmsprop")
+
+
+def test_remat_mode_attn_saved_matches_block():
+    """The remat_mode="attn_saved" branch (_block_mlp_remat + packed
+    flash residuals) must produce the same loss and gradients as the
+    default whole-block remat."""
+    import numpy as np
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:1])
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(0, 61, (2, 16)).astype(np.int32))
+    base = dict(vocab_size=61, seq_len=16, n_layer=2, n_head=2, feat=32,
+                n_microbatch=1, remat=True)
+    params = gpt_init(jax.random.PRNGKey(3), GPTConfig(**base))
+    out = {}
+    for mode in ("block", "attn_saved"):
+        cfg = GPTConfig(remat_mode=mode, **base)
+        out[mode] = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
+    np.testing.assert_allclose(out["block"][0], out["attn_saved"][0],
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(out["block"][1]),
+                    jax.tree.leaves(out["attn_saved"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_mode_validated():
+    import numpy as np
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:1])
+    cfg = GPTConfig(vocab_size=61, seq_len=16, n_layer=1, n_head=2,
+                    feat=32, remat=True, remat_mode="atn_saved")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="remat_mode"):
+        gpt_loss(params, ids, cfg, mesh)

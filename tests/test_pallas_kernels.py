@@ -206,3 +206,48 @@ def test_fused_relu_lrn_maxpool_tie_semantics():
         a, True, 1, 0.0, 0.75, 1.0, k, s).sum())(x)
     # every element ties in its (non-overlapping) window -> grad 1 each
     np.testing.assert_allclose(np.asarray(g), np.ones_like(np.asarray(g)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bhnd_packed_residual_grads(causal):
+    """d=64 engages the packed-residual backward (qo/kv lane-pair
+    packing); gradients must match the token-major flash path."""
+    rs = np.random.RandomState(13)
+    b, h, n, d = 2, 3, 32, 64
+    q, k, v = (jnp.asarray(rs.randn(b, h, n, d).astype(np.float32))
+               for _ in range(3))
+    assert pk._flash_pack_res(d, n)
+    tr = lambda t: jnp.transpose(t, (0, 2, 1, 3))
+    g_ref = jax.grad(lambda a, bb, c: (
+        pk.flash_attention(tr(a), tr(bb), tr(c), causal, 8, 8) ** 2)
+        .sum(), (0, 1, 2))(q, k, v)
+    g_out = jax.grad(lambda a, bb, c: (
+        pk.flash_attention_bhnd(a, bb, c, causal, 8, 8) ** 2)
+        .sum(), (0, 1, 2))(q, k, v)
+    for a, b2 in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_layernorm_fused_matches_reference():
+    def ref_ln(x, g, b, eps=1e-5):
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+        return ((xf - mean) * jax.lax.rsqrt(var + eps) * g + b).astype(
+            x.dtype)
+
+    rs = np.random.RandomState(21)
+    for shape in [(16, 128), (2, 8, 256)]:
+        x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+        g = jnp.asarray(rs.randn(shape[-1]).astype(np.float32))
+        b = jnp.asarray(rs.randn(shape[-1]).astype(np.float32))
+        assert pk.layernorm_fused_supported(shape, x.dtype)
+        np.testing.assert_allclose(
+            np.asarray(pk.layernorm_fused(x, g, b)),
+            np.asarray(ref_ln(x, g, b)), rtol=2e-5, atol=2e-5)
+        grads = lambda fn: jax.grad(
+            lambda a, gg, bb: (fn(a, gg, bb) ** 2).sum(), (0, 1, 2))(x, g, b)
+        for got, want in zip(grads(pk.layernorm_fused), grads(ref_ln)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-4, atol=1e-4)
