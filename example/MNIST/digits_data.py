@@ -1,0 +1,52 @@
+"""Write REAL handwritten-digit data in the mnist iterator's idx.gz format.
+
+This sandbox has no network egress, so `run.sh`'s MNIST download cannot
+run here. For committed, reproducible real-data convergence evidence the
+framework's repo uses the UCI Optical Recognition of Handwritten Digits
+set (1,797 real scanned digits, 8x8 grayscale, bundled with scikit-learn
+as `load_digits`) written into the exact on-disk format the `mnist`
+iterator consumes (idx3/idx1, gzip — iter_mnist-inl.hpp:14-158 parity).
+`MNIST.conf` / `MNIST_CONV.conf` remain the full-size recipes when the
+download is possible.
+
+Usage: python example/MNIST/digits_data.py [outdir=./data-digits]
+"""
+
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+
+def write_idx(outdir: str, seed: int = 7, n_test: int = 297) -> None:
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    # 0..16 pixel range -> 0..255 uint8 (the iterator divides by 256)
+    imgs = np.clip(d.images * 16, 0, 255).astype(np.uint8)
+    labels = d.target.astype(np.uint8)
+    order = np.random.RandomState(seed).permutation(imgs.shape[0])
+    imgs, labels = imgs[order], labels[order]
+    n_train = imgs.shape[0] - n_test
+    os.makedirs(outdir, exist_ok=True)
+    splits = {
+        "train-images-idx3-ubyte.gz": imgs[:n_train],
+        "t10k-images-idx3-ubyte.gz": imgs[n_train:],
+    }
+    for name, arr in splits.items():
+        with gzip.open(os.path.join(outdir, name), "wb") as f:
+            n, r, c = arr.shape
+            f.write(struct.pack(">iiii", 2051, n, r, c))
+            f.write(arr.tobytes())
+    for name, arr in (("train-labels-idx1-ubyte.gz", labels[:n_train]),
+                      ("t10k-labels-idx1-ubyte.gz", labels[n_train:])):
+        with gzip.open(os.path.join(outdir, name), "wb") as f:
+            f.write(struct.pack(">ii", 2049, arr.shape[0]))
+            f.write(arr.tobytes())
+    print("wrote %d train / %d test real digit images to %s"
+          % (n_train, n_test, outdir))
+
+
+if __name__ == "__main__":
+    write_idx(sys.argv[1] if len(sys.argv) > 1 else "./data-digits")
