@@ -163,10 +163,21 @@ class MetricSet:
                 raise KeyError("Metric: unknown target %r" % field)
             metric.add_eval(pred, labels[field])
 
-    def print(self, evname: str) -> str:
+    def print(self, evname: str, reduce=None) -> str:
+        """Format the eval line. ``reduce`` (optional) is applied to the
+        (n_metrics, 2) array of [sum_metric, cnt_inst] accumulator pairs
+        before the division — pass a cross-process summing reducer
+        (parallel.distributed.host_psum) so every rank prints the GLOBAL
+        statistic instead of its own shard's (the reference printed
+        per-worker numbers, utils/metric.h:175-236)."""
+        pairs = np.asarray([[m.sum_metric, float(m.cnt_inst)]
+                            for m in self.metrics], np.float64)
+        if reduce is not None and len(pairs):
+            pairs = np.asarray(reduce(pairs), np.float64)
         out = []
-        for metric, field in zip(self.metrics, self.label_fields):
+        for (s, c), metric, field in zip(pairs, self.metrics,
+                                         self.label_fields):
             tag = metric.name if field == "label" else "%s[%s]" % (metric.name,
                                                                    field)
-            out.append("\t%s-%s:%g" % (evname, tag, metric.get()))
+            out.append("\t%s-%s:%g" % (evname, tag, s / max(c, 1.0)))
         return "".join(out)

@@ -508,23 +508,64 @@ class Net:
         grouped by their index into the global array: shards covering the
         same slice (replicas) must match bit-for-bit; ZeRO/tensor-parallel
         shards with distinct indices are legitimately different and are not
-        compared. Returns (max_abs_diff, (layer, tag) of the worst weight)."""
+        compared.
+
+        Multi-process runs additionally compare replicas held on OTHER
+        hosts (exactly the divergence test_on_server existed for): each
+        process contributes per-(weight, shard-slice) f64 checksums
+        (sum, sum of squares), all-gathered host-side; groups with the
+        same slice must agree across every process. The returned diff for
+        a cross-host mismatch is the |mean difference| proxy derived from
+        the checksums (raw remote shards are not addressable).
+
+        Returns (max_abs_diff, (layer, tag) of the worst weight)."""
+        from ..parallel.distributed import (host_allgather_rows,
+                                            is_multi_host, process_count)
+        import zlib
+        multi = is_multi_host()
         max_diff, worst = 0.0, None
-        for lname, tags in self.params.items():
-            for tag, w in tags.items():
+        keys = []          # (lname, tag) in deterministic order
+        sums: list = []    # rows [key_id, slice_id, sum, sumsq, count]
+        for lname, tags in sorted(self.params.items()):
+            for tag, w in sorted(tags.items()):
                 groups: Dict[str, list] = {}
                 for s in w.addressable_shards:
                     groups.setdefault(str(s.index), []).append(
                         np.asarray(s.data))
-                for arrs in groups.values():
-                    ref = arrs[0]
+                keys.append((lname, tag))
+                kid = len(keys) - 1
+                for idx, arrs in sorted(groups.items()):
                     for a in arrs[1:]:
-                        if ref.size == 0:
+                        if arrs[0].size == 0:
                             continue
                         d = float(np.max(np.abs(a.astype(np.float32)
-                                                - ref.astype(np.float32))))
+                                                - arrs[0].astype(np.float32))))
                         if d > max_diff:
                             max_diff, worst = d, (lname, tag)
+                    if multi:
+                        ref = arrs[0].astype(np.float64)
+                        sums.append([kid, float(zlib.crc32(idx.encode())),
+                                     float(ref.sum()),
+                                     float((ref * ref).sum()),
+                                     float(ref.size)])
+        if multi and sums:
+            rows = host_allgather_rows(np.asarray(sums, np.float64))
+            assert rows.shape[0] == len(sums) * process_count()
+            local = np.asarray(sums, np.float64)
+            for r in range(rows.shape[0]):
+                kid, sid = rows[r, 0], rows[r, 1]
+                match = (local[:, 0] == kid) & (local[:, 1] == sid)
+                if not match.any():
+                    continue       # slice not held locally (ZeRO layouts)
+                mine = local[match][0]
+                cnt = max(mine[4], 1.0)
+                # |mean diff| from the sums, plus the sum-of-squares
+                # channel so sum-preserving divergence (swaps, +eps/-eps
+                # drift) is caught too
+                d = max(abs(rows[r, 2] - mine[2]) / cnt,
+                        abs(rows[r, 3] - mine[3]) / cnt)
+                if d > max_diff:
+                    max_diff, worst = d, keys[int(kid)]
         return max_diff, worst
 
     # ----------------------------------------------------------- evaluate
@@ -532,9 +573,12 @@ class Net:
         """Run metrics over an iterator; excludes padded tails. Prints (and
         clears) accumulated train metrics first when eval_train is on, exactly
         like the reference (Evaluate, nnet_impl:224-245)."""
+        from ..parallel.distributed import host_psum
         ret = ""
         if self.eval_train:
-            ret += self.train_metrics.print("train")
+            # cross-process (sum, count) reduction: every rank prints the
+            # GLOBAL metric (the reference printed per-worker numbers)
+            ret += self.train_metrics.print("train", reduce=host_psum)
             self.train_metrics.clear()
         if data_iter is None:
             return ret
@@ -556,7 +600,7 @@ class Net:
                 out = local_rows(node_to_out[n])
                 preds.append(out.reshape(out.shape[0], -1)[:n_valid])
             self.eval_metrics.add_eval(preds, labels)
-        return ret + self.eval_metrics.print(name)
+        return ret + self.eval_metrics.print(name, reduce=host_psum)
 
     # ------------------------------------------------------------ predict
     def predict(self, batch) -> np.ndarray:

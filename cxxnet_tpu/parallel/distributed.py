@@ -96,5 +96,42 @@ def local_rows(arr) -> np.ndarray:
         [np.asarray(by_start[st].data) for st in sorted(by_start)], axis=0)
 
 
+def _allgather_f64(arr: np.ndarray) -> np.ndarray:
+    """process_allgather that preserves f64 exactly: JAX's x32 default
+    would silently downcast f64 payloads to f32 (which breaks both the
+    checksum ids and the metric sums), so the payload crosses the wire
+    bit-packed as uint32 pairs."""
+    from jax.experimental import multihost_utils
+    a = np.ascontiguousarray(arr, np.float64)
+    packed = a.view(np.uint32).reshape(a.shape[:-1] + (a.shape[-1] * 2,))
+    gathered = np.asarray(multihost_utils.process_allgather(packed),
+                          np.uint32)
+    return gathered.view(np.float64)
+
+
+def host_psum(values: np.ndarray) -> np.ndarray:
+    """Sum a small host-side array across all processes (identity when
+    single-process). The cross-process reduction the reference's
+    per-worker metric accounting lacked: with it every rank can print the
+    *global* eval line instead of its own shard's
+    (utils/metric.h:175-236 kept per-worker sums)."""
+    if not is_multi_host():
+        return np.asarray(values)
+    return _allgather_f64(np.atleast_2d(np.asarray(values, np.float64))) \
+        .reshape((process_count(),) + np.asarray(values).shape).sum(axis=0)
+
+
+def host_allgather_rows(rows: np.ndarray) -> np.ndarray:
+    """All-gather a small (n, k) f64 host array across processes ->
+    (n_processes * n, k), value-exact (see _allgather_f64).
+    Single-process: identity. Requires every process to contribute the
+    same shape (true for symmetric meshes)."""
+    if not is_multi_host():
+        return np.asarray(rows)
+    return _allgather_f64(np.asarray(rows, np.float64)) \
+        .reshape(-1, rows.shape[-1])
+
+
 __all__ = ["init_distributed", "process_index", "process_count",
-           "is_multi_host", "global_batch", "local_rows"]
+           "is_multi_host", "global_batch", "local_rows", "host_psum",
+           "host_allgather_rows"]

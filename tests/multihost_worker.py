@@ -31,7 +31,8 @@ def main() -> None:
 
     net = Net(tokenize(CONF))
     net.init_model()
-    for xb, yb in make_batches():
+    batches = list(make_batches())
+    for xb, yb in batches:
         lo, hi = rank * 8, (rank + 1) * 8
 
         class B:
@@ -41,6 +42,49 @@ def main() -> None:
         net.update(B)
     np.savez(os.path.join(outdir, "params_rank%d.npz" % rank),
              **flat_params(net))
+
+    # global eval line: each rank feeds only ITS half of the eval set
+    # (different local rows -> different per-rank statistics), yet both
+    # must print the identical cross-process-reduced metric
+    class EvalIter:
+        def before_first(self):
+            self._i = 0
+
+        def next(self):
+            if self._i >= len(batches):
+                return False
+            xb, yb = batches[self._i]
+            lo, hi = rank * 8, (rank + 1) * 8
+
+            class B:
+                data, label, extra_data = xb[lo:hi], yb[lo:hi], []
+                num_batch_padd = 0
+            self._value = B
+            self._i += 1
+            return True
+
+        def value(self):
+            return self._value
+
+    line = net.evaluate(EvalIter(), "test")
+    print("EVALLINE rank%d %s" % (rank, line.strip()))
+
+    # cross-host replica consistency: clean pass, then rank 1 desyncs one
+    # of its local weight shards and BOTH ranks must detect it
+    diff, _ = net.check_replica_consistency()
+    print("CONSISTENCY_CLEAN rank%d %.3g" % (rank, diff))
+    import jax
+    w = net.params["fc1"]["wmat"]
+    local = [np.asarray(s.data) for s in w.addressable_shards]
+    if rank == 1:
+        local = [a + 0.125 for a in local]
+    desync = jax.make_array_from_single_device_arrays(
+        w.shape, w.sharding,
+        [jax.device_put(a, s.device)
+         for a, s in zip(local, w.addressable_shards)])
+    net.params["fc1"]["wmat"] = desync
+    diff, worst = net.check_replica_consistency()
+    print("CONSISTENCY_DESYNC rank%d %.3g %s" % (rank, diff, worst))
     print("rank", rank, "done")
 
 

@@ -104,3 +104,25 @@ def test_two_process_data_parallel_matches_single(tmp_path):
         # ...equal (mod reduction order) to the single-process full batch
         np.testing.assert_allclose(got[0][name], ref[name],
                                    rtol=2e-5, atol=2e-6, err_msg=name)
+
+    # global eval metrics: each rank fed different local rows, but the
+    # cross-process (sum, count) reduction makes both print the SAME line
+    eval_lines = [next(l for l in o.splitlines()
+                       if l.startswith("EVALLINE rank%d" % r))
+                  .split(" ", 2)[2] for r, o in zip((0, 1), outs)]
+    assert eval_lines[0] == eval_lines[1], eval_lines
+    assert "test-error:" in eval_lines[0]
+
+    # cross-host replica check: clean pass reports ~0 on both ranks, and
+    # after rank 1 perturbs its local shard of fc1 by +0.125 BOTH ranks
+    # flag the divergence (the reference's test_on_server capability,
+    # async_updater-inl.hpp:144-154)
+    for r, o in zip((0, 1), outs):
+        clean = next(l for l in o.splitlines()
+                     if l.startswith("CONSISTENCY_CLEAN rank%d" % r))
+        assert float(clean.split()[2]) == 0.0, clean
+        desync = next(l for l in o.splitlines()
+                      if l.startswith("CONSISTENCY_DESYNC rank%d" % r))
+        val = float(desync.split()[2])
+        assert 0.1 < val < 0.15, desync      # |mean diff| proxy == 0.125
+        assert "fc1" in desync, desync
