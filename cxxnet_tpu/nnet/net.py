@@ -238,11 +238,12 @@ class Net:
         """Place params / optimizer state on the mesh. Weights follow each
         layer's declared tensor-parallel axes (replicated on a pure-DP mesh);
         optimizer state additionally shards over the data axis under
-        ``shard_optimizer = 1`` (ZeRO-1). XLA GSPMD derives the collectives
+        ``shard_optimizer`` levels 1/2/3 (ZeRO-1/2/3 — see
+        parallel/sharding.py). XLA GSPMD derives the collectives
         that mshadow-ps Push/PullReq performed by hand (SURVEY §5.8)."""
         param_sh, opt_sh = resolve_shardings(
             self.mesh, self.graph, self.layers, self.params,
-            zero=bool(self.shard_optimizer))
+            zero=int(self.shard_optimizer))
         self._param_shardings = param_sh
         self._opt_shardings = opt_sh
         self.params = jax.device_put(self.params, param_sh)
@@ -253,7 +254,10 @@ class Net:
             self.states = jax.device_put(self.states,
                                          replicated_sharding(self.mesh))
         if self.gsum is not None:
-            self.gsum = jax.device_put(self.gsum, param_sh)
+            # ZeRO-2+: the accumulation buffer lives sharded like the
+            # optimizer state (each rank accumulates only its slice)
+            self.gsum = jax.device_put(
+                self.gsum, opt_sh if self.shard_optimizer >= 2 else param_sh)
 
     # ------------------------------------------------------------ executor
     def _layer_params(self, params, idx: int):
@@ -309,12 +313,24 @@ class Net:
         return total, (metric_outs, ctx.new_states)
 
     # ------------------------------------------------------------- steps
+    def _constrain_grads(self, grads):
+        """ZeRO-2+: pin gradients to the optimizer-state sharding — GSPMD
+        then lowers the gradient all-reduce to a reduce-scatter and each
+        rank updates only its slice (the reference's update_on_server
+        bandwidth shape, async_updater-inl.hpp:200-205, without a
+        server)."""
+        if self.shard_optimizer < 2:
+            return grads
+        return jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                            self._opt_shardings)
+
     def _step_update(self, params, opt_state, states, data, extras, label,
                      mask, rng, epoch):
         """Fused grad + optimizer apply (update_period == 1 fast path)."""
         (loss, (mouts, new_states)), grads = jax.value_and_grad(
             self._loss_and_outputs, has_aux=True)(
                 params, states, data, extras, label, mask, rng, epoch)
+        grads = self._constrain_grads(grads)
         params, opt_state = self._apply_grads(params, opt_state, grads, epoch)
         return params, opt_state, new_states, loss, mouts
 
@@ -323,7 +339,7 @@ class Net:
         (loss, (mouts, new_states)), grads = jax.value_and_grad(
             self._loss_and_outputs, has_aux=True)(
                 params, states, data, extras, label, mask, rng, epoch)
-        gsum = jax.tree.map(jnp.add, gsum, grads)
+        gsum = jax.tree.map(jnp.add, gsum, self._constrain_grads(grads))
         return gsum, new_states, loss, mouts
 
     def _step_apply(self, params, opt_state, gsum, epoch):
@@ -630,12 +646,24 @@ class Net:
         return local_rows(outs[0])
 
     # ------------------------------------------------------- weight access
+    @staticmethod
+    def _fetch(arr) -> np.ndarray:
+        """Host copy of a (possibly multi-host-sharded) array. ZeRO-3
+        params span non-addressable devices in multi-process runs;
+        process_allgather is collective, which is safe here because
+        every rank runs save/get at the same points (the CLI's round
+        loop is SPMD)."""
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
     def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
         idx = self.graph.layer_index(layer_name)
         lkey = self.graph.layers[idx].key()
         if lkey not in self.params or tag not in self.params[lkey]:
             return np.zeros((0,), np.float32)
-        return np.asarray(self.params[lkey][tag])
+        return self._fetch(self.params[lkey][tag])
 
     def set_weight(self, layer_name: str, tag: str, value: np.ndarray) -> None:
         idx = self.graph.layer_index(layer_name)
@@ -649,8 +677,8 @@ class Net:
     def save_model(self, path: str) -> None:
         """Binary checkpoint: structure + epoch + weights (+ layer states).
         Optimizer state is NOT saved, as in the reference (nnet_impl:82-99)."""
-        params_np = jax.tree.map(np.asarray, self.params)
-        states_np = jax.tree.map(np.asarray, self.states)
+        params_np = jax.tree.map(self._fetch, self.params)
+        states_np = jax.tree.map(self._fetch, self.states)
         tensors: List[Tuple[str, np.ndarray]] = []
         for lkey in sorted(params_np):
             for tag in sorted(params_np[lkey]):

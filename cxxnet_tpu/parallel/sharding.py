@@ -50,26 +50,42 @@ def param_sharding(mesh: Mesh, axes: AxesSpec,
     return NamedSharding(mesh, P(*_fit_spec(axes, shape, mesh)))
 
 
+def _data_shard_spec(spec: list, shape: Sequence[int], mesh: Mesh) -> list:
+    """Additionally shard the first free (unsharded, divisible) dim over
+    the ``data`` axis."""
+    nd = mesh.shape.get(DATA_AXIS, 1)
+    if nd > 1:
+        for d, cur in enumerate(spec):
+            if cur is None and shape[d] % nd == 0:
+                spec[d] = DATA_AXIS
+                break
+    return spec
+
+
 def opt_state_sharding(mesh: Mesh, axes: AxesSpec, shape: Sequence[int],
-                       zero: bool) -> NamedSharding:
-    """Sharding for optimizer-state tensors mirroring ``w``. With ``zero``,
-    additionally shard the first free (unsharded, divisible) dim over the
-    ``data`` axis — ZeRO-1: each DP rank owns a slice of momentum/variance."""
+                       zero: int) -> NamedSharding:
+    """Sharding for optimizer-state tensors mirroring ``w``. With ``zero``
+    >= 1, additionally shard over the ``data`` axis — each DP rank owns a
+    slice of momentum/variance (ZeRO-1; levels 2/3 change the gradient
+    and parameter placement, not this one)."""
     spec = _fit_spec(axes, shape, mesh)
-    if zero:
-        nd = mesh.shape.get(DATA_AXIS, 1)
-        if nd > 1:
-            for d, cur in enumerate(spec):
-                if cur is None and shape[d] % nd == 0:
-                    spec[d] = DATA_AXIS
-                    break
+    if zero >= 1:
+        spec = _data_shard_spec(spec, shape, mesh)
     return NamedSharding(mesh, P(*spec))
 
 
 def resolve_shardings(mesh: Mesh, graph, layers,
                       params: Dict[str, Dict],
-                      zero: bool) -> Tuple[Dict, Dict]:
+                      zero: int) -> Tuple[Dict, Dict]:
     """Per-tensor shardings for the params / opt-state pytrees.
+
+    ``zero`` (the ``shard_optimizer`` config level):
+      0 — nothing sharded over ``data``;
+      1 — optimizer state sharded (ZeRO-1);
+      2 — + gradients reduce-scattered instead of all-reduced (ZeRO-2;
+          applied by the train step via a sharding constraint on grads);
+      3 — + parameters themselves sharded over ``data`` (ZeRO-3 / FSDP:
+          XLA all-gathers each weight at its use sites).
 
     Returns ``(param_sh, opt_sh)`` keyed ``[layer_key][tag]``. ``opt_sh`` is a
     per-weight sharding applied to every tensor of that weight's optimizer
@@ -87,7 +103,14 @@ def resolve_shardings(mesh: Mesh, graph, layers,
         opt_sh[lkey] = {}
         for tag, w in params[lkey].items():
             axes = layer.param_axes(tag)
-            param_sh[lkey][tag] = param_sharding(mesh, axes, w.shape)
+            if zero >= 3:
+                # ZeRO-3: params placed exactly like their optimizer
+                # state (one shard-selection code path, layouts cannot
+                # drift)
+                param_sh[lkey][tag] = opt_state_sharding(
+                    mesh, axes, w.shape, zero)
+            else:
+                param_sh[lkey][tag] = param_sharding(mesh, axes, w.shape)
             opt_sh[lkey][tag] = opt_state_sharding(mesh, axes, w.shape, zero)
     return param_sh, opt_sh
 

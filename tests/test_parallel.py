@@ -124,3 +124,16 @@ def test_replica_consistency_after_training():
         full = np.asarray(arr)
         for s in arr.addressable_shards:
             np.testing.assert_array_equal(np.asarray(s.data), full[s.index])
+
+
+@pytest.mark.parametrize("level", ["2", "3"])
+def test_zero23_matches_single_device(reference_run, level):
+    """ZeRO-2 (gradients reduce-scattered) and ZeRO-3 (params
+    data-sharded, FSDP-style) must train to the same weights as the
+    single-device run."""
+    net = _train([("dev", "cpu:0-7"), ("shard_optimizer", level)])
+    if level == "3":
+        # params really are sharded over the data axis
+        w = net.params["fc1"]["wmat"]
+        assert "data" in tuple(w.sharding.spec), w.sharding
+    assert_params_close(_params_np(net), reference_run)
