@@ -1,0 +1,45 @@
+"""Memory/bubble accounting for the gpipe schedule on the virtual mesh.
+
+Reproduces the pipeline table in doc/multi-device.md: per-config XLA
+temp (live activation) memory from compiled.memory_analysis(), the
+analytic GPipe bubble (P-1)/(M+P-1), and a CPU step wall time (schedule
+shape comparison only -- virtual devices share one host).
+
+Usage: JAX_PLATFORMS=cpu python tools/pp_accounting.py
+"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import time
+import numpy as np, jax, jax.numpy as jnp
+from cxxnet_tpu.models.gpt import (GPTConfig, gpt_init, gpt_opt_init,
+                                   gpt_place, make_train_step)
+from cxxnet_tpu.parallel.mesh import make_mesh
+
+def run(pp, mb, remat):
+    cfg = GPTConfig(vocab_size=256, seq_len=256, n_layer=8, n_head=8,
+                    feat=512, n_microbatch=mb, dtype="float32", remat=remat)
+    mesh = make_mesh(devices=jax.devices()[:pp], pipeline_parallel=pp)
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    opt = gpt_opt_init(params, mesh, "sgd")
+    step = make_train_step(cfg, mesh, eta=0.1)
+    ids = jnp.zeros((8, 256), jnp.int32)
+    lowered = jax.jit(lambda p, o, x: step(p, o, x)).lower(params, opt, ids)
+    comp = lowered.compile()
+    ma = comp.memory_analysis()
+    temp = ma.temp_size_in_bytes / 1e6
+    # warm + time a step (CPU wall time: schedule-shape comparison only)
+    p, o = params, opt
+    p, o, l = comp(p, o, ids); jax.block_until_ready(l)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        p, o, l = comp(p, o, ids)
+    jax.block_until_ready(l)
+    dt = (time.perf_counter() - t0) / 3
+    bubble = (pp - 1) / (mb + pp - 1)
+    print("pp%d mb%d remat=%d: temp %7.1f MB  bubble %4.0f%%  step %6.1f ms"
+          % (pp, mb, remat, temp, bubble * 100, dt * 1e3), flush=True)
+
+for pp, mb in ((1, 1), (2, 1), (2, 4), (2, 8), (4, 4), (4, 8)):
+    for remat in (False, True):
+        run(pp, mb, remat)
