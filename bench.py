@@ -10,6 +10,11 @@ is a device-to-host fetch of the final loss: on the tunneled backend,
 ``block_until_ready`` returns before execution drains, so only a host fetch
 truly synchronizes; its one-time RTT is amortized over BENCH_STEPS.
 
+The paired pipeline-fed mode (real imgbin chain + StepStats data-wait
+accounting) lives in tools/pipeline_bench.py — on this rig its step time
+measures the host->device tunnel, so the two modes are reported
+separately (doc/performance.md "Input pipeline").
+
 Baseline: the driver-assigned north star is cxxnet's 4xK40 ImageNet AlexNet
 throughput (BASELINE.md). The reference publishes no number; contemporary
 cxxnet-era measurements put AlexNet at roughly 200 images/sec on one K40, so

@@ -100,7 +100,6 @@ class ImageAugmenter:
         """float32 CHW (0..255) -> warped+cropped CHW at self.shape[1:]."""
         if not self.need_process():
             return chw
-        from PIL import Image
         c, h, w = chw.shape
         # random crop-of-random-size mode: crop a square of random side then
         # the affine/crop below resizes to the target
@@ -146,18 +145,16 @@ class ImageAugmenter:
         i10, i11 = -m10 / det, m00 / det
         it0 = -(i00 * t0 + i01 * t1)
         it1 = -(i10 * t0 + i11 * t1)
-        hwc = np.clip(chw, 0, 255).astype(np.uint8).transpose(1, 2, 0)
-        img = Image.fromarray(hwc[:, :, 0] if c == 1 else hwc,
-                              mode="L" if c == 1 else "RGB")
-        warped = img.transform(
-            (new_w, new_h), Image.AFFINE,
-            (i00, i01, it0, i10, i11, it1),
-            resample=Image.BICUBIC,
-            fillcolor=(self.fill_value if c == 1
-                       else (self.fill_value,) * 3))
-        arr = np.asarray(warped, np.float32)
-        if arr.ndim == 2:
-            arr = arr[:, :, None]
+        hwc = np.ascontiguousarray(
+            np.clip(chw, 0, 255).astype(np.uint8).transpose(1, 2, 0))
+        # native bicubic warp (decoder.affine_warp_hwc; PIL fallback) —
+        # keeps the whole host augmentation chain GIL-free C when the
+        # library is present (the reference ran this in OpenCV,
+        # image_augmenter-inl.hpp:95-121)
+        from .decoder import affine_warp_hwc
+        arr = affine_warp_hwc(hwc, (new_w, new_h),
+                              (i00, i01, it0, i10, i11, it1),
+                              int(self.fill_value)).astype(np.float32)
         out_y, out_x = self.shape[1], self.shape[2]
         yy = max(0, arr.shape[0] - out_y)
         xx = max(0, arr.shape[1] - out_x)

@@ -48,6 +48,17 @@ def _find_native() -> Optional[ctypes.CDLL]:
                     ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                     ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                     ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+                # present from round 2 on; older .so builds simply lack them
+                if hasattr(lib, "cxn_png_decode"):
+                    lib.cxn_png_decode.restype = ctypes.c_int
+                    lib.cxn_png_decode.argtypes = lib.cxn_jpeg_decode.argtypes
+                if hasattr(lib, "cxn_affine_warp_u8"):
+                    lib.cxn_affine_warp_u8.restype = ctypes.c_int
+                    lib.cxn_affine_warp_u8.argtypes = [
+                        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                        ctypes.c_int, ctypes.c_void_p, ctypes.c_int,
+                        ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_double), ctypes.c_int]
                 _LIB = lib
                 break
             except OSError:
@@ -88,12 +99,76 @@ def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
     return arr
 
 
+def decode_png_hwc(buf: bytes) -> np.ndarray:
+    """PNG bytes -> HWC uint8 (RGB or single-channel grayscale); native
+    libpng path with a PIL fallback. For 8-bit RGB/gray sources the two
+    agree exactly (PNG is lossless); exotic formats (16-bit, gray+alpha)
+    are normalized to 8-bit and may differ in channel handling between
+    the paths."""
+    lib = _find_native()
+    if lib is not None and hasattr(lib, "cxn_png_decode"):
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        c = ctypes.c_int()
+        rc = lib.cxn_png_decode(buf, len(buf), None, 0,
+                                ctypes.byref(w), ctypes.byref(h),
+                                ctypes.byref(c))
+        if rc == 0:
+            out = np.empty((h.value, w.value, c.value), np.uint8)
+            rc = lib.cxn_png_decode(
+                buf, len(buf), out.ctypes.data_as(ctypes.c_void_p),
+                out.nbytes, ctypes.byref(w), ctypes.byref(h),
+                ctypes.byref(c))
+            if rc == 0:
+                return out
+    from PIL import Image
+    import io as _io
+    img = Image.open(_io.BytesIO(buf))
+    if img.mode not in ("RGB", "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    return arr[:, :, None] if arr.ndim == 2 else arr
+
+
+def affine_warp_hwc(hwc: np.ndarray, size, inverse6, fill: int) -> np.ndarray:
+    """Inverse-map affine warp of an HWC uint8 image to ``size`` (w, h),
+    bicubic with a = -1.0 (PIL's *transform* kernel — its resize bicubic
+    is a = -0.5). Native path when the library is new enough; PIL
+    fallback (the two agree to <1 gray level mean even on noise — the
+    boundary fill blending differs slightly)."""
+    out_w, out_h = size
+    lib = _find_native()
+    if lib is not None and hasattr(lib, "cxn_affine_warp_u8") \
+            and hwc.flags["C_CONTIGUOUS"]:
+        h, w, c = hwc.shape
+        out = np.empty((out_h, out_w, c), np.uint8)
+        m = (ctypes.c_double * 6)(*inverse6)
+        rc = lib.cxn_affine_warp_u8(
+            hwc.ctypes.data_as(ctypes.c_void_p), h, w, c,
+            out.ctypes.data_as(ctypes.c_void_p), out_h, out_w, m, fill)
+        if rc == 0:
+            return out
+    from PIL import Image
+    c = hwc.shape[2]
+    img = Image.fromarray(hwc[:, :, 0] if c == 1 else hwc,
+                          mode="L" if c == 1 else "RGB")
+    warped = img.transform((out_w, out_h), Image.AFFINE, tuple(inverse6),
+                           resample=Image.BICUBIC,
+                           fillcolor=(fill if c == 1 else (fill,) * 3))
+    arr = np.asarray(warped, np.uint8)
+    return arr[:, :, None] if arr.ndim == 2 else arr
+
+
 def decode_image_chw(buf: bytes, gray_to_rgb: bool = True) -> np.ndarray:
-    """Image bytes (any PIL-supported format; native path for JPEG) ->
-    float32 CHW 0..255, grayscale replicated to 3 channels if requested."""
+    """Image bytes (any PIL-supported format; native paths for JPEG and
+    PNG) -> float32 CHW 0..255, grayscale replicated to 3 channels if
+    requested."""
     is_jpeg = len(buf) > 2 and buf[0] == 0xFF and buf[1] == 0xD8
+    is_png = len(buf) > 8 and buf[:8] == b"\x89PNG\r\n\x1a\n"
     if is_jpeg:
         hwc = decode_jpeg_hwc(buf)
+    elif is_png:
+        hwc = decode_png_hwc(buf)
     else:
         from PIL import Image
         import io as _io

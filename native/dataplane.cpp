@@ -126,3 +126,126 @@ int cxn_decode_chw(const unsigned char* src, long len, unsigned char* scratch,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// PNG decode (libpng simplified API) — the reference's `img` iterator
+// decodes any OpenCV-supported format (iter_img-inl.hpp:16-137); JPEG and
+// PNG cover the reference example datasets, everything else falls back to
+// the Python PIL path.
+// ---------------------------------------------------------------------------
+
+#if defined(__has_include)
+#  if __has_include(<png.h>)
+#    define CXN_HAVE_PNG 1
+#  endif
+#endif
+
+#ifdef CXN_HAVE_PNG
+#include <png.h>
+#endif
+
+extern "C" {
+
+#ifdef CXN_HAVE_PNG
+// Same two-call protocol as cxn_jpeg_decode: out == null queries dims.
+// Decodes to 8-bit RGB (or GRAY when the source is single-channel).
+int cxn_png_decode(const unsigned char* src, long len,
+                   unsigned char* out, long out_cap,
+                   int* w, int* h, int* c) {
+  png_image image;
+  memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&image, src,
+                                        static_cast<size_t>(len))) {
+    return -1;
+  }
+  const int gray = (image.format & PNG_FORMAT_FLAG_COLOR) == 0;
+  image.format = gray ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
+  *w = static_cast<int>(image.width);
+  *h = static_cast<int>(image.height);
+  *c = gray ? 1 : 3;
+  if (out == nullptr) {
+    png_image_free(&image);
+    return 0;
+  }
+  const long need = static_cast<long>(PNG_IMAGE_SIZE(image));
+  if (out_cap < need) {
+    png_image_free(&image);
+    return -2;
+  }
+  if (!png_image_finish_read(&image, nullptr, out, 0, nullptr)) {
+    png_image_free(&image);
+    return -3;
+  }
+  return 0;
+}
+#endif  // CXN_HAVE_PNG (absent: decoder.py's hasattr check falls to PIL)
+
+// ---------------------------------------------------------------------------
+// Affine warp (inverse map, bicubic a=-1.0 — PIL's transform kernel), HWC
+// uint8. The reference ran this warp through OpenCV on the host hot path
+// (image_augmenter-inl.hpp:95-121); this keeps the augmentation chain
+// native end to end (decode -> warp -> crop/mirror/float).
+//   dst(y, x) <- src(i10*x + i11*y + it1, i00*x + i01*y + it0)
+// matching PIL.Image.transform(AFFINE, (i00, i01, it0, i10, i11, it1)).
+// ---------------------------------------------------------------------------
+
+static inline double cubic_w(double t) {
+  // Keys cubic, a = -1.0 — what PIL's AFFINE transform uses (its
+  // *resize* bicubic is a=-0.5; Geometry.c's transform kernel is not)
+  const double a = -1.0;
+  t = t < 0 ? -t : t;
+  if (t <= 1.0) return ((a + 2.0) * t - (a + 3.0)) * t * t + 1.0;
+  if (t < 2.0) return (((t - 5.0) * t + 8.0) * t - 4.0) * a;
+  return 0.0;
+}
+
+int cxn_affine_warp_u8(const unsigned char* src, int src_h, int src_w,
+                       int ch, unsigned char* dst, int dst_h, int dst_w,
+                       const double* m /* i00 i01 it0 i10 i11 it1 */,
+                       int fill) {
+  if (ch <= 0 || ch > 4) return -1;
+  for (int y = 0; y < dst_h; ++y) {
+    for (int x = 0; x < dst_w; ++x) {
+      // PIL samples at pixel centers: (x+0.5, y+0.5), then -0.5 back
+      const double xs = m[0] * (x + 0.5) + m[1] * (y + 0.5) + m[2] - 0.5;
+      const double ys = m[3] * (x + 0.5) + m[4] * (y + 0.5) + m[5] - 0.5;
+      unsigned char* d = dst + (static_cast<long>(y) * dst_w + x) * ch;
+      if (xs < -1.0 || ys < -1.0 || xs >= src_w || ys >= src_h) {
+        for (int k = 0; k < ch; ++k) d[k] = static_cast<unsigned char>(fill);
+        continue;
+      }
+      const int x0 = static_cast<int>(xs >= 0 ? xs : xs - 1.0);  // floor
+      const int y0 = static_cast<int>(ys >= 0 ? ys : ys - 1.0);
+      double wx[4], wy[4];
+      for (int k = 0; k < 4; ++k) {
+        wx[k] = cubic_w(xs - (x0 - 1 + k));
+        wy[k] = cubic_w(ys - (y0 - 1 + k));
+      }
+      for (int k = 0; k < ch; ++k) {
+        double acc = 0.0, wsum = 0.0;
+        for (int j = 0; j < 4; ++j) {
+          const int yy = y0 - 1 + j;
+          for (int i = 0; i < 4; ++i) {
+            const int xx = x0 - 1 + i;
+            const double wgt = wx[i] * wy[j];
+            double v;
+            if (yy < 0 || yy >= src_h || xx < 0 || xx >= src_w) {
+              v = fill;                       // outside: fill color
+            } else {
+              v = src[(static_cast<long>(yy) * src_w + xx) * ch + k];
+            }
+            acc += wgt * v;
+            wsum += wgt;
+          }
+        }
+        acc /= (wsum != 0.0 ? wsum : 1.0);
+        acc = acc < 0.0 ? 0.0 : (acc > 255.0 ? 255.0 : acc);
+        d[k] = static_cast<unsigned char>(acc + 0.5);
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -608,3 +608,93 @@ def test_inmem_iterator_requires_batch_size(tmp_path):
         create_iterator([("iter", "cifar"),
                          ("path_data", str(tmp_path / "nb.bin")),
                          ("silent", "1")])
+
+
+def test_native_png_decode_matches_pil():
+    """PNG is lossless: the native libpng path and PIL must agree exactly
+    (rgb and grayscale)."""
+    from cxxnet_tpu.io import decoder
+    if not decoder.have_native():
+        pytest.skip("native library not built")
+    import io as _io
+    from PIL import Image
+    rs = np.random.RandomState(3)
+    for mode, shape in (("RGB", (21, 17, 3)), ("L", (14, 9, 1))):
+        arr = rs.randint(0, 256, size=shape, dtype=np.uint8)
+        img = Image.fromarray(arr[:, :, 0] if mode == "L" else arr, mode)
+        buf = _io.BytesIO()
+        img.save(buf, format="PNG")
+        got = decoder.decode_png_hwc(buf.getvalue())
+        np.testing.assert_array_equal(got, arr)
+        # and through the full decode_image_chw dispatch
+    chw = decoder.decode_image_chw(buf.getvalue())
+    assert chw.shape[0] == 3      # gray replicated
+
+
+def test_native_affine_warp_matches_pil():
+    """The native bicubic warp and PIL's BICUBIC AFFINE transform agree
+    to ~1 gray level in the interior (boundary fill blending differs)."""
+    from cxxnet_tpu.io import decoder
+    if not decoder.have_native():
+        pytest.skip("native library not built")
+    import ctypes
+    lib = decoder._find_native()
+    if not hasattr(lib, "cxn_affine_warp_u8"):
+        pytest.skip("old native build without the warp")
+    from PIL import Image
+    rs = np.random.RandomState(5)
+    hwc = rs.randint(0, 256, size=(32, 40, 3), dtype=np.uint8)
+    # mild rotation+shear inverse map
+    inv = (0.95, 0.1, 1.5, -0.08, 1.02, -0.7)
+    native = decoder.affine_warp_hwc(hwc, (36, 30), inv, 128)
+    img = Image.fromarray(hwc)
+    pil = np.asarray(img.transform((36, 30), Image.AFFINE, inv,
+                                   resample=Image.BICUBIC,
+                                   fillcolor=(128,) * 3), np.uint8)
+    interior = (slice(3, -3), slice(3, -3))
+    diff = np.abs(native[interior].astype(int) - pil[interior].astype(int))
+    # a=-1 kernel + center convention matches PIL to sub-level mean even
+    # on white noise (worst case for subpixel differences)
+    assert diff.mean() < 1.5 and np.percentile(diff, 99) <= 8.0, \
+        (diff.mean(), diff.max())
+
+
+def test_pipeline_prefetch_hides_decode(imgbin_dataset):
+    """The threadbuffer prefetcher must hide decode behind consumer work:
+    with a consumer that takes ~2x the decode time per batch, the
+    measured data-wait fraction stays small (VERDICT r1: pin data-wait
+    ~ 0 at a feedable rate)."""
+    import time as _time
+    from cxxnet_tpu.utils.profiler import StepStats
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,28,28"), ("rand_crop", "1"),
+        ("decode_threads", "2"),
+        ("iter", "threadbuffer"),
+        ("batch_size", "16"), ("round_batch", "1"), ("silent", "1"),
+    ])
+    # calibrate decode cost per batch (no consumer work)
+    it.before_first()
+    t0 = _time.perf_counter()
+    n = 0
+    while it.next():
+        n += 1
+    per_batch = (_time.perf_counter() - t0) / max(n, 1)
+    stats = StepStats(batch_size=16)
+    it.before_first()
+    while True:
+        with stats.phase("data"):
+            if not it.next():
+                break
+        with stats.phase("step"):
+            _time.sleep(per_batch * 2)     # consumer slower than decode
+        stats.end_step()
+    totals = stats.phase_totals()
+    data_s = totals["data"]
+    step_s = totals["step"]
+    assert data_s < 0.5 * step_s, \
+        "prefetch failed to hide decode: data %.3fs vs step %.3fs" \
+        % (data_s, step_s)

@@ -144,6 +144,16 @@ def test_zero23_matches_single_device(reference_run, level, update_period):
         # params really are sharded over the data axis
         w = net.params["fc1"]["wmat"]
         assert "data" in tuple(w.sharding.spec), w.sharding
-    ref = reference_run if update_period == "1" \
-        else _params_np(_train([("dev", "cpu:0")] + extra))
+    ref = reference_run if update_period == "1" else _reference_up2()
     assert_params_close(_params_np(net), ref)
+
+
+_UP2_REF = {}
+
+
+def _reference_up2():
+    """Single-device update_period=2 reference, computed once."""
+    if "ref" not in _UP2_REF:
+        _UP2_REF["ref"] = _params_np(
+            _train([("dev", "cpu:0"), ("update_period", "2")]))
+    return _UP2_REF["ref"]
