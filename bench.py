@@ -23,10 +23,18 @@ images/sec. vs_baseline = measured_images_per_sec / 800.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# 64 MB scoped VMEM for fusions (default 16 MB): measured +4% AlexNet
+# throughput on one v5e chip, repeatably (17.8 -> 18.5-18.6k img/s) —
+# the big LRN/pool fusions get more working set. Neutral on the GPT
+# flagship, so set here (the conv benchmark entry) rather than globally.
+os.environ.setdefault("LIBTPU_INIT_ARGS",
+                      "--xla_tpu_scoped_vmem_limit_kib=65536")
 
 BASELINE_IMAGES_PER_SEC = 800.0
 # 1024 = the reference's ImageNet batch 256 (ImageNet.conf) scaled to the

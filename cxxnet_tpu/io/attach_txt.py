@@ -31,7 +31,8 @@ class AttachTxtIterator(IIterator):
     def init(self) -> None:
         self.base.init()
         assert self.filename, "attachtxt: must set filename"
-        with open(self.filename) as f:
+        from .binpage import open_maybe_gz
+        with open_maybe_gz(self.filename, "r") as f:
             tokens = f.read().split()
         self.dim = int(tokens[0])
         rec = 1 + self.dim

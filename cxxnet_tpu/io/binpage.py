@@ -88,8 +88,18 @@ class BinaryPage:
         return cls(buf)
 
 
+def open_maybe_gz(path: str, mode: str = "rb"):
+    """Open a file, transparently gunzipping ``*.gz`` — the reference's
+    GzFile stream (io.h:152-180) generalized to every dataset input
+    (.lst, .bin, attachtxt), not just the mnist idx files."""
+    if path.endswith(".gz"):
+        import gzip
+        return gzip.open(path, mode if "b" in mode else mode + "t")
+    return open(path, mode)
+
+
 def iter_pages(path: str) -> Iterator[BinaryPage]:
-    with open(path, "rb") as f:
+    with open_maybe_gz(path, "rb") as f:
         while True:
             page = BinaryPage.load(f)
             if page is None:

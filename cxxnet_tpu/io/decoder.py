@@ -70,6 +70,17 @@ def have_native() -> bool:
     return _find_native() is not None
 
 
+def _pil_decode_hwc(buf: bytes) -> np.ndarray:
+    """Shared PIL fallback: bytes -> HWC uint8 (RGB, or 1-channel gray)."""
+    from PIL import Image
+    import io as _io
+    img = Image.open(_io.BytesIO(buf))
+    if img.mode not in ("RGB", "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    return arr[:, :, None] if arr.ndim == 2 else arr
+
+
 def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
     """JPEG bytes -> HWC uint8 (RGB or single-channel grayscale)."""
     lib = _find_native()
@@ -88,15 +99,7 @@ def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
             if rc == 0:
                 return out
         # fall through to PIL on any native failure
-    from PIL import Image
-    import io as _io
-    img = Image.open(_io.BytesIO(buf))
-    if img.mode not in ("RGB", "L"):
-        img = img.convert("RGB")
-    arr = np.asarray(img, np.uint8)
-    if arr.ndim == 2:
-        arr = arr[:, :, None]
-    return arr
+    return _pil_decode_hwc(buf)
 
 
 def decode_png_hwc(buf: bytes) -> np.ndarray:
@@ -121,13 +124,7 @@ def decode_png_hwc(buf: bytes) -> np.ndarray:
                 ctypes.byref(c))
             if rc == 0:
                 return out
-    from PIL import Image
-    import io as _io
-    img = Image.open(_io.BytesIO(buf))
-    if img.mode not in ("RGB", "L"):
-        img = img.convert("RGB")
-    arr = np.asarray(img, np.uint8)
-    return arr[:, :, None] if arr.ndim == 2 else arr
+    return _pil_decode_hwc(buf)
 
 
 def affine_warp_hwc(hwc: np.ndarray, size, inverse6, fill: int) -> np.ndarray:
@@ -170,14 +167,7 @@ def decode_image_chw(buf: bytes, gray_to_rgb: bool = True) -> np.ndarray:
     elif is_png:
         hwc = decode_png_hwc(buf)
     else:
-        from PIL import Image
-        import io as _io
-        img = Image.open(_io.BytesIO(buf))
-        if img.mode not in ("RGB", "L"):
-            img = img.convert("RGB")
-        hwc = np.asarray(img, np.uint8)
-        if hwc.ndim == 2:
-            hwc = hwc[:, :, None]
+        hwc = _pil_decode_hwc(buf)
     lib = _find_native()
     h, w, c = hwc.shape
     out_c = 3 if (c == 1 and gray_to_rgb) else c

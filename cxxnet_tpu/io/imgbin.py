@@ -26,7 +26,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .binpage import BinaryPage
+from .binpage import BinaryPage, open_maybe_gz
 from .data import (DataInst, IIterator, PrefetchProducerMixin,
                    register_base_iterator)
 from .decoder import decode_image_chw
@@ -66,7 +66,7 @@ def read_list_file(path: str, label_width: int):
     """.lst file -> (indices uint32, labels float32 (n, label_width),
     filenames)."""
     idx, labels, names = [], [], []
-    with open(path) as f:
+    with open_maybe_gz(path, "r") as f:
         for line in f:
             parts = parse_list_line(line)
             if parts is None:
@@ -198,7 +198,7 @@ class ImageBinIterator(PrefetchProducerMixin, IIterator):
             lst_idx, lst_label, _ = self.lists[si]
             bin_path = self.shards[si][1]
             pos = 0   # instance cursor within the shard (page objs follow .lst order)
-            with open(bin_path, "rb") as f:
+            with open_maybe_gz(bin_path, "rb") as f:
                 while not self._stop.is_set():
                     page = BinaryPage.load(f)
                     if page is None:

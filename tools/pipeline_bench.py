@@ -67,7 +67,9 @@ def pipeline_rate(root: str, batch: int, threads: int, n_batches: int) -> float:
     while done < n_batches and it.next():
         done += 1
     dt = time.perf_counter() - t0
-    return done * batch / dt
+    if hasattr(it, "close"):
+        it.close()                 # stop prefetch/decode threads before
+    return done * batch / dt       # the next timed measurement
 
 
 def train_with_pipeline(root: str, batch: int, threads: int,
@@ -101,8 +103,11 @@ def train_with_pipeline(root: str, batch: int, threads: int,
         done += 1
     jax.block_until_ready(net.params)
     dt = time.perf_counter() - t0
-    data_s = sum(stats._phases.get("data", []))
-    step_s = sum(stats._phases.get("step", []))
+    if hasattr(it, "close"):
+        it.close()
+    totals = stats.phase_totals()
+    data_s = totals.get("data", 0.0)
+    step_s = totals.get("step", 0.0)
     print("pipeline-fed train: %.0f img/s over %d steps "
           "(data-wait %.0f%%, dispatch %.0f%%)"
           % (done * batch / dt, done, 100 * data_s / dt, 100 * step_s / dt),
