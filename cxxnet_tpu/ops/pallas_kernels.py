@@ -429,10 +429,14 @@ def _flash_resident(n: int, d: int) -> bool:
 def _flash_block(n: int, req) -> int:
     """Resolve a block-size request: explicit sizes are clamped to n; the
     default (None) picks 512 when the sequence is a multiple of 512 —
-    measured ~35%
-    faster fwd+bwd than 256 on one v5e chip at seq 1024 and 4096 (doc/
-    performance.md) — else 256 (the alignment local_attention dispatches
-    on)."""
+    measured ~35% faster fwd+bwd than 256 on one v5e chip at seq 1024
+    and 4096 (doc/performance.md) — else 256 (the alignment
+    local_attention dispatches on). 1024-row blocks compile on the
+    current toolchain and win the ISOLATED kernel micro by 6-8%, but
+    measured SLOWER inside the full rematerialized GPT step (437 vs 422
+    ms @ 303M) — coarser blocks serialize against the surrounding
+    fusions — so 512 stays the default; pass block_q/block_k explicitly
+    to override."""
     if req is not None:
         return min(req, n)
     return 512 if n >= 512 and n % 512 == 0 else min(256, n)
