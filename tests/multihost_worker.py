@@ -29,17 +29,21 @@ def main() -> None:
     from cxxnet_tpu.utils.config import tokenize
     from tests.test_multihost import CONF, make_batches, flat_params
 
-    net = Net(tokenize(CONF))
-    net.init_model()
-    batches = list(make_batches())
-    for xb, yb in batches:
+    def rank_shard(xb, yb):
+        """This rank's half of the global batch (the per-process feed
+        contract, iter_thread_imbin_x-inl.hpp:119-130)."""
         lo, hi = rank * 8, (rank + 1) * 8
 
         class B:
             data, label, extra_data = xb[lo:hi], yb[lo:hi], []
             num_batch_padd = 0
+        return B
 
-        net.update(B)
+    net = Net(tokenize(CONF))
+    net.init_model()
+    batches = list(make_batches())
+    for xb, yb in batches:
+        net.update(rank_shard(xb, yb))
     np.savez(os.path.join(outdir, "params_rank%d.npz" % rank),
              **flat_params(net))
 
@@ -54,12 +58,7 @@ def main() -> None:
             if self._i >= len(batches):
                 return False
             xb, yb = batches[self._i]
-            lo, hi = rank * 8, (rank + 1) * 8
-
-            class B:
-                data, label, extra_data = xb[lo:hi], yb[lo:hi], []
-                num_batch_padd = 0
-            self._value = B
+            self._value = rank_shard(xb, yb)
             self._i += 1
             return True
 
@@ -85,6 +84,20 @@ def main() -> None:
     net.params["fc1"]["wmat"] = desync
     diff, worst = net.check_replica_consistency()
     print("CONSISTENCY_DESYNC rank%d %.3g %s" % (rank, diff, worst))
+
+    # ZeRO-3 across processes: params shard over the 4-device data axis
+    # spanning BOTH hosts; one train step must run, and save_model must
+    # gather the non-addressable shards (Net._fetch process_allgather)
+    # into a full checkpoint identical on both ranks
+    net3 = Net(tokenize(CONF))
+    net3.set_param("shard_optimizer", "3")
+    net3.init_model()
+    net3.update(rank_shard(*batches[0]))
+    w = net3.params["fc1"]["wmat"]
+    assert not w.is_fully_addressable, "ZeRO-3 should span hosts"
+    path3 = os.path.join(outdir, "zero3_rank%d.model" % rank)
+    net3.save_model(path3)
+    print("ZERO3_SAVED rank%d %d bytes" % (rank, os.path.getsize(path3)))
     print("rank", rank, "done")
 
 

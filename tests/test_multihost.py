@@ -126,3 +126,11 @@ def test_two_process_data_parallel_matches_single(tmp_path):
         val = float(desync.split()[2])
         assert 0.1 < val < 0.15, desync      # |mean diff| proxy == 0.125
         assert "fc1" in desync, desync
+        assert any(l.startswith("ZERO3_SAVED rank%d" % r)
+                   for l in o.splitlines()), o[-1500:]
+
+    # ZeRO-3 checkpoints gathered from cross-host shards must be
+    # byte-identical on both ranks (same global params, full gather)
+    b0 = (tmp_path / "zero3_rank0.model").read_bytes()
+    b1 = (tmp_path / "zero3_rank1.model").read_bytes()
+    assert b0 == b1 and len(b0) > 1000
