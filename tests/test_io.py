@@ -727,3 +727,32 @@ def test_gz_compressed_lst_and_bin(imgbin_dataset, tmp_path):
     b = it.value()
     assert b.data.shape == (16, 3, 24, 24)
     assert b.data.max() > 1.0          # real decoded pixels
+
+
+def test_imgbin_chain_with_affine_augmentation(imgbin_dataset, native_lib):
+    """The full kaggle_bowl-style chain — imgbin decode -> affine warp
+    (rotation+shear, native kernel) -> crop/mirror -> batch — produces
+    well-formed batches (the warp path changed to native C in r2; the
+    native_lib fixture guarantees the C kernel, not the PIL fallback,
+    is what runs)."""
+    d = imgbin_dataset
+    it = create_iterator([
+        ("iter", "imgbin"),
+        ("image_list", str(d / "train.lst")),
+        ("image_bin", str(d / "train.bin")),
+        ("input_shape", "3,24,24"),
+        ("rand_crop", "1"), ("rand_mirror", "1"),
+        ("max_rotate_angle", "30"), ("max_shear_ratio", "0.2"),
+        ("fill_value", "127"),
+        ("iter", "threadbuffer"),
+        ("batch_size", "16"), ("round_batch", "1"), ("silent", "1"),
+    ])
+    it.before_first()
+    n = 0
+    while it.next():
+        b = it.value()
+        assert b.data.shape == (16, 3, 24, 24)
+        assert np.isfinite(b.data).all()
+        assert b.data.max() > 1.0 and b.data.min() >= 0.0
+        n += 1
+    assert n == 4                      # 64 images / 16
