@@ -104,6 +104,10 @@ def _attn_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     would hand rank 0 all of Q and half of K instead)."""
     b, n, _ = h.shape
     x = _layernorm(h, p["ln1_g"], p["ln1_b"])
+    # separate Q/K/V matmuls: a trace-time concat into one fused (F, 3F)
+    # product measured 7% SLOWER end-to-end (451 vs 422 ms @ 303M) — the
+    # per-layer weight concat re-runs inside the scan (and again in the
+    # remat recompute), costing more than the larger matmul saves
     q = x @ p["w_q"].astype(x.dtype) + p["b_q"].astype(x.dtype)
     k = x @ p["w_k"].astype(x.dtype) + p["b_k"].astype(x.dtype)
     v = x @ p["w_v"].astype(x.dtype) + p["b_v"].astype(x.dtype)
