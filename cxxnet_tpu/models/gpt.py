@@ -60,6 +60,18 @@ class GPTConfig:
     #                             saved output is pure extra HBM traffic.
     #                             Kept for the measurement; prefer
     #                             remat_mode="attn_saved".
+    attn_layout: str = "auto"   # "bnhd": token-major activations with
+    #                             (b,n,h,d)<->(b,h,n,d) transposes at the
+    #                             flash-kernel boundary; "bhnd": project
+    #                             straight into the kernels' head-major
+    #                             layout (einsum bnf,fhd->bhnd) and consume
+    #                             head-major output, so XLA has no layout
+    #                             copy to insert. At head_dim 64 the
+    #                             per-head 64-wide projection matmuls make
+    #                             bhnd a net LOSS (448 vs 422 ms @ 303M,
+    #                             round 2); at head_dim 128 they are
+    #                             lane-native. "auto" picks by measurement:
+    #                             bhnd iff head_dim >= 128 (and not ring).
     remat_mode: str = "block"   # "block": whole-block remat (max memory
     #                             savings — the long-context mode) — the
     #                             DEFAULT, and measured fastest or tied at
@@ -118,6 +130,34 @@ def _attn_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     return h + o + p["b_proj"].astype(x.dtype), aux
 
 
+def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
+                    attn_bhnd, reduce):
+    """Head-major attention half: projections go straight into the flash
+    kernels' native (b, heads, n, head_dim) layout (einsum bnf,fhd->bhnd)
+    and the output projection consumes it (bhnd,hdf->bnf), so XLA never
+    materializes a (b,n,h,d)<->(b,h,n,d) transpose at the kernel boundary.
+    Only profitable when head_dim is lane-native (>= 128): the projection
+    becomes h batched (b*n, f) x (f, d) matmuls instead of one
+    (b*n, f) x (f, h*d) — at d=64 that narrowness costs more than the
+    copies it saves (measured round 2), at d=128 it wins (measured round
+    3, doc/performance.md)."""
+    b, n, f = h.shape
+    x = _layernorm(h, p["ln1_g"], p["ln1_b"])
+
+    def proj(w, bias):
+        w = w.astype(x.dtype).reshape(f, n_head, -1)       # (f, h, d)
+        bias = bias.astype(x.dtype).reshape(n_head, -1)    # (h, d)
+        return (jnp.einsum("bnf,fhd->bhnd", x, w)
+                + bias[None, :, None, :])
+
+    att = attn_bhnd(proj(p["w_q"], p["b_q"]), proj(p["w_k"], p["b_k"]),
+                    proj(p["w_v"], p["b_v"]))
+    wp = p["w_proj"].astype(x.dtype)                       # (h*d, f)
+    o = reduce(jnp.einsum("bhnd,hdf->bnf", att,
+                          wp.reshape(n_head, -1, f)))
+    return h + o + p["b_proj"].astype(x.dtype)
+
+
 def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce):
     """MLP half of the pre-LN block (LN2 -> up -> relu -> down ->
     residual)."""
@@ -151,19 +191,31 @@ def _train_attn(q, k, v, use_ring: bool):
     return checkpoint_name(att, "attn_out"), None
 
 
+def _train_attn_bhnd(q, k, v):
+    """Head-major training attention (single-shard sequences only: the
+    ring path owns the bnhd layout because K/V chunks rotate along dim 1)."""
+    return checkpoint_name(local_attention_bhnd(q, k, v, causal=True),
+                           "attn_out")
+
+
 def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
-           use_ring: bool) -> jnp.ndarray:
+           use_ring: bool, layout: str = "bnhd") -> jnp.ndarray:
     """Training block on local shards (b, n_local, F), inside gpipe's
     shard_map: explicit psum combines row-sharded partials (on a size-1
     model axis it is the identity, and demotes the vma type)."""
+    reduce = lambda t: lax.psum(t, MODEL_AXIS)
+    if layout == "bhnd":
+        h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
+        return _mlp_core(p, h, reduce)
     out, _ = _block_core(p, h, n_head_local,
                          lambda q, k, v: _train_attn(q, k, v, use_ring),
-                         lambda t: lax.psum(t, MODEL_AXIS))
+                         reduce)
     return out
 
 
 def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
-                     n_head_local: int, use_ring: bool) -> jnp.ndarray:
+                     n_head_local: int, use_ring: bool,
+                     layout: str = "bnhd") -> jnp.ndarray:
     """Training block with the remat boundary between the halves: the
     attention half runs un-rematted (the flash custom-vjp's residuals —
     q/k/v/out head-major + log-sum-exp — stay saved, so its backward does
@@ -186,9 +238,12 @@ def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
     boundary move buys nothing; kept as a config switch because the
     trade-off is scale-dependent."""
     reduce = lambda t: lax.psum(t, MODEL_AXIS)
-    h, _ = _attn_core(p, h, n_head_local,
-                      lambda q, k, v: _train_attn(q, k, v, use_ring),
-                      reduce)
+    if layout == "bhnd":
+        h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
+    else:
+        h, _ = _attn_core(p, h, n_head_local,
+                          lambda q, k, v: _train_attn(q, k, v, use_ring),
+                          reduce)
     return jax.checkpoint(lambda pp, hh: _mlp_core(pp, hh, reduce))(p, h)
 
 
@@ -273,8 +328,23 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     if cfg.remat_mode not in ("block", "attn_saved"):
         raise ValueError("remat_mode must be 'block' or 'attn_saved', got %r"
                          % (cfg.remat_mode,))
+    if cfg.attn_layout not in ("auto", "bnhd", "bhnd"):
+        raise ValueError("attn_layout must be 'auto', 'bnhd' or 'bhnd', "
+                         "got %r" % (cfg.attn_layout,))
+    use_ring = n_sp > 1
+    layout = cfg.attn_layout
+    if layout == "auto":
+        # measured rule (doc/performance.md round 3): head-major wins when
+        # the per-head projection width is lane-native (d >= 128); the ring
+        # path keeps bnhd (its K/V rotation is along the seq dim)
+        layout = ("bhnd" if cfg.feat // cfg.n_head >= 128 and not use_ring
+                  else "bnhd")
+    if layout == "bhnd" and use_ring:
+        raise ValueError("attn_layout='bhnd' is incompatible with sequence "
+                         "parallelism (ring attention owns the bnhd layout)")
     h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
-    kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=n_sp > 1)
+    kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=use_ring,
+              layout=layout)
     if cfg.remat and cfg.remat_mode == "attn_saved":
         # remat boundary between the block halves — see _block_mlp_remat
         block = functools.partial(_block_mlp_remat, **kw)

@@ -246,3 +246,89 @@ def test_ring_32k_sp4_compiles():
     lowered = jax.jit(lambda p, o, x: step(p, o, x)).lower(params, opt, ids)
     compiled = lowered.compile()
     assert compiled is not None
+
+
+def test_attn_layout_bhnd_matches_bnhd():
+    """The head-major projection path (attn_layout="bhnd",
+    _attn_core_bhnd) must be numerically identical to the token-major
+    path — same math, different layout."""
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:1])
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 61, (2, 16)).astype(np.int32))
+    base = dict(vocab_size=61, seq_len=16, n_layer=2, n_head=2, feat=32,
+                n_microbatch=1)
+    params = gpt_init(jax.random.PRNGKey(4), GPTConfig(**base))
+    out = {}
+    for layout in ("bnhd", "bhnd"):
+        cfg = GPTConfig(attn_layout=layout, **base)
+        out[layout] = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
+    np.testing.assert_allclose(out["bnhd"][0], out["bhnd"][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(out["bnhd"][1]),
+                    jax.tree.leaves(out["bhnd"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_attn_layout_bhnd_remat_matches():
+    """bhnd under both remat modes == bnhd without remat."""
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:1])
+    rs = np.random.RandomState(8)
+    ids = jnp.asarray(rs.randint(0, 61, (2, 16)).astype(np.int32))
+    base = dict(vocab_size=61, seq_len=16, n_layer=2, n_head=2, feat=32,
+                n_microbatch=1)
+    params = gpt_init(jax.random.PRNGKey(4), GPTConfig(**base))
+    ref = gpt_loss(params, ids, GPTConfig(attn_layout="bnhd", **base),
+                   mesh)
+    for mode in ("block", "attn_saved"):
+        cfg = GPTConfig(attn_layout="bhnd", remat=True, remat_mode=mode,
+                        **base)
+        got = gpt_loss(params, ids, cfg, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+def test_attn_layout_bhnd_tp_matches_single_device():
+    """Head-major projections with tensor-parallel head shards: the
+    per-shard (f, h_local, d) reshape must pick whole heads (the same
+    slicing the separate-projection design guarantees for bnhd)."""
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_place
+    base = dict(vocab_size=32, seq_len=16, n_layer=2, n_head=4, feat=32,
+                n_microbatch=2, attn_layout="bhnd")
+    cfg = GPTConfig(**base)
+
+    def run(mesh):
+        params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+        mom = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+        step = make_train_step(cfg, mesh)
+        losses = []
+        for i in range(3):
+            params, mom, loss = step(params, mom, _ids(i))
+            losses.append(float(loss))
+        return losses
+
+    ref = run(make_mesh("cpu:0"))
+    par = run(make_mesh("cpu:0-7", model_parallel=2, pipeline_parallel=2))
+    np.testing.assert_allclose(par, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_attn_layout_validated():
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:1])
+    cfg = GPTConfig(vocab_size=61, seq_len=16, n_layer=1, n_head=2,
+                    feat=32, attn_layout="bndh")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="attn_layout"):
+        gpt_loss(params, ids, cfg, mesh)
+    # explicit bhnd + sequence parallelism is a contradiction: the ring
+    # rotates K/V chunks along the sequence dim of (b, n, h, d) shards
+    cfg2 = GPTConfig(vocab_size=61, seq_len=16, n_layer=1, n_head=2,
+                     feat=32, attn_layout="bhnd")
+    mesh2 = make_mesh("cpu:0-7", seq_parallel=2)
+    with pytest.raises(ValueError, match="bhnd"):
+        gpt_loss(params, ids, cfg2, mesh2)

@@ -1,0 +1,124 @@
+"""GPT flagship step benchmark: steady-state step time, tok/s and MFU.
+
+The MFU accounting is strict "model FLOPs" (useful work only):
+
+- param FLOPs / token = 6 * N_params   (fwd 2N + bwd 4N; embedding matmuls
+  are inside N, gather cost ignored)
+- attention FLOPs / sequence / layer = 6 * n^2 * f * causal(0.5) = 3*n^2*f
+  (QK^T and PV are 2*n^2*f each full; causal halves; bwd is 2x fwd)
+- remat recompute is NOT credited: recomputed FLOPs are overhead, so a
+  rematerialized run must be faster in wall-clock to score the same MFU.
+
+Peak is the v5e bf16 MXU rate (197 TFLOP/s) unless --peak-tflops is given.
+
+Usage:
+  python tools/gpt_bench.py --layers 24 --heads 16 --feat 1024 \
+      --batch 16 --seq 1024 --bf16 --remat --adam --steps 20
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def count_params(tree):
+    import jax
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--feat", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--microbatch", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--adam", action="store_true")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--remat-mode", default="block",
+                    choices=["block", "attn_saved"])
+    ap.add_argument("--attn-layout", default="auto",
+                    choices=["auto", "bnhd", "bhnd"],
+                    help="kernel-boundary layout (auto: bhnd iff "
+                         "head_dim >= 128 and no sp)")
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="bf16 peak of one chip (v5e default)")
+    ap.add_argument("--trace-dir", default="",
+                    help="write an XPlane trace of 3 steps here")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_data_sharding,
+                                       gpt_init, gpt_opt_init, gpt_place,
+                                       make_train_step)
+    from cxxnet_tpu.parallel.mesh import make_mesh
+
+    cfg = GPTConfig(vocab_size=args.vocab, seq_len=args.seq,
+                    n_layer=args.layers, n_head=args.heads, feat=args.feat,
+                    n_microbatch=args.microbatch,
+                    dtype="bfloat16" if args.bf16 else "float32",
+                    remat=args.remat, remat_mode=args.remat_mode,
+                    attn_layout=args.attn_layout)
+    mesh = make_mesh(devices=jax.devices(), pipeline_parallel=args.pp,
+                     seq_parallel=args.sp, model_parallel=args.tp)
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    n_params = count_params(params)
+    opt = gpt_opt_init(params, mesh, "adam" if args.adam else "sgd")
+    step = make_train_step(cfg, mesh, eta=1e-4,
+                           optimizer="adam" if args.adam else "sgd")
+
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(
+        rng.randint(0, args.vocab, (args.batch, args.seq)).astype(np.int32),
+        gpt_data_sharding(mesh))
+
+    t0 = time.time()
+    for _ in range(args.warmup):
+        params, opt, loss = step(params, opt, ids)
+    float(loss)     # host fetch: the only true barrier on tunneled backends
+    print("warmup (incl. compile): %.1f s" % (time.time() - t0))
+
+    t0 = time.time()
+    for _ in range(args.steps):
+        params, opt, loss = step(params, opt, ids)
+    float(loss)     # single host fetch barriers the whole chained run
+    dt = (time.time() - t0) / args.steps
+
+    if args.trace_dir:
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(3):
+                params, opt, loss = step(params, opt, ids)
+            jax.block_until_ready(loss)
+
+    tokens = args.batch * args.seq
+    param_fl = 6.0 * n_params * tokens
+    # causal attention per layer per sequence: fwd = QK^T (2*n^2*f) +
+    # PV (2*n^2*f), halved by causality = 2*n^2*f; bwd = 2x fwd.
+    # total = 3 * fwd = 6 * n^2 * f
+    attn_fl = 6.0 * args.seq * args.seq * args.feat \
+        * args.layers * args.batch
+    peak = args.peak_tflops * 1e12
+    mfu_p = param_fl / dt / peak
+    mfu_t = (param_fl + attn_fl) / dt / peak
+    print("params: %.1fM  loss=%.4f" % (n_params / 1e6, float(loss)))
+    print("step: %.1f ms   tok/s: %.0f" % (dt * 1e3, tokens / dt))
+    print("MFU (param FLOPs): %.1f%%   MFU (param+attn, no remat credit): "
+          "%.1f%%" % (100 * mfu_p, 100 * mfu_t))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
