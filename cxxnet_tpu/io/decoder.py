@@ -105,9 +105,16 @@ def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
 def decode_png_hwc(buf: bytes) -> np.ndarray:
     """PNG bytes -> HWC uint8 (RGB or single-channel grayscale); native
     libpng path with a PIL fallback. For 8-bit RGB/gray sources the two
-    agree exactly (PNG is lossless); exotic formats (16-bit, gray+alpha)
-    are normalized to 8-bit and may differ in channel handling between
-    the paths."""
+    agree exactly (PNG is lossless). Exotic formats (16-bit depth,
+    gray+alpha) go straight to the PIL path in BOTH builds — the native
+    normalization differed from PIL's (alpha dropped vs LA->RGB), so the
+    same file could decode differently depending on whether the native
+    library was built; routing on the IHDR keeps builds consistent."""
+    # IHDR layout: 8-byte signature, 4-byte length, b"IHDR", width(4),
+    # height(4), bit depth (byte 24), color type (byte 25)
+    if len(buf) > 25 and buf[12:16] == b"IHDR" and (
+            buf[24] == 16 or buf[25] == 4):
+        return _pil_decode_hwc(buf)
     lib = _find_native()
     if lib is not None and hasattr(lib, "cxn_png_decode"):
         w = ctypes.c_int()

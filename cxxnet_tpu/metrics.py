@@ -170,14 +170,23 @@ class MetricSet:
         (parallel.distributed.host_psum) so every rank prints the GLOBAL
         statistic instead of its own shard's (the reference printed
         per-worker numbers, utils/metric.h:175-236)."""
-        pairs = np.asarray([[m.sum_metric, float(m.cnt_inst)]
-                            for m in self.metrics], np.float64)
-        if reduce is not None and len(pairs):
-            pairs = np.asarray(reduce(pairs), np.float64)
+        if reduce is not None:
+            # cross-process path: reduce the raw (sum, cnt) accumulators —
+            # this assumes every Metric has linear sum/cnt semantics
+            # (true of all reference metrics, utils/metric.h); a subclass
+            # overriding get() with a nonlinear finish (e.g. a true RMSE
+            # sqrt) is only honored on the local path below
+            pairs = np.asarray([[m.sum_metric, float(m.cnt_inst)]
+                                for m in self.metrics], np.float64)
+            if len(pairs):
+                pairs = np.asarray(reduce(pairs), np.float64)
+            values = [s / max(c, 1.0) for s, c in pairs]
+        else:
+            values = [m.get() for m in self.metrics]
         out = []
-        for (s, c), metric, field in zip(pairs, self.metrics,
-                                         self.label_fields):
+        for v, metric, field in zip(values, self.metrics,
+                                    self.label_fields):
             tag = metric.name if field == "label" else "%s[%s]" % (metric.name,
                                                                    field)
-            out.append("\t%s-%s:%g" % (evname, tag, s / max(c, 1.0)))
+            out.append("\t%s-%s:%g" % (evname, tag, v))
         return "".join(out)

@@ -563,7 +563,12 @@ class Net:
                         sums.append([kid, float(zlib.crc32(idx.encode())),
                                      float(ref.sum()),
                                      float((ref * ref).sum()),
-                                     float(ref.size)])
+                                     float(ref.size),
+                                     # order-sensitive channel: sum/sumsq
+                                     # are permutation-invariant, so a
+                                     # cross-host element swap would pass
+                                     # them; the byte CRC is exact
+                                     float(zlib.crc32(ref.tobytes()))])
         if multi and sums:
             rows = host_allgather_rows(np.asarray(sums, np.float64))
             assert rows.shape[0] == len(sums) * process_count()
@@ -576,10 +581,14 @@ class Net:
                 mine = local[match][0]
                 cnt = max(mine[4], 1.0)
                 # |mean diff| from the sums, plus the sum-of-squares
-                # channel so sum-preserving divergence (swaps, +eps/-eps
-                # drift) is caught too
+                # channel (catches +eps/-eps drift); both are
+                # permutation-invariant, so the byte-CRC channel flags
+                # order divergence (swaps) that preserves them — with no
+                # magnitude to report, it contributes a tiny positive d
                 d = max(abs(rows[r, 2] - mine[2]) / cnt,
                         abs(rows[r, 3] - mine[3]) / cnt)
+                if rows[r, 5] != mine[5]:
+                    d = max(d, np.finfo(np.float64).eps)
                 if d > max_diff:
                     max_diff, worst = d, keys[int(kid)]
         return max_diff, worst

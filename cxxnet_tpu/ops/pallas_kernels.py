@@ -1279,6 +1279,13 @@ def _ln_rows(shape):
 
 def _ln_tile(rows: int, f: int) -> int:
     """Row tile: ~8 live (tile, F) f32 buffers within ~4 MB."""
+    if rows % 8:
+        # fail loudly (mirrors _check_flash_divisible): without this the
+        # search below would underflow tile to 0 and die with a confusing
+        # ZeroDivisionError
+        raise ValueError(
+            "layernorm_fused: flattened row count %d must be a multiple "
+            "of 8; gate callers with layernorm_fused_supported" % rows)
     tile = max(8, (4 * 1024 * 1024 // (8 * 4 * f)) // 8 * 8)
     while rows % tile:
         tile -= 8

@@ -85,6 +85,21 @@ def main() -> None:
     diff, worst = net.check_replica_consistency()
     print("CONSISTENCY_DESYNC rank%d %.3g %s" % (rank, diff, worst))
 
+    # permutation divergence: starting from the CLEAN weights again,
+    # rank 1 reverses its rows — sum and sum-of-squares are preserved
+    # exactly, so only the order-sensitive CRC channel can catch it
+    # (reported as a tiny positive diff)
+    local = [np.asarray(s.data) for s in w.addressable_shards]
+    if rank == 1:
+        local = [a[::-1].copy() for a in local]
+    perm = jax.make_array_from_single_device_arrays(
+        w.shape, w.sharding,
+        [jax.device_put(a, s.device)
+         for a, s in zip(local, w.addressable_shards)])
+    net.params["fc1"]["wmat"] = perm
+    diff, worst = net.check_replica_consistency()
+    print("CONSISTENCY_PERM rank%d %.3g %s" % (rank, diff, worst))
+
     # ZeRO-3 across processes: params shard over the 4-device data axis
     # spanning BOTH hosts; one train step must run, and save_model must
     # gather the non-addressable shards (Net._fetch process_allgather)

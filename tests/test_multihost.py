@@ -126,6 +126,13 @@ def test_two_process_data_parallel_matches_single(tmp_path):
         val = float(desync.split()[2])
         assert 0.1 < val < 0.15, desync      # |mean diff| proxy == 0.125
         assert "fc1" in desync, desync
+        # row-reversal on rank 1 preserves sum and sumsq exactly; only the
+        # order-sensitive CRC channel flags it (tiny positive diff)
+        permline = next(l for l in o.splitlines()
+                        if l.startswith("CONSISTENCY_PERM rank%d" % r))
+        pval = float(permline.split()[2])
+        assert 0.0 < pval < 1e-9, permline
+        assert "fc1" in permline, permline
         assert any(l.startswith("ZERO3_SAVED rank%d" % r)
                    for l in o.splitlines()), o[-1500:]
 
