@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import local_attention, ring_attention
-from ..parallel.mesh import MODEL_AXIS, SEQ_AXIS
+from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
 from ..utils.config import ConfigError
 from .base import ApplyContext, Layer, Params, Shape3, register_layer
 
@@ -124,9 +124,19 @@ class MoELayer(Layer):
     """Switch-MoE position-wise FFN on (b, N, 1, F) nodes (ops/moe.py).
 
     Config: ``nexpert``, ``nhidden`` (per-expert hidden width),
-    ``capacity_factor``, ``moe_aux_weight`` (load-balance loss weight).
+    ``capacity_factor``, ``moe_aux_weight`` (load-balance loss weight),
+    ``moe_dispatch`` (sort | dense, the single-logical-shard strategy —
+    doc/performance.md measures the crossover).
     Weights: "gate" (F, E), "w_up" (E, F, H), "w_down" (E, H, F) — the
-    expert dim is sharded over the ``model`` mesh axis (expert parallelism).
+    expert dim is sharded over the dedicated ``expert`` mesh axis
+    (``expert_parallel = k``) when present, else over ``model``.
+
+    With ``expert_parallel > 1`` the layer runs the explicit all-to-all
+    dispatch (ops/moe.py:switch_moe_alltoall) inside a shard_map over the
+    expert axis: tokens shard over (data, expert), capacity applies per
+    (source shard, expert) group — GShard's grouped dispatch. Otherwise
+    the GSPMD path partitions the einsum/scatter formulation from the
+    weight shardings alone.
     """
     type_name = "moe"
 
@@ -134,6 +144,7 @@ class MoELayer(Layer):
         self.nexpert = 0
         self.capacity_factor = 1.25
         self.aux_weight = 0.01
+        self.moe_dispatch = "auto"
         super().__init__(spec, cfg)
 
     def set_param(self, name, val):
@@ -143,6 +154,11 @@ class MoELayer(Layer):
             self.capacity_factor = float(val)
         elif name == "moe_aux_weight":
             self.aux_weight = float(val)
+        elif name == "moe_dispatch":
+            if val not in ("auto", "sort", "dense"):
+                raise ConfigError("moe_dispatch must be auto|sort|dense, "
+                                  "got %r" % val)
+            self.moe_dispatch = val
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         c, y, x = self.check_one_to_one(in_shapes)
@@ -164,16 +180,63 @@ class MoELayer(Layer):
         }
 
     def param_axes(self, tag):
-        return {"w_up": (MODEL_AXIS, None, None),
-                "w_down": (MODEL_AXIS, None, None)}.get(tag)
+        # prefer a dedicated expert axis; degrade to the model axis on
+        # meshes without one (resolver picks the first present+dividing)
+        return {"w_up": ((EXPERT_AXIS, MODEL_AXIS), None, None),
+                "w_down": ((EXPERT_AXIS, MODEL_AXIS), None, None)}.get(tag)
 
     def apply(self, params, inputs, ctx: ApplyContext):
-        from ..ops.moe import switch_moe
+        from ..ops.moe import switch_moe, switch_moe_alltoall
         x = inputs[0]
         b, n, _, f = x.shape
-        out, aux = switch_moe(x.reshape(b * n, f), params["gate"],
-                              params["w_up"], params["w_down"],
-                              self.capacity_factor)
+        mesh = ctx.mesh
+        ep = mesh.shape.get(EXPERT_AXIS, 1) if mesh is not None else 1
+        nd = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        if ep > 1 and (b * n) % (ep * nd) == 0 and self.nexpert % ep == 0:
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            def body(xs, g, wu, wd):
+                o, a = switch_moe_alltoall(
+                    xs, g, wu, wd, axis_name=EXPERT_AXIS,
+                    capacity_factor=self.capacity_factor)
+                # aux is psum-averaged over expert inside; averaging over
+                # data too makes it a genuinely replicated scalar (the
+                # P() out_spec below relies on that, check_vma is off)
+                return o, lax.psum(a, DATA_AXIS) / nd
+
+            tok = P((DATA_AXIS, EXPERT_AXIS), None)
+            # check_vma off: the varying-axes checker rejects the psum
+            # composition across two axes here (JAX 0.9), but the specs
+            # are replication-correct by construction
+            out, aux = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(tok, P(None, None), P(EXPERT_AXIS, None, None),
+                          P(EXPERT_AXIS, None, None)),
+                out_specs=(tok, P()), check_vma=False)(
+                    x.reshape(b * n, f), params["gate"], params["w_up"],
+                    params["w_down"])
+        else:
+            dispatch = self.moe_dispatch
+            if dispatch == "auto":
+                # measured (doc/performance.md round 3): sort-based sparse
+                # dispatch beats the dense one-hot einsums 2.4-3x at every
+                # E on one chip. Dense remains the choice when the expert
+                # weights are actually GSPMD-sharded on their expert dim
+                # (einsums partition into clean all-to-alls where
+                # scatter/gather would force gathers) — decided with the
+                # same resolver rule that placed the weights, so the two
+                # cannot diverge.
+                expert_sharded = False
+                if mesh is not None:
+                    from ..parallel.sharding import _fit_spec
+                    spec = _fit_spec(self.param_axes("w_up"),
+                                     params["w_up"].shape, mesh)
+                    expert_sharded = spec[0] is not None
+                dispatch = "dense" if expert_sharded else "sort"
+            out, aux = switch_moe(x.reshape(b * n, f), params["gate"],
+                                  params["w_up"], params["w_down"],
+                                  self.capacity_factor, dispatch=dispatch)
         if ctx.train and self.aux_weight > 0:
             # divide by update_period so gradient accumulation keeps the
             # aux:data loss ratio fixed (the CE loss carries the same factor,

@@ -281,17 +281,62 @@ def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
     }
 
 
-def gpt_param_shardings(mesh: Mesh) -> Dict:
+def _with_data_axis(spec: P, shape, mesh: Mesh) -> P:
+    """ZeRO placement: additionally shard the first free (unsharded,
+    divisible) dim over ``data``. XLA all-gathers the tensor at its use
+    sites and reduce-scatters its gradient — FSDP semantics from a
+    sharding annotation alone. Delegates to the Net path's rule
+    (parallel/sharding.py:_data_shard_spec) so the two ZeRO placements
+    cannot drift; idempotent (a spec that already carries ``data`` is
+    returned unchanged)."""
+    from ..parallel.sharding import _data_shard_spec
+    out = list(tuple(spec)) + [None] * (len(shape) - len(tuple(spec)))
+    if DATA_AXIS in out:
+        return P(*out)
+    return P(*_data_shard_spec(out, shape, mesh))
+
+
+def gpt_param_shardings(mesh: Mesh, params: Optional[Dict] = None,
+                        zero: int = 0) -> Dict:
     """Placement: blocks pipe-sharded on dim0 + tp-sharded on the megatron
     dims (derived from the same spec table gpipe uses, so placement and
     shard_map in_specs cannot diverge); embeddings/head replicated (small at
-    these scales)."""
+    these scales).
+
+    ``zero >= 3`` additionally shards every parameter over the ``data``
+    axis (ZeRO-3/FSDP); requires ``params`` (or example shapes) to check
+    divisibility. GSPMD gathers each weight at its use sites — for the
+    pipelined blocks that is the resharding into gpipe's shard_map
+    in_specs."""
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
     blocks = {k: NamedSharding(mesh, s)
               for k, s in _block_param_specs().items()}
-    return {"emb": ns(), "pos": ns(), "lnf_g": ns(), "lnf_b": ns(),
-            "head": ns(), "blocks": blocks}
+    sh = {"emb": ns(), "pos": ns(), "lnf_g": ns(), "lnf_b": ns(),
+          "head": ns(), "blocks": blocks}
+    if zero >= 3:
+        if params is None:
+            raise ValueError("zero>=3 needs the params tree for shapes")
+        sh = jax.tree.map(
+            lambda s, p: NamedSharding(mesh, _with_data_axis(s.spec,
+                                                             p.shape, mesh)),
+            sh, params,
+            is_leaf=lambda t: isinstance(t, NamedSharding))
+    return sh
+
+
+def gpt_opt_shardings(params: Dict, mesh: Mesh, zero: int = 0) -> Dict:
+    """Shardings for the momentum/variance trees: the param placements,
+    plus a ``data``-axis dim when ``zero >= 1`` (ZeRO-1: each DP rank owns
+    a slice of the optimizer state)."""
+    sh = gpt_param_shardings(mesh, params, zero if zero >= 3 else 0)
+    if zero >= 1:
+        sh = jax.tree.map(
+            lambda s, p: NamedSharding(mesh, _with_data_axis(s.spec,
+                                                             p.shape, mesh)),
+            sh, params,
+            is_leaf=lambda t: isinstance(t, NamedSharding))
+    return sh
 
 
 def _block_param_specs() -> Dict:
@@ -371,11 +416,14 @@ def gpt_loss(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     return nll.mean()
 
 
-def gpt_opt_init(params: Dict, mesh: Mesh, optimizer: str = "sgd") -> Dict:
+def gpt_opt_init(params: Dict, mesh: Mesh, optimizer: str = "sgd",
+                 zero: int = 0) -> Dict:
     """Optimizer state placed like the params: sgd -> momentum tree;
     adam -> {m, v, t} (same math as updaters.AdamUpdater, one-minus
-    decay convention not used here — betas are the usual 0.9/0.999)."""
-    zeros = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+    decay convention not used here — betas are the usual 0.9/0.999).
+    ``zero >= 1`` shards the state over the ``data`` axis (ZeRO)."""
+    opt_sh = gpt_opt_shardings(params, mesh, zero)
+    zeros = jax.device_put(jax.tree.map(jnp.zeros_like, params), opt_sh)
     if optimizer == "sgd":
         return zeros
     if optimizer == "adam":
@@ -386,23 +434,38 @@ def gpt_opt_init(params: Dict, mesh: Mesh, optimizer: str = "sgd") -> Dict:
         t = jax.device_put(jnp.zeros((), jnp.int32),
                            NamedSharding(mesh, PartitionSpec()))
         return {"m": zeros,
-                "v": gpt_place(jax.tree.map(jnp.zeros_like, params), mesh),
+                "v": jax.device_put(jax.tree.map(jnp.zeros_like, params),
+                                    opt_sh),
                 "t": t}
     raise ValueError("unknown optimizer %r" % optimizer)
 
 
 def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
                     momentum: float = 0.9, optimizer: str = "sgd",
-                    beta2: float = 0.999, eps: float = 1e-8):
+                    beta2: float = 0.999, eps: float = 1e-8,
+                    zero: int = 0):
     """Jitted train step; donates params/opt state. ``optimizer``: "sgd"
     (momentum; opt state = momentum tree, the original signature) or
-    "adam" (opt state from gpt_opt_init(..., "adam"))."""
+    "adam" (opt state from gpt_opt_init(..., "adam")). ``zero``: ZeRO
+    level — 1 shards optimizer state over ``data``, 3 also shards the
+    params (pass the same level to gpt_place/gpt_opt_init)."""
     if optimizer not in ("sgd", "adam"):
         raise ValueError("unknown optimizer %r" % optimizer)
-    shardings = gpt_param_shardings(mesh)
+    if zero:
+        shapes = jax.eval_shape(lambda k: gpt_init(k, cfg),
+                                jax.random.PRNGKey(0))
+        shardings = gpt_param_shardings(mesh, shapes,
+                                        zero if zero >= 3 else 0)
+        opt_shardings = gpt_opt_shardings(shapes, mesh, zero)
+    else:
+        shardings = gpt_param_shardings(mesh)
+        opt_shardings = shardings
 
     def constrain(tree):
         return jax.lax.with_sharding_constraint(tree, shardings)
+
+    def constrain_opt(tree):
+        return jax.lax.with_sharding_constraint(tree, opt_shardings)
 
     def step(params, opt, ids):
         loss, grads = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
@@ -410,7 +473,7 @@ def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
             new_opt = jax.tree.map(lambda m, g: momentum * m - eta * g,
                                    opt, grads)
             new_params = jax.tree.map(jnp.add, params, new_opt)
-            new_opt = constrain(new_opt)
+            new_opt = constrain_opt(new_opt)
         else:
             t = opt["t"] + 1
             m = jax.tree.map(lambda m, g: momentum * m + (1 - momentum) * g,
@@ -423,7 +486,7 @@ def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
             new_params = jax.tree.map(
                 lambda p, mm, vv: p - a * mm / (jnp.sqrt(vv) + eps),
                 params, m, v)
-            new_opt = {"m": constrain(m), "v": constrain(v), "t": t}
+            new_opt = {"m": constrain_opt(m), "v": constrain_opt(v), "t": t}
         # keep placements stable step-over-step
         new_params = constrain(new_params)
         return new_params, new_opt, loss
@@ -431,8 +494,9 @@ def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def gpt_place(params: Dict, mesh: Mesh) -> Dict:
-    return jax.device_put(params, gpt_param_shardings(mesh))
+def gpt_place(params: Dict, mesh: Mesh, zero: int = 0) -> Dict:
+    return jax.device_put(params, gpt_param_shardings(
+        mesh, params if zero >= 3 else None, zero))
 
 
 # ---------------------------------------------------------------------------
@@ -571,4 +635,4 @@ def gpt_data_sharding(mesh: Mesh) -> NamedSharding:
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss", "gpt_decode",
            "gpt_opt_init", "make_train_step", "gpt_place",
-           "gpt_param_shardings"]
+           "gpt_param_shardings", "gpt_opt_shardings"]

@@ -73,6 +73,7 @@ class Net:
         self.dev = ""
         self.model_parallel = 1
         self.seq_parallel = 1
+        self.expert_parallel = 1
         self.shard_optimizer = 0
         self.dist_feed = "replicated"
         self.clip_norm = 0.0
@@ -94,6 +95,8 @@ class Net:
                 self.model_parallel = int(v)
             elif k == "seq_parallel":
                 self.seq_parallel = int(v)
+            elif k == "expert_parallel":
+                self.expert_parallel = int(v)
             elif k == "shard_optimizer":
                 self.shard_optimizer = int(v)
             elif k == "clip_norm":
@@ -160,7 +163,8 @@ class Net:
                 "batch_size %d must divide the %d-process run"
                 % (self.batch_size, jax.process_count()))
         self.mesh = make_mesh(self.dev, self.model_parallel,
-                              self.seq_parallel)
+                              self.seq_parallel,
+                              expert_parallel=self.expert_parallel)
         self.n_data_shards = self.mesh.shape["data"]
         if self.batch_size % self.n_data_shards:
             raise ConfigError(

@@ -1,13 +1,31 @@
 """Switch-style mixture-of-experts with expert parallelism.
 
 No reference counterpart (SURVEY §2.7 lists expert parallelism as
-to-be-designed-fresh). TPU-first shape: the GShard dispatch/combine einsum
-formulation — top-1 routing, bounded per-expert capacity, overflow tokens
-dropped (pass through the residual), auxiliary load-balancing loss. The
-expert dim of every tensor is sharded over a mesh axis (default ``model``)
-with ordinary NamedShardings; GSPMD partitions the dispatch/combine einsums
-into the all-to-all exchanges that a hand-written expert-parallel backend
-would issue, and the per-expert FFN batch rides the MXU.
+to-be-designed-fresh). TPU-first shapes, three dispatch strategies behind
+one routing function:
+
+- ``dispatch="sort"`` (default): sort-based sparse dispatch. Tokens are
+  ordered by expert with one stable argsort, their queue positions come
+  from segment offsets, and the (E, C, D) expert batch is built with a
+  single scatter-add (and read back with a single gather). No (S, E, C)
+  one-hot tensor ever exists, so cost scales with S·D + S·log S instead
+  of S·E·C — the difference is decisive at real expert counts (measured
+  on one v5e chip, doc/performance.md round 3).
+- ``dispatch="dense"``: the GShard einsum formulation ((S,E,C) one-hot
+  dispatch/combine). Kept because GSPMD partitions einsums into clean
+  all-to-alls when the expert dim of the weights is sharded but the
+  tokens are not expert-sharded, and as the oracle for the sort path.
+- :func:`switch_moe_alltoall`: explicit expert parallelism for use INSIDE
+  a ``shard_map`` over the ``expert`` mesh axis. Tokens are sharded over
+  the axis; each shard routes locally, builds its (E, C_local, D) block,
+  and two ``lax.all_to_all`` exchanges move token blocks to the expert's
+  owner and back — the hand-written form of what a GShard backend issues.
+  Capacity is per (source shard, expert) group, exactly GShard's grouped
+  dispatch semantics.
+
+All three share top-1 routing, bounded per-expert capacity with overflow
+tokens dropped (they pass through the caller's residual), and the
+switch-transformer auxiliary load-balancing loss.
 """
 
 from __future__ import annotations
@@ -17,22 +35,89 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def _route(x: jnp.ndarray, w_gate: jnp.ndarray, capacity: int):
+    """Shared top-1 routing. Returns (gate (S,), expert_idx (S,) i32,
+    pos (S,) i32 queue position, keep (S,) bool, aux scalar).
+
+    Queue positions are assigned in token order (stable argsort), so the
+    keep set is identical to the dense cumsum formulation's.
+    """
+    s, _ = x.shape
+    e = w_gate.shape[1]
+    logits = (x @ w_gate.astype(x.dtype)).astype(jnp.float32)    # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)    # (S,)
+    gate = jnp.max(probs, axis=-1)                               # (S,)
+
+    order = jnp.argsort(expert_idx, stable=True)                 # (S,)
+    sorted_e = expert_idx[order]
+    seg_start = jnp.searchsorted(sorted_e, jnp.arange(e))        # (E,)
+    pos_sorted = jnp.arange(s, dtype=jnp.int32) \
+        - seg_start[sorted_e].astype(jnp.int32)
+    pos = jnp.zeros((s,), jnp.int32).at[order].set(pos_sorted)
+    keep = pos < capacity
+
+    # switch-transformer load-balancing loss: E * sum_e f_e * p_e
+    frac_tokens = jnp.zeros((e,), jnp.float32).at[expert_idx].add(1.0) / s
+    frac_probs = probs.mean(axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return gate, expert_idx, pos, keep, aux
+
+
+def _expert_ffn(xin: jnp.ndarray, w_up: jnp.ndarray,
+                w_down: jnp.ndarray) -> jnp.ndarray:
+    """(E, C, D) expert batch -> (E, C, D); the per-expert FFN rides the
+    MXU as E batched (C, D) x (D, H) matmuls."""
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, w_up.astype(xin.dtype)))
+    return jnp.einsum("ech,ehd->ecd", h, w_down.astype(xin.dtype))
+
+
+def _scatter_tokens(x, expert_idx, pos, keep, e, capacity):
+    """Tokens -> (E*C, D) expert batch via one scatter-add; dropped tokens
+    land in a dummy trailing row that is sliced off."""
+    s, d = x.shape
+    slot = jnp.where(keep, expert_idx * capacity + pos, e * capacity)
+    xin = jnp.zeros((e * capacity + 1, d), x.dtype).at[slot].add(x)
+    return xin[:e * capacity], slot
 
 
 def switch_moe(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
                w_down: jnp.ndarray, capacity_factor: float = 1.25,
-               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 MoE FFN.
+               dispatch: str = "sort") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 MoE FFN on one logical shard.
 
     x: (S, D) tokens; w_gate: (D, E); w_up: (E, D, H); w_down: (E, H, D).
     Returns (out (S, D), aux_loss scalar). Tokens beyond an expert's
     capacity ``ceil(S/E * capacity_factor)`` contribute zero (caller keeps
     the residual path).
     """
+    if dispatch not in ("sort", "dense"):
+        raise ValueError("dispatch must be 'sort' or 'dense', got %r"
+                         % (dispatch,))
     s, d = x.shape
     e = w_gate.shape[1]
     capacity = max(1, math.ceil(s / e * capacity_factor))
 
+    if dispatch == "dense":
+        return _switch_moe_dense(x, w_gate, w_up, w_down, capacity)
+
+    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity)
+    xin, slot = _scatter_tokens(x, expert_idx, pos, keep, e, capacity)
+    out_e = _expert_ffn(xin.reshape(e, capacity, d), w_up, w_down)
+    out_flat = out_e.reshape(e * capacity, d)
+    tok = out_flat[jnp.minimum(slot, e * capacity - 1)]
+    out = tok * (gate * keep).astype(tok.dtype)[:, None]
+    return out.astype(x.dtype), aux
+
+
+def _switch_moe_dense(x, w_gate, w_up, w_down, capacity):
+    """GShard one-hot einsum formulation — the GSPMD-friendly and oracle
+    path (the original round-1 implementation)."""
+    s, d = x.shape
+    e = w_gate.shape[1]
     logits = (x @ w_gate.astype(x.dtype)).astype(jnp.float32)   # (S, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)                     # (S,)
@@ -49,16 +134,62 @@ def switch_moe(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
     combine = dispatch * gate[:, None, None]
 
     xin = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin,
-                               w_up.astype(x.dtype)))
-    out_e = jnp.einsum("ech,ehd->ecd", h, w_down.astype(x.dtype))
+    out_e = _expert_ffn(xin, w_up, w_down)
     out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_e)
 
-    # switch-transformer load-balancing loss: E * sum_e f_e * p_e
     frac_tokens = onehot.mean(axis=0)
     frac_probs = probs.mean(axis=0)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return out, aux
 
 
-__all__ = ["switch_moe"]
+def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
+                        w_up: jnp.ndarray, w_down: jnp.ndarray,
+                        axis_name: str = "expert",
+                        capacity_factor: float = 1.25,
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel top-1 MoE for use INSIDE a shard_map over
+    ``axis_name``.
+
+    Per shard: x (S_local, D) local tokens; w_gate (D, E) replicated;
+    w_up (E_local, D, H) / w_down (E_local, H, D) local expert shards
+    (E = E_local * axis size). Routing is local; the (E, C_local, D)
+    dispatch block is exchanged with one ``all_to_all`` so each shard
+    holds its E_local experts' tokens from every source shard, the FFN
+    runs, and a mirror ``all_to_all`` returns the outputs. Capacity
+    ``ceil(S_local/E * capacity_factor)`` applies per (source shard,
+    expert) — GShard's grouped dispatch.
+
+    The aux loss is computed from the shard-local routing statistics and
+    psum-averaged, which equals the global statistic when shards see
+    i.i.d. token groups (and is the standard GShard formulation).
+    """
+    p = lax.psum(1, axis_name)
+    s, d = x.shape
+    e = w_gate.shape[1]
+    e_local = w_up.shape[0]
+    if e_local * p != e:
+        raise ValueError(
+            "switch_moe_alltoall: gate has %d experts but shards hold "
+            "%d x %d" % (e, p, e_local))
+    capacity = max(1, math.ceil(s / e * capacity_factor))
+
+    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity)
+    aux = lax.psum(aux, axis_name) / p
+    xin, slot = _scatter_tokens(x, expert_idx, pos, keep, e, capacity)
+    xin = xin.reshape(e, capacity, d)
+    # (E, C, D) -> (E_local, P*C, D): expert dim split across shards,
+    # every shard's contribution concatenated on the capacity dim
+    xin = lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=1,
+                         tiled=True)
+    out_e = _expert_ffn(xin, w_up, w_down)
+    # mirror exchange: (E_local, P*C, D) -> (E, C, D) back on the source
+    out_e = lax.all_to_all(out_e, axis_name, split_axis=1, concat_axis=0,
+                           tiled=True)
+    out_flat = out_e.reshape(e * capacity, d)
+    tok = out_flat[jnp.minimum(slot, e * capacity - 1)]
+    out = tok * (gate * keep).astype(tok.dtype)[:, None]
+    return out.astype(x.dtype), aux
+
+
+__all__ = ["switch_moe", "switch_moe_alltoall"]

@@ -21,6 +21,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def parse_devices(dev: str) -> Sequence[jax.Device]:
@@ -46,32 +47,38 @@ def parse_devices(dev: str) -> Sequence[jax.Device]:
 
 
 def make_mesh(dev: str = "", model_parallel: int = 1, seq_parallel: int = 1,
-              pipeline_parallel: int = 1,
+              pipeline_parallel: int = 1, expert_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a (data, pipe, seq, model) mesh; size-1 axes cost nothing.
+    """Build a (data, pipe, seq, expert, model) mesh; size-1 axes cost
+    nothing.
 
     Axis order is outermost-to-innermost communication intensity (the
     scaling-book ordering): ``pipe`` stages exchange one activation per tick,
-    ``seq`` rings K/V shards, ``model`` all-reduces every layer — so the
-    chattiest axes map to the most adjacent chips.
+    ``seq`` rings K/V shards, ``expert`` all-to-alls token blocks per MoE
+    layer, ``model`` all-reduces every layer — so the chattiest axes map to
+    the most adjacent chips.
     """
     if devices is None:
         devices = parse_devices(dev)
     n = len(devices)
     for name, k in (("model_parallel", model_parallel),
                     ("seq_parallel", seq_parallel),
-                    ("pipeline_parallel", pipeline_parallel)):
+                    ("pipeline_parallel", pipeline_parallel),
+                    ("expert_parallel", expert_parallel)):
         if k <= 0:
             raise ValueError("%s must be >= 1, got %d" % (name, k))
-    prod = model_parallel * seq_parallel * pipeline_parallel
+    prod = model_parallel * seq_parallel * pipeline_parallel * expert_parallel
     if n % prod:
         raise ValueError(
-            "pipeline_parallel=%d * seq_parallel=%d * model_parallel=%d "
-            "must divide device count %d"
-            % (pipeline_parallel, seq_parallel, model_parallel, n))
+            "pipeline_parallel=%d * seq_parallel=%d * expert_parallel=%d * "
+            "model_parallel=%d must divide device count %d"
+            % (pipeline_parallel, seq_parallel, expert_parallel,
+               model_parallel, n))
     arr = np.asarray(devices).reshape(
-        n // prod, pipeline_parallel, seq_parallel, model_parallel)
-    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
+        n // prod, pipeline_parallel, seq_parallel, expert_parallel,
+        model_parallel)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS,
+                      MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

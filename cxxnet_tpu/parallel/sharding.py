@@ -31,16 +31,23 @@ AxesSpec = Optional[Tuple[Optional[str], ...]]
 
 
 def _fit_spec(axes: AxesSpec, shape: Sequence[int], mesh: Mesh) -> list:
-    """Drop requested mesh axes that don't exist / don't divide the dim."""
+    """Drop requested mesh axes that don't exist / don't divide the dim.
+
+    A per-dim entry may be a tuple of axis names, meaning "the first axis
+    that is present (>1) and divides the dim" — e.g. the MoE expert dim
+    declares ``("expert", "model")``: shard over a dedicated expert axis
+    when the mesh has one, else fall back to the model axis."""
     out = [None] * len(shape)
     if axes is None:
         return out
     for d, ax in enumerate(axes[:len(shape)]):
         if ax is None:
             continue
-        size = mesh.shape.get(ax, 1)
-        if size > 1 and shape[d] % size == 0:
-            out[d] = ax
+        for cand in (ax if isinstance(ax, tuple) else (ax,)):
+            size = mesh.shape.get(cand, 1)
+            if size > 1 and shape[d] % size == 0:
+                out[d] = cand
+                break
     return out
 
 

@@ -113,6 +113,31 @@ def main() -> None:
     path3 = os.path.join(outdir, "zero3_rank%d.model" % rank)
     net3.save_model(path3)
     print("ZERO3_SAVED rank%d %d bytes" % (rank, os.path.getsize(path3)))
+
+    # hybrid parallelism across the process boundary: dp ACROSS the two
+    # gloo processes x tensor parallelism WITHIN each process's 2 local
+    # devices (mesh data=2 over processes, model=2 within) — the
+    # 2-process x 4-device composition the dryrun tail references
+    net4 = Net(tokenize(CONF))
+    net4.set_param("model_parallel", "2")
+    net4.init_model()
+    assert net4.mesh.shape["data"] == 2 and net4.mesh.shape["model"] == 2
+    w4 = net4.params["fc1"]["wmat"]
+    assert any(ax == "model" for ax in tuple(w4.sharding.spec) if ax), \
+        "fc1 weight should be tensor-parallel in the hybrid"
+    # the placement claim itself: each data row (= one tp group of 2
+    # model shards) maps entirely to ONE process, i.e. tp runs within a
+    # process and dp runs across them
+    dev_rows = net4.mesh.devices.reshape(net4.mesh.shape["data"], -1)
+    for row in dev_rows:
+        assert len({d.process_index for d in row}) == 1, dev_rows
+    assert {row[0].process_index for row in dev_rows} == {0, 1}, dev_rows
+    for xb, yb in batches:
+        net4.update(rank_shard(xb, yb))
+    hyb = {"%s/%s" % (l, t): net4.get_weight(l, t)
+           for l in ("fc1", "fc2") for t in ("wmat", "bias")}
+    np.savez(os.path.join(outdir, "hybrid_rank%d.npz" % rank), **hyb)
+    print("HYBRID_OK rank%d" % rank)
     print("rank", rank, "done")
 
 

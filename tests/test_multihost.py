@@ -141,3 +141,13 @@ def test_two_process_data_parallel_matches_single(tmp_path):
     b0 = (tmp_path / "zero3_rank0.model").read_bytes()
     b1 = (tmp_path / "zero3_rank1.model").read_bytes()
     assert b0 == b1 and len(b0) > 1000
+
+    # hybrid dp-across-processes x tp-within: both ranks converge to the
+    # same params as the single-process full-batch reference
+    for r in (0, 1):
+        assert any(l.startswith("HYBRID_OK rank%d" % r)
+                   for l in outs[r].splitlines()), outs[r][-1500:]
+        hyb = dict(np.load(tmp_path / ("hybrid_rank%d.npz" % r)))
+        for name in ref:
+            np.testing.assert_allclose(hyb[name], ref[name], rtol=2e-5,
+                                       atol=2e-6, err_msg="hybrid " + name)
