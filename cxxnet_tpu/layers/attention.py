@@ -20,7 +20,8 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import local_attention, ring_attention
+from ..ops.attention import (local_attention, ring_attention,
+                             ulysses_attention)
 from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
 from ..utils.config import ConfigError
 from .base import ApplyContext, Layer, Params, Shape3, register_layer
@@ -260,6 +261,7 @@ class AttentionLayer(Layer):
     def __init__(self, spec, cfg):
         self.nhead = 1
         self.causal = 0
+        self.seq_parallel_mode = "ring"
         super().__init__(spec, cfg)
 
     def set_param(self, name, val):
@@ -267,6 +269,11 @@ class AttentionLayer(Layer):
             self.nhead = int(val)
         elif name == "causal":
             self.causal = int(val)
+        elif name == "seq_parallel_mode":
+            if val not in ("ring", "ulysses"):
+                raise ConfigError("seq_parallel_mode must be ring|ulysses, "
+                                  "got %r" % val)
+            self.seq_parallel_mode = val
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         c, y, x = self.check_one_to_one(in_shapes)
@@ -309,8 +316,11 @@ class AttentionLayer(Layer):
         v = v.reshape(b, n, h, f // h)
         mesh = ctx.mesh
         if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
-            out = ring_attention(q, k, v, mesh, axis_name=SEQ_AXIS,
-                                 causal=bool(self.causal))
+            sp_attn = (ulysses_attention
+                       if self.seq_parallel_mode == "ulysses"
+                       else ring_attention)
+            out = sp_attn(q, k, v, mesh, axis_name=SEQ_AXIS,
+                          causal=bool(self.causal))
         else:
             out = local_attention(q, k, v, causal=bool(self.causal))
         out = out.reshape(b, n, f) @ params["proj"].astype(x.dtype).T

@@ -30,7 +30,8 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import (local_attention, local_attention_bhnd,
-                             ring_attention_inner)
+                             ring_attention_inner,
+                             ulysses_attention_inner)
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                              batch_sharding)
 from ..parallel.pipeline import gpipe
@@ -60,6 +61,14 @@ class GPTConfig:
     #                             saved output is pure extra HBM traffic.
     #                             Kept for the measurement; prefer
     #                             remat_mode="attn_saved".
+    seq_parallel_mode: str = "ring"  # sequence-parallel attention variant
+    #                             when the mesh's seq axis is > 1:
+    #                             "ring" rotates K/V chunks (works for any
+    #                             head count, O((n/P)^2) score memory);
+    #                             "ulysses" all-to-alls to head sharding
+    #                             and runs full-sequence flash locally
+    #                             (needs heads % (sp*tp) == 0). See
+    #                             doc/multi-device.md for the crossover.
     attn_layout: str = "auto"   # "bnhd": token-major activations with
     #                             (b,n,h,d)<->(b,h,n,d) transposes at the
     #                             flash-kernel boundary; "bhnd": project
@@ -176,13 +185,16 @@ def _block_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     return _mlp_core(p, h, reduce), aux
 
 
-def _train_attn(q, k, v, use_ring: bool):
-    """Training-time attention variant: ring over the seq axis, else the
-    head-major flash path (residuals saved (b,h,n,d), so under
-    remat_mode="attn_saved" the backward re-reads them with zero layout
-    copies)."""
+def _train_attn(q, k, v, use_ring: bool, sp_mode: str = "ring"):
+    """Training-time attention variant: ring or ulysses over the seq
+    axis, else the head-major flash path (residuals saved (b,h,n,d), so
+    under remat_mode="attn_saved" the backward re-reads them with zero
+    layout copies)."""
     if use_ring:
-        att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
+        if sp_mode == "ulysses":
+            att = ulysses_attention_inner(q, k, v, SEQ_AXIS, causal=True)
+        else:
+            att = ring_attention_inner(q, k, v, SEQ_AXIS, causal=True)
     else:
         tr = lambda t: jnp.transpose(t, (0, 2, 1, 3))
         att = tr(local_attention_bhnd(tr(q), tr(k), tr(v), causal=True))
@@ -199,7 +211,8 @@ def _train_attn_bhnd(q, k, v):
 
 
 def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
-           use_ring: bool, layout: str = "bnhd") -> jnp.ndarray:
+           use_ring: bool, layout: str = "bnhd",
+           sp_mode: str = "ring") -> jnp.ndarray:
     """Training block on local shards (b, n_local, F), inside gpipe's
     shard_map: explicit psum combines row-sharded partials (on a size-1
     model axis it is the identity, and demotes the vma type)."""
@@ -208,14 +221,16 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
         h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
         return _mlp_core(p, h, reduce)
     out, _ = _block_core(p, h, n_head_local,
-                         lambda q, k, v: _train_attn(q, k, v, use_ring),
+                         lambda q, k, v: _train_attn(q, k, v, use_ring,
+                                                     sp_mode),
                          reduce)
     return out
 
 
 def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
                      n_head_local: int, use_ring: bool,
-                     layout: str = "bnhd") -> jnp.ndarray:
+                     layout: str = "bnhd",
+                     sp_mode: str = "ring") -> jnp.ndarray:
     """Training block with the remat boundary between the halves: the
     attention half runs un-rematted (the flash custom-vjp's residuals —
     q/k/v/out head-major + log-sum-exp — stay saved, so its backward does
@@ -242,7 +257,8 @@ def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
         h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
     else:
         h, _ = _attn_core(p, h, n_head_local,
-                          lambda q, k, v: _train_attn(q, k, v, use_ring),
+                          lambda q, k, v: _train_attn(q, k, v, use_ring,
+                                                      sp_mode),
                           reduce)
     return jax.checkpoint(lambda pp, hh: _mlp_core(pp, hh, reduce))(p, h)
 
@@ -377,6 +393,15 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
         raise ValueError("attn_layout must be 'auto', 'bnhd' or 'bhnd', "
                          "got %r" % (cfg.attn_layout,))
     use_ring = n_sp > 1
+    if cfg.seq_parallel_mode not in ("ring", "ulysses"):
+        raise ValueError("seq_parallel_mode must be 'ring' or 'ulysses', "
+                         "got %r" % (cfg.seq_parallel_mode,))
+    if (cfg.seq_parallel_mode == "ulysses" and use_ring
+            and (cfg.n_head // max(n_tp, 1)) % n_sp):
+        raise ValueError(
+            "seq_parallel_mode='ulysses' needs local heads %d (n_head/tp) "
+            "divisible by the seq axis %d; use 'ring'"
+            % (cfg.n_head // max(n_tp, 1), n_sp))
     layout = cfg.attn_layout
     if layout == "auto":
         # measured rule (doc/performance.md round 3): head-major wins when
@@ -389,7 +414,7 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
                          "parallelism (ring attention owns the bnhd layout)")
     h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
     kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=use_ring,
-              layout=layout)
+              layout=layout, sp_mode=cfg.seq_parallel_mode)
     if cfg.remat and cfg.remat_mode == "attn_saved":
         # remat boundary between the block halves — see _block_mlp_remat
         block = functools.partial(_block_mlp_remat, **kw)

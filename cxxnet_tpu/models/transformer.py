@@ -21,7 +21,8 @@ from __future__ import annotations
 
 def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
                       causal: int, mlp_ratio: int = 4,
-                      moe_experts: int = 0) -> None:
+                      moe_experts: int = 0,
+                      seq_parallel_mode: str = "ring") -> None:
     # position-wise MLP = 1x1 conv on the (b, N, 1, F) node; with
     # moe_experts > 0 the MLP becomes a switch-MoE (expert parallelism)
     a, b = "b%da" % i, "b%db" % i
@@ -29,6 +30,8 @@ def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
     L.append("layer[%s->%s] = layer_norm:ln%da" % (a, a, i))
     L.append("layer[%s->%s] = attention:att%d" % (a, a, i))
     L.append("  nhead = %d" % nhead)
+    if seq_parallel_mode != "ring":
+        L.append("  seq_parallel_mode = %s" % seq_parallel_mode)
     if causal:
         L.append("  causal = 1")
     L.append("layer[%s,%s_r->%s] = add" % (a, a, b))
@@ -55,7 +58,8 @@ def transformer_config(seq_len: int = 128, vocab_size: int = 256,
                        batch_size: int = 16, dev: str = "",
                        seq_parallel: int = 1, model_parallel: int = 1,
                        moe_experts: int = 0, precision: str = "float32",
-                       eta: float = 0.05) -> str:
+                       eta: float = 0.05,
+                       seq_parallel_mode: str = "ring") -> str:
     L = ["netconfig=start"]
     L.append("layer[0->emb] = embedding:emb")
     L.append("  vocab_size = %d" % vocab_size)
@@ -64,7 +68,8 @@ def transformer_config(seq_len: int = 128, vocab_size: int = 256,
     for i in range(nblock):
         out = "blk%d" % i
         transformer_block(L, src, out, i, feat, nhead, causal,
-                          moe_experts=moe_experts)
+                          moe_experts=moe_experts,
+                          seq_parallel_mode=seq_parallel_mode)
         src = out
     L.append("layer[%s->%s] = layer_norm:lnf" % (src, src))
     # mean-pool over the sequence -> (b, 1, 1, feat) -> classifier head

@@ -363,5 +363,82 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          out_specs=spec, check_vma=vma_ok)(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+# DeepSpeed-Ulysses formulation: instead of rotating K/V chunks around a
+# ring (P steps, online-softmax merging), ONE all-to-all per tensor
+# re-shards from sequence-sharded (b, n/P, h, d) to head-sharded
+# (b, n, h/P, d); each device then runs plain local attention over the
+# FULL sequence for its h/P heads, and a mirror all-to-all restores the
+# sequence sharding. Requires heads % P == 0 (the ring does not).
+#
+# When each wins (doc/multi-device.md "Sequence parallelism"): ulysses
+# moves 4 * (b * n/P * h * d) elements per device in two collective
+# phases and computes attention in one dense local call — fewer, larger
+# kernels, no P-step loop, and the flash kernel sees the whole sequence
+# (better q-block pipelining). Ring keeps memory at O((n/P)^2) scores per
+# step, needs no head divisibility, and overlaps its ppermutes with the
+# block matmuls — it is the only option when h < P (long-context many-
+# shard regimes) and degrades more gracefully on slow links because each
+# hop is 1/P the ulysses payload. Rule of thumb: ulysses when h >= P and
+# the all-to-all rides ICI; ring otherwise.
+
+
+def ulysses_attention_inner(q, k, v, axis_name: str = "seq",
+                            causal: bool = False):
+    """Ulysses attention for use INSIDE an existing shard_map: q,k,v are
+    local (b, n_local, h, d) shards of a sequence sharded over
+    ``axis_name``; h must divide by the axis size."""
+    p = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % p:
+        raise ValueError(
+            "ulysses attention: %d heads must divide over the %r axis "
+            "(size %d); use ring attention instead" % (h, axis_name, p))
+
+    def seq_to_heads(t):
+        # (b, n/P, h, d) -> (b, n, h/P, d)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = local_attention(seq_to_heads(q), seq_to_heads(k),
+                          seq_to_heads(v), causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis_name: str = "seq",
+                      causal: bool = False,
+                      batch_axis: Optional[str] = "data") -> jnp.ndarray:
+    """Standalone Ulysses sequence-parallel attention (shard_map wrapper,
+    same signature/contract as :func:`ring_attention`)."""
+    n_seq = mesh.shape.get(axis_name, 1)
+    if q.shape[1] % n_seq:
+        raise ValueError(
+            "ulysses_attention: sequence length %d is not divisible by "
+            "the %r mesh axis (size %d)" % (q.shape[1], axis_name, n_seq))
+    if q.shape[2] % max(n_seq, 1):
+        raise ValueError(
+            "ulysses_attention: %d heads must divide over the %r axis "
+            "(size %d); use ring_attention instead"
+            % (q.shape[2], axis_name, n_seq))
+    batch_ax = batch_axis if (batch_axis and
+                              mesh.shape.get(batch_axis, 1) > 1 and
+                              q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(batch_ax, axis_name, None, None)
+    body = functools.partial(ulysses_attention_inner, axis_name=axis_name,
+                             causal=causal)
+    vma_ok = not _ring_chunk_kernels(q.shape[1])
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=vma_ok)(q, k, v)
+
+
 __all__ = ["full_attention", "local_attention", "ring_attention",
-           "ring_attention_inner"]
+           "ring_attention_inner", "ulysses_attention",
+           "ulysses_attention_inner"]

@@ -127,3 +127,60 @@ def test_attention_matches_torch_sdpa():
         a, b_, c, mesh, causal=True))(jnp.asarray(q), jnp.asarray(k),
                                       jnp.asarray(v)))
     np.testing.assert_allclose(ring, ref, rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------------------- ulysses
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_parallel", [1, 2, 4])
+def test_ulysses_matches_full(causal, seq_parallel):
+    from cxxnet_tpu.ops.attention import ulysses_attention
+    rs = np.random.RandomState(10)
+    q, k, v = _qkv(rs)                       # h=4 divides every sp here
+    mesh = make_mesh("cpu:0-7", seq_parallel=seq_parallel)
+    ref = full_attention(q, k, v, causal=causal)
+    out = jax.jit(lambda a, b_, c: ulysses_attention(
+        a, b_, c, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gradients_match_full(causal):
+    from cxxnet_tpu.ops.attention import ulysses_attention
+    rs = np.random.RandomState(11)
+    q, k, v = _qkv(rs, n=16)
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+
+    def loss_full(q, k, v):
+        return (full_attention(q, k, v, causal=causal) ** 2).sum()
+
+    def loss_uly(q, k, v):
+        return (ulysses_attention(q, k, v, mesh, causal=causal) ** 2).sum()
+
+    g_ref = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    from cxxnet_tpu.ops.attention import ulysses_attention
+    rs = np.random.RandomState(12)
+    q, k, v = _qkv(rs)
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+    a = jax.jit(lambda x, y, z: ring_attention(x, y, z, mesh,
+                                               causal=True))(q, k, v)
+    b = jax.jit(lambda x, y, z: ulysses_attention(x, y, z, mesh,
+                                                  causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_head_divisibility_validated():
+    from cxxnet_tpu.ops.attention import ulysses_attention
+    rs = np.random.RandomState(13)
+    q, k, v = _qkv(rs, h=3)                  # 3 heads over sp4: invalid
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh, causal=True)

@@ -363,3 +363,38 @@ def test_gpt_zero3_pp2_matches_single_device():
     for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, z_params)),
                     jax.tree.leaves(jax.tree.map(np.asarray, ref_params))):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+
+def test_gpt_ulysses_matches_ring_and_single():
+    """seq_parallel_mode='ulysses' (all-to-all head sharding) must train
+    identically to the ring and to a single device."""
+    import dataclasses
+    cfg_u = dataclasses.replace(CFG, seq_parallel_mode="ulysses")
+
+    def run(mesh, cfg):
+        params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+        mom = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+        step = make_train_step(cfg, mesh)
+        out = []
+        for i in range(4):
+            params, mom, loss = step(params, mom, _ids(i))
+            out.append(float(loss))
+        return out
+
+    ref = run(make_mesh("cpu:0"), CFG)
+    mesh = make_mesh("cpu:0-7", seq_parallel=4)
+    ring = run(mesh, CFG)
+    uly = run(mesh, cfg_u)
+    np.testing.assert_allclose(ring, ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(uly, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_ulysses_head_divisibility_validated():
+    import dataclasses
+    cfg = dataclasses.replace(CFG, n_head=3, feat=33,
+                              seq_parallel_mode="ulysses")
+    mesh = make_mesh("cpu:0-7", seq_parallel=2)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    with pytest.raises(ValueError, match="ulysses"):
+        gpt_loss(params, ids, cfg, mesh)

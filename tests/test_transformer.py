@@ -70,3 +70,34 @@ def test_causal_transformer_trains():
     net.update(_batch(0))
     after = [np.asarray(t) for t in jax.tree.leaves(net.params)]
     assert any(np.abs(a - b).sum() > 0 for a, b in zip(after, before))
+
+
+def test_transformer_ulysses_matches_ring():
+    """Same net, same seed: sp2 training with ulysses attention must land
+    on the same params as ring attention (both equal the exact math)."""
+    import jax
+    from cxxnet_tpu.models import transformer_config
+
+    def run(mode):
+        cfg = transformer_config(seq_len=16, vocab_size=16, feat=16,
+                                 nhead=2, nblock=1, num_classes=4,
+                                 batch_size=16, dev="cpu:0-7",
+                                 seq_parallel=2, causal=1,
+                                 seq_parallel_mode=mode)
+        net = Net(tokenize(cfg))
+        net.init_model()
+        rs = np.random.RandomState(0)
+        for i in range(3):
+            ids = rs.randint(0, 16, (16, 1, 1, 16)).astype(np.float32)
+            lab = rs.randint(0, 4, (16, 1)).astype(np.float32)
+            net.update(DataBatch(ids, lab))
+        return {"%s/%s" % (l, t): np.asarray(w)
+                for l, tags in net.params.items()
+                for t, w in tags.items()}
+
+    ring = run("ring")
+    uly = run("ulysses")
+    assert ring.keys() == uly.keys()
+    for k in ring:
+        np.testing.assert_allclose(uly[k], ring[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
