@@ -292,8 +292,10 @@ class AugmentIterator(IIterator):
             img = img[:, yy:yy + y, xx:xx + x]
         if do_mirror:
             img = img[:, :, ::-1]
-        return DataInst(np.ascontiguousarray(img, np.float32) * self.scale,
-                        d.label, d.index, d.extra_data)
+        img = np.ascontiguousarray(img, np.float32)
+        if self.scale != 1.0:       # skip the extra full pass at scale 1
+            img = img * self.scale
+        return DataInst(img, d.label, d.index, d.extra_data)
 
     def next(self) -> bool:
         if not self.base.next():

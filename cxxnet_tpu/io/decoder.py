@@ -48,6 +48,15 @@ def _find_native() -> Optional[ctypes.CDLL]:
                     ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                     ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                     ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+                # present from round 3 on (decode-at-scale)
+                if hasattr(lib, "cxn_jpeg_decode_scaled"):
+                    lib.cxn_jpeg_decode_scaled.restype = ctypes.c_int
+                    lib.cxn_jpeg_decode_scaled.argtypes = [
+                        ctypes.c_char_p, ctypes.c_long, ctypes.c_void_p,
+                        ctypes.c_long, ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_int),
+                        ctypes.POINTER(ctypes.c_int),
+                        ctypes.POINTER(ctypes.c_int)]
                 # present from round 2 on; older .so builds simply lack them
                 if hasattr(lib, "cxn_png_decode"):
                     lib.cxn_png_decode.restype = ctypes.c_int
@@ -70,20 +79,71 @@ def have_native() -> bool:
     return _find_native() is not None
 
 
-def _pil_decode_hwc(buf: bytes) -> np.ndarray:
-    """Shared PIL fallback: bytes -> HWC uint8 (RGB, or 1-channel gray)."""
+def _pil_decode_hwc(buf: bytes, min_hw=None) -> np.ndarray:
+    """Shared PIL fallback: bytes -> HWC uint8 (RGB, or 1-channel gray).
+    ``min_hw`` engages JPEG decode-at-scale via Image.draft (same
+    power-of-two libjpeg reduction the native path picks)."""
     from PIL import Image
     import io as _io
     img = Image.open(_io.BytesIO(buf))
+    if min_hw is not None and img.format == "JPEG":
+        n = _pick_jpeg_scale(img.height, img.width, min_hw)
+        if n < 8:
+            # request FLOOR dims: draft picks scale = dim // requested, so
+            # ceil dims would under-reduce any source that is not an
+            # exact multiple of the step (255x255 at n=4: ceil -> 128,
+            # 255 // 128 = 1 = no reduction; floor -> 127, 255 // 127 = 2,
+            # the same 1/2 reduction the native path applies)
+            img.draft(None, ((img.width * n) // 8, (img.height * n) // 8))
     if img.mode not in ("RGB", "L"):
         img = img.convert("RGB")
     arr = np.asarray(img, np.uint8)
     return arr[:, :, None] if arr.ndim == 2 else arr
 
 
-def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
-    """JPEG bytes -> HWC uint8 (RGB or single-channel grayscale)."""
+# decode-at-scale gating shared by the img/imgbin iterators: any of these
+# params defines warp geometry on the FULL source frame, so decode-at-scale
+# must stay off when one is configured
+WARP_PARAM_NAMES = ("max_rotate_angle", "rotate", "rotate_list",
+                    "max_shear_ratio", "min_crop_size", "max_crop_size",
+                    "min_img_size", "max_img_size")
+
+
+def is_warp_param(name: str, val: str) -> bool:
+    """True when (name, val) configures a warp-family augmentation."""
+    if name in WARP_PARAM_NAMES:
+        return True
+    return name in ("max_random_scale", "min_random_scale") \
+        and float(val) != 1.0
+
+
+def resolve_min_hw(decode_at_scale: int, target_hw, warp_params: bool):
+    """The min (h, w) passed to decode, or None for full-size decode."""
+    return target_hw if decode_at_scale and not warp_params else None
+
+
+def _pick_jpeg_scale(h: int, w: int, min_hw) -> int:
+    """Smallest libjpeg scale_num (power of two out of 8, so the PIL
+    draft fallback picks the identical reduction) whose output dims still
+    cover ``min_hw`` = (min_h, min_w)."""
+    mh, mw = min_hw
+    for n in (1, 2, 4):                       # 1/8, 1/4, 1/2
+        if (h * n + 7) // 8 >= mh and (w * n + 7) // 8 >= mw:
+            return n
+    return 8
+
+
+def decode_jpeg_hwc(buf: bytes, min_hw=None) -> np.ndarray:
+    """JPEG bytes -> HWC uint8 (RGB or single-channel grayscale).
+
+    ``min_hw`` (min_h, min_w) opts into decode-at-scale: the DCT is
+    decoded at the coarsest 1/2^k scale whose output still covers the
+    requested minimum (libjpeg scale_num/8 natively, PIL ``draft`` on the
+    fallback — both are libjpeg underneath, so the two paths stay
+    pixel-identical at the same reduction)."""
     lib = _find_native()
+    scaled = (min_hw is not None and lib is not None
+              and hasattr(lib, "cxn_jpeg_decode_scaled"))
     if lib is not None:
         w = ctypes.c_int()
         h = ctypes.c_int()
@@ -92,14 +152,26 @@ def decode_jpeg_hwc(buf: bytes) -> np.ndarray:
                                  ctypes.byref(w), ctypes.byref(h),
                                  ctypes.byref(c))
         if rc == 0:
-            out = np.empty((h.value, w.value, c.value), np.uint8)
-            rc = lib.cxn_jpeg_decode(
-                buf, len(buf), out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
-                ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
-            if rc == 0:
+            n = _pick_jpeg_scale(h.value, w.value, min_hw) if scaled else 8
+            # output dims are exactly ceil(dim * n / 8) (libjpeg
+            # jdiv_round_up) — no second header probe needed
+            oh = (h.value * n + 7) // 8
+            ow = (w.value * n + 7) // 8
+            out = np.empty((oh, ow, c.value), np.uint8)
+            if n < 8:
+                rc = lib.cxn_jpeg_decode_scaled(
+                    buf, len(buf), out.ctypes.data_as(ctypes.c_void_p),
+                    out.nbytes, n, ctypes.byref(w), ctypes.byref(h),
+                    ctypes.byref(c))
+            else:
+                rc = lib.cxn_jpeg_decode(
+                    buf, len(buf), out.ctypes.data_as(ctypes.c_void_p),
+                    out.nbytes, ctypes.byref(w), ctypes.byref(h),
+                    ctypes.byref(c))
+            if rc == 0 and (h.value, w.value) == (oh, ow):
                 return out
         # fall through to PIL on any native failure
-    return _pil_decode_hwc(buf)
+    return _pil_decode_hwc(buf, min_hw=min_hw)
 
 
 def decode_png_hwc(buf: bytes) -> np.ndarray:
@@ -163,14 +235,16 @@ def affine_warp_hwc(hwc: np.ndarray, size, inverse6, fill: int) -> np.ndarray:
     return arr[:, :, None] if arr.ndim == 2 else arr
 
 
-def decode_image_chw(buf: bytes, gray_to_rgb: bool = True) -> np.ndarray:
+def decode_image_chw(buf: bytes, gray_to_rgb: bool = True,
+                     min_hw=None) -> np.ndarray:
     """Image bytes (any PIL-supported format; native paths for JPEG and
     PNG) -> float32 CHW 0..255, grayscale replicated to 3 channels if
-    requested."""
+    requested. ``min_hw`` opts JPEG sources into decode-at-scale (see
+    decode_jpeg_hwc); other formats always decode at full size."""
     is_jpeg = len(buf) > 2 and buf[0] == 0xFF and buf[1] == 0xD8
     is_png = len(buf) > 8 and buf[:8] == b"\x89PNG\r\n\x1a\n"
     if is_jpeg:
-        hwc = decode_jpeg_hwc(buf)
+        hwc = decode_jpeg_hwc(buf, min_hw=min_hw)
     elif is_png:
         hwc = decode_png_hwc(buf)
     else:

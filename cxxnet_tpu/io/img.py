@@ -26,8 +26,15 @@ class ImageIterator(IIterator):
         self.seed = _RAND_MAGIC
         self.gray_to_rgb = True
         self.loc = 0
+        # decode-at-scale: same opt-in + warp-param gating as imgbin
+        self.decode_at_scale = 0
+        self.target_hw = None
+        self._warp_params = False
 
     def set_param(self, name: str, val: str) -> None:
+        from .decoder import is_warp_param
+        if is_warp_param(name, val):
+            self._warp_params = True
         if name == "image_list":
             self.image_list = val
         elif name == "image_root":
@@ -40,12 +47,20 @@ class ImageIterator(IIterator):
             self.silent = int(val)
         elif name == "seed_data":
             self.seed = _RAND_MAGIC + int(val)
+        elif name == "decode_at_scale":
+            self.decode_at_scale = int(val)
         elif name == "input_shape":
-            self.gray_to_rgb = int(val.split(",")[0]) == 3
+            parts = [int(v) for v in val.split(",")]
+            self.gray_to_rgb = parts[0] == 3
+            if len(parts) == 3:
+                self.target_hw = (parts[1], parts[2])
 
     def init(self) -> None:
         if not self.image_list:
             raise ValueError("img iterator: must set image_list")
+        from .decoder import resolve_min_hw
+        self._min_hw = resolve_min_hw(self.decode_at_scale, self.target_hw,
+                                      self._warp_params)
         self.idx, self.labels, self.names = read_list_file(
             self.image_list, self.label_width)
         self.order = np.arange(len(self.idx))
@@ -66,7 +81,8 @@ class ImageIterator(IIterator):
         i = self.order[self.loc]
         self.loc += 1
         with open(self.image_root + self.names[i], "rb") as f:
-            data = decode_image_chw(f.read(), self.gray_to_rgb)
+            data = decode_image_chw(f.read(), self.gray_to_rgb,
+                                    self._min_hw)
         self._value = DataInst(data, self.labels[i], int(self.idx[i]))
         return True
 

@@ -102,8 +102,22 @@ class ImageBinIterator(PrefetchProducerMixin, IIterator):
         # holding gigabytes of host RAM
         self.queue_size = 64
         self.gray_to_rgb = True
+        # decode-at-scale (opt-in): decode JPEGs at the coarsest power-of-
+        # two libjpeg scale still covering the crop target. Only engaged
+        # on the plain crop/mirror path — any warp-family augment param
+        # (rotation/shear/crop-size/scale jitter) needs the full source
+        # box and the warp geometry is defined relative to the source
+        # size, so those disable it. NOTE the crop offsets are then drawn
+        # in the scaled frame: the output is a crop of the DCT-downscaled
+        # image, not a downscale of the original's crop (doc/io.md).
+        self.decode_at_scale = 0
+        self.target_hw = None
+        self._warp_params = False
 
     def set_param(self, name: str, val: str) -> None:
+        from .decoder import is_warp_param
+        if is_warp_param(name, val):
+            self._warp_params = True
         if name == "image_list":
             self.image_list = val
         elif name == "image_bin":
@@ -126,8 +140,13 @@ class ImageBinIterator(PrefetchProducerMixin, IIterator):
             self.dist_worker_rank = int(val)
         elif name == "decode_threads":
             self.decode_threads = int(val)
+        elif name == "decode_at_scale":
+            self.decode_at_scale = int(val)
         elif name == "input_shape":
-            self.gray_to_rgb = int(val.split(",")[0]) == 3
+            parts = [int(v) for v in val.split(",")]
+            self.gray_to_rgb = parts[0] == 3
+            if len(parts) == 3:
+                self.target_hw = (parts[1], parts[2])
 
     # ---------------------------------------------------------------- setup
     def _shard_files(self) -> List[Tuple[str, str]]:
@@ -181,6 +200,11 @@ class ImageBinIterator(PrefetchProducerMixin, IIterator):
             print("ImageBinIterator: %d shards, %d images, shuffle=%d"
                   % (len(self.shards), total, self.shuffle))
         self.rng = np.random.RandomState(self.seed)
+        # resolved once all params are in: decode-at-scale only on the
+        # plain crop path (warp-family params need the full source box)
+        from .decoder import resolve_min_hw
+        self._min_hw = resolve_min_hw(self.decode_at_scale, self.target_hw,
+                                      self._warp_params)
         self._pool = ThreadPoolExecutor(max_workers=self.decode_threads)
         self._init_producer(self.queue_size)
 
@@ -220,7 +244,7 @@ class ImageBinIterator(PrefetchProducerMixin, IIterator):
                             continue   # unmatched trailing object; keep rest
                         pending.append((gi, self._pool.submit(
                             decode_image_chw, bytes(page[i]),
-                            self.gray_to_rgb)))
+                            self.gray_to_rgb, self._min_hw)))
                         if len(pending) >= window and not emit_oldest():
                             return
                     while pending:

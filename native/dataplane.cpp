@@ -34,12 +34,17 @@ void error_exit(j_common_ptr cinfo) {
 
 extern "C" {
 
-// Decode a JPEG byte buffer to interleaved RGB (or grayscale) HWC uint8.
-// Returns 0 on success; fills *w,*h,*c. out may be null to only query dims
-// (two-call protocol). out_cap is the byte capacity of out.
-int cxn_jpeg_decode(const unsigned char* src, long len,
-                    unsigned char* out, long out_cap,
-                    int* w, int* h, int* c) {
+// Decode a JPEG byte buffer to interleaved RGB (or grayscale) HWC uint8,
+// optionally at a reduced scale (scale_num/8 — libjpeg decodes the DCT at
+// the coarser scale, so a 1/2 decode costs roughly a quarter of the IDCT
+// and color-convert work; the input-pipeline decode-at-scale lever).
+// Returns 0 on success; fills *w,*h,*c with the OUTPUT (scaled) dims. out
+// may be null to only query dims (two-call protocol). out_cap is the byte
+// capacity of out.
+int cxn_jpeg_decode_scaled(const unsigned char* src, long len,
+                           unsigned char* out, long out_cap, int scale_num,
+                           int* w, int* h, int* c) {
+  if (scale_num < 1 || scale_num > 8) return -4;
   jpeg_decompress_struct cinfo;
   ErrorMgr jerr;
   cinfo.err = jpeg_std_error(&jerr.base);
@@ -55,8 +60,11 @@ int cxn_jpeg_decode(const unsigned char* src, long len,
     jpeg_destroy_decompress(&cinfo);
     return -2;
   }
-  *w = static_cast<int>(cinfo.image_width);
-  *h = static_cast<int>(cinfo.image_height);
+  cinfo.scale_num = static_cast<unsigned int>(scale_num);
+  cinfo.scale_denom = 8;
+  jpeg_calc_output_dimensions(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
   *c = cinfo.num_components >= 3 ? 3 : 1;
   if (out == nullptr) {
     jpeg_destroy_decompress(&cinfo);
@@ -79,6 +87,15 @@ int cxn_jpeg_decode(const unsigned char* src, long len,
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
   return 0;
+}
+
+// Decode a JPEG byte buffer to interleaved RGB (or grayscale) HWC uint8.
+// Returns 0 on success; fills *w,*h,*c. out may be null to only query dims
+// (two-call protocol). out_cap is the byte capacity of out.
+int cxn_jpeg_decode(const unsigned char* src, long len,
+                    unsigned char* out, long out_cap,
+                    int* w, int* h, int* c) {
+  return cxn_jpeg_decode_scaled(src, len, out, out_cap, 8, w, h, c);
 }
 
 // HWC uint8 (rgb or gray) -> CHW float32 with channel replication for gray

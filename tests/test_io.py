@@ -756,3 +756,95 @@ def test_imgbin_chain_with_affine_augmentation(imgbin_dataset, native_lib):
         assert b.data.max() > 1.0 and b.data.min() >= 0.0
         n += 1
     assert n == 4                      # 64 images / 16
+
+
+# ---------------------------------------------------------- decode-at-scale
+def _jpeg_bytes(rs, h=256, w=256):
+    import io as _io
+    from PIL import Image
+    arr = rs.randint(0, 256, (h, w, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+def test_decode_at_scale_dims_and_native_pil_agree():
+    """min_hw picks the coarsest power-of-two libjpeg scale covering the
+    target; the native scaled path and the PIL draft fallback are the
+    same libjpeg reduction and must agree pixel-exactly."""
+    from cxxnet_tpu.io import decoder
+    rs = np.random.RandomState(0)
+    buf = _jpeg_bytes(rs, 256, 256)
+    cases = [((112, 112), 128), ((227, 227), 256), ((64, 64), 64),
+             ((20, 20), 32)]
+    for min_hw, want in cases:
+        out = decoder.decode_jpeg_hwc(buf, min_hw=min_hw)
+        assert out.shape[:2] == (want, want), (min_hw, out.shape)
+        pil = decoder._pil_decode_hwc(buf, min_hw=min_hw)
+        assert pil.shape == out.shape
+        if decoder.have_native():
+            np.testing.assert_array_equal(out, pil)
+    # sources that are NOT multiples of the reduction step: the native
+    # path scales by ceil(dim*n/8) while PIL draft picks its reduction
+    # from the requested size — the floor-dims request keeps them equal
+    for h, w in ((255, 255), (250, 198), (257, 131)):
+        buf = _jpeg_bytes(rs, h, w)
+        out = decoder.decode_jpeg_hwc(buf, min_hw=(64, 64))
+        pil = decoder._pil_decode_hwc(buf, min_hw=(64, 64))
+        assert out.shape == pil.shape, (h, w, out.shape, pil.shape)
+        assert out.shape[0] < h, "scaling should have engaged"
+        if decoder.have_native():
+            np.testing.assert_array_equal(out, pil)
+
+
+def test_decode_at_scale_default_full_size():
+    from cxxnet_tpu.io import decoder
+    rs = np.random.RandomState(1)
+    buf = _jpeg_bytes(rs, 200, 300)
+    out = decoder.decode_jpeg_hwc(buf)
+    assert out.shape[:2] == (200, 300)
+
+
+def test_imgbin_decode_at_scale_chain(tmp_path):
+    """imgbin with decode_at_scale=1 feeds the crop path from the scaled
+    frame; warp-family params must disable it (full-size decode)."""
+    import io as _io
+    from PIL import Image
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.io.binpage import BinaryPageWriter
+    rs = np.random.RandomState(2)
+    lst = tmp_path / "t.lst"
+    binp = tmp_path / "t.bin"
+    with open(lst, "w") as f, BinaryPageWriter(str(binp)) as w:
+        for i in range(8):
+            arr = rs.randint(0, 256, (256, 256, 3), dtype=np.uint8)
+            b = _io.BytesIO()
+            Image.fromarray(arr).save(b, format="JPEG", quality=90)
+            w.push(b.getvalue())
+            f.write("%d\t%d\t%06d.jpg\n" % (i, i % 3, i))
+
+    def chain(extra):
+        return create_iterator([
+            ("iter", "imgbin"),
+            ("image_list", str(lst)), ("image_bin", str(binp)),
+            ("input_shape", "3,112,112"), ("rand_crop", "1"),
+            ("decode_at_scale", "1"), ("silent", "1"),
+        ] + extra + [("iter", "threadbuffer"), ("batch_size", "4"),
+                     ("round_batch", "1")])
+
+    it = chain([])
+    it.before_first()
+    assert it.next()
+    batch = it.value()
+    assert batch.data.shape == (4, 3, 112, 112)
+    if hasattr(it, "close"):
+        it.close()
+
+    # warp param present -> decode_at_scale must be ignored (the warp
+    # geometry is defined on the full source frame): output still valid
+    it2 = chain([("max_rotate_angle", "10")])
+    it2.before_first()
+    assert it2.next()
+    assert it2.value().data.shape == (4, 3, 112, 112)
+    if hasattr(it2, "close"):
+        it2.close()

@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 
 
-def build_dataset(root: str, n: int) -> str:
+def build_dataset(root: str, n: int, src: int = 256) -> str:
     import io as _io
     from PIL import Image
     from cxxnet_tpu.io.binpage import BinaryPageWriter
@@ -34,7 +34,15 @@ def build_dataset(root: str, n: int) -> str:
     rs = np.random.RandomState(0)
     with open(lst, "w") as f, BinaryPageWriter(binp) as w:
         for i in range(n):
-            arr = rs.randint(0, 256, (256, 256, 3), dtype=np.uint8)
+            # photo-like statistics (low-pass noise), not uniform noise:
+            # raw noise maxes out the Huffman entropy decode, which
+            # scale_denom cannot reduce, and inflates every decode cost
+            # ~4x vs natural images — the wrong thing to benchmark
+            from scipy import ndimage as _ndi
+            arr = rs.randint(0, 256, (src, src, 3)).astype(np.float32)
+            arr = _ndi.gaussian_filter(arr, (src / 64.0, src / 64.0, 0))
+            arr = ((arr - arr.min()) / (np.ptp(arr) + 1e-9)
+                   * 255).astype(np.uint8)
             buf = _io.BytesIO()
             Image.fromarray(arr).save(buf, format="JPEG", quality=90)
             w.push(buf.getvalue())
@@ -42,14 +50,16 @@ def build_dataset(root: str, n: int) -> str:
     return root
 
 
-def make_iter(root: str, batch: int, threads: int):
+def make_iter(root: str, batch: int, threads: int, target: int = 227,
+              decode_at_scale: int = 0):
     from cxxnet_tpu.io import create_iterator
     return create_iterator([
         ("iter", "imgbin"),
         ("image_list", os.path.join(root, "train.lst")),
         ("image_bin", os.path.join(root, "train.bin")),
-        ("input_shape", "3,227,227"),
+        ("input_shape", "3,%d,%d" % (target, target)),
         ("rand_crop", "1"), ("rand_mirror", "1"),
+        ("decode_at_scale", str(decode_at_scale)),
         ("decode_threads", str(threads)),
         ("iter", "threadbuffer"),
         ("batch_size", str(batch)),
@@ -58,8 +68,9 @@ def make_iter(root: str, batch: int, threads: int):
     ])
 
 
-def pipeline_rate(root: str, batch: int, threads: int, n_batches: int) -> float:
-    it = make_iter(root, batch, threads)
+def pipeline_rate(root: str, batch: int, threads: int, n_batches: int,
+                  target: int = 227, decode_at_scale: int = 0) -> float:
+    it = make_iter(root, batch, threads, target, decode_at_scale)
     it.before_first()
     it.next()                      # exclude warmup/first-fill
     t0 = time.perf_counter()
@@ -122,6 +133,19 @@ def main() -> int:
         r = pipeline_rate(root, batch, threads, n_batches=max(2, n // batch - 1))
         print("pipeline-only rate, decode_threads=%d: %.0f img/s"
               % (threads, r), flush=True)
+    # decode-at-scale scenarios (one decode thread = per-core number):
+    # a target at or below half the source engages the libjpeg
+    # scale_denom DCT decode (256 -> 112 at 1/2 scale; 512 -> 227 at 1/2)
+    nb = max(2, n // batch - 1)
+    for src, target in ((256, 112), (512, 227)):
+        r2 = build_dataset("/tmp/cxn_pipe_bench_%d" % src, n, src=src)
+        off = pipeline_rate(r2, batch, 1, nb, target=target,
+                            decode_at_scale=0)
+        on = pipeline_rate(r2, batch, 1, nb, target=target,
+                           decode_at_scale=1)
+        print("decode-at-scale %dpx src -> %d crop, 1 thread: "
+              "off %.0f img/s, on %.0f img/s (%.2fx)"
+              % (src, target, off, on, on / max(off, 1e-9)), flush=True)
     train_with_pipeline(root, batch, threads=4)
     return 0
 
