@@ -396,10 +396,13 @@ class LearnTask:
         assert self.itr_pred is not None, "must specify a pred iterator"
         print("start predicting...")
         with open(self.name_pred, "w") as fo:
-            self.itr_pred.before_first()
-            while self.itr_pred.next():
-                batch = self.itr_pred.value()
-                for v in self.net.predict(batch):
+            # double-buffered: each batch's forward dispatches before the
+            # previous batch's outputs are fetched (Net.forward_iter)
+            for out in self.net.forward_iter(self.itr_pred):
+                out = out.reshape(out.shape[0], -1)
+                vals = out[:, 0] if out.shape[1] == 1 \
+                    else np.argmax(out, axis=1).astype(np.float32)
+                for v in vals:
                     fo.write("%g\n" % v)
         print("finished prediction, write into %s" % self.name_pred)
 
@@ -409,10 +412,7 @@ class LearnTask:
         assert node, "must set extract_node_name"
         print("start extracting...")
         rows = []
-        self.itr_pred.before_first()
-        while self.itr_pred.next():
-            batch = self.itr_pred.value()
-            out = self.net.extract_feature(batch, node)
+        for out in self.net.forward_iter(self.itr_pred, node):
             rows.append(out.reshape(out.shape[0], -1))
         feats = np.concatenate(rows, axis=0) if rows else np.zeros((0, 0))
         if self.output_format == 1:

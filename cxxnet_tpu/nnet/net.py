@@ -613,23 +613,66 @@ class Net:
             return ret
         self.eval_metrics.clear()
         uniq = tuple(sorted(set(self._metric_nodes)))
+        # double-buffered: batch k+1's host prep (device_put, label
+        # slicing) and device forward are dispatched BEFORE batch k's
+        # outputs are fetched to the host, so the device computes while
+        # the host prepares — the threaded-inference overlap the
+        # reference got from running eval through the same ThreadBuffer
+        # machinery as training (cxxnet_main.cpp Evaluate path)
         data_iter.before_first()
-        while data_iter.next():
-            batch = data_iter.value()
-            data, extras, _ = self._device_batch(batch)
-            outs = self._jit_forward(self.params, self.states, data, extras,
-                                     uniq)
-            node_to_out = dict(zip(uniq, outs))
-            local_label = self._local_slice(batch.label)
-            n_valid = self._rank_valid(batch)
-            labels = {k: v[:n_valid]
-                      for k, v in self._host_labels(local_label).items()}
-            preds = []
-            for n in self._metric_nodes:
-                out = local_rows(node_to_out[n])
-                preds.append(out.reshape(out.shape[0], -1)[:n_valid])
-            self.eval_metrics.add_eval(preds, labels)
+        pending = None            # (device outs, host labels, n_valid)
+        has = data_iter.next()
+        while has or pending is not None:
+            nxt = None
+            if has:
+                batch = data_iter.value()
+                data, extras, _ = self._device_batch(batch)
+                outs = self._jit_forward(self.params, self.states, data,
+                                         extras, uniq)   # async dispatch
+                local_label = self._local_slice(batch.label)
+                n_valid = self._rank_valid(batch)
+                labels = {k: v[:n_valid]
+                          for k, v in self._host_labels(local_label).items()}
+                nxt = (outs, labels, n_valid)
+            if pending is not None:
+                outs, labels, n_valid = pending
+                node_to_out = dict(zip(uniq, outs))
+                preds = []
+                for n in self._metric_nodes:
+                    out = local_rows(node_to_out[n])     # host fetch
+                    preds.append(out.reshape(out.shape[0], -1)[:n_valid])
+                self.eval_metrics.add_eval(preds, labels)
+            pending = nxt
+            has = data_iter.next() if has else False
         return ret + self.eval_metrics.print(name, reduce=host_psum)
+
+    def forward_iter(self, data_iter, node: Optional[str] = None):
+        """Double-buffered inference generator: yields one host ndarray of
+        node outputs per batch (padded tail rows excluded), overlapping
+        each batch's device forward with the previous fetch — the
+        pipelined pred/extract path (used by the CLI tasks)."""
+        if node is None:
+            nid = self._out_node
+        elif node.startswith("top[-"):
+            nid = self.graph.num_nodes - int(node[len("top[-"):-1])
+        else:
+            nid = self.graph.node_map[node]
+        data_iter.before_first()
+        pending = None            # (device out, n_valid)
+        has = data_iter.next()
+        while has or pending is not None:
+            nxt = None
+            if has:
+                batch = data_iter.value()
+                data, extras, _ = self._device_batch(batch)
+                outs = self._jit_forward(self.params, self.states, data,
+                                         extras, (nid,))
+                nxt = (outs[0], self._rank_valid(batch))
+            if pending is not None:
+                out, n_valid = pending
+                yield local_rows(out)[:n_valid]
+            pending = nxt
+            has = data_iter.next() if has else False
 
     # ------------------------------------------------------------ predict
     def predict(self, batch) -> np.ndarray:

@@ -242,3 +242,38 @@ def test_cli_bf16_injects_pipeline_dtype(synth_mnist, tmp_path, capfd):
     task.itr_evals[0].before_first()
     assert task.itr_evals[0].next()
     assert task.itr_evals[0].value().data.dtype == ml_dtypes.bfloat16
+
+
+def test_forward_iter_matches_per_batch_predict(synth_mnist, tmp_path):
+    """The double-buffered forward_iter must yield exactly what the
+    per-batch predict/extract calls produced (pipelining must not change
+    values, order, or padded-tail exclusion)."""
+    conf = tmp_path / "m.conf"
+    conf.write_text(CONF.format(d=synth_mnist, md=tmp_path / "models"))
+    task = LearnTask()
+    task.run([str(conf), "num_round=1", "max_round=1"])
+    net = task.net
+    it = create_iterator([
+        ("iter", "mnist"),
+        ("path_img", "%s/test-img.gz" % synth_mnist),
+        ("path_label", "%s/test-lab.gz" % synth_mnist),
+        ("iter", "threadbuffer"),
+        ("batch_size", "48"), ("round_batch", "1"), ("label_width", "1"),
+        ("input_shape", "1,1,64"),
+    ])
+    it.init()
+
+    serial = []
+    it.before_first()
+    while it.next():
+        serial.append(net.predict(it.value()))
+    piped = []
+    for out in net.forward_iter(it):
+        out = out.reshape(out.shape[0], -1)
+        piped.append(out[:, 0] if out.shape[1] == 1
+                     else np.argmax(out, axis=1).astype(np.float32))
+    assert len(serial) == len(piped)
+    for a, b in zip(serial, piped):
+        np.testing.assert_array_equal(a, b)
+    if hasattr(it, "close"):
+        it.close()

@@ -1,0 +1,90 @@
+"""Eval/predict throughput: device-resident forward rate + pipelined
+evaluate() overlap.
+
+Two numbers, mirroring bench.py's convention for train:
+
+1. device-resident eval forward (steady state of a prefetching pipeline,
+   host-fetch barrier) -> eval img/s to quote next to the train img/s;
+2. evaluate() end-to-end through an in-memory iterator — on THIS rig the
+   host->device tunnel dominates (same caveat as pipeline-fed train), so
+   the interesting part is the overlap structure, not the absolute rate.
+
+Usage: python tools/eval_bench.py [batch=1024]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("LIBTPU_INIT_ARGS",
+                      "--xla_tpu_scoped_vmem_limit_kib=65536")
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.models import alexnet_config
+    from cxxnet_tpu.utils.config import tokenize
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    net = Net(tokenize(alexnet_config(batch_size=batch, dev="",
+                                      precision="bfloat16")))
+    net.init_model()
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch, 3, 227, 227).astype(np.float32)
+    y = rs.randint(0, 1000, (batch, 1)).astype(np.float32)
+
+    class _B:
+        data, label, extra_data = x, y, []
+        num_batch_padd = 0
+
+    import ml_dtypes
+    _B.data = _B.data.astype(ml_dtypes.bfloat16)
+    data, extras, _ = net._device_batch(_B())
+    uniq = (net._out_node,)
+
+    # 1. device-resident eval forward
+    for _ in range(3):
+        (out,) = net._jit_forward(net.params, net.states, data, extras, uniq)
+    float(np.asarray(out).reshape(-1)[0])   # barrier
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (out,) = net._jit_forward(net.params, net.states, data, extras, uniq)
+    float(np.asarray(out).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    print("device-resident eval forward: %.0f img/s (%.1f ms/batch of %d)"
+          % (steps * batch / dt, dt / steps * 1e3, batch))
+
+    # 2. evaluate() end-to-end (tunnel-bound on this rig; shows overlap)
+    class MemIter:
+        def __init__(self, n):
+            self.n = n
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self):
+            self.i += 1
+            return self.i <= self.n
+
+        def value(self):
+            return _B()
+
+    net.eval_metrics = net.eval_metrics  # metrics configured by the conf
+    it = MemIter(6)
+    t0 = time.perf_counter()
+    line = net.evaluate(it, "bench")
+    dt = time.perf_counter() - t0
+    print("evaluate() end-to-end: %.0f img/s over 6 host-fed batches%s"
+          % (6 * batch / dt, line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
