@@ -426,19 +426,27 @@ def _flash_resident(n: int, d: int) -> bool:
     return n * max(d, 1) <= _FLASH_RESIDENT_MAX * 64
 
 
-def _flash_block(n: int, req) -> int:
-    """Resolve a block-size request: explicit sizes are clamped to n; the
-    default (None) picks 512 when the sequence is a multiple of 512 —
-    measured ~35% faster fwd+bwd than 256 on one v5e chip at seq 1024
-    and 4096 (doc/performance.md) — else 256 (the alignment
-    local_attention dispatches on). 1024-row blocks compile on the
-    current toolchain and win the ISOLATED kernel micro by 6-8%, but
-    measured SLOWER inside the full rematerialized GPT step (437 vs 422
-    ms @ 303M) — coarser blocks serialize against the surrounding
-    fusions — so 512 stays the default; pass block_q/block_k explicitly
-    to override."""
+def _flash_block(n: int, req, d: int = 64) -> int:
+    """Resolve a block-size request: explicit sizes are clamped to n.
+
+    Default (None), by measurement on one v5e chip (doc/performance.md):
+    - RESIDENT family (K/V whole in VMEM): 512 when the sequence divides
+      it (~35% over 256 at seq 1024/4096), else 256. 1024-row blocks win
+      the isolated micro 6-8% but measured SLOWER inside the full
+      rematerialized GPT step (437 vs 422 ms @ 303M d64; 277.5 vs 276.6
+      @ 305M d128) — coarser blocks serialize against the surrounding
+      fusions.
+    - STREAMING family (long sequences, K/V blocks as a grid dim):
+      1024x1024 wins decisively — 85M d64 @ 4x8192: 661 vs 891 ms/step
+      (+35% tok/s); 305M-class d128 @ 4x4096: 355 vs 391 ms; @ 2x8192:
+      419 vs 504 ms (+20%). Larger k-blocks amortize the per-block
+      scratch-accumulator round trips that the resident family does not
+      have.
+    Pass block_q/block_k explicitly to override."""
     if req is not None:
         return min(req, n)
+    if not _flash_resident(n, d) and n % 1024 == 0:
+        return 1024
     return 512 if n >= 512 and n % 512 == 0 else min(256, n)
 
 
@@ -472,8 +480,8 @@ def _flash_fwd_bhnd(qt, kt, vt, causal: bool, block_q, block_k,
     lse (b,h,n,1)) with no layout copies."""
     b, h, n, d = qt.shape
     scale = 1.0 / (d ** 0.5)
-    bq = _flash_block(n, block_q)
-    bk = _flash_block(n, block_k)
+    bq = _flash_block(n, block_q, d)
+    bk = _flash_block(n, block_k, d)
     _check_flash_divisible(n, bq, bk)
     if _flash_resident(n, d):
         kern = functools.partial(_flash_kernel_res, block_k=bk,
@@ -672,8 +680,8 @@ def _flash_bwd_bhnd(qt, kt, vt, lse, delta, dot, causal, block_q, block_k,
     (b, h, n, 1)); returns (dq, dk, dv) in the same layout — no copies."""
     b, h, n, d = qt.shape
     scale = 1.0 / (d ** 0.5)
-    bq = _flash_block(n, block_q)
-    bk = _flash_block(n, block_k)
+    bq = _flash_block(n, block_q, d)
+    bk = _flash_block(n, block_k, d)
     _check_flash_divisible(n, bq, bk)
     if _flash_resident(n, d):
         blk_qd = pl.BlockSpec((1, 1, bq, d), lambda i, j, s: (i, j, s, 0))
@@ -1155,8 +1163,8 @@ def _flash_bwd_bhnd_packed(qo, kv, lse, g, causal, block_q, block_k):
     b, h, n, d2 = qo.shape
     d = d2 // 2
     scale = 1.0 / (d ** 0.5)
-    bq = _flash_block(n, block_q)
-    bk = _flash_block(n, block_k)
+    bq = _flash_block(n, block_q, d)
+    bk = _flash_block(n, block_k, d)
     _check_flash_divisible(n, bq, bk)
     blk_qo = pl.BlockSpec((1, 1, bq, d2), lambda i, j, s: (i, j, s, 0))
     blk_kv = pl.BlockSpec((1, 1, bk, d2), lambda i, j, s: (i, j, s, 0))
