@@ -45,6 +45,19 @@ def main() -> int:
                     help="Adam instead of momentum SGD")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks in backward (less HBM)")
+    ap.add_argument("--remat-mode", default="block",
+                    choices=["block", "attn_saved"],
+                    help="remat boundary (attn_saved wins at d>=128 scale)")
+    ap.add_argument("--attn-layout", default="auto",
+                    choices=["auto", "bnhd", "bhnd"],
+                    help="kernel-boundary layout (auto: head-major when "
+                         "head_dim >= 128 and no --sp)")
+    ap.add_argument("--sp-mode", default="ring",
+                    choices=["ring", "ulysses"],
+                    help="sequence-parallel attention variant")
+    ap.add_argument("--zero", type=int, default=0, choices=[0, 1, 3],
+                    help="ZeRO level: 1 shards optimizer state over data, "
+                         "3 also shards params (FSDP)")
     ap.add_argument("--ckpt", default="",
                     help="checkpoint dir: resume from it if present, save "
                          "into it at the end (sharded orbax format; works "
@@ -67,7 +80,9 @@ def main() -> int:
                     n_head=args.heads, feat=args.feat,
                     n_microbatch=args.microbatch,
                     dtype="bfloat16" if args.bf16 else "float32",
-                    remat=args.remat)
+                    remat=args.remat, remat_mode=args.remat_mode,
+                    attn_layout=args.attn_layout,
+                    seq_parallel_mode=args.sp_mode)
     optname = "adam" if args.adam else "sgd"
     if args.eta is None:
         args.eta = 0.003 if args.adam else 0.1
@@ -76,8 +91,9 @@ def main() -> int:
                      seq_parallel=args.sp, model_parallel=args.tp)
     print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
 
-    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
-    opt = gpt_opt_init(params, mesh, optname)
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh,
+                       zero=args.zero)
+    opt = gpt_opt_init(params, mesh, optname, zero=args.zero)
     if args.ckpt and os.path.isdir(args.ckpt):
         from cxxnet_tpu.utils import checkpoint
         try:
@@ -93,7 +109,8 @@ def main() -> int:
                 "stored the key 'mom')" % (args.ckpt, e, optname)) from e
         params, opt = state["params"], state["opt"]
         print("resumed from %s" % args.ckpt)
-    step = make_train_step(cfg, mesh, eta=args.eta, optimizer=optname)
+    step = make_train_step(cfg, mesh, eta=args.eta, optimizer=optname,
+                           zero=args.zero)
 
     rs = np.random.RandomState(0)
     n_tok = args.batch * args.seq
