@@ -398,3 +398,25 @@ def test_gpt_ulysses_head_divisibility_validated():
     ids = jnp.zeros((2, CFG.seq_len), jnp.int32)
     with pytest.raises(ValueError, match="ulysses"):
         gpt_loss(params, ids, cfg, mesh)
+
+
+def test_gpt_ulysses_composes_with_tp():
+    """ulysses under sp2 x tp2: the head shards split over tp first, then
+    the all-to-all re-shards the LOCAL heads over seq — losses must match
+    a single device."""
+    import dataclasses
+    cfg_u = dataclasses.replace(CFG, seq_parallel_mode="ulysses")
+
+    def run(mesh, cfg):
+        params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+        mom = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+        step = make_train_step(cfg, mesh)
+        out = []
+        for i in range(3):
+            params, mom, loss = step(params, mom, _ids(i))
+            out.append(float(loss))
+        return out
+
+    ref = run(make_mesh("cpu:0"), cfg_u)
+    par = run(make_mesh("cpu:0-7", seq_parallel=2, model_parallel=2), cfg_u)
+    np.testing.assert_allclose(par, ref, rtol=2e-4, atol=2e-4)

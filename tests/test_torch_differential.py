@@ -187,3 +187,73 @@ def test_whole_net_loss_trajectory_matches_torch():
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=2e-3, atol=2e-3,
                                     err_msg=k)
+
+
+def _adam_lr():
+    return 0.002
+
+
+def _torch_adam_step(model, state, step):
+    """The reference Adam (adam_updater-inl.hpp:16-83): one-minus decay
+    convention (decay1=0.1 == beta1=0.9), weight decay entering the
+    gradient as ``grad -= wd*w`` (sign quirk), lr schedule IGNORED
+    (recomputed from base lr each step) — reproduced manually."""
+    d1, d2, eps = 0.1, 0.001, 1e-8
+    lr = _adam_lr()
+    with torch.no_grad():
+        fix1 = 1.0 - (1.0 - d1) ** (step + 1)
+        fix2 = 1.0 - (1.0 - d2) ** (step + 1)
+        lr_t = lr * (fix2 ** 0.5) / fix1
+        for name, p in model.named_parameters():
+            g = p.grad - WD * p                   # reference sign quirk
+            m1, m2 = state[name]
+            m1 += d1 * (g - m1)
+            m2 += d2 * (g * g - m2)
+            p -= lr_t * m1 / (m2.sqrt() + eps)
+
+
+def test_whole_net_adam_trajectory_matches_torch():
+    """Same whole-path check with the Adam updater: pins the one-minus
+    decay convention, the wd sign quirk, bias correction from the
+    0-based update count, and the ignored lr schedule — composed with
+    conv+BN+pool+fc and the loss scaling."""
+    conf = CONF.replace("updater = sgd", "updater = adam") \
+        + "\neta = %g\n" % _adam_lr()
+    rs = np.random.RandomState(1)
+    protos = rs.randn(10, 1, 8, 8).astype(np.float32)
+
+    def batch(i):
+        r = np.random.RandomState(300 + i)
+        y = r.randint(0, 10, BATCH)
+        x = (protos[y] + r.randn(BATCH, 1, 8, 8) * 0.5).astype(np.float32)
+        return x, y
+
+    torch.manual_seed(11)
+    model = TorchNet()
+    model.train()
+    state = {n: (torch.zeros_like(p), torch.zeros_like(p))
+             for n, p in model.named_parameters()}
+
+    net = Net(tokenize(conf))
+    net.init_model()
+    _export_weights(model, net)
+
+    ours, theirs = [], []
+    for i in range(30):
+        x, y = batch(i)
+        probs = net.extract_feature(
+            DataBatch(x, y[:, None].astype(np.float32)),
+            "top[-1]").reshape(BATCH, 10)
+        ours.append(float(-np.mean(np.log(probs[np.arange(BATCH), y]
+                                          + 1e-12))))
+        net.update(DataBatch(x, y[:, None].astype(np.float32)))
+
+        loss = torch.nn.functional.cross_entropy(
+            model(torch.from_numpy(x)), torch.from_numpy(y).long())
+        theirs.append(float(loss.detach()))
+        model.zero_grad()
+        loss.backward()
+        _torch_adam_step(model, state, i)
+
+    np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-3)
+    assert theirs[-1] < theirs[0] * 0.5, theirs
