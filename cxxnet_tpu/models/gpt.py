@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import (local_attention, local_attention_bhnd,
                              ring_attention_inner,
+                             ring_attention_inner_bhnd,
                              ulysses_attention_inner)
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                              batch_sharding)
@@ -80,7 +81,8 @@ class GPTConfig:
     #                             bhnd a net LOSS (448 vs 422 ms @ 303M,
     #                             round 2); at head_dim 128 they are
     #                             lane-native. "auto" picks by measurement:
-    #                             bhnd iff head_dim >= 128 (and not ring).
+    #                             bhnd iff head_dim >= 128 (composes with
+    #                             the head-major ring; ulysses keeps bnhd).
     remat_mode: str = "block"   # "block": whole-block remat (max memory
     #                             savings — the long-context mode) — the
     #                             DEFAULT, and measured fastest or tied at
@@ -203,11 +205,15 @@ def _train_attn(q, k, v, use_ring: bool, sp_mode: str = "ring"):
     return checkpoint_name(att, "attn_out"), None
 
 
-def _train_attn_bhnd(q, k, v):
-    """Head-major training attention (single-shard sequences only: the
-    ring path owns the bnhd layout because K/V chunks rotate along dim 1)."""
-    return checkpoint_name(local_attention_bhnd(q, k, v, causal=True),
-                           "attn_out")
+def _train_attn_bhnd(q, k, v, use_ring: bool = False):
+    """Head-major training attention; with sequence parallelism the
+    head-major ring rotates K/V chunks along dim 2 (zero layout copies
+    through the whole ring — round 3)."""
+    if use_ring:
+        att = ring_attention_inner_bhnd(q, k, v, SEQ_AXIS, causal=True)
+    else:
+        att = local_attention_bhnd(q, k, v, causal=True)
+    return checkpoint_name(att, "attn_out")
 
 
 def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
@@ -218,7 +224,10 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
     model axis it is the identity, and demotes the vma type)."""
     reduce = lambda t: lax.psum(t, MODEL_AXIS)
     if layout == "bhnd":
-        h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
+        h = _attn_core_bhnd(p, h, n_head_local,
+                            lambda q, k, v: _train_attn_bhnd(q, k, v,
+                                                             use_ring),
+                            reduce)
         return _mlp_core(p, h, reduce)
     out, _ = _block_core(p, h, n_head_local,
                          lambda q, k, v: _train_attn(q, k, v, use_ring,
@@ -254,7 +263,10 @@ def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
     trade-off is scale-dependent."""
     reduce = lambda t: lax.psum(t, MODEL_AXIS)
     if layout == "bhnd":
-        h = _attn_core_bhnd(p, h, n_head_local, _train_attn_bhnd, reduce)
+        h = _attn_core_bhnd(p, h, n_head_local,
+                            lambda q, k, v: _train_attn_bhnd(q, k, v,
+                                                             use_ring),
+                            reduce)
     else:
         h, _ = _attn_core(p, h, n_head_local,
                           lambda q, k, v: _train_attn(q, k, v, use_ring,
@@ -405,13 +417,17 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     layout = cfg.attn_layout
     if layout == "auto":
         # measured rule (doc/performance.md round 3): head-major wins when
-        # the per-head projection width is lane-native (d >= 128); the ring
-        # path keeps bnhd (its K/V rotation is along the seq dim)
-        layout = ("bhnd" if cfg.feat // cfg.n_head >= 128 and not use_ring
+        # the per-head projection width is lane-native (d >= 128). The
+        # ring composes (head-major ring core); ulysses keeps bnhd (its
+        # all-to-all re-shards the head dim of token-major tensors)
+        layout = ("bhnd" if cfg.feat // cfg.n_head >= 128
+                  and not (use_ring and cfg.seq_parallel_mode == "ulysses")
                   else "bnhd")
-    if layout == "bhnd" and use_ring:
-        raise ValueError("attn_layout='bhnd' is incompatible with sequence "
-                         "parallelism (ring attention owns the bnhd layout)")
+    if layout == "bhnd" and use_ring and cfg.seq_parallel_mode == "ulysses":
+        raise ValueError("attn_layout='bhnd' is incompatible with "
+                         "seq_parallel_mode='ulysses' (the ulysses "
+                         "all-to-all owns the token-major layout); use "
+                         "ring or bnhd")
     h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
     kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=use_ring,
               layout=layout, sp_mode=cfg.seq_parallel_mode)

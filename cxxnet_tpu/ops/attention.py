@@ -91,25 +91,27 @@ def local_attention_bhnd(q, k, v, causal: bool = False) -> jnp.ndarray:
 
 
 def _block(q, k, v, o, m, l, causal, q_off, k_off):
-    """One online-softmax accumulation step over a K/V block.
+    """One online-softmax accumulation step over a K/V block, head-major.
 
-    q: (b, nq, h, d); k/v: (b, nk, h, d); o: (b, nq, h, d) f32;
-    m/l: (b, h, nq) f32 running max / normalizer.
+    q: (b, h, nq, d); k/v: (b, h, nk, d); o: (b, h, nq, d) f32;
+    m/l: (b, h, nq) f32 running max / normalizer. (Round 3 moved the
+    whole ring core to the flash kernels' native (b, h, n, d) layout —
+    the merges need no transposes and the kernel chunks no copies.)
     """
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
-        qpos = q_off + jnp.arange(q.shape[1])[:, None]
-        kpos = k_off + jnp.arange(k.shape[1])[None, :]
+        qpos = q_off + jnp.arange(q.shape[2])[:, None]
+        kpos = k_off + jnp.arange(k.shape[2])[None, :]
         s = jnp.where(qpos >= kpos, s, _NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])            # (b,h,q,k) f32
     l_new = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
-    o_new = o * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
+    o_new = o * corr[..., None] + pv
     return o_new, m_new, l_new
 
 
@@ -152,14 +154,15 @@ def _ring_vary(x, q, k, axis_name):
 
 
 def _ring_fwd_pass(q, k, v, axis_name, causal):
-    """One forward ring rotation. Returns (out (b,n,h,d), lse (b,h,n)) —
-    lse = max + log(sum) of the scaled logits, the backward's residual."""
+    """One forward ring rotation, head-major. q/k/v (b, h, n_local, d).
+    Returns (out (b,h,n,d), lse (b,h,n)) — lse = max + log(sum) of the
+    scaled logits, the backward's residual."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
-    n_local = q.shape[1]
-    b, _, h, dd = q.shape
+    n_local = q.shape[2]
+    b, h, _, dd = q.shape
 
-    o0 = _ring_vary(jnp.zeros((b, n_local, h, dd), jnp.float32), q, k, axis_name)
+    o0 = _ring_vary(jnp.zeros((b, h, n_local, dd), jnp.float32), q, k, axis_name)
     m0 = _ring_vary(jnp.full((b, h, n_local), _NEG_INF, jnp.float32), q, k, axis_name)
     l0 = _ring_vary(jnp.zeros((b, h, n_local), jnp.float32), q, k, axis_name)
 
@@ -172,13 +175,13 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
         # flash-kernel chunk: compute (o_c, lse_c) for this (q, chunk)
         # pair and fold it into the running (o, m, l) accumulators. The
         # causal mask across chunks is one of three whole-chunk cases.
-        from .pallas_kernels import flash_fwd_with_lse
+        from .pallas_kernels import flash_fwd_with_lse_bhnd
 
         def chunk_full(_):
-            return flash_fwd_with_lse(q, kk, vv, False)
+            return flash_fwd_with_lse_bhnd(q, kk, vv, False)
 
         def chunk_diag(_):
-            return flash_fwd_with_lse(q, kk, vv, True)
+            return flash_fwd_with_lse_bhnd(q, kk, vv, True)
 
         def chunk_skip(_):
             # f32 to match the kernels' f32 partial outputs across branches
@@ -193,9 +196,8 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
         m_new = jnp.maximum(m, lse_c)
         w_acc = jnp.exp(m - m_new)                    # (b, h, nq)
         w_c = jnp.exp(lse_c - m_new)
-        o = (o * jnp.transpose(w_acc, (0, 2, 1))[..., None]
-             + o_c.astype(jnp.float32)
-             * jnp.transpose(w_c, (0, 2, 1))[..., None])
+        o = (o * w_acc[..., None]
+             + o_c.astype(jnp.float32) * w_c[..., None])
         return o, m_new, l * w_acc + w_c
 
     def step(i, carry):
@@ -214,8 +216,7 @@ def _ring_fwd_pass(q, k, v, axis_name, causal):
                                     (o0, m0, l0, k, v))
     last_shard = (my_idx + axis_size - 1) % axis_size
     o, m, l = accumulate(last_shard, o, m, l, kk, vv)
-    norm = jnp.transpose(l, (0, 2, 1))[..., None]      # (b, nq, h, 1)
-    out = (o / jnp.maximum(norm, 1e-30)).astype(q.dtype)
+    out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     return out, lse
 
@@ -238,15 +239,16 @@ def _ring_inner_bwd(axis_name, causal, res, g):
     gradient has collected contributions from every query shard and is back
     on its home device. O(n_local) residual memory — reverse-mode AD
     through the forward loop would instead save every rotated chunk and
-    every per-step probability matrix (O(P * n_local^2))."""
+    every per-step probability matrix (O(P * n_local^2)). Head-major
+    (b, h, n, d) throughout — zero layout copies at the kernel chunks."""
     q, k, v, out, lse = res
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
-    n_local = q.shape[1]
+    n_local = q.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    do = g.astype(jnp.float32)                         # (b, nq, h, d)
-    # softmax-grad correction: rowsum(dO * O), in lse's (b, h, nq) layout
-    delta = jnp.einsum("bqhd,bqhd->bhq", do, out.astype(jnp.float32))
+    do = g.astype(jnp.float32)                         # (b, h, nq, d)
+    # softmax-grad correction: rowsum(dO * O), (b, h, nq)
+    delta = jnp.einsum("bhqd,bhqd->bhq", do, out.astype(jnp.float32))
 
     dq0 = _ring_vary(jnp.zeros(q.shape, jnp.float32), q, k, axis_name)
     dk0 = _ring_vary(jnp.zeros(k.shape, jnp.float32), q, k, axis_name)
@@ -260,16 +262,16 @@ def _ring_inner_bwd(axis_name, causal, res, g):
         if use_kernels:
             # blockwise kernels with the *global* lse/delta: p = exp(s -
             # lse) is globally normalized, so each chunk's grads are its
-            # exact contribution (pallas_kernels.flash_bwd_blocks)
-            from .pallas_kernels import flash_bwd_blocks
+            # exact contribution (pallas_kernels.flash_bwd_blocks_bhnd)
+            from .pallas_kernels import flash_bwd_blocks_bhnd
 
             def chunk_full(_):
-                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, False,
-                                        out_dtype=jnp.float32)
+                return flash_bwd_blocks_bhnd(q, kk, vv, lse, delta, g_in,
+                                             False, out_dtype=jnp.float32)
 
             def chunk_diag(_):
-                return flash_bwd_blocks(q, kk, vv, lse, delta, g_in, True,
-                                        out_dtype=jnp.float32)
+                return flash_bwd_blocks_bhnd(q, kk, vv, lse, delta, g_in,
+                                             True, out_dtype=jnp.float32)
 
             def chunk_skip(_):
                 return (jnp.zeros(q.shape, jnp.float32),
@@ -280,21 +282,21 @@ def _ring_inner_bwd(axis_name, causal, res, g):
                                            chunk_full, chunk_diag,
                                            chunk_skip)
             return dq + dq_c, dk + dk_c, dv + dv_c
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
                        preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = my_idx * n_local + jnp.arange(n_local)[:, None]
             kpos = k_shard * n_local + jnp.arange(n_local)[None, :]
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])                # exact probabilities
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do, vv,
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vv,
                         preferred_element_type=jnp.float32)
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kk,
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kk,
                              preferred_element_type=jnp.float32)
-        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, q,
                              preferred_element_type=jnp.float32)
-        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, do,
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, do,
                              preferred_element_type=jnp.float32)
         return dq, dk, dv
 
@@ -323,14 +325,28 @@ def _ring_inner_bwd(axis_name, causal, res, g):
 _ring_inner.defvjp(_ring_inner_fwd, _ring_inner_bwd)
 
 
+def ring_attention_inner_bhnd(q, k, v, axis_name: str = "seq",
+                              causal: bool = False):
+    """Head-major ring attention for use INSIDE an existing shard_map:
+    q,k,v are local (b, h, n_local, d) shards of a sequence sharded over
+    ``axis_name`` — the flash kernels' native layout, so a caller that
+    projects head-major (e.g. the GPT ``attn_layout="bhnd"`` block) pays
+    zero layout copies through the whole ring. Custom VJP: the backward
+    is a second ring pass recomputing probabilities from the saved
+    log-sum-exp."""
+    return _ring_inner(q, k, v, axis_name, causal)
+
+
 def ring_attention_inner(q, k, v, axis_name: str = "seq",
                          causal: bool = False):
     """Ring attention for use INSIDE an existing shard_map (e.g. a gpipe
     block): q,k,v are the local (b, n_local, h, d) shards of a sequence
     sharded over ``axis_name``. ``ring_attention`` wraps this in its own
-    shard_map for standalone use. Custom VJP: the backward is a second
-    ring pass recomputing probabilities from the saved log-sum-exp."""
-    return _ring_inner(q, k, v, axis_name, causal)
+    shard_map for standalone use. The core runs head-major (one transpose
+    in, one out — round 2 paid three per ring step); token-major callers
+    keep this entry, head-major ones use ring_attention_inner_bhnd."""
+    tr = lambda t: jnp.transpose(t, (0, 2, 1, 3))
+    return tr(_ring_inner(tr(q), tr(k), tr(v), axis_name, causal))
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -647,6 +647,25 @@ def flash_fwd_with_lse(q, k, v, causal: bool, block_q=None,
     return out, lse[..., 0]
 
 
+def flash_fwd_with_lse_bhnd(q, k, v, causal: bool, block_q=None,
+                            block_k=None):
+    """Head-major chunk forward for ring attention: q,k,v (b, h, n, d) ->
+    (out (b, h, n, d) f32, lse (b, h, n)) with NO layout copies — the
+    kernels' native layout end to end."""
+    out, lse = _flash_fwd_bhnd(q, k, v, causal, block_q, block_k,
+                               out_dtype=jnp.float32)
+    return out, lse[..., 0]
+
+
+def flash_bwd_blocks_bhnd(q, k, v, lse, delta, g, causal: bool,
+                          block_q=None, block_k=None, out_dtype=None):
+    """Head-major blockwise dq/dk/dv for ring chunks: all tensors
+    (b, h, n, d), lse/delta (b, h, n) f32 (possibly from a GLOBAL softmax
+    spanning more chunks than k). No layout copies."""
+    return _flash_bwd_bhnd(q, k, v, lse[..., None], delta[..., None], g,
+                           causal, block_q, block_k, out_dtype)
+
+
 def flash_bwd_blocks(q, k, v, lse, delta, g, causal: bool,
                      block_q=None, block_k=None,
                      out_dtype=None):

@@ -325,13 +325,9 @@ def test_attn_layout_validated():
     ids = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(ValueError, match="attn_layout"):
         gpt_loss(params, ids, cfg, mesh)
-    # explicit bhnd + sequence parallelism is a contradiction: the ring
-    # rotates K/V chunks along the sequence dim of (b, n, h, d) shards
-    cfg2 = GPTConfig(vocab_size=61, seq_len=16, n_layer=1, n_head=2,
-                     feat=32, attn_layout="bhnd")
-    mesh2 = make_mesh("cpu:0-7", seq_parallel=2)
-    with pytest.raises(ValueError, match="bhnd"):
-        gpt_loss(params, ids, cfg2, mesh2)
+    # bhnd + RING sequence parallelism is a supported composition since
+    # the head-major ring core (test_attn_layout_bhnd_composes_with_ring);
+    # only bhnd + ulysses is rejected (test_attn_layout_bhnd_ulysses_rejected)
 
 
 def test_gpt_zero3_pp2_matches_single_device():
@@ -420,3 +416,37 @@ def test_gpt_ulysses_composes_with_tp():
     ref = run(make_mesh("cpu:0"), cfg_u)
     par = run(make_mesh("cpu:0-7", seq_parallel=2, model_parallel=2), cfg_u)
     np.testing.assert_allclose(par, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_attn_layout_bhnd_composes_with_ring():
+    """The head-major ring core: bhnd layout + sequence parallelism must
+    match the token-major ring and the single-device run."""
+    import dataclasses
+    cfg_b = dataclasses.replace(CFG, attn_layout="bhnd")
+    cfg_n = dataclasses.replace(CFG, attn_layout="bnhd")
+
+    def run(mesh, cfg):
+        params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+        mom = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+        step = make_train_step(cfg, mesh)
+        out = []
+        for i in range(3):
+            params, mom, loss = step(params, mom, _ids(i))
+            out.append(float(loss))
+        return out
+
+    ref = run(make_mesh("cpu:0"), cfg_n)
+    mesh = make_mesh("cpu:0-7", seq_parallel=2, model_parallel=2)
+    np.testing.assert_allclose(run(mesh, cfg_b), ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(run(mesh, cfg_n), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_attn_layout_bhnd_ulysses_rejected():
+    import dataclasses
+    cfg = dataclasses.replace(CFG, attn_layout="bhnd",
+                              seq_parallel_mode="ulysses")
+    mesh = make_mesh("cpu:0-7", seq_parallel=2)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    with pytest.raises(ValueError, match="ulysses"):
+        gpt_loss(params, ids, cfg, mesh)
