@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.attention import (local_attention, local_attention_bhnd,
                              ring_attention_inner,
                              ring_attention_inner_bhnd,
-                             ulysses_attention_inner)
+                             ulysses_attention_inner,
+                             ulysses_attention_inner_bhnd)
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                              batch_sharding)
 from ..parallel.pipeline import gpipe
@@ -205,12 +206,18 @@ def _train_attn(q, k, v, use_ring: bool, sp_mode: str = "ring"):
     return checkpoint_name(att, "attn_out"), None
 
 
-def _train_attn_bhnd(q, k, v, use_ring: bool = False):
+def _train_attn_bhnd(q, k, v, use_ring: bool = False,
+                     sp_mode: str = "ring"):
     """Head-major training attention; with sequence parallelism the
-    head-major ring rotates K/V chunks along dim 2 (zero layout copies
-    through the whole ring — round 3)."""
+    head-major ring rotates K/V chunks along dim 2, or head-major
+    ulysses all-to-alls the head dim — zero layout copies either way
+    (round 3)."""
     if use_ring:
-        att = ring_attention_inner_bhnd(q, k, v, SEQ_AXIS, causal=True)
+        if sp_mode == "ulysses":
+            att = ulysses_attention_inner_bhnd(q, k, v, SEQ_AXIS,
+                                               causal=True)
+        else:
+            att = ring_attention_inner_bhnd(q, k, v, SEQ_AXIS, causal=True)
     else:
         att = local_attention_bhnd(q, k, v, causal=True)
     return checkpoint_name(att, "attn_out")
@@ -226,7 +233,8 @@ def _block(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *, n_head_local: int,
     if layout == "bhnd":
         h = _attn_core_bhnd(p, h, n_head_local,
                             lambda q, k, v: _train_attn_bhnd(q, k, v,
-                                                             use_ring),
+                                                             use_ring,
+                                                             sp_mode),
                             reduce)
         return _mlp_core(p, h, reduce)
     out, _ = _block_core(p, h, n_head_local,
@@ -265,7 +273,8 @@ def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
     if layout == "bhnd":
         h = _attn_core_bhnd(p, h, n_head_local,
                             lambda q, k, v: _train_attn_bhnd(q, k, v,
-                                                             use_ring),
+                                                             use_ring,
+                                                             sp_mode),
                             reduce)
     else:
         h, _ = _attn_core(p, h, n_head_local,
@@ -417,17 +426,11 @@ def gpt_logits(params: Dict, ids: jnp.ndarray, cfg: GPTConfig,
     layout = cfg.attn_layout
     if layout == "auto":
         # measured rule (doc/performance.md round 3): head-major wins when
-        # the per-head projection width is lane-native (d >= 128). The
-        # ring composes (head-major ring core); ulysses keeps bnhd (its
-        # all-to-all re-shards the head dim of token-major tensors)
-        layout = ("bhnd" if cfg.feat // cfg.n_head >= 128
-                  and not (use_ring and cfg.seq_parallel_mode == "ulysses")
-                  else "bnhd")
-    if layout == "bhnd" and use_ring and cfg.seq_parallel_mode == "ulysses":
-        raise ValueError("attn_layout='bhnd' is incompatible with "
-                         "seq_parallel_mode='ulysses' (the ulysses "
-                         "all-to-all owns the token-major layout); use "
-                         "ring or bnhd")
+        # the per-head projection width is lane-native (d >= 128); both
+        # sequence-parallel variants have head-major cores, so the rule
+        # is layout-only
+        layout = "bhnd" if cfg.feat // cfg.n_head >= 128 else "bnhd"
+
     h = (params["emb"][ids] + params["pos"][None, :ids.shape[1]]).astype(dtype)
     kw = dict(n_head_local=cfg.n_head // max(n_tp, 1), use_ring=use_ring,
               layout=layout, sp_mode=cfg.seq_parallel_mode)

@@ -427,6 +427,33 @@ def ulysses_attention_inner(q, k, v, axis_name: str = "seq",
     return heads_to_seq(out)
 
 
+def ulysses_attention_inner_bhnd(q, k, v, axis_name: str = "seq",
+                                 causal: bool = False):
+    """Head-major ulysses for use INSIDE a shard_map: q,k,v local
+    (b, h, n_local, d) shards. The all-to-alls split the head dim (1) and
+    concat the seq dim (2); the local full-sequence attention runs in the
+    flash kernels' native layout with zero copies."""
+    p = lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % p:
+        raise ValueError(
+            "ulysses attention: %d heads must divide over the %r axis "
+            "(size %d); use ring attention instead" % (h, axis_name, p))
+
+    def seq_to_heads(t):
+        # (b, h, n/P, d) -> (b, h/P, n, d)
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = local_attention_bhnd(seq_to_heads(q), seq_to_heads(k),
+                               seq_to_heads(v), causal=causal)
+    return heads_to_seq(out)
+
+
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, axis_name: str = "seq",
                       causal: bool = False,
@@ -456,5 +483,6 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 __all__ = ["full_attention", "local_attention", "ring_attention",
-           "ring_attention_inner", "ulysses_attention",
-           "ulysses_attention_inner"]
+           "ring_attention_inner", "ring_attention_inner_bhnd",
+           "ulysses_attention", "ulysses_attention_inner",
+           "ulysses_attention_inner_bhnd"]

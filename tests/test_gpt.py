@@ -325,9 +325,8 @@ def test_attn_layout_validated():
     ids = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(ValueError, match="attn_layout"):
         gpt_loss(params, ids, cfg, mesh)
-    # bhnd + RING sequence parallelism is a supported composition since
-    # the head-major ring core (test_attn_layout_bhnd_composes_with_ring);
-    # only bhnd + ulysses is rejected (test_attn_layout_bhnd_ulysses_rejected)
+    # bhnd composes with BOTH sequence-parallel variants since the
+    # head-major ring/ulysses cores (round 3) — no layout restriction left
 
 
 def test_gpt_zero3_pp2_matches_single_device():
@@ -441,12 +440,21 @@ def test_attn_layout_bhnd_composes_with_ring():
     np.testing.assert_allclose(run(mesh, cfg_n), ref, rtol=2e-4, atol=2e-4)
 
 
-def test_attn_layout_bhnd_ulysses_rejected():
+def test_attn_layout_bhnd_composes_with_ulysses():
     import dataclasses
     cfg = dataclasses.replace(CFG, attn_layout="bhnd",
                               seq_parallel_mode="ulysses")
-    mesh = make_mesh("cpu:0-7", seq_parallel=2)
-    params = gpt_init(jax.random.PRNGKey(0), cfg)
-    ids = jnp.zeros((2, CFG.seq_len), jnp.int32)
-    with pytest.raises(ValueError, match="ulysses"):
-        gpt_loss(params, ids, cfg, mesh)
+
+    def run(mesh, c):
+        params = gpt_place(gpt_init(jax.random.PRNGKey(0), c), mesh)
+        mom = gpt_place(jax.tree.map(jnp.zeros_like, params), mesh)
+        step = make_train_step(c, mesh)
+        out = []
+        for i in range(3):
+            params, mom, loss = step(params, mom, _ids(i))
+            out.append(float(loss))
+        return out
+
+    ref = run(make_mesh("cpu:0"), CFG)
+    par = run(make_mesh("cpu:0-7", seq_parallel=2), cfg)
+    np.testing.assert_allclose(par, ref, rtol=2e-4, atol=2e-4)

@@ -253,27 +253,42 @@ def test_forward_iter_matches_per_batch_predict(synth_mnist, tmp_path):
     task = LearnTask()
     task.run([str(conf), "num_round=1", "max_round=1"])
     net = task.net
-    it = create_iterator([
-        ("iter", "mnist"),
-        ("path_img", "%s/test-img.gz" % synth_mnist),
-        ("path_label", "%s/test-lab.gz" % synth_mnist),
-        ("iter", "threadbuffer"),
-        ("batch_size", "48"), ("round_batch", "1"), ("label_width", "1"),
-        ("input_shape", "1,1,64"),
-    ])
-    it.init()
 
+    def make_it():
+        # a FRESH chain per pass, and no threadbuffer: the prefetcher
+        # advances the base eagerly, which would hand the second pass
+        # different batches. This test pins forward_iter's VALUE
+        # equivalence, not the prefetch machinery (test_io covers that).
+        # NB the mnist iterator serves FULL batches only and drops the
+        # 128 % 48 = 32 tail — exactly the reference's MNISTIterator
+        # (iter_mnist-inl.hpp:62-71; round_batch wrapping lives in the
+        # instance-level batch processor, not the in-memory iterators)
+        it = create_iterator([
+            ("iter", "mnist"),
+            ("path_img", "%s/test-img.gz" % synth_mnist),
+            ("path_label", "%s/test-lab.gz" % synth_mnist),
+            ("batch_size", "48"),
+            ("label_width", "1"), ("input_shape", "1,1,64"),
+        ])
+        it.init()
+        return it
+
+    it1 = make_it()
     serial = []
-    it.before_first()
-    while it.next():
-        serial.append(net.predict(it.value()))
+    it1.before_first()
+    while it1.next():
+        serial.append(net.predict(it1.value()))
+    if hasattr(it1, "close"):
+        it1.close()
+
+    it2 = make_it()
     piped = []
-    for out in net.forward_iter(it):
+    for out in net.forward_iter(it2):
         out = out.reshape(out.shape[0], -1)
         piped.append(out[:, 0] if out.shape[1] == 1
                      else np.argmax(out, axis=1).astype(np.float32))
-    assert len(serial) == len(piped)
+    if hasattr(it2, "close"):
+        it2.close()
+    assert len(serial) == len(piped) and len(serial) == 2
     for a, b in zip(serial, piped):
         np.testing.assert_array_equal(a, b)
-    if hasattr(it, "close"):
-        it.close()
