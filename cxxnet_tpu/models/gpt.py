@@ -588,14 +588,28 @@ def _fuse_qkv_blocks(blocks: Dict[str, jnp.ndarray]) -> Dict:
 
 
 def _attn_cached(q, ck, cv, pos):
-    """q (b,1,H,d) against cache (b,S,H,d); positions > pos are masked."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   ck.astype(jnp.float32)) / (d ** 0.5)
-    mask = jnp.arange(ck.shape[1])[None, None, None, :] <= pos
-    w = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", w,
-                      cv.astype(jnp.float32)).astype(q.dtype)
+    """q (b,1,H,d) against HEAD-MAJOR cache (b,H,S,d); positions > pos
+    are masked. On TPU with aligned shapes the whole scores->mask->
+    softmax->PV chain runs as ONE Pallas kernel per (batch, head) —
+    batch-1 decode is op-count-bound (doc/performance.md round 3), so
+    collapsing the ~6 XLA kernels per layer is the lever; the jnp
+    formulation is the fallback and the differential oracle. (The
+    (b,1,h,d)<->(b,h,1,d) swaps are free: the swapped dims include a
+    singleton, so the memory layout is unchanged.)"""
+    from ..ops.pallas_kernels import (cached_attention,
+                                      cached_attention_supported)
+    qh = jnp.swapaxes(q, 1, 2)                         # (b, h, 1, d)
+    if cached_attention_supported(ck.shape):
+        out = cached_attention(qh, ck, cv, pos)
+    else:
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / (d ** 0.5)
+        mask = jnp.arange(ck.shape[2])[None, None, None, :] <= pos
+        w = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w,
+                         cv.astype(jnp.float32)).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)                     # (b, 1, h, d)
 
 
 @functools.lru_cache(maxsize=64)
@@ -630,8 +644,13 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                 return local_attention(q, k, v, causal=True), (k, v)
             out, (k, v) = _block_core_fusedqkv(p, carry, n_head, attn,
                                                identity)
-            pad = ((0, 0), (0, total - n_prompt), (0, 0), (0, 0))
-            return out, (jnp.pad(k, pad), jnp.pad(v, pad))
+            # head-major (b, h, S, d) caches: the decode step's update at
+            # [:, :, pos] is then a free-layout dus and the cached-
+            # attention kernel reads its native layout
+            kh = jnp.transpose(k, (0, 2, 1, 3))
+            vh = jnp.transpose(v, (0, 2, 1, 3))
+            pad = ((0, 0), (0, 0), (0, total - n_prompt), (0, 0))
+            return out, (jnp.pad(kh, pad), jnp.pad(vh, pad))
 
         h, (cache_k, cache_v) = lax.scan(prefill_layer, h, blocks)
         hl = _layernorm(h[:, -1:], params["lnf_g"], params["lnf_b"])
@@ -655,8 +674,10 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                 p, ck, cv = xs
 
                 def attn(q, k, v):
-                    ck2 = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-                    cv2 = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+                    kh = jnp.swapaxes(k, 1, 2)         # (b, h, 1, d) free
+                    vh = jnp.swapaxes(v, 1, 2)
+                    ck2 = lax.dynamic_update_slice(ck, kh, (0, 0, pos, 0))
+                    cv2 = lax.dynamic_update_slice(cv, vh, (0, 0, pos, 0))
                     return _attn_cached(q, ck2, cv2, pos), (ck2, cv2)
 
                 out, (ck, cv) = _block_core_fusedqkv(p, carry_h, n_head,

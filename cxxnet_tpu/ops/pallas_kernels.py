@@ -1387,3 +1387,67 @@ def _ln_bwd(eps, res, dy):
 
 
 layernorm_fused.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# cached attention (autoregressive decode)
+# ---------------------------------------------------------------------------
+# One query position against a K/V cache, the per-layer hot op of the KV
+# decode scan (models/gpt.py). Batch-1 decode is op-count-bound
+# (doc/performance.md round 3): the XLA formulation issues ~6 kernels per
+# layer (2 einsums + masked-softmax chain); this is ONE kernel per
+# (batch, head) doing scores -> causal mask -> softmax -> PV in VMEM.
+# Inference-only (no VJP; the train paths use the flash kernels).
+
+
+def _cached_attn_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *,
+                        scale: float):
+    # q: (1, 1, 1, D); k/v: (1, 1, S, D) — HEAD-MAJOR cache; pos: scalar
+    # int32 (current position; cache entries > pos are masked out)
+    q = q_ref[0, 0]                                    # (1, D)
+    k = k_ref[0, 0]                                    # (S, D)
+    v = v_ref[0, 0]
+    s = _mm_t(q, k)[0] * scale                         # (S,) f32
+    idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    s = jnp.where(idx <= pos_ref[0], s, _NEG_INF)
+    m = s.max()
+    p = jnp.exp(s - m)
+    o = _mm(p[None, :].astype(v.dtype), v)             # (1, D) f32
+    o_ref[0, 0] = (o / p.sum()).astype(o_ref.dtype)
+
+
+def cached_attention_supported(cache_shape) -> bool:
+    """(b, h, S, d) head-major cache with lane-aligned d. OPT-IN
+    (CXN_PALLAS_DECODE=1): measured NEUTRAL on the 85M batch-1 decode
+    (0.73-0.83 ms/token both ways across repeated A/Bs on one v5e chip) —
+    XLA already fuses the masked-softmax chain between the two tiny
+    einsums, so the op-count reduction buys no wall-clock. Kept as the
+    measured alternative and the single-kernel form of the op."""
+    import os
+    _, _, s, d = cache_shape
+    return (os.environ.get("CXN_PALLAS_DECODE", "0") == "1"
+            and use_pallas() and d % 128 in (0, 64) and s % 8 == 0)
+
+
+def cached_attention(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
+                     pos) -> jnp.ndarray:
+    """q (b, h, 1, d) against HEAD-MAJOR caches (b, h, S, d); positions >
+    ``pos`` (traced int32 scalar) are masked. Returns (b, h, 1, d) in q's
+    dtype — the Pallas form of models/gpt.py:_attn_cached."""
+    b, h, s, d = ck.shape
+    scale = 1.0 / (d ** 0.5)
+    kern = functools.partial(_cached_attn_kernel, scale=scale)
+    out = pl.pallas_call(
+        kern,
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=_out_struct((b, h, 1, d), q.dtype, q),
+        interpret=_INTERPRET,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, ck, cv)
+    return out

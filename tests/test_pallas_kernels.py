@@ -251,3 +251,24 @@ def test_layernorm_fused_matches_reference():
         for got, want in zip(grads(pk.layernorm_fused), grads(ref_ln)):
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=1e-4, atol=1e-4)
+
+
+def test_cached_attention_matches_reference(monkeypatch):
+    """The decode cached-attention kernel (one kernel per (batch, head):
+    scores -> causal mask -> softmax -> PV) vs the jnp chain, interpret
+    mode, several mask positions."""
+    import cxxnet_tpu.ops.pallas_kernels as pk
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    rs = np.random.RandomState(0)
+    b, h, s, d = 2, 3, 24, 64
+    q = jnp.asarray(rs.randn(b, h, 1, d).astype(np.float32))
+    ck = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    cv = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    for pos in (0, 5, s - 1):
+        got = pk.cached_attention(q, ck, cv, jnp.int32(pos))
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, ck) / (d ** 0.5)
+        mask = jnp.arange(s)[None, None, None, :] <= pos
+        w = jax.nn.softmax(jnp.where(mask, sc, -1e30), axis=-1)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", w, cv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
