@@ -146,6 +146,7 @@ class MoELayer(Layer):
         self.capacity_factor = 1.25
         self.aux_weight = 0.01
         self.moe_dispatch = "auto"
+        self.moe_topk = 1
         super().__init__(spec, cfg)
 
     def set_param(self, name, val):
@@ -160,6 +161,10 @@ class MoELayer(Layer):
                 raise ConfigError("moe_dispatch must be auto|sort|dense, "
                                   "got %r" % val)
             self.moe_dispatch = val
+        elif name == "moe_topk":
+            self.moe_topk = int(val)
+            if self.moe_topk < 1:
+                raise ConfigError("moe_topk must be >= 1")
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         c, y, x = self.check_one_to_one(in_shapes)
@@ -200,7 +205,8 @@ class MoELayer(Layer):
             def body(xs, g, wu, wd):
                 o, a = switch_moe_alltoall(
                     xs, g, wu, wd, axis_name=EXPERT_AXIS,
-                    capacity_factor=self.capacity_factor)
+                    capacity_factor=self.capacity_factor,
+                    top_k=self.moe_topk)
                 # aux is psum-averaged over expert inside; averaging over
                 # data too makes it a genuinely replicated scalar (the
                 # P() out_spec below relies on that, check_vma is off)
@@ -234,10 +240,13 @@ class MoELayer(Layer):
                     spec = _fit_spec(self.param_axes("w_up"),
                                      params["w_up"].shape, mesh)
                     expert_sharded = spec[0] is not None
-                dispatch = "dense" if expert_sharded else "sort"
+                # dense supports top-1 only; top-k forces the sort path
+                dispatch = ("dense" if expert_sharded
+                            and self.moe_topk == 1 else "sort")
             out, aux = switch_moe(x.reshape(b * n, f), params["gate"],
                                   params["w_up"], params["w_down"],
-                                  self.capacity_factor, dispatch=dispatch)
+                                  self.capacity_factor, dispatch=dispatch,
+                                  top_k=self.moe_topk)
         if ctx.train and self.aux_weight > 0:
             # divide by update_period so gradient accumulation keeps the
             # aux:data loss ratio fixed (the CE loss carries the same factor,

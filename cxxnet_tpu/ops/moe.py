@@ -23,9 +23,12 @@ one routing function:
   Capacity is per (source shard, expert) group, exactly GShard's grouped
   dispatch semantics.
 
-All three share top-1 routing, bounded per-expert capacity with overflow
-tokens dropped (they pass through the caller's residual), and the
-switch-transformer auxiliary load-balancing loss.
+All three share the routing in :func:`_route` — top-1 (switch
+transformer) or top-k (GShard: renormalized gates, first choices win
+capacity before second choices; ``top_k=2`` on the sort and all-to-all
+paths) — bounded per-expert capacity with overflow entries dropped (they
+pass through the caller's residual), and the auxiliary load-balancing
+loss computed from the first choice.
 """
 
 from __future__ import annotations
@@ -38,30 +41,46 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _route(x: jnp.ndarray, w_gate: jnp.ndarray, capacity: int):
-    """Shared top-1 routing. Returns (gate (S,), expert_idx (S,) i32,
-    pos (S,) i32 queue position, keep (S,) bool, aux scalar).
+def _route(x: jnp.ndarray, w_gate: jnp.ndarray, capacity: int,
+           top_k: int = 1):
+    """Shared top-k routing. Returns (gate (S*k,), expert_idx (S*k,) i32,
+    pos (S*k,) i32 queue position, keep (S*k,) bool, aux scalar) — the
+    k choices of token t occupy flat entries t*k .. t*k+k-1.
 
-    Queue positions are assigned in token order (stable argsort), so the
-    keep set is identical to the dense cumsum formulation's.
+    Queue positions are assigned per expert in (choice, token) order:
+    every token's FIRST choice competes for capacity before any second
+    choice does (GShard's top-2 policy), and within a choice rank the
+    stable sort preserves token order, so at k=1 the keep set is
+    identical to the dense cumsum formulation's. Top-k gates are the
+    top-k softmax probabilities renormalized to sum 1 (GShard); top-1
+    keeps the raw max probability (switch transformer).
     """
     s, _ = x.shape
     e = w_gate.shape[1]
     logits = (x @ w_gate.astype(x.dtype)).astype(jnp.float32)    # (S, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)    # (S,)
-    gate = jnp.max(probs, axis=-1)                               # (S,)
+    top_p, top_i = lax.top_k(probs, top_k)                       # (S, k)
+    if top_k > 1:
+        top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    gate = top_p.reshape(-1)                                     # (S*k,)
+    expert_idx = top_i.astype(jnp.int32).reshape(-1)             # (S*k,)
 
-    order = jnp.argsort(expert_idx, stable=True)                 # (S,)
+    # sort key (expert, choice, token): choice-major within each expert so
+    # 1st choices win the queue head
+    choice = jnp.tile(jnp.arange(top_k, dtype=jnp.int32), (s,))  # (S*k,)
+    key = (expert_idx * top_k + choice) * s \
+        + jnp.arange(s * top_k, dtype=jnp.int32) // top_k
+    order = jnp.argsort(key)                                     # (S*k,)
     sorted_e = expert_idx[order]
     seg_start = jnp.searchsorted(sorted_e, jnp.arange(e))        # (E,)
-    pos_sorted = jnp.arange(s, dtype=jnp.int32) \
+    pos_sorted = jnp.arange(s * top_k, dtype=jnp.int32) \
         - seg_start[sorted_e].astype(jnp.int32)
-    pos = jnp.zeros((s,), jnp.int32).at[order].set(pos_sorted)
+    pos = jnp.zeros((s * top_k,), jnp.int32).at[order].set(pos_sorted)
     keep = pos < capacity
 
-    # switch-transformer load-balancing loss: E * sum_e f_e * p_e
-    frac_tokens = jnp.zeros((e,), jnp.float32).at[expert_idx].add(1.0) / s
+    # load-balancing loss from the FIRST choice (switch/GShard): E * f.p
+    first = top_i[:, 0]
+    frac_tokens = jnp.zeros((e,), jnp.float32).at[first].add(1.0) / s
     frac_probs = probs.mean(axis=0)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return gate, expert_idx, pos, keep, aux
@@ -86,30 +105,42 @@ def _scatter_tokens(x, expert_idx, pos, keep, e, capacity):
 
 def switch_moe(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
                w_down: jnp.ndarray, capacity_factor: float = 1.25,
-               dispatch: str = "sort") -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 MoE FFN on one logical shard.
+               dispatch: str = "sort",
+               top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k MoE FFN on one logical shard (k=1: switch transformer; k=2:
+    GShard routing — gates renormalized over the chosen experts, first
+    choices win capacity before second choices).
 
     x: (S, D) tokens; w_gate: (D, E); w_up: (E, D, H); w_down: (E, H, D).
-    Returns (out (S, D), aux_loss scalar). Tokens beyond an expert's
-    capacity ``ceil(S/E * capacity_factor)`` contribute zero (caller keeps
-    the residual path).
+    Returns (out (S, D), aux_loss scalar). Entries beyond an expert's
+    capacity ``ceil(k*S/E * capacity_factor)`` contribute zero (caller
+    keeps the residual path).
     """
     if dispatch not in ("sort", "dense"):
         raise ValueError("dispatch must be 'sort' or 'dense', got %r"
                          % (dispatch,))
+    if top_k < 1 or top_k > w_gate.shape[1]:
+        raise ValueError("top_k must be in [1, n_experts], got %d" % top_k)
     s, d = x.shape
     e = w_gate.shape[1]
-    capacity = max(1, math.ceil(s / e * capacity_factor))
+    capacity = max(1, math.ceil(top_k * s / e * capacity_factor))
 
     if dispatch == "dense":
+        if top_k != 1:
+            raise ValueError("dispatch='dense' supports top_k=1 only "
+                             "(the one-hot einsum formulation); use "
+                             "dispatch='sort'")
         return _switch_moe_dense(x, w_gate, w_up, w_down, capacity)
 
-    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity)
-    xin, slot = _scatter_tokens(x, expert_idx, pos, keep, e, capacity)
+    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity, top_k)
+    x_flat = x if top_k == 1 else jnp.repeat(x, top_k, axis=0)
+    xin, slot = _scatter_tokens(x_flat, expert_idx, pos, keep, e, capacity)
     out_e = _expert_ffn(xin.reshape(e, capacity, d), w_up, w_down)
     out_flat = out_e.reshape(e * capacity, d)
     tok = out_flat[jnp.minimum(slot, e * capacity - 1)]
     out = tok * (gate * keep).astype(tok.dtype)[:, None]
+    if top_k > 1:
+        out = out.reshape(s, top_k, d).sum(axis=1)
     return out.astype(x.dtype), aux
 
 
@@ -147,7 +178,7 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
                         w_up: jnp.ndarray, w_down: jnp.ndarray,
                         axis_name: str = "expert",
                         capacity_factor: float = 1.25,
-                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                        top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel top-1 MoE for use INSIDE a shard_map over
     ``axis_name``.
 
@@ -172,11 +203,12 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
         raise ValueError(
             "switch_moe_alltoall: gate has %d experts but shards hold "
             "%d x %d" % (e, p, e_local))
-    capacity = max(1, math.ceil(s / e * capacity_factor))
+    capacity = max(1, math.ceil(top_k * s / e * capacity_factor))
 
-    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity)
+    gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity, top_k)
     aux = lax.psum(aux, axis_name) / p
-    xin, slot = _scatter_tokens(x, expert_idx, pos, keep, e, capacity)
+    x_flat = x if top_k == 1 else jnp.repeat(x, top_k, axis=0)
+    xin, slot = _scatter_tokens(x_flat, expert_idx, pos, keep, e, capacity)
     xin = xin.reshape(e, capacity, d)
     # (E, C, D) -> (E_local, P*C, D): expert dim split across shards,
     # every shard's contribution concatenated on the capacity dim
@@ -189,6 +221,8 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
     out_flat = out_e.reshape(e * capacity, d)
     tok = out_flat[jnp.minimum(slot, e * capacity - 1)]
     out = tok * (gate * keep).astype(tok.dtype)[:, None]
+    if top_k > 1:
+        out = out.reshape(s, top_k, d).sum(axis=1)
     return out.astype(x.dtype), aux
 
 

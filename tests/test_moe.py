@@ -231,3 +231,96 @@ def test_moe_sp_ep_tp_composition_matches_single_device():
     for k in ref:
         np.testing.assert_allclose(par[k], ref[k], rtol=2e-3, atol=2e-4,
                                    err_msg=k)
+
+
+def test_top2_matches_dense_per_token():
+    """Top-2 routing with ample capacity: each token's output must equal
+    the renormalized-gate sum of its two best experts' FFNs (GShard)."""
+    rs = np.random.RandomState(7)
+    wg, wu, wd = _weights(rs)
+    x = jnp.asarray(rs.randn(24, 8).astype(np.float32))
+    out, aux = switch_moe(x, wg, wu, wd, capacity_factor=8.0, top_k=2)
+
+    probs = np.asarray(jax.nn.softmax(x @ wg, axis=-1))
+    for t in range(24):
+        top2 = np.argsort(probs[t])[::-1][:2]
+        g = probs[t, top2] / probs[t, top2].sum()
+        ref = 0.0
+        for gi, ei in zip(g, top2):
+            hdn = np.maximum(np.asarray(x[t]) @ np.asarray(wu[ei]), 0)
+            ref = ref + gi * (hdn @ np.asarray(wd[ei]))
+        np.testing.assert_allclose(np.asarray(out[t]), ref, rtol=1e-4,
+                                   atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_top2_first_choices_win_capacity():
+    """Capacity contention: every token 1st-chooses expert 0 and
+    2nd-chooses expert 1. At capacity < S, expert 0 must serve the FIRST
+    tokens (choice-major queue), and every token still gets its second
+    expert (no contention there)."""
+    rs = np.random.RandomState(8)
+    e, d_model = 2, 8
+    wg = jnp.asarray(np.stack([np.full(d_model, 2.0),
+                               np.full(d_model, 1.0)], axis=1)
+                     .astype(np.float32))
+    wu, wd = _weights(rs, e=e)[1:]
+    x = jnp.abs(jnp.asarray(rs.randn(8, d_model).astype(np.float32)))
+    # capacity = ceil(2*8/2 * 0.25) = 2 per expert
+    out, _ = switch_moe(x, wg, wu, wd, capacity_factor=0.25, top_k=2)
+    probs = np.asarray(jax.nn.softmax(x @ wg, axis=-1))
+
+    def expert_out(t, ei, gi):
+        hdn = np.maximum(np.asarray(x[t]) @ np.asarray(wu[ei]), 0)
+        return gi * (hdn @ np.asarray(wd[ei]))
+
+    for t in range(8):
+        g = probs[t] / probs[t].sum()
+        want = np.zeros(d_model, np.float32)
+        # expert 0's queue holds only 1st choices (token order): t<2 kept.
+        # expert 1's queue holds only 2nd choices (token order): t<2 kept.
+        if t < 2:
+            want = want + expert_out(t, 0, g[0]) + expert_out(t, 1, g[1])
+        np.testing.assert_allclose(np.asarray(out[t]), want, rtol=1e-4,
+                                   atol=1e-5, err_msg=str(t))
+
+
+def test_top2_gradients_finite():
+    rs = np.random.RandomState(9)
+    wg, wu, wd = _weights(rs)
+    x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+
+    def loss(xx, g, u, dn):
+        out, aux = switch_moe(xx, g, u, dn, capacity_factor=1.0, top_k=2)
+        return jnp.sum(out * out) + 0.01 * aux
+
+    grads = jax.grad(loss, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+
+def test_dense_rejects_topk():
+    rs = np.random.RandomState(10)
+    wg, wu, wd = _weights(rs)
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+    import pytest
+    with pytest.raises(ValueError, match="top_k"):
+        switch_moe(x, wg, wu, wd, dispatch="dense", top_k=2)
+
+
+def test_moe_topk2_transformer_trains():
+    cfg = transformer_config(seq_len=16, vocab_size=16, feat=16, nhead=2,
+                             nblock=1, num_classes=4, batch_size=16,
+                             dev="cpu:0-7", moe_experts=4)
+    cfg = cfg.replace("  nexpert = 4", "  nexpert = 4\n  moe_topk = 2")
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    before = [np.asarray(t).copy() for t in jax.tree.leaves(net.params)]
+    for i in range(3):
+        ids = rs.randint(0, 16, (16, 1, 1, 16)).astype(np.float32)
+        lab = rs.randint(0, 4, (16, 1)).astype(np.float32)
+        net.update(DataBatch(ids, lab))
+    after = [np.asarray(t) for t in jax.tree.leaves(net.params)]
+    assert any(np.abs(a - b).sum() > 0 for a, b in zip(after, before))
