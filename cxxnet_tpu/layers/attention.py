@@ -127,7 +127,9 @@ class MoELayer(Layer):
     Config: ``nexpert``, ``nhidden`` (per-expert hidden width),
     ``capacity_factor``, ``moe_aux_weight`` (load-balance loss weight),
     ``moe_dispatch`` (sort | dense, the single-logical-shard strategy —
-    doc/performance.md measures the crossover).
+    doc/performance.md measures the crossover), ``moe_topk`` (1 = switch
+    top-1; 2 = GShard top-2, renormalized gates, first choices win
+    capacity).
     Weights: "gate" (F, E), "w_up" (E, F, H), "w_down" (E, H, F) — the
     expert dim is sharded over the dedicated ``expert`` mesh axis
     (``expert_parallel = k``) when present, else over ``model``.
@@ -171,6 +173,13 @@ class MoELayer(Layer):
         if self.nexpert <= 0 or self.param.num_hidden <= 0:
             raise ConfigError("moe %r: set nexpert and nhidden"
                               % self.spec.key())
+        if self.moe_topk > self.nexpert:
+            raise ConfigError("moe %r: moe_topk %d exceeds nexpert %d"
+                              % (self.spec.key(), self.moe_topk,
+                                 self.nexpert))
+        if self.moe_dispatch == "dense" and self.moe_topk != 1:
+            raise ConfigError("moe %r: moe_dispatch=dense supports "
+                              "moe_topk=1 only" % self.spec.key())
         self.feat = c
         return [(c, y, x)]
 

@@ -179,8 +179,8 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
                         axis_name: str = "expert",
                         capacity_factor: float = 1.25,
                         top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Expert-parallel top-1 MoE for use INSIDE a shard_map over
-    ``axis_name``.
+    """Expert-parallel top-k MoE for use INSIDE a shard_map over
+    ``axis_name`` (k=1 switch, k=2 GShard — see :func:`_route`).
 
     Per shard: x (S_local, D) local tokens; w_gate (D, E) replicated;
     w_up (E_local, D, H) / w_down (E_local, H, D) local expert shards
@@ -188,8 +188,8 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
     dispatch block is exchanged with one ``all_to_all`` so each shard
     holds its E_local experts' tokens from every source shard, the FFN
     runs, and a mirror ``all_to_all`` returns the outputs. Capacity
-    ``ceil(S_local/E * capacity_factor)`` applies per (source shard,
-    expert) — GShard's grouped dispatch.
+    ``ceil(top_k*S_local/E * capacity_factor)`` applies per (source
+    shard, expert) — GShard's grouped dispatch.
 
     The aux loss is computed from the shard-local routing statistics and
     psum-averaged, which equals the global statistic when shards see
@@ -203,6 +203,8 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
         raise ValueError(
             "switch_moe_alltoall: gate has %d experts but shards hold "
             "%d x %d" % (e, p, e_local))
+    if top_k < 1 or top_k > e:
+        raise ValueError("top_k must be in [1, n_experts], got %d" % top_k)
     capacity = max(1, math.ceil(top_k * s / e * capacity_factor))
 
     gate, expert_idx, pos, keep, aux = _route(x, w_gate, capacity, top_k)

@@ -256,9 +256,10 @@ def test_top2_matches_dense_per_token():
 
 def test_top2_first_choices_win_capacity():
     """Capacity contention: every token 1st-chooses expert 0 and
-    2nd-chooses expert 1. At capacity < S, expert 0 must serve the FIRST
-    tokens (choice-major queue), and every token still gets its second
-    expert (no contention there)."""
+    2nd-chooses expert 1. Each expert's queue (capacity 2) fills in
+    token order — expert 0 with first choices, expert 1 with second
+    choices — so tokens 0,1 get BOTH experts and the rest drop to the
+    residual entirely."""
     rs = np.random.RandomState(8)
     e, d_model = 2, 8
     wg = jnp.asarray(np.stack([np.full(d_model, 2.0),
