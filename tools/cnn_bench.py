@@ -1,0 +1,185 @@
+"""CNN model-zoo step benchmark with an XPlane op profile.
+
+The round-3 verdict's open question: ResNet-50 (~2,450 img/s, ~15% MFU) and
+Inception-BN (~4,600, ~14%) never got the roofline treatment AlexNet and GPT
+did. This harness times the jitted train step device-resident (same protocol
+as bench.py — the host link here is a tunnel no framework should be charged
+for) and, with --op-profile, traces a few steps and prints the top device
+ops by self-time from the XPlane, so "where does the step go" is one command.
+
+MFU accounting: training FLOPs = 3x forward conv/matmul FLOPs (bwd-data +
+bwd-filter each cost one forward). Forward FLOPs are counted analytically
+from the netconfig graph shapes (2*K*K*Cin/g*Cout*OH*OW per conv output
+position; 2*M*N*K per fullc).
+
+Usage:
+  python tools/cnn_bench.py --model resnet50 --batch 256 --steps 30
+  python tools/cnn_bench.py --model resnet50 --op-profile
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("LIBTPU_INIT_ARGS",
+                      "--xla_tpu_scoped_vmem_limit_kib=65536")
+
+
+def model_config(name: str, batch: int):
+    from cxxnet_tpu.models import (alexnet_config, inception_bn_config,
+                                   resnet_config, vgg16_config)
+    if name == "resnet50":
+        return resnet_config(50, batch_size=batch, dev="")
+    if name == "resnet101":
+        return resnet_config(101, batch_size=batch, dev="")
+    if name == "inception":
+        return inception_bn_config(batch_size=batch, dev="")
+    if name == "vgg16":
+        return vgg16_config(batch_size=batch, dev="")
+    if name == "alexnet":
+        return alexnet_config(batch_size=batch, dev="")
+    raise SystemExit("unknown model %r" % name)
+
+
+def analytic_train_flops(net, batch: int) -> float:
+    """3x forward conv/fullc MACs*2, from the graph's inferred shapes."""
+    fwd = 0.0
+    for spec, layer in zip(net.graph.layers, net.layers):
+        t = layer.type_name
+        if t == "conv":
+            p = layer.param
+            cin = layer.in_channel
+            cout, oy, ox = net.node_shapes[spec.outputs[0]]
+            fwd += (2.0 * p.kernel_height * p.kernel_width
+                    * (cin / p.num_group) * cout * oy * ox) * batch
+        elif t == "fullc":
+            c, y, x = net.node_shapes[spec.inputs[0]]
+            nh = net.node_shapes[spec.outputs[0]][2]
+            fwd += 2.0 * c * y * x * nh * batch
+    return 3.0 * fwd
+
+
+def top_ops_from_xplane(trace_dir: str, top: int = 18):
+    """Parse the newest xplane.pb under trace_dir; return rows of
+    (self_time_us, occurrences, category, op_name)."""
+    import glob
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        return None, "no xplane.pb under %s" % trace_dir
+    from xprof.convert import raw_to_tool_data
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [paths[-1]], "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    table = json.loads(data)[0]
+    cols = [c["id"] for c in table["cols"]]
+    out = []
+    for row in table["rows"]:
+        d = dict(zip(cols, [c.get("v") for c in row["c"]]))
+        if d.get("host_or_device") != "Device":
+            continue
+        out.append((float(d["total_self_time"]), int(d["occurrences"]),
+                    "%s/%s int=%.1f bw=%.0fGB/s" % (
+                        d.get("type", ""), d.get("bound_by", ""),
+                        float(d.get("operational_intensity") or 0),
+                        float(d.get("measured_memory_bw") or 0)),
+                    d.get("operation", "")))
+    out.sort(reverse=True)
+    return out[:top], None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=3)
+    # (both must be >=1: warmup compiles, steps divide the elapsed time)
+    ap.add_argument("--op-profile", action="store_true",
+                    help="trace 3 steps and print top device ops")
+    ap.add_argument("--trace-dir", default="/tmp/cxn_cnn_trace")
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--f32", action="store_true",
+                    help="feed f32 batches (default bf16)")
+    args = ap.parse_args()
+    if args.steps < 1 or args.warmup < 1:
+        ap.error("--steps and --warmup must be >= 1")
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.utils.config import tokenize
+
+    cfg = model_config(args.model, args.batch)
+    net = Net(tokenize(cfg))
+    net.init_model()
+
+    shape = net.graph.input_shape
+    rs = np.random.RandomState(0)
+    x = rs.rand(args.batch, *shape).astype(np.float32)
+    y = rs.randint(0, 1000, (args.batch, 1)).astype(np.float32)
+    if not args.f32:
+        import ml_dtypes
+        x = x.astype(ml_dtypes.bfloat16)
+
+    class _B:
+        data, label, extra_data = x, y, []
+
+    data, extras, label = net._device_batch(_B())
+    rng = jax.random.PRNGKey(0)
+    epoch = jnp.asarray(0, jnp.int32)
+
+    p, o, s = net.params, net.opt_state, net.states
+    for _ in range(args.warmup):
+        p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
+                                           None, rng, epoch)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
+                                           None, rng, epoch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / args.steps * 1e3
+    img_s = args.steps * args.batch / dt
+    tf = analytic_train_flops(net, args.batch)
+    mfu = tf / (dt / args.steps) / (args.peak_tflops * 1e12)
+    print(json.dumps({
+        "model": args.model, "batch": args.batch,
+        "step_ms": round(step_ms, 2),
+        "images_per_sec": round(img_s, 1),
+        "train_tflops_per_step": round(tf / 1e12, 3),
+        "mfu": round(mfu, 4),
+    }))
+
+    if args.op_profile:
+        import shutil
+        shutil.rmtree(args.trace_dir, ignore_errors=True)
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(3):
+                p, o, s, loss, _ = net._jit_update(
+                    p, o, s, data, extras, label, None, rng, epoch)
+            float(loss)
+        rows, err = top_ops_from_xplane(args.trace_dir)
+        if err:
+            print("op-profile error:", err, file=sys.stderr)
+            return 1
+        total = sum(r[0] for r in rows) if rows else 0.0
+        print("\n top device ops by self time (3 steps):")
+        for t_us, occ, cat, op in rows:
+            print("  %10.0f us  x%-5d %-22s %s" % (t_us, occ, cat, op[:90]))
+        print("  (top-%d sum: %.1f ms over 3 steps)" % (len(rows), total / 1e3))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
