@@ -1,19 +1,23 @@
-"""Benchmark driver: AlexNet ImageNet-shape training throughput on one chip.
+"""Benchmark driver: the framework's full headline set on one chip.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints one JSON line per metric, in this order:
+  1. alexnet_train_images_per_sec   (vs_baseline = cxxnet 4xK40 north star)
+  2. resnet50_train_images_per_sec  (the round-4 roofline target)
+  3. gpt_train_tokens_per_sec       (305M d128 flagship, batch 24)
+  4. gpt_train_mfu_param_attn       (diff vs round-3's 0.620)
+  5. moe_dispatch_tokens_per_sec    (E=32 sort top-2 fwd+bwd, S=16384)
 
-Measures the jitted train step with device-resident data — the steady state
-of a prefetching input pipeline (the framework's data plane double-buffers
-host->device transfers; in this harness the host link is a network tunnel to
-the chip, which no framework's step time should be charged for). The barrier
-is a device-to-host fetch of the final loss: on the tunneled backend,
-``block_until_ready`` returns before execution drains, so only a host fetch
-truly synchronizes; its one-time RTT is amortized over BENCH_STEPS.
+Round 3's bench emitted only the AlexNet line, which had plateaued at the
+chip's proven streaming ceiling — the driver-recorded BENCH_r*.json could no
+longer see where the perf work actually happened (VERDICT r3 #2). Each
+benchmark is isolated in try/except and device buffers are dropped between
+benchmarks, so a failure or OOM in one cannot silence the others.
 
-The paired pipeline-fed mode (real imgbin chain + StepStats data-wait
-accounting) lives in tools/pipeline_bench.py — on this rig its step time
-measures the host->device tunnel, so the two modes are reported
-separately (doc/performance.md "Input pipeline").
+All measurements are device-resident steady state (the host link on this
+rig is a network tunnel to the chip; no framework's step time should be
+charged for it) with a single host fetch as the barrier: on the tunneled
+backend ``block_until_ready`` returns before execution drains, so only a
+host fetch truly synchronizes; its one-time RTT is amortized over the steps.
 
 Baseline: the driver-assigned north star is cxxnet's 4xK40 ImageNet AlexNet
 throughput (BASELINE.md). The reference publishes no number; contemporary
@@ -22,6 +26,7 @@ cxxnet-era measurements put AlexNet at roughly 200 images/sec on one K40, so
 images/sec. vs_baseline = measured_images_per_sec / 800.
 """
 
+import gc
 import json
 import os
 import sys
@@ -32,73 +37,184 @@ import numpy as np
 # 64 MB scoped VMEM for fusions (default 16 MB): measured +4% AlexNet
 # throughput on one v5e chip, repeatably (17.8 -> 18.5-18.6k img/s) —
 # the big LRN/pool fusions get more working set. Neutral on the GPT
-# flagship, so set here (the conv benchmark entry) rather than globally.
+# flagship and the rest of the zoo.
 os.environ.setdefault("LIBTPU_INIT_ARGS",
                       "--xla_tpu_scoped_vmem_limit_kib=65536")
 
 BASELINE_IMAGES_PER_SEC = 800.0
-# 1024 = the reference's ImageNet batch 256 (ImageNet.conf) scaled to the
-# chip's throughput sweet spot (measured with the band-matmul LRN: ~16k
-# img/s @512, ~17k @1024 repeatably — the MXU wants the larger GEMMs;
-# 2048 fits with bf16 feeds but measured slightly slower, 17.8k vs 18.1k)
-BATCH = 1024
-WARMUP_STEPS = 3
-BENCH_STEPS = 50
+GPT_MFU_ROUND3 = 0.620          # BENCH_r03-era flagship MFU, for diffing
 
 
-def main() -> int:
+def emit(metric, value, unit, vs_baseline=None):
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit,
+                      "vs_baseline": (round(vs_baseline, 3)
+                                      if vs_baseline is not None else None)}),
+          flush=True)
+
+
+def prepare_cnn(config_text, batch, f32_feed=False):
+    """Build a Net + device-resident synthetic batch for step timing.
+
+    Returns (net, step_args) where step_args feeds run_steps below. The
+    single shared definition of the measurement protocol — tools/cnn_bench.py
+    imports these so headline and analysis numbers cannot drift apart.
+    """
     import jax
     import jax.numpy as jnp
+    import ml_dtypes
     from cxxnet_tpu import Net
-    from cxxnet_tpu.models import alexnet_config
     from cxxnet_tpu.utils.config import tokenize
 
-    n_dev = len(jax.devices())
-    batch = BATCH
-    if batch % n_dev:
-        batch = (batch // n_dev + 1) * n_dev
-
-    net = Net(tokenize(alexnet_config(batch_size=batch, dev="",
-                                      precision="bfloat16")))
+    net = Net(tokenize(config_text))
     net.init_model()
-
+    shape = net.graph.input_shape
     rs = np.random.RandomState(0)
-    x = rs.rand(batch, 3, 227, 227).astype(np.float32)
+    # steady state of a `data_dtype = bfloat16` + `threadbuffer` pipeline:
+    # batches arrive bf16 (converted in the prefetch producer thread)
+    x = rs.rand(batch, *shape).astype(np.float32)
+    if not f32_feed:
+        x = x.astype(ml_dtypes.bfloat16)
     y = rs.randint(0, 1000, (batch, 1)).astype(np.float32)
 
     class _B:
         data, label, extra_data = x, y, []
 
-    # steady state of a `data_dtype = bfloat16` + `threadbuffer` pipeline:
-    # batches arrive bf16 (converted in the prefetch producer thread), so
-    # the step's input cast no-ops — feed the same thing here
-    import ml_dtypes
-    _B.data = _B.data.astype(ml_dtypes.bfloat16)
     data, extras, label = net._device_batch(_B())
     rng = jax.random.PRNGKey(0)
     epoch = jnp.asarray(0, jnp.int32)
+    return net, (data, extras, label, rng, epoch)
 
+
+def run_steps(net, step_args, n):
+    """Run n jitted train steps; returns elapsed seconds (host-fetch barrier:
+    on tunneled backends block_until_ready returns before execution drains,
+    so only a host fetch truly synchronizes)."""
+    data, extras, label, rng, epoch = step_args
     p, o, s = net.params, net.opt_state, net.states
-    for _ in range(WARMUP_STEPS):
-        p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
-                                           None, rng, epoch)
-    float(loss)              # true barrier: drain the dispatch queue
-
     t0 = time.perf_counter()
-    for _ in range(BENCH_STEPS):
+    for _ in range(n):
         p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
                                            None, rng, epoch)
-    float(loss)              # single host fetch barriers the whole run
-    dt = time.perf_counter() - t0
+    float(loss)
+    net.params, net.opt_state, net.states = p, o, s
+    return time.perf_counter() - t0
 
-    images_per_sec = BENCH_STEPS * batch / dt
-    print(json.dumps({
-        "metric": "alexnet_train_images_per_sec",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
-    }))
-    return 0
+
+def _cnn_step_time(config_text, batch, warmup, steps):
+    """Measure the jitted train step of a netconfig model, device-resident."""
+    net, step_args = prepare_cnn(config_text, batch)
+    run_steps(net, step_args, warmup)       # compile + spin up
+    return run_steps(net, step_args, steps) / steps
+
+
+def bench_alexnet():
+    import jax
+    from cxxnet_tpu.models import alexnet_config
+    # 1024 = the reference's ImageNet batch 256 scaled to the chip's
+    # throughput sweet spot (measured: ~16.6k img/s @512, ~18.5k @1024;
+    # 2048 fits with bf16 feeds but measured slightly slower)
+    batch = 1024
+    n_dev = len(jax.devices())
+    if batch % n_dev:
+        batch = (batch // n_dev + 1) * n_dev
+    dt = _cnn_step_time(alexnet_config(batch_size=batch, dev="",
+                                       precision="bfloat16"),
+                        batch, warmup=3, steps=50)
+    ips = batch / dt
+    emit("alexnet_train_images_per_sec", ips, "images/sec",
+         ips / BASELINE_IMAGES_PER_SEC)
+
+
+def bench_resnet50():
+    from cxxnet_tpu.models import resnet_config
+    batch = 256
+    dt = _cnn_step_time(resnet_config(50, batch_size=batch, dev="",
+                                      precision="bfloat16"),
+                        batch, warmup=3, steps=20)
+    emit("resnet50_train_images_per_sec", batch / dt, "images/sec")
+
+
+def bench_gpt():
+    """The 305M d128 flagship (doc/performance.md round-3 table, last row)."""
+    import jax
+    from cxxnet_tpu.models.gpt import (GPTConfig, gpt_data_sharding,
+                                       gpt_init, gpt_opt_init, gpt_place,
+                                       make_train_step)
+    from cxxnet_tpu.parallel.mesh import make_mesh
+
+    batch, seq, vocab = 24, 1024, 256
+    cfg = GPTConfig(vocab_size=vocab, seq_len=seq, n_layer=6, n_head=16,
+                    feat=2048, n_microbatch=1, dtype="bfloat16", remat=True,
+                    remat_mode="attn_saved", attn_layout="auto")
+    mesh = make_mesh(devices=jax.devices())
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    opt = gpt_opt_init(params, mesh, "adam")
+    step = make_train_step(cfg, mesh, eta=1e-4, optimizer="adam")
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(rs.randint(0, vocab, (batch, seq)).astype(np.int32),
+                         gpt_data_sharding(mesh))
+    for _ in range(3):
+        params, opt, loss = step(params, opt, ids)
+    float(loss)
+    t0 = time.perf_counter()
+    steps = 15
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, ids)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch * seq
+    # strict model FLOPs: 6*N per token + causal attention 6*n^2*f per
+    # layer per sequence; remat recompute NOT credited (tools/gpt_bench.py)
+    flops = 6.0 * n_params * tokens + 6.0 * seq * seq * cfg.feat \
+        * cfg.n_layer * batch
+    mfu = flops / dt / 197e12
+    emit("gpt_train_tokens_per_sec", tokens / dt, "tokens/sec")
+    emit("gpt_train_mfu_param_attn", mfu, "fraction", mfu / GPT_MFU_ROUND3)
+
+
+def bench_moe():
+    """Sort-based top-2 dispatch at E=32 (tools/moe_bench.py headline cell)."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.moe import switch_moe
+
+    S, D, H, E = 16384, 1024, 2048, 32
+    rs = np.random.RandomState(0)
+    wg = jnp.asarray(rs.randn(D, E).astype(np.float32) * 0.02)
+    wu = jnp.asarray(rs.randn(E, D, H).astype(np.float32) * 0.02
+                     ).astype(jnp.bfloat16)
+    wd = jnp.asarray(rs.randn(E, H, D).astype(np.float32) * 0.02
+                     ).astype(jnp.bfloat16)
+    x = jnp.asarray(rs.randn(S, D).astype(np.float32)).astype(jnp.bfloat16)
+
+    def loss(xx, g, u, dn):
+        out, aux = switch_moe(xx, g, u, dn, 1.25, dispatch="sort", top_k=2)
+        return jnp.sum(out.astype(jnp.float32) ** 2) + aux
+
+    f = jax.jit(jax.value_and_grad(loss, argnums=(0, 2, 3)))
+    r = f(x, wg, wu, wd)
+    float(r[0])
+    t0 = time.perf_counter()
+    for _ in range(15):
+        r = f(x, wg, wu, wd)
+    float(r[0])
+    dt = (time.perf_counter() - t0) / 15
+    emit("moe_dispatch_tokens_per_sec", S / dt, "tokens/sec")
+
+
+def main() -> int:
+    rc = 0
+    for fn in (bench_alexnet, bench_resnet50, bench_gpt, bench_moe):
+        try:
+            fn()
+        except Exception as e:                      # noqa: BLE001
+            print("%s failed: %r" % (fn.__name__, e), file=sys.stderr)
+            rc = 1
+        gc.collect()                # drop device buffers between benchmarks
+    return rc
 
 
 if __name__ == "__main__":

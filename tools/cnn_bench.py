@@ -21,7 +21,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -84,7 +83,8 @@ def top_ops_from_xplane(trace_dir: str, top: int = 18):
         d = dict(zip(cols, [c.get("v") for c in row["c"]]))
         if d.get("host_or_device") != "Device":
             continue
-        out.append((float(d["total_self_time"]), int(d["occurrences"]),
+        out.append((float(d.get("total_self_time") or 0),
+                    int(d.get("occurrences") or 0),
                     "%s/%s int=%.1f bw=%.0fGB/s" % (
                         d.get("type", ""), d.get("bound_by", ""),
                         float(d.get("operational_intensity") or 0),
@@ -111,43 +111,13 @@ def main() -> int:
     if args.steps < 1 or args.warmup < 1:
         ap.error("--steps and --warmup must be >= 1")
 
-    import numpy as np
     import jax
-    import jax.numpy as jnp
-    from cxxnet_tpu import Net
-    from cxxnet_tpu.utils.config import tokenize
+    from bench import prepare_cnn, run_steps    # the one measurement protocol
 
-    cfg = model_config(args.model, args.batch)
-    net = Net(tokenize(cfg))
-    net.init_model()
-
-    shape = net.graph.input_shape
-    rs = np.random.RandomState(0)
-    x = rs.rand(args.batch, *shape).astype(np.float32)
-    y = rs.randint(0, 1000, (args.batch, 1)).astype(np.float32)
-    if not args.f32:
-        import ml_dtypes
-        x = x.astype(ml_dtypes.bfloat16)
-
-    class _B:
-        data, label, extra_data = x, y, []
-
-    data, extras, label = net._device_batch(_B())
-    rng = jax.random.PRNGKey(0)
-    epoch = jnp.asarray(0, jnp.int32)
-
-    p, o, s = net.params, net.opt_state, net.states
-    for _ in range(args.warmup):
-        p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
-                                           None, rng, epoch)
-    float(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        p, o, s, loss, _ = net._jit_update(p, o, s, data, extras, label,
-                                           None, rng, epoch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    net, step_args = prepare_cnn(model_config(args.model, args.batch),
+                                 args.batch, f32_feed=args.f32)
+    run_steps(net, step_args, args.warmup)
+    dt = run_steps(net, step_args, args.steps)
 
     step_ms = dt / args.steps * 1e3
     img_s = args.steps * args.batch / dt
@@ -165,10 +135,7 @@ def main() -> int:
         import shutil
         shutil.rmtree(args.trace_dir, ignore_errors=True)
         with jax.profiler.trace(args.trace_dir):
-            for _ in range(3):
-                p, o, s, loss, _ = net._jit_update(
-                    p, o, s, data, extras, label, None, rng, epoch)
-            float(loss)
+            run_steps(net, step_args, 3)
         rows, err = top_ops_from_xplane(args.trace_dir)
         if err:
             print("op-profile error:", err, file=sys.stderr)
