@@ -43,6 +43,23 @@ os.environ.setdefault("LIBTPU_INIT_ARGS",
 
 BASELINE_IMAGES_PER_SEC = 800.0
 GPT_MFU_ROUND3 = 0.620          # BENCH_r03-era flagship MFU, for diffing
+V5E_BF16_PEAK = 197e12          # one v5e chip, bf16 MXU
+
+
+def gpt_model_flops(n_params, batch, seq, feat, layers):
+    """Strict model FLOPs per step: 6*N per token (fwd 2N + bwd 4N) plus
+    causal attention 6*n^2*f per layer per sequence (QK^T + PV, causality
+    halves, bwd is 2x fwd). Remat recompute is NOT credited. The single
+    definition — tools/gpt_bench.py imports this so the headline MFU and
+    the analysis tool's cannot drift."""
+    return (6.0 * n_params * batch * seq
+            + 6.0 * seq * seq * feat * layers * batch)
+
+
+def round_up(batch, n_dev):
+    """Round a benchmark batch up to a multiple of the device count so the
+    data sharding always divides (no-op on one chip)."""
+    return batch if batch % n_dev == 0 else (batch // n_dev + 1) * n_dev
 
 
 def emit(metric, value, unit, vs_baseline=None):
@@ -114,10 +131,7 @@ def bench_alexnet():
     # 1024 = the reference's ImageNet batch 256 scaled to the chip's
     # throughput sweet spot (measured: ~16.6k img/s @512, ~18.5k @1024;
     # 2048 fits with bf16 feeds but measured slightly slower)
-    batch = 1024
-    n_dev = len(jax.devices())
-    if batch % n_dev:
-        batch = (batch // n_dev + 1) * n_dev
+    batch = round_up(1024, len(jax.devices()))
     dt = _cnn_step_time(alexnet_config(batch_size=batch, dev="",
                                        precision="bfloat16"),
                         batch, warmup=3, steps=50)
@@ -127,8 +141,9 @@ def bench_alexnet():
 
 
 def bench_resnet50():
+    import jax
     from cxxnet_tpu.models import resnet_config
-    batch = 256
+    batch = round_up(256, len(jax.devices()))
     dt = _cnn_step_time(resnet_config(50, batch_size=batch, dev="",
                                       precision="bfloat16"),
                         batch, warmup=3, steps=20)
@@ -143,7 +158,7 @@ def bench_gpt():
                                        make_train_step)
     from cxxnet_tpu.parallel.mesh import make_mesh
 
-    batch, seq, vocab = 24, 1024, 256
+    batch, seq, vocab = round_up(24, len(jax.devices())), 1024, 256
     cfg = GPTConfig(vocab_size=vocab, seq_len=seq, n_layer=6, n_head=16,
                     feat=2048, n_microbatch=1, dtype="bfloat16", remat=True,
                     remat_mode="attn_saved", attn_layout="auto")
@@ -166,22 +181,19 @@ def bench_gpt():
     dt = (time.perf_counter() - t0) / steps
 
     tokens = batch * seq
-    # strict model FLOPs: 6*N per token + causal attention 6*n^2*f per
-    # layer per sequence; remat recompute NOT credited (tools/gpt_bench.py)
-    flops = 6.0 * n_params * tokens + 6.0 * seq * seq * cfg.feat \
-        * cfg.n_layer * batch
-    mfu = flops / dt / 197e12
+    flops = gpt_model_flops(n_params, batch, seq, cfg.feat, cfg.n_layer)
+    mfu = flops / dt / V5E_BF16_PEAK
     emit("gpt_train_tokens_per_sec", tokens / dt, "tokens/sec")
     emit("gpt_train_mfu_param_attn", mfu, "fraction", mfu / GPT_MFU_ROUND3)
 
 
-def bench_moe():
-    """Sort-based top-2 dispatch at E=32 (tools/moe_bench.py headline cell)."""
+def moe_dispatch_cell(S, D, H, E, dispatch, top_k, steps=15):
+    """fwd+bwd seconds/step of one switch_moe cell — the single measurement
+    definition shared with tools/moe_bench.py."""
     import jax
     import jax.numpy as jnp
     from cxxnet_tpu.ops.moe import switch_moe
 
-    S, D, H, E = 16384, 1024, 2048, 32
     rs = np.random.RandomState(0)
     wg = jnp.asarray(rs.randn(D, E).astype(np.float32) * 0.02)
     wu = jnp.asarray(rs.randn(E, D, H).astype(np.float32) * 0.02
@@ -191,17 +203,24 @@ def bench_moe():
     x = jnp.asarray(rs.randn(S, D).astype(np.float32)).astype(jnp.bfloat16)
 
     def loss(xx, g, u, dn):
-        out, aux = switch_moe(xx, g, u, dn, 1.25, dispatch="sort", top_k=2)
+        out, aux = switch_moe(xx, g, u, dn, 1.25, dispatch=dispatch,
+                              top_k=top_k)
         return jnp.sum(out.astype(jnp.float32) ** 2) + aux
 
     f = jax.jit(jax.value_and_grad(loss, argnums=(0, 2, 3)))
     r = f(x, wg, wu, wd)
-    float(r[0])
+    float(r[0])              # host fetch: the true barrier
     t0 = time.perf_counter()
-    for _ in range(15):
+    for _ in range(steps):
         r = f(x, wg, wu, wd)
     float(r[0])
-    dt = (time.perf_counter() - t0) / 15
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_moe():
+    """Sort-based top-2 dispatch at E=32 (tools/moe_bench.py headline cell)."""
+    S = 16384
+    dt = moe_dispatch_cell(S, 1024, 2048, 32, "sort", 2)
     emit("moe_dispatch_tokens_per_sec", S / dt, "tokens/sec")
 
 

@@ -148,6 +148,7 @@ class MoELayer(Layer):
         self.capacity_factor = 1.25
         self.aux_weight = 0.01
         self.moe_dispatch = "auto"
+        self._warned_dispatch = False
         self.moe_topk = 1
         super().__init__(spec, cfg)
 
@@ -208,6 +209,18 @@ class MoELayer(Layer):
         ep = mesh.shape.get(EXPERT_AXIS, 1) if mesh is not None else 1
         nd = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
         if ep > 1 and (b * n) % (ep * nd) == 0 and self.nexpert % ep == 0:
+            if self.moe_dispatch != "auto" and not self._warned_dispatch:
+                # the expert-parallel all-to-all path groups capacity per
+                # source shard (GShard semantics), which differs from the
+                # global grouping of the single-device sort/dense paths —
+                # an explicit moe_dispatch cannot be honored here
+                import sys
+                print("moe %s: expert_parallel>1 uses the all-to-all "
+                      "dispatch; explicit moe_dispatch=%s is ignored "
+                      "(capacity grouped per source shard, not globally)"
+                      % (self.spec.key(), self.moe_dispatch),
+                      file=sys.stderr)
+                self._warned_dispatch = True
             from jax import lax
             from jax.sharding import PartitionSpec as P
 

@@ -37,7 +37,17 @@ class Metric:
         self.cnt_inst += pred.shape[0]
 
     def get(self) -> float:
-        return self.sum_metric / max(self.cnt_inst, 1)
+        return self.finish(self.sum_metric, float(self.cnt_inst))
+
+    def finish(self, sum_metric: float, cnt_inst: float) -> float:
+        """Turn globally-summable accumulators into the statistic. The
+        cross-process reduce path (MetricSet.print with a reducer) sums
+        (sum_metric, cnt_inst) over ranks and applies finish() to the
+        totals — so a subclass with a nonlinear finish (e.g. a true RMSE
+        sqrt) must express it HERE, not in get(), to be multi-host
+        correct. All reference metrics (utils/metric.h) are plain
+        sum/cnt means."""
+        return sum_metric / max(cnt_inst, 1.0)
 
     def calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -171,16 +181,17 @@ class MetricSet:
         statistic instead of its own shard's (the reference printed
         per-worker numbers, utils/metric.h:175-236)."""
         if reduce is not None:
-            # cross-process path: reduce the raw (sum, cnt) accumulators —
-            # this assumes every Metric has linear sum/cnt semantics
-            # (true of all reference metrics, utils/metric.h); a subclass
-            # overriding get() with a nonlinear finish (e.g. a true RMSE
-            # sqrt) is only honored on the local path below
+            # cross-process path: sum the raw (sum, cnt) accumulators over
+            # ranks, then apply each metric's finish() to the totals —
+            # nonlinear finishes are honored as long as they are expressed
+            # as Metric.finish (see its docstring); overriding get()
+            # directly would only affect the local path below
             pairs = np.asarray([[m.sum_metric, float(m.cnt_inst)]
                                 for m in self.metrics], np.float64)
             if len(pairs):
                 pairs = np.asarray(reduce(pairs), np.float64)
-            values = [s / max(c, 1.0) for s, c in pairs]
+            values = [m.finish(s, c) for m, (s, c) in zip(self.metrics,
+                                                          pairs)]
         else:
             values = [m.get() for m in self.metrics]
         out = []

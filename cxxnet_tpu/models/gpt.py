@@ -82,8 +82,10 @@ class GPTConfig:
     #                             bhnd a net LOSS (448 vs 422 ms @ 303M,
     #                             round 2); at head_dim 128 they are
     #                             lane-native. "auto" picks by measurement:
-    #                             bhnd iff head_dim >= 128 (composes with
-    #                             the head-major ring; ulysses keeps bnhd).
+    #                             bhnd iff head_dim >= 128 — layout-only,
+    #                             composes with BOTH sp modes (ring and
+    #                             ulysses cores are head-major; pinned by
+    #                             test_gpt.py layout-equivalence tests).
     remat_mode: str = "block"   # "block": whole-block remat (max memory
     #                             savings — the long-context mode) — the
     #                             DEFAULT, and measured fastest or tied at

@@ -1407,13 +1407,15 @@ def _cached_attn_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *,
     q = q_ref[0, 0]                                    # (1, D)
     k = k_ref[0, 0]                                    # (S, D)
     v = v_ref[0, 0]
-    s = _mm_t(q, k)[0] * scale                         # (S,) f32
-    idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    # scores stay (1, S): Mosaic's vector ops are 2-D (sublane, lane) —
+    # this file's kernels never drop to 1-D iota/reduce shapes
+    s = _mm_t(q, k) * scale                            # (1, S) f32
+    idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(idx <= pos_ref[0], s, _NEG_INF)
-    m = s.max()
+    m = jnp.max(s, axis=1, keepdims=True)              # (1, 1)
     p = jnp.exp(s - m)
-    o = _mm(p[None, :].astype(v.dtype), v)             # (1, D) f32
-    o_ref[0, 0] = (o / p.sum()).astype(o_ref.dtype)
+    o = _mm(p.astype(v.dtype), v)                      # (1, D) f32
+    o_ref[0, 0] = (o / jnp.sum(p, axis=1, keepdims=True)).astype(o_ref.dtype)
 
 
 def cached_attention_supported(cache_shape) -> bool:

@@ -51,7 +51,7 @@ def main() -> int:
     ap.add_argument("--attn-layout", default="auto",
                     choices=["auto", "bnhd", "bhnd"],
                     help="kernel-boundary layout (auto: head-major when "
-                         "head_dim >= 128 and no --sp)")
+                         "head_dim >= 128; composes with both --sp modes)")
     ap.add_argument("--sp-mode", default="ring",
                     choices=["ring", "ulysses"],
                     help="sequence-parallel attention variant")

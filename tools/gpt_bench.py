@@ -51,7 +51,7 @@ def main() -> int:
     ap.add_argument("--attn-layout", default="auto",
                     choices=["auto", "bnhd", "bhnd"],
                     help="kernel-boundary layout (auto: bhnd iff "
-                         "head_dim >= 128 and no sp)")
+                         "head_dim >= 128; composes with both sp modes)")
     ap.add_argument("--peak-tflops", type=float, default=197.0,
                     help="bf16 peak of one chip (v5e default)")
     ap.add_argument("--trace-dir", default="",
@@ -103,16 +103,14 @@ def main() -> int:
                 params, opt, loss = step(params, opt, ids)
             jax.block_until_ready(loss)
 
+    from bench import gpt_model_flops   # the one FLOPs/MFU definition
     tokens = args.batch * args.seq
     param_fl = 6.0 * n_params * tokens
-    # causal attention per layer per sequence: fwd = QK^T (2*n^2*f) +
-    # PV (2*n^2*f), halved by causality = 2*n^2*f; bwd = 2x fwd.
-    # total = 3 * fwd = 6 * n^2 * f
-    attn_fl = 6.0 * args.seq * args.seq * args.feat \
-        * args.layers * args.batch
+    total_fl = gpt_model_flops(n_params, args.batch, args.seq, args.feat,
+                               args.layers)
     peak = args.peak_tflops * 1e12
     mfu_p = param_fl / dt / peak
-    mfu_t = (param_fl + attn_fl) / dt / peak
+    mfu_t = total_fl / dt / peak
     print("params: %.1fM  loss=%.4f" % (n_params / 1e6, float(loss)))
     print("step: %.1f ms   tok/s: %.0f" % (dt * 1e3, tokens / dt))
     print("MFU (param FLOPs): %.1f%%   MFU (param+attn, no remat credit): "

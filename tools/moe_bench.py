@@ -2,48 +2,27 @@
 
 Reproduces the doc/performance.md "MoE dispatch" table: fwd+bwd of
 switch_moe on one chip, S=16384 tokens, D=1024, H=2048, bf16 weights,
-capacity_factor 1.25 (host-fetch barrier; 15 warm steps).
+capacity_factor 1.25 (host-fetch barrier; 15 warm steps). The measurement
+cell itself lives in bench.py (moe_dispatch_cell) so the headline metric
+and this analysis table share one definition.
 
 Usage: python tools/moe_bench.py [S=16384]
 """
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np
+from bench import moe_dispatch_cell  # noqa: E402
 
 
 def main() -> int:
-    import jax
-    import jax.numpy as jnp
-    from cxxnet_tpu.ops.moe import switch_moe
-
     S = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     D, H = 1024, 2048
-    rs = np.random.RandomState(0)
     for e in (2, 4, 8, 32):
-        wg = jnp.asarray(rs.randn(D, e).astype(np.float32) * 0.02)
-        wu = jnp.asarray(rs.randn(e, D, H).astype(np.float32)
-                         * 0.02).astype(jnp.bfloat16)
-        wd = jnp.asarray(rs.randn(e, H, D).astype(np.float32)
-                         * 0.02).astype(jnp.bfloat16)
-        x = jnp.asarray(rs.randn(S, D).astype(np.float32)).astype(jnp.bfloat16)
         for disp, k in (("dense", 1), ("sort", 1), ("sort", 2)):
-            def loss(xx, g, u, dn, _disp=disp, _k=k):
-                out, aux = switch_moe(xx, g, u, dn, 1.25, dispatch=_disp,
-                                      top_k=_k)
-                return jnp.sum(out.astype(jnp.float32) ** 2) + aux
-            f = jax.jit(jax.value_and_grad(loss, argnums=(0, 2, 3)))
-            r = f(x, wg, wu, wd)
-            float(r[0])              # host fetch: the true barrier
-            t0 = time.time()
-            for _ in range(15):
-                r = f(x, wg, wu, wd)
-            float(r[0])
-            dt = (time.time() - t0) / 15
+            dt = moe_dispatch_cell(S, D, H, e, disp, k)
             print("E=%2d %-5s top%d: %7.2f ms fwd+bwd (S=%d D=%d H=%d)"
                   % (e, disp, k, dt * 1e3, S, D, H), flush=True)
     return 0

@@ -165,6 +165,30 @@ def smoke_decode():
     print("KV-cache decode: %d tokens" % out.shape[1])
 
 
+def smoke_cached_attention():
+    """The opt-in single-kernel decode attention (CXN_PALLAS_DECODE=1) must
+    keep compiling under Mosaic (no 1-D vector shapes) and match the XLA
+    masked-softmax formulation."""
+    import jax
+    import jax.numpy as jnp
+    from cxxnet_tpu.ops.pallas_kernels import cached_attention
+
+    rs = np.random.RandomState(5)
+    b, h, s, d = 2, 4, 64, 128
+    q = jnp.asarray(rs.randn(b, h, 1, d), jnp.bfloat16)
+    ck = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    cv = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    pos = 17
+    out = cached_attention(q, ck, cv, pos)
+    qf, kf, vf = (t.astype(jnp.float32) for t in (q, ck, cv))
+    sc = jnp.einsum("bhqd,bhsd->bhqs", qf, kf) / (d ** 0.5)
+    sc = jnp.where(jnp.arange(s)[None, None, None, :] <= pos, sc, -jnp.inf)
+    ref = jnp.einsum("bhqs,bhsd->bhqd", jax.nn.softmax(sc, axis=-1), vf)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 3e-2, err
+    print("pallas cached-attention decode kernel: maxdiff %.3g" % err)
+
+
 def main() -> int:
     import jax
     from cxxnet_tpu.ops import pallas_kernels
@@ -177,7 +201,7 @@ def main() -> int:
     t0 = time.time()
     for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
                smoke_ring_kernels, smoke_flash_streaming, smoke_pallas_lrn,
-               smoke_decode):
+               smoke_decode, smoke_cached_attention):
         fn()
     print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
     return 0
