@@ -167,6 +167,7 @@ class Layer:
     type_name: str = ""
     uses_rng = False          # needs ctx rng at train time
     is_loss = False
+    has_state = False         # mutable per-layer state (BN running stats)
 
     def __init__(self, spec: LayerSpec, cfg: Sequence[Tuple[str, str]]):
         self.spec = spec

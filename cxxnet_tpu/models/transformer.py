@@ -59,7 +59,9 @@ def transformer_config(seq_len: int = 128, vocab_size: int = 256,
                        seq_parallel: int = 1, model_parallel: int = 1,
                        moe_experts: int = 0, precision: str = "float32",
                        eta: float = 0.05,
-                       seq_parallel_mode: str = "ring") -> str:
+                       seq_parallel_mode: str = "ring",
+                       pipeline_parallel: int = 1,
+                       pipeline_microbatch: int = 0) -> str:
     L = ["netconfig=start"]
     L.append("layer[0->emb] = embedding:emb")
     L.append("  vocab_size = %d" % vocab_size)
@@ -90,6 +92,8 @@ batch_size = %d
 %s
 seq_parallel = %d
 model_parallel = %d
+pipeline_parallel = %d
+pipeline_microbatch = %d
 precision = %s
 random_type = gaussian
 init_sigma = 0.02
@@ -97,5 +101,5 @@ eta = %g
 momentum = 0.9
 metric = error
 """ % (seq_len, batch_size, dev_line, seq_parallel, model_parallel,
-       precision, eta))
+       pipeline_parallel, pipeline_microbatch, precision, eta))
     return "\n".join(L)

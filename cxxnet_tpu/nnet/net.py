@@ -59,6 +59,7 @@ class Net:
         self.round = 0
         self.sample_counter = 0
         self._initialized = False
+        self._pp_segment = None
 
     # ------------------------------------------------------------ config
     def set_param(self, name: str, val: str) -> None:
@@ -74,6 +75,8 @@ class Net:
         self.model_parallel = 1
         self.seq_parallel = 1
         self.expert_parallel = 1
+        self.pipeline_parallel = 1
+        self.pipeline_microbatch = 0    # 0 = default to the pipe size
         self.shard_optimizer = 0
         self.dist_feed = "replicated"
         self.clip_norm = 0.0
@@ -97,6 +100,10 @@ class Net:
                 self.seq_parallel = int(v)
             elif k == "expert_parallel":
                 self.expert_parallel = int(v)
+            elif k == "pipeline_parallel":
+                self.pipeline_parallel = int(v)
+            elif k == "pipeline_microbatch":
+                self.pipeline_microbatch = int(v)
             elif k == "shard_optimizer":
                 self.shard_optimizer = int(v)
             elif k == "clip_norm":
@@ -164,12 +171,37 @@ class Net:
                 % (self.batch_size, jax.process_count()))
         self.mesh = make_mesh(self.dev, self.model_parallel,
                               self.seq_parallel,
+                              pipeline_parallel=self.pipeline_parallel,
                               expert_parallel=self.expert_parallel)
         self.n_data_shards = self.mesh.shape["data"]
         if self.batch_size % self.n_data_shards:
             raise ConfigError(
                 "batch_size %d must divide the %d-way data mesh"
                 % (self.batch_size, self.n_data_shards))
+
+        # config-DSL pipeline parallelism: detect the repeated block
+        # segment now so misconfiguration fails at build, not in jit
+        self._pp_segment = None
+        if self.pipeline_parallel > 1:
+            if (self.model_parallel > 1 or self.seq_parallel > 1
+                    or self.expert_parallel > 1):
+                raise ConfigError(
+                    "pipeline_parallel composes with data parallelism on "
+                    "the config path; model/seq/expert parallelism inside "
+                    "a pipelined segment needs the models/gpt.py path "
+                    "(doc/multi-device.md)")
+            from .pipeline_dsl import find_pp_segment
+            self._pp_segment = find_pp_segment(g, self.layers,
+                                               self.pipeline_parallel)
+            if self.pipeline_microbatch <= 0:
+                self.pipeline_microbatch = self.pipeline_parallel
+            local_b = self.batch_size // self.n_data_shards
+            if local_b % self.pipeline_microbatch:
+                raise ConfigError(
+                    "pipeline_microbatch %d must divide the per-data-shard "
+                    "batch %d (batch_size %d / %d data shards)"
+                    % (self.pipeline_microbatch, local_b, self.batch_size,
+                       self.n_data_shards))
 
         # metric -> node binding (default: the final node's output)
         self._metric_nodes: List[int] = []
@@ -179,6 +211,8 @@ class Net:
             else:
                 self._metric_nodes.append(g.num_nodes - 1)
         self._out_node = g.num_nodes - 1
+        for n in self._metric_nodes:
+            self._check_pp_visible(n, "metric node")
 
         self._compile_steps()
         self._initialized = True
@@ -264,6 +298,26 @@ class Net:
                 self.gsum, opt_sh if self.shard_optimizer >= 2 else param_sh)
 
     # ------------------------------------------------------------ executor
+    def _check_pp_visible(self, nid: int, what: str) -> None:
+        """Build-time guard: a node consumed by metrics/extract must not be
+        internal to the pipelined segment (those nodes are never
+        materialized — gpipe yields only the segment's exit)."""
+        seg = self._pp_segment
+        if seg is None:
+            return
+        internal = set()
+        for j in range(seg.start, seg.stop):
+            internal.update(self.graph.layers[j].outputs)
+        internal.discard(seg.exit)
+        if nid in internal:
+            raise ConfigError(
+                "%s %r is internal to the pipelined block segment (layers "
+                "%d..%d) and is not materialized under pipeline_parallel; "
+                "bind to the segment exit %r or a later node, or set "
+                "pipeline_parallel = 1"
+                % (what, self.graph.node_names[nid], seg.start,
+                   seg.stop - 1, self.graph.node_names[seg.exit]))
+
     def _layer_params(self, params, idx: int):
         spec = self.graph.layers[idx]
         if spec.type == "share":
@@ -272,11 +326,21 @@ class Net:
 
     def _run_graph(self, params, nodes: Dict[int, jnp.ndarray],
                    ctx: ApplyContext) -> Dict[int, jnp.ndarray]:
-        for i, (spec, layer) in enumerate(zip(self.graph.layers, self.layers)):
+        seg = self._pp_segment
+        i = 0
+        while i < len(self.graph.layers):
+            if seg is not None and i == seg.start:
+                from .pipeline_dsl import run_pp_segment
+                nodes[seg.exit] = run_pp_segment(self, params,
+                                                 nodes[seg.entry], ctx)
+                i = seg.stop
+                continue
+            spec, layer = self.graph.layers[i], self.layers[i]
             inputs = [nodes[n] for n in spec.inputs]
             outs = layer.apply(self._layer_params(params, i), inputs, ctx)
             for n, o in zip(spec.outputs, outs):
                 nodes[n] = o
+            i += 1
         return nodes
 
     def _entry_nodes(self, data: jnp.ndarray,
@@ -657,6 +721,7 @@ class Net:
             nid = self.graph.num_nodes - int(node[len("top[-"):-1])
         else:
             nid = self.graph.node_map[node]
+        self._check_pp_visible(nid, "extract node %r" % (node,))
         data_iter.before_first()
         pending = None            # (device out, n_valid)
         has = data_iter.next()

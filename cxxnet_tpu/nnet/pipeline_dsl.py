@@ -1,0 +1,204 @@
+"""Pipeline parallelism for netconfig-DSL models (``pipeline_parallel = k``).
+
+The reference has no pipeline parallelism (SURVEY §2.7 lists it among the
+designed-fresh axes); through round 3 the framework's gpipe schedule
+(parallel/pipeline.py) was reachable only from models/gpt.py. This module
+wires it into the config path: the Net detects the longest run of
+structurally-identical repeated blocks in the parsed graph (a transformer's
+`attention` block stack), stacks the per-repetition parameters along a
+leading layer dim inside the jitted step, and runs the segment through
+``gpipe`` — microbatches flow around the ``pipe`` mesh axis ring while each
+stage applies its local blocks.
+
+Detection contract (checked, with precise errors): each repetition must be
+single-entry/single-exit, chained (rep r's entry is rep r-1's exit), and
+contain only stateless, rng-free, non-loss, non-shared layers with identical
+types and scoped config across repetitions. The repetition count must divide
+the pipe axis.
+
+Composition boundary (doc/multi-device.md): the config-DSL pipeline
+composes with data parallelism (and ZeRO); ``model_parallel`` /
+``seq_parallel`` / ``expert_parallel`` inside a pipelined segment are
+rejected at build time — the DSL layers implement those via GSPMD/shard_map
+at the whole-graph level, which cannot nest inside gpipe's shard_map. The
+fully-composed pp x tp x sp x ep step lives on the models/gpt.py path
+(tested by the dryrun equivalence matrix).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..utils.config import ConfigError
+
+
+@dataclass
+class PPSegment:
+    start: int          # first layer index of the first repetition
+    period: int         # layers per repetition
+    count: int          # number of repetitions
+    entry: int          # node id feeding the first repetition
+    exit: int           # node id produced by the last repetition
+
+    @property
+    def stop(self) -> int:
+        return self.start + self.period * self.count
+
+
+def _rep_nodes(specs, start, period):
+    """(external_inputs, produced) node-id sets of one repetition."""
+    produced = set()
+    external = []
+    for j in range(start, start + period):
+        for n in specs[j].inputs:
+            if n not in produced and n not in external:
+                external.append(n)
+        produced.update(specs[j].outputs)
+    return external, produced
+
+
+def _layer_ok(spec, layer) -> bool:
+    return not (spec.type == "share" or spec.pairtest is not None
+                or layer.has_state or layer.uses_rng or layer.is_loss)
+
+
+def _has_params(layers, start, period) -> bool:
+    """gpipe stacks per-rep params; a param-free candidate (e.g. repeated
+    pooling) has nothing to shard over the pipe axis and nothing to gain —
+    detection skips it rather than crash downstream."""
+    from ..layers.base import Layer
+    return any(type(layers[j]).init_params is not Layer.init_params
+               for j in range(start, start + period))
+
+
+def _iso(specs, start, period, r) -> Optional[Dict[int, int]]:
+    """Node map rep0 -> rep r if they are structurally identical."""
+    m: Dict[int, int] = {}
+    for j in range(period):
+        s0, sr = specs[start + j], specs[start + r * period + j]
+        if (s0.type != sr.type or s0.cfg != sr.cfg
+                or len(s0.inputs) != len(sr.inputs)
+                or len(s0.outputs) != len(sr.outputs)):
+            return None
+        for a, b in zip(s0.inputs, sr.inputs):
+            if m.setdefault(a, b) != b:
+                return None
+        for a, b in zip(s0.outputs, sr.outputs):
+            if m.setdefault(a, b) != b:
+                return None
+    return m
+
+
+def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
+    """Longest chain of isomorphic single-entry/single-exit reps at start."""
+    n = len(specs)
+    if any(not _layer_ok(specs[j], layers[j])
+           for j in range(start, start + period)):
+        return None
+    if not _has_params(layers, start, period):
+        return None
+    ext0, prod0 = _rep_nodes(specs, start, period)
+    if len(ext0) != 1:
+        return None
+    entry = ext0[0]
+    outs = specs[start + period - 1].outputs
+    if len(outs) != 1 or outs[0] not in prod0:
+        return None
+    exit0 = outs[0]
+
+    count, prev_exit = 1, exit0
+    while start + (count + 1) * period <= n:
+        r = count
+        if any(not _layer_ok(specs[start + r * period + j],
+                             layers[start + r * period + j])
+               for j in range(period)):
+            break
+        m = _iso(specs, start, period, r)
+        if m is None or m.get(entry) != prev_exit:
+            break
+        prev_exit = m[exit0]
+        count += 1
+    if count < 2:
+        return None
+    seg = PPSegment(start, period, count, entry, prev_exit)
+    # no internal node may leak: outside the segment, only seg.exit and
+    # nodes that existed before the segment may be consumed
+    internal = set()
+    for j in range(seg.start, seg.stop):
+        internal.update(specs[j].outputs)
+    internal.discard(seg.exit)
+    for j in range(len(specs)):
+        if seg.start <= j < seg.stop:
+            continue
+        if any(x in internal for x in specs[j].inputs):
+            return None
+    return seg
+
+
+def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
+    """The maximal pipelineable segment, or a precise ConfigError."""
+    specs = graph.layers
+    n = len(specs)
+    best: Optional[PPSegment] = None
+    for period in range(1, n // 2 + 1):
+        for start in range(0, n - 2 * period + 1):
+            seg = _count_reps(specs, layers, start, period)
+            if seg and (best is None
+                        or seg.period * seg.count > best.period * best.count):
+                best = seg
+    if best is None:
+        raise ConfigError(
+            "pipeline_parallel > 1 but no repeated block segment found: the "
+            "net needs >= 2 consecutive structurally-identical single-entry/"
+            "single-exit blocks of stateless rng-free layers (e.g. a "
+            "transformer block stack)")
+    if best.count % n_stage:
+        raise ConfigError(
+            "pipeline_parallel = %d must divide the repeated block count %d "
+            "(layers %d..%d)" % (n_stage, best.count, best.start,
+                                 best.stop - 1))
+    return best
+
+
+def run_pp_segment(net, params, h, ctx):
+    """Execute the detected segment through gpipe; returns the exit node."""
+    from ..layers.base import ApplyContext
+    from ..parallel.pipeline import gpipe
+
+    seg: PPSegment = net._pp_segment
+    g = net.graph
+    stacked = {}
+    for j in range(seg.period):
+        per_rep = [net._layer_params(params, seg.start + r * seg.period + j)
+                   for r in range(seg.count)]
+        if per_rep[0]:
+            stacked[str(j)] = {
+                tag: jnp.stack([p[tag] for p in per_rep])
+                for tag in per_rep[0]}
+    # fresh context: no mesh (collectives cannot nest inside gpipe's
+    # shard_map), no labels/losses/states (rejected at detection time)
+    inner_ctx = ApplyContext(train=ctx.train, rng=None,
+                             batch_size=ctx.batch_size,
+                             update_period=ctx.update_period,
+                             epoch=ctx.epoch)
+    base = list(zip(g.layers[seg.start:seg.start + seg.period],
+                    net.layers[seg.start:seg.start + seg.period]))
+
+    def block_fn(pblock, x):
+        local = {seg.entry: x}
+        for j, (spec, layer) in enumerate(base):
+            outs = layer.apply(pblock.get(str(j), {}),
+                               [local[n] for n in spec.inputs], inner_ctx)
+            for n, o in zip(spec.outputs, outs):
+                local[n] = o
+        return local[specs_exit(base, seg)]
+
+    return gpipe(block_fn, stacked, h, net.mesh, net.pipeline_microbatch)
+
+
+def specs_exit(base, seg: PPSegment) -> int:
+    """Exit node id in rep-0 coordinates (last layer's single output)."""
+    return base[-1][0].outputs[0]
