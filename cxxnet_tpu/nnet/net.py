@@ -305,11 +305,7 @@ class Net:
         seg = self._pp_segment
         if seg is None:
             return
-        internal = set()
-        for j in range(seg.start, seg.stop):
-            internal.update(self.graph.layers[j].outputs)
-        internal.discard(seg.exit)
-        if nid in internal:
+        if nid in seg.internal:
             raise ConfigError(
                 "%s %r is internal to the pipelined block segment (layers "
                 "%d..%d) and is not materialized under pipeline_parallel; "
@@ -376,8 +372,15 @@ class Net:
         if not ctx.losses:
             raise ConfigError("network has no loss layer")
         total = sum(ctx.losses[1:], ctx.losses[0])
-        metric_outs = [nodes[n].reshape(nodes[n].shape[0], -1)
-                       for n in sorted(set(self._metric_nodes))]
+        # pin the metric outputs' batch dim to the data axis: under pure
+        # sp/pp meshes XLA may otherwise scatter rows across non-data axes,
+        # leaving a process owning rows that don't line up with its local
+        # label slice (multi-host metric accounting)
+        metric_outs = [
+            jax.lax.with_sharding_constraint(
+                nodes[n].reshape(nodes[n].shape[0], -1),
+                batch_sharding(self.mesh))
+            for n in sorted(set(self._metric_nodes))]
         return total, (metric_outs, ctx.new_states)
 
     # ------------------------------------------------------------- steps
@@ -491,6 +494,22 @@ class Net:
         nproc = jax.process_count()
         if nproc <= 1:
             return self._host_array(x)
+        if self.mesh.shape["data"] == 1:
+            # the batch is replicated over every device (pure sp/ep/pp
+            # meshes): make_array_from_process_local_data then requires
+            # the FULL batch from each process — a blind per-process split
+            # here would silently build a wrong half-size "global" batch
+            if self.dist_feed == "sharded":
+                raise ConfigError(
+                    "dist_feed=sharded needs a data axis spanning the %d "
+                    "processes; this mesh replicates the batch (data=1) — "
+                    "use dist_feed=replicated" % nproc)
+            if x.shape[0] != self.batch_size:
+                raise ValueError(
+                    "replicated-batch mesh expects the full global batch "
+                    "%d per process, got %d rows"
+                    % (self.batch_size, x.shape[0]))
+            return self._host_array(x)
         step = self.batch_size // nproc
         if self.dist_feed == "sharded":
             if x.shape[0] != step:
@@ -522,6 +541,11 @@ class Net:
         n_valid = batch.data.shape[0] - batch.num_batch_padd
         nproc = jax.process_count()
         if nproc <= 1 or self.dist_feed == "sharded":
+            return n_valid
+        if self.mesh.shape["data"] == 1:
+            # replicated-batch meshes (pure sp/ep/pp): every rank holds —
+            # and accounts — the full batch; metrics stay correct because
+            # the cross-process reduction doubles sum and count alike
             return n_valid
         step = self.batch_size // nproc
         return int(np.clip(n_valid - jax.process_index() * step, 0, step))
@@ -567,6 +591,15 @@ class Net:
         node_to_out = {n: local_rows(o) for n, o in zip(uniq, mouts)}
         labels = self._host_labels(self._local_slice(batch.label))
         preds = [node_to_out[n] for n in self._metric_nodes]
+        nloc = next(iter(labels.values())).shape[0] if labels else 0
+        for i, p in enumerate(preds):
+            if p.shape[0] != nloc:
+                # batch replicated over processes (data axis does not span
+                # them, e.g. pure sp/pp meshes): every rank holds all rows;
+                # keep this rank's range to match its local labels
+                r = jax.process_index()
+                assert p.shape[0] >= (r + 1) * nloc, (p.shape, nloc)
+                preds[i] = p[r * nloc:(r + 1) * nloc]
         self.train_metrics.add_eval(preds, labels)
 
     def _host_labels(self, label: np.ndarray) -> Dict[str, np.ndarray]:
@@ -757,6 +790,7 @@ class Net:
             nid = self.graph.num_nodes - k
         else:
             nid = self.graph.node_map[node]
+        self._check_pp_visible(nid, "extract node %r" % (node,))
         out = self._forward_node(batch, nid)
         return out[:self._rank_valid(batch)]
 

@@ -13,16 +13,29 @@ import sys
 
 def main() -> None:
     rank, port, outdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "full"
+    nproc = 4 if mode == "dp4" else 2
+    local_dev = 1 if mode == "dp4" else 2
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["XLA_FLAGS"] = \
+        "--xla_force_host_platform_device_count=%d" % local_dev
     import jax
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     from cxxnet_tpu.parallel.distributed import (init_distributed,
                                                  is_multi_host,
                                                  process_count)
-    init_distributed("127.0.0.1:" + port, 2, rank)
-    assert is_multi_host() and process_count() == 2
+    init_distributed("127.0.0.1:" + port, nproc, rank)
+    assert is_multi_host() and process_count() == nproc
+
+    if mode == "dp4":
+        _dp4_segment(rank, outdir)
+        print("rank", rank, "done")
+        return
+    if mode == "xproc":
+        _xproc_segments(rank, outdir)
+        print("rank", rank, "done")
+        return
 
     import numpy as np
     from cxxnet_tpu import Net
@@ -139,6 +152,71 @@ def main() -> None:
     np.savez(os.path.join(outdir, "hybrid_rank%d.npz" % rank), **hyb)
     print("HYBRID_OK rank%d" % rank)
     print("rank", rank, "done")
+
+
+def _xproc_segments(rank: int, outdir: str) -> None:
+    """Round 4: cross-process collective topologies. Each of the seq,
+    expert, and pipe axes is 4-wide over the 2x2-device process grid, so
+    the axis SPANS the process boundary: ring attention's K/V ppermute
+    (sp4), the MoE dispatch all-to-all (ep4), and gpipe's activation
+    ppermute (pp4) all execute over gloo — the paths the single-process
+    dryrun matrix cannot exercise."""
+    import os
+    import numpy as np
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.models import transformer_config
+    from cxxnet_tpu.utils.config import tokenize
+    from tests.test_multihost import SEQ_KW, flat_params, make_seq_batches
+
+    for tag, kw, extra in (
+            ("sp4", dict(seq_parallel=4), ""),
+            ("ep4", dict(moe_experts=4), "expert_parallel = 4\n"),
+            ("pp4", dict(pipeline_parallel=4, nblock=4), "")):
+        cfg = transformer_config(**dict(SEQ_KW, **kw)) + extra
+        netx = Net(tokenize(cfg))
+        netx.set_param("seed", "11")
+        netx.init_model()
+        ax = {"sp4": "seq", "ep4": "expert", "pp4": "pipe"}[tag]
+        assert netx.mesh.shape[ax] == 4
+        # the 4-wide axis must span both processes
+        procs_on_axis = {d.process_index for d in netx.mesh.devices.ravel()}
+        assert procs_on_axis == {0, 1}, (tag, procs_on_axis)
+        for xb, yb in make_seq_batches():
+
+            class SB:
+                data, label, extra_data = xb, yb, []
+                num_batch_padd = 0
+
+            netx.update(SB)      # replicated feed: full batch on each rank
+        # params shard across processes (expert/pipe axes span them):
+        # get_weight gathers the full tensors on every rank
+        gathered = {"%s/%s" % (k, t): netx._fetch(netx.params[k][t])
+                    for k, tags in netx.params.items() for t in tags}
+        np.savez(os.path.join(outdir, "%s_rank%d.npz" % (tag, rank)),
+                 **gathered)
+        print("%s_OK rank%d" % (tag.upper(), rank))
+
+
+def _dp4_segment(rank: int, outdir: str) -> None:
+    """4 processes x 1 device: plain dp4 with rank-sharded feed."""
+    import numpy as np
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.utils.config import tokenize
+    from tests.test_multihost import CONF, make_batches, flat_params
+
+    net = Net(tokenize(CONF))
+    net.init_model()
+    for xb, yb in make_batches():
+        lo, hi = rank * 4, (rank + 1) * 4
+
+        class B:
+            data, label, extra_data = xb[lo:hi], yb[lo:hi], []
+            num_batch_padd = 0
+
+        net.update(B)
+    np.savez(os.path.join(outdir, "dp4_rank%d.npz" % rank),
+             **flat_params(net))
+    print("DP4_OK rank%d" % rank)
 
 
 if __name__ == "__main__":
