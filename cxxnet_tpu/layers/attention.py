@@ -142,6 +142,7 @@ class MoELayer(Layer):
     weight shardings alone.
     """
     type_name = "moe"
+    emits_aux_loss = True      # appends the load-balance loss to ctx.losses
 
     def __init__(self, spec, cfg):
         self.nexpert = 0
@@ -160,9 +161,9 @@ class MoELayer(Layer):
         elif name == "moe_aux_weight":
             self.aux_weight = float(val)
         elif name == "moe_dispatch":
-            if val not in ("auto", "sort", "dense"):
-                raise ConfigError("moe_dispatch must be auto|sort|dense, "
-                                  "got %r" % val)
+            if val not in ("auto", "sort", "dense", "ragged"):
+                raise ConfigError("moe_dispatch must be auto|sort|dense|"
+                                  "ragged, got %r" % val)
             self.moe_dispatch = val
         elif name == "moe_topk":
             self.moe_topk = int(val)

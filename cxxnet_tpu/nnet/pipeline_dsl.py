@@ -42,6 +42,9 @@ class PPSegment:
     count: int          # number of repetitions
     entry: int          # node id feeding the first repetition
     exit: int           # node id produced by the last repetition
+    # nodes produced inside the segment other than exit — never
+    # materialized under gpipe (metrics/extract must not bind to them)
+    internal: frozenset = frozenset()
 
     @property
     def stop(self) -> int:
@@ -61,8 +64,12 @@ def _rep_nodes(specs, start, period):
 
 
 def _layer_ok(spec, layer) -> bool:
+    # emits_aux_loss (MoE load-balance): run_pp_segment's inner context
+    # discards ctx.losses, so such layers would silently train without
+    # their auxiliary objective — keep them out of pipelined segments
     return not (spec.type == "share" or spec.pairtest is not None
-                or layer.has_state or layer.uses_rng or layer.is_loss)
+                or layer.has_state or layer.uses_rng or layer.is_loss
+                or getattr(layer, "emits_aux_loss", False))
 
 
 def _has_params(layers, start, period) -> bool:
@@ -123,13 +130,14 @@ def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
         count += 1
     if count < 2:
         return None
-    seg = PPSegment(start, period, count, entry, prev_exit)
+    internal = set()
+    for j in range(start, start + period * count):
+        internal.update(specs[j].outputs)
+    internal.discard(prev_exit)
+    seg = PPSegment(start, period, count, entry, prev_exit,
+                    frozenset(internal))
     # no internal node may leak: outside the segment, only seg.exit and
     # nodes that existed before the segment may be consumed
-    internal = set()
-    for j in range(seg.start, seg.stop):
-        internal.update(specs[j].outputs)
-    internal.discard(seg.exit)
     for j in range(len(specs)):
         if seg.start <= j < seg.stop:
             continue
@@ -153,8 +161,9 @@ def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
         raise ConfigError(
             "pipeline_parallel > 1 but no repeated block segment found: the "
             "net needs >= 2 consecutive structurally-identical single-entry/"
-            "single-exit blocks of stateless rng-free layers (e.g. a "
-            "transformer block stack)")
+            "single-exit blocks of stateless rng-free layers without "
+            "auxiliary losses (e.g. a dense transformer block stack; moe "
+            "blocks pipeline only via the models/gpt.py path)")
     if best.count % n_stage:
         raise ConfigError(
             "pipeline_parallel = %d must divide the repeated block count %d "

@@ -164,6 +164,10 @@ def test_dsl_pp_rejections():
     # no repeated segment: single-block net
     with pytest.raises(ConfigError, match="no repeated block segment"):
         _tnet(pp=2, nblock=1)
+    # moe blocks emit an aux loss that gpipe's inner context would drop;
+    # they are excluded from config-path pipelining (gpt.py path instead)
+    with pytest.raises(ConfigError, match="no repeated block segment"):
+        _tnet(pp=2, moe_experts=4)
     # composition boundary: tp/sp/ep inside a pipelined segment is the
     # models/gpt.py path, the config path rejects it at build
     with pytest.raises(ConfigError, match="composes with data parallelism"):
