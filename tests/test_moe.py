@@ -325,3 +325,77 @@ def test_moe_topk2_transformer_trains():
         net.update(DataBatch(ids, lab))
     after = [np.asarray(t) for t in jax.tree.leaves(net.params)]
     assert any(np.abs(a - b).sum() > 0 for a, b in zip(after, before))
+
+
+def test_ragged_matches_sort_when_no_drops():
+    """Dropless ragged dispatch == sort dispatch whenever capacity is ample
+    (no tokens dropped), for k = 1, 2, 3."""
+    rs = np.random.RandomState(11)
+    wg, wu, wd = _weights(rs, e=4, d=8, h=16)
+    x = jnp.asarray(rs.randn(48, 8).astype(np.float32))
+    for k in (1, 2, 3):
+        ref, aux_ref = switch_moe(x, wg, wu, wd, capacity_factor=16.0,
+                                  dispatch="sort", top_k=k)
+        out, aux = switch_moe(x, wg, wu, wd, dispatch="ragged", top_k=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5, err_msg="k=%d" % k)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+
+def test_ragged_is_dropless_under_overflow():
+    """Route everything to one expert: sort with tight capacity drops most
+    tokens; ragged processes all of them."""
+    rs = np.random.RandomState(12)
+    _, wu, wd = _weights(rs, e=4, d=8, h=16)
+    wg = jnp.zeros((8, 4), jnp.float32).at[:, 2].set(50.0)
+    # positive inputs => positive row sums => every token routes to expert 2
+    x = jnp.asarray(np.abs(rs.randn(32, 8)).astype(np.float32) + 0.1)
+    dropped, _ = switch_moe(x, wg, wu, wd, capacity_factor=1.0,
+                            dispatch="sort")
+    full, _ = switch_moe(x, wg, wu, wd, dispatch="ragged")
+    n_zero_drop = int((np.abs(np.asarray(dropped)).max(-1) < 1e-7).sum())
+    n_zero_full = int((np.abs(np.asarray(full)).max(-1) < 1e-7).sum())
+    assert n_zero_drop >= 20          # capacity ceil(32/4) = 8 kept
+    assert n_zero_full == 0           # every token processed
+    # the kept tokens agree between the two paths
+    kept = np.abs(np.asarray(dropped)).max(-1) > 1e-7
+    np.testing.assert_allclose(np.asarray(full)[kept],
+                               np.asarray(dropped)[kept], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ragged_gradients_match_sort():
+    rs = np.random.RandomState(13)
+    wg, wu, wd = _weights(rs, e=4, d=8, h=16)
+    x = jnp.asarray(rs.randn(24, 8).astype(np.float32))
+
+    def loss(disp):
+        def f(xx, g, u, dn):
+            out, aux = switch_moe(xx, g, u, dn, 16.0, dispatch=disp,
+                                  top_k=2)
+            return jnp.sum(out ** 2) + aux
+        return jax.grad(f, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+
+    gr, gs = loss("ragged"), loss("sort")
+    for a, b in zip(gr, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_topk3_per_token_reference():
+    """top_k=3 against a dense per-token reference: renormalized top-3
+    gates, all tokens kept (ample capacity)."""
+    rs = np.random.RandomState(14)
+    wg, wu, wd = _weights(rs, e=5, d=8, h=16)
+    x = jnp.asarray(rs.randn(16, 8).astype(np.float32))
+    out, _ = switch_moe(x, wg, wu, wd, capacity_factor=16.0,
+                        dispatch="sort", top_k=3)
+    probs = np.asarray(jax.nn.softmax(x @ wg, axis=-1))
+    for t in range(16):
+        top3 = np.argsort(-probs[t])[:3]
+        g = probs[t, top3] / probs[t, top3].sum()
+        ref = sum(g[j] * (np.maximum(np.asarray(x[t]) @ np.asarray(wu[e]), 0)
+                          @ np.asarray(wd[e]))
+                  for j, e in enumerate(top3))
+        np.testing.assert_allclose(np.asarray(out[t]), ref, rtol=1e-4,
+                                   atol=1e-5, err_msg="token %d" % t)

@@ -20,10 +20,13 @@ from bench import moe_dispatch_cell  # noqa: E402
 def main() -> int:
     S = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     D, H = 1024, 2048
-    for e in (2, 4, 8, 32):
-        for disp, k in (("dense", 1), ("sort", 1), ("sort", 2)):
+    for e in (2, 4, 8, 32, 64):
+        for disp, k in (("dense", 1), ("sort", 1), ("sort", 2),
+                        ("ragged", 1), ("ragged", 2)):
+            if disp == "dense" and e == 64:
+                continue        # dense one-hot is long out of the race
             dt = moe_dispatch_cell(S, D, H, e, disp, k)
-            print("E=%2d %-5s top%d: %7.2f ms fwd+bwd (S=%d D=%d H=%d)"
+            print("E=%2d %-6s top%d: %7.2f ms fwd+bwd (S=%d D=%d H=%d)"
                   % (e, disp, k, dt * 1e3, S, D, H), flush=True)
     return 0
 
