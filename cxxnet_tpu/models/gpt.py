@@ -616,10 +616,13 @@ def _attn_cached(q, ck, cv, pos):
 
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
-               temperature: float):
+               temperature: float, fused: bool = False):
     """Build (and cache) the jitted prefill+decode program for one
     (config, prompt length, generation length, temperature) signature —
-    repeated gpt_decode calls hit jit's cache instead of retracing."""
+    repeated gpt_decode calls hit jit's cache instead of retracing.
+    ``fused``: run the whole decode step's layer stack as ONE Pallas
+    kernel per batch row (ops/pallas_kernels.fused_decode_step) with
+    bf16 weights double-buffered through VMEM."""
     cfg = GPTConfig(*cfg_key)
     total = n_prompt + max_new
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
@@ -636,6 +639,12 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
         b = prompt.shape[0]
         # fused QKV weights for the whole decode (see _block_core_fusedqkv)
         blocks = _fuse_qkv_blocks(params["blocks"])
+        if fused:
+            # the fused kernel streams weights HBM->VMEM per layer per
+            # token; converting once here halves that traffic (the XLA
+            # path measured bf16 weights SLOWER — an M=1 tiling artifact
+            # the kernel does not share, doc/performance.md round 4)
+            blocks = jax.tree.map(lambda a: a.astype(dtype), blocks)
 
         # ---- prefill: full forward over the prompt, emitting k/v caches
         h = (params["emb"][prompt]
@@ -672,22 +681,37 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                  + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
                                             axis=0)[None]).astype(dtype)
 
-            def layer(carry_h, xs):
-                p, ck, cv = xs
+            if fused:
+                # ONE kernel per token per batch row: grid over layers,
+                # weights double-buffered by the pallas pipeline, h in
+                # VMEM scratch, caches updated by a single dus per cache
+                # (in place — they are token-loop carries). The lax.scan
+                # form instead streams every cache through the scan's
+                # xs->ys, which XLA materializes as a full cache copy per
+                # layer per token — measured 87% of the fused decode step
+                # (doc/performance.md round 4).
+                from ..ops.pallas_kernels import fused_decode_step
+                h, cache_k, cache_v = fused_decode_step(
+                    blocks, h, cache_k, cache_v, pos, n_head)
+            else:
+                def layer(carry_h, xs):
+                    p, ck, cv = xs
 
-                def attn(q, k, v):
-                    kh = jnp.swapaxes(k, 1, 2)         # (b, h, 1, d) free
-                    vh = jnp.swapaxes(v, 1, 2)
-                    ck2 = lax.dynamic_update_slice(ck, kh, (0, 0, pos, 0))
-                    cv2 = lax.dynamic_update_slice(cv, vh, (0, 0, pos, 0))
-                    return _attn_cached(q, ck2, cv2, pos), (ck2, cv2)
+                    def attn(q, k, v):
+                        kh = jnp.swapaxes(k, 1, 2)     # (b, h, 1, d) free
+                        vh = jnp.swapaxes(v, 1, 2)
+                        ck2 = lax.dynamic_update_slice(ck, kh,
+                                                       (0, 0, pos, 0))
+                        cv2 = lax.dynamic_update_slice(cv, vh,
+                                                       (0, 0, pos, 0))
+                        return _attn_cached(q, ck2, cv2, pos), (ck2, cv2)
 
-                out, (ck, cv) = _block_core_fusedqkv(p, carry_h, n_head,
-                                                     attn, identity)
-                return out, (ck, cv)
+                    out, (ck, cv) = _block_core_fusedqkv(
+                        p, carry_h, n_head, attn, identity)
+                    return out, (ck, cv)
 
-            h, (cache_k, cache_v) = lax.scan(
-                layer, h, (blocks, cache_k, cache_v))
+                h, (cache_k, cache_v) = lax.scan(
+                    layer, h, (blocks, cache_k, cache_v))
             hl = _layernorm(h, params["lnf_g"], params["lnf_b"])
             logits = hl[:, 0] @ params["head"].astype(hl.dtype)
             nxt = pick(logits, jax.random.fold_in(rng, i + 1))
@@ -725,8 +749,25 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     import dataclasses
+    from ..ops.pallas_kernels import fused_decode_supported
+    hd = cfg.feat // cfg.n_head
+
+    def _unsharded(leaf):
+        # decode partitioning follows the PARAMS' placements (docstring
+        # above), so the fusion gate inspects them, not the advisory mesh
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        return spec is None or all(ax is None for ax in spec)
+
+    single_shard = (mesh is None or all(
+        mesh.shape.get(ax, 1) == 1 for ax in ("model", "pipe", "seq",
+                                              "expert"))) \
+        and all(_unsharded(x) for x in jax.tree.leaves(params["blocks"]))
+    fused = bool(single_shard and fused_decode_supported(
+        (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
+        cfg.n_head, cfg.feat,
+        itemsize=2 if cfg.dtype == "bfloat16" else 4))
     fn = _decode_fn(dataclasses.astuple(cfg), n_prompt, max_new,
-                    float(temperature))
+                    float(temperature), fused)
     return fn(params, prompt, rng)
 
 

@@ -41,6 +41,7 @@ paths in the callers stay active.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -1243,6 +1244,7 @@ def _flash_bwd_t(causal, block_q, block_k, res, g):
 flash_attention_bhnd.defvjp(_flash_fwd_t, _flash_bwd_t)
 
 __all__ = ["use_pallas", "lrn_fused", "flash_attention",
+           "fused_decode_step", "fused_decode_supported",
            "flash_attention_bhnd", "flash_fwd_with_lse",
            "flash_bwd_blocks",
            "fused_relu_lrn_maxpool", "fused_relu_lrn_maxpool_supported",
@@ -1453,3 +1455,179 @@ def cached_attention(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
         interpret=_INTERPRET,
     )(jnp.asarray(pos, jnp.int32).reshape(1), q, ck, cv)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fused per-layer decode block (round 4)
+# ---------------------------------------------------------------------------
+# The round-3 decode analysis (doc/performance.md) isolated batch-1 decode's
+# binding constraint as per-layer op DISPATCH plus O(cache) scan work — not
+# weight streaming — and named this kernel as the fix: one Pallas call per
+# transformer layer running the ENTIRE pre-LN block (LN1 -> fused-QKV matmul
+# -> KV-cache insert -> cached attention over every head -> proj + residual
+# -> LN2 -> MLP + residual) with the caches updated in place
+# (input_output_aliases). Inference-only, single-shard (the decode path's
+# GSPMD tp/pp composition keeps the unfused form).
+
+
+def _scoped_vmem_kib() -> int:
+    """The configured --xla_tpu_scoped_vmem_limit_kib (default 16 MB)."""
+    import re
+    m = re.search(r"--xla_tpu_scoped_vmem_limit_kib=(\d+)",
+                  os.environ.get("LIBTPU_INIT_ARGS", ""))
+    return int(m.group(1)) if m else 16384
+
+
+def fused_decode_supported(cache_shape, n_head: int, feat: int,
+                           itemsize: int = 2) -> bool:
+    """Whole-step fused decode: BATCH 1 (the kernel's grid re-streams the
+    whole weight stack per batch row — at batch 8/32 the XLA scan path
+    wins), head-major (b, h, S, d) caches, lane-friendly dims, and a
+    scoped-VMEM budget that covers one layer's resident weights + caches
+    with the pipeline's double buffering (~2.2x; compile fails with a
+    scoped-vmem OOM otherwise — bench.py and the GPT example set
+    --xla_tpu_scoped_vmem_limit_kib=65536). ``itemsize``: compute-dtype
+    bytes (2 bf16 / 4 f32). Auto-engaged by the decode path when neither
+    the mesh nor the param placements shard model/pipe/seq/expert dims
+    (models/gpt.py)."""
+    b, h, s, d = cache_shape
+    layer_bytes = (12 * feat * feat + 2 * n_head * s * d) * itemsize
+    need_kib = int(2.2 * layer_bytes) // 1024
+    return (use_pallas() and b == 1 and h == n_head and d * n_head == feat
+            and d % 64 == 0 and s % 8 == 0 and feat % 128 == 0
+            and _scoped_vmem_kib() >= need_kib
+            and os.environ.get("CXN_FUSED_DECODE", "1") == "1")
+
+
+def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
+                         bqkv_ref, wproj_ref, bproj_ref, ln2g_ref, ln2b_ref,
+                         wm1_ref, bm1_ref, wm2_ref, bm2_ref, ck_ref, cv_ref,
+                         out_ref, kwin_ref, vwin_ref, h_scr, *, n_head: int,
+                         n_layer: int, eps: float = 1e-5):
+    """One grid step = one transformer layer of one batch row; grid =
+    (batch, layer). The hidden state rides VMEM scratch across the layer
+    steps (TPU grid steps are sequential), so a WHOLE decode step is ONE
+    kernel dispatch per batch row — and pallas's block pipeline
+    double-buffers the next layer's weights behind this layer's compute."""
+    li = pl.program_id(1)
+    pos = pos_ref[0]
+
+    @pl.when(li == 0)
+    def _():
+        h_scr[...] = h_ref[0]
+
+    x = h_scr[...]                                     # (1, F)
+    f = x.shape[-1]
+    d = f // n_head
+    scale = 1.0 / (d ** 0.5)
+
+    def ln(xf, g_ref, b_ref):
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps)
+                * g_ref[0].astype(jnp.float32)
+                + b_ref[0].astype(jnp.float32))
+
+    xf = x.astype(jnp.float32)
+    xn = ln(xf, ln1g_ref, ln1b_ref).astype(x.dtype)
+    qkv = _mm(xn, wqkv_ref[0]) \
+        + bqkv_ref[0].astype(jnp.float32)            # (1, 3F) f32
+    q = qkv[:, :f]
+    kfr = [qkv[:, f + hd * d:f + (hd + 1) * d].astype(ck_ref.dtype)
+           for hd in range(n_head)]
+    vfr = [qkv[:, 2 * f + hd * d:2 * f + (hd + 1) * d].astype(cv_ref.dtype)
+           for hd in range(n_head)]
+    base = (pos // 8) * 8
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0) + base
+    for hd in range(n_head):
+        win_k = ck_ref[0, 0, hd, pl.dslice(base, 8), :]     # (8, D)
+        win_v = cv_ref[0, 0, hd, pl.dslice(base, 8), :]
+        kwin_ref[0, 0, hd] = jnp.where(rowi == pos, kfr[hd], win_k)
+        vwin_ref[0, 0, hd] = jnp.where(rowi == pos, vfr[hd], win_v)
+
+    rows = [_mm_t(q[:, hd * d:(hd + 1) * d].astype(x.dtype),
+                  ck_ref[0, 0, hd]) for hd in range(n_head)]
+    s = jnp.concatenate(rows, axis=0) * scale           # (H, S) f32
+    s_fresh = jnp.concatenate(
+        [jnp.sum(q[:, hd * d:(hd + 1) * d]
+                 * kfr[hd].astype(jnp.float32), axis=1, keepdims=True)
+         for hd in range(n_head)], axis=0) * scale      # (H, 1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(idx == pos, s_fresh, s)
+    s = jnp.where(idx <= pos, s, _NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=1, keepdims=True)           # (H, S) f32
+    p_pos = jnp.sum(jnp.where(idx == pos, p, 0.0), axis=1, keepdims=True)
+    p0 = jnp.where(idx == pos, 0.0, p).astype(cv_ref.dtype)
+    att = [_mm(p0[hd:hd + 1], cv_ref[0, 0, hd])
+           + p_pos[hd:hd + 1] * vfr[hd].astype(jnp.float32)
+           for hd in range(n_head)]
+    o = jnp.concatenate(att, axis=-1).astype(x.dtype)   # (1, F)
+    h2f = xf + _mm(o, wproj_ref[0]) + bproj_ref[0].astype(jnp.float32)
+
+    x2n = ln(h2f, ln2g_ref, ln2b_ref).astype(x.dtype)
+    m1 = jnp.maximum(_mm(x2n, wm1_ref[0])
+                     + bm1_ref[0].astype(jnp.float32), 0.0)
+    y = _mm(m1.astype(x.dtype), wm2_ref[0])
+    new_h = (h2f + y + bm2_ref[0].astype(jnp.float32)).astype(x.dtype)
+    h_scr[...] = new_h
+
+    @pl.when(li == n_layer - 1)
+    def _():
+        out_ref[0] = new_h.astype(out_ref.dtype)
+
+
+def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
+    """Run the WHOLE decode step's layer stack as one kernel per batch row.
+
+    blocks: the stacked (L, ...) fused-QKV weight dict, already in the
+    compute dtype; h: (b, 1, F); ck/cv: (L, b, H, S, D) stacked head-major
+    caches (the prefill layout); pos: traced i32. Returns (h_out, ck', cv')
+    with each layer's cache updated at pos via one dynamic_update_slice
+    per cache (in-place when ck/cv are loop carries).
+    """
+    b, _, f = h.shape
+    nl, _, nh, s, d = ck.shape
+    dt = h.dtype
+    row = lambda a: a.reshape(nl, 1, -1)
+    w = {k: blocks[k] for k in ("w_qkv", "w_proj", "w_mlp1", "w_mlp2")}
+    v = {k: row(blocks[k]) for k in ("ln1_g", "ln1_b", "b_qkv", "b_proj",
+                                     "ln2_g", "ln2_b", "b_mlp1", "b_mlp2")}
+    wspec = lambda a: pl.BlockSpec((1,) + a.shape[1:],
+                                   lambda bi, li: (li,) + (0,) * (a.ndim - 1))
+    vspec = lambda a: pl.BlockSpec((1, 1, a.shape[-1]),
+                                   lambda bi, li: (li, 0, 0))
+    kern = functools.partial(_decode_token_kernel, n_head=n_head,
+                             n_layer=nl)
+    out, kwin, vwin = pl.pallas_call(
+        kern,
+        grid=(b, nl),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, 1, f), lambda bi, li: (bi, 0, 0)),
+                  vspec(v["ln1_g"]), vspec(v["ln1_b"]), wspec(w["w_qkv"]),
+                  vspec(v["b_qkv"]), wspec(w["w_proj"]), vspec(v["b_proj"]),
+                  vspec(v["ln2_g"]), vspec(v["ln2_b"]), wspec(w["w_mlp1"]),
+                  vspec(v["b_mlp1"]), wspec(w["w_mlp2"]), vspec(v["b_mlp2"]),
+                  pl.BlockSpec((1, 1, nh, s, d),
+                               lambda bi, li: (li, bi, 0, 0, 0)),
+                  pl.BlockSpec((1, 1, nh, s, d),
+                               lambda bi, li: (li, bi, 0, 0, 0))],
+        out_specs=[pl.BlockSpec((1, 1, f), lambda bi, li: (bi, 0, 0)),
+                   pl.BlockSpec((1, 1, nh, 8, d),
+                                lambda bi, li: (li, bi, 0, 0, 0)),
+                   pl.BlockSpec((1, 1, nh, 8, d),
+                                lambda bi, li: (li, bi, 0, 0, 0))],
+        out_shape=[_out_struct((b, 1, f), dt, h),
+                   _out_struct((nl, b, nh, 8, d), ck.dtype, ck),
+                   _out_struct((nl, b, nh, 8, d), cv.dtype, cv)],
+        scratch_shapes=[pltpu.VMEM((1, f), dt)],
+        interpret=_INTERPRET,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), h.reshape(b, 1, f),
+      v["ln1_g"], v["ln1_b"], w["w_qkv"], v["b_qkv"], w["w_proj"],
+      v["b_proj"], v["ln2_g"], v["ln2_b"], w["w_mlp1"], v["b_mlp1"],
+      w["w_mlp2"], v["b_mlp2"], ck, cv)
+    base = (pos // 8) * 8
+    ck2 = jax.lax.dynamic_update_slice(ck, kwin, (0, 0, 0, base, 0))
+    cv2 = jax.lax.dynamic_update_slice(cv, vwin, (0, 0, 0, base, 0))
+    return out.reshape(b, 1, f), ck2, cv2

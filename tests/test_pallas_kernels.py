@@ -272,3 +272,67 @@ def test_cached_attention_matches_reference(monkeypatch):
         ref = jnp.einsum("bhqk,bhkd->bhqd", w, cv)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+def make_decode_reference(rs, nl=3, b=2, nh=4, s=64, d=64, pos=21,
+                          dtype="float32"):
+    """Shared fixture for the fused-decode differentials (also imported by
+    tools/tpu_smoke.py): stacked block weights, inputs, and the jnp
+    layer-stack reference function."""
+    import jax.numpy as jnp
+    from jax import lax
+    from cxxnet_tpu.models.gpt import _attn_cached, _block_core_fusedqkv
+
+    f = nh * d
+    m = 4 * f
+    blocks = {k: jnp.asarray(rs.randn(nl, *shp) * sc, jnp.float32)
+              for k, shp, sc in (
+                  ("ln1_g", (f,), 0.1), ("ln1_b", (f,), 0.1),
+                  ("w_qkv", (f, 3 * f), 0.05), ("b_qkv", (3 * f,), 0.02),
+                  ("w_proj", (f, f), 0.05), ("b_proj", (f,), 0.02),
+                  ("ln2_g", (f,), 0.1), ("ln2_b", (f,), 0.1),
+                  ("w_mlp1", (f, m), 0.05), ("b_mlp1", (m,), 0.02),
+                  ("w_mlp2", (m, f), 0.05), ("b_mlp2", (f,), 0.02))}
+    blocks["ln1_g"] = blocks["ln1_g"] + 1.0
+    blocks["ln2_g"] = blocks["ln2_g"] + 1.0
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    h = jnp.asarray(rs.randn(b, 1, f) * 0.5, dt)
+    ck = jnp.asarray(rs.randn(nl, b, nh, s, d) * 0.3, dt)
+    cv = jnp.asarray(rs.randn(nl, b, nh, s, d) * 0.3, dt)
+
+    def reference(bb, hh):
+        def layer(carry_h, xs):
+            p, ckl, cvl = xs
+
+            def attn(q, k, v):
+                kh = jnp.swapaxes(k, 1, 2)
+                vh = jnp.swapaxes(v, 1, 2)
+                ck2 = lax.dynamic_update_slice(ckl, kh, (0, 0, pos, 0))
+                cv2 = lax.dynamic_update_slice(cvl, vh, (0, 0, pos, 0))
+                return _attn_cached(q, ck2, cv2, pos), (ck2, cv2)
+
+            out, (c1, c2) = _block_core_fusedqkv(p, carry_h, nh, attn,
+                                                 lambda t: t)
+            return out, (c1, c2)
+
+        return jax.lax.scan(layer, hh, (bb, ck, cv))
+
+    return blocks, h, ck, cv, pos, nh, reference
+
+
+def test_fused_decode_step_matches_jnp(monkeypatch):
+    """Whole-step fused decode kernel (grid over layers, h in scratch,
+    window cache outputs) vs the jnp decode math, interpret mode."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    rs = np.random.RandomState(7)
+    blocks, h, ck, cv, pos, nh, reference = make_decode_reference(rs)
+    ref_h, (ref_ck, ref_cv) = reference(blocks, h)
+    out, ck2, cv2 = pk.fused_decode_step(blocks, h, ck, cv, pos, nh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_h),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ck2), np.asarray(ref_ck),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cv2), np.asarray(ref_cv),
+                               rtol=2e-5, atol=2e-5)

@@ -189,6 +189,32 @@ def smoke_cached_attention():
     print("pallas cached-attention decode kernel: maxdiff %.3g" % err)
 
 
+def smoke_fused_decode():
+    """The whole-step decode kernel must keep compiling under Mosaic and
+    match the jnp layer-stack math numerically (token-id comparison is
+    meaningless on random weights: near-uniform logits flip argmax at
+    1-ulp differences). Fixture shared with
+    tests/test_pallas_kernels.py::test_fused_decode_step_matches_jnp."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tests.test_pallas_kernels import make_decode_reference
+    from cxxnet_tpu.ops import pallas_kernels as pk
+
+    rs = np.random.RandomState(7)
+    blocks, h, ck, cv, pos, nh, reference = make_decode_reference(
+        rs, dtype="bfloat16")
+    ref_h, _ = jax.jit(reference)(blocks, h)
+    out, _, _ = jax.jit(
+        lambda bb, hh, c1, c2: pk.fused_decode_step(bb, hh, c1, c2, pos,
+                                                    nh))(blocks, h, ck, cv)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref_h.astype(jnp.float32))))
+    assert err < 0.1, err      # <= a few bf16 ulps at these magnitudes
+    print("fused whole-step decode kernel: maxdiff %.3g vs jnp stack"
+          % err)
+
+
 def main() -> int:
     import jax
     from cxxnet_tpu.ops import pallas_kernels
@@ -201,7 +227,7 @@ def main() -> int:
     t0 = time.time()
     for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
                smoke_ring_kernels, smoke_flash_streaming, smoke_pallas_lrn,
-               smoke_decode, smoke_cached_attention):
+               smoke_decode, smoke_cached_attention, smoke_fused_decode):
         fn()
     print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
     return 0
