@@ -6,6 +6,8 @@ Prints one JSON line per metric, in this order:
   3. gpt_train_tokens_per_sec       (305M d128 flagship, batch 24)
   4. gpt_train_mfu_param_attn       (diff vs round-3's 0.620)
   5. moe_dispatch_tokens_per_sec    (E=32 sort top-2 fwd+bwd, S=16384)
+  6. gpt_decode_ms_per_token        (85M batch-1, cache 1024, fused
+                                     whole-step kernel; r3 quoted 0.74)
 
 Round 3's bench emitted only the AlexNet line, which had plateaued at the
 chip's proven streaming ceiling — the driver-recorded BENCH_r*.json could no
@@ -224,9 +226,40 @@ def bench_moe():
     emit("moe_dispatch_tokens_per_sec", S / dt, "tokens/sec")
 
 
+def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
+                batch=1, reps=3):
+    """Best-of-reps seconds/token for KV-cache decode — the single
+    measurement definition shared with tools/decode_bench.py."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+
+    cfg = GPTConfig(vocab_size=256, seq_len=seq, n_layer=layers,
+                    n_head=heads, feat=feat, n_microbatch=1,
+                    dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(0)
+    prompt = jax.numpy.asarray(
+        rs.randint(0, 256, (batch, prompt_len)).astype(np.int32))
+    max_new = seq - prompt_len
+    np.asarray(gpt_decode(params, prompt, max_new, cfg))    # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(gpt_decode(params, prompt, max_new, cfg))
+        best = min(best, time.perf_counter() - t0)
+    return best / max_new
+
+
+def bench_decode():
+    """Batch-1 KV-cache decode on the 85M model (fused whole-step kernel
+    auto-engages; tools/decode_bench.py is the A/B harness)."""
+    emit("gpt_decode_ms_per_token", decode_cell(reps=2) * 1e3, "ms/token")
+
+
 def main() -> int:
     rc = 0
-    for fn in (bench_alexnet, bench_resnet50, bench_gpt, bench_moe):
+    for fn in (bench_alexnet, bench_resnet50, bench_gpt, bench_moe,
+               bench_decode):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

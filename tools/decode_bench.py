@@ -1,8 +1,10 @@
 """Batch-1 KV-cache decode benchmark: fused whole-step kernel vs XLA scan.
 
 The round-3 analysis pinned batch-1 decode as per-layer-dispatch +
-O(cache)-scan bound and named the fused kernel as the fix; this
-measures it (CXN_FUSED_DECODE=1 default vs =0 for the unfused A/B).
+O(cache)-scan bound and named the fused kernel as the fix; this measures
+it (CXN_FUSED_DECODE=1 default vs =0 for the unfused A/B). The
+measurement cell itself lives in bench.py (decode_cell) so the headline
+metric and this A/B harness share one definition.
 
 Usage: python tools/decode_bench.py [--layers 12 --heads 12 --feat 768]
 """
@@ -10,14 +12,16 @@ Usage: python tools/decode_bench.py [--layers 12 --heads 12 --feat 768]
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# the fused per-layer kernel holds a layer's bf16 weights + caches resident
-# in VMEM (~20 MB at the 85M shapes) — same setting bench.py uses
+# the fused whole-step decode kernel keeps a layer's bf16 weights + caches
+# resident in VMEM (ops/pallas_kernels.fused_decode_supported gates on
+# this being configured); also +4% on the conv zoo, neutral on GPT train
 os.environ.setdefault("LIBTPU_INIT_ARGS",
                       "--xla_tpu_scoped_vmem_limit_kib=65536")
+
+from bench import decode_cell  # noqa: E402
 
 
 def main() -> int:
@@ -31,28 +35,9 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args()
 
-    import numpy as np
-    import jax
-    from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
-
-    cfg = GPTConfig(vocab_size=256, seq_len=args.seq, n_layer=args.layers,
-                    n_head=args.heads, feat=args.feat, n_microbatch=1,
-                    dtype="bfloat16")
-    params = gpt_init(jax.random.PRNGKey(0), cfg)
-    rs = np.random.RandomState(0)
-    prompt = jax.numpy.asarray(
-        rs.randint(0, 256, (args.batch, args.prompt)).astype(np.int32))
-    max_new = args.seq - args.prompt
-
-    out = gpt_decode(params, prompt, max_new, cfg)   # compile
-    np.asarray(out)
-    best = float("inf")
-    for _ in range(args.reps):
-        t0 = time.perf_counter()
-        out = gpt_decode(params, prompt, max_new, cfg)
-        np.asarray(out)
-        best = min(best, time.perf_counter() - t0)
-    ms_tok = best / max_new * 1e3
+    dt = decode_cell(args.layers, args.heads, args.feat, args.seq,
+                     args.prompt, args.batch, args.reps)
+    ms_tok = dt * 1e3
     print("fused=%s  %dL x %dh x f%d, cache %d: %.3f ms/token (%.0f tok/s)"
           % (os.environ.get("CXN_FUSED_DECODE", "1"), args.layers,
              args.heads, args.feat, args.seq, ms_tok, 1000.0 / ms_tok))
