@@ -37,10 +37,12 @@ def main() -> int:
 
     dt = decode_cell(args.layers, args.heads, args.feat, args.seq,
                      args.prompt, args.batch, args.reps)
-    ms_tok = dt * 1e3
-    print("fused=%s  %dL x %dh x f%d, cache %d: %.3f ms/token (%.0f tok/s)"
+    ms_step = dt * 1e3
+    agg = args.batch * 1000.0 / ms_step
+    print("fused=%s  %dL x %dh x f%d, cache %d, batch %d: %.3f ms/step "
+          "(%.0f tok/s aggregate)"
           % (os.environ.get("CXN_FUSED_DECODE", "1"), args.layers,
-             args.heads, args.feat, args.seq, ms_tok, 1000.0 / ms_tok))
+             args.heads, args.feat, args.seq, args.batch, ms_step, agg))
     return 0
 
 
