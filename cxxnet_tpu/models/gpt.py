@@ -614,6 +614,11 @@ def _attn_cached(q, ck, cv, pos):
     return jnp.swapaxes(out, 1, 2)                     # (b, 1, h, d)
 
 
+# decode signatures whose fused compile hit a scoped-VMEM OOM (see
+# gpt_decode's fallback) — they use the XLA scan from then on
+_FUSED_DECODE_BLOCKLIST: set = set()
+
+
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                temperature: float, fused: bool = False):
@@ -766,9 +771,28 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
         cfg.n_head, cfg.feat,
         itemsize=2 if cfg.dtype == "bfloat16" else 4))
-    fn = _decode_fn(dataclasses.astuple(cfg), n_prompt, max_new,
-                    float(temperature), fused)
-    return fn(params, prompt, rng)
+    cfg_key = dataclasses.astuple(cfg)
+    if (cfg_key, n_prompt, max_new) in _FUSED_DECODE_BLOCKLIST:
+        fused = False
+    fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused)
+    try:
+        return fn(params, prompt, rng)
+    except Exception as e:                              # noqa: BLE001
+        # the supported() VMEM estimate is approximate; a Mosaic scoped-
+        # vmem compile OOM on a large shape degrades to the XLA scan
+        # (sticky per signature) instead of failing the decode
+        msg = str(e).lower()
+        if not fused or ("vmem" not in msg and "memory" not in msg):
+            raise
+        import sys
+        print("gpt_decode: fused kernel exceeded the scoped-VMEM budget "
+              "for this shape; falling back to the XLA scan (raise "
+              "--xla_tpu_scoped_vmem_limit_kib to re-enable)",
+              file=sys.stderr)
+        _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new))
+        fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
+                        False)
+        return fn(params, prompt, rng)
 
 
 def gpt_data_sharding(mesh: Mesh) -> NamedSharding:

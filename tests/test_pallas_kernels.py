@@ -326,13 +326,14 @@ def test_fused_decode_step_matches_jnp(monkeypatch):
     from cxxnet_tpu.ops import pallas_kernels as pk
 
     monkeypatch.setattr(pk, "_INTERPRET", True)
-    rs = np.random.RandomState(7)
-    blocks, h, ck, cv, pos, nh, reference = make_decode_reference(rs)
-    ref_h, (ref_ck, ref_cv) = reference(blocks, h)
-    out, ck2, cv2 = pk.fused_decode_step(blocks, h, ck, cv, pos, nh)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_h),
-                               rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(ck2), np.asarray(ref_ck),
-                               rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(cv2), np.asarray(ref_cv),
-                               rtol=2e-5, atol=2e-5)
+    for b in (1, 2, 5):     # batch rows share each layer's weight fetch
+        rs = np.random.RandomState(7)
+        blocks, h, ck, cv, pos, nh, reference = make_decode_reference(rs, b=b)
+        ref_h, (ref_ck, ref_cv) = reference(blocks, h)
+        out, ck2, cv2 = pk.fused_decode_step(blocks, h, ck, cv, pos, nh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_h),
+                                   rtol=2e-5, atol=2e-5, err_msg="b=%d" % b)
+        np.testing.assert_allclose(np.asarray(ck2), np.asarray(ref_ck),
+                                   rtol=2e-5, atol=2e-5, err_msg="b=%d" % b)
+        np.testing.assert_allclose(np.asarray(cv2), np.asarray(ref_cv),
+                                   rtol=2e-5, atol=2e-5, err_msg="b=%d" % b)

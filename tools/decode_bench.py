@@ -16,8 +16,10 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # the fused whole-step decode kernel keeps a layer's bf16 weights + caches
-# resident in VMEM (ops/pallas_kernels.fused_decode_supported gates on
-# this being configured); also +4% on the conv zoo, neutral on GPT train
+# resident in VMEM. 64 MB is fastest for the 85M shapes (96 MB measured
+# -18% there); the 303M batched cells need
+# LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=98304 (gpt_decode
+# falls back to the XLA scan with a notice when the budget is short)
 os.environ.setdefault("LIBTPU_INIT_ARGS",
                       "--xla_tpu_scoped_vmem_limit_kib=65536")
 
