@@ -763,9 +763,9 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         spec = getattr(getattr(leaf, "sharding", None), "spec", None)
         return spec is None or all(ax is None for ax in spec)
 
-    single_shard = (mesh is None or all(
-        mesh.shape.get(ax, 1) == 1 for ax in ("model", "pipe", "seq",
-                                              "expert"))) \
+    # the Pallas kernel is a Mosaic custom call GSPMD cannot partition:
+    # any multi-device axis (including data) keeps the XLA scan path
+    single_shard = (mesh is None or mesh.devices.size == 1) \
         and all(_unsharded(x) for x in jax.tree.leaves(params["blocks"]))
     fused = bool(single_shard and fused_decode_supported(
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),

@@ -196,6 +196,8 @@ def run_pp_segment(net, params, h, ctx):
     base = list(zip(g.layers[seg.start:seg.start + seg.period],
                     net.layers[seg.start:seg.start + seg.period]))
 
+    exit0 = base[-1][0].outputs[0]     # rep-0 coordinates of the exit node
+
     def block_fn(pblock, x):
         local = {seg.entry: x}
         for j, (spec, layer) in enumerate(base):
@@ -203,11 +205,6 @@ def run_pp_segment(net, params, h, ctx):
                                [local[n] for n in spec.inputs], inner_ctx)
             for n, o in zip(spec.outputs, outs):
                 local[n] = o
-        return local[specs_exit(base, seg)]
+        return local[exit0]
 
     return gpipe(block_fn, stacked, h, net.mesh, net.pipeline_microbatch)
-
-
-def specs_exit(base, seg: PPSegment) -> int:
-    """Exit node id in rep-0 coordinates (last layer's single output)."""
-    return base[-1][0].outputs[0]

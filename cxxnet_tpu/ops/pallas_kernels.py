@@ -1458,16 +1458,19 @@ def cached_attention(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# fused per-layer decode block (round 4)
+# fused whole-step decode kernel (round 4)
 # ---------------------------------------------------------------------------
 # The round-3 decode analysis (doc/performance.md) isolated batch-1 decode's
 # binding constraint as per-layer op DISPATCH plus O(cache) scan work — not
-# weight streaming — and named this kernel as the fix: one Pallas call per
-# transformer layer running the ENTIRE pre-LN block (LN1 -> fused-QKV matmul
-# -> KV-cache insert -> cached attention over every head -> proj + residual
-# -> LN2 -> MLP + residual) with the caches updated in place
-# (input_output_aliases). Inference-only, single-shard (the decode path's
-# GSPMD tp/pp composition keeps the unfused form).
+# weight streaming — and named this kernel as the fix: ONE Pallas dispatch
+# per decode step runs the entire layer stack (layer-major grid; each grid
+# step = LN1 -> fused-QKV matmul -> cache-window update -> cached attention
+# over every head -> proj + residual -> LN2 -> MLP + residual). Each layer's
+# updated aligned 8-row cache window is emitted stacked; the caller splices
+# it back with one dynamic_update_slice per cache (in place, because the
+# caches are token-loop carries). Inference-only, single-device (a Mosaic
+# custom call cannot be GSPMD-partitioned; sharded decode keeps the XLA
+# scan).
 
 
 def _scoped_vmem_kib() -> int:
