@@ -190,3 +190,42 @@ def test_dsl_pp_internal_node_guard():
     net2 = Net(tokenize(cfg))
     with pytest.raises(ConfigError, match="internal to the pipelined"):
         net2.init_model()
+
+
+def test_dsl_pp_through_cli(tmp_path, capfd):
+    """pipeline_parallel from an on-disk config through the CLI task — the
+    outermost user surface (config file -> LearnTask -> pipelined net)."""
+    import os
+    from cxxnet_tpu.cli import LearnTask
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 32, (64, 32)).astype(np.uint8)
+    labels = rs.randint(0, 10, 64)
+    # idx-format files for the mnist iterator (1x32 'images' = token ids)
+    import gzip
+    import struct
+    with gzip.open(tmp_path / "img.gz", "wb") as f:
+        f.write(struct.pack(">iiii", 2051, 64, 1, 32))
+        f.write(ids.tobytes())
+    with gzip.open(tmp_path / "lab.gz", "wb") as f:
+        f.write(struct.pack(">ii", 2049, 64))
+        f.write(labels.astype(np.uint8).tobytes())
+
+    cfg = transformer_config(seq_len=32, vocab_size=32, feat=32, nhead=4,
+                             nblock=2, num_classes=10, batch_size=16,
+                             dev="cpu", pipeline_parallel=2)
+    conf = tmp_path / "pp.conf"
+    conf.write_text("""
+data = train
+iter = mnist
+    path_img = "%s"
+    path_label = "%s"
+iter = end
+%s
+num_round = 2
+max_round = 2
+save_model = 0
+""" % (tmp_path / "img.gz", tmp_path / "lab.gz", cfg))
+    assert LearnTask().run([str(conf)]) == 0
+    err = capfd.readouterr().err
+    assert "[1]" in err and "train-error:" in err
