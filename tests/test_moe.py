@@ -399,3 +399,30 @@ def test_topk3_per_token_reference():
                   for j, e in enumerate(top3))
         np.testing.assert_allclose(np.asarray(out[t]), ref, rtol=1e-4,
                                    atol=1e-5, err_msg="token %d" % t)
+
+
+def test_moe_ragged_dispatch_through_config():
+    """moe_dispatch=ragged from the config DSL trains and tracks the sort
+    path (ample capacity => identical routing)."""
+    cfg = transformer_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                             nblock=1, num_classes=4, batch_size=8,
+                             dev="cpu:0", moe_experts=4)
+    rs = np.random.RandomState(5)
+    x = rs.randint(0, 32, (8, 1, 1, 16)).astype(np.float32)
+    y = rs.randint(0, 4, (8, 1)).astype(np.float32)
+
+    nets = {}
+    for disp in ("sort", "ragged"):
+        net = Net(tokenize(cfg + "\nmoe_dispatch = %s\n"
+                                 "capacity_factor = 16\n" % disp))
+        net.set_param("seed", "3")
+        net.init_model()
+        for _ in range(3):
+            net.update(DataBatch(x, y))
+        nets[disp] = net
+    for k in nets["sort"].params:
+        for tag in nets["sort"].params[k]:
+            np.testing.assert_allclose(
+                np.asarray(nets["ragged"].params[k][tag]),
+                np.asarray(nets["sort"].params[k][tag]),
+                rtol=2e-4, atol=2e-5, err_msg="%s/%s" % (k, tag))
