@@ -47,6 +47,17 @@ BASELINE_IMAGES_PER_SEC = 800.0
 GPT_MFU_ROUND3 = 0.620          # BENCH_r03-era flagship MFU, for diffing
 V5E_BF16_PEAK = 197e12          # one v5e chip, bf16 MXU
 
+# Round-4 recorded values (BENCH_r04.json), pinned as baselines so a
+# regression in ANY headline metric shows up as vs_baseline < 1 in the next
+# driver run instead of needing an eyeball diff across BENCH_r*.json files
+# (VERDICT r4 weak #5). Throughput metrics report value/baseline; the decode
+# latency metric reports baseline/value — in every line >1.0 means better
+# than round 4.
+R4_RESNET50_IPS = 2309.06
+R4_GPT_TOKENS_PER_SEC = 64619.5
+R4_MOE_TOKENS_PER_SEC = 913375.5
+R4_DECODE_MS_PER_TOKEN = 0.3934
+
 
 def gpt_model_flops(n_params, batch, seq, feat, layers):
     """Strict model FLOPs per step: 6*N per token (fwd 2N + bwd 4N) plus
@@ -149,7 +160,9 @@ def bench_resnet50():
     dt = _cnn_step_time(resnet_config(50, batch_size=batch, dev="",
                                       precision="bfloat16"),
                         batch, warmup=3, steps=20)
-    emit("resnet50_train_images_per_sec", batch / dt, "images/sec")
+    ips = batch / dt
+    emit("resnet50_train_images_per_sec", ips, "images/sec",
+         ips / R4_RESNET50_IPS)
 
 
 def bench_gpt():
@@ -185,7 +198,9 @@ def bench_gpt():
     tokens = batch * seq
     flops = gpt_model_flops(n_params, batch, seq, cfg.feat, cfg.n_layer)
     mfu = flops / dt / V5E_BF16_PEAK
-    emit("gpt_train_tokens_per_sec", tokens / dt, "tokens/sec")
+    tps = tokens / dt
+    emit("gpt_train_tokens_per_sec", tps, "tokens/sec",
+         tps / R4_GPT_TOKENS_PER_SEC)
     emit("gpt_train_mfu_param_attn", mfu, "fraction", mfu / GPT_MFU_ROUND3)
 
 
@@ -223,7 +238,9 @@ def bench_moe():
     """Sort-based top-2 dispatch at E=32 (tools/moe_bench.py headline cell)."""
     S = 16384
     dt = moe_dispatch_cell(S, 1024, 2048, 32, "sort", 2)
-    emit("moe_dispatch_tokens_per_sec", S / dt, "tokens/sec")
+    tps = S / dt
+    emit("moe_dispatch_tokens_per_sec", tps, "tokens/sec",
+         tps / R4_MOE_TOKENS_PER_SEC)
 
 
 def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
@@ -253,7 +270,9 @@ def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
 def bench_decode():
     """Batch-1 KV-cache decode on the 85M model (fused whole-step kernel
     auto-engages; tools/decode_bench.py is the A/B harness)."""
-    emit("gpt_decode_ms_per_token", decode_cell(reps=2) * 1e3, "ms/token")
+    ms = decode_cell(reps=2) * 1e3
+    emit("gpt_decode_ms_per_token", ms, "ms/token",
+         R4_DECODE_MS_PER_TOKEN / ms)
 
 
 def main() -> int:

@@ -126,10 +126,12 @@ class MoELayer(Layer):
 
     Config: ``nexpert``, ``nhidden`` (per-expert hidden width),
     ``capacity_factor``, ``moe_aux_weight`` (load-balance loss weight),
-    ``moe_dispatch`` (sort | dense, the single-logical-shard strategy —
-    doc/performance.md measures the crossover), ``moe_topk`` (1 = switch
-    top-1; 2 = GShard top-2, renormalized gates, first choices win
-    capacity).
+    ``moe_dispatch`` (auto | sort | dense | ragged, the single-logical-
+    shard strategy — doc/performance.md measures the sort/dense
+    crossover; ragged is the DROPLESS variant: no capacity limit, every
+    token is served via a ragged grouped matmul), ``moe_topk`` (1 =
+    switch top-1; 2 = GShard top-2, renormalized gates, first choices
+    win capacity).
     Weights: "gate" (F, E), "w_up" (E, F, H), "w_down" (E, H, F) — the
     expert dim is sharded over the dedicated ``expert`` mesh axis
     (``expert_parallel = k``) when present, else over ``model``.
@@ -210,6 +212,18 @@ class MoELayer(Layer):
         ep = mesh.shape.get(EXPERT_AXIS, 1) if mesh is not None else 1
         nd = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
         if ep > 1 and (b * n) % (ep * nd) == 0 and self.nexpert % ep == 0:
+            if self.moe_dispatch == "ragged":
+                # ragged is a SEMANTIC choice (dropless), not a strategy
+                # hint: the all-to-all path groups capacity per source
+                # shard and DROPS overflow tokens, so silently honoring
+                # ep>1 would reintroduce exactly the drops the user opted
+                # out of — fail loudly instead (ADVICE r4)
+                raise ConfigError(
+                    "moe %s: moe_dispatch=ragged (dropless) cannot run "
+                    "under expert_parallel>1 — the all-to-all dispatch "
+                    "drops tokens over capacity; use moe_dispatch=auto/"
+                    "sort/dense with expert_parallel, or expert_parallel=1 "
+                    "for dropless" % self.spec.key())
             if self.moe_dispatch != "auto" and not self._warned_dispatch:
                 # the expert-parallel all-to-all path groups capacity per
                 # source shard (GShard semantics), which differs from the

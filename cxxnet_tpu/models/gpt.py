@@ -780,9 +780,14 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     except Exception as e:                              # noqa: BLE001
         # the supported() VMEM estimate is approximate; a Mosaic scoped-
         # vmem compile OOM on a large shape degrades to the XLA scan
-        # (sticky per signature) instead of failing the decode
+        # (sticky per signature) instead of failing the decode. Matched
+        # on 'vmem' or 'scoped'+'memory' (the two Mosaic scoped-memory
+        # phrasings) but NOT bare 'memory': an unrelated HBM OOM must
+        # not trigger a pointless second trace of the unfused path
+        # before failing (ADVICE r4)
         msg = str(e).lower()
-        if not fused or ("vmem" not in msg and "memory" not in msg):
+        scoped = "vmem" in msg or ("scoped" in msg and "memory" in msg)
+        if not fused or not scoped:
             raise
         import sys
         print("gpt_decode: fused kernel exceeded the scoped-VMEM budget "

@@ -1581,9 +1581,17 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
     y = _mm(m1.astype(x.dtype), wm2_ref[0])
     new_h = (h2f + y + bm2_ref[0].astype(jnp.float32)).astype(x.dtype)
     h_scr[bi] = new_h
-    # the out block (this row) is revisited every layer; the write at the
-    # last layer is the one that lands (intermediate flushes are tiny)
-    out_ref[0] = new_h.astype(out_ref.dtype)
+
+    # the out block (this row) is revisited every layer; guarding on the
+    # last layer makes the "last write wins" contract EXPLICIT instead of
+    # an implicit Mosaic flush-order assumption (ADVICE r4). The block is
+    # still DMA'd back each grid step (bi is the fast dim, so the block
+    # index changes every step) — the guard buys correctness-by-
+    # construction, not traffic; pre-final flushes just carry don't-care
+    # data that the final layer's write overwrites
+    @pl.when(li == pl.num_programs(0) - 1)
+    def _():
+        out_ref[0] = new_h.astype(out_ref.dtype)
 
 
 def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):

@@ -426,3 +426,22 @@ def test_moe_ragged_dispatch_through_config():
                 np.asarray(nets["ragged"].params[k][tag]),
                 np.asarray(nets["sort"].params[k][tag]),
                 rtol=2e-4, atol=2e-5, err_msg="%s/%s" % (k, tag))
+
+
+def test_moe_ragged_rejects_expert_parallel():
+    """moe_dispatch=ragged is a dropless SEMANTIC choice; the ep>1
+    all-to-all path drops overflow tokens, so the combination must fail
+    loudly at first trace instead of silently dropping (ADVICE r4)."""
+    import pytest
+    from cxxnet_tpu.utils.config import ConfigError
+    cfg = transformer_config(seq_len=16, vocab_size=16, feat=16, nhead=2,
+                             nblock=1, num_classes=4, batch_size=16,
+                             dev="cpu:0-7", moe_experts=4)
+    cfg += "\nexpert_parallel = 4\nmoe_dispatch = ragged\n"
+    net = Net(tokenize(cfg))
+    net.init_model()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 16, (16, 1, 1, 16)).astype(np.float32)
+    lab = rs.randint(0, 4, (16, 1)).astype(np.float32)
+    with pytest.raises(ConfigError, match="dropless"):
+        net.update(DataBatch(ids, lab))
