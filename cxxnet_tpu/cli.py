@@ -3,7 +3,9 @@
 
 Usage: ``python -m cxxnet_tpu <config> [k=v ...]``
 
-Tasks (``task = ...``): train (default) / finetune / pred / extract.
+Tasks (``task = ...``): train (default) / finetune / pred / extract /
+generate (autoregressive decode from a GPT-shaped net — prompt_file in,
+token ids out; the fused whole-step decode kernel auto-engages).
 Config sections: ``data = <name> ... iter = end`` (training set),
 ``eval = <name> ... iter = end`` (eval sets), ``pred = <path> ... iter = end``
 (prediction input). Global pairs outside sections are broadcast to the trainer
@@ -61,6 +63,12 @@ class LearnTask:
         self.extract_node_name = ""
         self.output_format = 1
         self.name_pred = "pred.txt"
+        self.prompt_file = ""     # task=generate: token-id prompts, one
+        #                           space-separated sequence per line
+        self.num_gen = 32         # task=generate: tokens to generate
+        self.temperature = 0.0    # 0 = greedy, else categorical sampling
+        self.generate_out = "gen.txt"
+        self.generate_bench = 0   # 1: print warm ms/token after a warmup
         self.net: Optional[Net] = None
         self.itr_train = None
         self.itr_evals = []
@@ -108,6 +116,16 @@ class LearnTask:
             self.save_on_preempt = int(val)
         elif name == "extract_node_name":
             self.extract_node_name = val
+        elif name == "prompt_file":
+            self.prompt_file = val
+        elif name == "num_gen":
+            self.num_gen = int(val)
+        elif name == "temperature":
+            self.temperature = float(val)
+        elif name == "generate_out":
+            self.generate_out = val
+        elif name == "generate_bench":
+            self.generate_bench = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -135,6 +153,8 @@ class LearnTask:
             self.task_predict()
         elif self.task == "extract":
             self.task_extract()
+        elif self.task == "generate":
+            self.task_generate()
         else:
             raise ValueError("unknown task %r" % self.task)
         return 0
@@ -231,10 +251,10 @@ class LearnTask:
             # section config first, then globals — matching the reference's
             # CreateIterator-then-InitIter(defcfg) order (cxxnet_main.cpp:254-262)
             full = scfg + defcfg + extra
-            if sflag == 1 and self.task != "pred":
+            if sflag == 1 and self.task not in ("pred", "generate"):
                 assert self.itr_train is None, "can only have one data section"
                 self.itr_train = create_iterator(full)
-            elif sflag == 2 and self.task != "pred":
+            elif sflag == 2 and self.task not in ("pred", "generate"):
                 self.itr_evals.append(create_iterator(full))
                 self.eval_names.append(sname)
             elif sflag == 3 and self.task in ("pred", "extract"):
@@ -391,6 +411,59 @@ class LearnTask:
             self.start_counter += 1
         if not self.silent:
             print("\nupdating end, %d sec in all" % int(time.time() - start))
+
+    def task_generate(self) -> None:
+        """Autoregressive generation from a GPT-shaped model (the inference
+        twin of ``pred`` for sequence models — no reference counterpart,
+        SURVEY §5.7): reads ``prompt_file`` (one space-separated token-id
+        sequence per line, equal lengths batch together), generates
+        ``num_gen`` tokens each (``temperature`` 0 = greedy), writes the
+        full sequences to ``generate_out``. ``generate_bench = 1`` also
+        prints the warm per-token latency (the fused whole-step decode
+        kernel auto-engages on one chip, ops/pallas_kernels.py)."""
+        import jax
+
+        from .nnet.lm import net_generate, net_gpt_export
+        assert self.prompt_file, "task=generate needs prompt_file=<path>"
+        prompts = []
+        with open(self.prompt_file) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    prompts.append([int(t) for t in line.split()])
+        assert prompts, "prompt_file %r is empty" % self.prompt_file
+        if len({len(p) for p in prompts}) != 1:
+            raise ValueError(
+                "task=generate: all prompt lines must have equal length "
+                "(got lengths %s) so they batch into one decode"
+                % sorted({len(p) for p in prompts}))
+        batch = np.asarray(prompts, np.int32)
+        rng = (jax.random.PRNGKey(int(time.time()))
+               if self.temperature > 0 else None)
+        print("start generating (%d prompts, %d tokens each)..."
+              % (batch.shape[0], self.num_gen))
+        # export the weight tree ONCE: repeated net_generate calls (the
+        # warm-timing pass below) must time the decode, not the export
+        export = net_gpt_export(self.net)
+        t0 = time.time()
+        out = net_generate(self.net, batch, self.num_gen,
+                           temperature=self.temperature, rng=rng,
+                           export=export)
+        dt = time.time() - t0
+        with open(self.generate_out, "w") as fo:
+            for row in out:
+                fo.write(" ".join(str(int(t)) for t in row) + "\n")
+        print("finished generation, write into %s (%.1fs incl. compile)"
+              % (self.generate_out, dt))
+        if self.generate_bench:
+            t0 = time.time()
+            net_generate(self.net, batch, self.num_gen,
+                         temperature=self.temperature, rng=rng,
+                         export=export)
+            warm = time.time() - t0
+            print("generate_bench: %.4f ms/token warm (batch %d, %d new "
+                  "tokens)" % (warm * 1e3 / self.num_gen, batch.shape[0],
+                               self.num_gen))
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, "must specify a pred iterator"

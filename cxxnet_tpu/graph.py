@@ -41,7 +41,7 @@ KNOWN_LAYER_TYPES = frozenset([
     "insanity_max_pooling", "l2_loss", "multi_logistic", "ch_concat", "prelu",
     "batch_norm", "share",
     # sequence/long-context extensions (no reference counterpart, SURVEY §5.7)
-    "attention", "layer_norm", "add", "embedding", "moe",
+    "attention", "layer_norm", "add", "embedding", "moe", "lm_softmax",
     # external-framework adapter plugin (caffe_adapter-inl.hpp analogue)
     "torch",
 ])

@@ -8,6 +8,7 @@ from . import batch      # noqa: F401
 from . import imgbin     # noqa: F401  (imgbin/imgbinx/imgbinold)
 from . import img        # noqa: F401
 from . import attach_txt  # noqa: F401
+from . import lm         # noqa: F401
 
 __all__ = ["DataBatch", "DataInst", "IIterator", "create_iterator",
            "register_base_iterator", "register_proc_iterator"]

@@ -60,7 +60,9 @@ class InMemoryIterator(IIterator):
                 "%s iterator: batch_size must be set > 0 before init "
                 "(got %d)" % (tag, self.batch_size))
         self.img = img.astype(self._dtype)
-        self.labels = labels.astype(np.float32).reshape(img.shape[0], 1)
+        # labels keep their width (class iterators pass (n,) -> (n, 1);
+        # the lm iterator passes (n, seq) token-id label fields)
+        self.labels = labels.astype(np.float32).reshape(img.shape[0], -1)
         n = img.shape[0]
         self.inst = np.arange(n, dtype=np.uint32) + self.inst_offset
         if self.shuffle:

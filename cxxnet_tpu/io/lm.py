@@ -1,0 +1,106 @@
+"""Token-stream iterator for causal-LM training (``iter = lm``).
+
+No reference counterpart (the reference has no sequence models, SURVEY
+§5.7); this extends the reference's whole-dataset-in-memory iterator
+pattern (iter_mnist-inl.hpp:14-158 via InMemoryIterator) to token streams
+so the GPT flagship trains from a config file through the standard CLI.
+
+Reads a flat token stream and serves contiguous ``seq_len`` windows:
+data (b, 1, 1, N) float ids, and the SAME window as the width-N label
+field — a causal LM's target is its input shifted by one, and the shift
+happens inside the ``lm_softmax`` loss (layers/loss.py), so data and
+label are identical windows.
+
+Input formats (``path_data``, gz-transparent like every dataset input):
+  *.npy             — any integer dtype, loaded with numpy
+  ``format = bytes``  — raw bytes as uint8 tokens (byte-level LM: any
+                        text file is a corpus)
+  otherwise         — raw binary of ``token_dtype`` (uint8/uint16/uint32,
+                        default uint16)
+
+Config: ``seq_len`` (window length, required), ``stride`` (window step,
+default seq_len — non-overlapping), plus the shared in-memory keys
+(shuffle / seed_data / batch_size / silent). ``data_dtype`` is
+intentionally IGNORED (ids must stay exact; bfloat16 has 8 mantissa bits
+and would corrupt ids > 256 — the trainer keeps id entry nodes in f32 and
+casts to the compute dtype after embedding lookup, nnet/net.py).
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+
+from .data import register_base_iterator
+from .inmem import InMemoryIterator
+
+
+def _read_bytes(path: str) -> bytes:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rb") as f:
+            return f.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+@register_base_iterator("lm")
+class LMIterator(InMemoryIterator):
+    def __init__(self) -> None:
+        super().__init__()
+        self.path_data = ""
+        self.seq_len = 0
+        self.stride = 0
+        self.format = "auto"          # auto | npy | bytes | bin
+        self.token_dtype = np.uint16
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "path_data":
+            self.path_data = val
+        elif name == "seq_len":
+            self.seq_len = int(val)
+        elif name == "stride":
+            self.stride = int(val)
+        elif name == "format":
+            if val not in ("auto", "npy", "bytes", "bin"):
+                raise ValueError("lm iterator: format must be "
+                                 "auto|npy|bytes|bin, got %r" % val)
+            self.format = val
+        elif name == "token_dtype":
+            if val not in ("uint8", "uint16", "uint32"):
+                raise ValueError("lm iterator: token_dtype must be "
+                                 "uint8|uint16|uint32, got %r" % val)
+            self.token_dtype = np.dtype(val).type
+        elif name == "data_dtype":
+            pass    # ids stay exact f32 (module docstring)
+        else:
+            super().set_param(name, val)
+
+    def _load_tokens(self) -> np.ndarray:
+        fmt = self.format
+        if fmt == "auto":
+            base = self.path_data[:-3] if self.path_data.endswith(".gz") \
+                else self.path_data
+            fmt = "npy" if base.endswith(".npy") else "bin"
+        if fmt == "npy":
+            import io as _io
+            return np.load(_io.BytesIO(_read_bytes(self.path_data)))
+        raw = _read_bytes(self.path_data)
+        if fmt == "bytes":
+            return np.frombuffer(raw, np.uint8)
+        return np.frombuffer(raw, self.token_dtype)
+
+    def init(self) -> None:
+        if self.seq_len <= 0:
+            raise ValueError("lm iterator: seq_len must be set > 0")
+        tok = np.asarray(self._load_tokens()).ravel()
+        n = self.seq_len
+        if tok.size < n:
+            raise ValueError(
+                "lm iterator: token stream %r has %d tokens < seq_len %d"
+                % (self.path_data, tok.size, n))
+        stride = self.stride if self.stride > 0 else n
+        starts = np.arange(0, tok.size - n + 1, stride)
+        win = tok[starts[:, None] + np.arange(n)].astype(np.float32)
+        self._dtype = np.float32      # ids stay exact (module docstring)
+        self._finalize_load(win.reshape(-1, 1, 1, n), win, "LMIterator")

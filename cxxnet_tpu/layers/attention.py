@@ -20,8 +20,9 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import (local_attention, ring_attention,
-                             ulysses_attention)
+from ..ops.attention import (local_attention, local_attention_bhnd,
+                             ring_attention, ring_attention_bhnd,
+                             ulysses_attention, ulysses_attention_bhnd)
 from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
 from ..utils.config import ConfigError
 from .base import ApplyContext, Layer, Params, Shape3, register_layer
@@ -117,7 +118,10 @@ class EmbeddingLayer(Layer):
     def apply(self, params, inputs, ctx):
         ids = inputs[0].reshape(inputs[0].shape[0], -1).astype(jnp.int32)
         emb = jnp.take(params["wmat"], ids, axis=0) + params["pos"]
-        return [emb[:, :, None, :]]     # (b, N, 1, F)
+        # the net's precision applies from here: the id entry node stays
+        # exact f32 (bf16 ids would corrupt vocab > 256), the embedded
+        # activations carry the compute dtype downstream
+        return [emb.astype(ctx.compute_dtype)[:, :, None, :]]   # (b,N,1,F)
 
 
 @register_layer
@@ -300,6 +304,15 @@ class AttentionLayer(Layer):
     Weights: "qkv" (3F, F), "proj" (F, F) (+ "qkv_bias"/"proj_bias" unless
     no_bias). ``nhead`` heads; ``causal = 1`` for autoregressive masking.
     Ring attention engages when the trainer mesh's ``seq`` axis is > 1.
+
+    ``attn_layout`` (auto | bnhd | bhnd) picks the flash-kernel-boundary
+    layout, the same measured rule as the models/gpt.py flagship
+    (gpt.py GPTConfig.attn_layout): ``bhnd`` projects straight into the
+    kernels' head-major (b, heads, n, head_dim) layout via per-head
+    einsums so XLA inserts no transpose at the kernel boundary — a win
+    when head_dim >= 128 (lane-native), a loss below (measured round
+    2/3, doc/performance.md); ``auto`` applies that rule. Composes with
+    both sequence-parallel modes (the sp cores are head-major).
     """
     type_name = "attention"
     uses_rng = False
@@ -308,6 +321,7 @@ class AttentionLayer(Layer):
         self.nhead = 1
         self.causal = 0
         self.seq_parallel_mode = "ring"
+        self.attn_layout = "auto"
         super().__init__(spec, cfg)
 
     def set_param(self, name, val):
@@ -320,6 +334,11 @@ class AttentionLayer(Layer):
                 raise ConfigError("seq_parallel_mode must be ring|ulysses, "
                                   "got %r" % val)
             self.seq_parallel_mode = val
+        elif name == "attn_layout":
+            if val not in ("auto", "bnhd", "bhnd"):
+                raise ConfigError("attn_layout must be auto|bnhd|bhnd, "
+                                  "got %r" % val)
+            self.attn_layout = val
 
     def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
         c, y, x = self.check_one_to_one(in_shapes)
@@ -352,24 +371,57 @@ class AttentionLayer(Layer):
         x = inputs[0]                       # (b, N, 1, F)
         b, n, _, f = x.shape
         h = self.nhead
+        layout = self.attn_layout
+        if layout == "auto":
+            # measured rule shared with the gpt.py flagship
+            # (gpt_logits, doc/performance.md round 3): head-major iff
+            # the per-head projection width is lane-native
+            layout = "bhnd" if f // h >= 128 else "bnhd"
         xs = x.reshape(b, n, f)
-        qkv = xs @ params["qkv"].astype(xs.dtype).T
-        if "qkv_bias" in params:
-            qkv = qkv + params["qkv_bias"].astype(qkv.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, n, h, f // h)
-        k = k.reshape(b, n, h, f // h)
-        v = v.reshape(b, n, h, f // h)
         mesh = ctx.mesh
-        if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
-            sp_attn = (ulysses_attention
-                       if self.seq_parallel_mode == "ulysses"
-                       else ring_attention)
-            out = sp_attn(q, k, v, mesh, axis_name=SEQ_AXIS,
-                          causal=bool(self.causal))
+        sp = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
+        if layout == "bhnd":
+            # project straight into the kernels' head-major layout:
+            # qkv rows are [q; k; v] blocks of F, each row j mapping to
+            # (head j//d, dim j%d) — reshape (3F, F) -> (3, h, d, F)
+            w = params["qkv"].astype(xs.dtype).reshape(3, h, f // h, f)
+            qh = jnp.einsum("bnf,hdf->bhnd", xs, w[0])
+            kh = jnp.einsum("bnf,hdf->bhnd", xs, w[1])
+            vh = jnp.einsum("bnf,hdf->bhnd", xs, w[2])
+            if "qkv_bias" in params:
+                bias = params["qkv_bias"].astype(qh.dtype).reshape(
+                    3, h, f // h)
+                qh = qh + bias[0][None, :, None, :]
+                kh = kh + bias[1][None, :, None, :]
+                vh = vh + bias[2][None, :, None, :]
+            if sp:
+                sp_attn = (ulysses_attention_bhnd
+                           if self.seq_parallel_mode == "ulysses"
+                           else ring_attention_bhnd)
+                att = sp_attn(qh, kh, vh, mesh, axis_name=SEQ_AXIS,
+                              causal=bool(self.causal))
+            else:
+                att = local_attention_bhnd(qh, kh, vh,
+                                           causal=bool(self.causal))
+            wp = params["proj"].astype(x.dtype).reshape(f, h, f // h)
+            out = jnp.einsum("bhnd,fhd->bnf", att, wp)
         else:
-            out = local_attention(q, k, v, causal=bool(self.causal))
-        out = out.reshape(b, n, f) @ params["proj"].astype(x.dtype).T
+            qkv = xs @ params["qkv"].astype(xs.dtype).T
+            if "qkv_bias" in params:
+                qkv = qkv + params["qkv_bias"].astype(qkv.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, n, h, f // h)
+            k = k.reshape(b, n, h, f // h)
+            v = v.reshape(b, n, h, f // h)
+            if sp:
+                sp_attn = (ulysses_attention
+                           if self.seq_parallel_mode == "ulysses"
+                           else ring_attention)
+                out = sp_attn(q, k, v, mesh, axis_name=SEQ_AXIS,
+                              causal=bool(self.causal))
+            else:
+                out = local_attention(q, k, v, causal=bool(self.causal))
+            out = out.reshape(b, n, f) @ params["proj"].astype(x.dtype).T
         if "proj_bias" in params:
             out = out + params["proj_bias"].astype(out.dtype)
         return [out.reshape(b, n, 1, f)]

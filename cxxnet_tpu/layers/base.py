@@ -129,10 +129,15 @@ class ApplyContext:
                  sample_mask: Optional[jnp.ndarray] = None,
                  batch_size: int = 0, update_period: int = 1,
                  epoch=0, states: Optional[dict] = None,
-                 mesh=None) -> None:
+                 mesh=None, compute_dtype=jnp.float32) -> None:
         self.train = train
         self.mesh = mesh    # device mesh (static); lets layers pick
                             # sequence-parallel implementations
+        # activation dtype (the net's `precision`): most layers derive it
+        # from their input's dtype (the data node is cast on entry), but
+        # integer-indexed entries (embedding ids) must stay exact f32, so
+        # the embedding lookup reads the target dtype from here instead
+        self.compute_dtype = compute_dtype
         self._rng = rng
         self._rng_count = 0
         self.labels = labels or {}

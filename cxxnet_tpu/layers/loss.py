@@ -90,6 +90,53 @@ class SoftmaxLayer(LossLayer):
 
 
 @register_layer
+class LMSoftmaxLayer(LossLayer):
+    """Causal language-model loss on sequence nodes: next-token
+    cross-entropy over every position (position i predicts token i+1; the
+    last position predicts nothing — models/gpt.py:gpt_loss semantics,
+    exposed through the config DSL so the GPT flagship trains from a
+    netconfig file).
+
+    Input node: (b, N, 1, V) per-position logits. Target: a label field of
+    width N holding the token ids themselves (for an LM the label IS the
+    input sequence — the data pipeline feeds ids as both data and label).
+    Loss per sample = mean NLL over the N-1 predicting positions, then the
+    reference loss scaling (grad_scale / (batch * update_period)) over the
+    batch sum — equal to gpt_loss's flat mean at grad_scale 1. Forward
+    emits per-position probabilities (prediction/extraction see them, like
+    every loss layer)."""
+    type_name = "lm_softmax"
+
+    def infer_shapes(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        shape = super().infer_shapes(in_shapes)
+        if shape[0][2] != 1 or shape[0][1] < 2:
+            raise ConfigError(
+                "lm_softmax: expects (vocab, seq>=2, 1) sequence nodes, "
+                "got %r" % (shape[0],))
+        return shape
+
+    def apply(self, params: Params, inputs, ctx: ApplyContext):
+        x = inputs[0]                            # (b, N, 1, V)
+        b, n, _, v = x.shape
+        logits = x.reshape(b, n, v)
+        if ctx.train:
+            ids = self.get_label(ctx)
+            if ids.shape[1] != n:
+                raise ConfigError(
+                    "lm_softmax: label field %r has width %d, need the %d "
+                    "token ids (label = the input sequence)"
+                    % (self.target, ids.shape[1], n))
+            tgt = ids[:, 1:].astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
+                                      axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            mask = self.mask1(ctx, b)
+            ctx.losses.append(
+                jnp.sum(jnp.mean(nll, axis=-1) * mask) * self.scale(ctx))
+        return [jax.nn.softmax(logits, axis=-1).reshape(x.shape)]
+
+
+@register_layer
 class L2LossLayer(LossLayer):
     """Identity forward; loss 0.5*||pred - label||^2 per sample."""
     type_name = "l2_loss"

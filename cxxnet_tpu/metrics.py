@@ -90,6 +90,28 @@ class MetricLogloss(Metric):
         return res
 
 
+class MetricLMNLL(Metric):
+    """Per-token negative log-likelihood of a causal LM (no reference
+    counterpart — the reference has no sequence models, SURVEY §5.7).
+    pred: the flattened (n, seq*vocab) probabilities of an ``lm_softmax``
+    node; label: the (n, seq) token ids (position i's prediction is
+    scored against token i+1; the last position predicts nothing).
+    Perplexity = exp(lm_nll)."""
+    name = "lm_nll"
+
+    def calc(self, pred, label):
+        b, nv = pred.shape
+        n = label.shape[1]
+        if n < 2 or nv % n:
+            raise ValueError(
+                "lm_nll: prediction width %d is not seq*vocab for label "
+                "width %d" % (nv, n))
+        probs = pred.reshape(b, n, nv // n)
+        tgt = label[:, 1:].astype(np.int64)
+        p = np.take_along_axis(probs[:, :-1], tgt[..., None], axis=-1)[..., 0]
+        return -np.log(np.clip(p, 1e-15, None)).mean(axis=1)
+
+
 class MetricRecall(Metric):
     def __init__(self, name: str) -> None:
         m = re.match(r"^rec@(\d+)$", name)
@@ -123,6 +145,8 @@ def create_metric(name: str) -> Metric:
         return MetricRMSE()
     if name == "logloss":
         return MetricLogloss()
+    if name == "lm_nll":
+        return MetricLMNLL()
     if name.startswith("rec@"):
         return MetricRecall(name)
     raise ValueError("unknown metric name %r" % name)

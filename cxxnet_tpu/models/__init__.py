@@ -8,8 +8,9 @@ LeNet-style conv, kaggle-bowl CNN, ImageNet AlexNet, Inception-BN, VGG-16.
 from .alexnet import ALEXNET_NETCONFIG, alexnet_config
 from .inception_bn import inception_bn_config
 from .resnet import resnet_config
-from .transformer import transformer_config
+from .transformer import gpt_lm_config, transformer_config
 from .vgg import vgg16_config
 
-__all__ = ["ALEXNET_NETCONFIG", "alexnet_config", "inception_bn_config",
-           "resnet_config", "transformer_config", "vgg16_config"]
+__all__ = ["ALEXNET_NETCONFIG", "alexnet_config", "gpt_lm_config",
+           "inception_bn_config", "resnet_config", "transformer_config",
+           "vgg16_config"]

@@ -86,6 +86,19 @@ class GPTConfig:
     #                             composes with BOTH sp modes (ring and
     #                             ulysses cores are head-major; pinned by
     #                             test_gpt.py layout-equivalence tests).
+    pipeline_schedule: str = "gpipe"  # "gpipe": every-stage-every-tick
+    #                             schedule, differentiated by autodiff —
+    #                             composes with sp/ep and stays the
+    #                             default; "1f1b": one-forward-one-
+    #                             backward schedule with the loss
+    #                             computed in the last stage
+    #                             (parallel/pipeline_1f1b.py): no garbage
+    #                             bubble compute, no whole-output psum,
+    #                             O(P) in-flight activations instead of
+    #                             O(M) — the pp >= 4 memory/schedule
+    #                             lever. Composes dp x pp x tp (sp/ep
+    #                             need gpipe); remat is implicit (stage-
+    #                             granularity recompute).
     remat_mode: str = "block"   # "block": whole-block remat (max memory
     #                             savings — the long-context mode) — the
     #                             DEFAULT, and measured fastest or tied at
@@ -120,16 +133,18 @@ def _layernorm(x, g, b, eps=1e-5):
 
 
 def _attn_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
-               attn, reduce):
+               attn, reduce, pre=lambda x: x):
     """Attention half of the pre-LN block (LN1 -> QKV -> attn -> proj ->
     residual). ``attn(q4, k4, v4) -> (att4, aux)`` supplies the attention
     variant (full-causal, ring, or KV-cached); ``reduce`` combines
     row-sharded matmul partials (lax.psum inside shard_map, identity under
-    GSPMD jit). Separate Q/K/V projections so the model-axis shard of each
+    GSPMD jit); ``pre`` marks the tensor-parallel region's entry on the
+    manually-VJP'd 1F1B path (megatron's f operator — identity otherwise).
+    Separate Q/K/V projections so the model-axis shard of each
     is a whole set of heads (a fused (F,3F) weight sharded on its last dim
     would hand rank 0 all of Q and half of K instead)."""
     b, n, _ = h.shape
-    x = _layernorm(h, p["ln1_g"], p["ln1_b"])
+    x = pre(_layernorm(h, p["ln1_g"], p["ln1_b"]))
     # separate Q/K/V matmuls: a trace-time concat into one fused (F, 3F)
     # product measured 7% SLOWER end-to-end (451 vs 422 ms @ 303M) — the
     # per-layer weight concat re-runs inside the scan (and again in the
@@ -145,7 +160,7 @@ def _attn_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
 
 
 def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
-                    attn_bhnd, reduce):
+                    attn_bhnd, reduce, pre=lambda x: x):
     """Head-major attention half: projections go straight into the flash
     kernels' native (b, heads, n, head_dim) layout (einsum bnf,fhd->bhnd)
     and the output projection consumes it (bhnd,hdf->bnf), so XLA never
@@ -156,7 +171,7 @@ def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     copies it saves (measured round 2), at d=128 it wins (measured round
     3, doc/performance.md)."""
     b, n, f = h.shape
-    x = _layernorm(h, p["ln1_g"], p["ln1_b"])
+    x = pre(_layernorm(h, p["ln1_g"], p["ln1_b"]))
 
     def proj(w, bias):
         w = w.astype(x.dtype).reshape(f, n_head, -1)       # (f, h, d)
@@ -172,10 +187,11 @@ def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     return h + o + p["b_proj"].astype(x.dtype)
 
 
-def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce):
+def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce,
+              pre=lambda x: x):
     """MLP half of the pre-LN block (LN2 -> up -> relu -> down ->
     residual)."""
-    x = _layernorm(h, p["ln2_g"], p["ln2_b"])
+    x = pre(_layernorm(h, p["ln2_g"], p["ln2_b"]))
     m = jax.nn.relu(x @ p["w_mlp1"].astype(x.dtype) + p["b_mlp1"].astype(x.dtype))
     m = reduce(m @ p["w_mlp2"].astype(x.dtype))
     return h + m + p["b_mlp2"].astype(x.dtype)
@@ -284,6 +300,88 @@ def _block_mlp_remat(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
                                                       sp_mode),
                           reduce)
     return jax.checkpoint(lambda pp, hh: _mlp_core(pp, hh, reduce))(p, h)
+
+
+def _block_1f1b(p: Dict[str, jnp.ndarray], h: jnp.ndarray, *,
+                n_head_local: int, layout: str = "bnhd") -> jnp.ndarray:
+    """Training block for the manually-VJP'd 1F1B schedule: the same
+    math as `_block`, with megatron's conjugate f/g operators bracketing
+    each tensor-parallel region (tp_region_in: identity fwd / psum bwd at
+    the LN output; tp_region_out: psum fwd / identity bwd at the
+    row-sharded projection) so `jax.vjp` of the per-device body computes
+    the correct cross-shard cotangents without shard_map's automatic
+    replication-aware transposes (parallel/pipeline_1f1b.py)."""
+    from ..parallel.pipeline_1f1b import tp_region_in, tp_region_out
+    pre = lambda t: tp_region_in(t, MODEL_AXIS)
+    reduce = lambda t: tp_region_out(t, MODEL_AXIS)
+    if layout == "bhnd":
+        h = _attn_core_bhnd(p, h, n_head_local,
+                            lambda q, k, v: _train_attn_bhnd(q, k, v,
+                                                             False),
+                            reduce, pre)
+        return _mlp_core(p, h, reduce, pre)
+    out, _ = _block_core_pre(p, h, n_head_local,
+                             lambda q, k, v: _train_attn(q, k, v, False),
+                             reduce, pre)
+    return out
+
+
+def _block_core_pre(p, h, n_head, attn, reduce, pre):
+    h, aux = _attn_core(p, h, n_head, attn, reduce, pre)
+    return _mlp_core(p, h, reduce, pre), aux
+
+
+def _gpt_1f1b_loss_and_grads(params: Dict, ids: jnp.ndarray,
+                             cfg: GPTConfig, mesh: Mesh):
+    """(loss, grads) via the 1F1B pipeline schedule
+    (parallel/pipeline_1f1b.py): embedding forward + its VJP run under
+    GSPMD outside the schedule; the block stack runs the manual
+    one-forward-one-backward schedule with the head/loss computed in the
+    last stage; the entry cotangent closes the embedding backward.
+    Composes dp x pp x tp; sequence/expert parallelism stay on the gpipe
+    schedule (gpt_loss)."""
+    from ..parallel.pipeline_1f1b import pipeline_1f1b
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    n_tp = mesh.shape.get(MODEL_AXIS, 1)
+    if mesh.shape.get(SEQ_AXIS, 1) > 1:
+        raise ValueError(
+            "pipeline_schedule='1f1b' composes dp x pp x tp; "
+            "seq_parallel needs pipeline_schedule='gpipe'")
+    if cfg.n_head % max(n_tp, 1):
+        raise ValueError("n_head %d must divide over model axis %d"
+                         % (cfg.n_head, n_tp))
+    layout = cfg.attn_layout
+    if layout == "auto":
+        layout = "bhnd" if cfg.feat // cfg.n_head >= 128 else "bnhd"
+
+    def emb_fn(ep):
+        return (ep["emb"][ids]
+                + ep["pos"][None, :ids.shape[1]]).astype(dtype)
+
+    h, emb_vjp = jax.vjp(emb_fn, {"emb": params["emb"],
+                                  "pos": params["pos"]})
+
+    def head_loss(lp, hh, tgt):
+        hl = _layernorm(hh, lp["lnf_g"], lp["lnf_b"])
+        logits = (hl @ lp["head"].astype(hl.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        tgt2 = tgt[:, 1:].astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, tgt2[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    block = functools.partial(_block_1f1b,
+                              n_head_local=cfg.n_head // max(n_tp, 1),
+                              layout=layout)
+    lp = {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+          "head": params["head"]}
+    loss, gblocks, glp, dxs = pipeline_1f1b(
+        block, params["blocks"], head_loss, lp, h, ids, mesh,
+        cfg.n_microbatch, param_specs=_block_param_specs())
+    (demb,) = emb_vjp(dxs.astype(h.dtype))
+    grads = {"emb": demb["emb"], "pos": demb["pos"],
+             "lnf_g": glp["lnf_g"], "lnf_b": glp["lnf_b"],
+             "head": glp["head"], "blocks": gblocks}
+    return loss, grads
 
 
 def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
@@ -513,8 +611,18 @@ def make_train_step(cfg: GPTConfig, mesh: Mesh, eta: float = 0.1,
     def constrain_opt(tree):
         return jax.lax.with_sharding_constraint(tree, opt_shardings)
 
+    if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError("pipeline_schedule must be 'gpipe' or '1f1b', "
+                         "got %r" % (cfg.pipeline_schedule,))
+
+    def loss_and_grads(params, ids):
+        if cfg.pipeline_schedule == "1f1b" \
+                and mesh.shape.get(PIPE_AXIS, 1) > 1:
+            return _gpt_1f1b_loss_and_grads(params, ids, cfg, mesh)
+        return jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
+
     def step(params, opt, ids):
-        loss, grads = jax.value_and_grad(gpt_loss)(params, ids, cfg, mesh)
+        loss, grads = loss_and_grads(params, ids)
         if optimizer == "sgd":
             new_opt = jax.tree.map(lambda m, g: momentum * m - eta * g,
                                    opt, grads)

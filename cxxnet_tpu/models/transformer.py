@@ -52,6 +52,76 @@ def transformer_block(L, src: str, out: str, i: int, feat: int, nhead: int,
     L.append("layer[%s,%s_r->%s] = add" % (b, b, out))
 
 
+def gpt_lm_config(seq_len: int = 128, vocab_size: int = 256,
+                  feat: int = 64, nhead: int = 4, nblock: int = 4,
+                  mlp_ratio: int = 4, batch_size: int = 16, dev: str = "",
+                  seq_parallel: int = 1, model_parallel: int = 1,
+                  pipeline_parallel: int = 1, pipeline_microbatch: int = 0,
+                  precision: str = "float32", eta: float = 0.1,
+                  remat: int = 0, remat_mode: str = "block",
+                  attn_layout: str = "auto", zero: int = 0,
+                  updater: str = "sgd", momentum: float = 0.9,
+                  moe_experts: int = 0,
+                  seq_parallel_mode: str = "ring") -> str:
+    """Causal GPT language model in the config DSL — the netconfig twin of
+    the models/gpt.py flagship, with the SAME performance levers exposed
+    as config keys: ``remat`` / ``remat_mode`` (block | attn_saved),
+    ``attn_layout`` (auto | bnhd | bhnd), ``zero`` (= shard_optimizer
+    levels 1/2/3), and the four parallel axes. The data pipeline feeds
+    token ids as BOTH the data node (b, 1, 1, N) and the label field
+    (width N); the ``lm_softmax`` loss trains next-token prediction
+    (gpt.py:gpt_loss semantics).
+
+    Per-position MLP halves are 1x1 convs and the LM head is a 1x1 conv
+    to vocab — XLA lowers both to the same matmuls as gpt.py's einsums.
+    """
+    L = ["netconfig=start"]
+    L.append("layer[0->emb] = embedding:emb")
+    L.append("  vocab_size = %d" % vocab_size)
+    L.append("  nhidden = %d" % feat)
+    src = "emb"
+    for i in range(nblock):
+        out = "blk%d" % i
+        transformer_block(L, src, out, i, feat, nhead, causal=1,
+                          mlp_ratio=mlp_ratio, moe_experts=moe_experts,
+                          seq_parallel_mode=seq_parallel_mode)
+        src = out
+    L.append("layer[%s->%s] = layer_norm:lnf" % (src, src))
+    L.append("layer[%s->logits] = conv:head" % src)
+    L.append("  kernel_size = 1")
+    L.append("  nchannel = %d" % vocab_size)
+    L.append("  init_sigma = 0.02")
+    L.append("  no_bias = 1")
+    L.append("layer[logits->logits] = lm_softmax")
+    L.append("  target = ids")
+    L.append("netconfig=end")
+    dev_line = ("dev = %s" % dev) if dev else ""
+    L.append("""
+input_shape = 1,1,%d
+label_vec[0,%d) = ids
+batch_size = %d
+%s
+seq_parallel = %d
+model_parallel = %d
+pipeline_parallel = %d
+pipeline_microbatch = %d
+precision = %s
+remat = %d
+remat_mode = %s
+attn_layout = %s
+zero = %d
+updater = %s
+random_type = gaussian
+init_sigma = 0.02
+eta = %g
+momentum = %g
+metric[ids] = lm_nll
+""" % (seq_len, seq_len, batch_size, dev_line, seq_parallel, model_parallel,
+       pipeline_parallel, pipeline_microbatch, precision, remat, remat_mode,
+       attn_layout, zero, updater, eta, momentum))
+    return "\n".join(L)
+
+
 def transformer_config(seq_len: int = 128, vocab_size: int = 256,
                        feat: int = 64, nhead: int = 4, nblock: int = 2,
                        num_classes: int = 10, causal: int = 0,

@@ -1,0 +1,203 @@
+"""LM surface adapter: netconfig GPT <-> the models/gpt.py functional path.
+
+The reference's whole task surface is config-reachable
+(/root/reference/src/cxxnet_main.cpp:57-81); this module gives the
+framework the same property for GENERATION: a Net built from a GPT-shaped
+netconfig (models/transformer.py:gpt_lm_config) exports its weights into
+the models/gpt.py parameter layout, so ``task = generate`` (cli.py) and
+``Net.generate`` drive the SAME fused whole-step decode kernel
+(ops/pallas_kernels.fused_decode_step) as the functional path — one
+decode implementation, two surfaces.
+
+Structure contract (validated with precise errors): embedding -> N x
+pre-LN dense transformer blocks (layer_norm/attention/add + layer_norm/
+1x1-conv MLP/add, the gpt_lm_config shape) -> layer_norm -> 1x1-conv LM
+head -> lm_softmax. MoE blocks are rejected (the KV-cache decode path is
+dense; MoE generation would need expert dispatch per token).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.config import ConfigError
+
+
+def _segment(net):
+    from .pipeline_dsl import find_block_segment
+    seg = net._pp_segment or net._remat_segment
+    if seg is None:
+        seg = find_block_segment(net.graph, net.layers)
+    if seg is None:
+        raise ConfigError(
+            "generate: no repeated transformer block segment found in the "
+            "net (need >= 2 identical pre-LN blocks, e.g. gpt_lm_config)")
+    return seg
+
+
+def _rep_layers(net, seg) -> Dict[str, int]:
+    """Identify the block-segment layers of repetition r=0 by type;
+    returns rep-relative layer offsets (reps are isomorphic, so offset j
+    of rep r is graph layer ``seg.start + r*seg.period + j``)."""
+    specs = net.graph.layers[seg.start:seg.start + seg.period]
+    by_type: Dict[str, list] = {}
+    for j, s in enumerate(specs):
+        by_type.setdefault(s.type, []).append(j)
+    if "moe" in by_type:
+        raise ConfigError("generate: MoE blocks are not supported by the "
+                          "KV-cache decode path (dense MLP blocks only)")
+    for t, want in (("layer_norm", 2), ("attention", 1), ("conv", 2)):
+        if len(by_type.get(t, ())) != want:
+            raise ConfigError(
+                "generate: block segment is not a pre-LN transformer "
+                "block (expected %d %r layers per block, found %d)"
+                % (want, t, len(by_type.get(t, ()))))
+    ln1, ln2 = by_type["layer_norm"]
+    (attn,) = by_type["attention"]
+    up, down = by_type["conv"]
+    return {"ln1": ln1, "ln2": ln2, "attn": attn, "up": up, "down": down}
+
+
+def _outer_layers(net, seg):
+    """(embedding, final layer_norm, head conv) outside the segment."""
+    g = net.graph
+    emb = lnf = head = None
+    for i, (spec, layer) in enumerate(zip(g.layers, net.layers)):
+        if seg.start <= i < seg.stop:
+            continue
+        if spec.type == "embedding":
+            emb = (spec, layer)
+        elif spec.type == "layer_norm" and i >= seg.stop:
+            lnf = (spec, layer)
+        elif spec.type == "conv" and i >= seg.stop:
+            head = (spec, layer)
+    if emb is None or lnf is None or head is None:
+        raise ConfigError(
+            "generate: net must be embedding -> blocks -> layer_norm -> "
+            "1x1-conv head -> lm_softmax (gpt_lm_config shape)")
+    if head[1].param.kernel_width != 1 or head[1].param.kernel_height != 1:
+        raise ConfigError("generate: LM head must be a 1x1 conv")
+    return emb, lnf, head
+
+
+def net_gpt_config(net):
+    """Build the models/gpt.py GPTConfig mirroring a GPT-shaped Net."""
+    from ..models.gpt import GPTConfig
+    seg = _segment(net)
+    rep = _rep_layers(net, seg)
+    emb, _, _ = _outer_layers(net, seg)
+    attn_layer = net.layers[seg.start + rep["attn"]]
+    feat = attn_layer.feat
+    mf = net.layers[seg.start + rep["up"]].param.num_channel
+    return GPTConfig(
+        vocab_size=emb[1].vocab_size, seq_len=emb[1].seq_len,
+        n_layer=seg.count, n_head=attn_layer.nhead, feat=feat,
+        mlp_ratio=max(1, mf // feat),
+        dtype="bfloat16" if net.precision == "bfloat16" else "float32")
+
+
+def net_to_gpt_params(net) -> Dict:
+    """Export a GPT-shaped Net's weights into the models/gpt.py layout
+    (blocks stacked on a leading n_layer dim). Pure host-side reshapes/
+    transposes; cited layouts: DSL attention qkv (3F, F) applied as
+    ``x @ qkv.T`` (layers/attention.py) vs gpt.py per-matrix ``x @ w_q``
+    (models/gpt.py:_attn_core); DSL 1x1 convs are HWIO (1,1,cin,cout)
+    (layers/conv.py) vs gpt.py (cin, cout) matmuls."""
+    seg = _segment(net)
+    rep = _rep_layers(net, seg)
+    emb, lnf, head = _outer_layers(net, seg)
+
+    def w(params_key, tag):
+        return np.asarray(net._fetch(net.params[params_key][tag]))
+
+    def rep_key(j, r):
+        # layer key of repetition r for rep-relative offset j
+        return net.graph.layers[seg.start + r * seg.period + j].key()
+
+    f = net.layers[seg.start + rep["attn"]].feat
+    stack: Dict[str, list] = {k: [] for k in (
+        "ln1_g", "ln1_b", "ln2_g", "ln2_b", "w_q", "w_k", "w_v", "b_q",
+        "b_k", "b_v", "w_proj", "b_proj", "w_mlp1", "b_mlp1", "w_mlp2",
+        "b_mlp2")}
+    for r in range(seg.count):
+        k_ln1 = rep_key(rep["ln1"], r)
+        k_ln2 = rep_key(rep["ln2"], r)
+        k_att = rep_key(rep["attn"], r)
+        k_up = rep_key(rep["up"], r)
+        k_dn = rep_key(rep["down"], r)
+        stack["ln1_g"].append(w(k_ln1, "wmat"))
+        stack["ln1_b"].append(w(k_ln1, "bias"))
+        stack["ln2_g"].append(w(k_ln2, "wmat"))
+        stack["ln2_b"].append(w(k_ln2, "bias"))
+        qkv = w(k_att, "qkv")                      # (3F, F), x @ qkv.T
+        stack["w_q"].append(qkv[:f].T)
+        stack["w_k"].append(qkv[f:2 * f].T)
+        stack["w_v"].append(qkv[2 * f:].T)
+        if "qkv_bias" in net.params[k_att]:
+            qb = w(k_att, "qkv_bias")
+            pb = w(k_att, "proj_bias")
+        else:
+            qb = np.zeros((3 * f,), np.float32)
+            pb = np.zeros((f,), np.float32)
+        stack["b_q"].append(qb[:f])
+        stack["b_k"].append(qb[f:2 * f])
+        stack["b_v"].append(qb[2 * f:])
+        stack["w_proj"].append(w(k_att, "proj").T)
+        stack["b_proj"].append(pb)
+        stack["w_mlp1"].append(w(k_up, "wmat")[0, 0])       # (f, mf)
+        stack["w_mlp2"].append(w(k_dn, "wmat")[0, 0])       # (mf, f)
+        stack["b_mlp1"].append(
+            w(k_up, "bias") if "bias" in net.params[k_up]
+            else np.zeros((stack["w_mlp1"][-1].shape[1],), np.float32))
+        stack["b_mlp2"].append(
+            w(k_dn, "bias") if "bias" in net.params[k_dn]
+            else np.zeros((f,), np.float32))
+
+    k_emb = emb[0].key()
+    k_lnf = lnf[0].key()
+    k_head = head[0].key()
+    return {
+        "emb": jnp.asarray(w(k_emb, "wmat")),
+        "pos": jnp.asarray(w(k_emb, "pos")),
+        "lnf_g": jnp.asarray(w(k_lnf, "wmat")),
+        "lnf_b": jnp.asarray(w(k_lnf, "bias")),
+        "head": jnp.asarray(w(k_head, "wmat")[0, 0]),
+        "blocks": {k: jnp.asarray(np.stack(v)) for k, v in stack.items()},
+    }
+
+
+def net_gpt_export(net) -> Tuple:
+    """(GPTConfig, params) export of a GPT-shaped Net — run ONCE and pass
+    to repeated ``net_generate`` calls: the export fetches the whole
+    weight tree to the host (ZeRO-aware) and re-stacks it, which at
+    flagship scale costs far more than one decode."""
+    return net_gpt_config(net), net_to_gpt_params(net)
+
+
+def net_generate(net, prompt: np.ndarray, max_new: int,
+                 temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None,
+                 export: Optional[Tuple] = None) -> np.ndarray:
+    """Generate tokens from a GPT-shaped Net: prompt (b, n_prompt) int ->
+    (b, n_prompt + max_new) int32. Drives models/gpt.py:gpt_decode — the
+    fused whole-step decode kernel auto-engages on one chip exactly as on
+    the functional path. ``export``: a ``net_gpt_export(net)`` result to
+    reuse across calls (otherwise each call re-exports the weight tree —
+    fine for one-shot generation, wrong for timing loops; cli.py's
+    ``generate_bench`` exports once)."""
+    from ..models.gpt import gpt_decode
+    cfg, params = export if export is not None else net_gpt_export(net)
+    prompt = jnp.asarray(np.asarray(prompt, np.int32))
+    if rng is None and temperature > 0:
+        rng = jax.random.PRNGKey(net.seed)
+    out = gpt_decode(params, prompt, max_new, cfg,
+                     temperature=temperature, rng=rng)
+    return np.asarray(out)
+
+
+__all__ = ["net_gpt_config", "net_gpt_export", "net_to_gpt_params",
+           "net_generate"]

@@ -60,6 +60,8 @@ class Net:
         self.sample_counter = 0
         self._initialized = False
         self._pp_segment = None
+        self._remat_segment = None
+        self._remat_split = None
 
     # ------------------------------------------------------------ config
     def set_param(self, name: str, val: str) -> None:
@@ -81,6 +83,8 @@ class Net:
         self.dist_feed = "replicated"
         self.clip_norm = 0.0
         self.precision = "float32"
+        self.remat = 0
+        self.remat_mode = "block"
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
         for k, v in g.defcfg:
@@ -104,8 +108,19 @@ class Net:
                 self.pipeline_parallel = int(v)
             elif k == "pipeline_microbatch":
                 self.pipeline_microbatch = int(v)
-            elif k == "shard_optimizer":
+            elif k in ("shard_optimizer", "zero"):
+                # 'zero' is the models/gpt.py name for the same levels
+                # (1 = opt state, 2 = + grad reduce-scatter, 3 = FSDP);
+                # accepted as an alias so the two surfaces match
                 self.shard_optimizer = int(v)
+            elif k == "remat":
+                self.remat = int(v)
+            elif k == "remat_mode":
+                if v not in ("block", "attn_saved"):
+                    raise ConfigError(
+                        "remat_mode must be 'block' or 'attn_saved', "
+                        "got %r" % v)
+                self.remat_mode = v
             elif k == "clip_norm":
                 self.clip_norm = float(v)
             elif k == "dist_feed":
@@ -203,6 +218,38 @@ class Net:
                     % (self.pipeline_microbatch, local_b, self.batch_size,
                        self.n_data_shards))
 
+        # block rematerialization (remat = 1): checkpoint each repetition
+        # of the repeated block stack — the config-path twin of the
+        # models/gpt.py remat/remat_mode levers. With pipeline_parallel
+        # the remat happens inside the gpipe block body; standalone it
+        # wraps each repetition in _run_graph.
+        self._remat_segment = None
+        self._remat_split = None
+        if self.remat:
+            from .pipeline_dsl import attn_saved_split, find_block_segment
+            seg = self._pp_segment
+            if seg is None:
+                seg = find_block_segment(g, self.layers)
+                if seg is None:
+                    raise ConfigError(
+                        "remat = 1 needs a repeated block segment (>= 2 "
+                        "consecutive structurally-identical single-entry/"
+                        "single-exit blocks of stateless rng-free layers), "
+                        "e.g. a transformer block stack")
+                self._remat_segment = seg
+            if self.remat_mode == "attn_saved":
+                self._remat_split = attn_saved_split(g, seg)
+
+        # id entry nodes (consumed by an embedding) must stay exact f32 on
+        # device entry — a bf16 cast would corrupt ids > 256; the compute
+        # dtype applies from the embedding lookup onward (ApplyContext
+        # .compute_dtype)
+        self._id_entry_nodes = set()
+        for spec in g.layers:
+            if spec.type == "embedding":
+                self._id_entry_nodes.update(
+                    n for n in spec.inputs if n <= g.extra_data_num)
+
         # metric -> node binding (default: the final node's output)
         self._metric_nodes: List[int] = []
         for node_name in self.train_metrics.node_names:
@@ -216,6 +263,10 @@ class Net:
 
         self._compile_steps()
         self._initialized = True
+
+    @property
+    def _compute_dtype(self):
+        return jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
 
     def _compile_steps(self) -> None:
         donate = (0, 1, 2)
@@ -298,21 +349,29 @@ class Net:
                 self.gsum, opt_sh if self.shard_optimizer >= 2 else param_sh)
 
     # ------------------------------------------------------------ executor
-    def _check_pp_visible(self, nid: int, what: str) -> None:
+    def _check_pp_visible(self, nid: int, what: str,
+                          eval_only: bool = False) -> None:
         """Build-time guard: a node consumed by metrics/extract must not be
-        internal to the pipelined segment (those nodes are never
-        materialized — gpipe yields only the segment's exit)."""
-        seg = self._pp_segment
-        if seg is None:
-            return
-        if nid in seg.internal:
-            raise ConfigError(
-                "%s %r is internal to the pipelined block segment (layers "
-                "%d..%d) and is not materialized under pipeline_parallel; "
-                "bind to the segment exit %r or a later node, or set "
-                "pipeline_parallel = 1"
-                % (what, self.graph.node_names[nid], seg.start,
-                   seg.stop - 1, self.graph.node_names[seg.exit]))
+        internal to the pipelined (or rematted) segment — those nodes are
+        never materialized; only the segment's exit is. ``eval_only``:
+        the request comes from an inference forward (extract/pred), where
+        the remat segment does NOT apply (remat is gated on ctx.train —
+        eval forwards run the plain path and materialize every node), so
+        only the pipeline segment restricts visibility."""
+        for seg, why in ((self._pp_segment, "pipeline_parallel"),
+                         (None if eval_only
+                          else getattr(self, "_remat_segment", None),
+                          "remat")):
+            if seg is None:
+                continue
+            if nid in seg.internal:
+                raise ConfigError(
+                    "%s %r is internal to the block segment (layers "
+                    "%d..%d) and is not materialized under %s; bind to "
+                    "the segment exit %r or a later node, or disable %s"
+                    % (what, self.graph.node_names[nid], seg.start,
+                       seg.stop - 1, why, self.graph.node_names[seg.exit],
+                       why))
 
     def _layer_params(self, params, idx: int):
         spec = self.graph.layers[idx]
@@ -323,6 +382,7 @@ class Net:
     def _run_graph(self, params, nodes: Dict[int, jnp.ndarray],
                    ctx: ApplyContext) -> Dict[int, jnp.ndarray]:
         seg = self._pp_segment
+        rseg = self._remat_segment
         i = 0
         while i < len(self.graph.layers):
             if seg is not None and i == seg.start:
@@ -330,6 +390,14 @@ class Net:
                 nodes[seg.exit] = run_pp_segment(self, params,
                                                  nodes[seg.entry], ctx)
                 i = seg.stop
+                continue
+            if rseg is not None and i == rseg.start and ctx.train:
+                # remat only matters where there is a backward pass; eval
+                # forwards run the plain path (no checkpoint overhead)
+                from .pipeline_dsl import run_remat_segment
+                nodes[rseg.exit] = run_remat_segment(self, params,
+                                                     nodes[rseg.entry], ctx)
+                i = rseg.stop
                 continue
             spec, layer = self.graph.layers[i], self.layers[i]
             inputs = [nodes[n] for n in spec.inputs]
@@ -347,9 +415,13 @@ class Net:
         data = jnp.transpose(data, (0, 2, 3, 1))
         # force the net's compute dtype both ways: a bf16 pipeline feed
         # into a float32 net must not silently downgrade the forward pass
-        # (layers derive their compute dtype from the data node's dtype)
-        data = data.astype(jnp.bfloat16 if self.precision == "bfloat16"
-                           else jnp.float32)
+        # (layers derive their compute dtype from the data node's dtype) —
+        # EXCEPT id entries feeding an embedding, which stay exact f32
+        # (the embedding applies the compute dtype after lookup)
+        data = data.astype(jnp.float32 if 0 in self._id_entry_nodes
+                           else (jnp.bfloat16
+                                 if self.precision == "bfloat16"
+                                 else jnp.float32))
         nodes = {0: data}
         for i, e in enumerate(extras):
             nodes[1 + i] = jnp.transpose(e, (0, 2, 3, 1))
@@ -367,7 +439,7 @@ class Net:
             train=True, rng=rng, labels=self._split_labels(label),
             sample_mask=mask, batch_size=self.batch_size,
             update_period=self.update_period, epoch=epoch, states=states,
-            mesh=self.mesh)
+            mesh=self.mesh, compute_dtype=self._compute_dtype)
         nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
         if not ctx.losses:
             raise ConfigError("network has no loss layer")
@@ -375,8 +447,10 @@ class Net:
         # pin the metric outputs' batch dim to the data axis: under pure
         # sp/pp meshes XLA may otherwise scatter rows across non-data axes,
         # leaving a process owning rows that don't line up with its local
-        # label slice (multi-host metric accounting)
-        metric_outs = [
+        # label slice (multi-host metric accounting). With eval_train=0
+        # nothing reads them — return none so XLA dead-code-eliminates
+        # their compute (e.g. the lm_softmax probs materialization)
+        metric_outs = [] if not self.eval_train else [
             jax.lax.with_sharding_constraint(
                 nodes[n].reshape(nodes[n].shape[0], -1),
                 batch_sharding(self.mesh))
@@ -451,7 +525,8 @@ class Net:
     def _forward_eval(self, params, states, data, extras, node_ids):
         """Inference forward; returns only the requested nodes' outputs."""
         ctx = ApplyContext(train=False, rng=None, states=states,
-                           mesh=self.mesh)
+                           mesh=self.mesh,
+                           compute_dtype=self._compute_dtype)
         nodes = self._run_graph(params, self._entry_nodes(data, extras), ctx)
         return tuple(nodes[n] for n in node_ids)
 
@@ -754,7 +829,8 @@ class Net:
             nid = self.graph.num_nodes - int(node[len("top[-"):-1])
         else:
             nid = self.graph.node_map[node]
-        self._check_pp_visible(nid, "extract node %r" % (node,))
+        self._check_pp_visible(nid, "extract node %r" % (node,),
+                               eval_only=True)
         data_iter.before_first()
         pending = None            # (device out, n_valid)
         has = data_iter.next()
@@ -790,7 +866,8 @@ class Net:
             nid = self.graph.num_nodes - k
         else:
             nid = self.graph.node_map[node]
-        self._check_pp_visible(nid, "extract node %r" % (node,))
+        self._check_pp_visible(nid, "extract node %r" % (node,),
+                               eval_only=True)
         out = self._forward_node(batch, nid)
         return out[:self._rank_valid(batch)]
 

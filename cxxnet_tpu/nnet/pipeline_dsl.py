@@ -146,8 +146,11 @@ def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
     return seg
 
 
-def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
-    """The maximal pipelineable segment, or a precise ConfigError."""
+def find_block_segment(graph, layers) -> Optional[PPSegment]:
+    """The maximal repeated-block segment of the net, or None. Shared by
+    pipeline parallelism (find_pp_segment) and block rematerialization
+    (``remat = 1``), so the two features agree on what "the block stack"
+    is."""
     specs = graph.layers
     n = len(specs)
     best: Optional[PPSegment] = None
@@ -157,6 +160,12 @@ def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
             if seg and (best is None
                         or seg.period * seg.count > best.period * best.count):
                 best = seg
+    return best
+
+
+def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
+    """The maximal pipelineable segment, or a precise ConfigError."""
+    best = find_block_segment(graph, layers)
     if best is None:
         raise ConfigError(
             "pipeline_parallel > 1 but no repeated block segment found: the "
@@ -172,13 +181,75 @@ def find_pp_segment(graph, layers, n_stage: int) -> PPSegment:
     return best
 
 
+def attn_saved_split(graph, seg: PPSegment) -> int:
+    """The ``remat_mode = attn_saved`` boundary inside one repetition: the
+    layer offset of the ``add`` closing the attention half (layers
+    [0..split] run un-rematted so the flash custom-vjp's saved residuals
+    are reused; [split+1..period) — the MLP half — rematerialize). The
+    boundary must be a single-node cut; precise errors otherwise
+    (models/gpt.py:_block_mlp_remat is the functional-path twin)."""
+    specs = graph.layers[seg.start:seg.start + seg.period]
+    attn = [j for j, s in enumerate(specs) if s.type == "attention"]
+    if not attn:
+        raise ConfigError(
+            "remat_mode = attn_saved needs an attention layer in the "
+            "repeated block segment (layers %d..%d have none); use "
+            "remat_mode = block" % (seg.start, seg.stop - 1))
+    adds = [j for j in range(attn[0] + 1, len(specs))
+            if specs[j].type == "add"]
+    if not adds:
+        raise ConfigError(
+            "remat_mode = attn_saved: no residual 'add' follows the "
+            "attention layer in the repeated block; use remat_mode = block")
+    split = adds[0]
+    if len(specs[split].outputs) != 1:
+        raise ConfigError("remat_mode = attn_saved: the attention-half "
+                          "residual add must have one output")
+    mid = specs[split].outputs[0]
+    produced_late = set()
+    for j in range(split + 1, len(specs)):
+        for n in specs[j].inputs:
+            if n != mid and n not in produced_late:
+                raise ConfigError(
+                    "remat_mode = attn_saved: the MLP half consumes node "
+                    "%r across the remat boundary (only the attention-"
+                    "residual output may cross); use remat_mode = block"
+                    % (graph.node_names[n],))
+        produced_late.update(specs[j].outputs)
+    return split
+
+
+def _segment_base(net, seg: PPSegment):
+    """(spec, layer) pairs of repetition 0 + its exit node id."""
+    base = list(zip(net.graph.layers[seg.start:seg.start + seg.period],
+                    net.layers[seg.start:seg.start + seg.period]))
+    return base, base[-1][0].outputs[0]
+
+
+def _run_range(base, params_of, h, entry_node, j0, j1, ctx):
+    """Apply base layers [j0, j1) with ``params_of(j)`` starting from
+    ``h`` at ``entry_node``; returns the local node dict."""
+    local = {entry_node: h}
+    for j in range(j0, j1):
+        spec, layer = base[j]
+        outs = layer.apply(params_of(j), [local[n] for n in spec.inputs],
+                           ctx)
+        for n, o in zip(spec.outputs, outs):
+            local[n] = o
+    return local
+
+
 def run_pp_segment(net, params, h, ctx):
-    """Execute the detected segment through gpipe; returns the exit node."""
+    """Execute the detected segment through gpipe; returns the exit node.
+    With ``remat = 1`` each block body is rematerialized inside the
+    pipeline (remat_mode block / attn_saved), the same levers as the
+    models/gpt.py flagship."""
+    import jax
+
     from ..layers.base import ApplyContext
     from ..parallel.pipeline import gpipe
 
     seg: PPSegment = net._pp_segment
-    g = net.graph
     stacked = {}
     for j in range(seg.period):
         per_rep = [net._layer_params(params, seg.start + r * seg.period + j)
@@ -192,19 +263,61 @@ def run_pp_segment(net, params, h, ctx):
     inner_ctx = ApplyContext(train=ctx.train, rng=None,
                              batch_size=ctx.batch_size,
                              update_period=ctx.update_period,
-                             epoch=ctx.epoch)
-    base = list(zip(g.layers[seg.start:seg.start + seg.period],
-                    net.layers[seg.start:seg.start + seg.period]))
+                             epoch=ctx.epoch,
+                             compute_dtype=ctx.compute_dtype)
+    base, exit0 = _segment_base(net, seg)
 
-    exit0 = base[-1][0].outputs[0]     # rep-0 coordinates of the exit node
+    def whole(pblock, x):
+        return _run_range(base, lambda j: pblock.get(str(j), {}), x,
+                          seg.entry, 0, seg.period, inner_ctx)[exit0]
 
-    def block_fn(pblock, x):
-        local = {seg.entry: x}
-        for j, (spec, layer) in enumerate(base):
-            outs = layer.apply(pblock.get(str(j), {}),
-                               [local[n] for n in spec.inputs], inner_ctx)
-            for n, o in zip(spec.outputs, outs):
-                local[n] = o
-        return local[exit0]
+    if net.remat and net._remat_split is not None:
+        split = net._remat_split
+        mid = base[split][0].outputs[0]
+
+        def block_fn(pblock, x):
+            hm = _run_range(base, lambda j: pblock.get(str(j), {}), x,
+                            seg.entry, 0, split + 1, inner_ctx)[mid]
+            return jax.checkpoint(
+                lambda pb, hh: _run_range(
+                    base, lambda j: pb.get(str(j), {}), hh, mid,
+                    split + 1, seg.period, inner_ctx)[exit0])(pblock, hm)
+    elif net.remat:
+        block_fn = jax.checkpoint(whole)
+    else:
+        block_fn = whole
 
     return gpipe(block_fn, stacked, h, net.mesh, net.pipeline_microbatch)
+
+
+def run_remat_segment(net, params, h, ctx):
+    """Execute the repeated block segment with per-repetition
+    ``jax.checkpoint`` (``remat = 1`` without a pipeline axis): activation
+    memory drops from O(layers) to O(count) block boundaries + one live
+    block, at ~1/3 extra FLOPs in the backward — the models/gpt.py remat
+    levers on the config path. remat_mode "attn_saved" leaves the
+    attention half un-rematted (the flash custom-vjp's residuals stay
+    saved; only the MLP half recomputes)."""
+    import jax
+
+    seg: PPSegment = net._remat_segment
+    base, exit0 = _segment_base(net, seg)
+    split = net._remat_split
+    for r in range(seg.count):
+        plist = [net._layer_params(params, seg.start + r * seg.period + j)
+                 for j in range(seg.period)]
+        if split is None:
+            h = jax.checkpoint(
+                lambda pl, hh: _run_range(base, lambda j: pl[j], hh,
+                                          seg.entry, 0, seg.period,
+                                          ctx)[exit0])(plist, h)
+        else:
+            mid = base[split][0].outputs[0]
+            h_mid = _run_range(base, lambda j: plist[j], h, seg.entry, 0,
+                               split + 1, ctx)[mid]
+            h = jax.checkpoint(
+                lambda pl, hh: _run_range(base, lambda j: pl[j - split - 1],
+                                          hh, mid, split + 1, seg.period,
+                                          ctx)[exit0])(plist[split + 1:],
+                                                       h_mid)
+    return h

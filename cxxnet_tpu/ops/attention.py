@@ -454,6 +454,61 @@ def ulysses_attention_inner_bhnd(q, k, v, axis_name: str = "seq",
     return heads_to_seq(out)
 
 
+def ring_attention_bhnd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        mesh: Mesh, axis_name: str = "seq",
+                        causal: bool = False,
+                        batch_axis: Optional[str] = "data") -> jnp.ndarray:
+    """Standalone HEAD-MAJOR ring attention: q,k,v (batch, heads, seq,
+    head_dim) with seq (dim 2) sharded over ``axis_name``. The layer-path
+    twin of :func:`ring_attention` for callers that project straight into
+    the flash kernels' native layout (``attn_layout = bhnd``) — zero
+    layout copies through the whole ring."""
+    n_seq = mesh.shape.get(axis_name, 1)
+    if q.shape[2] % max(n_seq, 1):
+        raise ValueError(
+            "ring_attention_bhnd: sequence length %d is not divisible by "
+            "the %r mesh axis (size %d)" % (q.shape[2], axis_name, n_seq))
+    batch_ax = batch_axis if (batch_axis and
+                              mesh.shape.get(batch_axis, 1) > 1 and
+                              q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(batch_ax, None, axis_name, None)
+    body = functools.partial(ring_attention_inner_bhnd, axis_name=axis_name,
+                             causal=causal)
+    vma_ok = not _ring_chunk_kernels(q.shape[2] // max(n_seq, 1))
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=vma_ok)(q, k, v)
+
+
+def ulysses_attention_bhnd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           mesh: Mesh, axis_name: str = "seq",
+                           causal: bool = False,
+                           batch_axis: Optional[str] = "data") -> jnp.ndarray:
+    """Standalone HEAD-MAJOR Ulysses attention: q,k,v (batch, heads, seq,
+    head_dim), seq sharded over ``axis_name``; heads must divide the axis
+    (same contract as :func:`ulysses_attention`)."""
+    n_seq = mesh.shape.get(axis_name, 1)
+    if q.shape[2] % max(n_seq, 1):
+        raise ValueError(
+            "ulysses_attention_bhnd: sequence length %d is not divisible "
+            "by the %r mesh axis (size %d)" % (q.shape[2], axis_name, n_seq))
+    if q.shape[1] % max(n_seq, 1):
+        raise ValueError(
+            "ulysses_attention_bhnd: %d heads must divide over the %r axis "
+            "(size %d); use ring_attention_bhnd instead"
+            % (q.shape[1], axis_name, n_seq))
+    batch_ax = batch_axis if (batch_axis and
+                              mesh.shape.get(batch_axis, 1) > 1 and
+                              q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(batch_ax, None, axis_name, None)
+    body = functools.partial(ulysses_attention_inner_bhnd,
+                             axis_name=axis_name, causal=causal)
+    vma_ok = not _ring_chunk_kernels(q.shape[2])
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=vma_ok)(q, k, v)
+
+
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, axis_name: str = "seq",
                       causal: bool = False,
@@ -483,6 +538,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 __all__ = ["full_attention", "local_attention", "ring_attention",
-           "ring_attention_inner", "ring_attention_inner_bhnd",
-           "ulysses_attention", "ulysses_attention_inner",
+           "ring_attention_bhnd", "ring_attention_inner",
+           "ring_attention_inner_bhnd", "ulysses_attention",
+           "ulysses_attention_bhnd", "ulysses_attention_inner",
            "ulysses_attention_inner_bhnd"]

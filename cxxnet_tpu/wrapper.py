@@ -156,6 +156,20 @@ class Net:
             return np.concatenate(outs) if outs else np.zeros((0,), np.float32)
         return self._net.extract_feature(_as_batch(data, label), name)
 
+    def generate(self, prompt: Array, max_new: int,
+                 temperature: float = 0.0, seed: int = 0) -> Array:
+        """Autoregressive generation from a GPT-shaped net (gpt_lm_config
+        structure): prompt (b, n_prompt) int token ids -> (b, n_prompt +
+        max_new) int32. Greedy at temperature 0, else categorical
+        sampling. Drives the models/gpt.py fused whole-step decode kernel
+        — no reference counterpart (the reference has no sequence models,
+        SURVEY §5.7); the CLI twin is ``task = generate``."""
+        import jax
+        from .nnet.lm import net_generate
+        rng = jax.random.PRNGKey(seed) if temperature > 0 else None
+        return net_generate(self._net, np.asarray(prompt, np.int64),
+                            max_new, temperature=temperature, rng=rng)
+
     # -- weight surgery -----------------------------------------------
     def set_weight(self, weight: Array, layer_name: str, tag: str) -> None:
         self._net.set_weight(layer_name, tag, np.asarray(weight, np.float32))

@@ -181,14 +181,14 @@ def test_dsl_pp_internal_node_guard():
     """Nodes inside the pipelined segment are never materialized; binding a
     metric or extract to one must fail at build/call time, not in jit."""
     net = _tnet(pp=2)
-    with pytest.raises(ConfigError, match="internal to the pipelined"):
+    with pytest.raises(ConfigError, match="internal to the block segment"):
         list(net.forward_iter(_OneBatchIter(_tbatch(0)), node="b0a"))
     # a metric bound to an internal node fails at init_model
     cfg = transformer_config(seq_len=32, feat=32, nhead=4, nblock=4,
                              batch_size=16, dev="cpu", pipeline_parallel=2)
     cfg += "\nmetric[label,b1b] = error\n"
     net2 = Net(tokenize(cfg))
-    with pytest.raises(ConfigError, match="internal to the pipelined"):
+    with pytest.raises(ConfigError, match="internal to the block segment"):
         net2.init_model()
 
 

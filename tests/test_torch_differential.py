@@ -257,3 +257,199 @@ def test_whole_net_adam_trajectory_matches_torch():
 
     np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-3)
     assert theirs[-1] < theirs[0] * 0.5, theirs
+
+
+# ---------------------------------------------------------------------------
+# Transformer whole-net trajectory (VERDICT r4 weak #4): the same 2-block
+# causal GPT — dense and switch-MoE — trained 50 steps in the config DSL and
+# in torch from identical weights; per-step losses and final weights must
+# agree. Pins the attention scaling, pre-LN residual order, lm_softmax's
+# shifted CE + loss scaling, the MoE top-1 routing + load-balance aux, and
+# the SGD update — end to end, the sequence-model counterpart of the CNN
+# trajectory above.
+# ---------------------------------------------------------------------------
+
+T_N, T_B, T_V, T_F, T_H = 16, 16, 32, 32, 2
+T_STEPS = 50
+T_ETA, T_MOM = 0.1, 0.9
+MOE_E, MOE_AUXW = 4, 0.01
+
+
+class _TorchBlock(torch.nn.Module):
+    def __init__(self, moe: bool):
+        super().__init__()
+        F = T_F
+        self.ln1 = torch.nn.LayerNorm(F)
+        self.qkv = torch.nn.Linear(F, 3 * F)
+        self.proj = torch.nn.Linear(F, F)
+        self.ln2 = torch.nn.LayerNorm(F)
+        self.moe = moe
+        if moe:
+            self.gate = torch.nn.Linear(F, MOE_E, bias=False)
+            self.w_up = torch.nn.Parameter(torch.zeros(MOE_E, F, 4 * F))
+            self.w_down = torch.nn.Parameter(torch.zeros(MOE_E, 4 * F, F))
+        else:
+            self.up = torch.nn.Linear(F, 4 * F)
+            self.down = torch.nn.Linear(4 * F, F)
+
+    def forward(self, h):
+        b, n, f = h.shape
+        x = self.ln1(h)
+        q, k, v = self.qkv(x).split(f, dim=-1)
+        d = f // T_H
+        q = q.view(b, n, T_H, d).transpose(1, 2)
+        k = k.view(b, n, T_H, d).transpose(1, 2)
+        v = v.view(b, n, T_H, d).transpose(1, 2)
+        s = (q @ k.transpose(-1, -2)) / d ** 0.5
+        mask = torch.triu(torch.ones(n, n, dtype=torch.bool), 1)
+        s = s.masked_fill(mask, float("-inf"))
+        att = (torch.softmax(s, -1) @ v).transpose(1, 2).reshape(b, n, f)
+        h = h + self.proj(att)
+        x = self.ln2(h)
+        aux = h.new_zeros(())
+        if self.moe:
+            # switch top-1 with ample capacity: every token served by its
+            # argmax expert, scaled by the raw max probability (ops/moe.py)
+            probs = torch.softmax(self.gate(x.reshape(-1, f)).float(), -1)
+            top_p, top_i = probs.max(-1)
+            xf = x.reshape(-1, f)
+            out = torch.zeros_like(xf)
+            for e in range(MOE_E):
+                m = top_i == e
+                if m.any():
+                    ye = torch.relu(xf[m] @ self.w_up[e]) @ self.w_down[e]
+                    out[m] = top_p[m, None].to(ye.dtype) * ye
+            frac = torch.bincount(top_i, minlength=MOE_E).float() / xf.shape[0]
+            aux = MOE_E * (frac * probs.mean(0)).sum()
+            h = h + out.reshape(b, n, f)
+        else:
+            h = h + self.down(torch.relu(self.up(x)))
+        return h, aux
+
+
+class _TorchGPT(torch.nn.Module):
+    def __init__(self, moe: bool):
+        super().__init__()
+        self.emb = torch.nn.Embedding(T_V, T_F)
+        self.pos = torch.nn.Parameter(torch.zeros(T_N, T_F))
+        self.blocks = torch.nn.ModuleList([_TorchBlock(moe)
+                                           for _ in range(2)])
+        self.lnf = torch.nn.LayerNorm(T_F)
+        self.head = torch.nn.Linear(T_F, T_V, bias=False)
+
+    def forward(self, ids):
+        h = self.emb(ids) + self.pos[None]
+        aux_total = h.new_zeros(())
+        for blk in self.blocks:
+            h, aux = blk(h)
+            aux_total = aux_total + aux
+        logits = self.head(self.lnf(h))
+        ce = torch.nn.functional.cross_entropy(
+            logits[:, :-1].reshape(-1, T_V).float(),
+            ids[:, 1:].reshape(-1))
+        return ce + MOE_AUXW * aux_total
+
+
+def _export_gpt_weights(model, net, moe: bool):
+    """torch -> config-DSL net. Torch Linear weight (out,in) IS the DSL
+    qkv/proj convention (x @ W.T); 1x1 convs are HWIO so MLP weights
+    transpose; MoE expert tensors map 1:1."""
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    net.set_weight("emb", "wmat", sd["emb.weight"])
+    net.set_weight("emb", "pos", sd["pos"])
+    for i in range(2):
+        p = "blocks.%d." % i
+        net.set_weight("ln%da" % i, "wmat", sd[p + "ln1.weight"])
+        net.set_weight("ln%da" % i, "bias", sd[p + "ln1.bias"])
+        net.set_weight("att%d" % i, "qkv", sd[p + "qkv.weight"])
+        net.set_weight("att%d" % i, "qkv_bias", sd[p + "qkv.bias"])
+        net.set_weight("att%d" % i, "proj", sd[p + "proj.weight"])
+        net.set_weight("att%d" % i, "proj_bias", sd[p + "proj.bias"])
+        net.set_weight("ln%db" % i, "wmat", sd[p + "ln2.weight"])
+        net.set_weight("ln%db" % i, "bias", sd[p + "ln2.bias"])
+        if moe:
+            net.set_weight("moe%d" % i, "gate", sd[p + "gate.weight"].T)
+            net.set_weight("moe%d" % i, "w_up", sd[p + "w_up"])
+            net.set_weight("moe%d" % i, "w_down", sd[p + "w_down"])
+        else:
+            net.set_weight("mlp%da" % i, "wmat",
+                           sd[p + "up.weight"].T[None, None])
+            net.set_weight("mlp%da" % i, "bias", sd[p + "up.bias"])
+            net.set_weight("mlp%db" % i, "wmat",
+                           sd[p + "down.weight"].T[None, None])
+            net.set_weight("mlp%db" % i, "bias", sd[p + "down.bias"])
+    net.set_weight("lnf", "wmat", sd["lnf.weight"])
+    net.set_weight("lnf", "bias", sd["lnf.bias"])
+    net.set_weight("head", "wmat", sd["head.weight"].T[None, None])
+
+
+def _run_gpt_trajectory(moe: bool):
+    from cxxnet_tpu.models import gpt_lm_config
+
+    cfg = gpt_lm_config(seq_len=T_N, vocab_size=T_V, feat=T_F, nhead=T_H,
+                        nblock=2, batch_size=T_B, dev="cpu:0", eta=T_ETA,
+                        momentum=T_MOM,
+                        moe_experts=MOE_E if moe else 0)
+    if moe:
+        # ample capacity: no drops, so the torch oracle's dense routing
+        # is exact; fix dispatch so the trajectory is deterministic
+        cfg = cfg.replace("  nexpert = %d" % MOE_E,
+                          "  nexpert = %d\n  capacity_factor = 64" % MOE_E)
+    cfg += "\nwd = 0\n"
+    net = Net(tokenize(cfg))
+    net.init_model()
+
+    torch.manual_seed(11)
+    model = _TorchGPT(moe)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.normal_(0, 0.05)
+    model.train()
+    _export_gpt_weights(model, net, moe)
+    bufs = {n: torch.zeros_like(p) for n, p in model.named_parameters()}
+
+    ours, theirs = [], []
+    for i in range(T_STEPS):
+        # learnable corpus (the trajectory check is meaningless on a flat
+        # loss): cyclic successor sequences with 10% corruption
+        r = np.random.RandomState(500 + i)
+        start = r.randint(0, T_V, (T_B, 1))
+        ids = (start + np.arange(T_N)) % T_V
+        noise = r.randint(0, T_V, ids.shape)
+        ids = np.where(r.rand(*ids.shape) < 0.1, noise, ids)
+        ids = ids.astype(np.float32)
+        net.update(DataBatch(ids.reshape(T_B, 1, 1, T_N), ids))
+        ours.append(net.last_loss())
+
+        loss = model(torch.from_numpy(ids.astype(np.int64)))
+        theirs.append(float(loss.detach()))
+        model.zero_grad()
+        loss.backward()
+        with torch.no_grad():
+            for name, p in model.named_parameters():
+                bufs[name] = T_MOM * bufs[name] - T_ETA * p.grad
+                p += bufs[name]
+    return np.asarray(ours), np.asarray(theirs), net, model
+
+
+@pytest.mark.parametrize("moe", [False, True], ids=["dense", "moe"])
+def test_gpt_whole_net_trajectory_matches_torch(moe):
+    ours, theirs, net, model = _run_gpt_trajectory(moe)
+    np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-3)
+    assert theirs[-1] < theirs[0] - 0.1, theirs
+    # final weights agree too (drift compounds over 50 steps, so any
+    # semantic mismatch in grads/updates would blow through this)
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    np.testing.assert_allclose(net.get_weight("emb", "wmat"),
+                               sd["emb.weight"], rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(net.get_weight("att1", "qkv"),
+                               sd["blocks.1.qkv.weight"],
+                               rtol=2e-3, atol=2e-3)
+    if moe:
+        np.testing.assert_allclose(net.get_weight("moe0", "w_up"),
+                                   sd["blocks.0.w_up"],
+                                   rtol=2e-3, atol=2e-3)
+    else:
+        np.testing.assert_allclose(
+            net.get_weight("mlp1b", "wmat")[0, 0],
+            sd["blocks.1.down.weight"].T, rtol=2e-3, atol=2e-3)
