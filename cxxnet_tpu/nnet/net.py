@@ -198,13 +198,12 @@ class Net:
         # segment now so misconfiguration fails at build, not in jit
         self._pp_segment = None
         if self.pipeline_parallel > 1:
-            if (self.model_parallel > 1 or self.seq_parallel > 1
-                    or self.expert_parallel > 1):
+            if self.seq_parallel > 1 or self.expert_parallel > 1:
                 raise ConfigError(
-                    "pipeline_parallel composes with data parallelism on "
-                    "the config path; model/seq/expert parallelism inside "
-                    "a pipelined segment needs the models/gpt.py path "
-                    "(doc/multi-device.md)")
+                    "pipeline_parallel composes with data and model "
+                    "parallelism on the config path (round 5); seq/expert "
+                    "parallelism inside a pipelined segment needs the "
+                    "models/gpt.py path (doc/multi-device.md)")
             from .pipeline_dsl import find_pp_segment
             self._pp_segment = find_pp_segment(g, self.layers,
                                                self.pipeline_parallel)

@@ -239,24 +239,153 @@ def _run_range(base, params_of, h, entry_node, j0, j1, ctx):
     return local
 
 
+# ---------------------------------------------------------------------------
+# tensor parallelism inside the pipelined segment (round 5)
+# ---------------------------------------------------------------------------
+# Inside gpipe's shard_map GSPMD does not reach, so weight sharding over
+# the ``model`` axis needs layer-aware execution plans. Three plans cover
+# the transformer block zoo:
+#   "attn"     — megatron attention: the stacked qkv weight is PERMUTED at
+#                stack time from [q;k;v] row blocks to per-head groups
+#                [q_h0;k_h0;v_h0;q_h1;...] so "heads" becomes a contiguous
+#                dim-0 sharding; each shard runs its local heads and the
+#                row-sharded output projection closes with ONE psum
+#                (autodiff of shard_map transposes it correctly — the
+#                gpt.py gpipe path has pinned this since round 2).
+#   "conv_col" — 1x1 ungrouped conv (the position-wise MLP halves):
+#                column-parallel out-channel sharding + an all_gather.
+#   "plain"    — anything else: weights replicated over ``model``, applied
+#                as-is (identical per-shard compute — always correct, no
+#                tp speedup for that layer; LN/add/split/relu land here).
+
+
+def _pp_tp_plan(net, seg, n_tp: int):
+    """Per-rep-offset execution plans + the PartitionSpec pytree for the
+    stacked params (leading dim = pipe)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
+    plans = {}
+    specs = {}
+    for j in range(seg.period):
+        spec_j, layer = net.graph.layers[seg.start + j], \
+            net.layers[seg.start + j]
+        tags = net._layer_params(net.params, seg.start + j)
+        if not tags:
+            continue
+        plan = "plain"
+        if n_tp > 1 and spec_j.type == "attention" \
+                and layer.nhead % n_tp == 0:
+            plan = "attn"
+            table = {
+                "qkv": P(PIPE_AXIS, MODEL_AXIS, None),
+                "proj": P(PIPE_AXIS, None, MODEL_AXIS),
+                "qkv_bias": P(PIPE_AXIS, MODEL_AXIS),
+                "proj_bias": P(PIPE_AXIS),
+            }
+        elif n_tp > 1 and spec_j.type == "conv" \
+                and layer.param.kernel_width == 1 \
+                and layer.param.kernel_height == 1 \
+                and layer.param.num_group == 1 \
+                and layer.param.num_channel % n_tp == 0:
+            plan = "conv_col"
+            table = {
+                "wmat": P(PIPE_AXIS, None, None, None, MODEL_AXIS),
+                "bias": P(PIPE_AXIS, MODEL_AXIS),
+            }
+        else:
+            table = {}
+        # specs must mirror the tags ACTUALLY present (no_bias layers
+        # lack the bias tags; a fixed table would break the shard_map
+        # in_specs pytree match)
+        specs[str(j)] = {tag: table.get(tag, P(PIPE_AXIS))
+                         for tag in tags}
+        plans[j] = plan
+    return plans, specs
+
+
+def _permute_qkv_rows(qkv, nhead: int):
+    """(3F, F) [q;k;v] row blocks -> per-head groups (h, 3, d, F) ->
+    (3F, F), so a contiguous dim-0 shard is whole heads of q, k AND v.
+    Applied inside the jitted step, so autodiff transposes it — the
+    gradients come back in the original layout. The (3F,) bias permutes
+    the same way (zero-init biases make a layout mismatch invisible in
+    the forward; only gradients would reveal it)."""
+    if qkv.ndim == 1:
+        return jnp.transpose(qkv.reshape(3, nhead, -1),
+                             (1, 0, 2)).reshape(qkv.shape[0])
+    f3, f = qkv.shape
+    d = f3 // 3 // nhead
+    return jnp.transpose(qkv.reshape(3, nhead, d, f),
+                         (1, 0, 2, 3)).reshape(f3, f)
+
+
+def _apply_attn_tp(layer, pblock, x, axis_name: str, n_tp: int):
+    """Megatron attention on a per-head qkv shard (permuted layout):
+    local heads, row-sharded projection, one psum."""
+    from jax import lax
+
+    from ..ops.attention import local_attention
+    b, n, _, f = x.shape
+    h_loc = layer.nhead // n_tp
+    d = f // layer.nhead
+    xs = x.reshape(b, n, f)
+    w = pblock["qkv"].astype(xs.dtype).reshape(h_loc, 3, d, f)
+    q = jnp.einsum("bnf,hdf->bnhd", xs, w[:, 0])
+    k = jnp.einsum("bnf,hdf->bnhd", xs, w[:, 1])
+    v = jnp.einsum("bnf,hdf->bnhd", xs, w[:, 2])
+    if "qkv_bias" in pblock:
+        bias = pblock["qkv_bias"].astype(q.dtype).reshape(h_loc, 3, d)
+        q = q + bias[None, None, :, 0]
+        k = k + bias[None, None, :, 1]
+        v = v + bias[None, None, :, 2]
+    att = local_attention(q, k, v, causal=bool(layer.causal))
+    # proj (F, F) applied as x @ proj.T: input features (dim 1) are
+    # head-ordered, so the model shard is this rank's head block
+    wp = pblock["proj"].astype(xs.dtype)          # (F, f_loc)
+    out = lax.psum(att.reshape(b, n, h_loc * d) @ wp.T, axis_name)
+    if "proj_bias" in pblock:
+        out = out + pblock["proj_bias"].astype(out.dtype)
+    return out.reshape(b, n, 1, f)
+
+
+def _apply_conv_col_tp(layer, pblock, x, axis_name: str):
+    """1x1 conv, out-channels column-sharded: local matmul + all_gather."""
+    from jax import lax
+    w = pblock["wmat"][0, 0].astype(x.dtype)      # (Cin, Cout/tp)
+    out = x @ w
+    if "bias" in pblock:
+        out = out + pblock["bias"].astype(out.dtype)
+    return lax.all_gather(out, axis_name, axis=-1, tiled=True)
+
+
 def run_pp_segment(net, params, h, ctx):
     """Execute the detected segment through gpipe; returns the exit node.
     With ``remat = 1`` each block body is rematerialized inside the
-    pipeline (remat_mode block / attn_saved), the same levers as the
-    models/gpt.py flagship."""
+    pipeline (remat_mode block / attn_saved); with ``model_parallel > 1``
+    the attention/MLP weights shard over the ``model`` axis via the
+    per-layer plans above — the same levers as the models/gpt.py
+    flagship, from the config file."""
     import jax
 
     from ..layers.base import ApplyContext
+    from ..parallel.mesh import MODEL_AXIS
     from ..parallel.pipeline import gpipe
 
     seg: PPSegment = net._pp_segment
+    n_tp = net.mesh.shape.get(MODEL_AXIS, 1)
+    plans, specs = _pp_tp_plan(net, seg, n_tp)
     stacked = {}
     for j in range(seg.period):
         per_rep = [net._layer_params(params, seg.start + r * seg.period + j)
                    for r in range(seg.count)]
         if per_rep[0]:
             stacked[str(j)] = {
-                tag: jnp.stack([p[tag] for p in per_rep])
+                tag: jnp.stack([_permute_qkv_rows(
+                    p[tag], net.layers[seg.start + j].nhead)
+                    if plans.get(j) == "attn"
+                    and tag in ("qkv", "qkv_bias")
+                    else p[tag] for p in per_rep])
                 for tag in per_rep[0]}
     # fresh context: no mesh (collectives cannot nest inside gpipe's
     # shard_map), no labels/losses/states (rejected at detection time)
@@ -267,27 +396,48 @@ def run_pp_segment(net, params, h, ctx):
                              compute_dtype=ctx.compute_dtype)
     base, exit0 = _segment_base(net, seg)
 
+    def params_of(pblock, j):
+        return pblock.get(str(j), {})
+
+    def apply_layer(pblock, j, spec_l, layer, inputs):
+        plan = plans.get(j, "plain")
+        if plan == "attn":
+            return [_apply_attn_tp(layer, params_of(pblock, j), inputs[0],
+                                   MODEL_AXIS, n_tp)]
+        if plan == "conv_col":
+            return [_apply_conv_col_tp(layer, params_of(pblock, j),
+                                       inputs[0], MODEL_AXIS)]
+        return layer.apply(params_of(pblock, j), inputs, inner_ctx)
+
+    def run_range_tp(pblock, x, entry_node, j0, j1):
+        local = {entry_node: x}
+        for j in range(j0, j1):
+            spec_l, layer = base[j]
+            outs = apply_layer(pblock, j, spec_l, layer,
+                               [local[n] for n in spec_l.inputs])
+            for n, o in zip(spec_l.outputs, outs):
+                local[n] = o
+        return local
+
     def whole(pblock, x):
-        return _run_range(base, lambda j: pblock.get(str(j), {}), x,
-                          seg.entry, 0, seg.period, inner_ctx)[exit0]
+        return run_range_tp(pblock, x, seg.entry, 0, seg.period)[exit0]
 
     if net.remat and net._remat_split is not None:
         split = net._remat_split
         mid = base[split][0].outputs[0]
 
         def block_fn(pblock, x):
-            hm = _run_range(base, lambda j: pblock.get(str(j), {}), x,
-                            seg.entry, 0, split + 1, inner_ctx)[mid]
+            hm = run_range_tp(pblock, x, seg.entry, 0, split + 1)[mid]
             return jax.checkpoint(
-                lambda pb, hh: _run_range(
-                    base, lambda j: pb.get(str(j), {}), hh, mid,
-                    split + 1, seg.period, inner_ctx)[exit0])(pblock, hm)
+                lambda pb, hh: run_range_tp(pb, hh, mid, split + 1,
+                                            seg.period)[exit0])(pblock, hm)
     elif net.remat:
         block_fn = jax.checkpoint(whole)
     else:
         block_fn = whole
 
-    return gpipe(block_fn, stacked, h, net.mesh, net.pipeline_microbatch)
+    return gpipe(block_fn, stacked, h, net.mesh, net.pipeline_microbatch,
+                 param_specs=specs)
 
 
 def run_remat_segment(net, params, h, ctx):

@@ -168,10 +168,11 @@ def test_dsl_pp_rejections():
     # they are excluded from config-path pipelining (gpt.py path instead)
     with pytest.raises(ConfigError, match="no repeated block segment"):
         _tnet(pp=2, moe_experts=4)
-    # composition boundary: tp/sp/ep inside a pipelined segment is the
-    # models/gpt.py path, the config path rejects it at build
-    with pytest.raises(ConfigError, match="composes with data parallelism"):
-        _tnet(pp=2, model_parallel=2)
+    # composition boundary: sp/ep inside a pipelined segment is the
+    # models/gpt.py path, the config path rejects it at build (tp
+    # composes since round 5 — test_dsl_pp_tp_composition_matches_dp)
+    with pytest.raises(ConfigError, match="seq/expert"):
+        _tnet(pp=2, seq_parallel=2)
     # microbatch must divide the per-shard batch (16/dp4 = 4)
     with pytest.raises(ConfigError, match="pipeline_microbatch"):
         _tnet(pp=2, micro=3)
@@ -229,3 +230,64 @@ save_model = 0
     assert LearnTask().run([str(conf)]) == 0
     err = capfd.readouterr().err
     assert "[1]" in err and "train-error:" in err
+
+
+def test_dsl_pp_tp_composition_matches_dp():
+    """Round 5 (VERDICT r4 #3): model_parallel inside the pipelined
+    segment through the config DSL — megatron attention (per-head qkv
+    sharding, permuted at stack time, one psum) + column-parallel 1x1
+    convs + replicated fallback — matches dp8 to 1e-5 over a 3-step
+    trajectory, with and without remat."""
+    from cxxnet_tpu.models import gpt_lm_config
+    from cxxnet_tpu.nnet.pipeline_dsl import _pp_tp_plan
+
+    rs = np.random.RandomState(0)
+    N, B, V = 16, 16, 32
+    ids = rs.randint(0, V, (B, N)).astype(np.float32)
+    data = ids.reshape(B, 1, 1, N)
+
+    def run(**kw):
+        cfg = gpt_lm_config(seq_len=N, vocab_size=V, feat=16, nhead=4,
+                            nblock=2, batch_size=B, dev="cpu:0-7", **kw)
+        net = Net(tokenize(cfg))
+        net.init_model()
+        for _ in range(3):
+            net.update(DataBatch(data, ids))
+        return net
+
+    base = run()
+    for label, kw in [("pp2xtp2", dict(pipeline_parallel=2,
+                                       model_parallel=2)),
+                      ("pp2xtp2_remat", dict(pipeline_parallel=2,
+                                             model_parallel=2, remat=1))]:
+        net = run(**kw)
+        # the plans must actually engage tensor parallelism (not the
+        # replicated fallback) for the attention + both MLP convs
+        plans, specs = _pp_tp_plan(net, net._pp_segment, 2)
+        assert sorted(plans.values()) == \
+            ["attn", "conv_col", "conv_col", "plain", "plain"], plans
+        assert any(s == "model" for s in specs["2"]["qkv"]), specs["2"]
+        assert abs(net.last_loss() - base.last_loss()) < 1e-4, label
+        dmax = max(float(np.max(np.abs(np.asarray(net.params[k][t])
+                                       - np.asarray(base.params[k][t]))))
+                   for k in base.params for t in base.params[k])
+        assert dmax < 1e-5, (label, dmax)
+
+
+def test_dsl_pp_tp_no_bias():
+    """no_bias attention/conv layers inside a tp-sharded pipelined
+    segment: the spec pytree must mirror the tags actually present
+    (review r5 finding)."""
+    from cxxnet_tpu.models import gpt_lm_config
+
+    rs = np.random.RandomState(0)
+    N, B, V = 16, 16, 32
+    ids = rs.randint(0, V, (B, N)).astype(np.float32)
+    cfg = gpt_lm_config(seq_len=N, vocab_size=V, feat=16, nhead=4,
+                        nblock=2, batch_size=B, dev="cpu:0-7",
+                        pipeline_parallel=2, model_parallel=2)
+    cfg += "\nno_bias = 1\n"
+    net = Net(tokenize(cfg))
+    net.init_model()
+    net.update(DataBatch(ids.reshape(B, 1, 1, N), ids))
+    assert np.isfinite(net.last_loss())
