@@ -265,7 +265,7 @@ def bench_moe():
 
 
 def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
-                batch=1, reps=3):
+                batch=1, reps=3, int8=False):
     """Best-of-reps seconds/token for KV-cache decode — the single
     measurement definition shared with tools/decode_bench.py."""
     import jax
@@ -279,21 +279,37 @@ def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
     prompt = jax.numpy.asarray(
         rs.randint(0, 256, (batch, prompt_len)).astype(np.int32))
     max_new = seq - prompt_len
-    np.asarray(gpt_decode(params, prompt, max_new, cfg))    # compile
+    np.asarray(gpt_decode(params, prompt, max_new, cfg,
+                          int8_weights=int8))               # compile
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        np.asarray(gpt_decode(params, prompt, max_new, cfg))
+        np.asarray(gpt_decode(params, prompt, max_new, cfg,
+                              int8_weights=int8))
         best = min(best, time.perf_counter() - t0)
     return best / max_new
 
 
 def bench_decode():
     """Batch-1 KV-cache decode on the 85M model (fused whole-step kernel
-    auto-engages; tools/decode_bench.py is the A/B harness)."""
+    auto-engages; tools/decode_bench.py is the A/B harness). The int8
+    line is the opt-in weight-streaming quantization (round 5) — both
+    compare against the round-4 bf16 baseline."""
     ms = decode_cell(reps=2) * 1e3
     emit("gpt_decode_ms_per_token", ms, "ms/token",
          R4_DECODE_MS_PER_TOKEN / ms)
+    # only emit the int8 line when the int8 fused path can actually
+    # engage for this cell's signature — otherwise gpt_decode silently
+    # falls back to bf16 and the number would be mislabeled
+    from cxxnet_tpu.ops.pallas_kernels import fused_decode_supported
+    if fused_decode_supported((1, 12, 1024, 64), 12, 768, itemsize=2,
+                              weight_itemsize=1):
+        ms8 = decode_cell(reps=2, int8=True) * 1e3
+        emit("gpt_decode_int8_ms_per_token", ms8, "ms/token",
+             R4_DECODE_MS_PER_TOKEN / ms8)
+    else:
+        print("bench_decode: int8 fused path unavailable here; "
+              "skipping the int8 line", file=sys.stderr)
 
 
 def main() -> int:

@@ -69,6 +69,7 @@ class LearnTask:
         self.temperature = 0.0    # 0 = greedy, else categorical sampling
         self.generate_out = "gen.txt"
         self.generate_bench = 0   # 1: print warm ms/token after a warmup
+        self.generate_int8 = 0    # 1: int8 weight-streaming decode
         self.net: Optional[Net] = None
         self.itr_train = None
         self.itr_evals = []
@@ -126,6 +127,8 @@ class LearnTask:
             self.generate_out = val
         elif name == "generate_bench":
             self.generate_bench = int(val)
+        elif name == "generate_int8":
+            self.generate_int8 = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -448,7 +451,7 @@ class LearnTask:
         t0 = time.time()
         out = net_generate(self.net, batch, self.num_gen,
                            temperature=self.temperature, rng=rng,
-                           export=export)
+                           export=export, int8=bool(self.generate_int8))
         dt = time.time() - t0
         with open(self.generate_out, "w") as fo:
             for row in out:
@@ -459,7 +462,7 @@ class LearnTask:
             t0 = time.time()
             net_generate(self.net, batch, self.num_gen,
                          temperature=self.temperature, rng=rng,
-                         export=export)
+                         export=export, int8=bool(self.generate_int8))
             warm = time.time() - t0
             print("generate_bench: %.4f ms/token warm (batch %d, %d new "
                   "tokens)" % (warm * 1e3 / self.num_gen, batch.shape[0],

@@ -727,15 +727,34 @@ def _attn_cached(q, ck, cv, pos):
 _FUSED_DECODE_BLOCKLIST: set = set()
 
 
+def _quantize_decode_blocks(blocks: Dict) -> Dict:
+    """Per-out-column symmetric int8 quantization of the four matmul
+    weights in the fused-QKV block dict (the int8 weight-streaming
+    decode, round 5): scale[l, j] = max_i |w[l, i, j]| / 127, so the
+    dequant multiply commutes with the contraction and the kernel
+    applies ONE row-scale after each matmul. Biases/LN stay exact."""
+    bl = dict(blocks)
+    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
+                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+        w = bl[wk].astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=-2) / 127.0, 1e-8)
+        bl[wk] = jnp.round(w / s[:, None, :]).astype(jnp.int8)
+        bl[sk] = s
+    return bl
+
+
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
-               temperature: float, fused: bool = False):
+               temperature: float, fused: bool = False,
+               int8: bool = False):
     """Build (and cache) the jitted prefill+decode program for one
     (config, prompt length, generation length, temperature) signature —
     repeated gpt_decode calls hit jit's cache instead of retracing.
     ``fused``: run the whole decode step's layer stack as ONE Pallas
     kernel per batch row (ops/pallas_kernels.fused_decode_step) with
-    bf16 weights double-buffered through VMEM."""
+    bf16 weights double-buffered through VMEM. ``int8``: additionally
+    stream the matmul weights int8-quantized (half the bytes of the
+    weight-bandwidth-bound step; fused path only)."""
     cfg = GPTConfig(*cfg_key)
     total = n_prompt + max_new
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
@@ -752,12 +771,22 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
         b = prompt.shape[0]
         # fused QKV weights for the whole decode (see _block_core_fusedqkv)
         blocks = _fuse_qkv_blocks(params["blocks"])
+        dec_blocks = blocks
         if fused:
             # the fused kernel streams weights HBM->VMEM per layer per
             # token; converting once here halves that traffic (the XLA
             # path measured bf16 weights SLOWER — an M=1 tiling artifact
             # the kernel does not share, doc/performance.md round 4)
             blocks = jax.tree.map(lambda a: a.astype(dtype), blocks)
+            dec_blocks = blocks
+            if int8:
+                # quantize ONCE per decode call (outside the token
+                # scan); halves the weight stream again. DECODE steps
+                # only: the prefill keeps the bf16 blocks (it is one
+                # batched full-sequence pass — compute-shaped, not
+                # weight-bandwidth-bound — and its math must match the
+                # training forward that produced the caches)
+                dec_blocks = _quantize_decode_blocks(blocks)
 
         # ---- prefill: full forward over the prompt, emitting k/v caches
         h = (params["emb"][prompt]
@@ -805,7 +834,7 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                 # (doc/performance.md round 4).
                 from ..ops.pallas_kernels import fused_decode_step
                 h, cache_k, cache_v = fused_decode_step(
-                    blocks, h, cache_k, cache_v, pos, n_head)
+                    dec_blocks, h, cache_k, cache_v, pos, n_head)
             else:
                 def layer(carry_h, xs):
                     p, ck, cv = xs
@@ -843,13 +872,22 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
 def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                cfg: GPTConfig, mesh: Optional[Mesh] = None,
                temperature: float = 0.0,
-               rng: Optional[jax.Array] = None) -> jnp.ndarray:
+               rng: Optional[jax.Array] = None,
+               int8_weights: bool = False) -> jnp.ndarray:
     """Generate ``max_new`` (>= 1) tokens after ``prompt`` (b, n_prompt)
     int32. temperature 0 = greedy; else categorical sampling with ``rng``.
     Returns (b, n_prompt + max_new). n_prompt + max_new <= cfg.seq_len.
 
     ``mesh`` is accepted for API symmetry with gpt_logits but unused:
     decode partitioning follows the placements of ``params`` via GSPMD.
+
+    ``int8_weights`` (opt-in, round 5): stream the block matmul weights
+    int8-quantized through the fused kernel — decode is weight-bandwidth
+    -bound (the kernel measured 98.5% of the bf16 streaming floor), so
+    halving the bytes is the remaining lever; accuracy is pinned by the
+    interpret-mode differential + the on-chip token-agreement smoke.
+    Requires the fused path (single shard); ignored with a notice
+    otherwise.
     """
     n_prompt = int(prompt.shape[1])
     if max_new < 1:
@@ -867,22 +905,50 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
 
     def _unsharded(leaf):
         # decode partitioning follows the PARAMS' placements (docstring
-        # above), so the fusion gate inspects them, not the advisory mesh
-        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
-        return spec is None or all(ax is None for ax in spec)
+        # above), so the fusion gate inspects them, not the advisory
+        # mesh. A spec axis whose mesh size is 1 is replication in
+        # disguise (gpt_place emits P('pipe', ...) even on one chip) —
+        # without this, placed single-chip params silently lost the
+        # fused kernel (round-5 fix)
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            return True
+        msh = getattr(sh, "mesh", None)
+
+        def size(a):
+            try:
+                return dict(msh.shape).get(a, 1)
+            except Exception:           # unknown mesh type: be safe
+                return 2
+
+        return all(ax is None or all(size(a) == 1 for a in
+                                     (ax if isinstance(ax, tuple)
+                                      else (ax,)))
+                   for ax in spec)
 
     # the Pallas kernel is a Mosaic custom call GSPMD cannot partition:
     # any multi-device axis (including data) keeps the XLA scan path
     single_shard = (mesh is None or mesh.devices.size == 1) \
         and all(_unsharded(x) for x in jax.tree.leaves(params["blocks"]))
+    itemsize = 2 if cfg.dtype == "bfloat16" else 4
     fused = bool(single_shard and fused_decode_supported(
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
-        cfg.n_head, cfg.feat,
-        itemsize=2 if cfg.dtype == "bfloat16" else 4))
+        cfg.n_head, cfg.feat, itemsize=itemsize,
+        weight_itemsize=1 if int8_weights else None))
     cfg_key = dataclasses.astuple(cfg)
-    if (cfg_key, n_prompt, max_new) in _FUSED_DECODE_BLOCKLIST:
+    # blocklist keyed WITH the int8 flag: an OOM of the bf16-fused
+    # program must not lock out the int8 variant (half the weight VMEM
+    # — the large shapes that OOM are exactly where int8 fits)
+    if (cfg_key, n_prompt, max_new,
+            bool(int8_weights)) in _FUSED_DECODE_BLOCKLIST:
         fused = False
-    fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused)
+    if int8_weights and not fused:
+        import sys
+        print("gpt_decode: int8_weights needs the fused single-shard "
+              "path; falling back to the bf16/f32 decode", file=sys.stderr)
+    fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused,
+                    int8=bool(int8_weights and fused))
     try:
         return fn(params, prompt, rng)
     except Exception as e:                              # noqa: BLE001
@@ -902,7 +968,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
               "for this shape; falling back to the XLA scan (raise "
               "--xla_tpu_scoped_vmem_limit_kib to re-enable)",
               file=sys.stderr)
-        _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new))
+        _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new,
+                                     bool(int8_weights)))
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
                         False)
         return fn(params, prompt, rng)

@@ -181,7 +181,8 @@ def net_gpt_export(net) -> Tuple:
 def net_generate(net, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,
                  rng: Optional[jax.Array] = None,
-                 export: Optional[Tuple] = None) -> np.ndarray:
+                 export: Optional[Tuple] = None,
+                 int8: bool = False) -> np.ndarray:
     """Generate tokens from a GPT-shaped Net: prompt (b, n_prompt) int ->
     (b, n_prompt + max_new) int32. Drives models/gpt.py:gpt_decode — the
     fused whole-step decode kernel auto-engages on one chip exactly as on
@@ -195,7 +196,7 @@ def net_generate(net, prompt: np.ndarray, max_new: int,
     if rng is None and temperature > 0:
         rng = jax.random.PRNGKey(net.seed)
     out = gpt_decode(params, prompt, max_new, cfg,
-                     temperature=temperature, rng=rng)
+                     temperature=temperature, rng=rng, int8_weights=int8)
     return np.asarray(out)
 
 

@@ -1482,7 +1482,8 @@ def _scoped_vmem_kib() -> int:
 
 
 def fused_decode_supported(cache_shape, n_head: int, feat: int,
-                           itemsize: int = 2) -> bool:
+                           itemsize: int = 2,
+                           weight_itemsize: int = None) -> bool:
     """Whole-step fused decode: head-major (b, h, S, d) caches,
     lane-friendly dims, and a scoped-VMEM budget that covers one layer's
     resident weights + one row's caches with the pipeline's double
@@ -1495,8 +1496,10 @@ def fused_decode_supported(cache_shape, n_head: int, feat: int,
     the mesh nor the param placements shard model/pipe/seq/expert dims
     (models/gpt.py)."""
     b, h, s, d = cache_shape
-    layer_bytes = (12 * feat * feat + 2 * n_head * s * d
-                   + b * feat) * itemsize
+    if weight_itemsize is None:
+        weight_itemsize = itemsize      # int8 decode passes 1
+    layer_bytes = (12 * feat * feat * weight_itemsize
+                   + (2 * n_head * s * d + b * feat) * itemsize)
     need_kib = int(2.2 * layer_bytes) // 1024
     return (use_pallas() and h == n_head and d * n_head == feat
             and d % 64 == 0 and s % 8 == 0 and feat % 128 == 0
@@ -1507,8 +1510,8 @@ def fused_decode_supported(cache_shape, n_head: int, feat: int,
 def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
                          bqkv_ref, wproj_ref, bproj_ref, ln2g_ref, ln2b_ref,
                          wm1_ref, bm1_ref, wm2_ref, bm2_ref, ck_ref, cv_ref,
-                         out_ref, kwin_ref, vwin_ref, h_scr, *, n_head: int,
-                         eps: float = 1e-5):
+                         *rest, n_head: int, eps: float = 1e-5,
+                         quantized: bool = False):
     """One grid step = one transformer layer of one batch row; grid =
     (layer, batch) — LAYER-MAJOR, so the batch rows of a layer run on
     consecutive grid steps and pallas's block pipeline fetches each
@@ -1516,10 +1519,28 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
     not re-DMA'd), amortizing the weight stream over the whole batch.
     The per-row hidden states ride VMEM scratch (B, 1, F) across the
     layer steps (TPU grid steps are sequential), so a WHOLE decode step
-    is ONE kernel dispatch."""
+    is ONE kernel dispatch.
+
+    ``quantized``: the four matmul weight refs hold INT8 (per-out-column
+    symmetric) and four f32 scale refs follow ck/cv in ``rest`` —
+    weights stream HBM->VMEM at HALF the bf16 bytes (decode is weight-
+    bandwidth-bound: the round-5 XPlane decomposition put this kernel at
+    98.5% of the bf16 streaming floor, so halving the bytes is the one
+    remaining lever). Dequant = in-kernel astype + one row-scale
+    multiply after each matmul (per-column scales commute with the
+    contraction)."""
+    if quantized:
+        (sqkv_ref, sproj_ref, sm1_ref, sm2_ref,
+         out_ref, kwin_ref, vwin_ref, h_scr) = rest
+    else:
+        out_ref, kwin_ref, vwin_ref, h_scr = rest
     li = pl.program_id(0)
     bi = pl.program_id(1)
     pos = pos_ref[0]
+
+    def scaled(acc, s_ref):
+        """Apply the per-out-column dequant scale to a matmul result."""
+        return acc * s_ref[0] if quantized else acc
 
     @pl.when(li == 0)
     def _():
@@ -1537,9 +1558,16 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
                 * g_ref[0].astype(jnp.float32)
                 + b_ref[0].astype(jnp.float32))
 
+    def wload(ref):
+        # int8 weights convert to the compute dtype AFTER the (halved)
+        # HBM->VMEM stream; the converts ride the VPU under the next
+        # layer's weight DMA
+        return ref[0].astype(x.dtype) if quantized else ref[0]
+
     xf = x.astype(jnp.float32)
     xn = ln(xf, ln1g_ref, ln1b_ref).astype(x.dtype)
-    qkv = _mm(xn, wqkv_ref[0]) \
+    qkv = scaled(_mm(xn, wload(wqkv_ref)), sqkv_ref if quantized
+                 else None) \
         + bqkv_ref[0].astype(jnp.float32)            # (1, 3F) f32
     q = qkv[:, :f]
     kfr = [qkv[:, f + hd * d:f + (hd + 1) * d].astype(ck_ref.dtype)
@@ -1573,12 +1601,16 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
            + p_pos[hd:hd + 1] * vfr[hd].astype(jnp.float32)
            for hd in range(n_head)]
     o = jnp.concatenate(att, axis=-1).astype(x.dtype)   # (1, F)
-    h2f = xf + _mm(o, wproj_ref[0]) + bproj_ref[0].astype(jnp.float32)
+    h2f = xf + scaled(_mm(o, wload(wproj_ref)),
+                      sproj_ref if quantized else None) \
+        + bproj_ref[0].astype(jnp.float32)
 
     x2n = ln(h2f, ln2g_ref, ln2b_ref).astype(x.dtype)
-    m1 = jnp.maximum(_mm(x2n, wm1_ref[0])
+    m1 = jnp.maximum(scaled(_mm(x2n, wload(wm1_ref)),
+                            sm1_ref if quantized else None)
                      + bm1_ref[0].astype(jnp.float32), 0.0)
-    y = _mm(m1.astype(x.dtype), wm2_ref[0])
+    y = scaled(_mm(m1.astype(x.dtype), wload(wm2_ref)),
+               sm2_ref if quantized else None)
     new_h = (h2f + y + bm2_ref[0].astype(jnp.float32)).astype(x.dtype)
     h_scr[bi] = new_h
 
@@ -1606,6 +1638,7 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
     b, _, f = h.shape
     nl, _, nh, s, d = ck.shape
     dt = h.dtype
+    quantized = blocks["w_qkv"].dtype == jnp.int8
     row = lambda a: a.reshape(nl, 1, -1)
     w = {k: blocks[k] for k in ("w_qkv", "w_proj", "w_mlp1", "w_mlp2")}
     v = {k: row(blocks[k]) for k in ("ln1_g", "ln1_b", "b_qkv", "b_proj",
@@ -1614,7 +1647,13 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
                                    lambda li, bi: (li,) + (0,) * (a.ndim - 1))
     vspec = lambda a: pl.BlockSpec((1, 1, a.shape[-1]),
                                    lambda li, bi: (li, 0, 0))
-    kern = functools.partial(_decode_token_kernel, n_head=n_head)
+    kern = functools.partial(_decode_token_kernel, n_head=n_head,
+                             quantized=quantized)
+    scale_args, scale_specs = [], []
+    if quantized:
+        scale_args = [row(blocks[k]) for k in ("s_qkv", "s_proj",
+                                               "s_mlp1", "s_mlp2")]
+        scale_specs = [vspec(a) for a in scale_args]
     out, kwin, vwin = pl.pallas_call(
         kern,
         grid=(nl, b),
@@ -1627,7 +1666,8 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
                   pl.BlockSpec((1, 1, nh, s, d),
                                lambda li, bi: (li, bi, 0, 0, 0)),
                   pl.BlockSpec((1, 1, nh, s, d),
-                               lambda li, bi: (li, bi, 0, 0, 0))],
+                               lambda li, bi: (li, bi, 0, 0, 0))]
+        + scale_specs,
         out_specs=[pl.BlockSpec((1, 1, f), lambda li, bi: (bi, 0, 0)),
                    pl.BlockSpec((1, 1, nh, 8, d),
                                 lambda li, bi: (li, bi, 0, 0, 0)),
@@ -1641,7 +1681,7 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
     )(jnp.asarray(pos, jnp.int32).reshape(1), h.reshape(b, 1, f),
       v["ln1_g"], v["ln1_b"], w["w_qkv"], v["b_qkv"], w["w_proj"],
       v["b_proj"], v["ln2_g"], v["ln2_b"], w["w_mlp1"], v["b_mlp1"],
-      w["w_mlp2"], v["b_mlp2"], ck, cv)
+      w["w_mlp2"], v["b_mlp2"], ck, cv, *scale_args)
     base = (pos // 8) * 8
     ck2 = jax.lax.dynamic_update_slice(ck, kwin, (0, 0, 0, base, 0))
     cv2 = jax.lax.dynamic_update_slice(cv, vwin, (0, 0, 0, base, 0))

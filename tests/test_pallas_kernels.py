@@ -337,3 +337,41 @@ def test_fused_decode_step_matches_jnp(monkeypatch):
                                    rtol=2e-5, atol=2e-5, err_msg="b=%d" % b)
         np.testing.assert_allclose(np.asarray(cv2), np.asarray(ref_cv),
                                    rtol=2e-5, atol=2e-5, err_msg="b=%d" % b)
+
+
+def test_fused_decode_step_int8_matches_dequant(monkeypatch):
+    """int8 weight-streaming decode (round 5): the kernel fed int8
+    weights + per-out-column scales must equal the SAME kernel fed the
+    explicitly dequantized weights (the dequant multiply commutes with
+    the contraction); and the quantizer's round-trip error stays within
+    the symmetric-int8 bound."""
+    from cxxnet_tpu.models.gpt import _quantize_decode_blocks
+    from cxxnet_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    rs = np.random.RandomState(11)
+    blocks, h, ck, cv, pos, nh, _ = make_decode_reference(rs, b=2)
+    qb = _quantize_decode_blocks(blocks)
+    # quantizer bound: |w - q*s| <= s/2 per element
+    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
+                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+        w = np.asarray(blocks[wk], np.float32)
+        dq = (np.asarray(qb[wk], np.float32)
+              * np.asarray(qb[sk])[:, None, :])
+        bound = np.asarray(qb[sk])[:, None, :] * 0.5 + 1e-7
+        assert (np.abs(w - dq) <= bound).all(), wk
+        assert qb[wk].dtype == jnp.int8
+
+    deq = dict(blocks)
+    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
+                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+        deq[wk] = (qb[wk].astype(jnp.float32)
+                   * qb[sk][:, None, :]).astype(blocks[wk].dtype)
+    out_q, ckq, cvq = pk.fused_decode_step(qb, h, ck, cv, pos, nh)
+    out_r, ckr, cvr = pk.fused_decode_step(deq, h, ck, cv, pos, nh)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ckq), np.asarray(ckr),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cvq), np.asarray(cvr),
+                               rtol=2e-5, atol=2e-5)

@@ -215,6 +215,70 @@ def smoke_fused_decode():
           % err)
 
 
+def smoke_int8_decode():
+    """int8 weight-streaming decode on real hardware: (a) the kernel fed
+    int8+scales equals the kernel fed dequantized weights (Mosaic int8
+    load + convert path), (b) a TRAINED model's greedy generation under
+    int8 still follows its learned rule and matches bf16 token-for-token
+    (the accuracy bar for the opt-in; random weights can't test this —
+    near-uniform logits flip argmax at 1-ulp)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tests.test_pallas_kernels import make_decode_reference
+    from cxxnet_tpu.models.gpt import (GPTConfig, _quantize_decode_blocks,
+                                       gpt_decode, gpt_init, gpt_opt_init,
+                                       gpt_place, make_train_step)
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    from cxxnet_tpu.parallel.mesh import make_mesh
+
+    rs = np.random.RandomState(7)
+    blocks, h, ck, cv, pos, nh, _ = make_decode_reference(
+        rs, dtype="bfloat16")
+    qb = _quantize_decode_blocks(blocks)
+    deq = dict(blocks)
+    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
+                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+        deq[wk] = (qb[wk].astype(jnp.float32)
+                   * qb[sk][:, None, :]).astype(jnp.bfloat16)
+    run = jax.jit(lambda bb, hh, c1, c2: pk.fused_decode_step(
+        bb, hh, c1, c2, pos, nh))
+    out_q, _, _ = run(qb, h, ck, cv)
+    out_r, _, _ = run(deq, h, ck, cv)
+    err = float(jnp.max(jnp.abs(out_q.astype(jnp.float32)
+                                - out_r.astype(jnp.float32))))
+    assert err < 0.1, err
+
+    v = 64
+    cfg = GPTConfig(vocab_size=v, seq_len=256, n_layer=4, n_head=4,
+                    feat=256, dtype="bfloat16", n_microbatch=1)
+    mesh = make_mesh(devices=jax.devices())
+    params = gpt_place(gpt_init(jax.random.PRNGKey(0), cfg), mesh)
+    opt = gpt_opt_init(params, mesh, "adam")
+    step = make_train_step(cfg, mesh, eta=3e-3, optimizer="adam")
+    for i in range(120):
+        start = rs.randint(0, v, (32, 1))
+        ids = (start + np.arange(256)) % v
+        noise = rs.randint(0, v, ids.shape)
+        ids = np.where(rs.rand(*ids.shape) < 0.05, noise, ids)
+        params, opt, _ = step(params, opt,
+                              jnp.asarray(ids.astype(np.int32)))
+    prompt = jnp.asarray((np.arange(8)[None] % v).astype(np.int32))
+    out_bf = np.asarray(gpt_decode(params, prompt, 240, cfg))
+    out_i8 = np.asarray(gpt_decode(params, prompt, 240, cfg,
+                                   int8_weights=True))
+    s = out_i8[0]
+    rule = float((s[1:] == (s[:-1] + 1) % v).mean())
+    agree = float((out_bf == out_i8).mean())
+    # the ROBUST accuracy bar is rule-following: whole-sequence agreement
+    # under-reports (one early flip diverges an autoregressive run into a
+    # different-but-valid continuation), so it is reported, not asserted
+    assert rule > 0.99, (rule, agree)
+    print("int8 decode: kernel maxdiff %.3g vs dequant; trained-model "
+          "rule-following %.3f (asserted), bf16 agreement %.3f "
+          "(reported)" % (err, rule, agree))
+
+
 def main() -> int:
     import jax
     from cxxnet_tpu.ops import pallas_kernels
@@ -227,7 +291,8 @@ def main() -> int:
     t0 = time.time()
     for fn in (smoke_alexnet, smoke_flash_attention, smoke_gpt_long_seq,
                smoke_ring_kernels, smoke_flash_streaming, smoke_pallas_lrn,
-               smoke_decode, smoke_cached_attention, smoke_fused_decode):
+               smoke_decode, smoke_cached_attention, smoke_fused_decode,
+               smoke_int8_decode):
         fn()
     print("TPU SMOKE OK (%.0fs)" % (time.time() - t0))
     return 0
