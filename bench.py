@@ -264,7 +264,15 @@ def bench_moe():
          tps / R4_MOE_TOKENS_PER_SEC)
 
 
-def decode_cell(layers=12, heads=12, feat=768, seq=1024, prompt_len=16,
+# the headline decode cell's geometry — single source for decode_cell's
+# defaults AND bench_decode's int8-path gate (a drifting copy of these
+# constants is how a gate silently tests the wrong signature)
+DECODE_CELL = dict(layers=12, heads=12, feat=768, seq=1024, prompt_len=16)
+
+
+def decode_cell(layers=DECODE_CELL["layers"], heads=DECODE_CELL["heads"],
+                feat=DECODE_CELL["feat"], seq=DECODE_CELL["seq"],
+                prompt_len=DECODE_CELL["prompt_len"],
                 batch=1, reps=3, int8=False):
     """Best-of-reps seconds/token for KV-cache decode — the single
     measurement definition shared with tools/decode_bench.py."""
@@ -302,8 +310,10 @@ def bench_decode():
     # engage for this cell's signature — otherwise gpt_decode silently
     # falls back to bf16 and the number would be mislabeled
     from cxxnet_tpu.ops.pallas_kernels import fused_decode_supported
-    if fused_decode_supported((1, 12, 1024, 64), 12, 768, itemsize=2,
-                              weight_itemsize=1):
+    c = DECODE_CELL
+    if fused_decode_supported(
+            (1, c["heads"], c["seq"], c["feat"] // c["heads"]),
+            c["heads"], c["feat"], itemsize=2, weight_itemsize=1):
         ms8 = decode_cell(reps=2, int8=True) * 1e3
         emit("gpt_decode_int8_ms_per_token", ms8, "ms/token",
              R4_DECODE_MS_PER_TOKEN / ms8)

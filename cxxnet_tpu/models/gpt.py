@@ -727,6 +727,12 @@ def _attn_cached(q, ck, cv, pos):
 _FUSED_DECODE_BLOCKLIST: set = set()
 
 
+# (weight, scale) tag pairs of the int8 weight-streaming decode — the
+# single source for the quantizer, its inverse, and the kernel wiring
+QUANT_DECODE_PAIRS = (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
+                      ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2"))
+
+
 def _quantize_decode_blocks(blocks: Dict) -> Dict:
     """Per-out-column symmetric int8 quantization of the four matmul
     weights in the fused-QKV block dict (the int8 weight-streaming
@@ -734,12 +740,21 @@ def _quantize_decode_blocks(blocks: Dict) -> Dict:
     dequant multiply commutes with the contraction and the kernel
     applies ONE row-scale after each matmul. Biases/LN stay exact."""
     bl = dict(blocks)
-    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
-                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+    for wk, sk in QUANT_DECODE_PAIRS:
         w = bl[wk].astype(jnp.float32)
         s = jnp.maximum(jnp.max(jnp.abs(w), axis=-2) / 127.0, 1e-8)
         bl[wk] = jnp.round(w / s[:, None, :]).astype(jnp.int8)
         bl[sk] = s
+    return bl
+
+
+def _dequantize_decode_blocks(qblocks: Dict, dtype=jnp.float32) -> Dict:
+    """Inverse of :func:`_quantize_decode_blocks` (tests/smokes compare
+    the kernel on int8 inputs against the kernel on these)."""
+    bl = dict(qblocks)
+    for wk, sk in QUANT_DECODE_PAIRS:
+        bl[wk] = (bl[wk].astype(jnp.float32)
+                  * bl.pop(sk)[:, None, :]).astype(dtype)
     return bl
 
 
@@ -970,8 +985,11 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
               file=sys.stderr)
         _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new,
                                      bool(int8_weights)))
+        # int8=False kwarg spelled the same way as the primary call so
+        # lru_cache reuses one entry for the unfused program (a kwarg/
+        # positional mismatch would trace+compile it twice)
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
-                        False)
+                        False, int8=False)
         return fn(params, prompt, rng)
 
 

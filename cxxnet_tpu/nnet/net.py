@@ -228,7 +228,12 @@ class Net:
             from .pipeline_dsl import attn_saved_split, find_block_segment
             seg = self._pp_segment
             if seg is None:
-                seg = find_block_segment(g, self.layers)
+                # remat recomputes each rep over the SAME full batch, so
+                # quirk-mode (stateless) batch_norm is admissible here —
+                # unlike pipelining, whose microbatching would change the
+                # BN statistics (pipeline_dsl._layer_ok)
+                seg = find_block_segment(g, self.layers,
+                                         allow_batch_stats=True)
                 if seg is None:
                     raise ConfigError(
                         "remat = 1 needs a repeated block segment (>= 2 "

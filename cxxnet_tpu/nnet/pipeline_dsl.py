@@ -63,12 +63,24 @@ def _rep_nodes(specs, start, period):
     return external, produced
 
 
-def _layer_ok(spec, layer) -> bool:
+def _layer_ok(spec, layer, allow_batch_stats: bool = False) -> bool:
     # emits_aux_loss (MoE load-balance): run_pp_segment's inner context
     # discards ctx.losses, so such layers would silently train without
-    # their auxiliary objective — keep them out of pipelined segments
+    # their auxiliary objective — keep them out of pipelined segments.
+    #
+    # batch_norm: under the reference quirk default (moving_average = 0,
+    # batch stats at eval) it is STATELESS, but its statistics are
+    # per-batch — admissible for REMAT (the rep recomputes over the same
+    # full batch, exact) and NOT for pipelining (gpipe applies the block
+    # per MICROBATCH, which would silently change the statistics);
+    # ``allow_batch_stats`` encodes which caller is asking (round 5).
+    if spec.type == "batch_norm":
+        stateless = not (layer.has_state and bool(layer.init_state()))
+        return allow_batch_stats and stateless
+    stateful = layer.has_state and bool(layer.init_state()) \
+        if hasattr(layer, "init_state") else layer.has_state
     return not (spec.type == "share" or spec.pairtest is not None
-                or layer.has_state or layer.uses_rng or layer.is_loss
+                or stateful or layer.uses_rng or layer.is_loss
                 or getattr(layer, "emits_aux_loss", False))
 
 
@@ -99,10 +111,11 @@ def _iso(specs, start, period, r) -> Optional[Dict[int, int]]:
     return m
 
 
-def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
+def _count_reps(specs, layers, start, period,
+                allow_batch_stats: bool = False) -> Optional[PPSegment]:
     """Longest chain of isomorphic single-entry/single-exit reps at start."""
     n = len(specs)
-    if any(not _layer_ok(specs[j], layers[j])
+    if any(not _layer_ok(specs[j], layers[j], allow_batch_stats)
            for j in range(start, start + period)):
         return None
     if not _has_params(layers, start, period):
@@ -120,7 +133,8 @@ def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
     while start + (count + 1) * period <= n:
         r = count
         if any(not _layer_ok(specs[start + r * period + j],
-                             layers[start + r * period + j])
+                             layers[start + r * period + j],
+                             allow_batch_stats)
                for j in range(period)):
             break
         m = _iso(specs, start, period, r)
@@ -146,17 +160,21 @@ def _count_reps(specs, layers, start, period) -> Optional[PPSegment]:
     return seg
 
 
-def find_block_segment(graph, layers) -> Optional[PPSegment]:
+def find_block_segment(graph, layers,
+                       allow_batch_stats: bool = False) -> Optional[PPSegment]:
     """The maximal repeated-block segment of the net, or None. Shared by
-    pipeline parallelism (find_pp_segment) and block rematerialization
-    (``remat = 1``), so the two features agree on what "the block stack"
-    is."""
+    pipeline parallelism (find_pp_segment, ``allow_batch_stats=False``:
+    gpipe's per-microbatch application would change BN statistics) and
+    block rematerialization (``remat = 1``, True: recompute over the same
+    full batch is exact), so the two features agree on what "the block
+    stack" is up to that one admission rule."""
     specs = graph.layers
     n = len(specs)
     best: Optional[PPSegment] = None
     for period in range(1, n // 2 + 1):
         for start in range(0, n - 2 * period + 1):
-            seg = _count_reps(specs, layers, start, period)
+            seg = _count_reps(specs, layers, start, period,
+                              allow_batch_stats)
             if seg and (best is None
                         or seg.period * seg.count > best.period * best.count):
                 best = seg

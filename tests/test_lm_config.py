@@ -289,3 +289,20 @@ def test_wrapper_generate():
     out = net.generate(ids[:2, :4].astype(np.int32), max_new=3)
     assert out.shape == (2, 7)
     np.testing.assert_array_equal(out[:, :4], ids[:2, :4].astype(np.int32))
+
+
+def test_remat_admits_quirk_bn_pp_does_not():
+    """batch_norm admission split (round-5 review finding): remat
+    recomputes over the SAME full batch (exact) so quirk-mode stateless
+    BN blocks are admissible; gpipe applies blocks per MICROBATCH, which
+    would silently change BN statistics, so pipelining still rejects
+    them loudly."""
+    from cxxnet_tpu.models import resnet_config
+
+    cfg = resnet_config(50, batch_size=8, dev="cpu:0-7").replace(
+        "moving_average = 1", "moving_average = 0")
+    net = Net(tokenize(cfg + "\nremat = 1\n"))
+    net.init_model()
+    assert net._remat_segment is not None
+    with pytest.raises(ConfigError, match="no repeated block segment"):
+        Net(tokenize(cfg + "\npipeline_parallel = 2\n")).init_model()

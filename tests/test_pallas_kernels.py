@@ -345,28 +345,23 @@ def test_fused_decode_step_int8_matches_dequant(monkeypatch):
     explicitly dequantized weights (the dequant multiply commutes with
     the contraction); and the quantizer's round-trip error stays within
     the symmetric-int8 bound."""
-    from cxxnet_tpu.models.gpt import _quantize_decode_blocks
+    from cxxnet_tpu.models.gpt import (QUANT_DECODE_PAIRS,
+                                       _dequantize_decode_blocks,
+                                       _quantize_decode_blocks)
     from cxxnet_tpu.ops import pallas_kernels as pk
 
     monkeypatch.setattr(pk, "_INTERPRET", True)
     rs = np.random.RandomState(11)
     blocks, h, ck, cv, pos, nh, _ = make_decode_reference(rs, b=2)
     qb = _quantize_decode_blocks(blocks)
+    deq = _dequantize_decode_blocks(qb, dtype=blocks["w_qkv"].dtype)
     # quantizer bound: |w - q*s| <= s/2 per element
-    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
-                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
+    for wk, sk in QUANT_DECODE_PAIRS:
         w = np.asarray(blocks[wk], np.float32)
-        dq = (np.asarray(qb[wk], np.float32)
-              * np.asarray(qb[sk])[:, None, :])
         bound = np.asarray(qb[sk])[:, None, :] * 0.5 + 1e-7
-        assert (np.abs(w - dq) <= bound).all(), wk
+        assert (np.abs(w - np.asarray(deq[wk], np.float32))
+                <= bound).all(), wk
         assert qb[wk].dtype == jnp.int8
-
-    deq = dict(blocks)
-    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
-                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
-        deq[wk] = (qb[wk].astype(jnp.float32)
-                   * qb[sk][:, None, :]).astype(blocks[wk].dtype)
     out_q, ckq, cvq = pk.fused_decode_step(qb, h, ck, cv, pos, nh)
     out_r, ckr, cvr = pk.fused_decode_step(deq, h, ck, cv, pos, nh)
     np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_r),

@@ -226,7 +226,9 @@ def smoke_int8_decode():
     import jax.numpy as jnp
     import numpy as np
     from tests.test_pallas_kernels import make_decode_reference
-    from cxxnet_tpu.models.gpt import (GPTConfig, _quantize_decode_blocks,
+    from cxxnet_tpu.models.gpt import (GPTConfig,
+                                       _dequantize_decode_blocks,
+                                       _quantize_decode_blocks,
                                        gpt_decode, gpt_init, gpt_opt_init,
                                        gpt_place, make_train_step)
     from cxxnet_tpu.ops import pallas_kernels as pk
@@ -236,11 +238,7 @@ def smoke_int8_decode():
     blocks, h, ck, cv, pos, nh, _ = make_decode_reference(
         rs, dtype="bfloat16")
     qb = _quantize_decode_blocks(blocks)
-    deq = dict(blocks)
-    for wk, sk in (("w_qkv", "s_qkv"), ("w_proj", "s_proj"),
-                   ("w_mlp1", "s_mlp1"), ("w_mlp2", "s_mlp2")):
-        deq[wk] = (qb[wk].astype(jnp.float32)
-                   * qb[sk][:, None, :]).astype(jnp.bfloat16)
+    deq = _dequantize_decode_blocks(qb, dtype=jnp.bfloat16)
     run = jax.jit(lambda bb, hh, c1, c2: pk.fused_decode_step(
         bb, hh, c1, c2, pos, nh))
     out_q, _, _ = run(qb, h, ck, cv)
