@@ -761,7 +761,7 @@ def _dequantize_decode_blocks(qblocks: Dict, dtype=jnp.float32) -> Dict:
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                temperature: float, fused: bool = False,
-               int8: bool = False):
+               int8: bool = False, fold_head: bool = False):
     """Build (and cache) the jitted prefill+decode program for one
     (config, prompt length, generation length, temperature) signature —
     repeated gpt_decode calls hit jit's cache instead of retracing.
@@ -829,6 +829,9 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
         ids = ids.at[:, n_prompt].set(
             pick(logits, jax.random.fold_in(rng, 0)).astype(jnp.int32))
 
+        # hoisted once per decode call for the head-folded greedy path
+        head_cast = params["head"].astype(dtype)
+
         # ---- decode: one token per step against the caches
         def step(carry, i):
             ids, cache_k, cache_v = carry
@@ -838,6 +841,24 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                  + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
                                             axis=0)[None]).astype(dtype)
 
+            if fused and fold_head:
+                # batch-1 greedy decode with the final LN + LM-head
+                # matmul + argmax folded INTO the kernel (round 5) —
+                # removes ~6 glue ops per token (measured +5% on the
+                # int8 85M cell same-run; folding the embedding lookup
+                # too measured a WASH and is not used). The caller gates
+                # fold_head on batch 1 (the latency-bound case it exists
+                # for — batched decode shares the glue dispatch across
+                # rows, and the b>1 head-folded grid trips a JAX
+                # lowering-cache crash), greedy sampling, AND the head
+                # matrix fitting the scoped-VMEM budget
+                # (doc/performance.md round 5)
+                from ..ops.pallas_kernels import fused_decode_step
+                tok_next, cache_k, cache_v = fused_decode_step(
+                    dec_blocks, h, cache_k, cache_v, pos, n_head,
+                    head=(params["lnf_g"], params["lnf_b"], head_cast))
+                ids = lax.dynamic_update_slice(ids, tok_next, (0, pos + 1))
+                return (ids, cache_k, cache_v), None
             if fused:
                 # ONE kernel per token per batch row: grid over layers,
                 # weights double-buffered by the pallas pipeline, h in
@@ -962,8 +983,20 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         import sys
         print("gpt_decode: int8_weights needs the fused single-shard "
               "path; falling back to the bf16/f32 decode", file=sys.stderr)
+    # the head fold has its OWN vmem gate (the resident (feat, vocab)
+    # head matrix): an over-budget head only drops the fold, never the
+    # fused kernel (review r5)
+    fold_head = bool(
+        fused and temperature == 0 and int(prompt.shape[0]) == 1
+        and fused_decode_supported(
+            (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
+            cfg.n_head, cfg.feat, itemsize=itemsize,
+            weight_itemsize=1 if int8_weights else None,
+            head_bytes=cfg.feat * cfg.vocab_size * itemsize
+            + 8 * cfg.feat))
     fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused,
-                    int8=bool(int8_weights and fused))
+                    int8=bool(int8_weights and fused),
+                    fold_head=fold_head)
     try:
         return fn(params, prompt, rng)
     except Exception as e:                              # noqa: BLE001

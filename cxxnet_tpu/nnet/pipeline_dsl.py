@@ -75,8 +75,10 @@ def _layer_ok(spec, layer, allow_batch_stats: bool = False) -> bool:
     # per MICROBATCH, which would silently change the statistics);
     # ``allow_batch_stats`` encodes which caller is asking (round 5).
     if spec.type == "batch_norm":
-        stateless = not (layer.has_state and bool(layer.init_state()))
-        return allow_batch_stats and stateless
+        # static flag, not init_state(): this predicate runs inside the
+        # O(periods x starts) segment search and init_state() allocates
+        # device arrays (review r5)
+        return allow_batch_stats and not getattr(layer, "moving_average", 1)
     stateful = layer.has_state and bool(layer.init_state()) \
         if hasattr(layer, "init_state") else layer.has_state
     return not (spec.type == "share" or spec.pairtest is not None

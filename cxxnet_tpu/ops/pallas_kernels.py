@@ -1483,7 +1483,8 @@ def _scoped_vmem_kib() -> int:
 
 def fused_decode_supported(cache_shape, n_head: int, feat: int,
                            itemsize: int = 2,
-                           weight_itemsize: int = None) -> bool:
+                           weight_itemsize: int = None,
+                           head_bytes: int = 0) -> bool:
     """Whole-step fused decode: head-major (b, h, S, d) caches,
     lane-friendly dims, and a scoped-VMEM budget that covers one layer's
     resident weights + one row's caches with the pipeline's double
@@ -1498,9 +1499,12 @@ def fused_decode_supported(cache_shape, n_head: int, feat: int,
     b, h, s, d = cache_shape
     if weight_itemsize is None:
         weight_itemsize = itemsize      # int8 decode passes 1
+    # head_bytes: the resident (feat, vocab) head matrix of the folded
+    # greedy path — its gate is evaluated SEPARATELY by gpt_decode so a
+    # too-large head only drops the fold, never the fused kernel itself
     layer_bytes = (12 * feat * feat * weight_itemsize
                    + (2 * n_head * s * d + b * feat) * itemsize)
-    need_kib = int(2.2 * layer_bytes) // 1024
+    need_kib = int(2.2 * layer_bytes + head_bytes) // 1024
     return (use_pallas() and h == n_head and d * n_head == feat
             and d % 64 == 0 and s % 8 == 0 and feat % 128 == 0
             and b <= 64 and _scoped_vmem_kib() >= need_kib
@@ -1511,7 +1515,7 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
                          bqkv_ref, wproj_ref, bproj_ref, ln2g_ref, ln2b_ref,
                          wm1_ref, bm1_ref, wm2_ref, bm2_ref, ck_ref, cv_ref,
                          *rest, n_head: int, eps: float = 1e-5,
-                         quantized: bool = False):
+                         quantized: bool = False, with_head: bool = False):
     """One grid step = one transformer layer of one batch row; grid =
     (layer, batch) — LAYER-MAJOR, so the batch rows of a layer run on
     consecutive grid steps and pallas's block pipeline fetches each
@@ -1528,12 +1532,22 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
     98.5% of the bf16 streaming floor, so halving the bytes is the one
     remaining lever). Dequant = in-kernel astype + one row-scale
     multiply after each matmul (per-column scales commute with the
-    contraction)."""
+    contraction).
+
+    ``with_head``: three more refs (lnf gain/bias + the LM head matrix)
+    follow, and the first OUTPUT ref is the (b, 1) int32 GREEDY token
+    instead of the hidden state — the whole next-token computation
+    (final LN -> head matmul -> argmax) stays in the kernel, removing
+    the per-token glue ops whose dispatch gaps the round-5 decomposition
+    measured at ~0.09 ms/token."""
+    rest = list(rest)
     if quantized:
-        (sqkv_ref, sproj_ref, sm1_ref, sm2_ref,
-         out_ref, kwin_ref, vwin_ref, h_scr) = rest
-    else:
-        out_ref, kwin_ref, vwin_ref, h_scr = rest
+        sqkv_ref, sproj_ref, sm1_ref, sm2_ref = rest[:4]
+        rest = rest[4:]
+    if with_head:
+        lnfg_ref, lnfb_ref, whead_ref = rest[:3]
+        rest = rest[3:]
+    out_ref, kwin_ref, vwin_ref, h_scr = rest
     li = pl.program_id(0)
     bi = pl.program_id(1)
     pos = pos_ref[0]
@@ -1623,10 +1637,21 @@ def _decode_token_kernel(pos_ref, h_ref, ln1g_ref, ln1b_ref, wqkv_ref,
     # data that the final layer's write overwrites
     @pl.when(li == pl.num_programs(0) - 1)
     def _():
-        out_ref[0] = new_h.astype(out_ref.dtype)
+        if with_head:
+            hl = ln(new_h.astype(jnp.float32), lnfg_ref, lnfb_ref)
+            logits = _mm(hl.astype(x.dtype), whead_ref[...])  # (1, V) f32
+            # first-occurrence argmax via 2-D iota (Mosaic rejects 1-D
+            # iota; min-index-at-max matches jnp.argmax tie-breaking)
+            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            mx = jnp.max(logits, axis=-1, keepdims=True)
+            idx = jnp.min(jnp.where(logits == mx, cols, jnp.int32(1 << 30)),
+                          axis=-1, keepdims=True)        # (1, 1)
+            out_ref[...] = idx       # 2-D store (Mosaic rejects scalars)
+        else:
+            out_ref[0] = new_h.astype(out_ref.dtype)
 
 
-def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
+def fused_decode_step(blocks, h, ck, cv, pos, n_head: int, head=None):
     """Run the WHOLE decode step's layer stack as one kernel per batch row.
 
     blocks: the stacked (L, ...) fused-QKV weight dict, already in the
@@ -1634,10 +1659,17 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
     caches (the prefill layout); pos: traced i32. Returns (h_out, ck', cv')
     with each layer's cache updated at pos via one dynamic_update_slice
     per cache (in-place when ck/cv are loop carries).
+
+    ``head`` (optional): (lnf_g (F,), lnf_b (F,), w_head (F, V)) — fold
+    the final LN + LM-head matmul + GREEDY argmax into the kernel; the
+    first return becomes the (b, 1) int32 next-token ids. (Folding the
+    EMBEDDING lookup in as well was measured a wash — the positional
+    table's per-token DMA costs what the removed glue saved — and is
+    not offered; doc/performance.md round 5.)
     """
     b, _, f = h.shape
-    nl, _, nh, s, d = ck.shape
     dt = h.dtype
+    nl, _, nh, s, d = ck.shape
     quantized = blocks["w_qkv"].dtype == jnp.int8
     row = lambda a: a.reshape(nl, 1, -1)
     w = {k: blocks[k] for k in ("w_qkv", "w_proj", "w_mlp1", "w_mlp2")}
@@ -1648,12 +1680,27 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
     vspec = lambda a: pl.BlockSpec((1, 1, a.shape[-1]),
                                    lambda li, bi: (li, 0, 0))
     kern = functools.partial(_decode_token_kernel, n_head=n_head,
-                             quantized=quantized)
-    scale_args, scale_specs = [], []
+                             quantized=quantized,
+                             with_head=head is not None)
+    extra_args, extra_specs = [], []
     if quantized:
-        scale_args = [row(blocks[k]) for k in ("s_qkv", "s_proj",
-                                               "s_mlp1", "s_mlp2")]
-        scale_specs = [vspec(a) for a in scale_args]
+        extra_args += [row(blocks[k]) for k in ("s_qkv", "s_proj",
+                                                "s_mlp1", "s_mlp2")]
+        extra_specs += [vspec(a) for a in extra_args]
+    if head is not None:
+        lnf_g, lnf_b, w_head = head
+        vocab = w_head.shape[-1]
+        extra_args += [lnf_g.reshape(1, -1), lnf_b.reshape(1, -1), w_head]
+        extra_specs += [
+            pl.BlockSpec((1, f), lambda li, bi: (0, 0)),
+            pl.BlockSpec((1, f), lambda li, bi: (0, 0)),
+            pl.BlockSpec((f, vocab), lambda li, bi: (0, 0)),
+        ]
+        out0_spec = pl.BlockSpec((1, 1), lambda li, bi: (bi, 0))
+        out0_shape = _out_struct((b, 1), jnp.int32, h)
+    else:
+        out0_spec = pl.BlockSpec((1, 1, f), lambda li, bi: (bi, 0, 0))
+        out0_shape = _out_struct((b, 1, f), dt, h)
     out, kwin, vwin = pl.pallas_call(
         kern,
         grid=(nl, b),
@@ -1667,13 +1714,13 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
                                lambda li, bi: (li, bi, 0, 0, 0)),
                   pl.BlockSpec((1, 1, nh, s, d),
                                lambda li, bi: (li, bi, 0, 0, 0))]
-        + scale_specs,
-        out_specs=[pl.BlockSpec((1, 1, f), lambda li, bi: (bi, 0, 0)),
+        + extra_specs,
+        out_specs=[out0_spec,
                    pl.BlockSpec((1, 1, nh, 8, d),
                                 lambda li, bi: (li, bi, 0, 0, 0)),
                    pl.BlockSpec((1, 1, nh, 8, d),
                                 lambda li, bi: (li, bi, 0, 0, 0))],
-        out_shape=[_out_struct((b, 1, f), dt, h),
+        out_shape=[out0_shape,
                    _out_struct((nl, b, nh, 8, d), ck.dtype, ck),
                    _out_struct((nl, b, nh, 8, d), cv.dtype, cv)],
         scratch_shapes=[pltpu.VMEM((b, 1, f), dt)],
@@ -1681,8 +1728,10 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int):
     )(jnp.asarray(pos, jnp.int32).reshape(1), h.reshape(b, 1, f),
       v["ln1_g"], v["ln1_b"], w["w_qkv"], v["b_qkv"], w["w_proj"],
       v["b_proj"], v["ln2_g"], v["ln2_b"], w["w_mlp1"], v["b_mlp1"],
-      w["w_mlp2"], v["b_mlp2"], ck, cv, *scale_args)
+      w["w_mlp2"], v["b_mlp2"], ck, cv, *extra_args)
     base = (pos // 8) * 8
     ck2 = jax.lax.dynamic_update_slice(ck, kwin, (0, 0, 0, base, 0))
     cv2 = jax.lax.dynamic_update_slice(cv, vwin, (0, 0, 0, base, 0))
+    if head is not None:
+        return out, ck2, cv2                   # (b, 1) int32 next tokens
     return out.reshape(b, 1, f), ck2, cv2

@@ -370,3 +370,33 @@ def test_fused_decode_step_int8_matches_dequant(monkeypatch):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(cvq), np.asarray(cvr),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_fused_decode_step_head_folded(monkeypatch):
+    """Head folding (round 5): with head=(lnf_g, lnf_b, w_head) the
+    kernel emits the GREEDY next-token ids of final-LN + head-matmul +
+    argmax — must equal the same computation applied to the unfolded
+    kernel's hidden-state output, with identical cache windows."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    rs = np.random.RandomState(5)
+    blocks, h, ck, cv, pos, nh, _ = make_decode_reference(rs, b=3)
+    f = h.shape[-1]
+    v = 48
+    lnf_g = jnp.asarray(rs.randn(f).astype(np.float32) * 0.3 + 1)
+    lnf_b = jnp.asarray(rs.randn(f).astype(np.float32) * 0.1)
+    w_head = jnp.asarray(rs.randn(f, v).astype(np.float32) * 0.2)
+    out_h, ck1, cv1 = pk.fused_decode_step(blocks, h, ck, cv, pos, nh)
+    tok, ck2, cv2 = pk.fused_decode_step(blocks, h, ck, cv, pos, nh,
+                                         head=(lnf_g, lnf_b, w_head))
+    x = np.asarray(out_h, np.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    hl = (x - mu) / np.sqrt(var + 1e-5) * np.asarray(lnf_g) \
+        + np.asarray(lnf_b)
+    ref = (hl[:, 0] @ np.asarray(w_head)).argmax(-1)
+    assert tok.shape == (3, 1) and tok.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(tok)[:, 0], ref)
+    np.testing.assert_allclose(np.asarray(ck1), np.asarray(ck2))
+    np.testing.assert_allclose(np.asarray(cv1), np.asarray(cv2))
