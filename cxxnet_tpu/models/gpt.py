@@ -1012,17 +1012,35 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         if not fused or not scoped:
             raise
         import sys
+        if fold_head:
+            # an over-budget HEAD must only drop the fold, never the
+            # fused kernel (the fold's vmem gate is approximate too):
+            # retry fused-without-fold before considering the blocklist
+            print("gpt_decode: head-folded kernel exceeded the scoped-"
+                  "VMEM budget; retrying the fused kernel without the "
+                  "fold", file=sys.stderr)
+            fn = _decode_fn(cfg_key, n_prompt, max_new,
+                            float(temperature), fused,
+                            int8=bool(int8_weights and fused),
+                            fold_head=False)
+            try:
+                return fn(params, prompt, rng)
+            except Exception as e2:                     # noqa: BLE001
+                msg2 = str(e2).lower()
+                if "vmem" not in msg2 and not ("scoped" in msg2
+                                               and "memory" in msg2):
+                    raise
         print("gpt_decode: fused kernel exceeded the scoped-VMEM budget "
               "for this shape; falling back to the XLA scan (raise "
               "--xla_tpu_scoped_vmem_limit_kib to re-enable)",
               file=sys.stderr)
         _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new,
                                      bool(int8_weights)))
-        # int8=False kwarg spelled the same way as the primary call so
-        # lru_cache reuses one entry for the unfused program (a kwarg/
-        # positional mismatch would trace+compile it twice)
+        # kwargs spelled the same way as the primary call so lru_cache
+        # reuses one entry for the unfused program (a kwarg/positional
+        # mismatch would trace+compile it twice)
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
-                        False, int8=False)
+                        False, int8=False, fold_head=False)
         return fn(params, prompt, rng)
 
 

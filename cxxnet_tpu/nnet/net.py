@@ -121,6 +121,18 @@ class Net:
                         "remat_mode must be 'block' or 'attn_saved', "
                         "got %r" % v)
                 self.remat_mode = v
+            elif k == "pipeline_schedule":
+                # the config-DSL pipeline runs the gpipe schedule; 1f1b
+                # (manual per-stage VJPs with the loss in the last
+                # stage) needs the functional models/gpt.py trainer —
+                # reject rather than silently ignore the request
+                if v != "gpipe":
+                    raise ConfigError(
+                        "pipeline_schedule %r is not available on the "
+                        "config path (gpipe only); the 1f1b schedule "
+                        "lives on the models/gpt.py trainer "
+                        "(GPTConfig.pipeline_schedule, "
+                        "doc/multi-device.md)" % v)
             elif k == "clip_norm":
                 self.clip_norm = float(v)
             elif k == "dist_feed":

@@ -164,3 +164,22 @@ def test_gpt_1f1b_rejects_seq_parallel():
     ids = jnp.zeros((8, 16), jnp.int32)
     with pytest.raises(ValueError, match="1f1b"):
         step(params, mom, ids)
+
+
+def test_dsl_rejects_1f1b_schedule():
+    """The config DSL must reject (not silently ignore) a 1f1b
+    pipeline_schedule request — the schedule lives on the gpt.py path."""
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.models import gpt_lm_config
+    from cxxnet_tpu.utils.config import ConfigError, tokenize
+
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=8, dev="cpu:0-7",
+                        pipeline_parallel=2)
+    cfg += "\npipeline_schedule = 1f1b\n"
+    with pytest.raises(ConfigError, match="gpt.py"):
+        Net(tokenize(cfg)).init_model()
+    # the gpipe spelling is accepted (it is what the DSL runs)
+    net = Net(tokenize(cfg.replace("pipeline_schedule = 1f1b",
+                                   "pipeline_schedule = gpipe")))
+    net.init_model()
