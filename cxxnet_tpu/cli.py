@@ -52,6 +52,7 @@ class LearnTask:
         self.max_round = 1 << 30
         self.silent = 0
         self.test_io = 0
+        self.prefetch_to_device = 2   # async feed queue depth; 0 = sync path
         self.profile_dir = ""     # 'profile = <dir>': xplane trace dir
         self.step_stats = 0       # 'step_stats = 1': per-round phase timing
         self.nan_check = 0        # 'nan_check = N': check loss every N steps
@@ -72,6 +73,7 @@ class LearnTask:
         self.generate_int8 = 0    # 1: int8 weight-streaming decode
         self.net: Optional[Net] = None
         self.itr_train = None
+        self._train_feed = None   # DevicePrefetcher over itr_train (async)
         self.itr_evals = []
         self.eval_names = []
         self.itr_pred = None
@@ -101,6 +103,8 @@ class LearnTask:
             self.task = val
         elif name == "test_io":
             self.test_io = int(val)
+        elif name == "prefetch_to_device":
+            self.prefetch_to_device = int(val)
         elif name == "profile":
             self.profile_dir = val
         elif name == "step_stats":
@@ -321,7 +325,34 @@ class LearnTask:
                          % (self.start_counter, self.start_counter))
         return True
 
+    def _train_feed_iter(self):
+        """The round loop's batch source: a DevicePrefetcher over the host
+        chain when ``prefetch_to_device > 0`` (placement on a background
+        thread, batch k+1's transfer overlapped with step k — see
+        io/device_prefetch.py), else the host iterator itself (the old
+        synchronous path). ``test_io = 1`` never prefetches: there is no
+        net to place onto."""
+        if self.prefetch_to_device <= 0 or self.test_io:
+            return self.itr_train
+        if self._train_feed is None:
+            from .io.device_prefetch import DevicePrefetcher
+            self._train_feed = DevicePrefetcher(
+                self.net.place_batch, self.itr_train,
+                depth=self.prefetch_to_device)
+        return self._train_feed
+
+    def _close_train_feed(self) -> None:
+        if self._train_feed is not None:
+            self._train_feed.close()
+            self._train_feed = None
+
     def _task_train(self) -> None:
+        try:
+            self._task_train_rounds()
+        finally:
+            self._close_train_feed()
+
+    def _task_train_rounds(self) -> None:
         start = time.time()
         if self.continue_training == 0 and self.model_in == "NULL":
             pass      # fresh start
@@ -341,26 +372,28 @@ class LearnTask:
                 print("update round %d" % (self.start_counter - 1))
             sample_counter = 0
             self.net.start_round(self.start_counter)
-            self.itr_train.before_first()
+            feed = self._train_feed_iter()
+            feed.before_first()
             stats = profiler.StepStats(batch_size=self.net.batch_size) \
                 if self.step_stats else None
             restart_round = False
             while True:
                 if stats:
-                    with stats.phase("data"):
-                        has_next = self.itr_train.next()
+                    with stats.phase(profiler.FEED_WAIT):
+                        has_next = feed.next()
                 else:
-                    has_next = self.itr_train.next()
+                    has_next = feed.next()
                 if not has_next:
                     break
                 if self.test_io == 0:
                     with contextlib.ExitStack() as es:
                         if stats:
-                            es.enter_context(stats.phase("step"))
+                            es.enter_context(
+                                stats.phase(profiler.STEP_DISPATCH))
                         if self.profile_dir:
                             es.enter_context(
                                 profiler.step_annotation(self.net.epoch_counter))
-                        self.net.update(self.itr_train.value())
+                        self.net.update(feed.value())
                     if self.nan_check and \
                             (sample_counter + 1) % self.nan_check == 0 and \
                             self._diverged(self.net.last_loss()):
@@ -393,23 +426,31 @@ class LearnTask:
                                         elapsed))
                     sys.stdout.flush()
             if restart_round:
+                # recovery replaced self.net — the old feed's place_batch
+                # is bound to the dead trainer; rebuild it next round
+                self._close_train_feed()
                 continue
-            if stats and not self.silent:
-                print("\nround %d: %s" % (self.start_counter - 1,
-                                          stats.summary()))
             if self.check_consistency and self.test_io == 0:
                 diff, worst = self.net.check_replica_consistency()
                 sys.stderr.write("[%d] replica-consistency max|Δ|=%g%s\n"
                                  % (self.start_counter, diff,
                                     " at %s.%s" % worst if worst else ""))
             if self.test_io == 0:
-                sys.stderr.write("[%d]" % self.start_counter)
-                if not self.itr_evals:
-                    sys.stderr.write(self.net.evaluate(None, "train"))
-                for itr, name in zip(self.itr_evals, self.eval_names):
-                    sys.stderr.write(self.net.evaluate(itr, name))
-                sys.stderr.write("\n")
-                sys.stderr.flush()
+                with contextlib.ExitStack() as es:
+                    if stats:
+                        # the round's single train-metric fold + the eval
+                        # passes — the only device->host metric syncs
+                        es.enter_context(stats.phase(profiler.METRIC_SYNC))
+                    sys.stderr.write("[%d]" % self.start_counter)
+                    if not self.itr_evals:
+                        sys.stderr.write(self.net.evaluate(None, "train"))
+                    for itr, name in zip(self.itr_evals, self.eval_names):
+                        sys.stderr.write(self.net.evaluate(itr, name))
+                    sys.stderr.write("\n")
+                    sys.stderr.flush()
+            if stats and not self.silent:
+                print("\nround %d: %s" % (self.start_counter - 1,
+                                          stats.summary()))
             self.save_model()
             self.start_counter += 1
         if not self.silent:

@@ -2,6 +2,7 @@
 
 from .data import (DataBatch, DataInst, IIterator, create_iterator,
                    register_base_iterator, register_proc_iterator)
+from .device_prefetch import DeviceBatch, DevicePrefetcher
 from . import mnist      # noqa: F401
 from . import cifar      # noqa: F401
 from . import batch      # noqa: F401
@@ -11,4 +12,5 @@ from . import attach_txt  # noqa: F401
 from . import lm         # noqa: F401
 
 __all__ = ["DataBatch", "DataInst", "IIterator", "create_iterator",
-           "register_base_iterator", "register_proc_iterator"]
+           "register_base_iterator", "register_proc_iterator",
+           "DeviceBatch", "DevicePrefetcher"]

@@ -1,0 +1,172 @@
+"""Async training feed: host->device placement on a background thread.
+
+The reference hid host-side batch costs behind compute with the ThreadBuffer
+pipeline (utils/thread_buffer.h); the TPU build's `threadbuffer` iterator
+reproduces that for *host* work (decode/augment/assembly), but until now the
+`device_put`/`global_batch` placement of each batch ran synchronously inside
+``Net.update`` on the critical path. :class:`DevicePrefetcher` moves that
+placement off the hot loop: a producer thread drains the wrapped host
+iterator, places each batch on the mesh (``Net.place_batch``), and parks the
+resulting :class:`DeviceBatch` in a bounded queue — so batch k+1's
+host->device transfer overlaps step k's compute (the input-transfer overlap
+the TensorFlow system paper calls a first-order throughput lever, arxiv
+1605.08695 §4.2; Caffe con Troll makes the same case for pipelining host
+work, arxiv 1504.04343).
+
+Multi-host contract (IMPORTANT): ``global_batch`` assembles one *global*
+array from each process's local slice, so every process MUST place the same
+batches in the same order — batch k on process 0 and batch k on process 7
+are slices of the same logical array. The prefetcher guarantees per-process
+ordering (one producer thread, placements in iterator order, a bounded FIFO
+queue), and the usual SPMD deployment (same config, same seeds, same
+dataset shards) guarantees the cross-process part. Two guards back the
+contract up:
+
+- only ONE DevicePrefetcher may be live per process in a multi-host run —
+  a second concurrent producer could interleave placements and there is no
+  way to prove the interleaving identical across processes;
+- with ``CXN_PREFETCH_CHECK=1``, every ``before_first()`` (a main-thread,
+  all-ranks point) all-gathers the previous epoch's consumed-batch count
+  and raises if any process disagrees (a count mismatch means the feeds
+  diverged and the NEXT epoch's placements would pair wrong slices).
+
+Queue depth (``depth``, default 2) bounds device memory: at most
+``depth + 1`` batches are resident beyond the one being consumed —
+backpressure comes from the blocking queue put, exactly like the
+reference's two-slot ThreadBuffer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..parallel.distributed import is_multi_host, multihost_assert_equal
+from .data import PrefetchProducerMixin
+
+__all__ = ["DeviceBatch", "DevicePrefetcher"]
+
+
+class DeviceBatch:
+    """A host DataBatch after mesh placement (``Net.place_batch``).
+
+    ``data`` / ``extras`` / ``label`` are global, data-axis-sharded jax
+    arrays; ``mask`` is the short-pad loss mask (or None — padding
+    accounting is already baked into it, so no host-side pad metadata
+    rides along). ``host_label`` keeps this process's host-side label
+    slice ONLY when the trainer needs it (host-path train metrics); with
+    on-device metric accumulation it is None and nothing about the batch
+    ever returns to the host.
+    """
+
+    __slots__ = ("data", "extras", "label", "mask", "host_label")
+
+    def __init__(self, data, extras, label, mask,
+                 host_label: Optional[np.ndarray] = None) -> None:
+        self.data = data
+        self.extras = extras
+        self.label = label
+        self.mask = mask
+        self.host_label = host_label
+
+
+# multi-host single-producer guard (see module docstring): the set of live
+# prefetchers in this process, and the lock serializing placements so two
+# prefetchers in a SINGLE-host run (where they are allowed) cannot
+# interleave inside one placement either
+_live_prefetchers: set = set()
+_live_lock = threading.Lock()
+_place_lock = threading.Lock()
+
+
+class DevicePrefetcher(PrefetchProducerMixin):
+    """Wrap a host batch iterator; yield pre-placed :class:`DeviceBatch`.
+
+    Drop-in for the iterator contract (``before_first`` / ``next`` /
+    ``value`` / ``close``), so the CLI round loop and ``wrapper.train``
+    consume it exactly like the host chain. ``place_fn`` is
+    ``Net.place_batch`` (or any ``DataBatch -> DeviceBatch``); ``depth``
+    is the bounded-queue size (>= 1).
+    """
+
+    def __init__(self, place_fn: Callable, base, depth: int = 2) -> None:
+        if depth < 1:
+            raise ValueError("DevicePrefetcher depth must be >= 1, got %d"
+                             % depth)
+        self.place_fn = place_fn
+        self.base = base
+        self.depth = depth
+        self._value: Optional[DeviceBatch] = None
+        self._consumed = 0          # batches consumed this epoch
+        self._last_epoch_count = -1  # consumed count of the last full epoch
+        self.placed = 0             # total placements (test/diagnostic hook)
+        with _live_lock:
+            if is_multi_host() and _live_prefetchers:
+                raise RuntimeError(
+                    "DevicePrefetcher: a second concurrent prefetcher in a "
+                    "multi-host run would interleave device placements, and "
+                    "placement order must stay identical across processes "
+                    "(io/device_prefetch.py docstring) — close the other "
+                    "feed first")
+            _live_prefetchers.add(self)
+        self._init_producer(depth)
+
+    # ---------------------------------------------------------- producer
+    def _produce_epoch(self) -> None:
+        self.base.before_first()
+        while self.base.next():
+            # serialize placements process-wide: with two single-host
+            # prefetchers live, each batch's device_put sequence stays
+            # contiguous (and the multi-host case is single-feed by the
+            # constructor guard)
+            with _place_lock:
+                db = self.place_fn(self.base.value())
+                self.placed += 1
+            if not self._put(db):
+                return
+        self._put(self._END)
+
+    # ---------------------------------------------------------- consumer
+    def before_first(self) -> None:
+        if self._consumed and self._epoch_done:
+            self._last_epoch_count = self._consumed
+            # all-ranks point: verify every process consumed the same
+            # number of batches last epoch (opt-in — it is a collective)
+            if is_multi_host() and os.environ.get("CXN_PREFETCH_CHECK"):
+                multihost_assert_equal(
+                    [float(self._last_epoch_count)],
+                    "DevicePrefetcher epoch batch count")
+        self._consumed = 0
+        self._rewind_producer()
+
+    def next(self) -> bool:
+        item = self._next_item()
+        if item is None:
+            return False
+        self._value = item
+        self._consumed += 1
+        return True
+
+    def value(self) -> DeviceBatch:
+        return self._value
+
+    def close(self) -> None:
+        """Mandatory teardown: joins the producer thread and releases the
+        multi-host single-feed slot. There is deliberately no ``__del__``
+        fallback — the producer thread itself keeps the prefetcher
+        strongly referenced, so GC can never reclaim an un-closed feed;
+        callers hold it in try/finally (cli/wrapper do) and the test
+        harness leak-checks the named threads (tests/conftest.py)."""
+        self._close_producer()
+        with _live_lock:
+            _live_prefetchers.discard(self)
+
+    # the producer thread gets a recognizable name so the test harness can
+    # leak-check it (tests/conftest.py) — override the mixin's init to name it
+    def _init_producer(self, queue_size: int) -> None:
+        PrefetchProducerMixin._init_producer(self, queue_size)
+        if self._thread is not None:
+            self._thread.name = "cxn-device-prefetch-%x" % id(self)

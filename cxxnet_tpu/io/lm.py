@@ -24,6 +24,13 @@ default seq_len — non-overlapping), plus the shared in-memory keys
 intentionally IGNORED (ids must stay exact; bfloat16 has 8 mantissa bits
 and would corrupt ids > 256 — the trainer keeps id entry nodes in f32 and
 casts to the compute dtype after embedding lookup, nnet/net.py).
+
+Token-id ceiling: the window store is float32, whose 24 mantissa bits
+represent integers exactly only up to 2^24 (16,777,216). ``token_dtype =
+uint32`` streams with ids >= 2^24 would silently round to the wrong id,
+so :meth:`init` REJECTS them at load time — re-tokenize with a smaller
+vocabulary (every practical tokenizer fits: 2^24 is ~64x a GPT-4-class
+vocab) or split the id space upstream.
 """
 
 from __future__ import annotations
@@ -99,6 +106,13 @@ class LMIterator(InMemoryIterator):
             raise ValueError(
                 "lm iterator: token stream %r has %d tokens < seq_len %d"
                 % (self.path_data, tok.size, n))
+        if tok.size and int(tok.max()) >= (1 << 24):
+            raise ValueError(
+                "lm iterator: token id %d in %r exceeds the float32 "
+                "exact-integer ceiling 2^24 = 16777216 — ids ride the "
+                "pipeline as exact f32 (module docstring) and larger ids "
+                "would silently lose exactness; re-tokenize with a "
+                "smaller id space" % (int(tok.max()), self.path_data))
         stride = self.stride if self.stride > 0 else n
         starts = np.arange(0, tok.size - n + 1, stride)
         win = tok[starts[:, None] + np.arange(n)].astype(np.float32)

@@ -10,6 +10,14 @@
 Accumulators are numpy-side: predictions arrive as host arrays copied out of
 the jitted step (the eval_req path, nnet_impl-inl.hpp:152-180). Batched
 vectorized math replaces the reference's per-instance loops.
+
+Device path (round 6): metrics that define :meth:`Metric.device_calc`
+(``device_capable = True``) can ALSO run inside the jitted train step —
+the trainer sums their per-instance values into an on-device (sum, count)
+accumulator and fetches it only at round/log boundaries, so ``eval_train``
+costs zero device->host syncs per step (nnet/net.py). ``rec@n`` stays
+host-only: its tie-break draws from a stateful host RNG
+(reference metric.h:165) that a pure traced function cannot reproduce.
 """
 
 from __future__ import annotations
@@ -22,6 +30,10 @@ import numpy as np
 
 class Metric:
     name = ""
+    # True when device_calc mirrors calc under jit (jnp, f32) — the
+    # trainer then accumulates this metric on device between log
+    # boundaries instead of fetching predictions every step
+    device_capable = False
 
     def __init__(self) -> None:
         self.clear()
@@ -52,9 +64,18 @@ class Metric:
     def calc(self, pred: np.ndarray, label: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def device_calc(self, pred, label):
+        """Traced twin of :meth:`calc`: jnp in, per-instance jnp f32 out.
+        Only meaningful when ``device_capable``; values must equal calc's
+        (so the (sum, count) accumulators agree with the host path —
+        bit-for-bit for counting metrics like ``error``, to f32 rounding
+        for continuous ones)."""
+        raise NotImplementedError
+
 
 class MetricError(Metric):
     name = "error"
+    device_capable = True
 
     def calc(self, pred, label):
         if pred.shape[1] != 1:
@@ -63,18 +84,34 @@ class MetricError(Metric):
             maxidx = (pred[:, 0] > 0.0).astype(np.int64)
         return (maxidx != label[:, 0].astype(np.int64)).astype(np.float64)
 
+    def device_calc(self, pred, label):
+        import jax.numpy as jnp
+        if pred.shape[1] != 1:
+            maxidx = jnp.argmax(pred, axis=1)
+        else:
+            maxidx = (pred[:, 0] > 0.0).astype(jnp.int32)
+        return (maxidx != label[:, 0].astype(jnp.int32)).astype(jnp.float32)
+
 
 class MetricRMSE(Metric):
     name = "rmse"
+    device_capable = True
 
     def calc(self, pred, label):
         if pred.shape[1] != label.shape[1]:
             raise ValueError("rmse: prediction and label size must match")
         return np.sum((pred - label) ** 2, axis=1)
 
+    def device_calc(self, pred, label):
+        import jax.numpy as jnp
+        if pred.shape[1] != label.shape[1]:
+            raise ValueError("rmse: prediction and label size must match")
+        return jnp.sum((pred - label) ** 2, axis=1)
+
 
 class MetricLogloss(Metric):
     name = "logloss"
+    device_capable = True
 
     def calc(self, pred, label):
         eps = 1e-15
@@ -89,6 +126,19 @@ class MetricLogloss(Metric):
             raise FloatingPointError("logloss: NaN detected")
         return res
 
+    def device_calc(self, pred, label):
+        # eps clips to f32 denormal scale on device; the NaN raise of the
+        # host path becomes a NaN accumulator the nan_check watchdog sees
+        import jax.numpy as jnp
+        eps = 1e-15
+        if pred.shape[1] != 1:
+            target = label[:, 0].astype(jnp.int32)
+            p = jnp.take_along_axis(pred, target[:, None], axis=1)[:, 0]
+            return -jnp.log(jnp.clip(p, eps, 1 - eps))
+        p = jnp.clip(pred[:, 0], eps, 1 - eps)
+        y = label[:, 0]
+        return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+
 
 class MetricLMNLL(Metric):
     """Per-token negative log-likelihood of a causal LM (no reference
@@ -98,6 +148,7 @@ class MetricLMNLL(Metric):
     scored against token i+1; the last position predicts nothing).
     Perplexity = exp(lm_nll)."""
     name = "lm_nll"
+    device_capable = True
 
     def calc(self, pred, label):
         b, nv = pred.shape
@@ -110,6 +161,20 @@ class MetricLMNLL(Metric):
         tgt = label[:, 1:].astype(np.int64)
         p = np.take_along_axis(probs[:, :-1], tgt[..., None], axis=-1)[..., 0]
         return -np.log(np.clip(p, 1e-15, None)).mean(axis=1)
+
+    def device_calc(self, pred, label):
+        import jax.numpy as jnp
+        b, nv = pred.shape
+        n = label.shape[1]
+        if n < 2 or nv % n:
+            raise ValueError(
+                "lm_nll: prediction width %d is not seq*vocab for label "
+                "width %d" % (nv, n))
+        probs = pred.reshape(b, n, nv // n)
+        tgt = label[:, 1:].astype(jnp.int32)
+        p = jnp.take_along_axis(probs[:, :-1], tgt[..., None],
+                                axis=-1)[..., 0]
+        return -jnp.log(jnp.clip(p, 1e-15, None)).mean(axis=1)
 
 
 class MetricRecall(Metric):

@@ -939,6 +939,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     from ..ops.pallas_kernels import fused_decode_supported
     hd = cfg.feat // cfg.n_head
 
+    _unknown_mesh = {"suppressed": False}
+
     def _unsharded(leaf):
         # decode partitioning follows the PARAMS' placements (docstring
         # above), so the fusion gate inspects them, not the advisory
@@ -951,22 +953,37 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         if spec is None:
             return True
         msh = getattr(sh, "mesh", None)
+        hit_unknown = [False]
 
         def size(a):
             try:
                 return dict(msh.shape).get(a, 1)
             except Exception:           # unknown mesh type: be safe
+                hit_unknown[0] = True
                 return 2
 
-        return all(ax is None or all(size(a) == 1 for a in
-                                     (ax if isinstance(ax, tuple)
-                                      else (ax,)))
-                   for ax in spec)
+        ok = all(ax is None or all(size(a) == 1 for a in
+                                   (ax if isinstance(ax, tuple)
+                                    else (ax,)))
+                 for ax in spec)
+        if not ok and hit_unknown[0]:
+            # this leaf's verdict came from the conservative unknown-mesh
+            # branch, not a real >1 axis — remember so the fallback is
+            # announced instead of silent
+            _unknown_mesh["suppressed"] = True
+        return ok
 
     # the Pallas kernel is a Mosaic custom call GSPMD cannot partition:
     # any multi-device axis (including data) keeps the XLA scan path
     single_shard = (mesh is None or mesh.devices.size == 1) \
         and all(_unsharded(x) for x in jax.tree.leaves(params["blocks"]))
+    if _unknown_mesh["suppressed"] and not single_shard:
+        import sys
+        print("gpt_decode: param sharding uses a mesh type this gate "
+              "cannot inspect — conservatively treating it as sharded, "
+              "so the fused whole-step decode kernel is disabled "
+              "(falling back to the XLA scan); re-place the params with "
+              "a jax.sharding.Mesh to re-enable fusion", file=sys.stderr)
     itemsize = 2 if cfg.dtype == "bfloat16" else 4
     fused = bool(single_shard and fused_decode_supported(
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..graph import NetGraph
+from ..io.device_prefetch import DeviceBatch
 from ..layers import ApplyContext, create_layer
 from ..layers.base import Layer
 from ..metrics import MetricSet
@@ -72,6 +73,7 @@ class Net:
         self.batch_size = 0
         self.update_period = 1
         self.eval_train = 1
+        self.device_metrics = 1
         self.seed = 0
         self.dev = ""
         self.model_parallel = 1
@@ -94,6 +96,10 @@ class Net:
                 self.update_period = int(v)
             elif k == "eval_train":
                 self.eval_train = int(v)
+            elif k == "device_metrics":
+                # 0 forces the per-step host metric path even for metrics
+                # with a device twin (debug / exact-f64-accumulation knob)
+                self.device_metrics = int(v)
             elif k == "seed":
                 self.seed = int(v)
             elif k == "dev":
@@ -277,6 +283,19 @@ class Net:
         for n in self._metric_nodes:
             self._check_pp_visible(n, "metric node")
 
+        # train-metric accumulation mode: "device" keeps (sum, count)
+        # accumulators on device between log boundaries (zero per-step
+        # device->host syncs); "host" is the classic fetch-predictions-
+        # every-step path, used when eval_train metrics lack a device twin
+        # (rec@n's host-RNG tie-break) or device_metrics = 0
+        if not self.eval_train:
+            self._metric_mode = "off"
+        elif self.device_metrics and all(
+                m.device_capable for m in self.train_metrics.metrics):
+            self._metric_mode = "device"
+        else:
+            self._metric_mode = "host"
+
         self._compile_steps()
         self._initialized = True
 
@@ -285,9 +304,11 @@ class Net:
         return jnp.bfloat16 if self.precision == "bfloat16" else jnp.float32
 
     def _compile_steps(self) -> None:
-        donate = (0, 1, 2)
-        self._jit_update = jax.jit(self._step_update, donate_argnums=donate)
-        self._jit_accum = jax.jit(self._step_accum, donate_argnums=(0,))
+        # arg 3 of update/accum is the on-device train-metric accumulator,
+        # donated like the states it rides along with
+        self._jit_update = jax.jit(self._step_update,
+                                   donate_argnums=(0, 1, 2, 3))
+        self._jit_accum = jax.jit(self._step_accum, donate_argnums=(0, 3))
         self._jit_apply = jax.jit(self._step_apply, donate_argnums=(0, 1, 2))
         # node_ids is static: each distinct request set compiles a forward
         # that materializes only those nodes (XLA fuses the rest away)
@@ -363,6 +384,17 @@ class Net:
             # optimizer state (each rank accumulates only its slice)
             self.gsum = jax.device_put(
                 self.gsum, opt_sh if self.shard_optimizer >= 2 else param_sh)
+        self._reset_train_accum()
+        self.metric_sync_count = 0      # train-metric device->host folds
+
+    def _reset_train_accum(self) -> None:
+        """Fresh on-device (sum, count) train-metric accumulators — one
+        row per metric; a (0, 2) placeholder keeps the jitted step's
+        signature uniform when the host/off path is active."""
+        n = len(self.train_metrics.metrics) \
+            if getattr(self, "_metric_mode", "off") == "device" else 0
+        self._train_accum = jax.device_put(
+            np.zeros((n, 2), np.float32), replicated_sharding(self.mesh))
 
     # ------------------------------------------------------------ executor
     def _check_pp_visible(self, nid: int, what: str,
@@ -463,15 +495,34 @@ class Net:
         # pin the metric outputs' batch dim to the data axis: under pure
         # sp/pp meshes XLA may otherwise scatter rows across non-data axes,
         # leaving a process owning rows that don't line up with its local
-        # label slice (multi-host metric accounting). With eval_train=0
-        # nothing reads them — return none so XLA dead-code-eliminates
-        # their compute (e.g. the lm_softmax probs materialization)
-        metric_outs = [] if not self.eval_train else [
+        # label slice (multi-host metric accounting). Only the host metric
+        # path reads them — in device/off mode return none so XLA
+        # dead-code-eliminates their materialization (e.g. lm_softmax probs)
+        metric_outs = [] if self._metric_mode != "host" else [
             jax.lax.with_sharding_constraint(
                 nodes[n].reshape(nodes[n].shape[0], -1),
                 batch_sharding(self.mesh))
             for n in sorted(set(self._metric_nodes))]
-        return total, (metric_outs, ctx.new_states)
+        # device metric path: per-metric (sum over the GLOBAL batch, count)
+        # — a full cross-device reduction that replicates, accumulated into
+        # the donated on-device accumulator by the step; the host sees it
+        # only at round/log boundaries (_fold_train_accum)
+        if self._metric_mode == "device":
+            mlabels = self._split_labels(label)
+            rows = []
+            for metric, field, nid in zip(self.train_metrics.metrics,
+                                          self.train_metrics.label_fields,
+                                          self._metric_nodes):
+                pred = nodes[nid].reshape(nodes[nid].shape[0], -1) \
+                    .astype(jnp.float32)
+                vals = metric.device_calc(pred, mlabels[field])
+                rows.append(jnp.stack([
+                    jnp.sum(vals.astype(jnp.float32)),
+                    jnp.asarray(float(pred.shape[0]), jnp.float32)]))
+            metric_sums = jnp.stack(rows)
+        else:
+            metric_sums = jnp.zeros((0, 2), jnp.float32)
+        return total, (metric_outs, metric_sums, ctx.new_states)
 
     # ------------------------------------------------------------- steps
     def _constrain_grads(self, grads):
@@ -485,23 +536,26 @@ class Net:
         return jax.tree.map(jax.lax.with_sharding_constraint, grads,
                             self._opt_shardings)
 
-    def _step_update(self, params, opt_state, states, data, extras, label,
-                     mask, rng, epoch):
-        """Fused grad + optimizer apply (update_period == 1 fast path)."""
-        (loss, (mouts, new_states)), grads = jax.value_and_grad(
+    def _step_update(self, params, opt_state, states, maccum, data, extras,
+                     label, mask, rng, epoch):
+        """Fused grad + optimizer apply (update_period == 1 fast path).
+        ``maccum`` is the on-device (n_metrics, 2) train-metric
+        accumulator; the step folds this batch's (sum, count) in so
+        eval_train needs no per-step host fetch."""
+        (loss, (mouts, msums, new_states)), grads = jax.value_and_grad(
             self._loss_and_outputs, has_aux=True)(
                 params, states, data, extras, label, mask, rng, epoch)
         grads = self._constrain_grads(grads)
         params, opt_state = self._apply_grads(params, opt_state, grads, epoch)
-        return params, opt_state, new_states, loss, mouts
+        return params, opt_state, new_states, maccum + msums, loss, mouts
 
-    def _step_accum(self, gsum, params, states, data, extras, label, mask,
-                    rng, epoch):
-        (loss, (mouts, new_states)), grads = jax.value_and_grad(
+    def _step_accum(self, gsum, params, states, maccum, data, extras, label,
+                    mask, rng, epoch):
+        (loss, (mouts, msums, new_states)), grads = jax.value_and_grad(
             self._loss_and_outputs, has_aux=True)(
                 params, states, data, extras, label, mask, rng, epoch)
         gsum = jax.tree.map(jnp.add, gsum, self._constrain_grads(grads))
-        return gsum, new_states, loss, mouts
+        return gsum, new_states, maccum + msums, loss, mouts
 
     def _step_apply(self, params, opt_state, gsum, epoch):
         params, opt_state = self._apply_grads(params, opt_state, gsum, epoch)
@@ -652,35 +706,63 @@ class Net:
                                 self._local_slice(mask))
         return None
 
-    def update(self, batch) -> None:
-        """One training step on a host DataBatch (Update, nnet_impl:141-184)."""
+    def place_batch(self, batch) -> DeviceBatch:
+        """Move a host DataBatch to the mesh as a :class:`DeviceBatch` —
+        the unit the async feed (io/device_prefetch.py) produces on its
+        background thread and :meth:`update` consumes. Multi-host
+        contract: every process must place the same batches in the same
+        order (each contributes its local slice of the same global
+        array); the prefetcher enforces/documents this."""
         if not self._initialized:
             raise RuntimeError("call init_model() or load_model() first")
         data, extras, label = self._device_batch(batch)
         mask = self._train_mask(batch)
+        host_label = None
+        if self._metric_mode == "host":
+            # detach from iterator-owned buffers: the label slice outlives
+            # the producer thread's next base.next()
+            host_label = np.array(self._local_slice(batch.label))
+        return DeviceBatch(data, extras, label, mask, host_label=host_label)
+
+    def update(self, batch) -> None:
+        """One training step (Update, nnet_impl:141-184) on a host
+        DataBatch, or on a pre-placed :class:`DeviceBatch` from the async
+        feed — in which case no host->device work happens on this
+        thread. No device->host sync either way: the loss is fetched
+        lazily by :meth:`last_loss`, and train metrics accumulate on
+        device until a log boundary (``_metric_mode == 'device'``)."""
+        if not self._initialized:
+            raise RuntimeError("call init_model() or load_model() first")
+        db = batch if isinstance(batch, DeviceBatch) \
+            else self.place_batch(batch)
         rng = jax.random.fold_in(self._rng, self.epoch_counter)
         epoch = jnp.asarray(self.epoch_counter, jnp.int32)
         self.sample_counter += 1
         if self.update_period == 1:
-            (self.params, self.opt_state, self.states, loss,
-             mouts) = self._jit_update(self.params, self.opt_state, self.states,
-                                       data, extras, label, mask, rng, epoch)
+            (self.params, self.opt_state, self.states, self._train_accum,
+             loss, mouts) = self._jit_update(
+                 self.params, self.opt_state, self.states, self._train_accum,
+                 db.data, db.extras, db.label, db.mask, rng, epoch)
         else:
-            self.gsum, self.states, loss, mouts = self._jit_accum(
-                self.gsum, self.params, self.states, data, extras, label,
-                mask, rng, epoch)
+            (self.gsum, self.states, self._train_accum, loss,
+             mouts) = self._jit_accum(
+                 self.gsum, self.params, self.states, self._train_accum,
+                 db.data, db.extras, db.label, db.mask, rng, epoch)
             if self.sample_counter % self.update_period == 0:
                 self.params, self.opt_state, self.gsum = self._jit_apply(
                     self.params, self.opt_state, self.gsum, epoch)
         self.epoch_counter += 1
-        if self.eval_train:
-            self._accumulate_train_metrics(batch, mouts)
+        if self._metric_mode == "host":
+            self._accumulate_train_metrics(db.host_label, mouts)
         self._last_loss = loss
 
-    def _accumulate_train_metrics(self, batch, mouts) -> None:
+    def _accumulate_train_metrics(self, host_label, mouts) -> None:
+        """Host metric path: fetch this step's predictions (device sync)
+        and feed the numpy MetricSet — O(steps) syncs; the device path
+        replaces this wholesale."""
         uniq = sorted(set(self._metric_nodes))
         node_to_out = {n: local_rows(o) for n, o in zip(uniq, mouts)}
-        labels = self._host_labels(self._local_slice(batch.label))
+        labels = self._host_labels(host_label)
         preds = [node_to_out[n] for n in self._metric_nodes]
         nloc = next(iter(labels.values())).shape[0] if labels else 0
         for i, p in enumerate(preds):
@@ -698,6 +780,20 @@ class Net:
                 for name, (a, b) in
                 ((n, self.graph.label_range[i])
                  for n, i in self.graph.label_name_map.items())}
+
+    def _fold_train_accum(self) -> None:
+        """Fetch the on-device train-metric accumulators into the numpy
+        MetricSet and reset them — the single device->host metric sync
+        of a training round (counted in ``metric_sync_count`` so tests
+        can pin the O(log boundaries) property)."""
+        if self._metric_mode != "device":
+            return
+        sums = np.asarray(jax.device_get(self._train_accum))
+        self.metric_sync_count += 1
+        for m, (s, c) in zip(self.train_metrics.metrics, sums):
+            m.sum_metric += float(s)
+            m.cnt_inst += int(c)
+        self._reset_train_accum()
 
     # ---------------------------------------------------- failure detection
     def last_loss(self) -> float:
@@ -793,9 +889,18 @@ class Net:
         from ..parallel.distributed import host_psum
         ret = ""
         if self.eval_train:
-            # cross-process (sum, count) reduction: every rank prints the
-            # GLOBAL metric (the reference printed per-worker numbers)
-            ret += self.train_metrics.print("train", reduce=host_psum)
+            if self._metric_mode == "device":
+                # ONE device->host sync per log boundary folds the whole
+                # round's (sum, count) accumulators; the sums were reduced
+                # over the GLOBAL batch inside the jitted step, so no
+                # cross-process reduction applies here
+                self._fold_train_accum()
+                ret += self.train_metrics.print("train")
+            else:
+                # cross-process (sum, count) reduction: every rank prints
+                # the GLOBAL metric (the reference printed per-worker
+                # numbers)
+                ret += self.train_metrics.print("train", reduce=host_psum)
             self.train_metrics.clear()
         if data_iter is None:
             return ret

@@ -132,6 +132,25 @@ def host_allgather_rows(rows: np.ndarray) -> np.ndarray:
         .reshape(-1, rows.shape[-1])
 
 
+def multihost_assert_equal(row, what: str) -> None:
+    """Raise if ``row`` (a small list/array of floats) differs on any
+    process. Collective: every process must call it at the same point
+    (like the save/get paths, the callers are SPMD round boundaries).
+    Used by the async device feed to verify the per-epoch batch count —
+    a mismatch means the processes' feeds diverged, and the next epoch's
+    ``global_batch`` placements would pair wrong slices. No-op
+    single-process."""
+    if not is_multi_host():
+        return
+    mine = np.atleast_2d(np.asarray(row, np.float64))
+    rows = host_allgather_rows(mine).reshape(process_count(), -1)
+    if not np.all(rows == rows[0]):
+        raise RuntimeError(
+            "%s differs across processes: %s (rank %d has %s) — the SPMD "
+            "contract requires every process to run the same sequence"
+            % (what, rows.tolist(), process_index(), mine.ravel().tolist()))
+
+
 __all__ = ["init_distributed", "process_index", "process_count",
            "is_multi_host", "global_batch", "local_rows", "host_psum",
-           "host_allgather_rows"]
+           "host_allgather_rows", "multihost_assert_equal"]

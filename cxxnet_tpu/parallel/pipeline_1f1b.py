@@ -252,6 +252,19 @@ def pipeline_1f1b(block_fn: Callable, stacked_params, loss_fn: Callable,
                               mesh.shape.get(batch_axis, 1) > 1 and
                               x.shape[0] % mesh.shape[batch_axis] == 0) \
         else None
+    if batch_ax is None and batch_axis and \
+            mesh.shape.get(batch_axis, 1) > 1:
+        # the result stays correct (every data shard recomputes the full
+        # batch), but the user just lost data parallelism — say so
+        import warnings
+        warnings.warn(
+            "pipeline_1f1b: batch %d is not divisible by the %r axis "
+            "size %d — falling back to batch_ax=None (batch replicated, "
+            "every data shard recomputes the full batch; data "
+            "parallelism is OFF for this step). Pad the batch or resize "
+            "the mesh to restore it." % (x.shape[0], batch_axis,
+                                         mesh.shape[batch_axis]),
+            stacklevel=2)
     b_local = x.shape[0] // (mesh.shape[batch_ax] if batch_ax else 1)
     if b_local % n_microbatch:
         raise ValueError(

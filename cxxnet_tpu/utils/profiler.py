@@ -27,7 +27,22 @@ import contextlib
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time"]
+__all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
+           "FEED_WAIT", "STEP_DISPATCH", "METRIC_SYNC"]
+
+# canonical phase names of the training hot loop (round 6, async feed):
+#   FEED_WAIT     — blocked on the next batch (host iterator, or the async
+#                   device feed's queue; ~0 when prefetch hides placement)
+#   STEP_DISPATCH — Net.update dispatch (async; device time is NOT here)
+#   METRIC_SYNC   — round-boundary metric fold + eval passes (the only
+#                   device->host syncs of a round on the device-metric path)
+FEED_WAIT = "feed_wait"
+STEP_DISPATCH = "step_dispatch"
+METRIC_SYNC = "metric_sync"
+
+# phases counted as "waiting on input" for the wait-fraction line ("data"
+# is the pre-round-6 name, kept so external callers' stats still summarize)
+_WAIT_PHASES = (FEED_WAIT, "data")
 
 
 def get_time() -> float:
@@ -89,10 +104,25 @@ class StepStats:
         return sorted_vals[i]
 
     def phase_totals(self) -> Dict[str, float]:
-        return {k: sum(v) for k, v in self._phases.items()}
+        """Per-phase accumulated seconds — including round-level phases
+        still pending in the current step (e.g. METRIC_SYNC recorded after
+        the last end_step())."""
+        totals = {k: sum(v) for k, v in self._phases.items()}
+        for k, v in self._current.items():
+            totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def wait_fraction(self) -> float:
+        """Fraction of the round's wall time spent blocked on input
+        (FEED_WAIT / legacy "data") — the feed-overlap complement:
+        ``overlap = 1 - wait_fraction()`` is ~1 when the async device
+        feed fully hides host->device placement behind compute."""
+        wall = get_time() - self._round_start
+        totals = self.phase_totals()
+        return sum(totals.get(p, 0.0) for p in _WAIT_PHASES) / max(wall, 1e-9)
 
     def summary(self) -> str:
-        """One human line: wall, throughput, per-phase mean/p95, data-wait %."""
+        """One human line: wall, throughput, per-phase mean/p95, feed-wait %."""
         wall = get_time() - self._round_start
         if self.num_steps == 0:
             return "no steps recorded"
@@ -105,11 +135,19 @@ class StepStats:
         totals = self.phase_totals()
         for name in sorted(self._phases):
             vals = sorted(self._phases[name])
-            mean = totals[name] / len(vals)
+            mean = sum(vals) / len(vals)
             parts.append("%s %.1fms/p95 %.1fms"
                          % (name, mean * 1e3, self._pct(vals, 0.95) * 1e3))
-        if "data" in totals and wall > 0:
-            parts.append("data-wait %.0f%%" % (100.0 * totals["data"] / wall))
+        for name in sorted(self._current):
+            if name not in self._phases:    # round-level phase (METRIC_SYNC)
+                parts.append("%s %.1fms/round" % (name,
+                                                  self._current[name] * 1e3))
+        for p in _WAIT_PHASES:
+            if p in totals and wall > 0:
+                parts.append("%s-wait %.0f%%"
+                             % (p.split("_")[0],
+                                100.0 * totals[p] / wall))
+                break
         return "; ".join(parts)
 
 

@@ -18,6 +18,7 @@ import numpy as np
 
 from .io import create_iterator
 from .io.data import DataBatch
+from .io.device_prefetch import DeviceBatch, DevicePrefetcher
 from .nnet.net import Net as _CoreNet
 from .utils.config import tokenize
 
@@ -77,11 +78,11 @@ class DataIter:
         return np.asarray(self.batch.label)
 
 
-def _as_batch(data: Union[DataIter, DataBatch, Array],
-              label: Optional[Array] = None) -> DataBatch:
+def _as_batch(data: Union[DataIter, DataBatch, "DeviceBatch", Array],
+              label: Optional[Array] = None):
     if isinstance(data, DataIter):
         return data.batch
-    if isinstance(data, DataBatch):
+    if isinstance(data, (DataBatch, DeviceBatch)):
         return data
     data = np.asarray(data, np.float32)
     if data.ndim == 2:            # (batch, feat) -> (batch, 1, 1, feat)
@@ -188,19 +189,33 @@ def train(cfg: str, data: DataIter, num_round: int,
           eval_data: Optional[DataIter] = None) -> Net:
     """Convenience training loop (cxxnet.py:281-307): build Net from config,
     apply ``param`` overrides, run ``num_round`` epochs over ``data``,
-    printing eval lines per round."""
+    printing eval lines per round.
+
+    Feeds through the async device prefetcher by default (batch k+1's
+    host->device placement overlaps step k's compute — io/device_prefetch
+    .py); ``param['prefetch_to_device'] = 0`` restores the synchronous
+    path, any other N sets the bounded-queue depth."""
     net = Net(cfg=cfg)
+    depth = 2
     for k, v in param.items():
+        if k == "prefetch_to_device":
+            depth = int(v)
         net.set_param(k, v)
     net.init_model()
-    for r in range(num_round):
-        net.start_round(r)
-        data.before_first()
-        while data.next():
-            net.update(data)
-        line = net.evaluate(eval_data, "eval")
-        if line:
-            print("[%d]%s" % (r, line))
+    feed = DevicePrefetcher(net.core.place_batch, data._iter, depth=depth) \
+        if depth > 0 else data._iter
+    try:
+        for r in range(num_round):
+            net.start_round(r)
+            feed.before_first()
+            while feed.next():
+                net.core.update(feed.value())
+            line = net.evaluate(eval_data, "eval")
+            if line:
+                print("[%d]%s" % (r, line))
+    finally:
+        if isinstance(feed, DevicePrefetcher):
+            feed.close()
     return net
 
 

@@ -19,6 +19,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -26,3 +29,22 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_device_prefetch_threads():
+    """Leak check (round 6): the async device feed's producer threads are
+    named ``cxn-device-prefetch-*`` (io/device_prefetch.py); any still
+    alive after a test means a DevicePrefetcher was not close()d — a real
+    bug (the thread holds the iterator chain and device buffers), failed
+    here instead of hanging a later test."""
+    yield
+    deadline = time.time() + 5.0
+    while True:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("cxn-device-prefetch")]
+        if not leaked or time.time() > deadline:
+            break
+        time.sleep(0.05)
+    assert not leaked, \
+        "device-prefetch producer threads leaked past teardown: %s" % leaked

@@ -1,0 +1,261 @@
+"""Async training feed (io/device_prefetch.py) + on-device train metrics.
+
+Pins the round-6 tentpole's contracts:
+- the prefetcher yields exactly the synchronous path's batches, in order
+  (single-process, and the fake 2-process ordering guards);
+- the bounded queue really backpressures (at most depth+1 placements ahead
+  of the consumer) and close() mid-epoch tears the producer down;
+- with eval_train on, a training round performs O(log boundaries)
+  device->host syncs — not O(steps) — and the on-device (sum, count)
+  accumulators match the per-step host accumulation bit-for-bit on the
+  digits-style model;
+- prefetched and synchronous CLI training produce identical eval lines.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io import create_iterator
+from cxxnet_tpu.io.device_prefetch import DevicePrefetcher
+from cxxnet_tpu.nnet.net import Net
+from cxxnet_tpu.utils.config import tokenize
+from cxxnet_tpu.cli import LearnTask
+import cxxnet_tpu.io.device_prefetch as dp
+import cxxnet_tpu.nnet.net as nnet_net
+
+from test_train_e2e import CONF, synth_mnist  # noqa: F401 (fixture)
+
+
+def _train_iter(synth_mnist, batch_size=64):  # noqa: F811
+    return create_iterator([
+        ("iter", "mnist"),
+        ("path_img", "%s/train-img.gz" % synth_mnist),
+        ("path_label", "%s/train-lab.gz" % synth_mnist),
+        ("shuffle", "1"),
+        ("batch_size", str(batch_size)),
+        ("input_shape", "1,1,64"),
+    ])
+
+
+def _trainer_cfg(synth_mnist, tmp_path, extra=()):  # noqa: F811
+    pairs = [p for p in tokenize(CONF.format(d=synth_mnist, md=tmp_path))
+             if p[0] not in ("data", "eval", "iter", "path_img",
+                             "path_label", "shuffle")]
+    return pairs + list(extra)
+
+
+def _net(synth_mnist, tmp_path, extra=()):  # noqa: F811
+    net = Net(_trainer_cfg(synth_mnist, tmp_path, extra))
+    net.init_model()
+    return net
+
+
+def test_prefetcher_matches_sync_batches_and_order(synth_mnist, tmp_path):  # noqa: F811
+    """Identical data/label/order to the synchronous placement path,
+    across two epochs (epoch rewind included)."""
+    net = _net(synth_mnist, tmp_path)
+
+    sync_it = _train_iter(synth_mnist)
+    sync = []
+    for _ in range(2):
+        sync_it.before_first()
+        while sync_it.next():
+            db = net.place_batch(sync_it.value())
+            sync.append((np.asarray(db.data), np.asarray(db.label)))
+
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=2)
+    try:
+        pre = []
+        for _ in range(2):
+            feed.before_first()
+            while feed.next():
+                db = feed.value()
+                pre.append((np.asarray(db.data), np.asarray(db.label)))
+    finally:
+        feed.close()
+
+    assert len(sync) == len(pre) == 16      # 512 imgs / 64 x 2 epochs
+    for (sd, sl), (pd, pl) in zip(sync, pre):
+        np.testing.assert_array_equal(sd, pd)
+        np.testing.assert_array_equal(sl, pl)
+
+
+def test_bounded_queue_backpressure(synth_mnist, tmp_path):  # noqa: F811
+    """The producer may run at most depth ahead of the consumer, plus the
+    one batch blocked in the queue put."""
+    net = _net(synth_mnist, tmp_path)
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=1)
+    try:
+        feed.before_first()
+        deadline = time.time() + 2.0
+        while feed.placed < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)                      # would overrun here if unbounded
+        assert feed.placed <= 2, \
+            "queue depth 1 let %d placements run ahead" % feed.placed
+        n = 0
+        while feed.next():
+            n += 1
+        assert n == 8 and feed.placed == 8
+    finally:
+        feed.close()
+
+
+def test_close_mid_epoch_joins_producer(synth_mnist, tmp_path):  # noqa: F811
+    net = _net(synth_mnist, tmp_path)
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=1)
+    feed.before_first()
+    assert feed.next() and feed.next()       # mid-epoch
+    thread = feed._thread
+    feed.close()
+    assert thread is not None and not thread.is_alive()
+    feed.close()                             # idempotent
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("cxn-device-prefetch")]
+
+
+def test_multihost_single_feed_guard(synth_mnist, tmp_path, monkeypatch):  # noqa: F811
+    """Fake 2-process mode: a second live prefetcher must be refused —
+    placement order across processes is only provable with one producer."""
+    net = _net(synth_mnist, tmp_path)
+    monkeypatch.setattr(dp, "is_multi_host", lambda: True)
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=1)
+    try:
+        with pytest.raises(RuntimeError, match="identical across processes"):
+            DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                             depth=1)
+    finally:
+        feed.close()
+    feed2 = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                             depth=1)
+    feed2.close()
+
+
+def test_multihost_epoch_count_check(synth_mnist, tmp_path, monkeypatch):  # noqa: F811
+    """Fake 2-process mode with CXN_PREFETCH_CHECK=1: the epoch boundary
+    all-gathers the consumed-batch count (divergent feeds must fail loudly,
+    not place mismatched slices)."""
+    net = _net(synth_mnist, tmp_path)
+    calls = []
+    monkeypatch.setattr(dp, "is_multi_host", lambda: True)
+    monkeypatch.setattr(dp, "multihost_assert_equal",
+                        lambda row, what: calls.append((list(row), what)))
+    monkeypatch.setenv("CXN_PREFETCH_CHECK", "1")
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=2)
+    try:
+        feed.before_first()
+        while feed.next():
+            pass
+        assert not calls                     # first epoch: nothing to check
+        feed.before_first()                  # boundary -> count verified
+        assert calls == [([8.0], "DevicePrefetcher epoch batch count")]
+    finally:
+        feed.close()
+
+
+def test_device_metrics_match_host_bit_for_bit(synth_mnist, tmp_path):  # noqa: F811
+    """On-device (sum, count) accumulation == per-step host accumulation,
+    bit for bit, on the digits-style MLP (metric = error: integer-valued
+    sums, exactly representable — the acceptance bar)."""
+    net_dev = _net(synth_mnist, tmp_path)
+    net_host = _net(synth_mnist, tmp_path, extra=[("device_metrics", "0")])
+    assert net_dev._metric_mode == "device"
+    assert net_host._metric_mode == "host"
+
+    it = _train_iter(synth_mnist)
+    it.before_first()
+    while it.next():
+        b = it.value()
+        net_dev.update(b)
+        net_host.update(b)
+
+    net_dev._fold_train_accum()
+    dev_acc = [(m.sum_metric, m.cnt_inst)
+               for m in net_dev.train_metrics.metrics]
+    host_acc = [(m.sum_metric, m.cnt_inst)
+                for m in net_host.train_metrics.metrics]
+    assert dev_acc == host_acc == [(dev_acc[0][0], 512)]
+    assert dev_acc[0][0] == int(dev_acc[0][0])   # error sums are counts
+    # and the printed train line agrees end to end
+    assert net_dev.evaluate(None, "train") == \
+        net_host.evaluate(None, "train")
+
+
+def test_train_round_syncs_O_log_boundaries(synth_mnist, tmp_path,  # noqa: F811
+                                            monkeypatch):
+    """eval_train=1 must not fetch per step: zero local_rows/np.asarray
+    pulls during the round, exactly one accumulator fold per log
+    boundary."""
+    fetches = []
+    real_local_rows = nnet_net.local_rows
+    monkeypatch.setattr(nnet_net, "local_rows",
+                        lambda a: (fetches.append(1),
+                                   real_local_rows(a))[1])
+    net = _net(synth_mnist, tmp_path)
+    assert net._metric_mode == "device"
+    it = _train_iter(synth_mnist)
+    it.before_first()
+    steps = 0
+    while it.next():
+        net.update(it.value())
+        steps += 1
+    assert steps == 8
+    assert fetches == []                     # O(steps) syncs are gone
+    assert net.metric_sync_count == 0
+    line = net.evaluate(None, "train")
+    assert "train-error:" in line
+    assert net.metric_sync_count == 1        # one fold per log boundary
+    assert fetches == []
+    # the loss stays lazily fetchable (its own single sync on demand)
+    assert np.isfinite(net.last_loss())
+
+
+def test_prefetched_vs_sync_cli_identical(synth_mnist, tmp_path, capfd):  # noqa: F811
+    """prefetch_to_device = 2 (default) and = 0 must train identically —
+    same batches, same order, same math -> identical eval lines."""
+    def run(tag, prefetch):
+        md = tmp_path / ("m_%s" % tag)
+        conf = tmp_path / ("%s.conf" % tag)
+        conf.write_text(CONF.format(d=synth_mnist, md=md))
+        task = LearnTask()
+        assert task.run([str(conf), "num_round=2", "max_round=2",
+                         "save_model=0",
+                         "prefetch_to_device=%d" % prefetch]) == 0
+        err = capfd.readouterr().err
+        return [l for l in err.splitlines() if l.startswith("[")]
+
+    sync_lines = run("sync", 0)
+    pre_lines = run("pre", 2)
+    assert len(sync_lines) == 2
+    assert sync_lines == pre_lines
+
+
+@pytest.mark.slow
+def test_prefetch_stress_many_epochs(synth_mnist, tmp_path):  # noqa: F811
+    """Many-epoch soak of the async feed: epoch rewinds, queue reuse, and
+    the device metric accumulator across 30 rounds (excluded from tier-1
+    via the slow marker)."""
+    net = _net(synth_mnist, tmp_path)
+    feed = DevicePrefetcher(net.place_batch, _train_iter(synth_mnist),
+                            depth=2)
+    try:
+        total = 0
+        for _ in range(30):
+            feed.before_first()
+            while feed.next():
+                net.update(feed.value())
+                total += 1
+        assert total == 30 * 8
+        line = net.evaluate(None, "train")
+        assert "train-error:" in line and net.metric_sync_count == 1
+        assert np.isfinite(net.last_loss())
+    finally:
+        feed.close()
