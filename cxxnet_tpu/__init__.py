@@ -6,6 +6,8 @@ Public surface:
 - :func:`cxxnet_tpu.io.create_iterator` — config-driven data pipelines
 - :mod:`cxxnet_tpu.cli` — the ``cxxnet <config> [k=v ...]`` runner
 - :mod:`cxxnet_tpu.wrapper` — the cxxnet.py-compatible Python API
+- :mod:`cxxnet_tpu.serve` — the continuous-batching inference server
+  (``task = serve`` / ``Net.serve_*``; doc/serving.md)
 """
 
 __version__ = "0.1.0"

@@ -71,6 +71,13 @@ class LearnTask:
         self.generate_out = "gen.txt"
         self.generate_bench = 0   # 1: print warm ms/token after a warmup
         self.generate_int8 = 0    # 1: int8 weight-streaming decode
+        self.generate_topk = 0    # sampling: keep k most likely (0 = off)
+        self.generate_topp = 1.0  # sampling: nucleus mass (1.0 = off)
+        self.serve_slots = 8      # task=serve: KV-cache slot pool size
+        self.serve_queue = 32     # task=serve: admission queue bound
+        self.serve_timeout_ms = 0.0   # task=serve: per-request queue
+        #                               deadline (0 = none)
+        self.serve_eos = -1       # task=serve: stop token (-1 = none)
         self.net: Optional[Net] = None
         self.itr_train = None
         self._train_feed = None   # DevicePrefetcher over itr_train (async)
@@ -133,6 +140,18 @@ class LearnTask:
             self.generate_bench = int(val)
         elif name == "generate_int8":
             self.generate_int8 = int(val)
+        elif name == "generate_topk":
+            self.generate_topk = int(val)
+        elif name == "generate_topp":
+            self.generate_topp = float(val)
+        elif name == "serve_slots":
+            self.serve_slots = int(val)
+        elif name == "serve_queue":
+            self.serve_queue = int(val)
+        elif name == "serve_timeout_ms":
+            self.serve_timeout_ms = float(val)
+        elif name == "serve_eos":
+            self.serve_eos = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -162,6 +181,8 @@ class LearnTask:
             self.task_extract()
         elif self.task == "generate":
             self.task_generate()
+        elif self.task == "serve":
+            self.task_serve()
         else:
             raise ValueError("unknown task %r" % self.task)
         return 0
@@ -258,10 +279,11 @@ class LearnTask:
             # section config first, then globals — matching the reference's
             # CreateIterator-then-InitIter(defcfg) order (cxxnet_main.cpp:254-262)
             full = scfg + defcfg + extra
-            if sflag == 1 and self.task not in ("pred", "generate"):
+            if sflag == 1 and self.task not in ("pred", "generate", "serve"):
                 assert self.itr_train is None, "can only have one data section"
                 self.itr_train = create_iterator(full)
-            elif sflag == 2 and self.task not in ("pred", "generate"):
+            elif sflag == 2 and self.task not in ("pred", "generate",
+                                                  "serve"):
                 self.itr_evals.append(create_iterator(full))
                 self.eval_names.append(sname)
             elif sflag == 3 and self.task in ("pred", "extract"):
@@ -492,7 +514,9 @@ class LearnTask:
         t0 = time.time()
         out = net_generate(self.net, batch, self.num_gen,
                            temperature=self.temperature, rng=rng,
-                           export=export, int8=bool(self.generate_int8))
+                           export=export, int8=bool(self.generate_int8),
+                           top_k=self.generate_topk,
+                           top_p=self.generate_topp)
         dt = time.time() - t0
         with open(self.generate_out, "w") as fo:
             for row in out:
@@ -503,11 +527,125 @@ class LearnTask:
             t0 = time.time()
             net_generate(self.net, batch, self.num_gen,
                          temperature=self.temperature, rng=rng,
-                         export=export, int8=bool(self.generate_int8))
+                         export=export, int8=bool(self.generate_int8),
+                         top_k=self.generate_topk,
+                         top_p=self.generate_topp)
             warm = time.time() - t0
             print("generate_bench: %.4f ms/token warm (batch %d, %d new "
                   "tokens)" % (warm * 1e3 / self.num_gen, batch.shape[0],
                                self.num_gen))
+
+    def task_serve(self) -> None:
+        """Online serving: keep the model hot behind a request queue (the
+        continuous-batching scheduler, doc/serving.md). Line-oriented
+        loop: each stdin line is one prompt (space-separated token ids,
+        lengths may differ — requests are multiplexed onto KV-cache
+        slots, NOT batched by length like ``task=generate``); each stdout
+        line is the corresponding full sequence, emitted in SUBMISSION
+        order ("ERR <status>: <detail>" for requests that timed out or
+        were rejected). ``num_gen``/``temperature``/``generate_topk``/
+        ``generate_topp``/``serve_eos`` set the per-request defaults;
+        ``serve_slots``/``serve_queue``/``serve_timeout_ms`` size the
+        scheduler. A final metrics summary (p50/p95/p99 TTFT, tokens/s,
+        batch efficiency) goes to stderr."""
+        from .nnet.lm import net_gpt_export
+        from .serve import InferenceServer, SamplingParams
+
+        cfg, params = net_gpt_export(self.net)
+        defaults = SamplingParams(
+            max_tokens=self.num_gen, temperature=self.temperature,
+            top_k=self.generate_topk, top_p=self.generate_topp,
+            eos=self.serve_eos if self.serve_eos >= 0 else None,
+            timeout_ms=self.serve_timeout_ms)
+        srv = InferenceServer(cfg, params, slots=self.serve_slots,
+                              queue=self.serve_queue, defaults=defaults)
+        if not self.silent:
+            print("serving: %d slots, queue %d (one prompt per line; "
+                  "EOF drains and exits)"
+                  % (self.serve_slots, self.serve_queue), file=sys.stderr)
+        import collections
+        import threading
+
+        from .serve import AdmissionError
+        # pending results in submission order, drained by a dedicated
+        # printer thread: each response is emitted the moment ITS request
+        # finishes — an interactive client waiting on one reply must not
+        # have it gated on the arrival of the next stdin line. Printed
+        # entries are popped, so a long-lived serve process does not
+        # retain every request.
+        handles: collections.deque = collections.deque()
+        feed = threading.Condition()
+        eof = [False]
+
+        def printer() -> None:
+            while True:
+                with feed:
+                    while not handles and not eof[0]:
+                        feed.wait()
+                    if not handles:
+                        return
+                    h = handles.popleft()
+                if isinstance(h, str):          # pre-rejected line
+                    sys.stdout.write(h + "\n")
+                else:
+                    res = srv.result(h)         # blocks until THIS one
+                    if res.status == "ok":
+                        sys.stdout.write(" ".join(
+                            str(int(t)) for t in res.tokens) + "\n")
+                    else:
+                        sys.stdout.write("ERR %s: %s\n"
+                                         % (res.status, res.error))
+                sys.stdout.flush()
+
+        out_thread = threading.Thread(target=printer,
+                                      name="cxn-serve-printer",
+                                      daemon=True)
+        out_thread.start()
+
+        def emit(h) -> None:
+            with feed:
+                handles.append(h)
+                feed.notify()
+
+        try:
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                # one bad line must not take down the serving loop: it
+                # gets its ERR output slot and the stream continues
+                try:
+                    ids = [int(t) for t in line.split()]
+                    # block=True: the stdin loop IS the backpressure — a
+                    # full queue pauses reading instead of dropping
+                    emit(srv.submit(ids, block=True))
+                except ValueError:
+                    emit("ERR rejected: unparseable prompt line "
+                         "(want space-separated ints)")
+                except AdmissionError as e:
+                    emit("ERR rejected: %s" % e.reason)
+            srv.drain()
+            with feed:
+                eof[0] = True
+                feed.notify()
+            out_thread.join()
+            m = srv.metrics()
+            if not self.silent:
+                print("serve: %d ok / %d timeout / %d rejected; "
+                      "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "
+                      "batch efficiency %.2f over %d ticks"
+                      % (m["requests"]["completed"],
+                         m["requests"]["timeout"],
+                         m["requests"]["rejected"],
+                         m["ttft_ms"]["p50"], m["ttft_ms"]["p95"],
+                         m["ttft_ms"]["p99"], m["batch_efficiency"],
+                         m["ticks"]), file=sys.stderr)
+        finally:
+            srv.shutdown(drain=False)       # idempotent after drain()
+            with feed:                      # wake the printer on the
+                eof[0] = True               # error path too (shutdown
+                feed.notify()               # resolved every handle)
+            out_thread.join(timeout=10)
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, "must specify a pred iterator"

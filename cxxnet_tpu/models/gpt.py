@@ -761,15 +761,21 @@ def _dequantize_decode_blocks(qblocks: Dict, dtype=jnp.float32) -> Dict:
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                temperature: float, fused: bool = False,
-               int8: bool = False, fold_head: bool = False):
+               int8: bool = False, fold_head: bool = False,
+               top_k: int = 0, top_p: float = 1.0):
     """Build (and cache) the jitted prefill+decode program for one
-    (config, prompt length, generation length, temperature) signature —
+    (config, prompt length, generation length, sampling) signature —
     repeated gpt_decode calls hit jit's cache instead of retracing.
     ``fused``: run the whole decode step's layer stack as ONE Pallas
     kernel per batch row (ops/pallas_kernels.fused_decode_step) with
     bf16 weights double-buffered through VMEM. ``int8``: additionally
     stream the matmul weights int8-quantized (half the bytes of the
-    weight-bandwidth-bound step; fused path only)."""
+    weight-bandwidth-bound step; fused path only). ``top_k``/``top_p``
+    restrict the sampling candidate set (ops/sampling.py — the SAME
+    filter the serving tick applies per slot row, so serve-vs-generate
+    token identity holds under any sampling params); both are inert on
+    the greedy (temperature 0) path, which keeps the head-fold fast
+    path."""
     cfg = GPTConfig(*cfg_key)
     total = n_prompt + max_new
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
@@ -779,7 +785,18 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
 
     def pick(logits, key):
         if temperature > 0:
-            return jax.random.categorical(key, logits / temperature, -1)
+            scaled = logits / temperature
+            # top_k/top_p are STATIC here: skip the filter (and its two
+            # full-vocab sorts per token) entirely when both are
+            # disabled, keeping the pre-existing temperature-only path's
+            # op count. When a filter is on, the masked values equal the
+            # input wherever kept, so enabling k=V/p=1 is value-level
+            # identical to this bypass — sampled streams stay pinned
+            # either way.
+            if top_k > 0 or top_p < 1.0:
+                from ..ops.sampling import filter_logits
+                scaled = filter_logits(scaled, top_k, top_p)
+            return jax.random.categorical(key, scaled, -1)
         return jnp.argmax(logits, -1)
 
     def run(params, prompt, rng):
@@ -909,9 +926,14 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                cfg: GPTConfig, mesh: Optional[Mesh] = None,
                temperature: float = 0.0,
                rng: Optional[jax.Array] = None,
-               int8_weights: bool = False) -> jnp.ndarray:
+               int8_weights: bool = False,
+               top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
     """Generate ``max_new`` (>= 1) tokens after ``prompt`` (b, n_prompt)
-    int32. temperature 0 = greedy; else categorical sampling with ``rng``.
+    int32. temperature 0 = greedy; else categorical sampling with ``rng``,
+    optionally restricted by ``top_k`` (keep the k most likely tokens;
+    0 disables) and ``top_p`` (nucleus sampling, keep the smallest set
+    reaching cumulative probability p; 1.0 disables) — both compose with
+    temperature (scale first, then filter; ops/sampling.py).
     Returns (b, n_prompt + max_new). n_prompt + max_new <= cfg.seq_len.
 
     ``mesh`` is accepted for API symmetry with gpt_logits but unused:
@@ -933,6 +955,15 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                          % (n_prompt + max_new, cfg.seq_len))
     if temperature > 0 and rng is None:
         raise ValueError("sampling needs an rng key")
+    if top_k < 0:
+        raise ValueError("top_k must be >= 0 (0 disables), got %d" % top_k)
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError("top_p must be in (0, 1], got %g" % top_p)
+    if temperature <= 0:
+        # the filters are inert on the greedy path; normalizing them out
+        # of the _decode_fn cache key avoids compiling duplicate
+        # identical greedy programs per sampling-param combination
+        top_k, top_p = 0, 1.0
     if rng is None:
         rng = jax.random.PRNGKey(0)
     import dataclasses
@@ -1013,7 +1044,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
             + 8 * cfg.feat))
     fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused,
                     int8=bool(int8_weights and fused),
-                    fold_head=fold_head)
+                    fold_head=fold_head, top_k=int(top_k),
+                    top_p=float(top_p))
     try:
         return fn(params, prompt, rng)
     except Exception as e:                              # noqa: BLE001
@@ -1039,7 +1071,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
             fn = _decode_fn(cfg_key, n_prompt, max_new,
                             float(temperature), fused,
                             int8=bool(int8_weights and fused),
-                            fold_head=False)
+                            fold_head=False, top_k=int(top_k),
+                            top_p=float(top_p))
             try:
                 return fn(params, prompt, rng)
             except Exception as e2:                     # noqa: BLE001
@@ -1057,7 +1090,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         # reuses one entry for the unfused program (a kwarg/positional
         # mismatch would trace+compile it twice)
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
-                        False, int8=False, fold_head=False)
+                        False, int8=False, fold_head=False,
+                        top_k=int(top_k), top_p=float(top_p))
         return fn(params, prompt, rng)
 
 

@@ -182,21 +182,25 @@ def net_generate(net, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0,
                  rng: Optional[jax.Array] = None,
                  export: Optional[Tuple] = None,
-                 int8: bool = False) -> np.ndarray:
+                 int8: bool = False,
+                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
     """Generate tokens from a GPT-shaped Net: prompt (b, n_prompt) int ->
     (b, n_prompt + max_new) int32. Drives models/gpt.py:gpt_decode — the
     fused whole-step decode kernel auto-engages on one chip exactly as on
     the functional path. ``export``: a ``net_gpt_export(net)`` result to
     reuse across calls (otherwise each call re-exports the weight tree —
     fine for one-shot generation, wrong for timing loops; cli.py's
-    ``generate_bench`` exports once)."""
+    ``generate_bench`` exports once). ``top_k``/``top_p`` restrict the
+    sampling candidate set when ``temperature > 0`` (ops/sampling.py;
+    0 / 1.0 disable)."""
     from ..models.gpt import gpt_decode
     cfg, params = export if export is not None else net_gpt_export(net)
     prompt = jnp.asarray(np.asarray(prompt, np.int32))
     if rng is None and temperature > 0:
         rng = jax.random.PRNGKey(net.seed)
     out = gpt_decode(params, prompt, max_new, cfg,
-                     temperature=temperature, rng=rng, int8_weights=int8)
+                     temperature=temperature, rng=rng, int8_weights=int8,
+                     top_k=top_k, top_p=top_p)
     return np.asarray(out)
 
 

@@ -1,0 +1,101 @@
+"""Token sampling: temperature + top-k + top-p, one implementation for
+BOTH inference surfaces.
+
+``task=generate`` / ``gpt_decode`` (offline batch, one rng key per call)
+and the serving tick (``serve/engine.py``, one key + one parameter set
+PER SLOT ROW) must produce identical tokens for the same request given
+the same logits — that is the continuous-batching correctness contract
+(a request served from a recycled slot must match the same request run
+alone; pinned bit-level on the shared XLA decode path, see
+serve/engine.py for the fused-kernel caveat). So the
+filtering math lives here once, written row-wise so it accepts scalar
+parameters (generate: one temperature/top_k/top_p per call, traced or
+static) or per-row arrays (serve: mixed per-request params in one batch)
+with the same per-row arithmetic either way.
+
+Semantics (HuggingFace-conventional order): logits are temperature-scaled
+first, then top-k keeps the k highest-probability tokens, then top-p
+keeps the smallest prefix of the remaining distribution whose cumulative
+probability reaches p; the filtered logits feed one categorical draw.
+``top_k <= 0`` and ``top_p >= 1`` disable their filter — with both
+disabled the filtered logits are VALUE-IDENTICAL to the input (the mask
+is all-true), so adding the filter to an existing sampling path cannot
+change previously pinned token streams.
+
+The filters are threshold-based (compare against the k-th / nucleus-edge
+logit VALUE) rather than scatter-based, so ``top_k``/``top_p`` may be
+traced per-row values — ``lax.top_k`` with its static k cannot express a
+batch where every request carries its own k. Exact logit ties at the
+threshold are all kept (deterministic, order-free); for sampling this is
+the right bias — a tie at the boundary means the distribution itself
+does not distinguish the candidates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["filter_logits", "sample_rows"]
+
+
+def filter_logits(logits: jnp.ndarray, top_k=0, top_p=1.0) -> jnp.ndarray:
+    """Mask ``logits`` (..., V) to the top-k / top-p candidate set.
+
+    ``top_k``/``top_p`` are scalars or arrays broadcastable to the batch
+    shape ``logits.shape[:-1]`` (per-row values in the serving tick).
+    Masked entries become -inf; kept entries pass through UNCHANGED, so
+    disabled filters are a value-level no-op. The filters apply
+    SEQUENTIALLY: top-p's nucleus is measured on the softmax of the
+    top-k-filtered logits (survivor mass renormalized, as in the HF
+    convention), not on the original distribution. At least one token
+    always survives (the argmax: it is >= the k-th largest for any
+    k >= 1, and the first token of the nucleus prefix for any p > 0;
+    ``top_p <= 0`` is clamped to keep exactly that first token).
+    """
+    v = logits.shape[-1]
+    batch = logits.shape[:-1]
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), batch)
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), batch)
+    # top-k: keep logits >= the k-th largest VALUE (ties at the edge kept)
+    sl = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(sl, jnp.clip(k - 1, 0, v - 1)[..., None],
+                              axis=-1)
+    keep = (k <= 0)[..., None] | (logits >= kth)
+    out = jnp.where(keep, logits, -jnp.inf)
+    # top-p over the SURVIVORS: -inf entries softmax to 0 and sort last,
+    # so the cumulative mass is implicitly renormalized to the top-k set.
+    # nucleus = smallest sorted prefix with cumulative prob >= p, i.e.
+    # keep sorted position j iff the mass BEFORE j is still < p; the
+    # edge logit's value is then the row threshold
+    sl2 = jnp.flip(jnp.sort(out, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sl2.astype(jnp.float32), axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    in_nucleus = before < jnp.maximum(p, 1e-9)[..., None]
+    edge = jnp.min(jnp.where(in_nucleus, sl2, jnp.inf), axis=-1,
+                   keepdims=True).astype(logits.dtype)
+    keep_p = (p >= 1.0)[..., None] | (out >= edge)
+    return jnp.where(keep_p, out, -jnp.inf)
+
+
+def sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
+                temperature: jnp.ndarray, top_k: jnp.ndarray,
+                top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling for the serving tick: ``logits`` (b, V), ``keys``
+    (b, 2) uint32 (one PRNG key per slot), per-row temperature/top_k/
+    top_p (b,). Rows with ``temperature <= 0`` take the greedy argmax.
+
+    Each row's draw is ``jax.random.categorical(key_b, filtered_b[None])``
+    — via vmap, which JAX guarantees is semantically identical to the
+    per-row loop — so a slot row reproduces exactly what ``gpt_decode``'s
+    batch-1 ``pick`` computes for the same key and parameters. That
+    equality is what the serve-vs-generate identity tests pin.
+    """
+    temperature = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    filt = filter_logits(logits / safe_t[:, None].astype(logits.dtype),
+                         top_k, top_p)
+    sampled = jax.vmap(
+        lambda l, k: jax.random.categorical(k, l[None, :], -1)[0])(filt, keys)
+    greedy = jnp.argmax(logits, -1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,22 @@
+"""Online inference serving: continuous batching over the KV-cache
+decode path (doc/serving.md).
+
+The offline surface (``task=generate`` / ``gpt_decode``) batches
+equal-length prompts once and exits; this package keeps the model hot
+behind a request queue: a fixed pool of KV-cache slots, per-tick
+admission of queued prompts into free slots, one batched decode step
+across all active slots, and immediate retirement of finished sequences
+— so mixed-length traffic interleaves instead of convoying.
+
+Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
+(reference-style API), and CLI ``task = serve`` (cli.py).
+"""
+
+from .engine import DecodeEngine
+from .scheduler import Request, SamplingParams, SlotScheduler
+from .server import (AdmissionError, InferenceServer, QueueFullError,
+                     ServeResult)
+
+__all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
+           "SlotScheduler", "DecodeEngine", "AdmissionError",
+           "QueueFullError"]

@@ -1,0 +1,240 @@
+"""Slot-pool decode engine: the device side of continuous batching.
+
+The offline decode (``models/gpt.py:gpt_decode``) compiles prefill + the
+whole token scan into one program per (prompt length, generation length)
+signature — perfect for equal-length batch generation, useless for a
+server where requests arrive at different times with different lengths.
+This engine re-cuts the same math at the granularity a scheduler needs:
+
+* a **KV slot pool** — one (n_layer, slots, n_head, seq_len, head_dim)
+  cache pair; each in-flight request owns one slot row for its lifetime;
+* **prefill** — a jitted full-prompt forward for ONE request that writes
+  its K/V into an arbitrary slot row (traced slot index — one compiled
+  program per prompt length, reused for every slot) and samples the
+  request's first token;
+* **tick** — ONE jitted batched decode step across ALL slot rows, each
+  row at its own position with its own sampling params and PRNG key.
+  Rows advance independently, so short and long requests interleave
+  instead of convoying behind the longest member of a fixed batch.
+
+Token-identity contract: every numeric building block is shared with the
+offline path's XLA form (``_fuse_qkv_blocks`` / ``_block_core_fusedqkv``
+/ ``_layernorm`` from models/gpt.py, the masked-softmax cached attention
+in the same per-row form, ``ops/sampling.py`` with the per-request
+``fold_in(key, token_index)`` schedule), so a request served from any
+slot — including a recycled one — produces the same tokens as running it
+alone through ``gpt_decode``'s XLA scan path with the same params and
+seed (pinned by tests on the CPU mesh). Where the offline path engages
+its fused Pallas kernel instead (single TPU shard), its low-order logit
+bits can differ from any XLA formulation — including gpt_decode's own
+fallback — so the cross-path guarantee there is distribution-level, not
+bit-level. Prefill
+rewrites the WHOLE slot row (real K/V, zero-padded tail), and the decode
+mask admits only positions <= the row's own position, every one of which
+the row's own prefill/ticks have written — a recycled slot can never see
+its previous occupant's cache.
+
+The tick runs the XLA scan path (not the fused whole-step Pallas kernel):
+slot rows sit at DIFFERENT cache positions, and the fused kernel's
+single-position dus/mask layout assumes one shared ``pos``. The measured
+fused-kernel batch amortization (ops/pallas_kernels.py) is the obvious
+next lever — a per-row-position variant is future work, noted in
+doc/serving.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.gpt import (GPTConfig, _block_core_fusedqkv, _fuse_qkv_blocks,
+                          _layernorm)
+from ..ops.attention import local_attention
+from ..ops.sampling import sample_rows
+
+__all__ = ["DecodeEngine"]
+
+
+def _attn_cached_rows(q, ck, cv, pos):
+    """Per-row cached attention: q (b, 1, H, d) against head-major caches
+    (b, H, S, d), each row masked at its OWN position ``pos`` (b,) —
+    the multi-position form of models/gpt.py:_attn_cached's jnp path
+    (same einsums, same f32 softmax, same -1e30 mask), row-independent
+    so each slot reproduces the batch-1 offline computation exactly."""
+    d = q.shape[-1]
+    qh = jnp.swapaxes(q, 1, 2)                          # (b, h, 1, d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / (d ** 0.5)
+    mask = jnp.arange(ck.shape[2])[None, None, None, :] \
+        <= pos[:, None, None, None]
+    w = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w,
+                     cv.astype(jnp.float32)).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)                      # (b, 1, h, d)
+
+
+@functools.lru_cache(maxsize=16)
+def _tick_fn(cfg_key: tuple, donate: bool):
+    """Jitted batched decode tick for one model config — module-level and
+    lru-cached (the models/gpt.py:_decode_fn idiom) so every server over
+    the same config shares one compiled program; the slot count is a
+    traced dimension, not part of the key."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+
+    def impl(blocks, outer, cache_k, cache_v, tok, pos, keys, fold, temp,
+             top_k, top_p):
+        h = (outer["emb"][tok][:, None, :]
+             + outer["pos"][pos][:, None, :]).astype(dtype)
+        # python-unrolled layer loop (n_layer is static) with per-row
+        # dynamic_update_slice writes STRAIGHT into the stacked caches:
+        # the lax.scan form instead streams both caches through xs->ys,
+        # which XLA materializes as a full cache copy per layer per token
+        # — measured at 87% of the decode step (doc/performance.md round
+        # 4). With the caches donated, the dus chain can update in place.
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                kh = jnp.swapaxes(k, 1, 2)[:, None]     # (b, 1, h, 1, d)
+                vh = jnp.swapaxes(v, 1, 2)[:, None]
+                # vmap over the slot axis: each row writes (h, 1, d) at
+                # (layer l, its OWN position)
+                upd = jax.vmap(
+                    lambda c, u, pp: lax.dynamic_update_slice(
+                        c, u, (l, 0, pp, 0)),
+                    in_axes=(1, 0, 0), out_axes=1)
+                ck = upd(cache_k, kh, pos)
+                cv = upd(cache_v, vh, pos)
+                return _attn_cached_rows(q, ck[l], cv[l], pos), (ck, cv)
+
+            h, (cache_k, cache_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (b, V)
+        keys_t = jax.vmap(jax.random.fold_in)(keys, fold)
+        nxt = sample_rows(logits, keys_t, temp, top_k, top_p)
+        return cache_k, cache_v, nxt
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+@functools.lru_cache(maxsize=256)
+def _prefill_fn(cfg_key: tuple, n_prompt: int, donate: bool):
+    """Jitted admit program for one (config, prompt length): full-prompt
+    forward, whole-slot-row cache write (traced slot index — one program
+    serves every slot), first-token sample."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+
+    def impl(blocks, outer, cache_k, cache_v, prompt, slot, key, temp,
+             top_k, top_p):
+        h = (outer["emb"][prompt]
+             + outer["pos"][None, :n_prompt]).astype(dtype)
+
+        def prefill_layer(carry, p):
+            def attn(q, k, v):
+                return local_attention(q, k, v, causal=True), (k, v)
+            out, (k, v) = _block_core_fusedqkv(p, carry, cfg.n_head, attn,
+                                               identity)
+            # head-major (1, H, S, d) row, zero-padded to the FULL slot
+            # length: the dus below replaces the whole row, so a recycled
+            # slot keeps nothing of its previous occupant
+            kh = jnp.transpose(k, (0, 2, 1, 3))
+            vh = jnp.transpose(v, (0, 2, 1, 3))
+            pad = ((0, 0), (0, 0), (0, cfg.seq_len - n_prompt), (0, 0))
+            return out, (jnp.pad(kh, pad), jnp.pad(vh, pad))
+
+        h, (ck_row, cv_row) = lax.scan(prefill_layer, h, blocks)
+        hl = _layernorm(h[:, -1:], outer["lnf_g"], outer["lnf_b"])
+        logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
+        # first generated token: fold index 0 — the same schedule as
+        # gpt_decode's pick(logits, fold_in(rng, 0))
+        k0 = jax.random.fold_in(key, 0)
+        tok = sample_rows(logits, k0[None], temp[None], top_k[None],
+                          top_p[None])
+        cache_k = lax.dynamic_update_slice(cache_k, ck_row,
+                                           (0, slot, 0, 0, 0))
+        cache_v = lax.dynamic_update_slice(cache_v, cv_row,
+                                           (0, slot, 0, 0, 0))
+        return cache_k, cache_v, tok[0]
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+class DecodeEngine:
+    """Owns the slot-pool KV caches and drives the jitted programs
+    (prefill per prompt length, one shared tick). Host-side state is the
+    caller's job (serve/scheduler.py); this class only moves tensors."""
+
+    def __init__(self, cfg: GPTConfig, params: Dict, slots: int):
+        if slots < 1:
+            raise ValueError("serve_slots must be >= 1, got %d" % slots)
+        if cfg.feat % cfg.n_head:
+            raise ValueError("feat %d not divisible by n_head %d"
+                             % (cfg.feat, cfg.n_head))
+        self.cfg = cfg
+        self._cfg_key = dataclasses.astuple(cfg)
+        self.slots = slots
+        self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        # fused QKV once per server lifetime (models/gpt.py does this once
+        # per decode CALL; a server amortizes it over every request)
+        self._blocks = _fuse_qkv_blocks(params["blocks"])
+        self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
+                                              "lnf_b", "head")}
+        hd = cfg.feat // cfg.n_head
+        shape = (cfg.n_layer, slots, cfg.n_head, cfg.seq_len, hd)
+        self.cache_k = jnp.zeros(shape, self.dtype)
+        self.cache_v = jnp.zeros(shape, self.dtype)
+        # donating the caches halves peak HBM on real chips; CPU (the test
+        # mesh) ignores donation with a warning, so gate on the backend
+        self._donate = jax.default_backend() != "cpu"
+
+    def cache_bytes(self) -> int:
+        if self.cache_k is None:        # closed (metrics after shutdown)
+            return 0
+        return 2 * self.cache_k.size * self.cache_k.dtype.itemsize
+
+    def close(self) -> None:
+        """Drop the cache buffers (the server calls this at shutdown)."""
+        self.cache_k = self.cache_v = None
+
+    def prefill(self, slot: int, prompt: np.ndarray, key: np.ndarray,
+                temperature: float, top_k: int, top_p: float) -> int:
+        """Admit one request into ``slot``: full forward over ``prompt``
+        (1-D int array), write its K/V row, return the first generated
+        token (synchronized — the host needs it for EOS/TTFT anyway)."""
+        fn = _prefill_fn(self._cfg_key, int(len(prompt)), self._donate)
+        self.cache_k, self.cache_v, tok = fn(
+            self._blocks, self._outer, self.cache_k, self.cache_v,
+            jnp.asarray(np.asarray(prompt, np.int32))[None],
+            jnp.asarray(slot, jnp.int32), jnp.asarray(key),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
+        return int(tok)
+
+    def tick(self, tok: np.ndarray, pos: np.ndarray, keys: np.ndarray,
+             fold: np.ndarray, temp: np.ndarray, top_k: np.ndarray,
+             top_p: np.ndarray) -> np.ndarray:
+        """One batched decode step across every slot row (free rows run
+        too, on dummy state — their writes land at masked positions of
+        rows that prefill fully rewrites at the next admit, and their
+        tokens are discarded by the scheduler). ``fold`` is each row's
+        token index in ITS OWN request — the fold_in schedule that makes
+        a slot row's sample stream identical to the offline path's.
+        Returns the (slots,) next tokens, synchronized."""
+        fn = _tick_fn(self._cfg_key, self._donate)
+        self.cache_k, self.cache_v, nxt = fn(
+            self._blocks, self._outer, self.cache_k, self.cache_v,
+            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(keys),
+            jnp.asarray(fold), jnp.asarray(temp), jnp.asarray(top_k),
+            jnp.asarray(top_p))
+        return np.asarray(nxt)

@@ -1,0 +1,233 @@
+"""Continuous-batching scheduler: slot bookkeeping between device calls.
+
+Per scheduler pass (driven by serve/server.py's loop):
+
+1. **admit** — pop queued requests FIFO (skipping any whose deadline
+   already passed — they finish as ``timeout``) into free slots; each
+   admit runs one prefill (the request's TTFT token comes back with it);
+2. **tick** — one batched decode step across all slots; active rows
+   append their token, free rows are ignored;
+3. **retire** — rows that hit EOS, their token budget, or the sequence
+   length free their slot immediately, so the NEXT pass can admit into
+   it — short requests leave the batch the moment they finish instead of
+   convoying behind long ones.
+
+The scheduler is single-threaded by design (only the server's scheduler
+thread calls it); cross-thread state (the admission queue, completion
+events) lives in the server.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import profiler
+
+__all__ = ["SamplingParams", "Request", "SlotScheduler"]
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request generation parameters (defaults come from the server's
+    config). ``seed`` feeds ``jax.random.PRNGKey`` exactly like
+    ``gpt_decode(rng=PRNGKey(seed))``, so a served request reproduces the
+    offline path token for token. ``timeout_ms`` bounds QUEUE time: a
+    request still waiting when it expires finishes as ``timeout``
+    (0 = no deadline); once admitted a request always runs to
+    completion. ``eos``: stop early when this token is produced (it is
+    included in the output); None = run to max_tokens."""
+    max_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos: Optional[int] = None
+    timeout_ms: float = 0.0
+
+
+class Request:
+    """One in-flight generation request: prompt + params + lifecycle
+    timestamps. ``done`` is set exactly once, when ``status`` reaches a
+    terminal value (ok / timeout / rejected / cancelled)."""
+
+    __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
+                 "admit_t", "first_token_t", "done_t", "tokens", "status",
+                 "error", "done", "slot")
+
+    def __init__(self, rid: int, prompt: np.ndarray,
+                 params: SamplingParams, submit_t: float):
+        self.rid = rid
+        self.prompt = prompt
+        self.params = params
+        self.submit_t = submit_t
+        self.deadline = (submit_t + params.timeout_ms / 1e3
+                         if params.timeout_ms > 0 else None)
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.tokens: List[int] = []
+        self.status = "queued"
+        self.error = ""
+        self.done = threading.Event()
+        self.slot: Optional[int] = None
+
+    def finish(self, status: str, error: str = "") -> None:
+        self.status = status
+        self.error = error
+        self.done_t = time.perf_counter()
+        self.done.set()
+
+
+class SlotScheduler:
+    """Owns the per-slot host state mirroring the engine's cache rows."""
+
+    def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
+                 on_finish=None):
+        self.engine = engine
+        self.stats = stats or profiler.StepStats()
+        self.on_finish = on_finish      # called with each request that
+        #                                 reaches a terminal state here
+        n = engine.slots
+        self._req: List[Optional[Request]] = [None] * n
+        self._free = list(range(n - 1, -1, -1))     # pop() -> lowest slot
+        # device-call argument rows; free rows keep harmless dummies
+        # (tok 0 / pos 0 / temperature 0 — greedy over garbage, discarded)
+        self._tok = np.zeros(n, np.int32)
+        self._pos = np.zeros(n, np.int32)
+        self._fold = np.zeros(n, np.int32)
+        self._keys = np.zeros((n, 2), np.uint32)
+        self._temp = np.zeros(n, np.float32)
+        self._topk = np.zeros(n, np.int32)
+        self._topp = np.ones(n, np.float32)
+        # gauges
+        self.ticks = 0
+        self.active_row_ticks = 0       # sum of active counts over ticks
+        self.tokens_generated = 0
+        # request ids in admission order (bounded: diagnostic window, not
+        # a full history — a hot server admits forever)
+        self.admit_order: collections.deque = collections.deque(maxlen=4096)
+
+    # ------------------------------------------------------------- state
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active(self) -> int:
+        return self.engine.slots - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.active / float(self.engine.slots)
+
+    def batch_efficiency(self) -> float:
+        """Mean fraction of slot rows doing useful work per tick — the
+        continuous-batching quality gauge (1.0 = every tick fully
+        batched)."""
+        if not self.ticks:
+            return 0.0
+        return self.active_row_ticks / float(self.ticks * self.engine.slots)
+
+    # ------------------------------------------------------------- admit
+    def admit(self, req: Request) -> None:
+        """Prefill ``req`` into a free slot (caller checked free_slots).
+        May retire immediately (max_tokens == 1, or the first token is
+        EOS)."""
+        import jax
+
+        slot = self._free.pop()
+        p = req.params
+        req.slot = slot
+        req.admit_t = time.perf_counter()
+        self.stats.record(profiler.QUEUE_WAIT, req.admit_t - req.submit_t)
+        self.admit_order.append(req.rid)
+        key = np.asarray(jax.random.PRNGKey(p.seed), np.uint32)
+        with self.stats.phase(profiler.PREFILL):
+            tok = self.engine.prefill(slot, req.prompt, key,
+                                      p.temperature, p.top_k, p.top_p)
+        # commit this admit's QUEUE_WAIT/PREFILL as their own stats step:
+        # folding them into the next tick's end_step would sum every
+        # admit since the last tick into one sample (skewing the
+        # percentiles) and lose them entirely for requests that retire
+        # at admit (max_tokens 1 / instant EOS — no tick ever runs)
+        self.stats.end_step()
+        req.first_token_t = time.perf_counter()
+        req.status = "active"
+        req.tokens.append(tok)
+        self.tokens_generated += 1
+        if self._finished(req, tok):
+            self._retire(req, "ok")
+            return
+        n = len(req.prompt)
+        self._tok[slot] = tok
+        self._pos[slot] = n            # position the NEXT tick processes
+        self._fold[slot] = 1           # next token's fold_in index
+        self._keys[slot] = key
+        self._temp[slot] = p.temperature
+        self._topk[slot] = p.top_k
+        self._topp[slot] = p.top_p
+        self._req[slot] = req
+
+    def _finished(self, req: Request, tok: int) -> bool:
+        p = req.params
+        cap = min(p.max_tokens, self.engine.cfg.seq_len - len(req.prompt))
+        if len(req.tokens) >= cap:
+            return True
+        return p.eos is not None and tok == p.eos
+
+    def _retire(self, req: Request, status: str, error: str = "") -> None:
+        slot = req.slot
+        self._req[slot] = None
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._tok[slot] = 0
+        self._pos[slot] = 0
+        self._fold[slot] = 0
+        self._free.append(slot)
+        req.finish(status, error)
+        if self.on_finish is not None:
+            self.on_finish(req)
+
+    # -------------------------------------------------------------- tick
+    def tick(self) -> int:
+        """One batched decode step; returns the number of still-active
+        slots afterwards."""
+        if self.active == 0:
+            return 0
+        with self.stats.phase(profiler.DECODE_TICK):
+            nxt = self.engine.tick(self._tok, self._pos, self._keys,
+                                   self._fold, self._temp, self._topk,
+                                   self._topp)
+        self.ticks += 1
+        self.active_row_ticks += self.active
+        for slot, req in enumerate(self._req):
+            if req is None:
+                continue
+            tok = int(nxt[slot])
+            req.tokens.append(tok)
+            self.tokens_generated += 1
+            if self._finished(req, tok):
+                self._retire(req, "ok")
+            else:
+                self._tok[slot] = tok
+                self._pos[slot] += 1
+                self._fold[slot] += 1
+        self.stats.end_step()
+        return self.active
+
+    # ------------------------------------------------------------- drain
+    def cancel_active(self) -> int:
+        """Abort every in-flight request (non-drain shutdown); returns
+        how many were cancelled."""
+        n = 0
+        for req in list(self._req):
+            if req is not None:
+                self._retire(req, "cancelled", "server shutdown")
+                n += 1
+        return n

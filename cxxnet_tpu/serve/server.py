@@ -1,0 +1,341 @@
+"""InferenceServer: the online serving front door.
+
+``submit(prompt, params) -> handle`` / ``result(handle)`` over a bounded
+admission queue, with a dedicated scheduler thread driving the
+continuous-batching loop (serve/scheduler.py) against the slot-pool
+decode engine (serve/engine.py). Backpressure is explicit: a full queue
+rejects at submit time with a reason (``QueueFullError``) instead of
+buffering unboundedly — the caller decides whether to retry, shed, or
+block (``block=True``, what the CLI's stdin loop uses).
+
+Observability: per-request TTFT / per-token latency and the scheduler's
+prefill / decode_tick / queue_wait phases (utils/profiler.py) are
+summarized as p50/p95/p99 by :meth:`metrics`, alongside queue-depth,
+slot-occupancy and batch-efficiency gauges.
+
+Shutdown: ``shutdown(drain=True)`` stops admissions, finishes every
+queued + in-flight request, then joins the thread and drops the caches;
+``drain=False`` cancels queued and in-flight work first. Either way no
+slot stays occupied and no thread outlives the call (pinned by test and
+by the suite-wide thread-leak fixture — the thread is named
+``cxn-serve-scheduler-*`` so tests/conftest.py can see it).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import profiler
+from .engine import DecodeEngine
+from .scheduler import Request, SamplingParams, SlotScheduler
+
+__all__ = ["InferenceServer", "ServeResult", "AdmissionError",
+           "QueueFullError"]
+
+_server_seq = itertools.count()
+
+
+class AdmissionError(RuntimeError):
+    """A request the server refused to accept; ``reason`` says why."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class QueueFullError(AdmissionError):
+    """Backpressure: the bounded admission queue is at capacity."""
+
+
+@dataclass
+class ServeResult:
+    """Terminal state of one request. ``tokens`` is the FULL sequence
+    (prompt + generated), matching ``gpt_decode``'s return layout;
+    empty for non-ok statuses."""
+    status: str                     # ok | timeout | cancelled
+    tokens: np.ndarray
+    error: str = ""
+    ttft_ms: float = 0.0            # submit -> first token (incl. queue)
+    ms_per_token: float = 0.0       # mean inter-token gap after the first
+    queue_ms: float = 0.0           # submit -> admit
+
+
+class InferenceServer:
+    """Slot-based continuous-batching server over the GPT decode path.
+
+    ``cfg``/``params`` are the models/gpt.py config + parameter tree (a
+    config-DSL Net serves through ``nnet.lm.net_gpt_export`` — that is
+    what ``task=serve`` and ``wrapper.Net.serve_start`` do).
+    """
+
+    def __init__(self, cfg, params, *, slots: int = 8, queue: int = 32,
+                 timeout_ms: float = 0.0,
+                 defaults: Optional[SamplingParams] = None):
+        if queue < 1:
+            raise ValueError("serve_queue must be >= 1, got %d" % queue)
+        self._defaults = defaults or SamplingParams()
+        if timeout_ms and not self._defaults.timeout_ms:
+            self._defaults = replace(self._defaults, timeout_ms=timeout_ms)
+        self._engine = DecodeEngine(cfg, params, slots)
+        self._stats = profiler.StepStats()
+        self._sched = SlotScheduler(self._engine, self._stats,
+                                    on_finish=self._record_done)
+        self._queue: collections.deque = collections.deque()
+        self._queue_cap = queue
+        self._cond = threading.Condition()
+        self._rid = itertools.count()
+        self._closing = False           # no new submits
+        self._drain = True              # finish queued work on shutdown?
+        self._stopped = threading.Event()
+        # counters + per-request latency samples for metrics(); the
+        # sample reservoirs are bounded so a long-lived server's memory
+        # does not grow with requests served (percentiles then describe
+        # the most recent window)
+        self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
+                        "timeout": 0, "cancelled": 0}
+        self._ttft_s: collections.deque = collections.deque(maxlen=4096)
+        self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
+        self._queue_depth_max = 0
+        self._thread = threading.Thread(
+            target=self._loop,
+            name="cxn-serve-scheduler-%d" % next(_server_seq), daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+    @property
+    def slots(self) -> int:
+        return self._engine.slots
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def _reject(self, reason: str) -> None:
+        """Count + raise an unservable-request rejection, so the
+        'rejected' metric agrees with the ERR lines callers emit."""
+        with self._cond:
+            self._counts["rejected"] += 1
+        raise AdmissionError(reason)
+
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               block: bool = False, **overrides) -> Request:
+        """Enqueue one generation request; returns an opaque handle for
+        :meth:`result`. ``params``/keyword overrides fill a
+        SamplingParams on top of the server defaults. Raises
+        :class:`QueueFullError` when the admission queue is at capacity
+        (``block=True`` waits for space instead) and
+        :class:`AdmissionError` for unservable prompts."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        seq_len = self._engine.cfg.seq_len
+        if prompt.size < 1:
+            self._reject("empty prompt")
+        if prompt.size >= seq_len:
+            self._reject("prompt length %d leaves no room to generate "
+                         "within seq_len %d" % (prompt.size, seq_len))
+        p = params if params is not None else self._defaults
+        if overrides:
+            p = replace(p, **overrides)
+        if p.max_tokens < 1:
+            self._reject("max_tokens must be >= 1, got %d" % p.max_tokens)
+        if p.top_k < 0 or not 0.0 < p.top_p <= 1.0:
+            self._reject("bad sampling params: top_k=%r top_p=%r"
+                         % (p.top_k, p.top_p))
+        with self._cond:
+            if self._closing:
+                raise AdmissionError("server is shutting down")
+            while len(self._queue) >= self._queue_cap:
+                if not block:
+                    self._counts["rejected"] += 1
+                    raise QueueFullError(
+                        "admission queue full (%d queued, %d/%d slots "
+                        "busy); retry later or submit(block=True)"
+                        % (len(self._queue), self._sched.active,
+                           self._engine.slots))
+                self._cond.wait()
+                if self._closing:
+                    raise AdmissionError("server is shutting down")
+            req = Request(next(self._rid), prompt, p, time.perf_counter())
+            self._queue.append(req)
+            self._counts["submitted"] += 1
+            self._queue_depth_max = max(self._queue_depth_max,
+                                        len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def result(self, handle: Request,
+               timeout: Optional[float] = None) -> ServeResult:
+        """Block until ``handle`` reaches a terminal state (or ``timeout``
+        seconds pass — then raises TimeoutError) and return its
+        ServeResult."""
+        if not handle.done.wait(timeout):
+            raise TimeoutError("request %d still in flight" % handle.rid)
+        if handle.status == "ok":
+            tokens = np.concatenate(
+                [handle.prompt,
+                 np.asarray(handle.tokens, np.int32)])
+            ttft = (handle.first_token_t - handle.submit_t) * 1e3
+            gaps = ((handle.done_t - handle.first_token_t)
+                    / max(1, len(handle.tokens) - 1) * 1e3
+                    if len(handle.tokens) > 1 else 0.0)
+            return ServeResult("ok", tokens, ttft_ms=ttft,
+                               ms_per_token=gaps,
+                               queue_ms=(handle.admit_t
+                                         - handle.submit_t) * 1e3)
+        return ServeResult(handle.status, np.zeros((0,), np.int32),
+                           error=handle.error)
+
+    # -------------------------------------------------------------- loop
+    def _expire_queued_locked(self, now: float) -> None:
+        """Finish queued requests whose deadline passed (FIFO order is
+        preserved for the survivors)."""
+        if not any(r.deadline is not None for r in self._queue):
+            return
+        keep = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._counts["timeout"] += 1
+                req.finish("timeout",
+                           "expired after %.0f ms in queue"
+                           % ((now - req.submit_t) * 1e3))
+            else:
+                keep.append(req)
+        if len(keep) != len(self._queue):
+            self._queue = keep
+            self._cond.notify_all()
+
+    def _loop(self) -> None:
+        admitted = []
+        try:
+            while True:
+                admitted = []
+                with self._cond:
+                    now = time.perf_counter()
+                    self._expire_queued_locked(now)
+                    if self._closing and not self._drain:
+                        break
+                    n_free = self._sched.free_slots   # slots shrink only
+                    #   when admit() runs below, outside this lock
+                    while n_free > 0 and self._queue:
+                        admitted.append(self._queue.popleft())
+                        n_free -= 1
+                        self._cond.notify_all()     # space for blocked submits
+                    if not admitted and self._sched.active == 0:
+                        if self._closing and not self._queue:
+                            break
+                        # truly idle: active == 0 means every slot is
+                        # free, so the pop loop above drained the queue —
+                        # nothing can expire while we sleep. Every
+                        # mutation path (submit, shutdown) notifies, so
+                        # an untimed wait parks the thread completely
+                        # instead of polling
+                        self._cond.wait()
+                        continue
+                for req in admitted:            # prefill outside the lock
+                    self._sched.admit(req)
+                if self._sched.active:
+                    self._sched.tick()
+        finally:
+            # reached on shutdown OR on an unexpected scheduler-thread
+            # exception (e.g. a compile OOM in prefill): either way the
+            # server must stop ACCEPTING — otherwise submits would queue
+            # forever with no thread to serve them and result() would
+            # hang — and every request still in flight must reach a
+            # terminal state so result() returns
+            with self._cond:
+                self._closing = True
+                for req in self._queue:
+                    self._counts["cancelled"] += 1
+                    req.finish("cancelled", "server shutdown")
+                self._queue.clear()
+                self._cond.notify_all()
+            for req in admitted:        # popped but not admit()ed when a
+                if not req.done.is_set():   # mid-pass exception hit
+                    self._counts["cancelled"] += 1
+                    req.finish("cancelled", "server shutdown")
+            self._sched.cancel_active()     # counted via _record_done
+            self._engine.close()
+            self._stopped.set()
+
+    def _record_done(self, req: Request) -> None:
+        """Scheduler on_finish hook (scheduler-thread only)."""
+        if req.status != "ok":
+            self._counts["cancelled" if req.status == "cancelled"
+                         else req.status] += 1
+            return
+        self._counts["completed"] += 1
+        self._ttft_s.append(req.first_token_t - req.submit_t)
+        if len(req.tokens) > 1:
+            self._tok_gap_s.append((req.done_t - req.first_token_t)
+                                   / (len(req.tokens) - 1))
+
+    # ----------------------------------------------------------- control
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Finish everything queued + in flight, keep the server alive is
+        NOT supported — drain means shutdown(drain=True)."""
+        self.shutdown(drain=True, timeout=timeout)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the server. ``drain=True`` finishes queued + in-flight
+        requests first; ``drain=False`` cancels them. Idempotent; joins
+        the scheduler thread and frees every slot + the cache buffers."""
+        with self._cond:
+            self._closing = True
+            self._drain = drain
+            self._cond.notify_all()
+        self._stopped.wait(timeout)
+        self._thread.join(timeout)
+
+    def close(self) -> None:
+        self.shutdown(drain=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=not any(exc))
+
+    # ----------------------------------------------------------- metrics
+    def metrics(self) -> Dict:
+        """Serving health snapshot: request counters, p50/p95/p99 latency
+        summaries (ms), and scheduler gauges."""
+        ms = lambda xs: {k: v * 1e3 for k, v in
+                         profiler.percentiles(xs).items()}
+        with self._cond:
+            depth = len(self._queue)
+        st = self._stats
+        return {
+            "requests": dict(self._counts),
+            "ttft_ms": ms(self._ttft_s),
+            "token_ms": ms(self._tok_gap_s),
+            "queue_wait_ms": ms(st._phases.get(profiler.QUEUE_WAIT, [])),
+            "prefill_ms": ms(st._phases.get(profiler.PREFILL, [])),
+            "decode_tick_ms": ms(st._phases.get(profiler.DECODE_TICK, [])),
+            "queue_depth": {"now": depth, "max": self._queue_depth_max},
+            "slot_occupancy": self._sched.occupancy(),
+            "batch_efficiency": self._sched.batch_efficiency(),
+            "ticks": self._sched.ticks,
+            "tokens_generated": self._sched.tokens_generated,
+            "slots": self._engine.slots,
+            "kv_cache_bytes": self._engine.cache_bytes(),
+        }
+
+    def reset_metrics(self) -> None:
+        """Zero the latency samples and gauges (bench.py warms the jit
+        caches with one pass of the trace, then measures a clean one)."""
+        with self._cond:
+            self._ttft_s.clear()
+            self._tok_gap_s.clear()
+            self._queue_depth_max = 0
+            self._counts = {k: 0 for k in self._counts}
+        self._stats.clear()
+        self._sched.ticks = 0
+        self._sched.active_row_ticks = 0
+        self._sched.tokens_generated = 0

@@ -28,7 +28,8 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
-           "FEED_WAIT", "STEP_DISPATCH", "METRIC_SYNC"]
+           "percentiles", "FEED_WAIT", "STEP_DISPATCH", "METRIC_SYNC",
+           "PREFILL", "DECODE_TICK", "QUEUE_WAIT"]
 
 # canonical phase names of the training hot loop (round 6, async feed):
 #   FEED_WAIT     — blocked on the next batch (host iterator, or the async
@@ -39,6 +40,15 @@ __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
 FEED_WAIT = "feed_wait"
 STEP_DISPATCH = "step_dispatch"
 METRIC_SYNC = "metric_sync"
+
+# canonical phase names of the serving hot loop (serve/ scheduler):
+#   PREFILL     — admit: full-prompt forward filling the request's KV slot
+#   DECODE_TICK — one batched decode step across all active slots
+#   QUEUE_WAIT  — time a request sat in the admission queue before a slot
+#                 freed up (recorded at admit via StepStats.record)
+PREFILL = "prefill"
+DECODE_TICK = "decode_tick"
+QUEUE_WAIT = "queue_wait"
 
 # phases counted as "waiting on input" for the wait-fraction line ("data"
 # is the pre-round-6 name, kept so external callers' stats still summarize)
@@ -81,6 +91,12 @@ class StepStats:
         finally:
             self._current[name] = self._current.get(name, 0.0) + get_time() - t0
 
+    def record(self, name: str, seconds: float) -> None:
+        """Add an externally measured duration to a phase — for spans the
+        context manager cannot bracket (e.g. QUEUE_WAIT: the wait ends in
+        the scheduler thread but started at submit in the caller's)."""
+        self._current[name] = self._current.get(name, 0.0) + seconds
+
     def end_step(self) -> None:
         for name, dt in self._current.items():
             lst = self._phases.setdefault(name, [])
@@ -111,6 +127,12 @@ class StepStats:
         for k, v in self._current.items():
             totals[k] = totals.get(k, 0.0) + v
         return totals
+
+    def percentiles(self, name: str, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+        """{p50, p95, p99, ...} of a phase's per-step durations (seconds);
+        zeros when the phase never ran. The serving scheduler summarizes
+        its PREFILL/DECODE_TICK/QUEUE_WAIT phases through this."""
+        return percentiles(self._phases.get(name, []), qs)
 
     def wait_fraction(self) -> float:
         """Fraction of the round's wall time spent blocked on input
@@ -149,6 +171,13 @@ class StepStats:
                                 100.0 * totals[p] / wall))
                 break
         return "; ".join(parts)
+
+
+def percentiles(vals: List[float], qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+    """Nearest-rank percentile summary of a sample list: {"p50": ..,
+    "p95": .., "p99": ..} (keys follow ``qs``). Empty input -> zeros."""
+    s = sorted(vals)
+    return {"p%g" % (q * 100): StepStats._pct(s, q) for q in qs}
 
 
 @contextlib.contextmanager

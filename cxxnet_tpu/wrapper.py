@@ -158,18 +158,71 @@ class Net:
         return self._net.extract_feature(_as_batch(data, label), name)
 
     def generate(self, prompt: Array, max_new: int,
-                 temperature: float = 0.0, seed: int = 0) -> Array:
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0) -> Array:
         """Autoregressive generation from a GPT-shaped net (gpt_lm_config
         structure): prompt (b, n_prompt) int token ids -> (b, n_prompt +
         max_new) int32. Greedy at temperature 0, else categorical
-        sampling. Drives the models/gpt.py fused whole-step decode kernel
+        sampling, optionally top-k / top-p (nucleus) restricted — the
+        filters compose with temperature (ops/sampling.py; 0 / 1.0
+        disable). Drives the models/gpt.py fused whole-step decode kernel
         — no reference counterpart (the reference has no sequence models,
         SURVEY §5.7); the CLI twin is ``task = generate``."""
         import jax
         from .nnet.lm import net_generate
         rng = jax.random.PRNGKey(seed) if temperature > 0 else None
         return net_generate(self._net, np.asarray(prompt, np.int64),
-                            max_new, temperature=temperature, rng=rng)
+                            max_new, temperature=temperature, rng=rng,
+                            top_k=top_k, top_p=top_p)
+
+    # -- online serving (doc/serving.md) ------------------------------
+    def serve_start(self, slots: int = 8, queue: int = 32,
+                    timeout_ms: float = 0.0, **defaults) -> None:
+        """Start the continuous-batching inference server over this net's
+        decode path (serve/InferenceServer; the CLI twin is ``task =
+        serve``). ``defaults`` seed the per-request SamplingParams
+        (max_tokens / temperature / top_k / top_p / seed / eos)."""
+        from .nnet.lm import net_gpt_export
+        from .serve import InferenceServer, SamplingParams
+        if getattr(self, "_server", None) is not None:
+            raise RuntimeError("serve_start: server already running "
+                               "(call serve_stop first)")
+        cfg, params = net_gpt_export(self._net)
+        self._server = InferenceServer(
+            cfg, params, slots=slots, queue=queue, timeout_ms=timeout_ms,
+            defaults=SamplingParams(**defaults))
+
+    def _serving(self):
+        srv = getattr(self, "_server", None)
+        if srv is None:
+            raise RuntimeError("no server running (call serve_start)")
+        return srv
+
+    def serve_submit(self, prompt: Array, block: bool = False,
+                     **params):
+        """Enqueue one request -> handle (per-request ``params`` override
+        the serve_start defaults). Raises serve.QueueFullError when the
+        bounded admission queue is full, unless ``block=True``."""
+        return self._serving().submit(np.asarray(prompt, np.int64),
+                                      block=block, **params)
+
+    def serve_result(self, handle, timeout=None):
+        """Block for a handle's ServeResult (status / full token
+        sequence / TTFT + per-token latency)."""
+        return self._serving().result(handle, timeout=timeout)
+
+    def serve_metrics(self) -> Dict:
+        """Serving health snapshot (p50/p95/p99 TTFT and tick latencies,
+        queue depth, slot occupancy, batch efficiency)."""
+        return self._serving().metrics()
+
+    def serve_stop(self, drain: bool = True) -> None:
+        """Stop the server (``drain=True`` finishes in-flight + queued
+        requests first); idempotent."""
+        srv = getattr(self, "_server", None)
+        if srv is not None:
+            srv.shutdown(drain=drain)
+            self._server = None
 
     # -- weight surgery -----------------------------------------------
     def set_weight(self, weight: Array, layer_name: str, tag: str) -> None:

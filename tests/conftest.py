@@ -32,19 +32,24 @@ def rng():
 
 
 @pytest.fixture(autouse=True)
-def _no_leaked_device_prefetch_threads():
-    """Leak check (round 6): the async device feed's producer threads are
-    named ``cxn-device-prefetch-*`` (io/device_prefetch.py); any still
-    alive after a test means a DevicePrefetcher was not close()d — a real
-    bug (the thread holds the iterator chain and device buffers), failed
-    here instead of hanging a later test."""
+def _no_leaked_background_threads():
+    """Leak check (round 6, extended round 7): background threads owned
+    by framework objects are namespaced ``cxn-*`` — the async device
+    feed's producers (``cxn-device-prefetch-*``, io/device_prefetch.py)
+    and the inference server's scheduler (``cxn-serve-scheduler-*``,
+    serve/server.py). Any still alive after a test means a
+    DevicePrefetcher was not close()d or an InferenceServer was not shut
+    down — a real bug (the thread holds the iterator chain / the KV slot
+    pool and its device buffers), failed here instead of hanging a later
+    test."""
     yield
+    prefixes = ("cxn-device-prefetch", "cxn-serve")   # scheduler + printer
     deadline = time.time() + 5.0
     while True:
         leaked = [t.name for t in threading.enumerate()
-                  if t.name.startswith("cxn-device-prefetch")]
+                  if t.name.startswith(prefixes)]
         if not leaked or time.time() > deadline:
             break
         time.sleep(0.05)
     assert not leaked, \
-        "device-prefetch producer threads leaked past teardown: %s" % leaked
+        "framework background threads leaked past teardown: %s" % leaked
