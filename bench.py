@@ -22,6 +22,9 @@ Prints one JSON line per metric, in this order:
  10. serve_vs_sequential            (same trace served one-at-a-time
                                      through gpt_decode / served wall —
                                      >1 means continuous batching wins)
+ 11. lint_wall_ms                   (cxn-lint pass 1 on the largest
+                                     example config — the CXN_LINT
+                                     startup/CI cost, round 8)
 
 Round 3's bench emitted only the AlexNet line, which had plateaued at the
 chip's proven streaming ceiling — the driver-recorded BENCH_r*.json could no
@@ -520,10 +523,29 @@ def bench_serve():
     emit("serve_vs_sequential", seq_wall / serve_wall, "ratio")
 
 
+def bench_lint():
+    """cxn-lint pass-1 wall time on the LARGEST example config (round 8):
+    the linter runs at every CXN_LINT startup and in CI, so its cost is a
+    perf surface like any other — this line keeps it visible in the
+    trajectory. Warm pass timed (the registry's AST introspection caches
+    amortize across configs in a CI run; the first pass pays them)."""
+    import glob
+    from cxxnet_tpu.analysis import lint_config_file
+    path = max(glob.glob(os.path.join(os.path.dirname(__file__), "example",
+                                      "*", "*.conf")), key=os.path.getsize)
+    result = lint_config_file(path)          # cold: fills registry caches
+    assert result.ok(), "largest example %s must lint clean" % path
+    t0 = time.perf_counter()
+    lint_config_file(path)
+    ms = (time.perf_counter() - t0) * 1e3
+    emit("lint_wall_ms", ms, "ms", config=os.path.relpath(
+        path, os.path.dirname(__file__)))
+
+
 def main() -> int:
     rc = 0
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
-               bench_moe, bench_decode, bench_serve):
+               bench_moe, bench_decode, bench_serve, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

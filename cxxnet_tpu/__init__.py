@@ -8,6 +8,8 @@ Public surface:
 - :mod:`cxxnet_tpu.wrapper` — the cxxnet.py-compatible Python API
 - :mod:`cxxnet_tpu.serve` — the continuous-batching inference server
   (``task = serve`` / ``Net.serve_*``; doc/serving.md)
+- :mod:`cxxnet_tpu.analysis` — cxn-lint static analysis: graph/config
+  lint + compiled-step audit (``task = lint`` / ``CXN_LINT``; doc/lint.md)
 """
 
 __version__ = "0.1.0"

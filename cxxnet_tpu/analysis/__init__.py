@@ -1,0 +1,35 @@
+"""cxn-lint: static analysis over both halves of the stack.
+
+**Pass 1** (:mod:`.graph_lint`) runs on the parsed netconfig IR with no
+devices: unknown/unconsumed config keys with did-you-mean, full
+shape/dtype inference with ``file:line`` attribution, dead-node and
+unreachable-layer detection, share-layer consistency, metric bindings,
+trainer scalar validation.
+
+**Pass 2** (:mod:`.step_audit`) inspects the lowered/compiled XLA
+programs of the trainer's four jitted steps and the serve engine's
+prefill/tick through the AOT API: donation aliasing, f64 promotion,
+host transfers, weak-typed inputs, collective counts vs a pinned
+budget. :mod:`.recompile` adds the runtime recompilation guard.
+
+Surfaces: ``task=lint`` (CLI), the ``CXN_LINT`` runtime hook (both at
+startup, findings through the profiler log), and ``tools/cxn_lint.py``
+for CI. Rule catalog and exit codes: doc/lint.md.
+"""
+
+from .findings import (Finding, LintError, LintReport, RULES,
+                       parse_suppressions)
+from .graph_lint import (GraphLintResult, lint_config_file,
+                         lint_config_text, lint_pairs)
+from .recompile import RecompileGuard, abstract_signature
+from .step_audit import (audit_jit, audit_net, audit_serve_engine,
+                         collective_counts, format_step_info,
+                         net_step_specs)
+
+__all__ = [
+    "Finding", "LintError", "LintReport", "RULES", "parse_suppressions",
+    "GraphLintResult", "lint_config_file", "lint_config_text", "lint_pairs",
+    "RecompileGuard", "abstract_signature",
+    "audit_jit", "audit_net", "audit_serve_engine", "collective_counts",
+    "format_step_info", "net_step_specs",
+]

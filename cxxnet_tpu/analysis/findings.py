@@ -1,0 +1,115 @@
+"""Lint findings: the shared result type of both analysis passes.
+
+Every rule has a stable id (``CXN1xx`` = graph/config lint, ``CXN2xx`` =
+compiled-step audit) so findings can be suppressed per-config with
+``lint_ignore = <rule_id>`` (comma-separated ids accepted, repeatable) and
+golden-tested by exact formatted output. The catalog below is the single
+source of truth doc/lint.md renders from.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+# rule_id -> (default severity, one-line description)
+RULES = {
+    # ---- pass 1: graph/config lint (no devices) ----
+    "CXN100": ("error", "config parse / graph structure error"),
+    "CXN101": ("error", "unknown config key (never read by any component)"),
+    "CXN102": ("error", "layer wiring / shape-inference error"),
+    "CXN103": ("error", "dead node or unreachable layer"),
+    "CXN104": ("error", "share-layer inconsistency (input shapes differ "
+                        "from the primary layer's)"),
+    "CXN105": ("error", "metric bound to an unknown label field or node"),
+    "CXN106": ("warning", "embedding input is a computed node, not an id "
+                          "entry (values will be cast, ids may corrupt)"),
+    "CXN107": ("error", "invalid trainer config value"),
+    # ---- pass 2: compiled-step audit (lower/compile, no execution) ----
+    "CXN201": ("error", "donated buffer not aliased in the compiled "
+                        "executable"),
+    "CXN202": ("error", "f32->f64 dtype promotion inside a jitted step"),
+    "CXN203": ("error", "host transfer / callback inside a jitted step"),
+    "CXN204": ("error", "collective count exceeds the pinned budget"),
+    "CXN205": ("error", "hot function re-traced more than the allowed "
+                        "number of times"),
+    "CXN206": ("warning", "weak-typed step input (re-specializes against "
+                          "strong-typed callers)"),
+}
+
+
+class LintError(RuntimeError):
+    """Raised by strict surfaces (CXN_LINT=2, the recompilation guard)."""
+
+
+@dataclass
+class Finding:
+    rule: str
+    message: str
+    path: str = ""            # config file ("" = not file-attributed)
+    line: int = 0             # 1-based; 0 = unknown
+    layer: str = ""           # layer name/key when the finding is per-layer
+    severity: str = ""        # default from RULES when empty
+
+    def __post_init__(self) -> None:
+        if not self.severity:
+            self.severity = RULES.get(self.rule, ("error",))[0]
+
+    def format(self) -> str:
+        loc = "%s:%d: " % (self.path or "<config>", self.line) if self.line \
+            else ("%s: " % self.path if self.path else "")
+        layer = " [layer %s]" % self.layer if self.layer else ""
+        return "%s%s %s:%s %s" % (loc, self.severity, self.rule, layer,
+                                  self.message)
+
+
+@dataclass
+class LintReport:
+    """Findings of one lint run. ``suppressed`` rule ids (from
+    ``lint_ignore``) are dropped at add() time but counted."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppress: frozenset = frozenset()
+    n_suppressed: int = 0
+
+    def add(self, finding: Finding) -> None:
+        if finding.rule in self.suppress:
+            self.n_suppressed += 1
+            return
+        self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        for f in findings:
+            self.add(f)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def exit_code(self) -> int:
+        return 0 if self.ok() else 1
+
+    def format(self) -> str:
+        out = [f.format() for f in self.findings]
+        tail = "%d error(s), %d warning(s)" % (len(self.errors()),
+                                               len(self.warnings()))
+        if self.n_suppressed:
+            tail += ", %d suppressed" % self.n_suppressed
+        out.append(tail)
+        return "\n".join(out)
+
+
+def parse_suppressions(pairs) -> frozenset:
+    """Collect ``lint_ignore = CXN103[,CXN106...]`` values from config
+    pairs (2- or 3-tuples)."""
+    ids = set()
+    for p in pairs:
+        if p[0] == "lint_ignore":
+            for rid in str(p[1]).replace(",", " ").split():
+                ids.add(rid.strip())
+    return frozenset(ids)

@@ -1,0 +1,373 @@
+"""Pass 1: graph/config lint — runs on the parsed IR with NO devices.
+
+Everything here is pre-flight: tokenize the config (keeping line numbers),
+replay the CLI's section routing and the netconfig scoping to know which
+component each ``key = value`` pair feeds, then
+
+- audit every key against the introspected consumer registry
+  (:mod:`.registry`) with did-you-mean suggestions          -> CXN101
+- build the :class:`~cxxnet_tpu.graph.NetGraph` and run full shape
+  inference over the layer zoo, attributing any wiring/shape error to the
+  exact layer declaration line                               -> CXN100/102
+- share-layer consistency (input shapes match the primary)   -> CXN104
+- dead-node / unreachable-layer detection (liveness walk
+  back from losses, metric bindings, and the output node)    -> CXN103
+- metric label-field / node bindings                         -> CXN105
+- embedding inputs that are computed nodes, not id entries   -> CXN106
+- trainer scalar validation (batch_size, remat_mode, ...)    -> CXN107
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..graph import NetGraph
+from ..utils.config import ConfigError, tokenize
+from . import registry
+from .findings import Finding, LintReport, parse_suppressions
+
+_SECTION_MARKERS = ("data", "eval", "pred")
+_LOSS_TYPES = frozenset(("softmax", "l2_loss", "multi_logistic",
+                         "lm_softmax", "pairtest"))
+
+
+@dataclass
+class _Scoped:
+    """One config pair with its resolved routing scope."""
+    name: str
+    val: str
+    line: int
+    scope: str          # "global" | "iterator:<t1+t2>" | "layer:<type>"
+    marker: bool = False  # structural marker (data/eval/pred/iter/netconfig)
+
+
+@dataclass
+class GraphLintResult:
+    report: LintReport
+    graph: Optional[NetGraph] = None
+    node_shapes: List[Optional[Tuple[int, int, int]]] = field(
+        default_factory=list)
+
+    def ok(self) -> bool:
+        return self.report.ok()
+
+
+def _layer_type_of_decl(val: str) -> str:
+    ltype = val.split(":", 1)[0]
+    if ltype.startswith("share"):
+        return "share"
+    if ltype.startswith("pairtest-"):
+        return "pairtest"
+    return ltype
+
+
+def _route_scopes(triples: Sequence[Tuple[str, str, int]], path: str,
+                  report: LintReport) -> List[_Scoped]:
+    """Replay CLI section routing + netconfig layer scoping over the
+    ordered pairs. Emits CXN100 for structural misuse it can see."""
+    # prescan: iterator types per section (keys may precede iter lines)
+    section_types: List[List[str]] = []
+    cur: Optional[List[str]] = None
+    for name, val, _ in triples:
+        if name in _SECTION_MARKERS:
+            cur = []
+            section_types.append(cur)
+        elif name == "iter" and val == "end":
+            cur = None
+        elif name == "iter" and cur is not None:
+            cur.append(val)
+    out: List[_Scoped] = []
+    sec_i = -1
+    in_section = False
+    layer_scope = ""          # layer type of the open layer block
+    for name, val, line in triples:
+        if name in _SECTION_MARKERS:
+            sec_i += 1
+            in_section = True
+            out.append(_Scoped(name, val, line, "global", marker=True))
+            continue
+        if name == "iter":
+            if val == "end":
+                if not in_section:
+                    report.add(Finding(
+                        "CXN100", "'iter = end' outside a data/eval/pred "
+                        "section", path=path, line=line))
+                in_section = False
+            elif not in_section:
+                report.add(Finding(
+                    "CXN100", "'iter = %s' outside a data/eval/pred "
+                    "section" % val, path=path, line=line))
+            elif val not in registry.iterator_type_names():
+                hint = difflib.get_close_matches(
+                    val, registry.iterator_type_names(), n=1, cutoff=0.6)
+                report.add(Finding(
+                    "CXN101", "unknown iterator type %r%s" % (
+                        val, " (did you mean %r?)" % hint[0] if hint else ""),
+                    path=path, line=line))
+            out.append(_Scoped(name, val, line, "global", marker=True))
+            continue
+        if in_section:
+            types = [t for t in section_types[sec_i]
+                     if t in registry.iterator_type_names()]
+            out.append(_Scoped(name, val, line,
+                               "iterator:%s" % "+".join(types)))
+            continue
+        if name == "netconfig":
+            layer_scope = ""
+            out.append(_Scoped(name, val, line, "global", marker=True))
+            continue
+        if name.startswith("layer["):
+            layer_scope = _layer_type_of_decl(val)
+            out.append(_Scoped(name, val, line, "global", marker=True))
+            continue
+        if layer_scope:
+            out.append(_Scoped(name, val, line, "layer:%s" % layer_scope))
+        else:
+            out.append(_Scoped(name, val, line, "global"))
+    return out
+
+
+def _audit_keys(scoped: List[_Scoped], path: str,
+                report: LintReport) -> None:
+    for s in scoped:
+        if s.marker or registry.known_in_scope(s.name, s.scope):
+            continue
+        hint = difflib.get_close_matches(
+            s.name, registry.candidates_in_scope(s.scope), n=1, cutoff=0.6)
+        where = ""
+        if s.scope.startswith("iterator:"):
+            where = " in a data section (iterators: %s)" \
+                % (s.scope[len("iterator:"):] or "none")
+        elif s.scope.startswith("layer:"):
+            where = " on a %r layer" % s.scope[len("layer:"):]
+        report.add(Finding(
+            "CXN101", "unknown config key %r%s — never read by any "
+            "component%s" % (
+                s.name, where,
+                "; did you mean %r?" % hint[0] if hint else ""),
+            path=path, line=s.line))
+
+
+def _trainer_triples(scoped: List[_Scoped]) -> List[Tuple[str, str, int]]:
+    """The pairs the CLI would hand the trainer (cli._trainer_cfg)."""
+    return [(s.name, s.val, s.line) for s in scoped
+            if not s.scope.startswith("iterator:")
+            and s.name not in _SECTION_MARKERS and s.name != "iter"]
+
+
+def _resolve_extract_node(g: NetGraph, node: str) -> Optional[int]:
+    if node.startswith("top[-") and node.endswith("]"):
+        try:
+            return g.num_nodes - int(node[len("top[-"):-1])
+        except ValueError:
+            return None
+    return g.node_map.get(node)
+
+
+def _lint_structure(g: NetGraph, decl_lines: List[int], scoped: List[_Scoped],
+                    path: str, report: LintReport) -> GraphLintResult:
+    """Layer construction + shape inference + share/dead/metric checks."""
+    from ..layers import create_layer
+
+    result = GraphLintResult(report, graph=g)
+    if not g.layers:
+        return result
+
+    def decl_line(i: int) -> int:
+        return decl_lines[i] if i < len(decl_lines) else 0
+
+    layers: List[Optional[object]] = []
+    for i, spec in enumerate(g.layers):
+        if spec.type == "share":
+            layers.append(layers[spec.primary])
+            continue
+        try:
+            layers.append(create_layer(spec, g.defcfg))
+        except Exception as e:          # pre-flight: never crash the lint
+            report.add(Finding("CXN102", "layer cannot be constructed: %s"
+                               % e, path=path, line=decl_line(i),
+                               layer=spec.key()))
+            layers.append(None)
+
+    # ---- shape inference (the trainer's walk, with line attribution) ----
+    if g.input_shape is None:
+        report.add(Finding("CXN100", "input_shape must be set", path=path))
+        return result
+    node_shapes: List[Optional[Tuple[int, int, int]]] = [None] * g.num_nodes
+    node_shapes[0] = g.input_shape
+    for i in range(g.extra_data_num):
+        if i < len(g.extra_shapes):
+            node_shapes[1 + i] = g.extra_shapes[i]
+    layer_in_shapes: List[Optional[list]] = [None] * len(g.layers)
+    for i, (spec, layer) in enumerate(zip(g.layers, layers)):
+        in_shapes = []
+        for ni in spec.inputs:
+            if node_shapes[ni] is None:
+                report.add(Finding(
+                    "CXN102", "node %r used before it is produced"
+                    % g.node_names[ni], path=path, line=decl_line(i),
+                    layer=spec.key()))
+                in_shapes = None
+                break
+            in_shapes.append(node_shapes[ni])
+        if in_shapes is None or layer is None:
+            continue
+        layer_in_shapes[i] = in_shapes
+        if spec.type == "share":
+            prim_in = layer_in_shapes[spec.primary]
+            if prim_in is not None and prim_in != in_shapes:
+                report.add(Finding(
+                    "CXN104", "share layer input shapes %s do not match "
+                    "the primary layer %r's input shapes %s — the shared "
+                    "weights cannot apply" % (
+                        in_shapes, g.layers[spec.primary].key(), prim_in),
+                    path=path, line=decl_line(i), layer=spec.key()))
+                continue
+        try:
+            out_shapes = layer.infer_shapes(in_shapes)
+        except Exception as e:
+            report.add(Finding(
+                "CXN102", "shape inference failed for input shapes %s: %s"
+                % (in_shapes, e), path=path, line=decl_line(i),
+                layer=spec.key()))
+            continue
+        for ni, s in zip(spec.outputs, out_shapes):
+            node_shapes[ni] = s
+        if spec.type == "embedding" and any(
+                ni > g.extra_data_num for ni in spec.inputs):
+            report.add(Finding(
+                "CXN106", "embedding input %s is a computed node, not a "
+                "data-entry node — token ids will pass through float "
+                "compute and may be corrupted" % (
+                    [g.node_names[ni] for ni in spec.inputs
+                     if ni > g.extra_data_num]),
+                path=path, line=decl_line(i), layer=spec.key()))
+    result.node_shapes = node_shapes
+
+    # ---- metric / extract bindings ----------------------------------
+    metric_nodes = set()
+    for s in scoped:
+        m = re.match(r"^metric(?:\[([^\],]+)(?:,([^\]]+))?\])?$", s.name)
+        if not m or s.scope.startswith("iterator:"):
+            continue
+        fld, node = m.group(1) or "label", m.group(2)
+        if fld not in g.label_name_map:
+            report.add(Finding(
+                "CXN105", "metric label field %r is not declared "
+                "(label_vec[...] registers fields; known: %s)"
+                % (fld, sorted(g.label_name_map)), path=path, line=s.line))
+        if node is not None:
+            if node not in g.node_map:
+                report.add(Finding(
+                    "CXN105", "metric bound to unknown node %r" % node,
+                    path=path, line=s.line))
+            else:
+                metric_nodes.add(g.node_map[node])
+    for s in scoped:
+        if s.name == "extract_node_name":
+            nid = _resolve_extract_node(g, s.val)
+            if nid is None or not (0 <= nid < g.num_nodes):
+                report.add(Finding(
+                    "CXN105", "extract_node_name %r names no node" % s.val,
+                    path=path, line=s.line))
+            else:
+                metric_nodes.add(nid)
+
+    # ---- dead nodes / unreachable layers ----------------------------
+    live_nodes = set(metric_nodes)
+    live_nodes.add(g.num_nodes - 1)        # default output/metric node
+    live_layers = set()
+    for i in range(len(g.layers) - 1, -1, -1):
+        spec, layer = g.layers[i], layers[i]
+        is_loss = (getattr(layer, "is_loss", False)
+                   or spec.type in _LOSS_TYPES)
+        if is_loss or any(o in live_nodes for o in spec.outputs):
+            live_layers.add(i)
+            live_nodes.update(spec.inputs)
+    consumed = set()
+    for spec in g.layers:
+        consumed.update(spec.inputs)
+    for i, spec in enumerate(g.layers):
+        if i not in live_layers:
+            report.add(Finding(
+                "CXN103", "unreachable layer: its outputs %s reach no "
+                "loss, metric, or output node — remove it or wire it in"
+                % ([g.node_names[o] for o in spec.outputs]),
+                path=path, line=decl_line(i), layer=spec.key()))
+            continue
+        for o in spec.outputs:
+            if o not in consumed and o not in live_nodes \
+                    and o != g.num_nodes - 1 and o not in spec.inputs:
+                report.add(Finding(
+                    "CXN103", "dead node %r: produced but never consumed "
+                    "by any layer, metric, or output"
+                    % g.node_names[o], path=path, line=decl_line(i),
+                    layer=spec.key()))
+    return result
+
+
+def _lint_trainer_values(g: NetGraph,
+                         triples: List[Tuple[str, str, int]], path: str,
+                         report: LintReport) -> None:
+    """Run the trainer's own scalar validation (batch_size, remat_mode,
+    dist_feed, metric names, ...) pre-flight — CXN107."""
+    from ..nnet.net import Net
+    net = Net([(n, v) for n, v, _ in triples])
+    net.graph = g
+    try:
+        net._parse_trainer_cfg()
+    except (ConfigError, ValueError) as e:
+        msg = str(e)
+        line = 0
+        for n, v, ln in triples:       # best-effort: the key or value the
+            if n in msg or (v and v in msg):   # message names
+                line = ln
+                break
+        report.add(Finding("CXN107", msg, path=path, line=line))
+
+
+def lint_pairs(triples: Sequence[Tuple[str, str, int]],
+               path: str = "<config>") -> GraphLintResult:
+    """Lint ordered (name, value, line) triples (pass 1, no devices)."""
+    report = LintReport(suppress=parse_suppressions(triples))
+    scoped = _route_scopes(list(triples), path, report)
+    _audit_keys(scoped, path, report)
+    trainer = _trainer_triples(scoped)
+    g = NetGraph()
+    try:
+        g.configure([(n, v) for n, v, _ in trainer],
+                    lines=[ln for _, _, ln in trainer])
+    except ConfigError as e:
+        report.add(Finding("CXN100", re.sub(r"^line \d+: ", "", str(e)),
+                           path=path, line=getattr(e, "line", 0) or 0))
+        return GraphLintResult(report, graph=None)
+    decl_lines = [s.line for s in scoped if s.name.startswith("layer[")
+                  and not s.scope.startswith("iterator:")]
+    result = _lint_structure(g, decl_lines, scoped, path, report)
+    _lint_trainer_values(g, trainer, path, report)
+    return result
+
+
+def lint_config_text(text: str, path: str = "<config>",
+                     extra_pairs: Optional[Sequence[Tuple[str, str]]] = None
+                     ) -> GraphLintResult:
+    try:
+        triples = tokenize(text, with_lines=True)
+    except ConfigError as e:
+        report = LintReport()
+        report.add(Finding("CXN100", re.sub(r"^line \d+: ", "", str(e)),
+                           path=path, line=getattr(e, "line", 0) or 0))
+        return GraphLintResult(report)
+    triples = list(triples) + [(n, v, 0) for n, v in (extra_pairs or [])]
+    return lint_pairs(triples, path=path)
+
+
+def lint_config_file(path: str,
+                     extra_pairs: Optional[Sequence[Tuple[str, str]]] = None
+                     ) -> GraphLintResult:
+    """Lint a config file; findings carry ``path:line`` locations."""
+    with open(path, "r") as f:
+        return lint_config_text(f.read(), path=path, extra_pairs=extra_pairs)

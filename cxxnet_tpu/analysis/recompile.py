@@ -1,0 +1,94 @@
+"""Recompilation guard: catch silent per-epoch re-specialization.
+
+A jitted step recompiles whenever the *abstract signature* of its inputs
+changes — a drifting batch shape, a weak-typed scalar, a new static arg.
+On a real run that is minutes of XLA time burned silently every epoch.
+The guard hashes the abstract signature of every call and errors (or
+warns) when a hot function has seen more than ``limit`` distinct
+signatures — one trace per signature is exactly what jit's cache does, so
+counting signatures counts compilations without touching jax internals.
+
+Wired into :class:`~cxxnet_tpu.nnet.net.Net` via the
+``lint_recompile_limit`` config key (0 = off) and enabled by default by
+the ``CXN_LINT`` runtime hook (doc/lint.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+from .findings import LintError
+
+
+def abstract_signature(args: tuple, kwargs: Dict[str, Any] = None) -> tuple:
+    """Hashable abstract signature of a call: (shape, dtype) per array
+    leaf, repr for static/python leaves, with the pytree structure."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype),
+                        bool(getattr(leaf, "weak_type", False))))
+        else:
+            sig.append(repr(leaf))
+    return (str(treedef), tuple(sig))
+
+
+class RecompileGuard:
+    """Transparent wrapper around a jitted callable that tracks distinct
+    abstract input signatures. Attribute access (``.lower``, ...)
+    delegates to the wrapped function, so guarded steps stay drop-in for
+    AOT inspection and the step audit."""
+
+    def __init__(self, fn: Callable, name: str, limit: int,
+                 strict: bool = True, log: Callable[[str], None] = None):
+        self._fn = fn
+        self._name = name
+        self._limit = max(1, int(limit))
+        self._strict = strict
+        self._log = log
+        self._seen: Dict[tuple, int] = {}       # signature -> first call no
+        self._calls = 0
+
+    @property
+    def signatures(self) -> Tuple[tuple, ...]:
+        return tuple(self._seen)
+
+    def __call__(self, *args, **kwargs):
+        self._calls += 1
+        sig = abstract_signature(args, kwargs)
+        if sig not in self._seen:
+            self._seen[sig] = self._calls
+            if len(self._seen) > self._limit:
+                msg = ("CXN205: hot function %r traced %d times (limit %d) "
+                       "— its abstract input signature keeps changing "
+                       "(call %d introduced %s); pad/bucket the offending "
+                       "input or raise lint_recompile_limit"
+                       % (self._name, len(self._seen), self._limit,
+                          self._calls, _diff_hint(self._seen)))
+                if self._strict:
+                    raise LintError(msg)
+                if self._log is not None:
+                    self._log(msg)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+
+def _diff_hint(seen: Dict[tuple, int]) -> str:
+    """Name the leaf positions whose (shape, dtype) differ across the two
+    most recent signatures — usually the one drifting input."""
+    sigs = list(seen)
+    if len(sigs) < 2:
+        return "a new signature"
+    (_, a), (_, b) = sigs[-2], sigs[-1]
+    if len(a) != len(b):
+        return "a different input structure"
+    diffs = [i for i, (x, y) in enumerate(zip(a, b)) if x != y]
+    parts = ["leaf %d: %s -> %s" % (i, a[i], b[i]) for i in diffs[:3]]
+    return "; ".join(parts) if parts else "a different input structure"

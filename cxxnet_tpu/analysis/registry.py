@@ -1,0 +1,255 @@
+"""Known-config-key registry: which component reads which ``key = value``.
+
+The tokenizer keeps the config as ordered pairs, so "this key is never read
+by any component" is decidable — IF we know every consumer's key set. Rather
+than a hand-maintained list that drifts, the registry *introspects* the
+consumers: every ``set_param``-style function in the codebase is an
+if/elif chain comparing the key against string literals, so a small AST walk
+over each consumer's source recovers its exact keys (``name == "lr"``,
+``name in ("a", "b")``, ``name.startswith("metric")``). Hand-curated entries
+cover only what AST cannot see (regex-matched structural keys in graph.py,
+the ``lr:``/``wmat:`` scoped-key grammars).
+
+Scopes (mirroring how the CLI routes pairs):
+- ``global``   — outside iterator sections / netconfig layer blocks; the
+  reference broadcasts these to every component, so the known set is the
+  union of everything.
+- ``iterator`` — inside a ``data``/``eval``/``pred`` section: the union of
+  keys of the iterator types the section's ``iter =`` lines name.
+- ``layer:<type>`` — after a ``layer[...]`` declaration: that layer type's
+  keys (common LayerParam + class-specific) plus updater keys (layer-scoped
+  optimizer overrides are legal: ``Net._init_updaters`` feeds ``spec.cfg``
+  to ``create_updater``).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import re
+import textwrap
+from typing import Iterable, Set, Tuple
+
+# variable names that hold the config key in consumer code
+_KEY_VARS = frozenset(("name", "k", "key"))
+
+# keys consumed by regex/structural matching the AST walk cannot see
+_GRAPH_EXACT = frozenset(("netconfig", "input_shape", "extra_data_num",
+                          "updater"))
+_GRAPH_PATTERNS = (
+    re.compile(r"^extra_data_shape\[\d+\]$"),
+    re.compile(r"^label_vec\[\d+,\d+\)$"),
+    re.compile(r"^layer\[[^\]]+\]$"),
+    re.compile(r"^metric(\[[^\]]+\])?$"),
+)
+
+# ``lr:<sub>`` / ``eta:<sub>`` schedule sub-keys (updaters/__init__.py
+# validates <sub> against these on a different variable, out of AST reach)
+_LR_SUBKEYS = frozenset(("schedule", "gamma", "alpha", "step", "factor",
+                         "minimum_lr", "start_epoch"))
+# per-tensor scope prefixes: ``wmat:lr = ...`` applies a valid updater key
+# to one weight tag (UpdaterParam.set_param strips the prefix)
+_TAG_PREFIXES = ("wmat:", "bias:")
+
+# keys introduced by the analysis subsystem itself
+_LINT_KEYS = frozenset(("lint_ignore",))
+
+
+def _keys_of_callable(fn) -> Tuple[Set[str], Set[str]]:
+    """(exact keys, prefix keys) a consumer function reads, via AST."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return set(), set()
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) \
+                and isinstance(node.left, ast.Name) \
+                and node.left.id in _KEY_VARS:
+            for comp in node.comparators:
+                if isinstance(comp, ast.Constant) \
+                        and isinstance(comp.value, str):
+                    exact.add(comp.value)
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    exact.update(e.value for e in comp.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "startswith" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in _KEY_VARS \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            prefixes.add(node.args[0].value)
+    return exact, prefixes
+
+
+def _keys_of_class(cls) -> Tuple[Set[str], Set[str]]:
+    """Union over every ``set_param`` in the MRO (subclasses delegate up)."""
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for klass in cls.__mro__:
+        fn = klass.__dict__.get("set_param")
+        if fn is not None:
+            e, p = _keys_of_callable(fn)
+            exact |= e
+            prefixes |= p
+    return exact, prefixes
+
+
+@functools.lru_cache(maxsize=None)
+def cli_keys() -> frozenset:
+    from ..cli import LearnTask
+    return frozenset(_keys_of_callable(LearnTask.set_param)[0])
+
+
+@functools.lru_cache(maxsize=None)
+def trainer_keys() -> Tuple[frozenset, frozenset]:
+    from ..nnet.net import Net
+    exact, prefixes = _keys_of_callable(Net._parse_trainer_cfg)
+    return frozenset(exact), frozenset(prefixes)
+
+
+@functools.lru_cache(maxsize=None)
+def updater_keys() -> Tuple[frozenset, frozenset]:
+    from ..updaters import UPDATER_REGISTRY, Updater, UpdaterParam
+    exact, prefixes = _keys_of_callable(UpdaterParam.set_param)
+    for cls in set(UPDATER_REGISTRY.values()) | {Updater}:
+        e, p = _keys_of_class(cls)
+        exact |= e
+        prefixes |= p
+    return frozenset(exact), frozenset(prefixes)
+
+
+@functools.lru_cache(maxsize=None)
+def layer_keys(layer_type: str) -> frozenset:
+    """Keys a layer-scoped block may set for one layer type: the layer
+    class's own keys (incl. LayerParam via the base Layer __init__ feeding
+    both) plus updater keys (per-layer optimizer overrides)."""
+    from ..layers import LAYER_REGISTRY
+    from ..layers.base import LayerParam
+    exact = set(_keys_of_callable(LayerParam.set_param)[0])
+    cls = LAYER_REGISTRY.get(layer_type)
+    if cls is not None:
+        exact |= _keys_of_class(cls)[0]
+    u_exact, _ = updater_keys()
+    return frozenset(exact | u_exact)
+
+
+@functools.lru_cache(maxsize=None)
+def all_layer_keys() -> frozenset:
+    from ..layers import LAYER_REGISTRY
+    keys: Set[str] = set()
+    for t in LAYER_REGISTRY:
+        keys |= layer_keys(t)
+    return frozenset(keys)
+
+
+def _iterator_chain_classes(iter_type: str) -> list:
+    """Instantiate one registered iterator factory (init() NOT called — no
+    I/O) and collect the classes of everything ``set_param`` reaches
+    through its attributes: proc iterators hold their base, and helpers
+    like the augmenter (AugmentIterator.aug) receive the same broadcast."""
+    from ..io.data import (_BASE_FACTORIES, _PROC_FACTORIES,  # noqa
+                           IIterator)
+    if iter_type in _BASE_FACTORIES:
+        obj = _BASE_FACTORIES[iter_type]()
+    elif iter_type in _PROC_FACTORIES:
+        obj = _PROC_FACTORIES[iter_type](IIterator())
+    else:
+        return []
+    seen, todo, out = set(), [obj], []
+    while todo:
+        cur = todo.pop()
+        if id(cur) in seen:
+            continue
+        seen.add(id(cur))
+        out.append(type(cur))
+        for v in vars(cur).values():
+            if callable(getattr(v, "set_param", None)):
+                todo.append(v)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def iterator_type_names() -> frozenset:
+    from ..io.data import _BASE_FACTORIES, _PROC_FACTORIES  # noqa
+    return frozenset(set(_BASE_FACTORIES) | set(_PROC_FACTORIES))
+
+
+@functools.lru_cache(maxsize=None)
+def iterator_keys(iter_types: Tuple[str, ...]) -> frozenset:
+    keys: Set[str] = set()
+    for t in iter_types:
+        for cls in _iterator_chain_classes(t):
+            keys |= _keys_of_class(cls)[0]
+    return frozenset(keys | {"iter"})
+
+
+@functools.lru_cache(maxsize=None)
+def all_iterator_keys() -> frozenset:
+    return iterator_keys(tuple(sorted(iterator_type_names())))
+
+
+@functools.lru_cache(maxsize=None)
+def global_keys() -> frozenset:
+    """Everything a global pair can legally reach: the CLI task, the
+    trainer, graph structure, every layer type (layer params broadcast),
+    every updater, every iterator (the CLI appends globals to each
+    section's chain), and the lint's own keys."""
+    t_exact, _ = trainer_keys()
+    u_exact, _ = updater_keys()
+    return frozenset(cli_keys() | t_exact | _GRAPH_EXACT | all_layer_keys()
+                     | u_exact | all_iterator_keys() | _LINT_KEYS)
+
+
+def _match_patterns(key: str) -> bool:
+    return any(p.match(key) for p in _GRAPH_PATTERNS)
+
+
+def _strip_tag_prefix(key: str) -> str:
+    for pref in _TAG_PREFIXES:
+        if key.startswith(pref):
+            return key[len(pref):]
+    return key
+
+
+def _updater_scoped_ok(key: str) -> bool:
+    """lr:/eta: schedule sub-keys and wmat:/bias: tag-scoped keys."""
+    key = _strip_tag_prefix(key)
+    for pref in ("lr:", "eta:"):
+        if key.startswith(pref):
+            return key[len(pref):] in _LR_SUBKEYS
+    u_exact, _ = updater_keys()
+    return key in u_exact
+
+
+def known_in_scope(key: str, scope: str) -> bool:
+    """Is ``key`` read by any component reachable from ``scope``
+    ("global", "iterator:<t1+t2>", "layer:<type>")?"""
+    if _match_patterns(key):
+        return True
+    if _updater_scoped_ok(key):
+        return True
+    if scope == "global":
+        return key in global_keys()
+    if scope.startswith("iterator:"):
+        types = tuple(t for t in scope[len("iterator:"):].split("+") if t)
+        return key in iterator_keys(types)
+    if scope.startswith("layer:"):
+        return key in layer_keys(scope[len("layer:"):])
+    return key in global_keys()
+
+
+def candidates_in_scope(scope: str) -> Iterable[str]:
+    """Key universe for did-you-mean suggestions in a scope."""
+    if scope.startswith("iterator:"):
+        types = tuple(t for t in scope[len("iterator:"):].split("+") if t)
+        return iterator_keys(types)
+    if scope.startswith("layer:"):
+        return layer_keys(scope[len("layer:"):])
+    return global_keys()

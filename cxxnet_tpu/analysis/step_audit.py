@@ -1,0 +1,272 @@
+"""Pass 2: compiled-step audit — inspect the lowered/compiled XLA steps.
+
+Works entirely through the AOT API (``fn.lower(...)`` on abstract
+ShapeDtypeStructs, then ``.compile()``): nothing executes, no batch is
+needed, and the audit sees exactly the programs the run will use.
+
+Checks per jitted step:
+
+- **donation** (CXN201): every ``donate_argnums`` buffer must survive to
+  an ``input_output_alias`` entry in the compiled executable. Drops are
+  attributed to the stage that lost them — jax's lowering (no unaliased
+  output of matching shape/dtype existed: the donated arg's
+  ``tf.aliasing_output`` attribute is missing from the StableHLO) or XLA
+  itself (the attribute was there but the executable kept no alias).
+- **dtype promotion** (CXN202): any ``f64`` tensor inside the step — the
+  classic silent 2x-slowdown when a python float sneaks in under
+  ``jax_enable_x64``.
+- **host transfers** (CXN203): callback/infeed/outfeed custom-calls
+  inside the step (a ``pure_callback`` in a layer turns every step into
+  a device->host round-trip).
+- **weak-typed inputs** (CXN206): python scalars passed as traced args —
+  each distinct strong/weak pairing re-specializes the step.
+- **collectives** (CXN204): all-gather/all-reduce/reduce-scatter/
+  all-to-all/collective-permute count in the optimized HLO, compared
+  against a pinned budget (``lint_collective_budget``); an unbudgeted
+  audit still reports the counts so a new collective shows up in logs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .findings import Finding, LintReport
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute")
+_ALIAS_RE = re.compile(r"\{\s*\d+\s*\}\s*:\s*\((\d+),")
+_HOST_MARKERS = ("callback", "infeed", "outfeed", "SendToHost",
+                 "RecvFromHost")
+# donation markers on @main arguments: jax emits tf.aliasing_output when
+# it resolves the alias itself at lowering, jax.buffer_donor when it
+# defers the pairing to XLA — either means "this donation survived jax"
+_DONOR_MARKS = ("jax.buffer_donor", "tf.aliasing_output")
+
+
+def _requested_donations(args: Sequence, donate_argnums: Sequence[int],
+                         static_argnums: Sequence[int]) -> int:
+    """How many array leaves the caller asked to donate."""
+    import jax
+    n = 0
+    for i in donate_argnums:
+        if i not in static_argnums and i < len(args):
+            n += len(jax.tree_util.tree_leaves(args[i]))
+    return n
+
+
+def _main_signature_donors(stable: str) -> Tuple[set, Dict[int, str]]:
+    """(donor param numbers, param -> tensor type) of the entry function.
+
+    Parsed from the ``@main`` signature only — inner stablehlo functions
+    have their own %argN numbering. XLA parameter numbering matches the
+    entry signature (jax prunes unused args BEFORE lowering, so the
+    signature already reflects the executable's parameter list)."""
+    sig = ""
+    for line in stable.splitlines():
+        if "@main(" in line:
+            sig = line
+            break
+    sig = sig.split(") -> ", 1)[0]
+    donors, types = set(), {}
+    parts = re.split(r"%arg(\d+)", sig)
+    for j in range(1, len(parts) - 1, 2):
+        pnum = int(parts[j])
+        seg = parts[j + 1]
+        m = re.match(r": tensor<([^>]*)>", seg)
+        types[pnum] = m.group(1) if m else "?"
+        if any(mark in seg for mark in _DONOR_MARKS):
+            donors.add(pnum)
+    return donors, types
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    return {op: len(re.findall(r"\b%s(?:-start)?\(" % op, hlo_text))
+            for op in _COLLECTIVE_OPS}
+
+
+def format_step_info(info: Dict) -> str:
+    """One human line per audited step's info dict (the single renderer —
+    task=lint, the CXN_LINT hook, and tools/cxn_lint.py all print this)."""
+    cc = ", ".join("%s=%d" % (k, v)
+                   for k, v in info["collectives"].items() if v)
+    return "%s: donated %d aliased %d collectives {%s}" % (
+        info["label"], info["donated"], info["aliased"], cc or "none")
+
+
+def audit_jit(fn, args: tuple, label: str,
+              donate_argnums: Sequence[int] = (),
+              static_argnums: Sequence[int] = (),
+              collective_budget: Optional[int] = None
+              ) -> Tuple[List[Finding], Dict]:
+    """Audit one jitted function AOT. Returns (findings, info) where info
+    carries the raw counts ({"collectives", "donated", "aliased"})."""
+    import warnings
+    findings: List[Finding] = []
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        lowered = fn.lower(*args)
+    stable = lowered.as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+
+    # ---- donation ---------------------------------------------------
+    requested = _requested_donations(args, donate_argnums, static_argnums)
+    donors, arg_types = _main_signature_donors(stable)
+    # jax-level drops announce themselves at lowering ("Some donated
+    # buffers were not usable: ShapedArray(...)"): no unaliased output of
+    # matching shape/dtype existed for that buffer
+    for w in wrec:
+        msg = str(w.message)
+        if "donated buffers were not usable" in msg:
+            findings.append(Finding(
+                "CXN201", "%s: donation dropped at lowering — %s (no "
+                "unaliased output of matching shape/dtype; the buffer "
+                "cannot be reused in place)" % (label, msg.split("\n")[0])))
+    header = hlo.splitlines()[0] if hlo else ""
+    alias_body = ""
+    if "input_output_alias={" in header:
+        start = header.index("input_output_alias={") + len(
+            "input_output_alias={")
+        depth, end = 1, start
+        while end < len(header) and depth:
+            depth += {"{": 1, "}": -1}.get(header[end], 0)
+            end += 1
+        alias_body = header[start:end]
+    compiled_aliased = {int(m) for m in _ALIAS_RE.findall(alias_body)}
+    for p in sorted(donors - compiled_aliased):
+        findings.append(Finding(
+            "CXN201", "%s: donated buffer (entry param %d, tensor<%s>) "
+            "survived lowering but the compiled executable keeps no "
+            "input_output_alias for it — XLA dropped the aliasing "
+            "(backend limitation or layout mismatch)"
+            % (label, p, arg_types.get(p, "?"))))
+
+    # ---- dtype promotion / host transfers / weak inputs -------------
+    if re.search(r"tensor<(?:\d+x)*f64>", stable):
+        findings.append(Finding(
+            "CXN202", "%s: f64 tensors inside the step — a python float "
+            "or numpy f64 promoted the computation (check jax_enable_x64 "
+            "and input dtypes)" % label))
+    host_hits = sorted({mk for mk in _HOST_MARKERS
+                        if mk in stable or mk in hlo})
+    if host_hits:
+        findings.append(Finding(
+            "CXN203", "%s: host transfer inside the step (%s) — every "
+            "step round-trips to the host" % (label, ", ".join(host_hits))))
+    import jax
+    weak = []
+    for i, a in enumerate(args):
+        if i in static_argnums:
+            continue
+        for leaf in jax.tree_util.tree_leaves(a):
+            if isinstance(leaf, (bool, int, float)) \
+                    or getattr(leaf, "weak_type", False):
+                weak.append(i)
+                break
+    for i in weak:
+        findings.append(Finding(
+            "CXN206", "%s: arg %d is weak-typed (python scalar) — pass "
+            "jnp.asarray(x, dtype) so strong/weak pairings don't "
+            "re-specialize the step" % (label, i)))
+
+    # ---- collectives ------------------------------------------------
+    counts = collective_counts(hlo)
+    total = sum(counts.values())
+    if collective_budget is not None and collective_budget >= 0 \
+            and total > collective_budget:
+        findings.append(Finding(
+            "CXN204", "%s: %d collectives per step (%s) exceeds the "
+            "pinned budget %d (lint_collective_budget)"
+            % (label, total,
+               ", ".join("%s=%d" % (k, v) for k, v in counts.items() if v),
+               collective_budget)))
+    info = {"label": label, "collectives": counts,
+            "donated": requested,
+            "aliased": len(donors & compiled_aliased)}
+    return findings, info
+
+
+def net_step_specs(net) -> List[Tuple[str, object, tuple, tuple, tuple]]:
+    """(label, fn, abstract args, donate_argnums, static_argnums) for the
+    four hot jitted steps of an initialized :class:`Net` — built from
+    ShapeDtypeStructs carrying the REAL mesh shardings (batch sharded on
+    the data axis, scalars replicated, gsum on its placement sharding),
+    so the audited executable is the partitioned program the run uses —
+    with its collectives — not an unpartitioned lookalike. No batch and
+    no execution is needed."""
+    import jax
+    from ..parallel.mesh import batch_sharding, replicated_sharding
+    g = net.graph
+    b = net.batch_size
+    bsh = batch_sharding(net.mesh)
+    rsh = replicated_sharding(net.mesh)
+
+    def SDS(shape, dtype, sharding=None):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    data = SDS((b,) + tuple(g.input_shape), np.float32, bsh)
+    extras = [SDS((b,) + tuple(s), np.float32, bsh) for s in g.extra_shapes]
+    label_w = max(hi for _, hi in g.label_range)
+    label = SDS((b, label_w), np.float32, bsh)
+    rng = SDS((2,), np.uint32, rsh)
+    epoch = SDS((), np.int32, rsh)
+    maccum = SDS(tuple(net._train_accum.shape), np.float32, rsh)
+    gsum_sh = net._opt_shardings if net.shard_optimizer >= 2 \
+        else net._param_shardings
+    gsum = {lk: {tag: SDS(tuple(w.shape), w.dtype, gsum_sh[lk][tag])
+                 for tag, w in tags.items()}
+            for lk, tags in net.params.items()}
+    out_node = (g.num_nodes - 1,)
+    return [
+        ("net_update", net._jit_update,
+         (net.params, net.opt_state, net.states, maccum, data, extras,
+          label, None, rng, epoch), (0, 1, 2, 3), ()),
+        ("net_accum", net._jit_accum,
+         (gsum, net.params, net.states, maccum, data, extras, label, None,
+          rng, epoch), (0, 3), ()),
+        ("net_apply", net._jit_apply,
+         (net.params, net.opt_state, gsum, epoch), (0, 1, 2), ()),
+        ("net_forward", net._jit_forward,
+         (net.params, net.states, data, extras, out_node), (), (4,)),
+    ]
+
+
+def audit_net(net, collective_budget: Optional[int] = None
+              ) -> Tuple[LintReport, List[Dict]]:
+    """Audit all four Net jit steps; returns (report, per-step info)."""
+    report = LintReport()
+    infos = []
+    budget = collective_budget
+    if budget is None:
+        budget = getattr(net, "lint_collective_budget", -1)
+        budget = budget if budget >= 0 else None
+    for label, fn, args, donate, static in net_step_specs(net):
+        findings, info = audit_jit(fn, args, label, donate_argnums=donate,
+                                   static_argnums=static,
+                                   collective_budget=budget)
+        report.extend(findings)
+        infos.append(info)
+    return report, infos
+
+
+def audit_serve_engine(engine, n_prompt: int = 8,
+                       collective_budget: Optional[int] = None,
+                       donate: Optional[bool] = None
+                       ) -> Tuple[LintReport, List[Dict]]:
+    """Audit the serve engine's prefill (one representative prompt
+    length) and the shared decode tick. ``donate`` overrides the
+    engine's backend-gated donation choice — tests pass True to pin the
+    aliasing contract even on the CPU mesh."""
+    report = LintReport()
+    infos = []
+    for label, fn, args, donate_nums in engine.lint_specs(
+            n_prompt=n_prompt, donate=donate):
+        findings, info = audit_jit(fn, args, label,
+                                   donate_argnums=donate_nums,
+                                   collective_budget=collective_budget)
+        report.extend(findings)
+        infos.append(info)
+    return report, infos

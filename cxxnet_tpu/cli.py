@@ -32,7 +32,7 @@ import numpy as np
 from .io import create_iterator
 from .nnet.net import Net
 from .utils import profiler
-from .utils.config import load_config, tokenize
+from .utils.config import ConfigError, load_config, tokenize
 
 Pairs = List[Tuple[str, str]]
 
@@ -78,6 +78,8 @@ class LearnTask:
         self.serve_timeout_ms = 0.0   # task=serve: per-request queue
         #                               deadline (0 = none)
         self.serve_eos = -1       # task=serve: stop token (-1 = none)
+        self.lint_compile = 0     # task=lint: also lower/compile-audit the
+        #                           jitted steps (pass 2; needs init_model)
         self.net: Optional[Net] = None
         self.itr_train = None
         self._train_feed = None   # DevicePrefetcher over itr_train (async)
@@ -152,6 +154,13 @@ class LearnTask:
             self.serve_timeout_ms = float(val)
         elif name == "serve_eos":
             self.serve_eos = int(val)
+        elif name == "name_pred":
+            # output path for pred/extract; the `pred = <path>` section
+            # marker also sets it (reference cxxnet_main.cpp honors both —
+            # the missing branch here was found by cxn-lint dogfooding)
+            self.name_pred = val
+        elif name == "lint_compile":
+            self.lint_compile = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -164,13 +173,44 @@ class LearnTask:
         if not os.path.exists(argv[0]):
             print("cannot open config file %r" % argv[0], file=sys.stderr)
             return 1
-        for name, val in load_config(argv[0]):
+        try:
+            pairs = load_config(argv[0])
+        except ConfigError:
+            # the config cannot even tokenize: report it through the lint
+            # formatter (file:line finding) instead of a traceback —
+            # whatever the task, this is the CXN100 surface
+            from .analysis import lint_config_file
+            print(lint_config_file(argv[0]).report.format(),
+                  file=sys.stderr)
+            return 1
+        for name, val in pairs:
             self.set_param(name, val)
+        cli_overrides = []
         for arg in argv[1:]:
             m = re.match(r"^([^=]+)=(.*)$", arg)
             if m:
                 self.set_param(m.group(1), m.group(2))
+                cli_overrides.append((m.group(1), m.group(2)))
+        if self.task == "lint":
+            # lint-and-exit: pass 1 needs no devices and no data files;
+            # `lint_compile = 1` additionally builds the net and audits
+            # the compiled steps (pass 2)
+            return self.task_lint(argv[0], cli_overrides)
+        lint_level = int(os.environ.get("CXN_LINT", "0") or 0)
+        if lint_level:
+            # runtime hook: graph/config lint before anything is built,
+            # and a default recompilation guard on the trainer's hot
+            # steps (explicit lint_recompile_limit in the config wins)
+            self._run_startup_lint(argv[0], cli_overrides, lint_level)
+            if not any(k == "lint_recompile_limit" for k, _ in self.cfg):
+                self.set_param("lint_recompile_limit", "8")
+                if lint_level < 2:
+                    # level 1 is log-only: a guard trip logs CXN205
+                    # through the profiler instead of aborting the run
+                    self.set_param("lint_recompile_strict", "0")
         self.init()
+        if lint_level and self.net is not None:
+            self._run_step_audit(lint_level)
         if not self.silent:
             print("initializing end, start working")
         if self.task in ("train", "finetune"):
@@ -186,6 +226,65 @@ class LearnTask:
         else:
             raise ValueError("unknown task %r" % self.task)
         return 0
+
+    # ------------------------------------------------------------- lint
+    def task_lint(self, config_path: str, overrides: Pairs) -> int:
+        """``task=lint``: run the static analyzer on the config and exit
+        nonzero on errors (doc/lint.md). Pass 1 (graph/config) always;
+        ``lint_compile = 1`` also builds the net and audits the compiled
+        steps (pass 2)."""
+        from .analysis import audit_net, format_step_info, lint_config_file
+        t0 = profiler.get_time()
+        result = lint_config_file(config_path, extra_pairs=overrides)
+        report = result.report
+        if self.lint_compile and report.ok():
+            self.net = Net(self._trainer_cfg())
+            self.net.init_model()
+            audit_report, infos = audit_net(self.net)
+            report.extend(audit_report.findings)
+            for info in infos:
+                print("lint: %s" % format_step_info(info))
+        print(report.format())
+        print("lint: %s in %.0f ms" % (
+            "clean" if report.ok() else "FAILED",
+            (profiler.get_time() - t0) * 1e3))
+        return report.exit_code()
+
+    def _run_startup_lint(self, config_path: str, overrides: Pairs,
+                          level: int) -> None:
+        """CXN_LINT pass 1 at startup: findings through the profiler log;
+        level >= 2 turns lint errors fatal."""
+        from .analysis import lint_config_file
+        t0 = profiler.get_time()
+        with profiler.annotate("cxn-lint/graph"):
+            report = lint_config_file(config_path,
+                                      extra_pairs=overrides).report
+        self._log_lint_report("graph lint", report, t0, level)
+
+    def _run_step_audit(self, level: int) -> None:
+        """CXN_LINT pass 2 after init: audit the compiled steps."""
+        from .analysis import audit_net, format_step_info
+        t0 = profiler.get_time()
+        with profiler.annotate("cxn-lint/steps"):
+            report, infos = audit_net(self.net)
+        for info in infos:
+            profiler.log("cxn-lint: %s" % format_step_info(info))
+        self._log_lint_report("step audit", report, t0, level)
+
+    @staticmethod
+    def _log_lint_report(what: str, report, t0: float, level: int) -> None:
+        from .analysis import LintError
+        for f in report.findings:
+            profiler.log("cxn-lint: %s" % f.format())
+        profiler.log("cxn-lint: %s %s (%d error(s), %d warning(s), "
+                     "%.0f ms)" % (what,
+                                   "clean" if report.ok() else "FAILED",
+                                   len(report.errors()),
+                                   len(report.warnings()),
+                                   (profiler.get_time() - t0) * 1e3))
+        if level >= 2 and not report.ok():
+            raise LintError("CXN_LINT=2: %s failed with %d error(s)"
+                            % (what, len(report.errors())))
 
     # ------------------------------------------------------------------
     def _trainer_cfg(self) -> Pairs:

@@ -142,7 +142,27 @@ class NetGraph:
             if sm is None:
                 raise ConfigError("shared layer must specify share[tag]: %r" % val)
             tag = sm.group(1)
+            # a share must name an EARLIER layer: on a fresh parse a later
+            # tag is simply absent from layer_name_map, but the name map of
+            # a loaded graph (from_structure_state) is fully populated, and
+            # the config prescan (_decl_order) knows where every tag will
+            # be declared — both cases get the explicit forward-reference
+            # error instead of a downstream KeyError/IndexError
+            if tag in self.layer_name_map \
+                    and self.layer_name_map[tag] >= layer_index:
+                raise ConfigError(
+                    "share[%s] is a forward reference: the primary layer "
+                    "%r is declared at position %d, after this share "
+                    "(position %d); share[...] must name an earlier layer"
+                    % (tag, tag, self.layer_name_map[tag], layer_index))
             if tag not in self.layer_name_map:
+                decl_at = getattr(self, "_decl_order", {}).get(tag)
+                if decl_at is not None:
+                    raise ConfigError(
+                        "share[%s] is a forward reference: the primary "
+                        "layer %r is declared at position %d, after this "
+                        "share (position %d); share[...] must name an "
+                        "earlier layer" % (tag, tag, decl_at, layer_index))
                 raise ConfigError("shared layer tag %r not defined before" % tag)
             return LayerSpec("share", "", inputs, outputs,
                              primary=self.layer_name_map[tag])
@@ -166,10 +186,13 @@ class NetGraph:
                 self.layer_name_map[lname] = layer_index
         return LayerSpec(ltype, lname, inputs, outputs, pairtest=pairtest)
 
-    def configure(self, cfg: Pairs) -> "NetGraph":
+    def configure(self, cfg: Pairs,
+                  lines: Optional[List[int]] = None) -> "NetGraph":
         """Parse an ordered (name, value) list. Re-configuring an already-built
         graph validates structural equality instead of rebuilding
-        (nnet_config.h:267-271)."""
+        (nnet_config.h:267-271). ``lines`` (optional, parallel to ``cfg``)
+        attributes any ConfigError to its source line — the lint path
+        tokenizes ``with_lines`` and passes them through."""
         first_time = not self.layers
         netcfg_mode = 0      # 0 global, 1 inside netconfig, 2 after a layer decl
         top_node = 0
@@ -178,7 +201,18 @@ class NetGraph:
             for lyr in self.layers:
                 lyr.cfg = []
             self.defcfg = []
+        # prescan: where each named layer WILL be declared, so a
+        # share[tag] naming a later layer fails as an explicit forward
+        # reference at its own line (not a downstream lookup error)
+        self._decl_order: Dict[str, int] = {}
+        decl_i = 0
         for name, val in cfg:
+            if name.startswith("layer["):
+                if ":" in val and not val.split(":", 1)[0].startswith("share"):
+                    self._decl_order.setdefault(val.split(":", 1)[1], decl_i)
+                decl_i += 1
+        for pair_i, (name, val) in enumerate(cfg):
+          try:
             if name == "extra_data_num":
                 self.extra_data_num = int(val)
                 for i in range(self.extra_data_num):
@@ -229,6 +263,10 @@ class NetGraph:
                 self.layers[layer_index - 1].cfg.append((name, val))
             else:
                 self.defcfg.append((name, val))
+          except ConfigError as e:
+            if lines is not None and getattr(e, "line", None) is None:
+                raise ConfigError(str(e), line=lines[pair_i]) from None
+            raise
         return self
 
     def _set_global(self, name: str, val: str) -> None:

@@ -87,6 +87,14 @@ class Net:
         self.precision = "float32"
         self.remat = 0
         self.remat_mode = "block"
+        # cxn-lint (analysis/): recompilation guard on the hot jitted
+        # steps (0 = off; N = max distinct abstract signatures per step),
+        # whether a trip raises (strict) or only logs (the CXN_LINT=1
+        # log-only hook sets 0), and the per-step collective budget the
+        # compiled-step audit pins (-1 = unbudgeted)
+        self.lint_recompile_limit = 0
+        self.lint_recompile_strict = 1
+        self.lint_collective_budget = -1
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
         for k, v in g.defcfg:
@@ -148,6 +156,12 @@ class Net:
                 self.dist_feed = v
             elif k == "precision":
                 self.precision = v
+            elif k == "lint_recompile_limit":
+                self.lint_recompile_limit = int(v)
+            elif k == "lint_recompile_strict":
+                self.lint_recompile_strict = int(v)
+            elif k == "lint_collective_budget":
+                self.lint_collective_budget = int(v)
             elif k.startswith("metric"):
                 self.train_metrics.configure(k, v)
                 self.eval_metrics.configure(k, v)
@@ -313,6 +327,25 @@ class Net:
         # node_ids is static: each distinct request set compiles a forward
         # that materializes only those nodes (XLA fuses the rest away)
         self._jit_forward = jax.jit(self._forward_eval, static_argnums=(4,))
+        if self.lint_recompile_limit > 0:
+            # cxn-lint recompilation guard: each hot step errors when its
+            # abstract input signature changes more than N times — the
+            # silent re-specialization the audit exists to catch. The
+            # guard is attribute-transparent, so .lower()/AOT inspection
+            # still reach the underlying jit.
+            from ..analysis.recompile import RecompileGuard
+            from ..utils import profiler
+            n = self.lint_recompile_limit
+            guard = partial(RecompileGuard,
+                            strict=bool(self.lint_recompile_strict),
+                            log=profiler.log)
+            self._jit_update = guard(self._jit_update, "net_update", n)
+            self._jit_accum = guard(self._jit_accum, "net_accum", n)
+            self._jit_apply = guard(self._jit_apply, "net_apply", n)
+            # the eval forward legitimately traces once per requested
+            # node set on top of shape changes; give it headroom
+            self._jit_forward = guard(self._jit_forward, "net_forward",
+                                      2 * n)
 
     # ------------------------------------------------------ initialization
     def init_model(self) -> None:

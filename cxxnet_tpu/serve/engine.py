@@ -198,6 +198,32 @@ class DecodeEngine:
         # mesh) ignores donation with a warning, so gate on the backend
         self._donate = jax.default_backend() != "cpu"
 
+    def lint_specs(self, n_prompt: int = 8, donate: Optional[bool] = None):
+        """(label, jitted fn, abstract args, donate_argnums) rows for the
+        compiled-step audit (analysis/step_audit.py): prefill at one
+        representative prompt length plus the shared tick. ``donate``
+        overrides the backend-gated donation choice so tests can pin the
+        aliasing contract on the CPU mesh too. Pure AOT — nothing runs,
+        nothing is allocated."""
+        from jax import ShapeDtypeStruct as SDS
+        don = self._donate if donate is None else bool(donate)
+        nums = (2, 3) if don else ()
+        f32, i32, key = jnp.float32, jnp.int32, SDS((2,), jnp.uint32)
+        b = self.slots
+        prefill_args = (self._blocks, self._outer, self.cache_k,
+                        self.cache_v, SDS((1, n_prompt), i32),
+                        SDS((), i32), key, SDS((), f32), SDS((), i32),
+                        SDS((), f32))
+        tick_args = (self._blocks, self._outer, self.cache_k, self.cache_v,
+                     SDS((b,), i32), SDS((b,), i32),
+                     SDS((b, 2), jnp.uint32), SDS((b,), i32),
+                     SDS((b,), f32), SDS((b,), i32), SDS((b,), f32))
+        return [
+            ("serve_prefill", _prefill_fn(self._cfg_key, n_prompt, don),
+             prefill_args, nums),
+            ("serve_tick", _tick_fn(self._cfg_key, don), tick_args, nums),
+        ]
+
     def cache_bytes(self) -> int:
         if self.cache_k is None:        # closed (metrics after shutdown)
             return 0

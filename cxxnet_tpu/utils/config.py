@@ -8,24 +8,42 @@ Capability parity with the reference tokenizer (/root/reference/src/utils/config
 - later occurrences of a key do NOT override earlier ones at the tokenizer
   level: the config is an ordered list of (name, value) pairs, because order
   is meaningful to the netconfig DSL (scoped layer/iterator blocks).
+
+Locations: every :class:`ConfigError` raised here carries the 1-based source
+line on ``.line`` (and in the message), and ``tokenize(text, with_lines=True)``
+returns ``(name, value, line)`` triples — the static analyzer
+(:mod:`cxxnet_tpu.analysis`) reports findings as ``file:line`` through these.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple, Union
+
+Pair = Tuple[str, str]
+PairLine = Tuple[str, str, int]
 
 
 class ConfigError(ValueError):
-    pass
+    """Config/graph error. ``line`` is the 1-based source line when the
+    failing pair's location is known (tokenizer errors always know it;
+    graph errors know it when the caller tokenized ``with_lines``)."""
+
+    def __init__(self, msg: str, line: Optional[int] = None) -> None:
+        self.line = line
+        super().__init__("line %d: %s" % (line, msg) if line else msg)
 
 
 _ESCAPES = {'"': '"', "'": "'", "\\": "\\", "n": "\n", "t": "\t", "r": "\r"}
 
 
-def tokenize(text: str) -> List[Tuple[str, str]]:
-    """Tokenize config text into an ordered list of (name, value) pairs."""
-    pairs: List[Tuple[str, str]] = []
+def tokenize(text: str, with_lines: bool = False
+             ) -> Union[List[Pair], List[PairLine]]:
+    """Tokenize config text into an ordered list of (name, value) pairs,
+    or (name, value, line) triples when ``with_lines`` is set (line is the
+    1-based line the key starts on)."""
+    pairs: list = []
     i, n = 0, len(text)
+    line = 1          # advanced incrementally per consumed span (O(n) total)
 
     def skip_ws_comments(i: int) -> int:
         while i < n:
@@ -39,7 +57,7 @@ def tokenize(text: str) -> List[Tuple[str, str]]:
                 break
         return i
 
-    def read_token(i: int, stop_at_eq: bool) -> Tuple[str, int]:
+    def read_token(i: int, stop_at_eq: bool, line0: int) -> Tuple[str, int]:
         c = text[i]
         if c in "\"'":
             quote = c
@@ -47,7 +65,8 @@ def tokenize(text: str) -> List[Tuple[str, str]]:
             out = []
             while True:
                 if i >= n:
-                    raise ConfigError("unterminated quoted string in config")
+                    raise ConfigError("unterminated quoted string in config "
+                                      "(opened here)", line=line0)
                 c = text[i]
                 if c == "\\" and i + 1 < n and text[i + 1] in _ESCAPES:
                     out.append(_ESCAPES[text[i + 1]])
@@ -68,27 +87,39 @@ def tokenize(text: str) -> List[Tuple[str, str]]:
             i += 1
         return "".join(out), i
 
+    def advance(j: int) -> int:
+        nonlocal line
+        line += text.count("\n", i, j)
+        return j
+
     while True:
-        i = skip_ws_comments(i)
+        i = advance(skip_ws_comments(i))
         if i >= n:
             break
-        name, i = read_token(i, stop_at_eq=True)
-        i = skip_ws_comments(i)
+        key_line = line
+        name, j = read_token(i, stop_at_eq=True, line0=line)
+        i = advance(j)
+        i = advance(skip_ws_comments(i))
         if i >= n or text[i] != "=":
-            raise ConfigError("expected '=' after config key %r" % name)
+            raise ConfigError("expected '=' after config key %r" % name,
+                              line=key_line)
         i += 1
-        i = skip_ws_comments(i)
+        i = advance(skip_ws_comments(i))
         if i >= n:
-            raise ConfigError("expected value after '%s ='" % name)
-        value, i = read_token(i, stop_at_eq=False)
-        pairs.append((name, value))
+            raise ConfigError("expected value after '%s ='" % name,
+                              line=key_line)
+        value, j = read_token(i, stop_at_eq=False, line0=line)
+        i = advance(j)
+        pairs.append((name, value, key_line) if with_lines
+                     else (name, value))
     return pairs
 
 
-def load_config(path: str) -> List[Tuple[str, str]]:
+def load_config(path: str, with_lines: bool = False
+                ) -> Union[List[Pair], List[PairLine]]:
     with open(path, "r") as f:
-        return tokenize(f.read())
+        return tokenize(f.read(), with_lines=with_lines)
 
 
-def iter_config(path: str) -> Iterator[Tuple[str, str]]:
+def iter_config(path: str) -> Iterator[Pair]:
     yield from load_config(path)

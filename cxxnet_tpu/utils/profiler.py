@@ -28,8 +28,8 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
-           "percentiles", "FEED_WAIT", "STEP_DISPATCH", "METRIC_SYNC",
-           "PREFILL", "DECODE_TICK", "QUEUE_WAIT"]
+           "percentiles", "log", "FEED_WAIT", "STEP_DISPATCH",
+           "METRIC_SYNC", "PREFILL", "DECODE_TICK", "QUEUE_WAIT", "LINT"]
 
 # canonical phase names of the training hot loop (round 6, async feed):
 #   FEED_WAIT     — blocked on the next batch (host iterator, or the async
@@ -55,9 +55,23 @@ QUEUE_WAIT = "queue_wait"
 _WAIT_PHASES = (FEED_WAIT, "data")
 
 
+# one-shot phase of the CXN_LINT startup audit (analysis/): recorded via
+# StepStats.record so linter cost stays visible next to the hot-loop phases
+LINT = "lint"
+
+
 def get_time() -> float:
     """High-resolution wall clock (GetTime, timer.h:16-31)."""
     return time.perf_counter()
+
+
+def log(msg: str) -> None:
+    """Timestamped host-side log line on stderr — the runtime channel for
+    subsystem findings (the CXN_LINT startup audit routes through here so
+    lint output lands in the same stream as the metric lines)."""
+    import sys
+    sys.stderr.write("[%s] %s\n" % (time.strftime("%H:%M:%S"), msg))
+    sys.stderr.flush()
 
 
 class StepStats:

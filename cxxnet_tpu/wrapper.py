@@ -104,7 +104,9 @@ class Net:
     """
 
     def __init__(self, dev: str = "", cfg: str = ""):
+        self._cfg_text = cfg            # kept for lint() line numbers
         self._net = _CoreNet(_cfg_pairs(cfg))
+        self._n_ctor_pairs = len(self._net.cfg)
         if dev:
             self._net.set_param("dev", dev)
 
@@ -223,6 +225,28 @@ class Net:
         if srv is not None:
             srv.shutdown(drain=drain)
             self._server = None
+
+    # -- static analysis (doc/lint.md) --------------------------------
+    def lint(self, compile: bool = False):
+        """Run cxn-lint over this net's config: pass 1 (graph/config,
+        no devices; line numbers refer to the constructor's ``cfg``
+        string, later ``set_param`` pairs lint as line-less) and — with
+        ``compile=True`` on an initialized net — pass 2, the
+        compiled-step audit (donation aliasing, dtype promotion, host
+        transfers, collectives). Returns the
+        :class:`~cxxnet_tpu.analysis.LintReport`."""
+        from .analysis import audit_net, lint_config_text
+        extra = [(k, v) for k, v in self._net.cfg[self._n_ctor_pairs:]]
+        report = lint_config_text(self._cfg_text, path="<cfg>",
+                                  extra_pairs=extra).report
+        if compile:
+            if not self._net._initialized:
+                raise RuntimeError("lint(compile=True) needs an "
+                                   "initialized net (call init_model or "
+                                   "load_model first)")
+            step_report, _ = audit_net(self._net)
+            report.extend(step_report.findings)
+        return report
 
     # -- weight surgery -----------------------------------------------
     def set_weight(self, weight: Array, layer_name: str, tag: str) -> None:

@@ -45,3 +45,23 @@ def test_missing_equals():
 def test_unterminated_quote():
     with pytest.raises(ConfigError):
         tokenize("a = 'oops")
+
+
+def test_with_lines_triples():
+    triples = tokenize("a = 1\n# comment\nb = 2\np = 'x\ny'\nc = 3",
+                       with_lines=True)
+    assert triples == [("a", "1", 1), ("b", "2", 3), ("p", "x\ny", 4),
+                       ("c", "3", 6)]
+
+
+def test_unterminated_quote_carries_line():
+    with pytest.raises(ConfigError) as ei:
+        tokenize("a = 1\nb = 2\npath = 'oops")
+    assert ei.value.line == 3
+    assert "line 3" in str(ei.value)
+
+
+def test_missing_equals_carries_line():
+    with pytest.raises(ConfigError) as ei:
+        tokenize("a = 1\n\nnovalue\n")
+    assert ei.value.line == 3

@@ -142,3 +142,53 @@ input_shape = 1,1,8
 """)
     assert g.layers[0].type == "pairtest"
     assert g.layers[0].pairtest == ("fullc", "fullc")
+
+
+def test_share_forward_reference_rejected():
+    """share[tag] naming a LATER layer must fail with an explicit
+    forward-reference error, not a downstream lookup error."""
+    cfg = tokenize("""
+netconfig=start
+layer[+1:a] = fullc:a
+  nhidden = 4
+layer[+1] = share[zz]
+layer[+1:zz] = fullc:zz
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,8
+""")
+    with pytest.raises(ConfigError, match="forward reference"):
+        NetGraph().configure(cfg)
+
+
+def test_share_forward_reference_rejected_on_loaded_graph():
+    """Re-configuring a loaded graph (fully populated name map) with a
+    forward share also gets the explicit error."""
+    base = """
+netconfig=start
+layer[+1:a] = fullc:a
+  nhidden = 4
+layer[+1:zz] = fullc:zz
+  nhidden = 4
+netconfig=end
+input_shape = 1,1,8
+"""
+    g = NetGraph().configure(tokenize(base))
+    g2 = NetGraph.from_structure_state(g.structure_state())
+    bad = base.replace("layer[+1:a] = fullc:a", "layer[+1:a] = share[zz]")
+    with pytest.raises(ConfigError, match="forward reference"):
+        g2.configure(tokenize(bad))
+
+
+def test_configure_attributes_error_lines():
+    triples = tokenize("""
+netconfig=start
+layer[+1:a] = fullc:a
+layer[+1] = bogustype
+netconfig=end
+input_shape = 1,1,8
+""", with_lines=True)
+    with pytest.raises(ConfigError) as ei:
+        NetGraph().configure([(n, v) for n, v, _ in triples],
+                             lines=[ln for _, _, ln in triples])
+    assert ei.value.line == 4

@@ -1,0 +1,420 @@
+"""cxn-lint: graph/config lint (pass 1), compiled-step audit (pass 2),
+recompilation guard, and the CLI/tools surfaces (doc/lint.md)."""
+
+import glob
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis import (LintError, RULES, audit_jit, audit_net,
+                                 audit_serve_engine, lint_config_file,
+                                 lint_config_text)
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.nnet.net import Net
+from cxxnet_tpu.utils.config import tokenize
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NET_CFG = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+layer[+1] = relu
+layer[+1:fc2] = fullc:fc2
+  nhidden = 4
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 16
+"""
+
+
+def _net(extra=""):
+    net = Net(tokenize(NET_CFG + extra))
+    net.init_model()
+    return net
+
+
+# ---------------------------------------------------------------- pass 1
+@pytest.mark.parametrize("conf", sorted(
+    glob.glob(os.path.join(_REPO, "example", "*", "*.conf"))),
+    ids=lambda p: os.path.relpath(p, _REPO))
+def test_all_example_configs_lint_clean(conf):
+    """Every shipped example config must produce zero findings — the
+    linter's no-false-positives contract on real configs."""
+    result = lint_config_file(conf)
+    assert result.report.ok() and not result.report.warnings(), \
+        "\n" + result.report.format()
+
+
+def test_typo_key_did_you_mean():
+    r = lint_config_text(NET_CFG + "bacth_size = 32\n", path="t.conf")
+    f = [x for x in r.report.findings if x.rule == "CXN101"]
+    assert len(f) == 1 and "bacth_size" in f[0].message
+    assert "did you mean 'batch_size'" in f[0].message
+    assert f[0].path == "t.conf" and f[0].line == 12
+    assert not r.report.ok()
+
+
+def test_typo_key_in_iterator_section_scoped():
+    cfg = ("data = train\niter = mnist\n  path_img = x\n  shufle = 1\n"
+           "iter = end\n" + NET_CFG)
+    r = lint_config_text(cfg)
+    f = [x for x in r.report.findings if x.rule == "CXN101"]
+    assert len(f) == 1 and "shufle" in f[0].message and f[0].line == 4
+    assert "did you mean 'shuffle'" in f[0].message
+
+
+def test_typo_layer_scoped_key():
+    cfg = NET_CFG.replace("  nhidden = 16", "  nhiden = 16")
+    r = lint_config_text(cfg)
+    msgs = [x.message for x in r.report.findings if x.rule == "CXN101"]
+    assert any("nhiden" in m and "'fullc' layer" in m
+               and "did you mean 'nhidden'" in m for m in msgs)
+
+
+def test_dead_node_and_unreachable_layer():
+    cfg = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+layer[fc1->stub] = fullc:deadfc
+  nhidden = 3
+layer[fc1->out] = fullc:fc2
+  nhidden = 4
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 8
+"""
+    r = lint_config_text(cfg, path="dead.conf")
+    f = [x for x in r.report.findings if x.rule == "CXN103"]
+    assert len(f) == 1 and f[0].layer == "deadfc" and f[0].line == 5
+    assert "unreachable layer" in f[0].message
+
+
+def test_shape_mismatch_reports_layer_and_line():
+    cfg = """
+netconfig = start
+layer[0->a] = max_pooling
+  kernel_size = 4
+  stride = 4
+layer[a->b] = conv:cv1
+  kernel_size = 5
+  nchannel = 8
+layer[+0] = softmax
+netconfig = end
+input_shape = 3,8,8
+batch_size = 8
+"""
+    r = lint_config_text(cfg, path="shape.conf")
+    f = [x for x in r.report.findings if x.rule == "CXN102"]
+    assert f and f[0].layer == "cv1" and f[0].line == 6
+    assert not r.report.ok()
+
+
+def test_share_shape_mismatch():
+    cfg = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+layer[fc1->h2] = fullc:fc2
+  nhidden = 6
+layer[h2->h3] = share[fc1]
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 8
+"""
+    r = lint_config_text(cfg)
+    f = [x for x in r.report.findings if x.rule == "CXN104"]
+    assert f and "do not match the primary layer" in f[0].message
+
+
+def test_metric_binding_unknown_field_and_node():
+    cfg = NET_CFG + "metric[nolabel] = error\nmetric[label,ghost] = error\n"
+    r = lint_config_text(cfg)
+    f = [x for x in r.report.findings if x.rule == "CXN105"]
+    assert len(f) == 2
+    assert any("nolabel" in x.message for x in f)
+    assert any("ghost" in x.message for x in f)
+
+
+def test_trainer_value_validation():
+    r = lint_config_text(NET_CFG + "dist_feed = bogus\n")
+    f = [x for x in r.report.findings if x.rule == "CXN107"]
+    assert f and "dist_feed" in f[0].message and f[0].line == 12
+
+
+def test_unknown_metric_name_caught():
+    r = lint_config_text(NET_CFG + "metric = acuracy\n")
+    f = [x for x in r.report.findings if x.rule == "CXN107"]
+    assert f and "acuracy" in f[0].message
+
+
+def test_lint_ignore_suppresses_rule():
+    cfg = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 8
+layer[fc1->stub] = fullc:deadfc
+  nhidden = 3
+layer[fc1->out] = fullc:fc2
+  nhidden = 4
+layer[+0] = softmax
+netconfig = end
+input_shape = 1,1,8
+batch_size = 8
+lint_ignore = CXN103
+"""
+    r = lint_config_text(cfg)
+    assert r.report.ok(), r.report.format()
+    assert r.report.n_suppressed == 1
+
+
+def test_unterminated_quote_carries_line():
+    r = lint_config_text("a = 1\nb = 2\npath = \"unterminated\n",
+                         path="q.conf")
+    f = r.report.findings
+    assert len(f) == 1 and f[0].rule == "CXN100" and f[0].line == 3
+    assert "unterminated" in f[0].message
+
+
+def test_rule_catalog_covers_all_emitted_rules():
+    for rid, (sev, _) in RULES.items():
+        assert sev in ("error", "warning")
+        assert rid.startswith("CXN")
+
+
+# ---------------------------------------------------------------- pass 2
+def test_donation_audit_all_four_net_steps_aliased_on_cpu():
+    """Regression pin: every donated buffer of all four Net jit steps
+    keeps its input_output_alias in the CPU executable."""
+    net = _net("update_period = 2\n")
+    report, infos = audit_net(net)
+    assert report.ok(), report.format()
+    by = {i["label"]: i for i in infos}
+    for label in ("net_update", "net_accum", "net_apply"):
+        assert by[label]["donated"] > 0, label
+        assert by[label]["aliased"] == by[label]["donated"], (label, by)
+    assert by["net_forward"]["donated"] == 0
+
+
+def test_dropped_donation_is_reported_with_reason():
+    import jax
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as SDS
+    f = jax.jit(lambda a, b: (a * b).sum(), donate_argnums=(0,))
+    findings, info = audit_jit(
+        f, (SDS((4, 4), jnp.float32), SDS((4, 4), jnp.float32)), "toy",
+        donate_argnums=(0,))
+    assert len(findings) == 1 and findings[0].rule == "CXN201"
+    assert "dropped at lowering" in findings[0].message
+    assert info["donated"] == 1 and info["aliased"] == 0
+
+
+def test_collective_budget():
+    net = _net()
+    report, _ = audit_net(net, collective_budget=0)
+    # pure-DP on the 8-device CPU mesh: the grad all-reduce must show up
+    over = [f for f in report.findings if f.rule == "CXN204"]
+    assert over, "expected the data-parallel all-reduce to break budget 0"
+    report2, infos = audit_net(net, collective_budget=64)
+    assert not [f for f in report2.findings if f.rule == "CXN204"]
+    assert any(sum(i["collectives"].values()) > 0 for i in infos)
+
+
+def test_serve_engine_audit_donation():
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.serve.engine import DecodeEngine
+    cfg = GPTConfig(vocab_size=64, feat=32, n_head=2, n_layer=2, seq_len=32)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(cfg, params, slots=4)
+    report, infos = audit_serve_engine(eng, n_prompt=4, donate=True)
+    assert report.ok(), report.format()
+    for info in infos:       # both KV caches aliased in prefill AND tick
+        assert info["donated"] == 2 and info["aliased"] == 2, info
+
+
+# --------------------------------------------------- recompilation guard
+def test_recompile_guard_trips_on_varied_static_shape():
+    net = _net("lint_recompile_limit = 1\n")
+    rs = np.random.RandomState(0)
+
+    def batch(b):
+        return DataBatch(rs.rand(b, 1, 1, 8).astype(np.float32),
+                         np.zeros((b, 1), np.float32))
+
+    net.update(batch(16))
+    net.update(batch(16))           # same signature: no trip
+    assert len(net._jit_update.signatures) == 1
+    net.batch_size = 8              # deliberately vary the static shape
+    with pytest.raises(LintError, match="CXN205.*net_update"):
+        net.update(batch(8))
+
+
+def test_recompile_guard_off_by_default():
+    net = _net()
+    assert not hasattr(net._jit_update, "signatures")
+
+
+# ------------------------------------------------------------- surfaces
+BAD_CONF = """
+netconfig = start
+layer[+1:fc1] = fullc:fc1
+  nhidden = 16
+layer[fc1->stub] = fullc:deadfc
+  nhidden = 3
+layer[fc1->a] = max_pooling
+  kernel_size = 4
+  stride = 4
+layer[a->b] = conv:cv1
+  kernel_size = 5
+  nchannel = 8
+layer[+0] = softmax
+netconfig = end
+input_shape = 3,8,8
+bacth_size = 100
+batch_size = 8
+"""
+
+
+def test_cli_task_lint_exits_nonzero_and_reports_all(tmp_path, capfd):
+    from cxxnet_tpu.cli import main
+    conf = tmp_path / "bad.conf"
+    conf.write_text(BAD_CONF)
+    rc = main([str(conf), "task=lint"])
+    out = capfd.readouterr().out
+    assert rc == 1
+    # the misspelled key, the dead layer, and the shape mismatch all
+    # report with file:line
+    assert "%s:16: error CXN101" % conf in out and "bacth_size" in out
+    assert "%s:5: error CXN103" % conf in out
+    assert "%s:10: error CXN102" % conf in out
+
+
+def test_cli_task_lint_clean_config(tmp_path, capfd):
+    from cxxnet_tpu.cli import main
+    conf = tmp_path / "ok.conf"
+    conf.write_text(NET_CFG)
+    assert main([str(conf), "task=lint"]) == 0
+    assert "clean" in capfd.readouterr().out
+
+
+def test_cli_task_lint_compile_audit(tmp_path, capfd):
+    from cxxnet_tpu.cli import main
+    conf = tmp_path / "ok.conf"
+    conf.write_text(NET_CFG)
+    assert main([str(conf), "task=lint", "lint_compile=1"]) == 0
+    out = capfd.readouterr().out
+    assert "net_update" in out and "donated" in out
+
+
+def test_tools_cxn_lint_all_examples():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cxn_lint", os.path.join(_REPO, "tools", "cxn_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--all-examples", "--quiet"]) == 0
+
+
+def test_wrapper_lint_surface():
+    from cxxnet_tpu import wrapper
+    net = wrapper.Net(cfg=NET_CFG + "bacth_size = 1\n")
+    report = net.lint()
+    assert any(f.rule == "CXN101" for f in report.findings)
+    ok = wrapper.Net(cfg=NET_CFG)
+    ok.init_model()
+    report = ok.lint(compile=True)
+    assert report.ok(), report.format()
+
+
+# --------------------------------------------------- CXN_LINT runtime hook
+def _write_idx(tmp, images, labels):
+    pi, pl = str(tmp / "img.gz"), str(tmp / "lab.gz")
+    n, r, c = images.shape
+    with gzip.open(pi, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, n, r, c))
+        f.write(images.tobytes())
+    with gzip.open(pl, "wb") as f:
+        f.write(struct.pack(">ii", 2049, labels.shape[0]))
+        f.write(labels.astype(np.uint8).tobytes())
+    return pi, pl
+
+
+TRAIN_CONF = """
+data = train
+iter = mnist
+    path_img = "{img}"
+    path_label = "{lab}"
+iter = end
+""" + NET_CFG + """
+input_shape = 1,1,64
+num_round = 1
+save_model = 0
+silent = 1
+dev = cpu
+"""
+
+
+def test_cxn_lint_runtime_hook(tmp_path, capfd, monkeypatch):
+    """CXN_LINT=1 runs both passes at startup, logs findings through the
+    profiler, and installs the recompilation guard — the run itself
+    completes."""
+    from cxxnet_tpu.cli import LearnTask
+    rs = np.random.RandomState(0)
+    img, lab = _write_idx(tmp_path,
+                          (rs.rand(64, 8, 8) * 255).astype(np.uint8),
+                          rs.randint(0, 4, 64))
+    conf = tmp_path / "t.conf"
+    conf.write_text(TRAIN_CONF.format(img=img, lab=lab))
+    monkeypatch.setenv("CXN_LINT", "1")
+    task = LearnTask()
+    assert task.run([str(conf)]) == 0
+    err = capfd.readouterr().err
+    assert "cxn-lint: graph lint clean" in err
+    assert "cxn-lint: step audit clean" in err
+    assert "net_update: donated" in err
+    # the hook installed the default recompilation guard
+    assert hasattr(task.net._jit_update, "signatures")
+
+
+def test_cxn_lint_strict_fails_on_errors(tmp_path, capfd, monkeypatch):
+    from cxxnet_tpu.cli import LearnTask
+    conf = tmp_path / "bad.conf"
+    conf.write_text(BAD_CONF)
+    monkeypatch.setenv("CXN_LINT", "2")
+    with pytest.raises(LintError, match="graph lint failed"):
+        LearnTask().run([str(conf)])
+
+
+def test_recompile_guard_non_strict_logs_and_continues(capfd):
+    net = _net("lint_recompile_limit = 1\nlint_recompile_strict = 0\n")
+    rs = np.random.RandomState(0)
+
+    def batch(b):
+        return DataBatch(rs.rand(b, 1, 1, 8).astype(np.float32),
+                         np.zeros((b, 1), np.float32))
+
+    net.update(batch(16))
+    net.batch_size = 8
+    net.update(batch(8))            # trips, but only logs
+    assert "CXN205" in capfd.readouterr().err
+    assert len(net._jit_update.signatures) == 2
+
+
+def test_cli_reports_tokenizer_error_as_finding(tmp_path, capfd):
+    """A config that cannot even tokenize must exit with a formatted
+    CXN100 file:line finding, not a traceback — whatever the task."""
+    from cxxnet_tpu.cli import main
+    conf = tmp_path / "broken.conf"
+    conf.write_text("a = 1\npath = 'unterminated\n")
+    assert main([str(conf), "task=lint"]) == 1
+    err = capfd.readouterr().err
+    assert "%s:2: error CXN100" % conf in err
+    assert "unterminated" in err

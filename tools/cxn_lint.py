@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""cxn-lint CI driver: lint config files (and optionally their compiled
+steps) from the command line.
+
+    python tools/cxn_lint.py <config> [<config> ...] [k=v ...]
+    python tools/cxn_lint.py --all-examples
+    python tools/cxn_lint.py --compile <config>
+
+``--all-examples`` lints every ``example/**/*.conf`` (pass 1 only — no
+data files or devices are needed, so this is the fast tier-1 CI check;
+tests/test_lint.py wires it into pytest). ``--compile`` additionally
+builds the net (init_model on the default backend) and audits the
+compiled steps (pass 2: donation aliasing, dtype promotion, host
+transfers, collectives). ``k=v`` args are CLI-style overrides linted as
+line-less pairs.
+
+Exit codes: 0 clean (warnings allowed), 1 lint errors, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
+    from cxxnet_tpu.analysis import audit_net, lint_config_file
+    result = lint_config_file(path, extra_pairs=overrides)
+    report = result.report
+    if do_compile and report.ok():
+        # reuse the CLI's section routing for the trainer config
+        from cxxnet_tpu.cli import LearnTask
+        from cxxnet_tpu.nnet.net import Net
+        from cxxnet_tpu.utils.config import load_config
+        task = LearnTask()
+        for n, v in load_config(path):
+            task.set_param(n, v)
+        for n, v in overrides:
+            task.set_param(n, v)
+        net = Net(task._trainer_cfg())
+        net.init_model()
+        audit_report, infos = audit_net(net)
+        report.extend(audit_report.findings)
+        if verbose:
+            from cxxnet_tpu.analysis import format_step_info
+            for info in infos:
+                print("  %s" % format_step_info(info))
+    if verbose or not report.ok():
+        print("== %s" % path)
+        print(report.format())
+    return report.exit_code()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    do_compile = "--compile" in argv
+    all_examples = "--all-examples" in argv
+    quiet = "--quiet" in argv
+    argv = [a for a in argv
+            if a not in ("--compile", "--all-examples", "--quiet")]
+    overrides = []
+    paths = []
+    for a in argv:
+        if "=" in a and not os.path.exists(a):
+            k, v = a.split("=", 1)
+            overrides.append((k, v))
+        else:
+            paths.append(a)
+    if all_examples:
+        paths += sorted(glob.glob(os.path.join(_REPO, "example", "*",
+                                               "*.conf")))
+    if not paths:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    rc = 0
+    for p in paths:
+        if not os.path.exists(p):
+            print("cannot open config %r" % p, file=sys.stderr)
+            return 2
+        rc |= lint_one(p, overrides, do_compile=do_compile,
+                       verbose=not quiet)
+    if not quiet:
+        print("cxn-lint: %d config(s), %s" % (len(paths),
+                                              "clean" if rc == 0
+                                              else "FAILED"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
