@@ -257,9 +257,12 @@ def audit_serve_engine(engine, n_prompt: int = 8,
                        donate: Optional[bool] = None
                        ) -> Tuple[LintReport, List[Dict]]:
     """Audit the serve engine's prefill (one representative prompt
-    length) and the shared decode tick. ``donate`` overrides the
-    engine's backend-gated donation choice — tests pass True to pin the
-    aliasing contract even on the CPU mesh."""
+    length), the chunk-prefill step (when the engine runs chunked —
+    its donation aliasing matters double: the chunk program runs
+    ceil(n/chunk) times per admit), and the shared decode tick.
+    ``donate`` overrides the engine's backend-gated donation choice —
+    tests pass True to pin the aliasing contract even on the CPU
+    mesh."""
     report = LintReport()
     infos = []
     for label, fn, args, donate_nums in engine.lint_specs(

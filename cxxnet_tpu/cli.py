@@ -78,6 +78,13 @@ class LearnTask:
         self.serve_timeout_ms = 0.0   # task=serve: per-request queue
         #                               deadline (0 = none)
         self.serve_eos = -1       # task=serve: stop token (-1 = none)
+        self.serve_prefill_chunk = 64   # task=serve: chunked-prefill unit
+        #                                 (tokens/jitted step; 0 = legacy
+        #                                 whole-prompt prefill)
+        self.serve_prefill_budget = 1   # task=serve: max prefill chunks
+        #                                 interleaved per decode tick
+        self.serve_prefix_mb = 32.0     # task=serve: shared-prefix KV
+        #                                 cache budget in MiB (0 = off)
         self.lint_compile = 0     # task=lint: also lower/compile-audit the
         #                           jitted steps (pass 2; needs init_model)
         self.net: Optional[Net] = None
@@ -154,6 +161,12 @@ class LearnTask:
             self.serve_timeout_ms = float(val)
         elif name == "serve_eos":
             self.serve_eos = int(val)
+        elif name == "serve_prefill_chunk":
+            self.serve_prefill_chunk = int(val)
+        elif name == "serve_prefill_budget":
+            self.serve_prefill_budget = int(val)
+        elif name == "serve_prefix_mb":
+            self.serve_prefix_mb = float(val)
         elif name == "name_pred":
             # output path for pred/extract; the `pred = <path>` section
             # marker also sets it (reference cxxnet_main.cpp honors both —
@@ -645,8 +658,13 @@ class LearnTask:
         were rejected). ``num_gen``/``temperature``/``generate_topk``/
         ``generate_topp``/``serve_eos`` set the per-request defaults;
         ``serve_slots``/``serve_queue``/``serve_timeout_ms`` size the
-        scheduler. A final metrics summary (p50/p95/p99 TTFT, tokens/s,
-        batch efficiency) goes to stderr."""
+        scheduler; ``serve_prefill_chunk``/``serve_prefill_budget``/
+        ``serve_prefix_mb`` shape the chunked prefill + prefix-reuse path
+        (doc/serving.md). An explicit ``lint_recompile_limit`` (or the
+        CXN_LINT default) extends the recompilation guard to the serve
+        engine's prefill/chunk programs. A final metrics summary
+        (p50/p95/p99 TTFT, tokens/s, batch efficiency, prefix hit rate)
+        goes to stderr."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
 
@@ -656,12 +674,30 @@ class LearnTask:
             top_k=self.generate_topk, top_p=self.generate_topp,
             eos=self.serve_eos if self.serve_eos >= 0 else None,
             timeout_ms=self.serve_timeout_ms)
+        # the trainer's recompile-guard keys (already parsed by Net from
+        # the same config pairs, including the CXN_LINT-injected limit 8
+        # / non-strict defaults) also govern the serve engine's compiled
+        # prefill/chunk signature count
         srv = InferenceServer(cfg, params, slots=self.serve_slots,
-                              queue=self.serve_queue, defaults=defaults)
+                              queue=self.serve_queue, defaults=defaults,
+                              prefill_chunk=self.serve_prefill_chunk,
+                              prefill_budget=self.serve_prefill_budget,
+                              prefix_mb=self.serve_prefix_mb,
+                              recompile_limit=self.net.lint_recompile_limit,
+                              recompile_strict=bool(
+                                  self.net.lint_recompile_strict))
         if not self.silent:
-            print("serving: %d slots, queue %d (one prompt per line; "
-                  "EOF drains and exits)"
-                  % (self.serve_slots, self.serve_queue), file=sys.stderr)
+            if self.serve_prefill_chunk > 0:
+                mode = "prefill chunk %d, prefix cache %s" % (
+                    self.serve_prefill_chunk,
+                    "%g MiB" % self.serve_prefix_mb
+                    if self.serve_prefix_mb > 0 else "off")
+            else:
+                mode = "whole-prompt prefill, prefix cache off"
+            print("serving: %d slots, queue %d, %s (one prompt per "
+                  "line; EOF drains and exits)"
+                  % (self.serve_slots, self.serve_queue, mode),
+                  file=sys.stderr)
         import collections
         import threading
 
@@ -730,15 +766,25 @@ class LearnTask:
             out_thread.join()
             m = srv.metrics()
             if not self.silent:
+                # gauge text follows the serving mode, so a legacy run
+                # reads "prefix cache off" instead of a misleading
+                # "prefix hit 0%" (disabled, not ineffective)
+                if self.serve_prefill_chunk > 0:
+                    extra = "%.1f prefill chunks/req, prefix %s" % (
+                        m["prefill_chunks_per_req"],
+                        "hit %.0f%%" % (100.0 * m["prefix_hit_rate"])
+                        if m["prefix_cache"] is not None else "cache off")
+                else:
+                    extra = "whole-prompt prefill"
                 print("serve: %d ok / %d timeout / %d rejected; "
                       "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "
-                      "batch efficiency %.2f over %d ticks"
+                      "batch efficiency %.2f over %d ticks; %s"
                       % (m["requests"]["completed"],
                          m["requests"]["timeout"],
                          m["requests"]["rejected"],
                          m["ttft_ms"]["p50"], m["ttft_ms"]["p95"],
                          m["ttft_ms"]["p99"], m["batch_efficiency"],
-                         m["ticks"]), file=sys.stderr)
+                         m["ticks"], extra), file=sys.stderr)
         finally:
             srv.shutdown(drain=False)       # idempotent after drain()
             with feed:                      # wake the printer on the

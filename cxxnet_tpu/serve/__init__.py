@@ -13,10 +13,11 @@ Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
 """
 
 from .engine import DecodeEngine
+from .prefix_cache import PrefixCache
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      ServeResult)
 
 __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
-           "SlotScheduler", "DecodeEngine", "AdmissionError",
-           "QueueFullError"]
+           "SlotScheduler", "DecodeEngine", "PrefixCache",
+           "AdmissionError", "QueueFullError"]

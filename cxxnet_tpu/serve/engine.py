@@ -6,16 +6,32 @@ signature — perfect for equal-length batch generation, useless for a
 server where requests arrive at different times with different lengths.
 This engine re-cuts the same math at the granularity a scheduler needs:
 
-* a **KV slot pool** — one (n_layer, slots, n_head, seq_len, head_dim)
-  cache pair; each in-flight request owns one slot row for its lifetime;
-* **prefill** — a jitted full-prompt forward for ONE request that writes
-  its K/V into an arbitrary slot row (traced slot index — one compiled
-  program per prompt length, reused for every slot) and samples the
-  request's first token;
+* a **KV slot pool** — one (n_layer, slots, n_head, row_len, head_dim)
+  cache pair; each in-flight request owns one slot row for its lifetime
+  (``row_len`` is ``seq_len`` rounded up to a chunk multiple so the last
+  — padded — prefill chunk's row write always fits);
+* **chunked prefill** — ONE jitted chunk step consuming
+  ``prefill_chunk`` tokens into a slot row at a traced offset, attending
+  over the row's already-written cache; every prompt of every length
+  runs as ceil(n / chunk) calls of the SAME compiled program, so the
+  per-prompt-length compile storm of the whole-prompt path cannot
+  happen, and the scheduler can interleave decode ticks between a long
+  prompt's chunks. The last (possibly partial) chunk pads + masks and
+  samples the request's first token;
+* **prefill** — the legacy whole-prompt admit program (one compiled
+  program PER prompt length; ``prefill_chunk = 0`` selects it — kept as
+  the bench baseline and the single-dispatch path for tiny prompts);
 * **tick** — ONE jitted batched decode step across ALL slot rows, each
   row at its own position with its own sampling params and PRNG key.
   Rows advance independently, so short and long requests interleave
   instead of convoying behind the longest member of a fixed batch.
+
+Compiled-program hygiene: every prefill/chunk program fetch is counted
+by a :class:`~cxxnet_tpu.analysis.recompile.RecompileGuard` when
+``recompile_limit > 0`` — a mixed-length trace through the legacy path
+trips it with the drifting dimension named (``n_prompt=...``), while the
+chunked path stays at one signature per server. The lru_cache below is
+the cache, the guard is the alarm.
 
 Token-identity contract: every numeric building block is shared with the
 offline path's XLA form (``_fuse_qkv_blocks`` / ``_block_core_fusedqkv``
@@ -28,11 +44,23 @@ seed (pinned by tests on the CPU mesh). Where the offline path engages
 its fused Pallas kernel instead (single TPU shard), its low-order logit
 bits can differ from any XLA formulation — including gpt_decode's own
 fallback — so the cross-path guarantee there is distribution-level, not
-bit-level. Prefill
-rewrites the WHOLE slot row (real K/V, zero-padded tail), and the decode
-mask admits only positions <= the row's own position, every one of which
-the row's own prefill/ticks have written — a recycled slot can never see
-its previous occupant's cache.
+bit-level.
+
+Recycled-slot safety: every attention mask admits only positions <= the
+querying row's own position, and every admitted position was written by
+THIS request — a prefix-cache copy, one of its own prefill chunks, or
+one of its own ticks (each tick writes its position's K/V before
+attending). The legacy whole-prompt prefill additionally rewrites the
+entire row; the chunked path does not need to, because stale positions
+beyond the row's current position are unreachable by construction (a
+masked score of -1e30 softmaxes to exactly 0.0 in f32, so stale columns
+contribute exactly nothing). The scheduler parks free and still-
+prefilling rows' tick position at row_len - 1, so the batched tick's
+unconditional per-row cache write can never land inside a pending row's
+already-prefilled prefix; the parked position itself is safe to dirty
+because a decode row ALWAYS writes its own position's K/V before
+attending to it — the write-before-attend order in the tick is the
+load-bearing half of this invariant (do not reorder it).
 
 The tick runs the XLA scan path (not the fused whole-step Pallas kernel):
 slot rows sit at DIFFERENT cache positions, and the fused kernel's
@@ -91,8 +119,14 @@ def _tick_fn(cfg_key: tuple, donate: bool):
 
     def impl(blocks, outer, cache_k, cache_v, tok, pos, keys, fold, temp,
              top_k, top_p):
+        # explicit clip, not implicit XLA gather clamping: free and
+        # still-prefilling rows are parked at row_len - 1, which is past
+        # the pos table when the chunk does not divide seq_len; real
+        # decode rows always sit < seq_len, so the clip is an identity
+        # for every row whose output is kept
         h = (outer["emb"][tok][:, None, :]
-             + outer["pos"][pos][:, None, :]).astype(dtype)
+             + outer["pos"][jnp.minimum(pos, cfg.seq_len - 1)][:, None, :]
+             ).astype(dtype)
         # python-unrolled layer loop (n_layer is static) with per-row
         # dynamic_update_slice writes STRAIGHT into the stacked caches:
         # the lax.scan form instead streams both caches through xs->ys,
@@ -127,10 +161,11 @@ def _tick_fn(cfg_key: tuple, donate: bool):
 
 
 @functools.lru_cache(maxsize=256)
-def _prefill_fn(cfg_key: tuple, n_prompt: int, donate: bool):
+def _prefill_fn(cfg_key: tuple, n_prompt: int, row_len: int, donate: bool):
     """Jitted admit program for one (config, prompt length): full-prompt
     forward, whole-slot-row cache write (traced slot index — one program
-    serves every slot), first-token sample."""
+    serves every slot), first-token sample. ``row_len`` is the engine's
+    (possibly chunk-padded) cache row length."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     identity = lambda t: t
@@ -150,7 +185,7 @@ def _prefill_fn(cfg_key: tuple, n_prompt: int, donate: bool):
             # slot keeps nothing of its previous occupant
             kh = jnp.transpose(k, (0, 2, 1, 3))
             vh = jnp.transpose(v, (0, 2, 1, 3))
-            pad = ((0, 0), (0, 0), (0, cfg.seq_len - n_prompt), (0, 0))
+            pad = ((0, 0), (0, 0), (0, row_len - n_prompt), (0, 0))
             return out, (jnp.pad(kh, pad), jnp.pad(vh, pad))
 
         h, (ck_row, cv_row) = lax.scan(prefill_layer, h, blocks)
@@ -170,41 +205,226 @@ def _prefill_fn(cfg_key: tuple, n_prompt: int, donate: bool):
     return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
 
 
-class DecodeEngine:
-    """Owns the slot-pool KV caches and drives the jitted programs
-    (prefill per prompt length, one shared tick). Host-side state is the
-    caller's job (serve/scheduler.py); this class only moves tensors."""
+def _attn_chunk(q, ck, cv, start):
+    """Chunk-prefill attention: q (1, C, H, d) token-major against the
+    row's head-major caches (1, H, S, d), causal at absolute positions
+    ``start + i`` — the multi-key form of ops/attention.py:full_attention
+    (same einsum contractions with f32 accumulation, the same -1e30 mask,
+    p cast back to v.dtype before the PV product), so a chunk's
+    activations reproduce the whole-prompt prefill position for
+    position. Masked cache columns (future positions, pad writes, a
+    recycled slot's stale tail) softmax to exactly 0.0 in f32 and
+    contribute exactly nothing to the output."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("bqhd,bhkd->bhqk", q, ck,
+                   preferred_element_type=jnp.float32) * scale
+    qpos = start + jnp.arange(q.shape[1])[:, None]
+    kpos = jnp.arange(ck.shape[2])[None, :]
+    s = jnp.where(qpos >= kpos, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bqhd", p.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
+    return out.astype(cv.dtype)
 
-    def __init__(self, cfg: GPTConfig, params: Dict, slots: int):
+
+@functools.lru_cache(maxsize=16)
+def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool):
+    """Jitted chunk-prefill step: consume ``chunk`` tokens into a slot
+    row starting at a traced offset ``start``, attending over the row's
+    already-written cache — ONE compiled program serves every prompt
+    length (ceil(n / chunk) calls), every slot, and every chunk index.
+    The caller pads the final chunk to ``chunk`` tokens and passes
+    ``n_valid``; the first generated token is sampled from position
+    ``n_valid - 1``'s logits with the offline ``fold_in(key, 0)``
+    schedule (only the final chunk's sample is meaningful — earlier
+    chunks' returned token is a mid-prompt sample the host discards).
+    Layer loop python-unrolled with per-layer dus straight into the
+    stacked caches, the tick's idiom — a lax.scan would stream both
+    caches through xs->ys as a full copy per layer."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+    hd = cfg.feat // cfg.n_head
+
+    def impl(blocks, outer, cache_k, cache_v, toks, slot, start, n_valid,
+             key, temp, top_k, top_p):
+        # position rows by gather, index clamped into the table: pad
+        # positions of the final chunk can point past seq_len - 1 (the
+        # table's extent) — their rows are masked garbage either way,
+        # while every VALID position start+i < seq_len fetches exactly
+        # the row the whole-prompt prefill adds at that position
+        pidx = jnp.clip(start + jnp.arange(chunk), 0, cfg.seq_len - 1)
+        h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
+        row_len = cache_k.shape[3]
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                # write this chunk's K/V at (layer l, slot, start), then
+                # attend the chunk's queries over the updated row
+                kh = jnp.transpose(k, (0, 2, 1, 3))[None]   # (1,1,H,C,d)
+                vh = jnp.transpose(v, (0, 2, 1, 3))[None]
+                ck = lax.dynamic_update_slice(cache_k, kh,
+                                              (l, slot, 0, start, 0))
+                cv = lax.dynamic_update_slice(cache_v, vh,
+                                              (l, slot, 0, start, 0))
+                size = (1, 1, cfg.n_head, row_len, hd)
+                row_k = lax.dynamic_slice(ck, (l, slot, 0, 0, 0), size)[0]
+                row_v = lax.dynamic_slice(cv, (l, slot, 0, 0, 0), size)[0]
+                return _attn_chunk(q, row_k, row_v, start), (ck, cv)
+
+            h, (cache_k, cache_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        last = lax.dynamic_slice_in_dim(h, n_valid - 1, 1, axis=1)
+        hl = _layernorm(last, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
+        k0 = jax.random.fold_in(key, 0)
+        tok = sample_rows(logits, k0[None], temp[None], top_k[None],
+                          top_p[None])
+        return cache_k, cache_v, tok[0]
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+@functools.lru_cache(maxsize=256)
+def _extract_chunks_fn(cfg_key: tuple, chunk: int, n_chunks: int):
+    """Jitted chunk copy-out for the prefix cache: ``n_chunks``
+    contiguous chunks sliced from a slot row at a traced offset in ONE
+    dispatch, returned chunk-major (n_chunks, n_layer, n_head, chunk,
+    head_dim) so the caller can index per-chunk trie buffers out of it.
+    Compiled per chunk count — bounded by row_len / chunk, which the
+    maxsize covers up to seq_len 16k at the default chunk 64 (these
+    small copy programs sit outside the RecompileGuard: their signature
+    count is config-bounded, not traffic-driven). The caches are NOT
+    donated — the row keeps serving."""
+    cfg = GPTConfig(*cfg_key)
+    hd = cfg.feat // cfg.n_head
+    size = (cfg.n_layer, 1, cfg.n_head, n_chunks * chunk, hd)
+
+    def grab(cache, slot, start):
+        blk = lax.dynamic_slice(cache, (0, slot, 0, start, 0), size)[:, 0]
+        blk = blk.reshape(cfg.n_layer, cfg.n_head, n_chunks, chunk, hd)
+        return jnp.transpose(blk, (2, 0, 1, 3, 4))
+
+    def impl(cache_k, cache_v, slot, start):
+        return grab(cache_k, slot, start), grab(cache_v, slot, start)
+
+    return jax.jit(impl)
+
+
+@functools.lru_cache(maxsize=256)
+def _insert_prefix_fn(cfg_key: tuple, n_tokens: int, donate: bool):
+    """Jitted whole-prefix copy-in: a matched prefix is CONTIGUOUS at
+    the row start, so the cache's chunk nodes are concatenated once and
+    restored with ONE dus per cache — the admit-time fast path (N
+    separate per-chunk dus calls each rewrite the whole cache on
+    backends without donation; one call pays that once). Compiled per
+    restored-prefix length in chunks — bounded by row_len / chunk, which
+    the maxsize covers up to seq_len 16k at the default chunk 64."""
+    def impl(cache_k, cache_v, ks, vs, slot):
+        # ks/vs: n_chunks-tuples of (L, H, chunk, hd); concat -> one
+        # (L, 1, H, n_tokens, hd) block at position 0 of the slot row
+        k = jnp.concatenate(ks, axis=2)[:, None]
+        v = jnp.concatenate(vs, axis=2)[:, None]
+        ck = lax.dynamic_update_slice(cache_k, k, (0, slot, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cache_v, v, (0, slot, 0, 0, 0))
+        return ck, cv
+
+    return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
+
+
+class DecodeEngine:
+    """Owns the slot-pool KV caches and drives the jitted programs (one
+    chunk-prefill step, legacy prefill per prompt length, one shared
+    tick, chunk extract/insert for the prefix cache). Host-side state is
+    the caller's job (serve/scheduler.py); this class only moves
+    tensors."""
+
+    def __init__(self, cfg: GPTConfig, params: Dict, slots: int,
+                 prefill_chunk: int = 64, recompile_limit: int = 0,
+                 recompile_strict: bool = True, abstract: bool = False):
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
             raise ValueError("feat %d not divisible by n_head %d"
                              % (cfg.feat, cfg.n_head))
+        if prefill_chunk < 0:
+            raise ValueError("serve_prefill_chunk must be >= 0 "
+                             "(0 = whole-prompt prefill), got %d"
+                             % prefill_chunk)
         self.cfg = cfg
         self._cfg_key = dataclasses.astuple(cfg)
         self.slots = slots
+        # a chunk beyond seq_len buys nothing (no prompt can fill it —
+        # submit rejects prompts >= seq_len) but would inflate row_len,
+        # and with it every slot row's HBM; clamp instead of erroring so
+        # the default chunk 64 composes with tiny-seq_len configs
+        self.chunk = min(int(prefill_chunk), cfg.seq_len)
+        # cache rows rounded UP to a chunk multiple: the final (padded)
+        # chunk's row write at start = floor((n-1)/chunk)*chunk always
+        # fits without jax's dynamic_update_slice start-clamping silently
+        # shifting it onto earlier chunks. Decode positions stay < seq_len
+        # (submit rejects prompts that leave no room), so the pad tail is
+        # only ever written — by padded chunks and parked dummy ticks —
+        # never read.
+        c = self.chunk
+        self.row_len = ((cfg.seq_len + c - 1) // c * c) if c else cfg.seq_len
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         # fused QKV once per server lifetime (models/gpt.py does this once
-        # per decode CALL; a server amortizes it over every request)
-        self._blocks = _fuse_qkv_blocks(params["blocks"])
+        # per decode CALL; a server amortizes it over every request); an
+        # abstract engine fuses shapes only — no device concat
+        self._blocks = (jax.eval_shape(_fuse_qkv_blocks, params["blocks"])
+                        if abstract else _fuse_qkv_blocks(params["blocks"]))
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
                                               "lnf_b", "head")}
         hd = cfg.feat // cfg.n_head
-        shape = (cfg.n_layer, slots, cfg.n_head, cfg.seq_len, hd)
-        self.cache_k = jnp.zeros(shape, self.dtype)
-        self.cache_v = jnp.zeros(shape, self.dtype)
+        shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
+        if abstract:
+            # audit-only engine (tools/cxn_lint.py --compile): the cache
+            # leaves are ShapeDtypeStructs, so lint_specs can AOT-lower
+            # every program without allocating a single device byte;
+            # prefill/tick calls on such an engine are a usage error
+            self.cache_k = jax.ShapeDtypeStruct(shape, self.dtype)
+            self.cache_v = jax.ShapeDtypeStruct(shape, self.dtype)
+        else:
+            self.cache_k = jnp.zeros(shape, self.dtype)
+            self.cache_v = jnp.zeros(shape, self.dtype)
         # donating the caches halves peak HBM on real chips; CPU (the test
         # mesh) ignores donation with a warning, so gate on the backend
         self._donate = jax.default_backend() != "cpu"
+        # compiled prefill/chunk signature counting (lint_recompile_limit
+        # for the serve engine): the lru_caches above silently absorb a
+        # per-prompt-length compile storm; the guard makes it loud
+        self._guard = None
+        if recompile_limit > 0:
+            from ..analysis.recompile import RecompileGuard
+            from ..utils import profiler
+            self._guard = RecompileGuard(
+                lambda sig: None, "serve_prefill", recompile_limit,
+                strict=bool(recompile_strict), log=profiler.log)
+
+    def _count_program(self, sig: str) -> None:
+        """Register one prefill/chunk program fetch with the guard; the
+        signature string carries the drifting dimension's name, so a
+        CXN205 trip reads e.g. \"leaf 0: 'n_prompt=17' -> 'n_prompt=23'\"."""
+        if self._guard is not None:
+            self._guard(sig)
+
+    @property
+    def prefill_signatures(self) -> tuple:
+        """Distinct compiled prefill/chunk program signatures seen so far
+        (empty when the guard is off)."""
+        return self._guard.signatures if self._guard is not None else ()
 
     def lint_specs(self, n_prompt: int = 8, donate: Optional[bool] = None):
         """(label, jitted fn, abstract args, donate_argnums) rows for the
         compiled-step audit (analysis/step_audit.py): prefill at one
-        representative prompt length plus the shared tick. ``donate``
-        overrides the backend-gated donation choice so tests can pin the
-        aliasing contract on the CPU mesh too. Pure AOT — nothing runs,
-        nothing is allocated."""
+        representative prompt length, the chunk-prefill step (when
+        chunking is enabled), plus the shared tick. ``donate`` overrides
+        the backend-gated donation choice so tests can pin the aliasing
+        contract on the CPU mesh too. Pure AOT — nothing runs, nothing
+        is allocated."""
         from jax import ShapeDtypeStruct as SDS
         don = self._donate if donate is None else bool(donate)
         nums = (2, 3) if don else ()
@@ -218,13 +438,30 @@ class DecodeEngine:
                      SDS((b,), i32), SDS((b,), i32),
                      SDS((b, 2), jnp.uint32), SDS((b,), i32),
                      SDS((b,), f32), SDS((b,), i32), SDS((b,), f32))
-        return [
-            ("serve_prefill", _prefill_fn(self._cfg_key, n_prompt, don),
+        specs = [
+            ("serve_prefill",
+             _prefill_fn(self._cfg_key, n_prompt, self.row_len, don),
              prefill_args, nums),
-            ("serve_tick", _tick_fn(self._cfg_key, don), tick_args, nums),
         ]
+        if self.chunk:
+            chunk_args = (self._blocks, self._outer, self.cache_k,
+                          self.cache_v, SDS((1, self.chunk), i32),
+                          SDS((), i32), SDS((), i32), SDS((), i32), key,
+                          SDS((), f32), SDS((), i32), SDS((), f32))
+            specs.append(
+                ("serve_prefill_chunk",
+                 _prefill_chunk_fn(self._cfg_key, self.chunk, don),
+                 chunk_args, nums))
+        specs.append(
+            ("serve_tick", _tick_fn(self._cfg_key, don), tick_args, nums))
+        return specs
 
     def cache_bytes(self) -> int:
+        """Slot-pool K/V bytes: 2 * layers * slots * heads * row_len *
+        head_dim * itemsize (row_len is chunk-padded seq_len). The
+        serving TOTAL adds the prefix cache on top — up to
+        ``serve_prefix_mb`` more, reported as ``prefix_cache_bytes`` in
+        InferenceServer.metrics() (doc/serving.md memory formula)."""
         if self.cache_k is None:        # closed (metrics after shutdown)
             return 0
         return 2 * self.cache_k.size * self.cache_k.dtype.itemsize
@@ -237,8 +474,12 @@ class DecodeEngine:
                 temperature: float, top_k: int, top_p: float) -> int:
         """Admit one request into ``slot``: full forward over ``prompt``
         (1-D int array), write its K/V row, return the first generated
-        token (synchronized — the host needs it for EOS/TTFT anyway)."""
-        fn = _prefill_fn(self._cfg_key, int(len(prompt)), self._donate)
+        token (synchronized — the host needs it for EOS/TTFT anyway).
+        The legacy whole-prompt path: one compiled program PER prompt
+        length."""
+        n = int(len(prompt))
+        self._count_program("n_prompt=%d" % n)
+        fn = _prefill_fn(self._cfg_key, n, self.row_len, self._donate)
         self.cache_k, self.cache_v, tok = fn(
             self._blocks, self._outer, self.cache_k, self.cache_v,
             jnp.asarray(np.asarray(prompt, np.int32))[None],
@@ -247,13 +488,61 @@ class DecodeEngine:
             jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
         return int(tok)
 
+    def prefill_chunk(self, slot: int, toks: np.ndarray, start: int,
+                      n_valid: int, key: np.ndarray, temperature: float,
+                      top_k: int, top_p: float):
+        """One chunk of prefill work for ``slot``: ``toks`` is exactly
+        ``prefill_chunk`` tokens (the caller zero-pads the final chunk
+        and passes ``n_valid``); ``start`` is the chunk's offset in the
+        row. Returns the sampled token as a DEVICE value — meaningful
+        only on the final chunk (fold_in(key, 0) on position n_valid-1's
+        logits, the offline first-token schedule), and left unsynced so
+        a long prompt's chunk steps pipeline on device instead of
+        paying one host round-trip each; the scheduler fetches it only
+        when the final chunk lands."""
+        toks = np.asarray(toks, np.int32).reshape(-1)
+        if toks.size != self.chunk:
+            raise ValueError("prefill_chunk expects exactly %d tokens, "
+                             "got %d" % (self.chunk, toks.size))
+        self._count_program("chunk=%d" % self.chunk)
+        fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
+                               self._donate)
+        self.cache_k, self.cache_v, tok = fn(
+            self._blocks, self._outer, self.cache_k, self.cache_v,
+            jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
+        return tok
+
+    def extract_row_chunks(self, slot: int, start: int, n_chunks: int):
+        """Copy ``n_chunks`` contiguous chunks' K/V out of ``slot``'s row
+        from offset ``start`` in one dispatch (the prefix cache's
+        copy-out at retire); returns chunk-major stacked (n_chunks,
+        n_layer, n_head, chunk, head_dim) arrays."""
+        fn = _extract_chunks_fn(self._cfg_key, self.chunk, int(n_chunks))
+        return fn(self.cache_k, self.cache_v, jnp.asarray(slot, jnp.int32),
+                  jnp.asarray(start, jnp.int32))
+
+    def insert_row_prefix(self, slot: int, ks, vs) -> None:
+        """Restore a whole matched prefix (``ks``/``vs``: equal-length
+        sequences of chunk K/V pairs, contiguous from position 0) into
+        ``slot``'s row in ONE jitted call — one dus per cache total
+        instead of one per chunk."""
+        fn = _insert_prefix_fn(self._cfg_key, len(ks) * self.chunk,
+                               self._donate)
+        self.cache_k, self.cache_v = fn(
+            self.cache_k, self.cache_v, tuple(ks), tuple(vs),
+            jnp.asarray(slot, jnp.int32))
+
     def tick(self, tok: np.ndarray, pos: np.ndarray, keys: np.ndarray,
              fold: np.ndarray, temp: np.ndarray, top_k: np.ndarray,
              top_p: np.ndarray) -> np.ndarray:
-        """One batched decode step across every slot row (free rows run
-        too, on dummy state — their writes land at masked positions of
-        rows that prefill fully rewrites at the next admit, and their
-        tokens are discarded by the scheduler). ``fold`` is each row's
+        """One batched decode step across every slot row (free and
+        still-prefilling rows run too, on dummy state — the scheduler
+        parks their position at row_len - 1, past every readable
+        position, so their unconditional cache write can never land
+        inside real data, and their tokens are discarded). ``fold`` is each row's
         token index in ITS OWN request — the fold_in schedule that makes
         a slot row's sample stream identical to the offline path's.
         Returns the (slots,) next tokens, synchronized."""

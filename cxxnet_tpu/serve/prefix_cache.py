@@ -1,0 +1,264 @@
+"""Shared-prefix KV reuse: a ref-counted token-trie at chunk granularity.
+
+Real traffic repeats prompt prefixes — system prompts, few-shot
+templates, conversation history — and the whole-prompt prefill recomputed
+every one of them from scratch on every request. This cache retains the
+K/V of retired slot rows' complete prompt chunks, keyed by the chunk's
+token ids in a trie (so two prompts sharing 3 chunks share 3 nodes), and
+restores the longest cached prefix into a fresh slot row at admit in ONE
+jitted call — the matched chunks are concatenated and written with one
+``dynamic_update_slice`` per cache (engine.insert_row_prefix, no
+recompute); chunked prefill then resumes at the first divergent chunk.
+
+Correctness: K/V at position i depends only on tokens 0..i (causal), so
+a chunk computed once for a token prefix is bit-for-bit the chunk any
+other request with the same prefix would compute through the same chunk
+program — restoring it is a pure copy, and token identity with the solo
+``gpt_decode`` path is preserved exactly (pinned by
+tests/test_serve_chunked.py's prefix-hit-vs-cold test). The match is
+capped at the last complete chunk STRICTLY before the prompt's final
+token, so the final chunk always runs and samples token 0 with the
+request's own key. Only enabled together with chunked prefill: the
+legacy whole-prompt program is a different compiled formulation whose
+low-order bits are not contractually identical to the chunk step's.
+
+Memory: every node holds one (n_layer, n_head, chunk, head_dim) K/V pair
+(``2 * n_layer * n_head * chunk * head_dim * itemsize`` bytes). The trie
+is bounded by a byte budget (``serve_prefix_mb``); going over evicts
+least-recently-used EVICTABLE nodes — refcount 0, i.e. no child chunks
+and no in-flight copy — so an interior node can never be evicted from
+under its children and a chain stays contiguous. Budget 0 disables reuse
+entirely (match/insert become no-ops).
+
+Thread-safety: all methods run on the server's single scheduler thread
+(the same discipline as serve/scheduler.py); the unit tests drive it
+directly from one thread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    """One cached chunk: trie edge label = the chunk's token tuple."""
+
+    __slots__ = ("tokens", "k", "v", "parent", "children", "refs",
+                 "last_used", "nbytes")
+
+    def __init__(self, tokens: tuple, k, v, parent: Optional["_Node"]):
+        self.tokens = tokens
+        self.k = k
+        self.v = v
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.refs = 0               # children + in-flight borrows
+        self.last_used = 0
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+
+
+class PrefixCache:
+    """Token-trie over cached prompt chunks; see module docstring."""
+
+    def __init__(self, engine, budget_bytes: int):
+        if not engine.chunk:
+            raise ValueError("PrefixCache needs chunked prefill "
+                             "(engine prefill_chunk > 0)")
+        self.engine = engine
+        self.chunk = int(engine.chunk)
+        self.budget = int(budget_bytes)
+        # bytes of one cached chunk node (K + V), from the engine's
+        # geometry — the insert cap below needs it BEFORE any copy-out
+        cfg = engine.cfg
+        import numpy as _np
+        self.node_bytes = (2 * cfg.n_layer * cfg.n_head * self.chunk
+                           * (cfg.feat // cfg.n_head)
+                           * _np.dtype(engine.dtype).itemsize)
+        self._children: Dict[tuple, _Node] = {}     # trie root
+        # flat node index for eviction: a dict (insertion-ordered) so
+        # removal is O(1) — a list's .remove() turns an eviction burst
+        # quadratic on the scheduler thread
+        self._nodes: Dict[_Node, None] = {}
+        self._clock = 0
+        self._bytes = 0
+        self.reset_counters()
+
+    # ------------------------------------------------------------- state
+    @property
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    @property
+    def chunks(self) -> int:
+        return len(self._nodes)
+
+    def reset_counters(self) -> None:
+        """Zero the traffic counters (bench warm-up); cached chunks and
+        their LRU clocks are kept — steady-state is the point."""
+        self.hits = 0               # admits that restored >= 1 chunk
+        self.misses = 0             # admits that restored none
+        self.hit_tokens = 0         # prompt tokens restored from cache
+        self.prompt_tokens = 0      # prompt tokens across all lookups
+        self.evictions = 0
+        self.inserted_chunks = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunk_key(self, prompt, i: int) -> tuple:
+        c = self.chunk
+        return tuple(int(t) for t in prompt[i * c:(i + 1) * c])
+
+    # ------------------------------------------------------------- match
+    def match(self, prompt) -> List[_Node]:
+        """Longest chain of cached complete chunks prefixing ``prompt``,
+        capped at ``(len(prompt) - 1) // chunk`` chunks so at least the
+        prompt's final token is always recomputed (the final chunk must
+        run to sample the request's first generated token)."""
+        if not self.enabled:
+            return []
+        out: List[_Node] = []
+        children = self._children
+        for i in range((len(prompt) - 1) // self.chunk):
+            node = children.get(self._chunk_key(prompt, i))
+            if node is None:
+                break
+            out.append(node)
+            children = node.children
+        return out
+
+    def copy_into(self, slot: int, prompt) -> int:
+        """Restore the longest cached prefix of ``prompt`` into ``slot``'s
+        cache row; returns the number of tokens restored (chunked prefill
+        resumes there). Matched nodes are pinned (refs) for the duration
+        of the copy and LRU-refreshed."""
+        if not self.enabled:
+            return 0
+        self.prompt_tokens += len(prompt)
+        nodes = self.match(prompt)
+        if not nodes:
+            self.misses += 1
+            return 0
+        now = self._tick()
+        for n in nodes:
+            n.refs += 1
+        try:
+            # one jitted call restores the whole contiguous prefix (one
+            # dus per cache total — per-chunk calls would rewrite the
+            # cache once per chunk on backends without donation)
+            self.engine.insert_row_prefix(slot, [n.k for n in nodes],
+                                          [n.v for n in nodes])
+            for n in nodes:
+                n.last_used = now
+        finally:
+            for n in nodes:
+                n.refs -= 1
+        self.hits += 1
+        restored = len(nodes) * self.chunk
+        self.hit_tokens += restored
+        return restored
+
+    # ------------------------------------------------------------ insert
+    def insert_from_row(self, slot: int, prompt) -> int:
+        """Offer a retired row's complete prompt chunks to the trie:
+        uncached chunks are copied out of the row on device, existing
+        ones are LRU-refreshed. Returns the number of chunks added. Must
+        run BEFORE the slot is recycled (the scheduler calls it inside
+        retire)."""
+        if not self.enabled:
+            return 0
+        n_chunks = len(prompt) // self.chunk
+        # cap the chain at what the budget could ever retain: inserting
+        # a chain larger than the whole budget would flush every warm
+        # entry only for evict_to_budget to trim the chain's own tail
+        # right back — pay the copy-out only for chunks that can stay.
+        # (Deliberately NOT headroom-based: at steady state the cache
+        # sits at budget, and LRU churn of older entries is the point.)
+        n_chunks = min(n_chunks, self.budget // self.node_bytes)
+        if not n_chunks:
+            return 0
+        now = self._tick()
+        keys = [self._chunk_key(prompt, i) for i in range(n_chunks)]
+        children = self._children
+        parent: Optional[_Node] = None
+        i = 0
+        while i < n_chunks:                 # walk the already-cached part
+            node = children.get(keys[i])
+            if node is None:
+                break
+            node.last_used = now
+            parent = node
+            children = node.children
+            i += 1
+        if i == n_chunks:
+            return 0
+        # the uncached chunks are a contiguous SUFFIX of this chain
+        # (nodes are only ever created parent-first), so one dispatch
+        # copies them all out — retire runs on the scheduler thread,
+        # where a per-chunk dispatch chain would stall active rows
+        ks, vs = self.engine.extract_row_chunks(slot, i * self.chunk,
+                                                n_chunks - i)
+        added = n_chunks - i
+        for j in range(i, n_chunks):
+            node = _Node(keys[j], ks[j - i], vs[j - i], parent)
+            node.last_used = now
+            children[keys[j]] = node
+            if parent is not None:
+                parent.refs += 1
+            self._nodes[node] = None
+            self._bytes += node.nbytes
+            self.inserted_chunks += 1
+            parent = node
+            children = node.children
+        self.evict_to_budget()
+        return added
+
+    # ----------------------------------------------------------- evict
+    def evict_to_budget(self) -> int:
+        """LRU-evict refcount-0 nodes (leaves with no in-flight borrow)
+        until the byte budget holds; returns how many were dropped.
+        Evicting a leaf un-refs its parent, so a cold chain unwinds tail
+        first and an interior node never orphans its children. One
+        sorted sweep over the evictable snapshot per round (parents
+        freed mid-sweep join the NEXT round's snapshot), so an eviction
+        burst costs O(rounds * n log n) instead of a per-victim scan."""
+        n = 0
+        while self._bytes > self.budget:
+            sweep = sorted((nd for nd in self._nodes if nd.refs == 0),
+                           key=lambda nd: nd.last_used)
+            if not sweep:               # everything pinned: over-budget
+                break                   # but nothing is safely droppable
+            for node in sweep:
+                if self._bytes <= self.budget:
+                    break
+                self._remove(node)
+                self.evictions += 1
+                n += 1
+        return n
+
+    def _remove(self, node: _Node) -> None:
+        parent = node.parent
+        siblings = parent.children if parent is not None else self._children
+        del siblings[node.tokens]
+        if parent is not None:
+            parent.refs -= 1
+        del self._nodes[node]
+        self._bytes -= node.nbytes
+        node.k = node.v = None          # drop the device buffers
+
+    def clear(self) -> None:
+        """Drop every cached chunk (server shutdown)."""
+        for node in self._nodes:
+            node.k = node.v = None
+            node.children = {}
+            node.parent = None
+        self._nodes = {}
+        self._children = {}
+        self._bytes = 0

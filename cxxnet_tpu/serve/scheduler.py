@@ -4,12 +4,24 @@ Per scheduler pass (driven by serve/server.py's loop):
 
 1. **admit** — pop queued requests FIFO (skipping any whose deadline
    already passed — they finish as ``timeout``) into free slots; each
-   admit runs one prefill (the request's TTFT token comes back with it);
-2. **tick** — one batched decode step across all slots; active rows
-   append their token, free rows are ignored;
-3. **retire** — rows that hit EOS, their token budget, or the sequence
-   length free their slot immediately, so the NEXT pass can admit into
-   it — short requests leave the batch the moment they finish instead of
+   admit restores the longest prefix-cache match into its row and
+   enqueues the rest of the prompt as chunk-prefill work (with
+   ``serve_prefill_chunk = 0``, the legacy path runs one whole-prompt
+   prefill here instead);
+2. **prefill** — up to ``serve_prefill_budget`` chunk steps of the
+   OLDEST still-prefilling request (``prefill_step``), so a long prompt
+   advances without stalling the decode tick for more than one chunk's
+   duration; the final (padded) chunk returns the request's first token
+   and activates the row;
+3. **tick** — one batched decode step across all slots; decoding rows
+   append their token, free and still-prefilling rows run on parked
+   dummy state (position row_len - 1, outside every pending row's
+   prefix; the spot is safe to dirty because a decode row always writes
+   its own position before attending to it) and are ignored;
+4. **retire** — rows that hit EOS, their token budget, or the sequence
+   length offer their complete prompt chunks to the prefix cache and
+   free their slot immediately, so the NEXT pass can admit into it —
+   short requests leave the batch the moment they finish instead of
    convoying behind long ones.
 
 The scheduler is single-threaded by design (only the server's scheduler
@@ -53,8 +65,10 @@ class SamplingParams:
 
 class Request:
     """One in-flight generation request: prompt + params + lifecycle
-    timestamps. ``done`` is set exactly once, when ``status`` reaches a
-    terminal value (ok / timeout / rejected / cancelled)."""
+    timestamps. ``status`` walks queued -> prefill (chunked admit;
+    legacy admits jump straight on) -> active -> terminal; ``done`` is
+    set exactly once, when ``status`` reaches a terminal value
+    (ok / timeout / rejected / cancelled)."""
 
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
@@ -88,18 +102,35 @@ class SlotScheduler:
     """Owns the per-slot host state mirroring the engine's cache rows."""
 
     def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
-                 on_finish=None):
+                 on_finish=None, prefix_cache=None):
         self.engine = engine
         self.stats = stats or profiler.StepStats()
         self.on_finish = on_finish      # called with each request that
         #                                 reaches a terminal state here
+        self.chunk = int(engine.chunk)  # 0 = legacy whole-prompt
+        self.prefix = prefix_cache if self.chunk > 0 else None
         n = engine.slots
         self._req: List[Optional[Request]] = [None] * n
         self._free = list(range(n - 1, -1, -1))     # pop() -> lowest slot
-        # device-call argument rows; free rows keep harmless dummies
-        # (tok 0 / pos 0 / temperature 0 — greedy over garbage, discarded)
+        # chunk-prefill work: per-slot in-progress state + FIFO of slots
+        # still prefilling (the front request's chunks run first, so
+        # prefill completion order follows admission order)
+        self._pending: List[Optional[dict]] = [None] * n
+        self._prefill_q: collections.deque = collections.deque()
+        # device-call argument rows; free and still-prefilling rows keep
+        # harmless dummies (temperature 0 — greedy over garbage,
+        # discarded) PARKED at the row's last position: the batched tick
+        # writes every row's K/V at its position unconditionally, so the
+        # park spot must be one no later reader can see stale. Chunk
+        # masks stop at the prompt (< seq_len <= row_len), which leaves
+        # only a decode step at pos row_len - 1 (reachable when seq_len
+        # == row_len) — safe because the tick ALWAYS writes a row's own
+        # position before attending to it, the invariant every reuse
+        # argument here leans on. A parked write can therefore never
+        # corrupt a pending row's already-prefilled prefix.
+        self._park = engine.row_len - 1
         self._tok = np.zeros(n, np.int32)
-        self._pos = np.zeros(n, np.int32)
+        self._pos = np.full(n, self._park, np.int32)
         self._fold = np.zeros(n, np.int32)
         self._keys = np.zeros((n, 2), np.uint32)
         self._temp = np.zeros(n, np.float32)
@@ -107,8 +138,10 @@ class SlotScheduler:
         self._topp = np.ones(n, np.float32)
         # gauges
         self.ticks = 0
-        self.active_row_ticks = 0       # sum of active counts over ticks
+        self.active_row_ticks = 0       # sum of decoding counts over ticks
         self.tokens_generated = 0
+        self.prefill_chunks = 0         # chunk steps run (chunked path)
+        self.requests_prefilled = 0     # requests whose prefill completed
         # request ids in admission order (bounded: diagnostic window, not
         # a full history — a hot server admits forever)
         self.admit_order: collections.deque = collections.deque(maxlen=4096)
@@ -120,7 +153,18 @@ class SlotScheduler:
 
     @property
     def active(self) -> int:
+        """Occupied slots (decoding + still prefilling)."""
         return self.engine.slots - len(self._free)
+
+    @property
+    def prefilling(self) -> int:
+        """Admitted requests whose prefill has not finished yet."""
+        return len(self._prefill_q)
+
+    @property
+    def decoding(self) -> int:
+        """Rows the next tick advances (prefill complete, not retired)."""
+        return sum(r is not None for r in self._req)
 
     def occupancy(self) -> float:
         return self.active / float(self.engine.slots)
@@ -135,9 +179,11 @@ class SlotScheduler:
 
     # ------------------------------------------------------------- admit
     def admit(self, req: Request) -> None:
-        """Prefill ``req`` into a free slot (caller checked free_slots).
-        May retire immediately (max_tokens == 1, or the first token is
-        EOS)."""
+        """Claim a free slot for ``req`` (caller checked free_slots).
+        Chunked path: restore the longest prefix-cache match into the
+        row and enqueue the remaining chunks (prefill_step runs them).
+        Legacy path (chunk 0): one whole-prompt prefill, may retire
+        immediately (max_tokens == 1, or the first token is EOS)."""
         import jax
 
         slot = self._free.pop()
@@ -147,15 +193,70 @@ class SlotScheduler:
         self.stats.record(profiler.QUEUE_WAIT, req.admit_t - req.submit_t)
         self.admit_order.append(req.rid)
         key = np.asarray(jax.random.PRNGKey(p.seed), np.uint32)
-        with self.stats.phase(profiler.PREFILL):
-            tok = self.engine.prefill(slot, req.prompt, key,
-                                      p.temperature, p.top_k, p.top_p)
-        # commit this admit's QUEUE_WAIT/PREFILL as their own stats step:
-        # folding them into the next tick's end_step would sum every
-        # admit since the last tick into one sample (skewing the
-        # percentiles) and lose them entirely for requests that retire
-        # at admit (max_tokens 1 / instant EOS — no tick ever runs)
-        self.stats.end_step()
+        if self.chunk <= 0:
+            with self.stats.phase(profiler.PREFILL):
+                tok = self.engine.prefill(slot, req.prompt, key,
+                                          p.temperature, p.top_k, p.top_p)
+            # commit this admit's QUEUE_WAIT/PREFILL as their own stats
+            # step: folding them into the next tick's end_step would sum
+            # every admit since the last tick into one sample (skewing
+            # the percentiles) and lose them entirely for requests that
+            # retire at admit (max_tokens 1 / instant EOS — no tick runs)
+            self.stats.end_step()
+            self.requests_prefilled += 1
+            self._activate(req, key, tok)
+            return
+        start = 0
+        if self.prefix is not None:
+            with self.stats.phase(profiler.PREFIX_COPY):
+                start = self.prefix.copy_into(slot, req.prompt)
+        self.stats.end_step()       # commit QUEUE_WAIT (+ PREFIX_COPY)
+        req.status = "prefill"
+        self._pending[slot] = {"req": req, "key": key, "next": start}
+        self._prefill_q.append(slot)
+
+    def prefill_step(self) -> bool:
+        """Run ONE chunk of prefill work for the oldest still-prefilling
+        request; returns False when none is pending. The final (padded)
+        chunk samples the request's first token and activates the row
+        for ticking."""
+        if not self._prefill_q:
+            return False
+        slot = self._prefill_q[0]
+        st = self._pending[slot]
+        req = st["req"]
+        p = req.params
+        n = len(req.prompt)
+        start = st["next"]
+        end = min(start + self.chunk, n)
+        toks = np.zeros(self.chunk, np.int32)
+        toks[:end - start] = req.prompt[start:end]
+        with self.stats.phase(profiler.PREFILL_CHUNK):
+            tok = self.engine.prefill_chunk(slot, toks, start, end - start,
+                                            st["key"], p.temperature,
+                                            p.top_k, p.top_p)
+            if end >= n:
+                # the request's first token: only the FINAL chunk's
+                # sample is fetched — mid-prompt chunks stay async so
+                # they pipeline on device
+                tok = int(tok)
+        self.stats.end_step()       # one chunk = one stats step
+        self.prefill_chunks += 1
+        st["next"] = end
+        if end < n:
+            return True
+        self._prefill_q.popleft()
+        self._pending[slot] = None
+        self.requests_prefilled += 1
+        self._activate(req, st["key"], tok)
+        return True
+
+    def _activate(self, req: Request, key: np.ndarray, tok: int) -> None:
+        """Prefill finished: record TTFT, take the first token, and arm
+        the row for decode ticks (or retire on the spot — max_tokens 1 /
+        instant EOS)."""
+        slot = req.slot
+        p = req.params
         req.first_token_t = time.perf_counter()
         req.status = "active"
         req.tokens.append(tok)
@@ -182,12 +283,24 @@ class SlotScheduler:
 
     def _retire(self, req: Request, status: str, error: str = "") -> None:
         slot = req.slot
+        if self._pending[slot] is not None:     # cancelled mid-prefill
+            # _pending and _prefill_q are always mutated together on the
+            # scheduler thread, so membership is an invariant — a
+            # ValueError here is a real bug, not a race to paper over
+            self._pending[slot] = None
+            self._prefill_q.remove(slot)
+        elif status == "ok" and self.prefix is not None:
+            # offer the row's complete prompt chunks to the prefix cache
+            # BEFORE the slot is recycled (the copy-out reads the row)
+            with self.stats.phase(profiler.PREFIX_COPY):
+                self.prefix.insert_from_row(slot, req.prompt)
+            self.stats.end_step()
         self._req[slot] = None
         self._temp[slot] = 0.0
         self._topk[slot] = 0
         self._topp[slot] = 1.0
         self._tok[slot] = 0
-        self._pos[slot] = 0
+        self._pos[slot] = self._park
         self._fold[slot] = 0
         self._free.append(slot)
         req.finish(status, error)
@@ -196,16 +309,18 @@ class SlotScheduler:
 
     # -------------------------------------------------------------- tick
     def tick(self) -> int:
-        """One batched decode step; returns the number of still-active
-        slots afterwards."""
-        if self.active == 0:
+        """One batched decode step; returns the number of still-decoding
+        slots afterwards. Rows still in chunk prefill are skipped (their
+        device rows are parked dummies)."""
+        decoding = self.decoding
+        if decoding == 0:
             return 0
         with self.stats.phase(profiler.DECODE_TICK):
             nxt = self.engine.tick(self._tok, self._pos, self._keys,
                                    self._fold, self._temp, self._topk,
                                    self._topp)
         self.ticks += 1
-        self.active_row_ticks += self.active
+        self.active_row_ticks += decoding
         for slot, req in enumerate(self._req):
             if req is None:
                 continue
@@ -219,15 +334,20 @@ class SlotScheduler:
                 self._pos[slot] += 1
                 self._fold[slot] += 1
         self.stats.end_step()
-        return self.active
+        return self.decoding
 
     # ------------------------------------------------------------- drain
     def cancel_active(self) -> int:
-        """Abort every in-flight request (non-drain shutdown); returns
-        how many were cancelled."""
+        """Abort every in-flight request — decoding AND mid-prefill
+        (non-drain shutdown); returns how many were cancelled."""
         n = 0
         for req in list(self._req):
             if req is not None:
                 self._retire(req, "cancelled", "server shutdown")
+                n += 1
+        for slot in list(self._prefill_q):
+            st = self._pending[slot]
+            if st is not None:
+                self._retire(st["req"], "cancelled", "server shutdown")
                 n += 1
         return n

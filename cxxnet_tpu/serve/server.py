@@ -3,9 +3,13 @@
 ``submit(prompt, params) -> handle`` / ``result(handle)`` over a bounded
 admission queue, with a dedicated scheduler thread driving the
 continuous-batching loop (serve/scheduler.py) against the slot-pool
-decode engine (serve/engine.py). Backpressure is explicit: a full queue
-rejects at submit time with a reason (``QueueFullError``) instead of
-buffering unboundedly — the caller decides whether to retry, shed, or
+decode engine (serve/engine.py). Prefill runs CHUNKED by default
+(``prefill_chunk`` tokens per jitted step, at most ``prefill_budget``
+chunks interleaved with each decode tick) with shared-prefix KV reuse
+(serve/prefix_cache.py, ``prefix_mb`` byte budget); ``prefill_chunk=0``
+selects the legacy whole-prompt admit. Backpressure is explicit: a full
+queue rejects at submit time with a reason (``QueueFullError``) instead
+of buffering unboundedly — the caller decides whether to retry, shed, or
 block (``block=True``, what the CLI's stdin loop uses).
 
 Observability: per-request TTFT / per-token latency and the scheduler's
@@ -77,16 +81,39 @@ class InferenceServer:
 
     def __init__(self, cfg, params, *, slots: int = 8, queue: int = 32,
                  timeout_ms: float = 0.0,
-                 defaults: Optional[SamplingParams] = None):
+                 defaults: Optional[SamplingParams] = None,
+                 prefill_chunk: int = 64, prefill_budget: int = 1,
+                 prefix_mb: float = 32.0, recompile_limit: int = 0,
+                 recompile_strict: bool = True):
+        """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
+        legacy whole-prompt prefill, one compiled program per prompt
+        length); ``prefill_budget``: max chunk steps interleaved with
+        each decode tick; ``prefix_mb``: shared-prefix KV cache byte
+        budget in MiB (0 disables reuse; only active with chunking);
+        ``recompile_limit``: cap on distinct compiled prefill/chunk
+        signatures (0 = uncounted; see analysis/recompile.py)."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
+        if prefill_budget < 1:
+            raise ValueError("serve_prefill_budget must be >= 1, got %d"
+                             % prefill_budget)
         self._defaults = defaults or SamplingParams()
         if timeout_ms and not self._defaults.timeout_ms:
             self._defaults = replace(self._defaults, timeout_ms=timeout_ms)
-        self._engine = DecodeEngine(cfg, params, slots)
+        self._engine = DecodeEngine(cfg, params, slots,
+                                    prefill_chunk=prefill_chunk,
+                                    recompile_limit=recompile_limit,
+                                    recompile_strict=recompile_strict)
+        self._prefill_budget = int(prefill_budget)
+        self._prefix = None
+        if prefill_chunk > 0 and prefix_mb > 0:
+            from .prefix_cache import PrefixCache
+            self._prefix = PrefixCache(self._engine,
+                                       int(prefix_mb * (1 << 20)))
         self._stats = profiler.StepStats()
         self._sched = SlotScheduler(self._engine, self._stats,
-                                    on_finish=self._record_done)
+                                    on_finish=self._record_done,
+                                    prefix_cache=self._prefix)
         self._queue: collections.deque = collections.deque()
         self._queue_cap = queue
         self._cond = threading.Condition()
@@ -237,9 +264,16 @@ class InferenceServer:
                         # instead of polling
                         self._cond.wait()
                         continue
-                for req in admitted:            # prefill outside the lock
-                    self._sched.admit(req)
-                if self._sched.active:
+                for req in admitted:            # device work outside the
+                    self._sched.admit(req)      # lock
+                # at most prefill_budget chunk steps per pass, so a long
+                # prompt's prefill cannot stall the decode tick for more
+                # than one chunk's duration (whole-prompt admits already
+                # ran inside admit() when chunking is off)
+                for _ in range(self._prefill_budget):
+                    if not self._sched.prefill_step():
+                        break
+                if self._sched.decoding:
                     self._sched.tick()
         finally:
             # reached on shutdown OR on an unexpected scheduler-thread
@@ -255,11 +289,18 @@ class InferenceServer:
                     req.finish("cancelled", "server shutdown")
                 self._queue.clear()
                 self._cond.notify_all()
-            for req in admitted:        # popped but not admit()ed when a
-                if not req.done.is_set():   # mid-pass exception hit
+            # retire every scheduler-tracked request FIRST (counted via
+            # _record_done), so the sweep below only touches requests
+            # the scheduler never took ownership of — popped but not
+            # admit()ed, or crashed mid-admit before being tracked — and
+            # nothing is finished (or counted) twice
+            self._sched.cancel_active()
+            for req in admitted:
+                if not req.done.is_set():
                     self._counts["cancelled"] += 1
                     req.finish("cancelled", "server shutdown")
-            self._sched.cancel_active()     # counted via _record_done
+            if self._prefix is not None:
+                self._prefix.clear()        # drop the cached chunk K/V
             self._engine.close()
             self._stopped.set()
 
@@ -311,20 +352,42 @@ class InferenceServer:
         with self._cond:
             depth = len(self._queue)
         st = self._stats
+        sc = self._sched
+        pc = self._prefix
         return {
             "requests": dict(self._counts),
             "ttft_ms": ms(self._ttft_s),
             "token_ms": ms(self._tok_gap_s),
             "queue_wait_ms": ms(st._phases.get(profiler.QUEUE_WAIT, [])),
             "prefill_ms": ms(st._phases.get(profiler.PREFILL, [])),
+            "prefill_chunk_ms": ms(st._phases.get(profiler.PREFILL_CHUNK,
+                                                  [])),
+            "prefix_copy_ms": ms(st._phases.get(profiler.PREFIX_COPY, [])),
             "decode_tick_ms": ms(st._phases.get(profiler.DECODE_TICK, [])),
             "queue_depth": {"now": depth, "max": self._queue_depth_max},
-            "slot_occupancy": self._sched.occupancy(),
-            "batch_efficiency": self._sched.batch_efficiency(),
-            "ticks": self._sched.ticks,
-            "tokens_generated": self._sched.tokens_generated,
+            "slot_occupancy": sc.occupancy(),
+            "batch_efficiency": sc.batch_efficiency(),
+            "ticks": sc.ticks,
+            "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
             "kv_cache_bytes": self._engine.cache_bytes(),
+            # chunked prefill + prefix reuse gauges (doc/serving.md):
+            # hit rate is FRACTION OF PROMPT TOKENS restored from the
+            # prefix cache; chunks/req is the mean chunk steps a request
+            # cost (prefix hits lower it below ceil(n/chunk))
+            "prefill_chunks_per_req": (sc.prefill_chunks
+                                       / max(1, sc.requests_prefilled)),
+            "prefix_hit_rate": (pc.hit_tokens / max(1, pc.prompt_tokens)
+                                if pc is not None else 0.0),
+            "prefix_cache_bytes": pc.nbytes if pc is not None else 0,
+            "prefix_cache": ({
+                "budget_bytes": pc.budget, "bytes": pc.nbytes,
+                "chunks": pc.chunks, "hits": pc.hits,
+                "misses": pc.misses, "hit_tokens": pc.hit_tokens,
+                "prompt_tokens": pc.prompt_tokens,
+                "evictions": pc.evictions,
+                "inserted_chunks": pc.inserted_chunks,
+            } if pc is not None else None),
         }
 
     def reset_metrics(self) -> None:
@@ -339,3 +402,9 @@ class InferenceServer:
         self._sched.ticks = 0
         self._sched.active_row_ticks = 0
         self._sched.tokens_generated = 0
+        self._sched.prefill_chunks = 0
+        self._sched.requests_prefilled = 0
+        if self._prefix is not None:
+            # traffic counters only: cached chunks stay warm — a bench's
+            # measured pass is supposed to see the steady state
+            self._prefix.reset_counters()

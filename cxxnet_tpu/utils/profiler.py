@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
            "percentiles", "log", "FEED_WAIT", "STEP_DISPATCH",
-           "METRIC_SYNC", "PREFILL", "DECODE_TICK", "QUEUE_WAIT", "LINT"]
+           "METRIC_SYNC", "PREFILL", "PREFILL_CHUNK", "PREFIX_COPY",
+           "DECODE_TICK", "QUEUE_WAIT", "LINT"]
 
 # canonical phase names of the training hot loop (round 6, async feed):
 #   FEED_WAIT     — blocked on the next batch (host iterator, or the async
@@ -42,11 +43,20 @@ STEP_DISPATCH = "step_dispatch"
 METRIC_SYNC = "metric_sync"
 
 # canonical phase names of the serving hot loop (serve/ scheduler):
-#   PREFILL     — admit: full-prompt forward filling the request's KV slot
-#   DECODE_TICK — one batched decode step across all active slots
-#   QUEUE_WAIT  — time a request sat in the admission queue before a slot
-#                 freed up (recorded at admit via StepStats.record)
+#   PREFILL       — admit: full-prompt forward filling the request's KV slot
+#                   (legacy whole-prompt path, serve_prefill_chunk = 0)
+#   PREFILL_CHUNK — one fixed-size chunk of prefill work (the chunked
+#                   path's unit: the scheduler interleaves these with
+#                   decode ticks instead of stalling on a whole prompt)
+#   PREFIX_COPY   — prefix-cache traffic at admit/retire: cached-chunk
+#                   K/V copied into a fresh row, or a retired row's
+#                   prompt chunks copied out into the trie
+#   DECODE_TICK   — one batched decode step across all active slots
+#   QUEUE_WAIT    — time a request sat in the admission queue before a slot
+#                   freed up (recorded at admit via StepStats.record)
 PREFILL = "prefill"
+PREFILL_CHUNK = "prefill_chunk"
+PREFIX_COPY = "prefix_copy"
 DECODE_TICK = "decode_tick"
 QUEUE_WAIT = "queue_wait"
 

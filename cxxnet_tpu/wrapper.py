@@ -179,11 +179,21 @@ class Net:
 
     # -- online serving (doc/serving.md) ------------------------------
     def serve_start(self, slots: int = 8, queue: int = 32,
-                    timeout_ms: float = 0.0, **defaults) -> None:
+                    timeout_ms: float = 0.0, prefill_chunk: int = 64,
+                    prefill_budget: int = 1, prefix_mb: float = 32.0,
+                    recompile_limit: int = 0, recompile_strict: bool = True,
+                    **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
-        serve``). ``defaults`` seed the per-request SamplingParams
-        (max_tokens / temperature / top_k / top_p / seed / eos)."""
+        serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
+        prefill (0 = legacy whole-prompt prefill), ``prefix_mb`` budgets
+        the shared-prefix KV cache (0 disables reuse), and
+        ``recompile_limit`` extends the recompilation guard to the
+        engine's prefill/chunk programs (``recompile_strict=False``
+        logs CXN205 instead of raising, the CLI's
+        ``lint_recompile_strict=0`` mode). ``defaults`` seed the
+        per-request SamplingParams (max_tokens / temperature / top_k /
+        top_p / seed / eos)."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
         if getattr(self, "_server", None) is not None:
@@ -192,6 +202,9 @@ class Net:
         cfg, params = net_gpt_export(self._net)
         self._server = InferenceServer(
             cfg, params, slots=slots, queue=queue, timeout_ms=timeout_ms,
+            prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
+            prefix_mb=prefix_mb, recompile_limit=recompile_limit,
+            recompile_strict=recompile_strict,
             defaults=SamplingParams(**defaults))
 
     def _serving(self):

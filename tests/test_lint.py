@@ -236,7 +236,12 @@ def test_serve_engine_audit_donation():
     eng = DecodeEngine(cfg, params, slots=4)
     report, infos = audit_serve_engine(eng, n_prompt=4, donate=True)
     assert report.ok(), report.format()
-    for info in infos:       # both KV caches aliased in prefill AND tick
+    # prefill, the chunk-prefill step (engine default chunking), and the
+    # tick must each keep both donated KV caches aliased
+    assert [i["label"] for i in infos] == ["serve_prefill",
+                                           "serve_prefill_chunk",
+                                           "serve_tick"]
+    for info in infos:
         assert info["donated"] == 2 and info["aliased"] == 2, info
 
 

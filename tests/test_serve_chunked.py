@@ -1,0 +1,280 @@
+"""Chunked prefill + shared-prefix KV reuse (serve/engine.py chunk step,
+serve/prefix_cache.py trie, scheduler interleaving). The load-bearing
+invariants: (1) a request prefilled in fixed-size chunks — at any prompt
+length, including non-multiples of the chunk — produces tokens identical
+to its solo ``gpt_decode`` run; (2) a prefix-cache hit restores K/V
+bit-identical to recomputing it, so hit and cold paths emit the same
+tokens; (3) compiled prefill programs are bounded by chunk buckets, not
+distinct prompt lengths (the extended RecompileGuard pins it); (4) a
+long prompt's prefill cannot stall an active row's decode — chunks and
+ticks interleave."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis.findings import LintError
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import DecodeEngine, InferenceServer, PrefixCache
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, **kw):
+    """The offline oracle: the same request run alone through
+    gpt_decode."""
+    seed = kw.pop("seed", 0)
+    t = kw.get("temperature", 0.0)
+    rng = jax.random.PRNGKey(seed) if t > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 rng=rng, **kw))[0]
+
+
+# ------------------------------------------------------ token identity
+def test_chunked_prefill_matches_offline_path():
+    """The tentpole invariant: prompts whose lengths are NOT chunk
+    multiples (plus exact multiples and shorter-than-one-chunk), with
+    mixed sampling params, all reproduce their solo gpt_decode run when
+    prefilled 4 tokens at a time."""
+    rs = np.random.RandomState(0)
+    cases = [
+        dict(n=3, max_tokens=5),                        # < one chunk
+        dict(n=4, max_tokens=6),                        # exact multiple
+        dict(n=5, max_tokens=4, temperature=1.0, seed=3),
+        dict(n=9, max_tokens=6, temperature=0.8, top_k=5, top_p=0.9,
+             seed=7),
+        dict(n=13, max_tokens=5),                       # 3 chunks + 1
+        dict(n=8, max_tokens=4, temperature=1.2, top_k=3, seed=11),
+    ]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                         prefill_chunk=4) as srv:
+        handles = []
+        for c in cases:
+            c = dict(c)
+            c["prompt"] = _prompt(rs, c.pop("n"))
+            handles.append((c, srv.submit(c["prompt"],
+                                          **{k: v for k, v in c.items()
+                                             if k != "prompt"})))
+        for c, h in handles:
+            res = srv.result(h, timeout=300)
+            assert res.status == "ok", (res.status, res.error)
+            kw = {k: v for k, v in c.items() if k not in ("prompt",
+                                                          "max_tokens")}
+            np.testing.assert_array_equal(
+                res.tokens, _ref(c["prompt"], c["max_tokens"], **kw))
+        m = srv.metrics()
+    assert m["prefill_chunks_per_req"] >= 1.0
+    assert set(m["prefill_chunk_ms"]) == {"p50", "p95", "p99"}
+
+
+def test_recycled_slot_multichunk_prompts_no_prefix_reuse():
+    """Chunked prefill does NOT rewrite the whole row — a recycled
+    slot's stale tail must still be unreachable. One slot, two
+    multi-chunk prompts back to back, prefix cache OFF so nothing is
+    shared: both must match their solo runs."""
+    rs = np.random.RandomState(1)
+    a, b = _prompt(rs, 11), _prompt(rs, 7)
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8, prefill_chunk=4,
+                         prefix_mb=0.0) as srv:
+        ha = srv.submit(a, max_tokens=8, temperature=0.7, seed=2)
+        hb = srv.submit(b, max_tokens=8, temperature=0.7, seed=9)
+        res_a = srv.result(ha, timeout=300)
+        res_b = srv.result(hb, timeout=300)
+        assert hb.slot == ha.slot == 0
+    np.testing.assert_array_equal(
+        res_a.tokens, _ref(a, 8, temperature=0.7, seed=2))
+    np.testing.assert_array_equal(
+        res_b.tokens, _ref(b, 8, temperature=0.7, seed=9))
+
+
+# ------------------------------------------------------- prefix cache
+def test_prefix_hit_matches_cold_path():
+    """A second request sharing a 12-token prefix restores 3 cached
+    chunks instead of recomputing them — and its tokens are identical
+    to the cold path's (and to the solo offline run)."""
+    rs = np.random.RandomState(2)
+    shared = _prompt(rs, 12)
+    a = np.concatenate([shared, _prompt(rs, 3)])
+    b = np.concatenate([shared, _prompt(rs, 5)])
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8,
+                         prefill_chunk=4) as srv:
+        res_a = srv.result(srv.submit(a, max_tokens=5, temperature=0.7,
+                                      seed=2), timeout=300)
+        res_b = srv.result(srv.submit(b, max_tokens=5, temperature=0.7,
+                                      seed=9), timeout=300)
+        m = srv.metrics()
+    np.testing.assert_array_equal(
+        res_a.tokens, _ref(a, 5, temperature=0.7, seed=2))
+    np.testing.assert_array_equal(
+        res_b.tokens, _ref(b, 5, temperature=0.7, seed=9))
+    # request b's first 3 chunks (12 tokens) came from a's retired row
+    assert m["prefix_cache"]["hit_tokens"] == 12, m["prefix_cache"]
+    assert m["prefix_cache"]["hits"] == 1
+    assert 0 < m["prefix_hit_rate"] < 1
+    assert m["prefix_cache_bytes"] > 0
+
+
+def test_prefix_budget_zero_disables_reuse():
+    """serve_prefix_mb = 0 turns reuse off entirely: no hits, no cached
+    bytes, tokens still identical."""
+    rs = np.random.RandomState(3)
+    shared = _prompt(rs, 12)
+    a = np.concatenate([shared, _prompt(rs, 3)])
+    b = np.concatenate([shared, _prompt(rs, 5)])
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8, prefill_chunk=4,
+                         prefix_mb=0.0) as srv:
+        res_a = srv.result(srv.submit(a, max_tokens=4), timeout=300)
+        res_b = srv.result(srv.submit(b, max_tokens=4), timeout=300)
+        m = srv.metrics()
+    np.testing.assert_array_equal(res_a.tokens, _ref(a, 4))
+    np.testing.assert_array_equal(res_b.tokens, _ref(b, 4))
+    assert m["prefix_hit_rate"] == 0.0
+    assert m["prefix_cache_bytes"] == 0
+    assert m["prefix_cache"] is None
+
+
+def test_trie_refcount_and_lru_eviction():
+    """PrefixCache mechanics, driven directly: shared chunks become
+    shared nodes, an interior node's refcount counts its children (so
+    eviction unwinds chains leaf first), LRU picks the coldest
+    evictable node, and eviction shortens later matches."""
+    eng = DecodeEngine(CFG, PARAMS, slots=1, prefill_chunk=4)
+    node_bytes = 2 * CFG.n_layer * CFG.n_head * 4 * (CFG.feat
+                                                     // CFG.n_head) * 4
+    cache = PrefixCache(eng, budget_bytes=3 * node_bytes)
+    rs = np.random.RandomState(4)
+    a = _prompt(rs, 12)                     # 3 complete chunks
+    assert cache.insert_from_row(0, a) == 3
+    assert cache.chunks == 3 and cache.nbytes == 3 * node_bytes
+    chain = cache.match(np.concatenate([a, a[:1]]))
+    assert len(chain) == 3
+    # interior nodes are pinned by their children; only the tail is
+    # evictable
+    assert [n.refs for n in chain] == [1, 1, 0]
+    # a second prompt sharing chunks 0-1 with a different chunk 2 adds
+    # ONE node -> over budget -> the LRU evictable leaf (a's tail, older
+    # than b's fresh tail) is dropped
+    b = np.concatenate([a[:8], _prompt(rs, 4)])
+    assert cache.insert_from_row(0, b) == 1
+    assert cache.evictions == 1
+    assert cache.chunks == 3 and cache.nbytes == 3 * node_bytes
+    assert len(cache.match(np.concatenate([a, a[:1]]))) == 2
+    assert len(cache.match(np.concatenate([b, b[:1]]))) == 3
+    # shrinking the budget unwinds the remaining chain leaf first — the
+    # root chunk survives to the end
+    cache.budget = node_bytes
+    assert cache.evict_to_budget() == 2
+    assert cache.chunks == 1
+    (root_node,) = cache.match(np.concatenate([a[:4], a[:1]]))
+    assert root_node.tokens == tuple(int(t) for t in a[:4])
+    assert root_node.refs == 0
+    # a chain larger than the WHOLE budget is truncated up front — it
+    # must not flush warm entries for a tail eviction would trim anyway
+    small = PrefixCache(eng, budget_bytes=2 * node_bytes)
+    assert small.insert_from_row(0, _prompt(rs, 16)) == 2   # of 4 chunks
+    assert small.chunks == 2 and small.evictions == 0
+    # budget 0 = disabled: no lookups, no inserts
+    off = PrefixCache(eng, budget_bytes=0)
+    assert not off.enabled
+    assert off.match(a) == [] and off.insert_from_row(0, a) == 0
+
+
+# ------------------------------------------- compiled-program bounding
+def test_chunk_signatures_bounded_under_mixed_lengths():
+    """The acceptance bound: >= 30 distinct prompt lengths through the
+    chunked path compile <= 4 prefill/chunk signatures (here: exactly
+    one), asserted via the engine's RecompileGuard."""
+    rs = np.random.RandomState(5)
+    with InferenceServer(CFG, PARAMS, slots=4, queue=40, prefill_chunk=4,
+                         prefix_mb=0.0, recompile_limit=4) as srv:
+        handles = [srv.submit(_prompt(rs, n), max_tokens=1)
+                   for n in range(2, 32)]         # 30 distinct lengths
+        for h in handles:
+            assert srv.result(h, timeout=300).status == "ok"
+        sigs = srv._engine.prefill_signatures
+    assert 1 <= len(sigs) <= 4, sigs
+
+
+def test_whole_prompt_guard_trips_naming_the_drifting_dimension():
+    """The legacy path under the same guard: each new prompt length is a
+    new compiled program, and the limit trips with the drifting
+    dimension named (CXN205 via analysis/recompile.py)."""
+    eng = DecodeEngine(CFG, PARAMS, slots=1, prefill_chunk=0,
+                       recompile_limit=2)
+    rs = np.random.RandomState(6)
+    key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+    eng.prefill(0, _prompt(rs, 3), key, 0.0, 0, 1.0)
+    eng.prefill(0, _prompt(rs, 4), key, 0.0, 0, 1.0)
+    with pytest.raises(LintError, match="n_prompt"):
+        eng.prefill(0, _prompt(rs, 5), key, 0.0, 0, 1.0)
+    assert len(eng.prefill_signatures) == 3
+
+
+# --------------------------------------------------------- scheduling
+def test_long_prompt_prefill_does_not_stall_active_row():
+    """Interleaving: while a 40-token prompt prefills 2 tokens per pass
+    (20 chunk steps), an already-active row keeps ticking — it finishes
+    its whole generation BEFORE the long prompt produces its first
+    token, instead of convoying behind the prefill."""
+    rs = np.random.RandomState(7)
+    a = _prompt(rs, 3)
+    b = _prompt(rs, 40)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=2,
+                         prefix_mb=0.0) as srv:
+        ha = srv.submit(a, max_tokens=6)
+        deadline = time.time() + 60
+        while ha.status in ("queued", "prefill") and time.time() < deadline:
+            time.sleep(0.005)               # wait until a is decoding
+        hb = srv.submit(b, max_tokens=2)
+        res_a = srv.result(ha, timeout=300)
+        res_b = srv.result(hb, timeout=300)
+    assert res_a.status == "ok" and res_b.status == "ok"
+    np.testing.assert_array_equal(res_a.tokens, _ref(a, 6))
+    np.testing.assert_array_equal(res_b.tokens, _ref(b, 2))
+    # a retired strictly before b's prefill completed
+    assert ha.done_t < hb.first_token_t, (ha.done_t, hb.first_token_t)
+
+
+def test_scheduler_crash_cancels_each_request_exactly_once():
+    """A device-call failure mid-pass (after requests were admitted into
+    slots) must finish every in-flight request exactly once: the
+    scheduler retires the ones it tracks, the server's sweep only
+    touches untracked ones — no double finish, no double count."""
+    rs = np.random.RandomState(8)
+    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4)
+    boom = RuntimeError("injected chunk failure")
+
+    def exploding(*a, **kw):
+        raise boom
+
+    srv._engine.prefill_chunk = exploding
+    handles = [srv.submit(_prompt(rs, 9), max_tokens=4) for _ in range(3)]
+    results = [srv.result(h, timeout=60) for h in handles]
+    srv.shutdown(drain=False)
+    assert [r.status for r in results] == ["cancelled"] * 3
+    m = srv.metrics()
+    assert m["requests"]["cancelled"] == 3, m["requests"]
+    assert m["requests"]["submitted"] == 3
+
+
+# --------------------------------------------------------- step audit
+def test_chunk_step_lint_specs_fully_aliased():
+    """lint_specs passes on the chunk step: prefill, chunk-prefill AND
+    tick executables keep both donated caches aliased (pinned with
+    donate=True on the CPU mesh, the test_lint idiom)."""
+    from cxxnet_tpu.analysis import audit_serve_engine
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4)
+    report, infos = audit_serve_engine(eng, n_prompt=5, donate=True)
+    assert report.ok(), report.format()
+    labels = [i["label"] for i in infos]
+    assert labels == ["serve_prefill", "serve_prefill_chunk", "serve_tick"]
+    for info in infos:
+        assert info["donated"] == 2 and info["aliased"] == 2, info

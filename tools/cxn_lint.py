@@ -11,8 +11,10 @@ data files or devices are needed, so this is the fast tier-1 CI check;
 tests/test_lint.py wires it into pytest). ``--compile`` additionally
 builds the net (init_model on the default backend) and audits the
 compiled steps (pass 2: donation aliasing, dtype promotion, host
-transfers, collectives). ``k=v`` args are CLI-style overrides linted as
-line-less pairs.
+transfers, collectives); for a GPT-shaped config it also audits the
+serve engine's prefill / chunk-prefill / tick executables — the
+programs ``task=serve`` runs. ``k=v`` args are CLI-style overrides
+linted as line-less pairs.
 
 Exit codes: 0 clean (warnings allowed), 1 lint errors, 2 usage error.
 """
@@ -46,6 +48,33 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
         net.init_model()
         audit_report, infos = audit_net(net)
         report.extend(audit_report.findings)
+        # GPT-shaped configs get the serving executables audited too —
+        # prefill, the chunk-prefill step, and the decode tick are the
+        # programs task=serve actually runs, and their donation aliasing
+        # is a different contract from the train steps'. Only the
+        # export's own "not GPT-shaped" verdict (ConfigError) skips the
+        # audit; any other failure propagates so a broken export cannot
+        # silently drop the serve audit while CI stays green.
+        try:
+            from cxxnet_tpu.nnet.lm import net_gpt_export
+            from cxxnet_tpu.utils.config import ConfigError
+            gcfg, gparams = net_gpt_export(net)
+        except ConfigError:
+            gcfg = None
+            if verbose:
+                print("  (not GPT-shaped: serve-engine audit skipped)")
+        if gcfg is not None:
+            from cxxnet_tpu.analysis import audit_serve_engine
+            from cxxnet_tpu.serve.engine import DecodeEngine
+            # abstract engine: the audit AOT-lowers against
+            # ShapeDtypeStruct caches, so no slot-pool KV is allocated
+            # for a lint step that never executes anything
+            eng = DecodeEngine(gcfg, gparams, slots=2,
+                               prefill_chunk=task.serve_prefill_chunk,
+                               abstract=True)
+            serve_report, serve_infos = audit_serve_engine(eng)
+            report.extend(serve_report.findings)
+            infos += serve_infos
         if verbose:
             from cxxnet_tpu.analysis import format_step_info
             for info in infos:
