@@ -13,6 +13,11 @@ Prints one JSON line per metric, in this order:
   7. gpt_decode_ms_per_token        (85M batch-1, cache 1024, fused
                                      whole-step kernel; r3 quoted 0.74;
                                      best-of-5 since round 7)
+  7b. gpt_decode_spec_ms_per_token  (speculative draft-and-verify decode,
+                                     n-gram drafter on a repetitive-
+                                     suffix prompt; vs_baseline = the
+                                     same prompt non-speculative,
+                                     round 10)
   8. serve_tokens_per_sec           (continuous-batching serving cell:
                                      steady-state aggregate tokens/s of
                                      the slot scheduler under an open-
@@ -31,6 +36,10 @@ Prints one JSON line per metric, in this order:
                                      SAME trace through the legacy
                                      whole-prompt prefill — >1 means
                                      chunking + reuse cut p95 TTFT)
+ 12b. serve_spec_tokens_per_sec     (speculative serving: n-gram drafter
+                                     on a repetitive-suffix trace;
+                                     vs_baseline = the same trace served
+                                     without speculation, round 10)
  13. lint_wall_ms                   (cxn-lint pass 1 on the largest
                                      example config — the CXN_LINT
                                      startup/CI cost, round 8)
@@ -431,6 +440,61 @@ def decode_cell(layers=DECODE_CELL["layers"], heads=DECODE_CELL["heads"],
     return best / max_new
 
 
+# the speculative decode cell: the decode-cell geometry with a
+# repetitive-suffix prompt — a steady-state window CUT FROM THE MODEL'S
+# OWN greedy stream (random-init models don't continue an arbitrary
+# tiled pattern, but they do keep producing self-similar text, which is
+# exactly the traffic shape the n-gram/prompt-lookup drafter hits on any
+# checkpoint). Single source so the spec and non-spec passes cannot
+# drift onto different prompts.
+SPEC_CELL = dict(prompt_len=64, warm_tokens=120, spec_len=8, max_new=256)
+
+
+def bench_decode_spec():
+    """Speculative offline decode (round 10, doc/serving.md): the
+    decode-cell model with the n-gram drafter on a repetitive-suffix
+    prompt, best-of-3 warm. vs_baseline = the SAME prompt through the
+    plain (non-speculative) decode, measured in the same run — > 1.0
+    means draft-and-verify beats one-forward-per-token; the line also
+    records the observed accept_rate, since the win degrades to a small
+    loss (per-verify overhead) when the drafter stops hitting."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+
+    c, s = DECODE_CELL, SPEC_CELL
+    cfg = GPTConfig(vocab_size=256, seq_len=c["seq"], n_layer=c["layers"],
+                    n_head=c["heads"], feat=c["feat"], n_microbatch=1,
+                    dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(0)
+    seed = jax.numpy.asarray(rs.randint(0, 256, (1, 8)).astype(np.int32))
+    warm = np.asarray(gpt_decode(params, seed, s["warm_tokens"], cfg))[0]
+    prompt = jax.numpy.asarray(
+        warm[None, -s["prompt_len"]:].astype(np.int32))
+    max_new = min(s["max_new"], c["seq"] - s["prompt_len"])
+    spec = {"mode": "ngram", "spec_len": s["spec_len"], "stats": {}}
+
+    def run(sp):
+        np.asarray(gpt_decode(params, prompt, max_new, cfg,
+                              speculative=sp))        # warm/compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(gpt_decode(params, prompt, max_new, cfg,
+                                  speculative=sp))
+            best = min(best, time.perf_counter() - t0)
+        return best / max_new
+
+    base_ms = run(None) * 1e3
+    spec_ms = run(spec) * 1e3
+    emit("gpt_decode_spec_ms_per_token", spec_ms, "ms/token",
+         base_ms / spec_ms,
+         accept_rate=round(spec["stats"]["accept_rate"], 3),
+         spec_tokens_per_forward=round(
+             spec["stats"]["spec_tokens_per_forward"], 2),
+         plain_ms_per_token=round(base_ms, 4))
+
+
 def bench_decode():
     """Batch-1 KV-cache decode on the 85M model (fused whole-step kernel
     auto-engages; tools/decode_bench.py is the A/B harness). The int8
@@ -611,6 +675,82 @@ def bench_serve_prefill_heavy():
          whole_prefill_p95_ms=round(m0["ttft_ms"]["p95"], 1))
 
 
+def serve_spec_trace(cfg, params, cell=None):
+    """Seeded repetitive-suffix serving trace: [(gap_s, prompt,
+    max_tokens)] with Poisson open-loop arrivals — every prompt is a
+    window cut from the model's OWN greedy stream (self-similar
+    traffic, the shape where the n-gram drafter's prompt lookup hits on
+    any checkpoint; see SPEC_CELL)."""
+    import jax
+    from cxxnet_tpu.models.gpt import gpt_decode
+
+    c = cell or SERVE_CELL
+    rs = np.random.RandomState(c["seed"] + 17)
+    seed = jax.numpy.asarray(
+        rs.randint(0, c["vocab"], (1, 8)).astype(np.int32))
+    # window + warm-stream lengths scale with the cell's seq_len so the
+    # trace stays valid for CPU-scaled geometries too
+    win = min(64, cfg.seq_len // 3)
+    warm_n = min(160, cfg.seq_len - 9)
+    warm = np.asarray(gpt_decode(params, seed, warm_n, cfg))[0]
+    gaps = rs.exponential(c["mean_gap_ms"] / 1e3, c["n_requests"])
+    maxt = rs.choice([32, 64], c["n_requests"])
+    out = []
+    for g, m in zip(gaps, maxt):
+        start = int(rs.randint(8, len(warm) - win))
+        out.append((float(g), warm[start:start + win].astype(np.int32),
+                    int(m)))
+    return out
+
+
+def bench_serve_spec():
+    """Speculative serving cell (round 10): the SERVE_CELL model served
+    with the n-gram drafter (spec_mode=ngram) vs the PR-4 serving
+    configuration (chunked prefill + prefix cache, no speculation) on
+    the SAME repetitive-suffix request set. The HEADLINE is the
+    low-occupancy single-slot pass — the latency regime speculation is
+    for, where a verify forward has the offline path's economics (it
+    replaces batch-1 ticks one-for-one) — with vs_baseline =
+    spec/non-spec tokens/s. The saturated 8-slot open-loop pass rides
+    along as extra fields: there per-slot verifies compete with the
+    batched tick, and the scheduler's accept-rate back-off
+    (serve/scheduler.py SPEC_BACKOFF_*) is what bounds the loss —
+    batched_vs_baseline ~1.0 with backoffs > 0 means the containment
+    worked, not that speculation won."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+
+    c = SERVE_CELL
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_spec_trace(cfg, params, c)
+    # headline: sequential single-slot service (no arrival gaps)
+    t1 = [(0.0, p, m) for _, p, m in trace[:c["n_requests"] // 2]]
+    kw1 = dict(slots=1, queue=c["n_requests"])
+    wall, m_ = run_serve_trace(cfg, params, t1, spec_mode="ngram",
+                               spec_len=8, **kw1)
+    wall0, m0 = run_serve_trace(cfg, params, t1, **kw1)
+    tps = m_["tokens_generated"] / wall
+    tps0 = m0["tokens_generated"] / wall0
+    # rider: the saturated 8-slot open-loop pass
+    kw8 = dict(slots=c["slots"], queue=c["n_requests"])
+    wall8, m8 = run_serve_trace(cfg, params, trace, spec_mode="ngram",
+                                spec_len=8, **kw8)
+    wall80, m80 = run_serve_trace(cfg, params, trace, **kw8)
+    tps8 = m8["tokens_generated"] / wall8
+    tps80 = m80["tokens_generated"] / wall80
+    emit("serve_spec_tokens_per_sec", tps, "tokens/sec", tps / tps0,
+         accept_rate=round(m_["accept_rate"], 3),
+         spec_tokens_per_forward=round(m_["spec_tokens_per_forward"], 2),
+         spec_rollback_rate=round(m_["spec_rollback_rate"], 3),
+         nonspec_tokens_per_sec=round(tps0, 1),
+         batched_vs_baseline=round(tps8 / tps80, 3),
+         batched_accept_rate=round(m8["accept_rate"], 3),
+         batched_backoffs=m8["spec_backoffs"])
+
+
 def bench_lint():
     """cxn-lint pass-1 wall time on the LARGEST example config (round 8):
     the linter runs at every CXN_LINT startup and in CI, so its cost is a
@@ -633,8 +773,8 @@ def bench_lint():
 def main() -> int:
     rc = 0
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
-               bench_moe, bench_decode, bench_serve,
-               bench_serve_prefill_heavy, bench_lint):
+               bench_moe, bench_decode, bench_decode_spec, bench_serve,
+               bench_serve_prefill_heavy, bench_serve_spec, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -259,10 +259,13 @@ def audit_serve_engine(engine, n_prompt: int = 8,
     """Audit the serve engine's prefill (one representative prompt
     length), the chunk-prefill step (when the engine runs chunked —
     its donation aliasing matters double: the chunk program runs
-    ceil(n/chunk) times per admit), and the shared decode tick.
-    ``donate`` overrides the engine's backend-gated donation choice —
-    tests pass True to pin the aliasing contract even on the CPU
-    mesh."""
+    ceil(n/chunk) times per admit), the speculative
+    ``serve_verify_chunk`` step (when the engine was built with a
+    ``spec_len`` — a verify forward runs once per speculative window,
+    so an unaliased cache there would copy the whole slot pool every
+    few tokens), and the shared decode tick. ``donate`` overrides the
+    engine's backend-gated donation choice — tests pass True to pin
+    the aliasing contract even on the CPU mesh."""
     report = LintReport()
     infos = []
     for label, fn, args, donate_nums in engine.lint_specs(

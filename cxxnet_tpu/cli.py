@@ -85,6 +85,13 @@ class LearnTask:
         #                                 interleaved per decode tick
         self.serve_prefix_mb = 32.0     # task=serve: shared-prefix KV
         #                                 cache budget in MiB (0 = off)
+        self.spec_mode = "off"    # speculative decoding draft source:
+        #                           off | ngram (prompt lookup) | model
+        self.spec_len = 4         # draft tokens verified per forward
+        self.spec_model_netconfig = ""  # spec_mode=model: netconfig file
+        #                                 of the small draft model
+        self.spec_model_in = ""   # spec_mode=model: draft model snapshot
+        #                           (empty = random init — testing only)
         self.lint_compile = 0     # task=lint: also lower/compile-audit the
         #                           jitted steps (pass 2; needs init_model)
         self.net: Optional[Net] = None
@@ -167,6 +174,14 @@ class LearnTask:
             self.serve_prefill_budget = int(val)
         elif name == "serve_prefix_mb":
             self.serve_prefix_mb = float(val)
+        elif name == "spec_mode":
+            self.spec_mode = val
+        elif name == "spec_len":
+            self.spec_len = int(val)
+        elif name == "spec_model_netconfig":
+            self.spec_model_netconfig = val
+        elif name == "spec_model_in":
+            self.spec_model_in = val
         elif name == "name_pred":
             # output path for pred/extract; the `pred = <path>` section
             # marker also sets it (reference cxxnet_main.cpp honors both —
@@ -623,29 +638,63 @@ class LearnTask:
         # export the weight tree ONCE: repeated net_generate calls (the
         # warm-timing pass below) must time the decode, not the export
         export = net_gpt_export(self.net)
+        spec = None
+        if self.spec_mode != "off":
+            # offline draft-and-verify (gpt_decode(speculative=...)):
+            # greedy output stays bit-identical, the drafter only
+            # changes how many forwards the stream costs
+            spec = {"mode": self.spec_mode, "spec_len": self.spec_len,
+                    "model": self._spec_model_export(), "stats": {}}
         t0 = time.time()
         out = net_generate(self.net, batch, self.num_gen,
                            temperature=self.temperature, rng=rng,
                            export=export, int8=bool(self.generate_int8),
                            top_k=self.generate_topk,
-                           top_p=self.generate_topp)
+                           top_p=self.generate_topp, speculative=spec)
         dt = time.time() - t0
         with open(self.generate_out, "w") as fo:
             for row in out:
                 fo.write(" ".join(str(int(t)) for t in row) + "\n")
         print("finished generation, write into %s (%.1fs incl. compile)"
               % (self.generate_out, dt))
+        if spec is not None:
+            print("speculative (%s x%d): accept %.0f%%, %.1f tokens/"
+                  "forward" % (self.spec_mode, self.spec_len,
+                               100.0 * spec["stats"]["accept_rate"],
+                               spec["stats"]["spec_tokens_per_forward"]))
         if self.generate_bench:
             t0 = time.time()
             net_generate(self.net, batch, self.num_gen,
                          temperature=self.temperature, rng=rng,
                          export=export, int8=bool(self.generate_int8),
                          top_k=self.generate_topk,
-                         top_p=self.generate_topp)
+                         top_p=self.generate_topp, speculative=spec)
             warm = time.time() - t0
             print("generate_bench: %.4f ms/token warm (batch %d, %d new "
                   "tokens)" % (warm * 1e3 / self.num_gen, batch.shape[0],
                                self.num_gen))
+
+    def _spec_model_export(self):
+        """(draft_cfg, draft_params) for ``spec_mode = model``: build the
+        draft Net from ``spec_model_netconfig`` (a netconfig file with
+        the same GPT shape at reduced depth/width), load its snapshot
+        from ``spec_model_in`` when given (a random-init draft model is
+        a valid but useless drafter — identity never depends on it, only
+        accept_rate does). None for the other modes."""
+        if self.spec_mode != "model":
+            return None
+        assert self.spec_model_netconfig, \
+            "spec_mode=model needs spec_model_netconfig=<config>"
+        sub = LearnTask()
+        for name, val in load_config(self.spec_model_netconfig):
+            sub.set_param(name, val)
+        from .nnet.lm import net_gpt_export
+        dnet = Net(sub._trainer_cfg())
+        if self.spec_model_in:
+            dnet.load_model(self.spec_model_in)
+        else:
+            dnet.init_model()
+        return net_gpt_export(dnet)
 
     def task_serve(self) -> None:
         """Online serving: keep the model hot behind a request queue (the
@@ -685,7 +734,10 @@ class LearnTask:
                               prefix_mb=self.serve_prefix_mb,
                               recompile_limit=self.net.lint_recompile_limit,
                               recompile_strict=bool(
-                                  self.net.lint_recompile_strict))
+                                  self.net.lint_recompile_strict),
+                              spec_mode=self.spec_mode,
+                              spec_len=self.spec_len,
+                              spec_model=self._spec_model_export())
         if not self.silent:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (
@@ -694,6 +746,9 @@ class LearnTask:
                     if self.serve_prefix_mb > 0 else "off")
             else:
                 mode = "whole-prompt prefill, prefix cache off"
+            if self.spec_mode != "off":
+                mode += ", speculative %s x%d" % (self.spec_mode,
+                                                  self.spec_len)
             print("serving: %d slots, queue %d, %s (one prompt per "
                   "line; EOF drains and exits)"
                   % (self.serve_slots, self.serve_queue, mode),
@@ -776,6 +831,12 @@ class LearnTask:
                         if m["prefix_cache"] is not None else "cache off")
                 else:
                     extra = "whole-prompt prefill"
+                if self.spec_mode != "off":
+                    extra += ("; spec accept %.0f%% (%.1f tok/fwd, "
+                              "rollback %.0f%%)"
+                              % (100.0 * m["accept_rate"],
+                                 m["spec_tokens_per_forward"],
+                                 100.0 * m["spec_rollback_rate"]))
                 print("serve: %d ok / %d timeout / %d rejected; "
                       "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "
                       "batch efficiency %.2f over %d ticks; %s"

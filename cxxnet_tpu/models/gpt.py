@@ -927,7 +927,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                temperature: float = 0.0,
                rng: Optional[jax.Array] = None,
                int8_weights: bool = False,
-               top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+               top_k: int = 0, top_p: float = 1.0,
+               speculative=None) -> jnp.ndarray:
     """Generate ``max_new`` (>= 1) tokens after ``prompt`` (b, n_prompt)
     int32. temperature 0 = greedy; else categorical sampling with ``rng``,
     optionally restricted by ``top_k`` (keep the k most likely tokens;
@@ -946,7 +947,17 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     interpret-mode differential + the on-chip token-agreement smoke.
     Requires the fused path (single shard); ignored with a notice
     otherwise.
-    """
+
+    ``speculative`` (opt-in, round 10): draft-and-verify multi-token
+    decoding (serve/speculative.py) — an int is a ``spec_len`` for the
+    zero-cost n-gram/prompt-lookup drafter, a dict takes ``{"mode":
+    "ngram" | "model", "spec_len": K, "model": (draft_cfg,
+    draft_params), "stats": {}}`` (``stats`` is filled with
+    accept_rate / forwards / drafted on return). Greedy output is
+    bit-identical to the non-speculative scan; sampled output is
+    identical in distribution. The speculative path runs on the XLA
+    decode formulation (it shares the serving engine's programs), so
+    ``int8_weights`` does not compose with it and is rejected."""
     n_prompt = int(prompt.shape[1])
     if max_new < 1:
         raise ValueError("max_new must be >= 1, got %d" % max_new)
@@ -959,6 +970,22 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         raise ValueError("top_k must be >= 0 (0 disables), got %d" % top_k)
     if not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1], got %g" % top_p)
+    if speculative:
+        if int8_weights:
+            raise ValueError("speculative decoding runs the XLA decode "
+                             "path; int8_weights needs the fused kernel "
+                             "— pick one")
+        # lazy import: serve imports models.gpt at module load, so the
+        # reverse edge must stay inside this branch
+        import numpy as np
+
+        from ..serve.speculative import speculative_decode
+        spec = ({"spec_len": int(speculative)}
+                if isinstance(speculative, int) else dict(speculative))
+        return jnp.asarray(speculative_decode(
+            params, np.asarray(prompt, np.int32), max_new, cfg,
+            temperature=float(temperature), rng=rng, top_k=int(top_k),
+            top_p=float(top_p), spec=spec))
     if temperature <= 0:
         # the filters are inert on the greedy path; normalizing them out
         # of the _decode_fn cache key avoids compiling duplicate

@@ -183,7 +183,8 @@ def net_generate(net, prompt: np.ndarray, max_new: int,
                  rng: Optional[jax.Array] = None,
                  export: Optional[Tuple] = None,
                  int8: bool = False,
-                 top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
+                 top_k: int = 0, top_p: float = 1.0,
+                 speculative=None) -> np.ndarray:
     """Generate tokens from a GPT-shaped Net: prompt (b, n_prompt) int ->
     (b, n_prompt + max_new) int32. Drives models/gpt.py:gpt_decode — the
     fused whole-step decode kernel auto-engages on one chip exactly as on
@@ -192,7 +193,10 @@ def net_generate(net, prompt: np.ndarray, max_new: int,
     fine for one-shot generation, wrong for timing loops; cli.py's
     ``generate_bench`` exports once). ``top_k``/``top_p`` restrict the
     sampling candidate set when ``temperature > 0`` (ops/sampling.py;
-    0 / 1.0 disable)."""
+    0 / 1.0 disable). ``speculative`` passes through to
+    ``gpt_decode(speculative=...)`` — draft-and-verify multi-token
+    decoding (an int spec_len for the n-gram drafter, or the full dict
+    form; greedy output stays bit-identical)."""
     from ..models.gpt import gpt_decode
     cfg, params = export if export is not None else net_gpt_export(net)
     prompt = jnp.asarray(np.asarray(prompt, np.int32))
@@ -200,7 +204,7 @@ def net_generate(net, prompt: np.ndarray, max_new: int,
         rng = jax.random.PRNGKey(net.seed)
     out = gpt_decode(params, prompt, max_new, cfg,
                      temperature=temperature, rng=rng, int8_weights=int8,
-                     top_k=top_k, top_p=top_p)
+                     top_k=top_k, top_p=top_p, speculative=speculative)
     return np.asarray(out)
 
 

@@ -29,6 +29,15 @@ batch where every request carries its own k. Exact logit ties at the
 threshold are all kept (deterministic, order-free); for sampling this is
 the right bias — a tie at the boundary means the distribution itself
 does not distinguish the candidates.
+
+Speculative decoding (serve/speculative.py) builds on the same filtered
+distribution: :func:`accept_draft_rows` runs the per-row rejection test
+for a deterministic draft proposal and :func:`residual_sample_rows`
+draws the correction/bonus token from the draft-excluded residual —
+together they leave the emitted distribution exactly equal to a direct
+:func:`sample_rows` draw (chi-squared-pinned in tests/test_sampling.py),
+and greedy rows reduce to argmax-prefix acceptance, which is what keeps
+speculative greedy streams bit-identical to the plain decode.
 """
 
 from __future__ import annotations
@@ -36,7 +45,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["filter_logits", "sample_rows"]
+__all__ = ["filter_logits", "sample_rows", "accept_draft_rows",
+           "residual_sample_rows"]
 
 
 def filter_logits(logits: jnp.ndarray, top_k=0, top_p=1.0) -> jnp.ndarray:
@@ -78,6 +88,31 @@ def filter_logits(logits: jnp.ndarray, top_k=0, top_p=1.0) -> jnp.ndarray:
     return jnp.where(keep_p, out, -jnp.inf)
 
 
+def _scaled_filtered(logits, temperature, top_k, top_p):
+    """Shared per-row prologue: (f32 temperature, temperature-scaled
+    top-k/top-p-filtered logits). EVERY per-row sampler below must run
+    this exact pipeline — the speculative accept/residual pair's
+    distribution identity with a direct :func:`sample_rows` draw (and
+    with it the serve-vs-generate identity tests) holds only while all
+    of them filter byte-identically. Greedy rows scale by 1 so the
+    division never sees 0."""
+    temperature = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    return temperature, filter_logits(
+        logits / safe_t[:, None].astype(logits.dtype), top_k, top_p)
+
+
+def _draw_rows(logits, filt, keys, temperature):
+    """Shared per-row epilogue: one categorical draw per row from the
+    filtered logits (vmap — semantically identical to the per-row loop,
+    which is what lets a slot row reproduce gpt_decode's batch-1 pick),
+    greedy argmax of the RAW logits where temperature <= 0."""
+    sampled = jax.vmap(
+        lambda l, k: jax.random.categorical(k, l[None, :], -1)[0])(filt, keys)
+    greedy = jnp.argmax(logits, -1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
 def sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
                 temperature: jnp.ndarray, top_k: jnp.ndarray,
                 top_p: jnp.ndarray) -> jnp.ndarray:
@@ -91,11 +126,65 @@ def sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
     batch-1 ``pick`` computes for the same key and parameters. That
     equality is what the serve-vs-generate identity tests pin.
     """
-    temperature = jnp.asarray(temperature, jnp.float32)
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    filt = filter_logits(logits / safe_t[:, None].astype(logits.dtype),
-                         top_k, top_p)
-    sampled = jax.vmap(
-        lambda l, k: jax.random.categorical(k, l[None, :], -1)[0])(filt, keys)
-    greedy = jnp.argmax(logits, -1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    temperature, filt = _scaled_filtered(logits, temperature, top_k, top_p)
+    return _draw_rows(logits, filt, keys, temperature)
+
+
+def accept_draft_rows(logits: jnp.ndarray, draft: jnp.ndarray,
+                      keys: jnp.ndarray, temperature: jnp.ndarray,
+                      top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row speculative accept test for a DEGENERATE (probability-1)
+    draft proposal — both serving drafters are deterministic: the n-gram
+    lookup proposes one continuation, and the draft model drafts
+    greedily. ``logits`` (rows, V) are the TARGET model's logits at each
+    draft position, ``draft`` (rows,) the proposed tokens, ``keys``
+    (rows, 2) one PRNG key per row (derived from the per-token fold_in
+    schedule by the caller).
+
+    Greedy rows (``temperature <= 0``) accept iff the draft equals the
+    target argmax — the longest-matching-prefix rule that keeps
+    speculative greedy output bit-identical to the solo decode. Sampled
+    rows run the standard rejection test ``u < p(draft)`` with ``p`` the
+    temperature-scaled, top-k/top-p-filtered softmax (the q ≡ 1 case of
+    accept-with-min(1, p/q)); combined with
+    :func:`residual_sample_rows` on rejection the emitted token is
+    distributed exactly as a direct ``sample_rows`` draw — pinned by the
+    chi-squared test in tests/test_sampling.py."""
+    temperature, filt = _scaled_filtered(logits, temperature, top_k, top_p)
+    probs = jax.nn.softmax(filt.astype(jnp.float32), axis=-1)
+    p_d = jnp.take_along_axis(probs, draft[:, None].astype(jnp.int32),
+                              axis=-1)[:, 0]
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(keys)
+    greedy_acc = draft == jnp.argmax(logits, -1)
+    return jnp.where(temperature > 0, u < p_d, greedy_acc)
+
+
+def residual_sample_rows(logits: jnp.ndarray, draft: jnp.ndarray,
+                         keys: jnp.ndarray, temperature: jnp.ndarray,
+                         top_k: jnp.ndarray,
+                         top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row draw of the token EMITTED at a speculative verify row:
+    the residual distribution after rejecting a degenerate proposal
+    ``draft`` — the filtered softmax with the draft token masked out and
+    implicitly renormalized ((p - q)+ with q ≡ 1 on the draft). Pass
+    ``draft = -1`` (matches no vocab index) for the no-rejection bonus
+    row, where this reduces to a plain filtered draw — the same
+    computation :func:`sample_rows` performs. Greedy rows take the plain
+    argmax (a greedy rejection already means draft != argmax, so the
+    exclusion is vacuous and the emitted token is exactly the solo
+    path's pick).
+
+    Together the accept/residual pair leaves the output distribution
+    unchanged: P(emit x) = p(x)·1[x = d] + (1 - p(d))·p(x)/(1 - p(d)) =
+    p(x). The all-masked corner (the draft is the ONLY filtered
+    candidate yet was rejected — measure-zero since p(draft) = 1 makes
+    the accept test u < 1 always pass) falls back to the unexcluded
+    filtered row rather than sampling an all -inf one; with a single
+    finite candidate that deterministically re-emits the draft, the only
+    token the filters left."""
+    temperature, filt = _scaled_filtered(logits, temperature, top_k, top_p)
+    v = logits.shape[-1]
+    excl = jnp.where(jnp.arange(v)[None, :] == draft[:, None].astype(
+        jnp.int32), -jnp.inf, filt)
+    excl = jnp.where(jnp.isfinite(excl).any(-1, keepdims=True), excl, filt)
+    return _draw_rows(logits, excl, keys, temperature)

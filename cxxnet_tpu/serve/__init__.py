@@ -17,7 +17,9 @@ from .prefix_cache import PrefixCache
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      ServeResult)
+from .speculative import ModelDrafter, NgramDrafter, SpeculativeDecoder
 
 __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
-           "AdmissionError", "QueueFullError"]
+           "AdmissionError", "QueueFullError", "NgramDrafter",
+           "ModelDrafter", "SpeculativeDecoder"]

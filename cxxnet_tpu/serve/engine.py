@@ -24,7 +24,15 @@ This engine re-cuts the same math at the granularity a scheduler needs:
 * **tick** — ONE jitted batched decode step across ALL slot rows, each
   row at its own position with its own sampling params and PRNG key.
   Rows advance independently, so short and long requests interleave
-  instead of convoying behind the longest member of a fixed batch.
+  instead of convoying behind the longest member of a fixed batch;
+* **verify** — ONE jitted draft-and-verify step (``serve_verify_chunk``,
+  speculative decoding): ``spec_len`` drafted tokens plus the row's
+  pending token run through the model in a single forward, all
+  candidate K/V rows written, the accepted prefix and one
+  correction/bonus token computed on device — up to ``spec_len + 1``
+  tokens per forward instead of one per tick. Slot, position, and the
+  real draft count are traced, so mixed n-gram hit lengths share one
+  compiled signature (its own RecompileGuard enforces that).
 
 Compiled-program hygiene: every prefill/chunk program fetch is counted
 by a :class:`~cxxnet_tpu.analysis.recompile.RecompileGuard` when
@@ -84,7 +92,8 @@ from jax import lax
 from ..models.gpt import (GPTConfig, _block_core_fusedqkv, _fuse_qkv_blocks,
                           _layernorm)
 from ..ops.attention import local_attention
-from ..ops.sampling import sample_rows
+from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
+                            sample_rows)
 
 __all__ = ["DecodeEngine"]
 
@@ -287,6 +296,130 @@ def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool):
     return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
 
 
+def _attn_verify(q, ck, cv, pos):
+    """Multi-query cached attention for the draft-and-verify step: q
+    (1, K+1, H, d) token-major against the row's head-major caches
+    (1, H, S, d), query i masked at absolute position ``pos + i``. This
+    is _attn_cached_rows' EXACT arithmetic (f32-cast einsums, the same
+    ``/ d ** 0.5`` scaling, -1e30 mask, f32 softmax) with the query
+    count widened from 1 to K+1 — query rows are independent through
+    every op here (batch dims of the einsums, row-wise softmax), so row
+    i reproduces bit for bit what the batched tick would compute for
+    the same token at the same position. That equality is the greedy
+    identity contract of speculative decoding: an accepted draft
+    token's logits ARE the tick's logits."""
+    d = q.shape[-1]
+    qh = jnp.swapaxes(q, 1, 2)                          # (1, h, K+1, d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / (d ** 0.5)
+    kpos = jnp.arange(ck.shape[2])[None, None, None, :]
+    qpos = (pos + jnp.arange(q.shape[1]))[None, None, :, None]
+    w = jax.nn.softmax(jnp.where(kpos <= qpos, s, -1e30), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w,
+                     cv.astype(jnp.float32)).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)                      # (1, K+1, h, d)
+
+
+@functools.lru_cache(maxsize=16)
+def _verify_fn(cfg_key: tuple, spec_len: int, donate: bool):
+    """Jitted draft-and-verify step (``serve_verify_chunk``): process
+    ``spec_len + 1`` tokens — the row's last emitted token plus
+    ``spec_len`` (padded) draft tokens — through the target model in ONE
+    forward, writing all K+1 candidate K/V rows at a traced position,
+    then compute the accepted prefix and the one emitted
+    correction/bonus token on device. Slot, position, draft count, and
+    sampling params are all traced, so ONE compiled program serves every
+    slot, every position, and every draft hit length (mixed n-gram hit
+    lengths included — fewer real drafts just lower ``n_draft``).
+
+    Acceptance preserves the solo decode's output exactly: greedy
+    accepts the longest prefix matching the target argmax (row i's
+    logits are bit-identical to the tick's at that position, see
+    _attn_verify) and emits the argmax at the first divergence — the
+    greedy stream is the argmax chain either way. Sampled rows use the
+    standard rejection/residual rule (ops/sampling.py) so the output
+    DISTRIBUTION is unchanged. The fold_in key schedule consumes one
+    index per EMITTED token — row i derives its accept/emit keys from
+    ``fold_in(key, fold + i)`` and the verify advances ``fold`` by the
+    emitted count — so a speculative stream and a tick-by-tick stream
+    stay on the same per-token schedule (greedy never touches the keys
+    at all, which is why greedy is bit-identical, not just
+    distributionally identical).
+
+    Rejected draft rows need no rollback copy: the row's new position
+    stops at the last accepted token, and stale K/V beyond a row's own
+    position is unreachable by construction (the same masked-softmax
+    invariant recycled slots lean on); the next forward overwrites the
+    rejected rows in place. Layer loop python-unrolled with per-layer
+    dus straight into the stacked caches — the tick/chunk idiom."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+    hd = cfg.feat // cfg.n_head
+    rows = spec_len + 1
+
+    def impl(blocks, outer, cache_k, cache_v, toks, slot, pos, n_draft,
+             key, fold, temp, top_k, top_p):
+        # position rows by gather, clipped into the table: pad drafts
+        # past seq_len - 1 produce masked garbage the accept logic never
+        # reads (n_draft caps acceptance; the caller gates dispatch so
+        # pos + spec_len + 1 <= row_len and real positions stay valid)
+        pidx = jnp.clip(pos + jnp.arange(rows), 0, cfg.seq_len - 1)
+        h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
+        row_len = cache_k.shape[3]
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                # write all K+1 candidate rows at (layer l, slot, pos),
+                # then attend the queries over the updated row
+                kh = jnp.transpose(k, (0, 2, 1, 3))[None]   # (1,1,H,K+1,d)
+                vh = jnp.transpose(v, (0, 2, 1, 3))[None]
+                ck = lax.dynamic_update_slice(cache_k, kh,
+                                              (l, slot, 0, pos, 0))
+                cv = lax.dynamic_update_slice(cache_v, vh,
+                                              (l, slot, 0, pos, 0))
+                size = (1, 1, cfg.n_head, row_len, hd)
+                row_k = lax.dynamic_slice(ck, (l, slot, 0, 0, 0), size)[0]
+                row_v = lax.dynamic_slice(cv, (l, slot, 0, 0, 0), size)[0]
+                return _attn_verify(q, row_k, row_v, pos), (ck, cv)
+
+            h, (cache_k, cache_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[0] @ outer["head"].astype(hl.dtype)     # (K+1, V)
+        # one fold index per candidate emitted token; greedy ignores keys
+        folds = fold + jnp.arange(rows)
+        keys_r = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, folds)
+        draft = toks[0, 1:]                                 # (spec_len,)
+        bshape = (spec_len,)
+        acc_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(
+            keys_r[:spec_len])
+        acc = accept_draft_rows(
+            logits[:spec_len], draft, acc_keys,
+            jnp.broadcast_to(temp, bshape), jnp.broadcast_to(top_k, bshape),
+            jnp.broadcast_to(top_p, bshape))
+        acc = acc & (jnp.arange(spec_len) < n_draft)
+        # accepted-prefix length = index of the first rejected row (the
+        # appended False makes an all-accepted window resolve to n_draft)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [acc, jnp.zeros((1,), bool)])).astype(jnp.int32)
+        # the emitted token comes from row n_acc's logits: residual
+        # (draft token excluded) on a rejection, a plain filtered draw
+        # (exclusion disabled via draft = -1) on the all-accepted bonus
+        la = jnp.take(logits, n_acc, axis=0)[None]
+        da = jnp.where(n_acc >= n_draft, -1,
+                       jnp.take(draft, jnp.minimum(n_acc, spec_len - 1)))
+        ke = jax.random.fold_in(jnp.take(keys_r, n_acc, axis=0), 2)
+        emit = residual_sample_rows(la, da[None], ke[None],
+                                    jnp.asarray(temp)[None],
+                                    jnp.asarray(top_k)[None],
+                                    jnp.asarray(top_p)[None])[0]
+        return cache_k, cache_v, n_acc, emit
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
 @functools.lru_cache(maxsize=256)
 def _extract_chunks_fn(cfg_key: tuple, chunk: int, n_chunks: int):
     """Jitted chunk copy-out for the prefix cache: ``n_chunks``
@@ -343,7 +476,8 @@ class DecodeEngine:
 
     def __init__(self, cfg: GPTConfig, params: Dict, slots: int,
                  prefill_chunk: int = 64, recompile_limit: int = 0,
-                 recompile_strict: bool = True, abstract: bool = False):
+                 recompile_strict: bool = True, abstract: bool = False,
+                 spec_len: int = 0):
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -353,6 +487,9 @@ class DecodeEngine:
             raise ValueError("serve_prefill_chunk must be >= 0 "
                              "(0 = whole-prompt prefill), got %d"
                              % prefill_chunk)
+        if spec_len < 0:
+            raise ValueError("spec_len must be >= 0 (0 = no speculative "
+                             "verify program), got %d" % spec_len)
         self.cfg = cfg
         self._cfg_key = dataclasses.astuple(cfg)
         self.slots = slots
@@ -370,6 +507,10 @@ class DecodeEngine:
         # never read.
         c = self.chunk
         self.row_len = ((cfg.seq_len + c - 1) // c * c) if c else cfg.seq_len
+        # default verify window for the speculative path: drafts beyond
+        # seq_len - 1 could never all be verified inside one row anyway
+        # (the verify writes spec_len + 1 rows from a decode position)
+        self.spec_len = min(int(spec_len), max(cfg.seq_len - 1, 0))
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         # fused QKV once per server lifetime (models/gpt.py does this once
         # per decode CALL; a server amortizes it over every request); an
@@ -397,11 +538,19 @@ class DecodeEngine:
         # for the serve engine): the lru_caches above silently absorb a
         # per-prompt-length compile storm; the guard makes it loud
         self._guard = None
+        self._vguard = None
         if recompile_limit > 0:
             from ..analysis.recompile import RecompileGuard
             from ..utils import profiler
             self._guard = RecompileGuard(
                 lambda sig: None, "serve_prefill", recompile_limit,
+                strict=bool(recompile_strict), log=profiler.log)
+            # the verify program gets its OWN signature count: its one
+            # legitimate signature must not share headroom with the
+            # prefill/chunk programs', and a trip should name spec_len —
+            # the only dimension that can drift there
+            self._vguard = RecompileGuard(
+                lambda sig: None, "serve_verify_chunk", recompile_limit,
                 strict=bool(recompile_strict), log=profiler.log)
 
     def _count_program(self, sig: str) -> None:
@@ -416,6 +565,14 @@ class DecodeEngine:
         """Distinct compiled prefill/chunk program signatures seen so far
         (empty when the guard is off)."""
         return self._guard.signatures if self._guard is not None else ()
+
+    @property
+    def verify_signatures(self) -> tuple:
+        """Distinct compiled verify program signatures seen so far
+        (empty when the guard is off). One fixed ``spec_len`` = one
+        signature no matter how draft hit lengths mix — the speculative
+        acceptance bound, pinned by tests/test_speculative.py."""
+        return self._vguard.signatures if self._vguard is not None else ()
 
     def lint_specs(self, n_prompt: int = 8, donate: Optional[bool] = None):
         """(label, jitted fn, abstract args, donate_argnums) rows for the
@@ -452,6 +609,16 @@ class DecodeEngine:
                 ("serve_prefill_chunk",
                  _prefill_chunk_fn(self._cfg_key, self.chunk, don),
                  chunk_args, nums))
+        if self.spec_len:
+            verify_args = (self._blocks, self._outer, self.cache_k,
+                           self.cache_v, SDS((1, self.spec_len + 1), i32),
+                           SDS((), i32), SDS((), i32), SDS((), i32), key,
+                           SDS((), i32), SDS((), f32), SDS((), i32),
+                           SDS((), f32))
+            specs.append(
+                ("serve_verify_chunk",
+                 _verify_fn(self._cfg_key, self.spec_len, don),
+                 verify_args, nums))
         specs.append(
             ("serve_tick", _tick_fn(self._cfg_key, don), tick_args, nums))
         return specs
@@ -514,6 +681,39 @@ class DecodeEngine:
             jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
         return tok
+
+    def verify_chunk(self, slot: int, toks: np.ndarray, pos: int,
+                     n_draft: int, key: np.ndarray, fold: int,
+                     temperature: float, top_k: int, top_p: float):
+        """One draft-and-verify step for ``slot``: ``toks`` is
+        ``spec_len + 1`` tokens — the row's last emitted token followed
+        by ``n_draft`` real draft tokens (rest padding); ``pos`` is the
+        position the last emitted token will be written at, ``fold`` the
+        fold_in index of the NEXT emitted token. Returns
+        ``(n_accepted, emitted)`` synchronized — the host must know the
+        accepted prefix to advance the row. The caller guarantees
+        ``pos + spec_len + 1 <= row_len`` (all candidate rows fit
+        without dynamic_update_slice start-clamping shifting the write
+        onto earlier, live positions)."""
+        toks = np.asarray(toks, np.int32).reshape(-1)
+        k = toks.size - 1
+        if k < 1:
+            raise ValueError("verify_chunk needs >= 1 draft token slot, "
+                             "got %d tokens" % toks.size)
+        if int(pos) + k + 1 > self.row_len:
+            raise ValueError("verify window [%d, %d) exceeds row_len %d"
+                             % (int(pos), int(pos) + k + 1, self.row_len))
+        if self._vguard is not None:
+            self._vguard("spec_len=%d" % k)
+        fn = _verify_fn(self._cfg_key, k, self._donate)
+        self.cache_k, self.cache_v, n_acc, emit = fn(
+            self._blocks, self._outer, self.cache_k, self.cache_v,
+            jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+            jnp.asarray(pos, jnp.int32), jnp.asarray(n_draft, jnp.int32),
+            jnp.asarray(key), jnp.asarray(fold, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
+        return int(n_acc), int(emit)
 
     def extract_row_chunks(self, slot: int, start: int, n_chunks: int):
         """Copy ``n_chunks`` contiguous chunks' K/V out of ``slot``'s row

@@ -43,6 +43,20 @@ from ..utils import profiler
 
 __all__ = ["SamplingParams", "Request", "SlotScheduler"]
 
+# speculative back-off: a SERVING verify is one dispatch per slot while
+# the tick amortizes every slot in one forward — so with SEVERAL rows
+# decoding, a request whose drafts don't stick pays the full verify
+# overhead for ~1 token per forward. After SPEC_BACKOFF_PROBE drafted
+# tokens, a request accepting below SPEC_BACKOFF_MIN stops speculating
+# for its remaining lifetime (a fresh admit re-probes); identity is
+# untouched — the row just ticks like a spec-off request. The trip only
+# arms while MORE than one row is decoding: a lone row's verify has the
+# offline path's economics (it costs about one batch-1 tick and emits
+# >= 1 token, so even a ~15% accept rate wins there — measured in
+# doc/serving.md's round-10 cells).
+SPEC_BACKOFF_PROBE = 8
+SPEC_BACKOFF_MIN = 0.3
+
 
 @dataclasses.dataclass
 class SamplingParams:
@@ -53,7 +67,16 @@ class SamplingParams:
     request still waiting when it expires finishes as ``timeout``
     (0 = no deadline); once admitted a request always runs to
     completion. ``eos``: stop early when this token is produced (it is
-    included in the output); None = run to max_tokens."""
+    included in the output); None = run to max_tokens.
+
+    ``spec_mode`` / ``spec_len`` override the server's speculative
+    decoding defaults per request: None inherits the server mode,
+    ``"off"`` disables speculation for this request, ``"ngram"`` /
+    ``"model"`` select a drafter the server has available (rejected at
+    submit otherwise). ``spec_len`` 0 inherits; a positive value caps
+    the draft window BELOW the server's (the verify program's shape is
+    fixed server-wide — a per-request cap only lowers the traced draft
+    count, so it cannot add a compiled signature)."""
     max_tokens: int = 32
     temperature: float = 0.0
     top_k: int = 0
@@ -61,6 +84,8 @@ class SamplingParams:
     seed: int = 0
     eos: Optional[int] = None
     timeout_ms: float = 0.0
+    spec_mode: Optional[str] = None
+    spec_len: int = 0
 
 
 class Request:
@@ -102,13 +127,22 @@ class SlotScheduler:
     """Owns the per-slot host state mirroring the engine's cache rows."""
 
     def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
-                 on_finish=None, prefix_cache=None):
+                 on_finish=None, prefix_cache=None, drafters=None,
+                 spec_mode: str = "off", spec_len: int = 0):
         self.engine = engine
         self.stats = stats or profiler.StepStats()
         self.on_finish = on_finish      # called with each request that
         #                                 reaches a terminal state here
         self.chunk = int(engine.chunk)  # 0 = legacy whole-prompt
         self.prefix = prefix_cache if self.chunk > 0 else None
+        # speculative decoding (serve/speculative.py): available drafter
+        # objects by name, the server-default mode, and the verify
+        # window (the engine's compiled spec_len — per-request overrides
+        # can only lower the draft count inside it)
+        self.drafters = dict(drafters or {})
+        self.spec_mode = spec_mode if self.drafters else "off"
+        self.spec_len = min(int(spec_len), engine.spec_len) \
+            if engine.spec_len else 0
         n = engine.slots
         self._req: List[Optional[Request]] = [None] * n
         self._free = list(range(n - 1, -1, -1))     # pop() -> lowest slot
@@ -142,6 +176,21 @@ class SlotScheduler:
         self.tokens_generated = 0
         self.prefill_chunks = 0         # chunk steps run (chunked path)
         self.requests_prefilled = 0     # requests whose prefill completed
+        # speculative gauges: verify forwards run, draft tokens proposed
+        # vs accepted, tokens a verify actually APPENDED (EOS / the token
+        # budget can retire a request mid-window, discarding the rest of
+        # an accepted prefix — spec_tokens_per_forward must not count
+        # those), and forwards that rolled back a rejected suffix
+        self.spec_forwards = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_rollbacks = 0
+        self.spec_backoffs = 0          # requests that stopped speculating
+        # per-request accept probe for the back-off (reset at admit)
+        self._spec_try = np.zeros(n, np.int64)
+        self._spec_hit = np.zeros(n, np.int64)
+        self._spec_off = [False] * n
         # request ids in admission order (bounded: diagnostic window, not
         # a full history — a hot server admits forever)
         self.admit_order: collections.deque = collections.deque(maxlen=4096)
@@ -190,6 +239,10 @@ class SlotScheduler:
         p = req.params
         req.slot = slot
         req.admit_t = time.perf_counter()
+        for d in self.drafters.values():
+            d.reset(slot)               # new occupant: drop mirror state
+        self._spec_try[slot] = self._spec_hit[slot] = 0
+        self._spec_off[slot] = False
         self.stats.record(profiler.QUEUE_WAIT, req.admit_t - req.submit_t)
         self.admit_order.append(req.rid)
         key = np.asarray(jax.random.PRNGKey(p.seed), np.uint32)
@@ -306,6 +359,123 @@ class SlotScheduler:
         req.finish(status, error)
         if self.on_finish is not None:
             self.on_finish(req)
+
+    # ------------------------------------------------------- speculative
+    def _spec_mode_for(self, req: Request) -> str:
+        """Effective drafter name for ``req`` ("off" = no speculation):
+        the per-request override when set, else the server default; a
+        mode with no available drafter degrades to off (submit already
+        rejected explicitly-unavailable overrides)."""
+        mode = req.params.spec_mode or self.spec_mode
+        return mode if mode in self.drafters else "off"
+
+    def spec_steps(self) -> int:
+        """One draft-and-verify pass: draft for every eligible decoding
+        row (host n-gram lookup, or the draft model's catch-up + batched
+        greedy ticks), then run one ``serve_verify_chunk`` per row with
+        a non-empty draft — each emits between 1 (all drafts rejected:
+        the correction token alone) and ``spec_len + 1`` tokens. Returns
+        the number of verify forwards run. Rows are eligible when their
+        request speculates (mode != off), at least 2 tokens of budget
+        remain (with 1 left a plain tick finishes cheaper than a
+        verify), and the verify window fits the row
+        (``pos + spec_len + 1 <= row_len`` — the program writes the full
+        window regardless of the draft hit length). The decode tick runs
+        AFTER this in the same pass; just-verified rows tick too (the
+        tick writes its own position's K/V before attending — the
+        standard write-before-attend invariant)."""
+        if self.spec_mode == "off" and not any(
+                r is not None and r.params.spec_mode not in (None, "off")
+                for r in self._req):
+            return 0
+        K = self.spec_len
+        if K < 1 or not self.drafters:
+            return 0
+        want: dict = {}                 # slot -> (mode, k_eff)
+        for slot, req in enumerate(self._req):
+            if req is None or self._spec_off[slot]:
+                continue
+            mode = self._spec_mode_for(req)
+            if mode == "off":
+                continue
+            p = req.params
+            cap = min(p.max_tokens,
+                      self.engine.cfg.seq_len - len(req.prompt))
+            remaining = cap - len(req.tokens)
+            k_eff = min(K, remaining - 1)
+            if p.spec_len > 0:
+                k_eff = min(k_eff, p.spec_len)
+            if k_eff < 1 or remaining < 2:
+                continue
+            if int(self._pos[slot]) + K + 1 > self.engine.row_len:
+                continue
+            want[slot] = (mode, k_eff)
+        if not want:
+            return 0
+        drafts: dict = {}
+        with self.stats.phase(profiler.SPEC_DRAFT):
+            for name, drafter in self.drafters.items():
+                slots = {s for s, (m, _) in want.items() if m == name}
+                if not slots:
+                    continue
+                ctxs = {s: np.concatenate(
+                    [self._req[s].prompt,
+                     np.asarray(self._req[s].tokens, np.int32)])
+                    for s in slots}
+                drafts.update(drafter.draft(
+                    ctxs, {s: want[s][1] for s in slots}))
+        n = 0
+        for slot, d in drafts.items():
+            nd = len(d)
+            req = self._req[slot]
+            if nd < 1 or req is None:
+                continue
+            p = req.params
+            buf = np.zeros(K + 1, np.int32)
+            buf[0] = self._tok[slot]
+            buf[1:1 + nd] = d
+            with self.stats.phase(profiler.SPEC_VERIFY):
+                n_acc, emit = self.engine.verify_chunk(
+                    slot, buf, int(self._pos[slot]), nd,
+                    self._keys[slot], int(self._fold[slot]),
+                    p.temperature, p.top_k, p.top_p)
+            self.spec_forwards += 1
+            self.spec_drafted += nd
+            self.spec_accepted += n_acc
+            if n_acc < nd:
+                self.spec_rollbacks += 1
+            n += 1
+            self._spec_try[slot] += nd
+            self._spec_hit[slot] += n_acc
+            if self.decoding > 1 \
+                    and self._spec_try[slot] >= SPEC_BACKOFF_PROBE \
+                    and self._spec_hit[slot] \
+                    < SPEC_BACKOFF_MIN * self._spec_try[slot]:
+                self._spec_off[slot] = True
+                self.spec_backoffs += 1
+            self.spec_emitted += self._append_spec(
+                slot, req, [int(t) for t in d[:n_acc]] + [int(emit)])
+        self.stats.end_step()           # one spec pass = one stats step
+        return n
+
+    def _append_spec(self, slot: int, req: Request, emitted) -> int:
+        """Take the verify's emitted tokens one at a time — EOS or the
+        token budget can land mid-window, in which case the request
+        retires there and the remaining emitted tokens are DISCARDED
+        (exactly what the tick-by-tick path would never have generated;
+        their K/V rows sit beyond the retired row's position and are
+        plain recycled-slot stale data). Returns the count actually
+        appended — what the per-forward emission gauge may count."""
+        for i, tok in enumerate(emitted):
+            req.tokens.append(tok)
+            self.tokens_generated += 1
+            self._tok[slot] = tok
+            self._pos[slot] += 1
+            self._fold[slot] += 1
+            if self._finished(req, tok):
+                self._retire(req, "ok")
+                return i + 1
+        return len(emitted)
 
     # -------------------------------------------------------------- tick
     def tick(self) -> int:

@@ -84,36 +84,69 @@ class InferenceServer:
                  defaults: Optional[SamplingParams] = None,
                  prefill_chunk: int = 64, prefill_budget: int = 1,
                  prefix_mb: float = 32.0, recompile_limit: int = 0,
-                 recompile_strict: bool = True):
+                 recompile_strict: bool = True, spec_mode: str = "off",
+                 spec_len: int = 4, spec_model=None):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
         each decode tick; ``prefix_mb``: shared-prefix KV cache byte
         budget in MiB (0 disables reuse; only active with chunking);
-        ``recompile_limit``: cap on distinct compiled prefill/chunk
-        signatures (0 = uncounted; see analysis/recompile.py)."""
+        ``recompile_limit``: cap on distinct compiled prefill/chunk AND
+        verify signatures (0 = uncounted; see analysis/recompile.py).
+
+        Speculative decoding (serve/speculative.py): ``spec_mode``
+        selects the draft source — ``"off"`` (default; a true no-op on
+        the serve path), ``"ngram"`` (host-side prompt lookup), or
+        ``"model"`` (a small draft model, ``spec_model=(draft_cfg,
+        draft_params)``, which also makes the ngram drafter available
+        for per-request overrides); ``spec_len`` is the verify window
+        (max draft tokens per forward, one compiled verify signature
+        server-wide). Greedy speculative output is bit-identical to the
+        non-speculative path; sampled output is identical in
+        distribution (doc/serving.md)."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
             raise ValueError("serve_prefill_budget must be >= 1, got %d"
                              % prefill_budget)
+        if spec_mode not in ("off", "ngram", "model"):
+            raise ValueError("spec_mode must be 'off', 'ngram' or "
+                             "'model', got %r" % (spec_mode,))
+        if spec_mode != "off" and spec_len < 1:
+            raise ValueError("spec_len must be >= 1 with spec_mode=%s, "
+                             "got %d" % (spec_mode, spec_len))
+        if spec_mode == "model" and spec_model is None:
+            raise ValueError("spec_mode='model' needs spec_model="
+                             "(draft_cfg, draft_params)")
         self._defaults = defaults or SamplingParams()
         if timeout_ms and not self._defaults.timeout_ms:
             self._defaults = replace(self._defaults, timeout_ms=timeout_ms)
-        self._engine = DecodeEngine(cfg, params, slots,
-                                    prefill_chunk=prefill_chunk,
-                                    recompile_limit=recompile_limit,
-                                    recompile_strict=recompile_strict)
+        self._engine = DecodeEngine(
+            cfg, params, slots, prefill_chunk=prefill_chunk,
+            recompile_limit=recompile_limit,
+            recompile_strict=recompile_strict,
+            spec_len=spec_len if spec_mode != "off" else 0)
         self._prefill_budget = int(prefill_budget)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             from .prefix_cache import PrefixCache
             self._prefix = PrefixCache(self._engine,
                                        int(prefix_mb * (1 << 20)))
+        self._drafters = {}
+        if spec_mode != "off":
+            from .speculative import ModelDrafter, NgramDrafter
+            self._drafters["ngram"] = NgramDrafter(self._engine.spec_len)
+            if spec_mode == "model":
+                dcfg, dparams = spec_model
+                self._drafters["model"] = ModelDrafter(
+                    dcfg, dparams, slots, target_cfg=cfg)
         self._stats = profiler.StepStats()
         self._sched = SlotScheduler(self._engine, self._stats,
                                     on_finish=self._record_done,
-                                    prefix_cache=self._prefix)
+                                    prefix_cache=self._prefix,
+                                    drafters=self._drafters,
+                                    spec_mode=spec_mode,
+                                    spec_len=self._engine.spec_len)
         self._queue: collections.deque = collections.deque()
         self._queue_cap = queue
         self._cond = threading.Condition()
@@ -174,6 +207,14 @@ class InferenceServer:
         if p.top_k < 0 or not 0.0 < p.top_p <= 1.0:
             self._reject("bad sampling params: top_k=%r top_p=%r"
                          % (p.top_k, p.top_p))
+        if p.spec_len < 0:
+            self._reject("spec_len must be >= 0, got %d" % p.spec_len)
+        if p.spec_mode not in (None, "off") \
+                and p.spec_mode not in self._drafters:
+            self._reject("spec_mode %r not available on this server "
+                         "(server spec drafters: %s)"
+                         % (p.spec_mode,
+                            ", ".join(sorted(self._drafters)) or "none"))
         with self._cond:
             if self._closing:
                 raise AdmissionError("server is shutting down")
@@ -273,6 +314,12 @@ class InferenceServer:
                 for _ in range(self._prefill_budget):
                     if not self._sched.prefill_step():
                         break
+                # draft-and-verify before the tick: each eligible row
+                # banks up to spec_len + 1 tokens from ONE verify
+                # forward, then the shared tick advances every decoding
+                # row (verified rows included) by one more
+                if self._drafters and self._sched.decoding:
+                    self._sched.spec_steps()
                 if self._sched.decoding:
                     self._sched.tick()
         finally:
@@ -301,6 +348,8 @@ class InferenceServer:
                     req.finish("cancelled", "server shutdown")
             if self._prefix is not None:
                 self._prefix.clear()        # drop the cached chunk K/V
+            for d in self._drafters.values():
+                d.close()                   # drop the draft slot pool
             self._engine.close()
             self._stopped.set()
 
@@ -364,6 +413,8 @@ class InferenceServer:
                                                   [])),
             "prefix_copy_ms": ms(st._phases.get(profiler.PREFIX_COPY, [])),
             "decode_tick_ms": ms(st._phases.get(profiler.DECODE_TICK, [])),
+            "spec_draft_ms": ms(st._phases.get(profiler.SPEC_DRAFT, [])),
+            "spec_verify_ms": ms(st._phases.get(profiler.SPEC_VERIFY, [])),
             "queue_depth": {"now": depth, "max": self._queue_depth_max},
             "slot_occupancy": sc.occupancy(),
             "batch_efficiency": sc.batch_efficiency(),
@@ -379,6 +430,17 @@ class InferenceServer:
                                        / max(1, sc.requests_prefilled)),
             "prefix_hit_rate": (pc.hit_tokens / max(1, pc.prompt_tokens)
                                 if pc is not None else 0.0),
+            # speculative decoding gauges (doc/serving.md): all three
+            # report a consistent 0.0 when no verify forward ever ran
+            # (spec off, or the drafter never produced a proposal)
+            "accept_rate": sc.spec_accepted / max(1, sc.spec_drafted),
+            "spec_tokens_per_forward": (
+                sc.spec_emitted / float(sc.spec_forwards)
+                if sc.spec_forwards else 0.0),
+            "spec_rollback_rate": (sc.spec_rollbacks
+                                   / max(1, sc.spec_forwards)),
+            "spec_forwards": sc.spec_forwards,
+            "spec_backoffs": sc.spec_backoffs,
             "prefix_cache_bytes": pc.nbytes if pc is not None else 0,
             "prefix_cache": ({
                 "budget_bytes": pc.budget, "bytes": pc.nbytes,
@@ -404,6 +466,12 @@ class InferenceServer:
         self._sched.tokens_generated = 0
         self._sched.prefill_chunks = 0
         self._sched.requests_prefilled = 0
+        self._sched.spec_forwards = 0
+        self._sched.spec_drafted = 0
+        self._sched.spec_accepted = 0
+        self._sched.spec_emitted = 0
+        self._sched.spec_rollbacks = 0
+        self._sched.spec_backoffs = 0
         if self._prefix is not None:
             # traffic counters only: cached chunks stay warm — a bench's
             # measured pass is supposed to see the steady state

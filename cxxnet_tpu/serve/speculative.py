@@ -1,0 +1,409 @@
+"""Speculative decoding: draft-and-verify multi-token decode steps.
+
+Every plain decode tick runs one full model forward per emitted token,
+so per-token latency is floored by per-step weight traffic no matter how
+good the KV path is. Speculative decoding turns K sequential forwards
+into ONE batched verify forward: a cheap *drafter* proposes up to
+``spec_len`` continuation tokens, the target model scores all of them
+(plus the pending last token) in a single forward
+(``serve/engine.py:_verify_fn``), and the longest acceptable prefix is
+emitted together with one correction/bonus token — up to
+``spec_len + 1`` tokens per forward when the drafter is right, exactly
+one (the correction) when it is wrong.
+
+Two draft sources, both DETERMINISTIC proposals (the q ≡ 1 case of the
+standard accept-with-min(1, p/q) rule, which makes the rejection test
+``u < p(draft)`` and the residual the draft-excluded renormalized
+target distribution — ops/sampling.py):
+
+* :class:`NgramDrafter` — zero-cost prompt lookup: match the last few
+  tokens of the context (prompt + generated history) against the
+  context itself and propose the continuation of the most recent
+  earlier occurrence. Pure host NumPy over tokens the scheduler already
+  holds; no extra model, no device work. Pays off exactly when the
+  output repeats material from the prompt/history (summarization,
+  code edits, template-y generations).
+* :class:`ModelDrafter` — a small draft model (same GPT stack at
+  reduced depth/width) greedy-decoding K tokens ahead through its OWN
+  :class:`~cxxnet_tpu.serve.engine.DecodeEngine` slot pool, one draft
+  row mirroring each target slot. Catch-up reuses the engine's
+  chunk-prefill program (consume the tokens the target emitted since
+  the last draft), then K-1 BATCHED draft ticks propose for every
+  drafting slot at once. Stale draft-row K/V beyond the synced point is
+  unreachable by the same masked-softmax invariant the target's
+  recycled slots lean on, and is overwritten by the next catch-up.
+
+Identity contract: greedy (temperature 0) speculative output is
+bit-identical to the solo ``gpt_decode`` stream — acceptance is
+argmax-prefix matching against logits that are themselves bit-identical
+to the tick's (engine._attn_verify), and the keys are never consumed on
+the greedy path. Sampled output is identical in DISTRIBUTION (standard
+rejection/residual sampling; chi-squared-pinned in
+tests/test_sampling.py), with one fold_in index consumed per EMITTED
+token so the per-token key schedule never desynchronizes from the
+non-speculative path. Either way the drafter only affects SPEED
+(accept_rate), never which distribution the tokens come from.
+
+:class:`SpeculativeDecoder` drives the offline
+``gpt_decode(speculative=...)`` path: batch prompts admitted into a
+b-slot engine, per-iteration draft + verify per row, stragglers and
+no-draft rows advanced by the ordinary batched tick. The serving
+integration lives in serve/scheduler.py (``spec_steps``), which
+interleaves per-slot verify chunks with the shared decode tick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
+           "speculative_decode"]
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most
+    recent earlier occurrence of the context's trailing n-gram.
+
+    Longest-match-first: n-grams from ``max_ngram`` down to
+    ``min_ngram`` are tried in order; the LAST (most recent) earlier
+    occurrence wins, matching the intuition that recent repetition is
+    the best predictor of continued repetition. Returns up to ``k``
+    tokens (possibly fewer near the match's end, possibly none when the
+    suffix never occurred before) — an empty draft simply means the
+    scheduler falls back to a plain tick for that row this pass."""
+
+    name = "ngram"
+
+    def __init__(self, spec_len: int, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        if spec_len < 1:
+            raise ValueError("spec_len must be >= 1, got %d" % spec_len)
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram, got %d/%d"
+                             % (min_ngram, max_ngram))
+        self.spec_len = int(spec_len)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def reset(self, slot: int) -> None:
+        """Stateless — the context is passed whole every call."""
+
+    def close(self) -> None:
+        """Nothing to release (no device state, no threads)."""
+
+    def draft_one(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        """Up to ``k`` proposed continuation tokens for ``ctx``."""
+        ctx = np.asarray(ctx, np.int32).reshape(-1)
+        n = ctx.size
+        k = min(int(k), self.spec_len)
+        if k < 1 or n < self.min_ngram + 1:
+            return np.zeros((0,), np.int32)
+        for g in range(min(self.max_ngram, n - 1), self.min_ngram - 1, -1):
+            # candidate starts 0 .. n-g-1: every one has >= 1
+            # continuation token and excludes the trailing suffix itself
+            win = np.lib.stride_tricks.sliding_window_view(ctx, g)
+            hits = np.flatnonzero((win[:n - g] == ctx[n - g:]).all(axis=1))
+            if hits.size:
+                j = int(hits[-1])
+                return ctx[j + g:j + g + k].copy()
+        return np.zeros((0,), np.int32)
+
+    def draft(self, contexts: Dict[int, np.ndarray],
+              lens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        """Per-slot drafts for a batch of contexts (host-only loop)."""
+        return {slot: self.draft_one(ctx, lens[slot])
+                for slot, ctx in contexts.items()}
+
+
+class ModelDrafter:
+    """Small-draft-model drafter over its own DecodeEngine slot pool.
+
+    One draft cache row mirrors each target slot. ``draft()`` first
+    catches each drafting row up to its request's current context via
+    the draft engine's chunk-prefill program — the final chunk's greedy
+    sample IS the first draft token — then runs K-1 BATCHED draft ticks
+    (greedy, temperature 0) to extend every drafting slot's proposal at
+    once. Draft-row K/V written by those speculative ticks is stale the
+    moment the target rejects; it sits beyond the synced position, so
+    it is unreachable (masked) until the next catch-up overwrites it —
+    the engine's standard recycled-row invariant.
+
+    The draft model must share the target's vocabulary (its tokens index
+    the target's embedding) and cover its sequence length (draft
+    positions run up to the target's verify window)."""
+
+    name = "model"
+
+    def __init__(self, cfg, params: Dict, slots: int, target_cfg=None,
+                 prefill_chunk: int = 64):
+        from .engine import DecodeEngine
+        if target_cfg is not None:
+            if cfg.vocab_size != target_cfg.vocab_size:
+                raise ValueError(
+                    "draft model vocab %d != target vocab %d (draft "
+                    "tokens index the target embedding)"
+                    % (cfg.vocab_size, target_cfg.vocab_size))
+            if cfg.seq_len < target_cfg.seq_len:
+                raise ValueError(
+                    "draft model seq_len %d < target seq_len %d (draft "
+                    "rows mirror target positions)"
+                    % (cfg.seq_len, target_cfg.seq_len))
+        self.engine = DecodeEngine(cfg, params, slots,
+                                   prefill_chunk=max(1, prefill_chunk))
+        n = slots
+        self._synced = [0] * n          # context tokens already consumed
+        self._park = self.engine.row_len - 1
+        self._zero_key = np.zeros((2,), np.uint32)
+        self._keys = np.zeros((n, 2), np.uint32)
+        self._fold = np.zeros(n, np.int32)
+        self._temp = np.zeros(n, np.float32)    # greedy drafting
+        self._topk = np.zeros(n, np.int32)
+        self._topp = np.ones(n, np.float32)
+
+    def reset(self, slot: int) -> None:
+        """A new request owns ``slot`` — its mirror row restarts from
+        position 0 (catch-up rewrites it; stale tail is masked)."""
+        self._synced[slot] = 0
+
+    def close(self) -> None:
+        self.engine.close()
+
+    def _catch_up(self, slot: int, ctx: np.ndarray) -> int:
+        """Consume ``ctx[synced:]`` into the mirror row via the chunk
+        program; returns the greedy next token after the full context
+        (the first draft). The start is aligned DOWN to a chunk multiple
+        — the chunk program writes a full ``chunk``-wide window at its
+        offset, and only aligned offsets are guaranteed to fit inside
+        ``row_len`` (an unaligned final window could run past the row,
+        where dynamic_update_slice start-clamping would silently shift
+        the write onto earlier live positions). Re-consumed tokens
+        (alignment, or a retry on an ungrown context) just rewrite their
+        own K/V rows with identical values — harmless."""
+        n = len(ctx)
+        s = min(self._synced[slot], n - 1)
+        c = self.engine.chunk
+        s = (s // c) * c
+        tok = None
+        while s < n:
+            e = min(s + c, n)
+            buf = np.zeros(c, np.int32)
+            buf[:e - s] = ctx[s:e]
+            tok = self.engine.prefill_chunk(slot, buf, s, e - s,
+                                            self._zero_key, 0.0, 0, 1.0)
+            s = e
+        self._synced[slot] = n
+        return int(tok)
+
+    def draft(self, contexts: Dict[int, np.ndarray],
+              lens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        if not contexts:
+            return {}
+        drafts: Dict[int, list] = {}
+        lim: Dict[int, int] = {}
+        tok = np.zeros(self.engine.slots, np.int32)
+        pos = np.full(self.engine.slots, self._park, np.int32)
+        seq = self.engine.cfg.seq_len
+        for slot, ctx in contexts.items():
+            ctx = np.asarray(ctx, np.int32).reshape(-1)
+            # draft positions run len(ctx) .. len(ctx) + k - 1: cap k so
+            # they stay inside the draft model's own position table (the
+            # ctor only requires seq_len >= the target's, so a request
+            # near the sequence end can ask for more than the table
+            # holds — a shorter draft is still useful, none falls back
+            # to a plain tick)
+            k = min(int(lens[slot]), seq - len(ctx))
+            if k < 1:
+                continue
+            first = self._catch_up(slot, ctx)
+            lim[slot] = k
+            drafts[slot] = [first]
+            tok[slot] = first
+            pos[slot] = len(ctx)        # the first draft's position
+        if not drafts:
+            return {}
+        for _ in range(max(lim.values()) - 1):
+            # slots whose cap is reached park their row so the batched
+            # tick's unconditional write stops touching live positions
+            done = True
+            for slot in drafts:
+                if len(drafts[slot]) >= lim[slot]:
+                    pos[slot] = self._park
+                else:
+                    done = False
+            if done:
+                break
+            nxt = self.engine.tick(tok, pos, self._keys, self._fold,
+                                   self._temp, self._topk, self._topp)
+            for slot in drafts:
+                if len(drafts[slot]) >= lim[slot]:
+                    continue
+                drafts[slot].append(int(nxt[slot]))
+                tok[slot] = nxt[slot]
+                pos[slot] += 1
+        return {slot: np.asarray(d[:lens[slot]], np.int32)
+                for slot, d in drafts.items()}
+
+
+class SpeculativeDecoder:
+    """Offline draft-and-verify decode over a b-slot DecodeEngine — the
+    machinery behind ``gpt_decode(speculative=...)``.
+
+    Prefill runs the engine's whole-prompt program (equal-length offline
+    batches are its sweet spot, and its ``fold_in(key, 0)`` first-token
+    schedule is the solo path's); then each iteration drafts per row,
+    verifies rows with non-empty drafts in one ``serve_verify_chunk``
+    dispatch each, and advances every remaining unfinished row with one
+    BATCHED tick. Greedy output is bit-identical to the non-speculative
+    ``gpt_decode`` scan; a drafter only changes how many forwards that
+    stream costs."""
+
+    def __init__(self, cfg, params: Dict, batch: int, spec_len: int = 4,
+                 mode: str = "ngram", model=None):
+        from .engine import DecodeEngine
+        if mode not in ("ngram", "model"):
+            raise ValueError("speculative mode must be 'ngram' or "
+                             "'model', got %r" % (mode,))
+        if spec_len < 1:
+            raise ValueError("spec_len must be >= 1, got %d" % spec_len)
+        self.cfg = cfg
+        self.spec_len = min(int(spec_len), max(cfg.seq_len - 1, 1))
+        self.engine = DecodeEngine(cfg, params, slots=batch,
+                                   prefill_chunk=0, spec_len=self.spec_len)
+        if mode == "model":
+            if model is None:
+                raise ValueError("speculative mode 'model' needs "
+                                 "model=(draft_cfg, draft_params)")
+            dcfg, dparams = model
+            self.drafter = ModelDrafter(dcfg, dparams, batch,
+                                        target_cfg=cfg)
+        else:
+            self.drafter = NgramDrafter(self.spec_len)
+        # observability: filled per decode() call
+        self.stats = {"forwards": 0, "drafted": 0, "accepted": 0,
+                      "rollbacks": 0, "ticks": 0, "tokens": 0}
+
+    def close(self) -> None:
+        self.drafter.close()
+        self.engine.close()
+
+    def decode(self, prompt: np.ndarray, max_new: int,
+               temperature: float = 0.0, rng=None, top_k: int = 0,
+               top_p: float = 1.0) -> np.ndarray:
+        """(b, n_prompt) int32 -> (b, n_prompt + max_new) int32."""
+        import jax
+        prompt = np.asarray(prompt, np.int32)
+        b, n = prompt.shape
+        if b != self.engine.slots:
+            raise ValueError("decoder built for batch %d, got %d"
+                             % (self.engine.slots, b))
+        eng = self.engine
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        # per-row keys: greedy never reads them; sampled speculative is
+        # distribution-level (doc/serving.md), so independent per-row
+        # streams (split) are the right semantics for a batch
+        keys = (np.asarray(jax.random.split(rng, b), np.uint32) if b > 1
+                else np.asarray(rng, np.uint32)[None])
+        for s in self.stats:
+            self.stats[s] = 0
+        toks = [[] for _ in range(b)]
+        for i in range(b):
+            self.drafter.reset(i)
+            toks[i].append(eng.prefill(i, prompt[i], keys[i],
+                                       temperature, top_k, top_p))
+        park = eng.row_len - 1
+        pos = np.full(b, n, np.int32)
+        fold = np.ones(b, np.int32)
+        last = np.asarray([t[-1] for t in toks], np.int32)
+        temp_row = np.full(b, temperature, np.float32)
+        topk_row = np.full(b, top_k, np.int32)
+        topp_row = np.full(b, top_p, np.float32)
+        K = self.spec_len
+        while True:
+            live = [i for i in range(b) if len(toks[i]) < max_new]
+            if not live:
+                break
+            # draft for rows whose remaining budget and row window admit
+            # a verify (the program writes K + 1 rows from pos)
+            want = {i: min(K, max_new - len(toks[i]) - 1) for i in live
+                    if max_new - len(toks[i]) >= 2
+                    and int(pos[i]) + K + 1 <= eng.row_len}
+            drafts = self.drafter.draft(
+                {i: np.concatenate([prompt[i],
+                                    np.asarray(toks[i], np.int32)])
+                 for i in want}, want) if want else {}
+            for i, d in drafts.items():
+                nd = len(d)
+                if nd < 1:
+                    continue
+                buf = np.zeros(K + 1, np.int32)
+                buf[0] = last[i]
+                buf[1:1 + nd] = d
+                n_acc, emit = eng.verify_chunk(
+                    i, buf, int(pos[i]), nd, keys[i], int(fold[i]),
+                    temperature, top_k, top_p)
+                emitted = [int(t) for t in d[:n_acc]] + [int(emit)]
+                self.stats["forwards"] += 1
+                self.stats["drafted"] += nd
+                self.stats["accepted"] += n_acc
+                self.stats["rollbacks"] += int(n_acc < nd)
+                toks[i].extend(emitted)
+                pos[i] += len(emitted)
+                fold[i] += len(emitted)
+                last[i] = emitted[-1]
+            # one batched tick advances every still-unfinished row
+            # (including just-verified ones — their new position's K/V
+            # is written by the tick itself, write-before-attend)
+            tick_rows = [i for i in range(b) if len(toks[i]) < max_new]
+            if tick_rows:
+                t_pos = np.full(b, park, np.int32)
+                t_temp = np.zeros(b, np.float32)
+                for i in tick_rows:
+                    t_pos[i] = pos[i]
+                    t_temp[i] = temp_row[i]
+                nxt = eng.tick(last, t_pos, keys, fold, t_temp, topk_row,
+                               topp_row)
+                self.stats["ticks"] += 1
+                for i in tick_rows:
+                    toks[i].append(int(nxt[i]))
+                    last[i] = nxt[i]
+                    pos[i] += 1
+                    fold[i] += 1
+        self.stats["tokens"] = sum(len(t) for t in toks)
+        return np.concatenate(
+            [prompt, np.asarray(toks, np.int32)], axis=1)
+
+
+def speculative_decode(params: Dict, prompt, max_new: int, cfg,
+                       temperature: float = 0.0, rng=None,
+                       top_k: int = 0, top_p: float = 1.0,
+                       spec: Optional[dict] = None):
+    """``gpt_decode(speculative=...)``'s implementation: build a
+    one-shot :class:`SpeculativeDecoder`, run it, fill ``spec['stats']``
+    (if the caller passed a dict to receive accept_rate & friends), and
+    return the (b, n_prompt + max_new) ids. ``spec`` keys: ``mode``
+    ('ngram' | 'model'), ``spec_len``, ``model`` ((draft_cfg,
+    draft_params) for mode 'model'), ``stats`` (optional out-dict)."""
+    spec = dict(spec or {})
+    stats_out = spec.get("stats")
+    prompt = np.asarray(prompt, np.int32)
+    dec = SpeculativeDecoder(cfg, params, batch=prompt.shape[0],
+                             spec_len=int(spec.get("spec_len", 4)),
+                             mode=spec.get("mode", "ngram"),
+                             model=spec.get("model"))
+    try:
+        out = dec.decode(prompt, max_new, temperature=temperature,
+                         rng=rng, top_k=top_k, top_p=top_p)
+        if isinstance(stats_out, dict):
+            st = dec.stats
+            stats_out.update(st)
+            stats_out["accept_rate"] = (st["accepted"]
+                                        / max(1, st["drafted"]))
+            stats_out["spec_tokens_per_forward"] = (
+                (st["accepted"] + st["forwards"]) / max(1, st["forwards"])
+                if st["forwards"] else 0.0)
+    finally:
+        dec.close()
+    return out

@@ -30,7 +30,8 @@ from typing import Dict, List, Optional
 __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
            "percentiles", "log", "FEED_WAIT", "STEP_DISPATCH",
            "METRIC_SYNC", "PREFILL", "PREFILL_CHUNK", "PREFIX_COPY",
-           "DECODE_TICK", "QUEUE_WAIT", "LINT"]
+           "DECODE_TICK", "QUEUE_WAIT", "SPEC_DRAFT", "SPEC_VERIFY",
+           "LINT"]
 
 # canonical phase names of the training hot loop (round 6, async feed):
 #   FEED_WAIT     — blocked on the next batch (host iterator, or the async
@@ -54,11 +55,17 @@ METRIC_SYNC = "metric_sync"
 #   DECODE_TICK   — one batched decode step across all active slots
 #   QUEUE_WAIT    — time a request sat in the admission queue before a slot
 #                   freed up (recorded at admit via StepStats.record)
+#   SPEC_DRAFT    — speculative-decoding draft generation (host n-gram
+#                   lookup, or the draft model's catch-up + greedy ticks)
+#   SPEC_VERIFY   — one draft-and-verify forward (serve_verify_chunk):
+#                   up to spec_len + 1 tokens banked per sample
 PREFILL = "prefill"
 PREFILL_CHUNK = "prefill_chunk"
 PREFIX_COPY = "prefix_copy"
 DECODE_TICK = "decode_tick"
 QUEUE_WAIT = "queue_wait"
+SPEC_DRAFT = "spec_draft"
+SPEC_VERIFY = "spec_verify"
 
 # phases counted as "waiting on input" for the wait-fraction line ("data"
 # is the pre-round-6 name, kept so external callers' stats still summarize)
@@ -199,8 +206,13 @@ class StepStats:
 
 def percentiles(vals: List[float], qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
     """Nearest-rank percentile summary of a sample list: {"p50": ..,
-    "p95": .., "p99": ..} (keys follow ``qs``). Empty input -> zeros."""
-    s = sorted(vals)
+    "p95": .., "p99": ..} (keys follow ``qs``). An EMPTY window — a
+    server summarized before any tick ran, a phase that never fired —
+    yields consistent zeros rather than raising, and non-finite samples
+    are dropped so a poisoned entry can never surface NaN in a stats
+    line (the empty-window contract, pinned by tests/test_profiler.py)."""
+    import math
+    s = sorted(v for v in vals if math.isfinite(v))
     return {"p%g" % (q * 100): StepStats._pct(s, q) for q in qs}
 
 

@@ -161,7 +161,8 @@ class Net:
 
     def generate(self, prompt: Array, max_new: int,
                  temperature: float = 0.0, seed: int = 0,
-                 top_k: int = 0, top_p: float = 1.0) -> Array:
+                 top_k: int = 0, top_p: float = 1.0,
+                 speculative=None) -> Array:
         """Autoregressive generation from a GPT-shaped net (gpt_lm_config
         structure): prompt (b, n_prompt) int token ids -> (b, n_prompt +
         max_new) int32. Greedy at temperature 0, else categorical
@@ -169,42 +170,60 @@ class Net:
         filters compose with temperature (ops/sampling.py; 0 / 1.0
         disable). Drives the models/gpt.py fused whole-step decode kernel
         — no reference counterpart (the reference has no sequence models,
-        SURVEY §5.7); the CLI twin is ``task = generate``."""
+        SURVEY §5.7); the CLI twin is ``task = generate``.
+
+        ``speculative``: draft-and-verify multi-token decoding — an int
+        ``spec_len`` for the n-gram/prompt-lookup drafter, or a dict
+        ``{"mode", "spec_len", "model", "stats"}``
+        (``gpt_decode(speculative=...)``; greedy output is
+        bit-identical, sampled output identical in distribution)."""
         import jax
         from .nnet.lm import net_generate
         rng = jax.random.PRNGKey(seed) if temperature > 0 else None
         return net_generate(self._net, np.asarray(prompt, np.int64),
                             max_new, temperature=temperature, rng=rng,
-                            top_k=top_k, top_p=top_p)
+                            top_k=top_k, top_p=top_p,
+                            speculative=speculative)
 
     # -- online serving (doc/serving.md) ------------------------------
     def serve_start(self, slots: int = 8, queue: int = 32,
                     timeout_ms: float = 0.0, prefill_chunk: int = 64,
                     prefill_budget: int = 1, prefix_mb: float = 32.0,
                     recompile_limit: int = 0, recompile_strict: bool = True,
-                    **defaults) -> None:
+                    spec_mode: str = "off", spec_len: int = 4,
+                    spec_model=None, **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
         prefill (0 = legacy whole-prompt prefill), ``prefix_mb`` budgets
         the shared-prefix KV cache (0 disables reuse), and
         ``recompile_limit`` extends the recompilation guard to the
-        engine's prefill/chunk programs (``recompile_strict=False``
-        logs CXN205 instead of raising, the CLI's
-        ``lint_recompile_strict=0`` mode). ``defaults`` seed the
-        per-request SamplingParams (max_tokens / temperature / top_k /
-        top_p / seed / eos)."""
+        engine's prefill/chunk/verify programs
+        (``recompile_strict=False`` logs CXN205 instead of raising, the
+        CLI's ``lint_recompile_strict=0`` mode).
+
+        Speculative decoding: ``spec_mode`` ∈ off | ngram | model with
+        ``spec_len`` draft tokens verified per forward; ``spec_model``
+        (mode=model) is a ``(draft_cfg, draft_params)`` pair or another
+        GPT-shaped ``wrapper.Net`` (exported automatically). Per-request
+        overrides ride in ``serve_submit(spec_mode=..., spec_len=...)``.
+        ``defaults`` seed the per-request SamplingParams (max_tokens /
+        temperature / top_k / top_p / seed / eos / spec_mode /
+        spec_len)."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
         if getattr(self, "_server", None) is not None:
             raise RuntimeError("serve_start: server already running "
                                "(call serve_stop first)")
+        if isinstance(spec_model, Net):
+            spec_model = net_gpt_export(spec_model._net)
         cfg, params = net_gpt_export(self._net)
         self._server = InferenceServer(
             cfg, params, slots=slots, queue=queue, timeout_ms=timeout_ms,
             prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
             prefix_mb=prefix_mb, recompile_limit=recompile_limit,
-            recompile_strict=recompile_strict,
+            recompile_strict=recompile_strict, spec_mode=spec_mode,
+            spec_len=spec_len, spec_model=spec_model,
             defaults=SamplingParams(**defaults))
 
     def _serving(self):

@@ -43,7 +43,11 @@ def _no_leaked_background_threads():
     pool and its device buffers), failed here instead of hanging a later
     test."""
     yield
-    prefixes = ("cxn-device-prefetch", "cxn-serve")   # scheduler + printer
+    # scheduler + printer + any speculative drafter workers (cxn-spec-*:
+    # the naming contract for future async drafters — today's drafters
+    # run on the scheduler thread, but a leak check that predates the
+    # first worker is the cheap kind)
+    prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec")
     deadline = time.time() + 5.0
     while True:
         leaked = [t.name for t in threading.enumerate()

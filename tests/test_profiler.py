@@ -39,6 +39,60 @@ def test_step_stats_empty_phase_is_cheap():
     assert "1 steps" in stats.summary()
 
 
+def test_percentiles_empty_window_is_zero():
+    """The empty-window contract: summarizing a phase that never ran
+    (zero ticks, zero requests) yields consistent finite zeros — never
+    a raise, never NaN in a stats line."""
+    out = profiler.percentiles([])
+    assert out == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    assert profiler.percentiles([], qs=(0.25, 0.5)) == {"p25": 0.0,
+                                                        "p50": 0.0}
+    stats = profiler.StepStats()
+    assert stats.percentiles("never_ran") == {"p50": 0.0, "p95": 0.0,
+                                              "p99": 0.0}
+    # non-finite samples are dropped instead of propagating into the
+    # summary (a poisoned entry must not surface NaN percentiles)
+    out = profiler.percentiles([float("nan"), 1.0, float("inf"), 2.0])
+    assert all(np.isfinite(v) for v in out.values())
+    assert out["p99"] == 2.0
+    assert all(np.isfinite(v)
+               for v in profiler.percentiles([float("nan")]).values())
+
+
+def test_server_gauges_zero_ticks_consistent():
+    """A server that served nothing (zero ticks, zero admits) reports
+    0.0 occupancy/batch-efficiency and all-finite metrics — the gauges
+    the CLI stats line formats must never see NaN."""
+    import math
+
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.serve import InferenceServer
+
+    cfg = GPTConfig(vocab_size=16, seq_len=16, n_layer=1, n_head=2,
+                    feat=8, n_microbatch=1)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    with InferenceServer(cfg, params, slots=2, queue=4) as srv:
+        m = srv.metrics()
+    assert m["slot_occupancy"] == 0.0
+    assert m["batch_efficiency"] == 0.0
+    assert m["ticks"] == 0
+
+    def flat(v):
+        if isinstance(v, dict):
+            for x in v.values():
+                yield from flat(x)
+        elif isinstance(v, (int, float)):
+            yield v
+
+    assert all(math.isfinite(v) for v in flat(m)), m
+    # the CLI stats line's formatting of the empty window cannot raise
+    line = ("serve: ttft p50 %.1f / p95 %.1f; batch efficiency %.2f "
+            "over %d ticks" % (m["ttft_ms"]["p50"], m["ttft_ms"]["p95"],
+                               m["batch_efficiency"], m["ticks"]))
+    assert "nan" not in line
+
+
 def test_trace_noop_without_logdir():
     with profiler.trace(None):
         pass

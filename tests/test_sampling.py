@@ -126,6 +126,108 @@ def test_decode_validates_sampling_params():
         gpt_decode(params, prompt, 2, cfg, top_p=1.5)
 
 
+def _filtered_probs(logits, temperature, top_k, top_p):
+    """Host-side expected distribution: the filtered softmax the
+    speculative accept/residual pair must preserve."""
+    filt = np.asarray(filter_logits(
+        jnp.asarray(logits[None] / temperature, jnp.float32),
+        top_k=top_k, top_p=top_p))[0]
+    e = np.where(np.isfinite(filt), np.exp(filt - np.nanmax(
+        np.where(np.isfinite(filt), filt, np.nan))), 0.0)
+    return e / e.sum()
+
+
+def _chi2(counts, probs, n):
+    keep = probs > 0
+    exp = probs[keep] * n
+    return float(((counts[keep] - exp) ** 2 / exp).sum()), int(keep.sum())
+
+
+def test_speculative_rejection_matches_direct_distribution():
+    """The satellite's chi-squared check: emitting via the speculative
+    accept/residual pair (accept the deterministic draft with prob
+    p(draft), else sample the draft-excluded renormalized residual) must
+    reproduce the SAME distribution as a direct sample_rows draw under
+    top-k/top-p filters. Small vocab, many trials, generous chi-squared
+    bound (p ~ 1e-4 rejection at the pinned df)."""
+    from cxxnet_tpu.ops.sampling import (accept_draft_rows,
+                                         residual_sample_rows)
+    rs = np.random.RandomState(0)
+    logits = rs.randn(8).astype(np.float32) * 2.0
+    temperature, top_k, top_p = 0.9, 5, 0.9
+    probs = _filtered_probs(logits, temperature, top_k, top_p)
+    draft = int(np.argsort(probs)[-2])      # a plausible (2nd best) draft
+    n = 4000
+    lrow = jnp.asarray(logits)[None]
+    t_row = jnp.asarray([temperature], jnp.float32)
+    k_row = jnp.asarray([top_k], jnp.int32)
+    p_row = jnp.asarray([top_p], jnp.float32)
+    spec_counts = np.zeros(8)
+    direct_counts = np.zeros(8)
+    for s in range(n):
+        key = jax.random.PRNGKey(s)
+        acc = bool(np.asarray(accept_draft_rows(
+            lrow, jnp.asarray([draft]), jax.random.fold_in(key, 1)[None],
+            t_row, k_row, p_row))[0])
+        if acc:
+            tok = draft
+        else:
+            tok = int(np.asarray(residual_sample_rows(
+                lrow, jnp.asarray([draft]),
+                jax.random.fold_in(key, 2)[None], t_row, k_row,
+                p_row))[0])
+        spec_counts[tok] += 1
+        direct_counts[int(np.asarray(sample_rows(
+            lrow, jax.random.fold_in(key, 3)[None], t_row, k_row,
+            p_row))[0])] += 1
+    # the filters must actually bite in this setup (df > 1, < vocab)
+    kept = int((probs > 0).sum())
+    assert 2 <= kept < 8
+    stat_spec, df = _chi2(spec_counts, probs, n)
+    stat_direct, _ = _chi2(direct_counts, probs, n)
+    # chi-squared 99.99% quantiles for df-1 in [1, 7]
+    crit = {1: 15.1, 2: 18.4, 3: 21.1, 4: 23.5, 5: 25.7, 6: 27.9,
+            7: 29.9}[df - 1]
+    assert stat_spec < crit, (stat_spec, spec_counts, probs * n)
+    assert stat_direct < crit, (stat_direct, direct_counts, probs * n)
+    # no mass may leak outside the filtered candidate set
+    assert spec_counts[probs == 0].sum() == 0
+
+
+def test_speculative_greedy_accept_and_residual_rules():
+    """Greedy rows: accept iff draft == argmax; the emitted token on a
+    rejection is the plain argmax (the solo path's pick), never
+    affected by the exclusion."""
+    from cxxnet_tpu.ops.sampling import (accept_draft_rows,
+                                         residual_sample_rows)
+    logits = jnp.asarray([[1.0, 4.0, 2.0, 3.0],
+                          [1.0, 4.0, 2.0, 3.0]])
+    keys = jnp.stack([jax.random.PRNGKey(0), jax.random.PRNGKey(1)])
+    zeros = jnp.zeros(2, jnp.float32)
+    acc = np.asarray(accept_draft_rows(
+        logits, jnp.asarray([1, 3]), keys, zeros,
+        jnp.zeros(2, jnp.int32), jnp.ones(2, jnp.float32)))
+    np.testing.assert_array_equal(acc, [True, False])
+    out = np.asarray(residual_sample_rows(
+        logits, jnp.asarray([3, 1]), keys, zeros,
+        jnp.zeros(2, jnp.int32), jnp.ones(2, jnp.float32)))
+    np.testing.assert_array_equal(out, [1, 1])
+
+
+def test_residual_excludes_draft_in_sampled_rows():
+    """Sampled rejection rows never re-emit the rejected draft token."""
+    from cxxnet_tpu.ops.sampling import residual_sample_rows
+    rs = np.random.RandomState(2)
+    logits = jnp.asarray(rs.randn(1, 6).astype(np.float32))
+    draft = int(np.argmax(np.asarray(logits)[0]))    # exclude the mode
+    for s in range(50):
+        tok = int(np.asarray(residual_sample_rows(
+            logits, jnp.asarray([draft]), jax.random.PRNGKey(s)[None],
+            jnp.asarray([1.0], jnp.float32), jnp.asarray([0], jnp.int32),
+            jnp.asarray([1.0], jnp.float32)))[0])
+        assert tok != draft
+
+
 def test_net_generate_topk_through_config_surface():
     """generate_topk/generate_topp reach the decode from the Net surface
     (wrapper + nnet.lm), reproducibly for a fixed seed."""

@@ -1,0 +1,576 @@
+"""Speculative decoding (serve/speculative.py + engine verify program +
+scheduler interleaving + the offline ``gpt_decode(speculative=...)``
+path). The load-bearing invariants: (1) GREEDY speculative output is
+bit-identical to the solo ``gpt_decode`` run — for chunked, prefix-hit,
+and recycled-slot admissions, with both the n-gram and the draft-model
+drafter, because acceptance is argmax-prefix matching against logits
+that are themselves bit-identical to the tick's; (2) ``spec_mode=off``
+is a TRUE no-op on the existing serve path (the verify program is never
+even fetched); (3) mixed draft hit lengths compile exactly ONE verify
+signature (RecompileGuard-pinned), and a drifting ``spec_len`` trips
+CXN205 naming it; (4) the verify executable keeps both donated caches
+aliased."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis.findings import LintError
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (DecodeEngine, InferenceServer, ModelDrafter,
+                              NgramDrafter)
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+DCFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=1, n_head=2, feat=16,
+                 n_microbatch=1)
+DPARAMS = gpt_init(jax.random.PRNGKey(7), DCFG)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, **kw):
+    """The offline oracle: the same request run alone through
+    gpt_decode (non-speculative)."""
+    seed = kw.pop("seed", 0)
+    t = kw.get("temperature", 0.0)
+    rng = jax.random.PRNGKey(seed) if t > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 rng=rng, **kw))[0]
+
+
+# ------------------------------------------------------------ drafters
+def test_ngram_drafter_prompt_lookup():
+    """The drafter proposes the continuation of the most recent earlier
+    occurrence of the trailing n-gram, longest n-gram first, and returns
+    empty when the suffix never occurred before."""
+    d = NgramDrafter(spec_len=4, max_ngram=3)
+    ctx = np.asarray([1, 2, 3, 9, 8, 1, 2, 3], np.int32)
+    # trailing 3-gram (1,2,3) occurred at 0 -> propose what followed: 9,8,1,2
+    np.testing.assert_array_equal(d.draft_one(ctx, 4), [9, 8, 1, 2])
+    # shorter draft window truncates
+    np.testing.assert_array_equal(d.draft_one(ctx, 2), [9, 8])
+    # most RECENT match wins: (7,) last occurred at index 4 -> proposes 5
+    ctx2 = np.asarray([7, 1, 7, 2, 7, 5, 6, 7], np.int32)
+    np.testing.assert_array_equal(d.draft_one(ctx2, 3), [5, 6, 7])
+    # unseen suffix -> no proposal
+    assert d.draft_one(np.asarray([1, 2, 3, 4], np.int32), 4).size == 0
+    # degenerate contexts never crash
+    assert d.draft_one(np.asarray([5], np.int32), 4).size == 0
+    assert d.draft_one(np.zeros(0, np.int32), 4).size == 0
+
+
+def test_greedy_prefix_accept_property():
+    """The verify program's acceptance rule, driven directly: ANY draft
+    that is a prefix of the target's greedy (argmax) continuation is
+    fully accepted, and the first divergence is replaced by the target's
+    own pick — so the emitted window is always exactly the next
+    ``n_acc + 1`` tokens of the solo greedy stream."""
+    rs = np.random.RandomState(3)
+    p = _prompt(rs, 6)
+    full = _ref(p, 10)
+    gen = full[len(p):]                     # the greedy continuation
+    K = 4
+    key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+    for n_good in range(K + 1):             # drafts agreeing for n_good
+        eng = DecodeEngine(CFG, PARAMS, slots=1, prefill_chunk=0,
+                           spec_len=K)
+        tok0 = eng.prefill(0, p, key, 0.0, 0, 1.0)
+        assert tok0 == int(gen[0])
+        draft = list(gen[1:1 + n_good])
+        while len(draft) < K:               # diverge, then pad
+            draft.append(int(gen[len(draft) + 1] + 1) % CFG.vocab_size)
+        buf = np.asarray([tok0] + draft, np.int32)
+        n_acc, emit = eng.verify_chunk(0, buf, len(p), K, key, 1,
+                                       0.0, 0, 1.0)
+        assert n_acc == n_good, (n_good, n_acc)
+        assert emit == int(gen[1 + n_good]), (n_good, emit)
+        eng.close()
+
+
+# ----------------------------------------------------- serving identity
+def test_spec_ngram_chunked_matches_offline_path():
+    """The acceptance invariant: chunked admissions (prompt lengths that
+    are and are not chunk multiples) with spec_mode=ngram reproduce the
+    solo gpt_decode stream bit for bit, and the server actually ran
+    verify forwards to get there."""
+    rs = np.random.RandomState(0)
+    prompts = [_prompt(rs, n) for n in (3, 4, 9, 13, 8)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        handles = [srv.submit(p, max_tokens=8) for p in prompts]
+        res = [srv.result(h, timeout=300) for h in handles]
+        m = srv.metrics()
+    for p, r in zip(prompts, res):
+        assert r.status == "ok", (r.status, r.error)
+        np.testing.assert_array_equal(r.tokens, _ref(p, 8))
+    assert m["spec_forwards"] > 0
+    assert 0.0 <= m["accept_rate"] <= 1.0
+    assert m["spec_tokens_per_forward"] >= 1.0
+    assert set(m["spec_verify_ms"]) == {"p50", "p95", "p99"}
+
+
+def test_spec_model_drafter_matches_offline_path():
+    """spec_mode=model: a SMALLER draft GPT (its own slot pool, its own
+    cache machinery) proposes, the target verifies — output still
+    bit-identical to solo gpt_decode no matter how bad the drafter is
+    (these two random inits disagree almost always)."""
+    rs = np.random.RandomState(1)
+    prompts = [_prompt(rs, n) for n in (5, 11, 7)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="model", spec_len=3,
+                         spec_model=(DCFG, DPARAMS)) as srv:
+        handles = [srv.submit(p, max_tokens=7) for p in prompts]
+        res = [srv.result(h, timeout=300) for h in handles]
+        m = srv.metrics()
+    for p, r in zip(prompts, res):
+        assert r.status == "ok", (r.status, r.error)
+        np.testing.assert_array_equal(r.tokens, _ref(p, 7))
+    assert m["spec_forwards"] > 0
+    assert 0.0 <= m["spec_rollback_rate"] <= 1.0
+
+
+def test_model_drafter_catch_up_stays_aligned():
+    """A draft model IDENTICAL to the target is a perfect drafter: its
+    greedy proposals must equal the target's own greedy continuation on
+    every draft call, including later calls whose catch-up starts at a
+    chunk-UNALIGNED synced offset. Regression: the catch-up used to
+    issue its chunk-wide cache write at the raw synced offset, which
+    can run past row_len where dynamic_update_slice start-clamping
+    silently shifts the write onto earlier live draft K/V — drafts
+    after the first call became garbage (identity unaffected, accept
+    rate silently collapsed)."""
+    d = ModelDrafter(CFG, PARAMS, slots=1, target_cfg=CFG)
+    try:
+        assert d.engine.chunk > 1     # unaligned growth must be possible
+        rs = np.random.RandomState(9)
+        ctx = _prompt(rs, 7)
+        K = 4
+        for _ in range(3):
+            want = _ref(ctx, K)[len(ctx):]
+            got = d.draft({0: ctx}, {0: K})[0]
+            np.testing.assert_array_equal(got, want)
+            # grow by the true greedy continuation to an offset that is
+            # NOT a chunk multiple, then draft again from the same row
+            ctx = np.concatenate([ctx, want[:3]])
+            assert len(ctx) % d.engine.chunk
+    finally:
+        d.close()
+
+
+def test_model_drafter_caps_draft_at_position_table():
+    """A context near the sequence end caps the draft: draft positions
+    run len(ctx) .. len(ctx) + k - 1 and must stay inside the draft
+    model's own position table (the ctor only requires seq_len >= the
+    target's), so a request asking for more gets a SHORTER draft — and
+    a perfect (same-model) drafter's shortened proposal still matches
+    the target's greedy continuation exactly."""
+    d = ModelDrafter(CFG, PARAMS, slots=1, target_cfg=CFG)
+    try:
+        rs = np.random.RandomState(11)
+        ctx = _ref(_prompt(rs, 5), CFG.seq_len - 7)     # len = seq - 2
+        assert len(ctx) == CFG.seq_len - 2
+        got = d.draft({0: ctx}, {0: 4})[0]
+        assert 1 <= len(got) <= 2                       # 2 positions left
+        np.testing.assert_array_equal(
+            got, _ref(ctx, 2)[len(ctx):][:len(got)])
+    finally:
+        d.close()
+
+
+def test_spec_recycled_slot_matches_fresh_decode():
+    """One slot, back-to-back speculative requests: the second lands in
+    the recycled slot (stale verify rows included) and must match its
+    solo run."""
+    rs = np.random.RandomState(2)
+    a, b = _prompt(rs, 6), _prompt(rs, 9)
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8, prefill_chunk=4,
+                         prefix_mb=0.0, spec_mode="ngram",
+                         spec_len=4) as srv:
+        ha = srv.submit(a, max_tokens=8)
+        hb = srv.submit(b, max_tokens=8)
+        res_a = srv.result(ha, timeout=300)
+        res_b = srv.result(hb, timeout=300)
+        assert hb.slot == ha.slot == 0
+    np.testing.assert_array_equal(res_a.tokens, _ref(a, 8))
+    np.testing.assert_array_equal(res_b.tokens, _ref(b, 8))
+
+
+def test_spec_prefix_hit_matches_cold_path():
+    """Prefix-cache hit + speculation: request b restores a's cached
+    prompt chunks AND speculates — still bit-identical to both the cold
+    path and the solo run."""
+    rs = np.random.RandomState(4)
+    shared = _prompt(rs, 12)
+    a = np.concatenate([shared, _prompt(rs, 3)])
+    b = np.concatenate([shared, _prompt(rs, 5)])
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        res_a = srv.result(srv.submit(a, max_tokens=6), timeout=300)
+        res_b = srv.result(srv.submit(b, max_tokens=6), timeout=300)
+        m = srv.metrics()
+    np.testing.assert_array_equal(res_a.tokens, _ref(a, 6))
+    np.testing.assert_array_equal(res_b.tokens, _ref(b, 6))
+    assert m["prefix_cache"]["hits"] == 1       # the reuse still engaged
+
+
+def test_spec_eos_mid_window_truncates():
+    """EOS landing inside an accepted speculative window retires the
+    request THERE — tokens after it are discarded, exactly like the
+    tick-by-tick path."""
+    rs = np.random.RandomState(6)
+    p = _prompt(rs, 5)
+    full = _ref(p, 10)
+    gen = full[len(p):]
+    i = next((j for j in range(1, len(gen))
+              if int(gen[j]) not in gen[:j].tolist()), 0)
+    eos = int(gen[i])
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        res = srv.result(srv.submit(p, max_tokens=10, eos=eos),
+                         timeout=300)
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, full[:len(p) + i + 1])
+    assert int(res.tokens[-1]) == eos
+
+
+# ------------------------------------------------- mode plumbing / off
+def test_spec_off_is_true_noop():
+    """spec_mode=off must leave the serve path untouched: the verify
+    program is never fetched (a poisoned verify_chunk proves it), spec
+    gauges stay at their consistent zeros, and tokens match."""
+    rs = np.random.RandomState(7)
+    p = _prompt(rs, 6)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8) as srv:
+        def boom(*a, **kw):
+            raise AssertionError("verify_chunk fetched with spec off")
+        srv._engine.verify_chunk = boom
+        assert srv._engine.spec_len == 0        # no verify program built
+        res = srv.result(srv.submit(p, max_tokens=6), timeout=300)
+        m = srv.metrics()
+    np.testing.assert_array_equal(res.tokens, _ref(p, 6))
+    assert m["spec_forwards"] == 0
+    assert m["accept_rate"] == 0.0
+    assert m["spec_tokens_per_forward"] == 0.0
+    assert m["spec_rollback_rate"] == 0.0
+
+
+def test_spec_per_request_override_and_validation():
+    """Per-request spec_mode overrides: off-on-a-spec-server and
+    ngram-on-a-model-server both serve identically; an unavailable mode
+    is rejected at submit with a reason."""
+    from cxxnet_tpu.serve import AdmissionError
+    rs = np.random.RandomState(8)
+    a, b, c = _prompt(rs, 7), _prompt(rs, 9), _prompt(rs, 5)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="model", spec_len=3,
+                         spec_model=(DCFG, DPARAMS)) as srv:
+        h1 = srv.submit(a, max_tokens=6, spec_mode="off")
+        h2 = srv.submit(b, max_tokens=6, spec_mode="ngram")
+        h3 = srv.submit(c, max_tokens=6, spec_len=2)    # tighter window
+        for h, p in ((h1, a), (h2, b), (h3, c)):
+            r = srv.result(h, timeout=300)
+            assert r.status == "ok"
+            np.testing.assert_array_equal(r.tokens, _ref(p, 6))
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        with pytest.raises(AdmissionError, match="not available"):
+            srv.submit(a, max_tokens=4, spec_mode="model")
+        assert srv.metrics()["requests"]["rejected"] == 1
+    # a spec-off server rejects explicit spec requests too
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4) as srv:
+        with pytest.raises(AdmissionError, match="not available"):
+            srv.submit(a, max_tokens=4, spec_mode="ngram")
+
+
+def test_spec_sampled_seeded_reproducible():
+    """Sampled speculative serving: distribution-level (not bit-pinned
+    to the solo run), but the same seed on the same single-slot server
+    reproduces the same stream — the fold_in schedule still consumes
+    one index per emitted token."""
+    rs = np.random.RandomState(9)
+    p = _prompt(rs, 9)
+
+    def run():
+        with InferenceServer(CFG, PARAMS, slots=1, queue=4,
+                             prefill_chunk=4, spec_mode="ngram",
+                             spec_len=4) as srv:
+            return srv.result(srv.submit(p, max_tokens=8, temperature=0.9,
+                                         top_k=5, seed=3), timeout=300)
+    r1, r2 = run(), run()
+    assert r1.status == r2.status == "ok"
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+    assert ((0 <= r1.tokens) & (r1.tokens < CFG.vocab_size)).all()
+
+
+# ------------------------------------------- compiled-program bounding
+def test_verify_one_signature_across_mixed_requests():
+    """The acceptance bound: >= 30 mixed-length speculative requests
+    (mixed draft hit lengths included) compile exactly ONE verify
+    signature, enforced by the engine's RecompileGuard (limit 1 would
+    trip on the second signature — it never does)."""
+    rs = np.random.RandomState(10)
+    with InferenceServer(CFG, PARAMS, slots=4, queue=40, prefill_chunk=4,
+                         prefix_mb=0.0, recompile_limit=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        handles = [srv.submit(
+            np.tile(_prompt(rs, 4), 4)[:n].astype(np.int32), max_tokens=6)
+            for n in range(2, 32)]          # 30 distinct lengths
+        for h in handles:
+            assert srv.result(h, timeout=300).status == "ok"
+        vsigs = srv._engine.verify_signatures
+        forwards = srv.metrics()["spec_forwards"]
+    assert forwards > 0
+    assert len(vsigs) == 1, vsigs
+
+
+def test_verify_guard_trips_naming_spec_len():
+    """A drifting verify window is a compile-per-shape bug: the guard
+    trips CXN205 with the drifting dimension named (spec_len)."""
+    eng = DecodeEngine(CFG, PARAMS, slots=1, prefill_chunk=0, spec_len=4,
+                       recompile_limit=1)
+    rs = np.random.RandomState(11)
+    key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+    tok0 = eng.prefill(0, _prompt(rs, 4), key, 0.0, 0, 1.0)
+    eng.verify_chunk(0, np.asarray([tok0, 1, 2], np.int32), 4, 2, key, 1,
+                     0.0, 0, 1.0)
+    with pytest.raises(LintError, match="spec_len"):
+        eng.verify_chunk(0, np.asarray([tok0, 1, 2, 3], np.int32), 4, 3,
+                         key, 1, 0.0, 0, 1.0)
+    eng.close()
+
+
+# --------------------------------------------------------- step audit
+def test_verify_lint_specs_fully_aliased():
+    """lint_specs grows the serve_verify_chunk row when the engine
+    carries a spec_len, and its executable keeps both donated caches
+    aliased (pinned with donate=True on the CPU mesh)."""
+    from cxxnet_tpu.analysis import audit_serve_engine
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4, spec_len=4)
+    report, infos = audit_serve_engine(eng, n_prompt=5, donate=True)
+    assert report.ok(), report.format()
+    labels = [i["label"] for i in infos]
+    assert labels == ["serve_prefill", "serve_prefill_chunk",
+                      "serve_verify_chunk", "serve_tick"]
+    for info in infos:
+        assert info["donated"] == 2 and info["aliased"] == 2, info
+    eng.close()
+
+
+def test_cxn_lint_compile_audits_verify_program(tmp_path, capsys):
+    """tools/cxn_lint.py --compile with spec_mode enabled audits the
+    verify program alongside prefill/chunk/tick for a GPT-shaped
+    config."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import cxn_lint
+    finally:
+        sys.path.pop(0)
+    from cxxnet_tpu.models import gpt_lm_config
+    conf = tmp_path / "gpt.conf"
+    conf.write_text(gpt_lm_config(seq_len=16, vocab_size=32, feat=16,
+                                  nhead=2, nblock=2, batch_size=4,
+                                  dev="cpu:0"))
+    rc = cxn_lint.lint_one(str(conf), [("spec_mode", "ngram"),
+                                       ("spec_len", "3")], do_compile=True)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "serve_verify_chunk" in out
+
+
+# ------------------------------------------------------- offline path
+def test_gpt_decode_speculative_greedy_identity():
+    """gpt_decode(speculative=...) greedy output is bit-identical to the
+    plain scan for both drafters and for batch > 1; the stats out-dict
+    reports the forwards/accept accounting."""
+    rs = np.random.RandomState(12)
+    prompt = np.asarray([_prompt(rs, 7), np.tile(_prompt(rs, 7)[:4], 2)[:7]],
+                        np.int32)
+    ref = np.asarray(gpt_decode(PARAMS, prompt, 16, CFG))
+    st = {}
+    out = np.asarray(gpt_decode(PARAMS, prompt, 16, CFG,
+                                speculative={"mode": "ngram",
+                                             "spec_len": 4, "stats": st}))
+    np.testing.assert_array_equal(ref, out)
+    assert st["tokens"] == 32 and st["forwards"] >= 0
+    assert 0.0 <= st["accept_rate"] <= 1.0
+    out_m = np.asarray(gpt_decode(
+        PARAMS, prompt, 16, CFG,
+        speculative={"mode": "model", "spec_len": 3,
+                     "model": (DCFG, DPARAMS)}))
+    np.testing.assert_array_equal(ref, out_m)
+    # the int shorthand selects the ngram drafter
+    out_i = np.asarray(gpt_decode(PARAMS, prompt, 16, CFG, speculative=4))
+    np.testing.assert_array_equal(ref, out_i)
+
+
+def test_gpt_decode_speculative_rejects_int8():
+    rs = np.random.RandomState(13)
+    p = _prompt(rs, 4)[None]
+    with pytest.raises(ValueError, match="int8"):
+        gpt_decode(PARAMS, p, 4, CFG, int8_weights=True, speculative=4)
+
+
+def test_wrapper_generate_speculative():
+    """Net.generate(speculative=...) through the config surface stays
+    identical to the non-speculative call."""
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.models import gpt_lm_config
+
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=4, dev="cpu:0")
+    net = wrapper.Net(cfg=cfg)
+    net.init_model()
+    prompt = (np.arange(8, dtype=np.int32) % 4).reshape(1, 8)
+    want = net.generate(prompt, max_new=6)
+    got = net.generate(prompt, max_new=6, speculative=3)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_wrapper_serve_spec_api():
+    """Net.serve_start(spec_mode=...) with a wrapper.Net draft model:
+    tokens stay pinned to Net.generate on the same request."""
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.models import gpt_lm_config
+
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=4, dev="cpu:0")
+    net = wrapper.Net(cfg=cfg)
+    net.init_model()
+    draft = wrapper.Net(cfg=gpt_lm_config(seq_len=16, vocab_size=32,
+                                          feat=16, nhead=2, nblock=2,
+                                          batch_size=4, dev="cpu:0"))
+    draft.init_model()
+    prompt = np.arange(4, dtype=np.int32) % 32
+    want = net.generate(prompt[None], max_new=5)
+    net.serve_start(slots=2, queue=4, max_tokens=5, spec_mode="model",
+                    spec_len=3, spec_model=draft)
+    try:
+        res = net.serve_result(net.serve_submit(prompt), timeout=300)
+        assert res.status == "ok"
+        np.testing.assert_array_equal(res.tokens, want[0])
+        m = net.serve_metrics()
+        assert "accept_rate" in m and "spec_rollback_rate" in m
+    finally:
+        net.serve_stop()
+
+
+# ------------------------------------------------------------ CLI path
+def test_cli_task_serve_speculative(tmp_path, capfd, monkeypatch):
+    """task=serve with spec_mode=ngram end to end: outputs stay
+    token-identical to task=generate on the same snapshot, and the
+    stats line reports the speculative gauges."""
+    import io as _io
+
+    from cxxnet_tpu.cli import LearnTask
+    from cxxnet_tpu.models import gpt_lm_config
+
+    corpus = tmp_path / "corpus.bin"
+    toks = np.tile(np.arange(16, dtype=np.uint16), 40)
+    corpus.write_bytes(toks.tobytes())
+    conf = tmp_path / "gpt.conf"
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=8, dev="cpu:0", eta=0.2)
+    conf.write_text("""
+data = train
+iter = lm
+    path_data = "%s"
+    token_dtype = uint16
+    seq_len = 16
+    stride = 8
+iter = end
+%s
+num_round = 1
+save_model = 1
+model_dir = %s
+""" % (corpus, cfg, tmp_path / "models"))
+    assert LearnTask().run([str(conf)]) == 0
+    model = tmp_path / "models" / "0001.model"
+
+    prompts = tmp_path / "p.txt"
+    gen_out = tmp_path / "g.txt"
+    prompts.write_text("0 1 2 3 0 1 2 3\n")
+    assert LearnTask().run([
+        str(conf), "task=generate", "model_in=%s" % model,
+        "prompt_file=%s" % prompts, "num_gen=4",
+        "generate_out=%s" % gen_out]) == 0
+    want = gen_out.read_text().split()
+    # the speculative offline CLI path writes the same tokens
+    gen_spec = tmp_path / "gs.txt"
+    assert LearnTask().run([
+        str(conf), "task=generate", "model_in=%s" % model,
+        "prompt_file=%s" % prompts, "num_gen=4", "spec_mode=ngram",
+        "spec_len=3", "generate_out=%s" % gen_spec]) == 0
+    assert gen_spec.read_text().split() == want
+    capfd.readouterr()
+
+    monkeypatch.setattr("sys.stdin", _io.StringIO("0 1 2 3 0 1 2 3\n"))
+    assert LearnTask().run([
+        str(conf), "task=serve", "model_in=%s" % model, "num_gen=4",
+        "serve_slots=2", "serve_queue=4", "spec_mode=ngram",
+        "spec_len=3"]) == 0
+    out, err = capfd.readouterr()
+    rows = [l.split() for l in out.strip().splitlines()
+            if l and l[0].isdigit()]
+    assert rows and rows[0] == want
+    assert "speculative ngram x3" in err
+    assert "spec accept" in err
+
+
+# ------------------------------------------------------------- metrics
+def test_spec_metrics_zero_window_consistent():
+    """A speculative server that never ran a verify forward (no traffic)
+    reports consistent finite zeros — no NaN, no raise (the empty-window
+    contract of the satellite task)."""
+    import math
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=4) as srv:
+        m = srv.metrics()
+    assert m["spec_forwards"] == 0
+    assert m["accept_rate"] == 0.0
+    assert m["spec_tokens_per_forward"] == 0.0
+    assert m["spec_rollback_rate"] == 0.0
+    for key in ("spec_draft_ms", "spec_verify_ms", "ttft_ms"):
+        assert all(math.isfinite(v) and v == 0.0 for v in m[key].values())
+
+
+# ----------------------------------------------------------- slow soak
+@pytest.mark.slow
+def test_soak_mixed_spec_nonspec_identity():
+    """Mixed speculative / non-speculative concurrent load: every greedy
+    request — spec ngram, spec model, and spec off, interleaved on the
+    same slots — stays bit-identical to its solo gpt_decode run, and
+    sampled spec-off requests stay pinned too."""
+    rs = np.random.RandomState(20)
+    cases = []
+    for i in range(18):
+        n = int(rs.choice([4, 7, 11, 14]))
+        p = _prompt(rs, n)
+        if i % 3 == 0:
+            p = np.tile(p, 3)[:n + 6].astype(np.int32)  # repetitive-ish
+        mode = ("ngram", "model", "off")[i % 3]
+        kw = {"max_tokens": int(rs.choice([6, 10, 14]))}
+        if mode == "off" and i % 2:
+            kw.update(temperature=0.8, top_k=5, seed=int(i))
+        cases.append((p, mode, kw))
+    with InferenceServer(CFG, PARAMS, slots=4, queue=32, prefill_chunk=4,
+                         spec_mode="model", spec_len=4,
+                         spec_model=(DCFG, DPARAMS)) as srv:
+        handles = [srv.submit(p, spec_mode=mode, **kw)
+                   for p, mode, kw in cases]
+        res = [srv.result(h, timeout=600) for h in handles]
+        m = srv.metrics()
+    assert all(r.status == "ok" for r in res)
+    for (p, mode, kw), r in zip(cases, res):
+        ref_kw = {k: v for k, v in kw.items() if k != "max_tokens"}
+        np.testing.assert_array_equal(
+            r.tokens, _ref(p, kw["max_tokens"], **ref_kw))
+    assert m["spec_forwards"] > 0

@@ -12,9 +12,10 @@ tests/test_lint.py wires it into pytest). ``--compile`` additionally
 builds the net (init_model on the default backend) and audits the
 compiled steps (pass 2: donation aliasing, dtype promotion, host
 transfers, collectives); for a GPT-shaped config it also audits the
-serve engine's prefill / chunk-prefill / tick executables — the
-programs ``task=serve`` runs. ``k=v`` args are CLI-style overrides
-linted as line-less pairs.
+serve engine's prefill / chunk-prefill / tick executables — plus the
+speculative ``serve_verify_chunk`` program when the config enables it
+(``spec_mode`` != off) — the programs ``task=serve`` runs. ``k=v``
+args are CLI-style overrides linted as line-less pairs.
 
 Exit codes: 0 clean (warnings allowed), 1 lint errors, 2 usage error.
 """
@@ -71,7 +72,10 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             # for a lint step that never executes anything
             eng = DecodeEngine(gcfg, gparams, slots=2,
                                prefill_chunk=task.serve_prefill_chunk,
-                               abstract=True)
+                               abstract=True,
+                               spec_len=(task.spec_len
+                                         if task.spec_mode != "off"
+                                         else 0))
             serve_report, serve_infos = audit_serve_engine(eng)
             report.extend(serve_report.findings)
             infos += serve_infos
