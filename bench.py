@@ -40,6 +40,10 @@ Prints one JSON line per metric, in this order:
                                      on a repetitive-suffix trace;
                                      vs_baseline = the same trace served
                                      without speculation, round 10)
+ 12c. obs_overhead_pct              (serving throughput cost of leaving
+                                     span tracing on, SERVE_CELL trace
+                                     served with tracing on vs off; the
+                                     obs cost budget is <= 2%, round 11)
  13. lint_wall_ms                   (cxn-lint pass 1 on the largest
                                      example config — the CXN_LINT
                                      startup/CI cost, round 8)
@@ -751,6 +755,43 @@ def bench_serve_spec():
          batched_backoffs=m8["spec_backoffs"])
 
 
+def bench_obs_overhead(cell=None):
+    """Span-tracing cost gate (round 11, doc/observability.md): the
+    SERVE_CELL open-loop trace served with the obs tracer ON (the
+    shipped default — every request records its span tree, the
+    registry's callback metrics are live either way) vs a disabled
+    tracer, emitting the throughput overhead percentage. The obs cost
+    budget is <= 2%: tracing is designed to stay on under production
+    traffic (monotonic-clock spans, one lock-guarded deque append per
+    span, NO per-token records in the tick loop), and this line is what
+    enforces that claim release over release. Best-of-3 per arm with
+    the arms interleaved, so platform drift lands on both and the
+    percentage compares each arm's best achievable rate (a mean would
+    charge tracing for scheduler jitter)."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.obs.trace import Tracer
+
+    c = cell or SERVE_CELL
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_trace(c)
+    kw = dict(slots=c["slots"], queue=c["n_requests"])
+    best = {"on": 0.0, "off": 0.0}
+    for _ in range(3):
+        for arm in ("on", "off"):
+            wall, m_ = run_serve_trace(cfg, params, trace,
+                                       tracer=Tracer(enabled=arm == "on"),
+                                       **kw)
+            best[arm] = max(best[arm], m_["tokens_generated"] / wall)
+    pct = 100.0 * (best["off"] - best["on"]) / best["off"]
+    emit("obs_overhead_pct", pct, "%",
+         tracing_on_tokens_per_sec=round(best["on"], 1),
+         tracing_off_tokens_per_sec=round(best["off"], 1))
+
+
 def bench_lint():
     """cxn-lint pass-1 wall time on the LARGEST example config (round 8):
     the linter runs at every CXN_LINT startup and in CI, so its cost is a
@@ -774,7 +815,8 @@ def main() -> int:
     rc = 0
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
-               bench_serve_prefill_heavy, bench_serve_spec, bench_lint):
+               bench_serve_prefill_heavy, bench_serve_spec,
+               bench_obs_overhead, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -38,6 +38,17 @@ def abstract_signature(args: tuple, kwargs: Dict[str, Any] = None) -> tuple:
     return (str(treedef), tuple(sig))
 
 
+def trip_counter(registry):
+    """The guard-trip metric family — the ONE spelling of its
+    name/help/labels for every subsystem that wires ``on_trip`` into an
+    obs registry (``nnet.Net``, the serve engine, the server's catalog
+    pre-touch). Returns the labeled ``cxn_recompile_trips_total{fn=}``
+    family; trip with ``.labels(guard_name).inc()``."""
+    return registry.counter("cxn_recompile_trips_total",
+                            "RecompileGuard trips (CXN205)",
+                            labelnames=("fn",))
+
+
 class RecompileGuard:
     """Transparent wrapper around a jitted callable that tracks distinct
     abstract input signatures. Attribute access (``.lower``, ...)
@@ -45,12 +56,20 @@ class RecompileGuard:
     AOT inspection and the step audit."""
 
     def __init__(self, fn: Callable, name: str, limit: int,
-                 strict: bool = True, log: Callable[[str], None] = None):
+                 strict: bool = True, log: Callable[[str], None] = None,
+                 on_trip: Callable[[str], None] = None):
+        """``on_trip``: optional ``(guard_name)`` callable invoked on
+        EVERY trip, strict or not, before any raise — the obs hook that
+        turns trips into a registry counter
+        (``cxn_recompile_trips_total{fn=...}``) so a scraper sees them
+        even when the run survives in non-strict mode."""
         self._fn = fn
         self._name = name
         self._limit = max(1, int(limit))
         self._strict = strict
         self._log = log
+        self._on_trip = on_trip
+        self.trips = 0
         self._seen: Dict[tuple, int] = {}       # signature -> first call no
         self._calls = 0
 
@@ -70,6 +89,9 @@ class RecompileGuard:
                        "input or raise lint_recompile_limit"
                        % (self._name, len(self._seen), self._limit,
                           self._calls, _diff_hint(self._seen)))
+                self.trips += 1
+                if self._on_trip is not None:
+                    self._on_trip(self._name)
                 if self._strict:
                     raise LintError(msg)
                 if self._log is not None:

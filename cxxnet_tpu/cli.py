@@ -94,6 +94,21 @@ class LearnTask:
         #                           (empty = random init — testing only)
         self.lint_compile = 0     # task=lint: also lower/compile-audit the
         #                           jitted steps (pass 2; needs init_model)
+        self.obs_trace = 1        # span tracing (obs/trace.py): cheap
+        #                           enough to stay on; 0 disables
+        self.obs_trace_buffer = 65536   # span ring capacity (old spans
+        #                                 fall off; memory stays bounded)
+        self.obs_slow_ms = 0.0    # slow-request exemplar threshold:
+        #                           auto-dump the span tree of any
+        #                           request over this TTFT/total latency
+        #                           (0 = off)
+        self.obs_export = ""      # path PREFIX for telemetry dumps:
+        #                           <prefix>.metrics.jsonl (periodic
+        #                           snapshots), <prefix>.trace.json
+        #                           (Chrome trace), <prefix>.spans.jsonl
+        #                           (raw spans), <prefix>.prom (final
+        #                           exposition); empty = no files
+        self.obs_export_interval_s = 10.0   # JSONL snapshot period
         self.net: Optional[Net] = None
         self.itr_train = None
         self._train_feed = None   # DevicePrefetcher over itr_train (async)
@@ -189,6 +204,16 @@ class LearnTask:
             self.name_pred = val
         elif name == "lint_compile":
             self.lint_compile = int(val)
+        elif name == "obs_trace":
+            self.obs_trace = int(val)
+        elif name == "obs_trace_buffer":
+            self.obs_trace_buffer = int(val)
+        elif name == "obs_slow_ms":
+            self.obs_slow_ms = float(val)
+        elif name == "obs_export":
+            self.obs_export = val
+        elif name == "obs_export_interval_s":
+            self.obs_export_interval_s = float(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -236,24 +261,68 @@ class LearnTask:
                     # level 1 is log-only: a guard trip logs CXN205
                     # through the profiler instead of aborting the run
                     self.set_param("lint_recompile_strict", "0")
+        # observability knobs land on the process-global tracer before
+        # any task work records a span (doc/observability.md)
+        from .obs import trace as obs_trace
+        obs_trace.configure(
+            enabled=bool(self.obs_trace),
+            capacity=self.obs_trace_buffer,
+            slow_dir=(self.obs_export + ".slow")
+            if self.obs_export and self.obs_slow_ms > 0 else "")
         self.init()
         if lint_level and self.net is not None:
             self._run_step_audit(lint_level)
         if not self.silent:
             print("initializing end, start working")
-        if self.task in ("train", "finetune"):
-            self.task_train()
-        elif self.task == "pred":
-            self.task_predict()
-        elif self.task == "extract":
-            self.task_extract()
-        elif self.task == "generate":
-            self.task_generate()
-        elif self.task == "serve":
+        if self.task == "serve":
+            # serve exports its server-private registry; the wrapping
+            # happens inside task_serve where that registry exists
             self.task_serve()
-        else:
-            raise ValueError("unknown task %r" % self.task)
+            return 0
+        from .obs.metrics import default_registry
+        with self._obs_run(default_registry()):
+            if self.task in ("train", "finetune"):
+                self.task_train()
+            elif self.task == "pred":
+                self.task_predict()
+            elif self.task == "extract":
+                self.task_extract()
+            elif self.task == "generate":
+                self.task_generate()
+            else:
+                raise ValueError("unknown task %r" % self.task)
         return 0
+
+    @contextlib.contextmanager
+    def _obs_run(self, registry):
+        """Telemetry export around one task when ``obs_export`` is set:
+        a background JSONL flusher (cxn-obs-flusher thread) during the
+        task, then the end-of-task dump — Chrome trace + raw spans +
+        final Prometheus text under the ``obs_export`` prefix."""
+        if not self.obs_export:
+            yield
+            return
+        from .obs import MetricsFlusher, export_run
+        from .obs import trace as obs_trace
+        flusher = MetricsFlusher(registry,
+                                 self.obs_export + ".metrics.jsonl",
+                                 self.obs_export_interval_s,
+                                 extra=lambda: {"task": self.task})
+        try:
+            yield
+        finally:
+            flusher.close()
+            try:
+                paths = export_run(self.obs_export, registry,
+                                   obs_trace.get_tracer())
+                profiler.log("obs: telemetry written to %s"
+                             % ", ".join(paths))
+            except OSError as e:
+                # same discipline as flusher.close(): a telemetry write
+                # failure in a finally must not mask the task's own
+                # exception (or crash an otherwise-successful run)
+                profiler.warn("obs: end-of-task telemetry dump under %r "
+                              "failed (%s)" % (self.obs_export, e))
 
     # ------------------------------------------------------------- lint
     def task_lint(self, config_path: str, overrides: Pairs) -> int:
@@ -523,6 +592,7 @@ class LearnTask:
             self.net.start_round(self.start_counter)
             feed = self._train_feed_iter()
             feed.before_first()
+            t_round = time.perf_counter()
             stats = profiler.StepStats(batch_size=self.net.batch_size) \
                 if self.step_stats else None
             restart_round = False
@@ -600,10 +670,40 @@ class LearnTask:
             if stats and not self.silent:
                 print("\nround %d: %s" % (self.start_counter - 1,
                                           stats.summary()))
+            self._record_round_spans(t_round, stats, sample_counter)
             self.save_model()
             self.start_counter += 1
         if not self.silent:
             print("\nupdating end, %d sec in all" % int(time.time() - start))
+
+    def _record_round_spans(self, t0: float, stats, steps: int) -> None:
+        """Per-round training spans on the obs tracer's TID_TRAIN
+        track: one ``train_round`` span, plus (when ``step_stats = 1``
+        timed the phases) aggregate ``feed_wait`` / ``step_dispatch`` /
+        ``metric_sync`` child spans laid end to end inside it — each is
+        the round's phase TOTAL, not an exact interval (the per-step
+        intervals would be a per-step allocation for no new
+        information; the totals are what the feed-overlap question
+        needs)."""
+        from .obs import trace as obs_trace
+        tr = obs_trace.get_tracer()
+        if not tr.enabled:
+            return
+        now = time.perf_counter()
+        tid = obs_trace.TID_TRAIN
+        tr.add("train_round", t0, now - t0, tid, cat="train",
+               args={"round": self.start_counter, "steps": steps})
+        if stats is None:
+            return
+        cur = t0
+        totals = stats.phase_totals()
+        for phase in (profiler.FEED_WAIT, profiler.STEP_DISPATCH,
+                      profiler.METRIC_SYNC):
+            dur = totals.get(phase, 0.0)
+            if dur > 0:
+                tr.add(phase, cur, dur, tid, cat="train",
+                       args={"aggregate": True})
+                cur += dur
 
     def task_generate(self) -> None:
         """Autoregressive generation from a GPT-shaped model (the inference
@@ -737,7 +837,8 @@ class LearnTask:
                                   self.net.lint_recompile_strict),
                               spec_mode=self.spec_mode,
                               spec_len=self.spec_len,
-                              spec_model=self._spec_model_export())
+                              spec_model=self._spec_model_export(),
+                              slow_ms=self.obs_slow_ms)
         if not self.silent:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (
@@ -749,10 +850,12 @@ class LearnTask:
             if self.spec_mode != "off":
                 mode += ", speculative %s x%d" % (self.spec_mode,
                                                   self.spec_len)
-            print("serving: %d slots, queue %d, %s (one prompt per "
-                  "line; EOF drains and exits)"
-                  % (self.serve_slots, self.serve_queue, mode),
-                  file=sys.stderr)
+            # through the leveled logger, not a bare stderr print: the
+            # serve path's human lines carry timestamps so they
+            # interleave coherently with the obs JSONL snapshots
+            profiler.log("serving: %d slots, queue %d, %s (one prompt "
+                         "per line; EOF drains and exits)"
+                         % (self.serve_slots, self.serve_queue, mode))
         import collections
         import threading
 
@@ -798,6 +901,8 @@ class LearnTask:
                 feed.notify()
 
         try:
+            es = contextlib.ExitStack()
+            es.enter_context(self._obs_run(srv.registry))
             for line in sys.stdin:
                 line = line.strip()
                 if not line:
@@ -837,21 +942,30 @@ class LearnTask:
                               % (100.0 * m["accept_rate"],
                                  m["spec_tokens_per_forward"],
                                  100.0 * m["spec_rollback_rate"]))
-                print("serve: %d ok / %d timeout / %d rejected; "
-                      "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "
-                      "batch efficiency %.2f over %d ticks; %s"
-                      % (m["requests"]["completed"],
-                         m["requests"]["timeout"],
-                         m["requests"]["rejected"],
-                         m["ttft_ms"]["p50"], m["ttft_ms"]["p95"],
-                         m["ttft_ms"]["p99"], m["batch_efficiency"],
-                         m["ticks"], extra), file=sys.stderr)
+                profiler.log(
+                    "serve: %d ok / %d timeout / %d rejected; "
+                    "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "
+                    "batch efficiency %.2f over %d ticks; %s"
+                    % (m["requests"]["completed"],
+                       m["requests"]["timeout"],
+                       m["requests"]["rejected"],
+                       m["ttft_ms"]["p50"], m["ttft_ms"]["p95"],
+                       m["ttft_ms"]["p99"], m["batch_efficiency"],
+                       m["ticks"], extra))
         finally:
             srv.shutdown(drain=False)       # idempotent after drain()
-            with feed:                      # wake the printer on the
-                eof[0] = True               # error path too (shutdown
-                feed.notify()               # resolved every handle)
-            out_thread.join(timeout=10)
+            try:
+                with feed:                  # wake the printer on the
+                    eof[0] = True           # error path too (shutdown
+                    feed.notify()           # resolved every handle)
+                out_thread.join(timeout=10)
+            finally:
+                es.close()                  # final flush + trace dump
+                #                             LAST (after shutdown the
+                #                             gauges report the drained
+                #                             state) so a telemetry
+                #                             write error can't skip
+                #                             the printer wakeup/join
 
     def task_predict(self) -> None:
         assert self.itr_pred is not None, "must specify a pred iterator"

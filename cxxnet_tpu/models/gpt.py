@@ -1036,12 +1036,13 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     single_shard = (mesh is None or mesh.devices.size == 1) \
         and all(_unsharded(x) for x in jax.tree.leaves(params["blocks"]))
     if _unknown_mesh["suppressed"] and not single_shard:
-        import sys
-        print("gpt_decode: param sharding uses a mesh type this gate "
-              "cannot inspect — conservatively treating it as sharded, "
-              "so the fused whole-step decode kernel is disabled "
-              "(falling back to the XLA scan); re-place the params with "
-              "a jax.sharding.Mesh to re-enable fusion", file=sys.stderr)
+        from ..utils import profiler
+        profiler.warn(
+            "gpt_decode: param sharding uses a mesh type this gate "
+            "cannot inspect — conservatively treating it as sharded, "
+            "so the fused whole-step decode kernel is disabled "
+            "(falling back to the XLA scan); re-place the params with "
+            "a jax.sharding.Mesh to re-enable fusion")
     itemsize = 2 if cfg.dtype == "bfloat16" else 4
     fused = bool(single_shard and fused_decode_supported(
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
@@ -1055,9 +1056,10 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
             bool(int8_weights)) in _FUSED_DECODE_BLOCKLIST:
         fused = False
     if int8_weights and not fused:
-        import sys
-        print("gpt_decode: int8_weights needs the fused single-shard "
-              "path; falling back to the bf16/f32 decode", file=sys.stderr)
+        from ..utils import profiler
+        profiler.warn(
+            "gpt_decode: int8_weights needs the fused single-shard "
+            "path; falling back to the bf16/f32 decode")
     # the head fold has its OWN vmem gate (the resident (feat, vocab)
     # head matrix): an over-budget head only drops the fold, never the
     # fused kernel (review r5)
@@ -1087,14 +1089,15 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         scoped = "vmem" in msg or ("scoped" in msg and "memory" in msg)
         if not fused or not scoped:
             raise
-        import sys
+        from ..utils import profiler
         if fold_head:
             # an over-budget HEAD must only drop the fold, never the
             # fused kernel (the fold's vmem gate is approximate too):
             # retry fused-without-fold before considering the blocklist
-            print("gpt_decode: head-folded kernel exceeded the scoped-"
-                  "VMEM budget; retrying the fused kernel without the "
-                  "fold", file=sys.stderr)
+            profiler.warn(
+                "gpt_decode: head-folded kernel exceeded the scoped-"
+                "VMEM budget; retrying the fused kernel without the "
+                "fold")
             fn = _decode_fn(cfg_key, n_prompt, max_new,
                             float(temperature), fused,
                             int8=bool(int8_weights and fused),
@@ -1107,10 +1110,10 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                 if "vmem" not in msg2 and not ("scoped" in msg2
                                                and "memory" in msg2):
                     raise
-        print("gpt_decode: fused kernel exceeded the scoped-VMEM budget "
-              "for this shape; falling back to the XLA scan (raise "
-              "--xla_tpu_scoped_vmem_limit_kib to re-enable)",
-              file=sys.stderr)
+        profiler.warn(
+            "gpt_decode: fused kernel exceeded the scoped-VMEM budget "
+            "for this shape; falling back to the XLA scan (raise "
+            "--xla_tpu_scoped_vmem_limit_kib to re-enable)")
         _FUSED_DECODE_BLOCKLIST.add((cfg_key, n_prompt, max_new,
                                      bool(int8_weights)))
         # kwargs spelled the same way as the primary call so lru_cache

@@ -327,18 +327,28 @@ class Net:
         # node_ids is static: each distinct request set compiles a forward
         # that materializes only those nodes (XLA fuses the rest away)
         self._jit_forward = jax.jit(self._forward_eval, static_argnums=(4,))
+        # process-level train-step counter in the obs registry (shared
+        # across Nets, like any Prometheus process counter)
+        from ..obs.metrics import default_registry
+        self._obs_steps = default_registry().counter(
+            "cxn_train_steps_total", "jitted train steps dispatched")
         if self.lint_recompile_limit > 0:
             # cxn-lint recompilation guard: each hot step errors when its
             # abstract input signature changes more than N times — the
             # silent re-specialization the audit exists to catch. The
             # guard is attribute-transparent, so .lower()/AOT inspection
             # still reach the underlying jit.
-            from ..analysis.recompile import RecompileGuard
+            from ..analysis.recompile import RecompileGuard, trip_counter
             from ..utils import profiler
             n = self.lint_recompile_limit
+            # trips land in the process-global obs registry so a
+            # training job's telemetry shows signature churn alongside
+            # its round counters (doc/observability.md)
+            trips = trip_counter(default_registry())
             guard = partial(RecompileGuard,
                             strict=bool(self.lint_recompile_strict),
-                            log=profiler.log)
+                            log=profiler.warn,
+                            on_trip=lambda name: trips.labels(name).inc())
             self._jit_update = guard(self._jit_update, "net_update", n)
             self._jit_accum = guard(self._jit_accum, "net_accum", n)
             self._jit_apply = guard(self._jit_apply, "net_apply", n)
@@ -785,6 +795,7 @@ class Net:
                 self.params, self.opt_state, self.gsum = self._jit_apply(
                     self.params, self.opt_state, self.gsum, epoch)
         self.epoch_counter += 1
+        self._obs_steps.inc()
         if self._metric_mode == "host":
             self._accumulate_train_metrics(db.host_label, mouts)
         self._last_loss = loss

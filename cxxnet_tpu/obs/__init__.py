@@ -1,0 +1,22 @@
+"""Observability subsystem (doc/observability.md): request-scoped span
+tracing with Chrome-trace export (obs/trace.py), the unified
+Counter/Gauge/Histogram metrics registry with Prometheus text
+exposition (obs/metrics.py), and the export plumbing — periodic JSONL
+snapshots plus end-of-task dumps (obs/export.py).
+
+Surfaces: CLI ``obs_trace`` / ``obs_trace_buffer`` / ``obs_slow_ms`` /
+``obs_export`` / ``obs_export_interval_s`` keys (doc/config.md),
+``wrapper.Net.trace_export()`` / ``wrapper.Net.metrics_text()``, and
+``tools/cxn_trace.py export|summary`` for offline trace files.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, Registry, TIME_BUCKETS,
+                      default_registry)
+from .trace import (REQ_TID_BASE, TID_ENGINE, TID_TRAIN, Span, Tracer,
+                    configure, get_tracer, request_tid)
+from .export import MetricsFlusher, export_run
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
+           "default_registry", "Span", "Tracer", "configure",
+           "get_tracer", "request_tid", "TID_ENGINE", "TID_TRAIN",
+           "REQ_TID_BASE", "MetricsFlusher", "export_run"]

@@ -1,0 +1,133 @@
+"""Telemetry export: Prometheus text endpoint material + periodic JSONL
+snapshots + end-of-task trace dumps.
+
+Two consumption models, both fed by the same registry/tracer:
+
+* **Pull** — a scraper asks for the current state:
+  :meth:`Registry.to_prometheus` (obs/metrics.py) is the payload;
+  ``wrapper.Net.metrics_text()`` / ``InferenceServer.metrics_text()``
+  hand it to whatever HTTP front end the deployment runs.
+* **Push** — :class:`MetricsFlusher`: a background thread appending one
+  JSON line (wall timestamp + full registry snapshot) to a file every
+  ``interval_s`` seconds. Lines interleave coherently with
+  ``profiler.log``'s timestamped human lines because both carry wall
+  timestamps. The thread is named ``cxn-obs-flusher-*`` so the
+  suite-wide leak fixture (tests/conftest.py) can see one that outlives
+  its owner; ``close()`` flushes one final snapshot and joins.
+
+``export_run`` is the end-of-task convenience the CLI uses: given the
+``obs_export`` path prefix it writes ``<prefix>.trace.json`` (Chrome
+trace of the whole ring), ``<prefix>.spans.jsonl`` (the raw span dump
+``tools/cxn_trace.py`` consumes) and ``<prefix>.prom`` (final
+Prometheus exposition), returning the paths written.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .metrics import Registry
+from .trace import Tracer
+
+__all__ = ["MetricsFlusher", "export_run"]
+
+_flusher_seq = itertools.count()
+
+
+class MetricsFlusher:
+    """Periodic registry-snapshot-to-JSONL writer (see module doc)."""
+
+    def __init__(self, registry: Registry, path: str,
+                 interval_s: float = 10.0, extra=None):
+        """``extra``: optional zero-arg callable merged into every
+        snapshot line (the CLI passes the task name); an exception in
+        it (or an unserializable value) is the caller's bug — it stops
+        the flusher with a loud ``profiler.warn`` naming the error, but
+        is never re-raised from ``close()`` (which runs in finally
+        blocks and must not mask the task's own exception)."""
+        if interval_s <= 0:
+            raise ValueError("obs_export_interval_s must be > 0, got %g"
+                             % interval_s)
+        self.registry = registry
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._extra = extra
+        self._stop = threading.Event()
+        self.flushes = 0
+        # fail fast: an unwritable path must error HERE on the caller's
+        # thread, not one interval later on the background one
+        with open(self.path, "a"):
+            pass
+        self._thread = threading.Thread(
+            target=self._loop,
+            name="cxn-obs-flusher-%d" % next(_flusher_seq), daemon=True)
+        self._thread.start()
+
+    def _write_snapshot(self) -> None:
+        line: Dict = {"ts": time.time(),
+                      "metrics": self.registry.snapshot()}
+        if self._extra is not None:
+            line.update(self._extra() or {})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        self.flushes += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._write_snapshot()
+            except Exception as e:          # noqa: BLE001
+                # disk/dir gone mid-run, a raising extra() callable, an
+                # unserializable snapshot value: stop LOUDLY instead of
+                # dying with a bare thread traceback and silently
+                # ending snapshots
+                from ..utils import profiler
+                profiler.warn("obs: metrics flusher stopping, cannot "
+                              "write %s (%s: %s)"
+                              % (self.path, type(e).__name__, e))
+                return
+
+    def close(self, final_flush: bool = True) -> None:
+        """Stop the thread (idempotent); ``final_flush`` appends one
+        last snapshot so the file always ends with the terminal state
+        even when the run was shorter than one interval. An error on
+        that last snapshot is logged, not raised — close() runs in
+        finally blocks and must not mask the original exception."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._thread.join(timeout=10)
+        if final_flush:
+            try:
+                self._write_snapshot()
+            except Exception as e:          # noqa: BLE001
+                from ..utils import profiler
+                profiler.warn("obs: final metrics flush to %s failed "
+                              "(%s: %s)" % (self.path,
+                                            type(e).__name__, e))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def export_run(prefix: str, registry: Optional[Registry] = None,
+               tracer: Optional[Tracer] = None) -> List[str]:
+    """End-of-task dump under ``prefix`` (see module doc); skips the
+    pieces whose source is None. Returns the written paths."""
+    out: List[str] = []
+    if tracer is not None:
+        out.append(tracer.write_chrome(prefix + ".trace.json"))
+        tracer.dump_jsonl(prefix + ".spans.jsonl")
+        out.append(prefix + ".spans.jsonl")
+    if registry is not None:
+        with open(prefix + ".prom", "w") as f:
+            f.write(registry.to_prometheus())
+        out.append(prefix + ".prom")
+    return out

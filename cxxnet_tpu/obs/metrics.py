@@ -1,0 +1,457 @@
+"""Unified metrics registry: Counter / Gauge / Histogram behind one
+thread-safe surface with Prometheus text exposition.
+
+Before this subsystem every serving counter lived in a private dict —
+``InferenceServer._counts``, the prefix cache's ``hits``/``misses``,
+``SlotScheduler.spec_*``, the RecompileGuard's signature map — visible
+only through one-shot ``metrics()`` snapshots a scraper cannot consume.
+The registry absorbs them behind three metric kinds:
+
+* **Counter** — monotonically increasing float (``_total`` names).
+* **Gauge** — set-to-current value, or a *callback* gauge evaluated at
+  collection time (occupancy, queue depth, cache bytes — values that are
+  a property of live objects, not an accumulation).
+* **Histogram** — observation counts in FIXED log-spaced buckets plus
+  sum/count. The boundaries are process-independent constants, so two
+  engine replicas' histograms merge by adding bucket counts and the
+  merged percentiles stay exact to bucket resolution — the property the
+  ROADMAP item-2 router needs to aggregate TTFT across replicas (a
+  sample-reservoir p95 cannot be merged; a fixed-bucket one can).
+
+Label support is the minimal Prometheus subset: a metric family created
+with ``labelnames`` yields children via ``labels(value, ...)``; children
+are created on first touch and live for the registry's lifetime.
+
+Thread-safety: one lock per registry guards family creation; each child
+takes its own lock only for the few arithmetic ops of an update. Metric
+updates never allocate on the hot path (bucket index is a bisect into a
+static tuple).
+
+Exposition: :meth:`Registry.to_prometheus` renders the standard text
+format (``# HELP`` / ``# TYPE``, ``_bucket{le=...}`` / ``_sum`` /
+``_count`` for histograms); :meth:`Registry.snapshot` returns the same
+data as one plain dict for the JSONL flusher (obs/export.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
+           "default_registry"]
+
+
+def _log_spaced(lo: float, hi: float, per_decade: int) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds from ``lo`` to >= ``hi`` with
+    ``per_decade`` buckets per decade. Pure function of its arguments —
+    every process computes the identical tuple, which is what makes
+    histograms mergeable across replicas."""
+    n = int(math.ceil(per_decade * math.log10(hi / lo))) + 1
+    # deterministic 6-sig-fig rounding: the ``le`` labels stay readable
+    # and every process still computes bit-identical bounds
+    return tuple(float("%.6g" % (lo * 10.0 ** (i / per_decade)))
+                 for i in range(n))
+
+
+# the shared latency geometry: 10 us .. ~158 s, 4 buckets per decade
+# (each bound ~1.78x the previous — percentile resolution well under the
+# run-to-run noise of any latency this registry observes). One constant
+# for every duration histogram in the process, so ANY two histograms
+# with these buckets merge.
+TIME_BUCKETS = _log_spaced(1e-5, 100.0, 4)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integers without the trailing .0;
+    non-finite values render as the exposition format's NaN/+Inf/-Inf
+    tokens (a dead callback provider yields NaN — it must render, not
+    crash the scrape)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names: Tuple[str, ...], values: Tuple[str, ...],
+                 extra: str = "") -> str:
+    parts = ['%s="%s"' % (n, v) for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+class Counter:
+    """Monotonic counter. ``inc`` with a negative amount is an error —
+    a counter that can go down is a gauge wearing the wrong name. A
+    *callback* counter (``fn``) reads a live monotonic int at
+    collection time instead of being incremented — how the registry
+    absorbs counters that already exist as plain attributes on hot
+    objects (``SlotScheduler.ticks``, the prefix cache's ``hits``)
+    with ZERO added cost on their increment paths."""
+
+    kind = "counter"
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._fn is not None:
+            raise RuntimeError("callback counter cannot be inc()ed")
+        if amount < 0:
+            raise ValueError("Counter.inc amount must be >= 0, got %r"
+                             % (amount,))
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:       # a dead provider must not kill scrape
+                return float("nan")
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Set-to-current value, or a callback evaluated at collection time
+    (``fn``) for values that are properties of live objects."""
+
+    kind = "gauge"
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, value: float) -> None:
+        if self._fn is not None:
+            raise RuntimeError("callback gauge cannot be set")
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._fn is not None:
+            raise RuntimeError("callback gauge cannot be inc()ed")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:       # a dead provider must not kill scrape
+                return float("nan")
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: cumulative-style exposition, mergeable
+    percentile estimates (see module docstring). ``buckets`` are the
+    upper bounds of the non-overflow buckets; observations above the
+    last bound land in +Inf."""
+
+    kind = "histogram"
+
+    def __init__(self, buckets: Tuple[float, ...] = TIME_BUCKETS):
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != \
+                len(buckets):
+            raise ValueError("histogram buckets must be strictly "
+                             "increasing")
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)    # [+Inf] last
+        self._sum = 0.0
+        self._count = 0
+
+    def reset(self) -> None:
+        """Zero the observations (bench warm-up isolation — an owner
+        resetting its window counters must reset the histogram too, or
+        the exposition goes internally inconsistent: histogram count >
+        the zeroed request counters)."""
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def observe(self, value: float) -> None:
+        if not math.isfinite(value):
+            return              # the empty-window contract: poison dropped
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def counts(self) -> List[int]:
+        """Per-bucket (non-cumulative) counts, +Inf last."""
+        with self._lock:
+            return list(self._counts)
+
+    def _snapshot(self) -> Tuple[List[int], float, int]:
+        """(counts, sum, count) read under ONE lock acquisition — a
+        concurrent observe() between separate reads would hand merge()
+        a state where sum(counts) != count, permanently corrupting the
+        destination's percentiles."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram (same buckets) into this one — the
+        cross-replica aggregation primitive; safe against concurrent
+        observes on ``other`` (its state is read atomically)."""
+        if other.buckets != self.buckets:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket boundaries")
+        oc, osum, ocount = other._snapshot()
+        with self._lock:
+            for i, c in enumerate(oc):
+                self._counts[i] += c
+            self._sum += osum
+            self._count += ocount
+
+    def percentile(self, q: float) -> float:
+        """Bucket-resolution percentile estimate: the upper bound of the
+        bucket where the cumulative count crosses ``q`` (0 with no
+        observations). Mergeable by construction — merging replicas then
+        asking for p95 equals asking each replica and combining counts."""
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c:
+                if i < len(self.buckets):
+                    return self.buckets[i]
+                return self.buckets[-1]     # +Inf bucket: clamp to last
+        return self.buckets[-1]
+
+
+class _Family:
+    """One registered metric name: unlabeled (a single child) or a
+    labeled family (children created per label-value tuple)."""
+
+    def __init__(self, name: str, help_: str, kind: str,
+                 labelnames: Tuple[str, ...], make: Callable):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.labelnames = labelnames
+        self._make = make
+        self._buckets: Optional[Tuple[float, ...]] = None
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not labelnames:
+            self._children[()] = make()
+
+    def labels(self, *values) -> object:
+        if len(values) != len(self.labelnames):
+            raise ValueError("metric %s wants labels %s, got %r"
+                             % (self.name, self.labelnames, values))
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make()
+            return child
+
+    @property
+    def default(self):
+        if self.labelnames:
+            raise ValueError("metric %s is labeled (%s); use .labels()"
+                             % (self.name, self.labelnames))
+        return self._children[()]
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def rebind(self, fn: Callable[[], float], make: Callable) -> None:
+        """Point a callback family at a new live provider. Registering
+        an existing name WITH a new ``fn`` means the LATEST provider
+        wins — a restarted server re-registering its catalog into a
+        shared registry must not leave the exported names bound to its
+        dead predecessor's objects."""
+        with self._lock:
+            self._make = make
+            for child in self._children.values():
+                child._fn = fn
+
+
+class Registry:
+    """Get-or-create metric registry. Creating the same name twice with
+    the same kind returns the SAME family (so two subsystems can share a
+    counter without coordination); a kind mismatch is an error."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    # ---------------------------------------------------------- creation
+    def _register(self, name: str, help_: str, kind: str,
+                  labelnames, make, fn=None, buckets=None) -> _Family:
+        labelnames = tuple(labelnames or ())
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != labelnames:
+                    raise ValueError(
+                        "metric %r already registered as %s%s"
+                        % (name, fam.kind, fam.labelnames))
+                if buckets is not None and fam._buckets != tuple(buckets):
+                    # silently keeping the old geometry would break the
+                    # mergeability contract the caller asked for
+                    raise ValueError(
+                        "histogram %r already registered with different "
+                        "buckets" % name)
+                if fn is not None:
+                    fam.rebind(fn, make)
+                return fam
+            fam = _Family(name, help_, kind, labelnames, make)
+            if buckets is not None:
+                fam._buckets = tuple(buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_: str = "", labelnames=(),
+                fn: Optional[Callable[[], float]] = None):
+        fam = self._register(name, help_, "counter", labelnames,
+                             lambda: Counter(fn), fn=fn)
+        return fam if fam.labelnames else fam.default
+
+    def gauge(self, name: str, help_: str = "", labelnames=(),
+              fn: Optional[Callable[[], float]] = None):
+        fam = self._register(name, help_, "gauge", labelnames,
+                             lambda: Gauge(fn), fn=fn)
+        return fam if fam.labelnames else fam.default
+
+    def histogram(self, name: str, help_: str = "", labelnames=(),
+                  buckets: Tuple[float, ...] = TIME_BUCKETS):
+        fam = self._register(name, help_, "histogram", labelnames,
+                             lambda: Histogram(buckets), buckets=buckets)
+        return fam if fam.labelnames else fam.default
+
+    def freeze(self, names) -> None:
+        """Convert callback metrics to their CURRENT values: each child
+        reads its provider one last time and becomes a plain stored
+        value. An owner shutting down calls this so (a) the registry
+        stops pinning it — callback closures hold the whole server,
+        params and KV pool included — and (b) later scrapes report the
+        honest terminal state (final totals, drained gauges) instead of
+        evaluating a dead object. A later re-register with a new ``fn``
+        rebinds the family live (the shared-registry restart path)."""
+        with self._lock:
+            fams = [self._families[n] for n in names
+                    if n in self._families]
+        for fam in fams:
+            make = Counter if fam.kind == "counter" else Gauge
+            with fam._lock:
+                fam._make = make
+                for child in fam._children.values():
+                    if child._fn is not None:
+                        v = child.value
+                        child._fn = None
+                        child._value = float(v)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._families)
+
+    # -------------------------------------------------------- exposition
+    def to_prometheus(self) -> str:
+        """Standard Prometheus text exposition of every registered
+        metric (text format version 0.0.4)."""
+        out: List[str] = []
+        with self._lock:
+            fams = [self._families[n] for n in sorted(self._families)]
+        for fam in fams:
+            if fam.help:
+                out.append("# HELP %s %s" % (fam.name, fam.help))
+            out.append("# TYPE %s %s" % (fam.name, fam.kind))
+            for values, child in fam.children():
+                lt = _labels_text(fam.labelnames, values)
+                if fam.kind in ("counter", "gauge"):
+                    out.append("%s%s %s" % (fam.name, lt,
+                                            _fmt(child.value)))
+                    continue
+                counts = child.counts()
+                cum = 0
+                for bound, c in zip(child.buckets, counts):
+                    cum += c
+                    out.append('%s_bucket%s %d' % (
+                        fam.name,
+                        _labels_text(fam.labelnames, values,
+                                     'le="%s"' % _fmt(bound)),
+                        cum))
+                cum += counts[-1]
+                out.append('%s_bucket%s %d' % (
+                    fam.name,
+                    _labels_text(fam.labelnames, values, 'le="+Inf"'),
+                    cum))
+                out.append("%s_sum%s %s" % (fam.name, lt,
+                                            _fmt(child.sum)))
+                out.append("%s_count%s %d" % (fam.name, lt, child.count))
+        return "\n".join(out) + ("\n" if out else "")
+
+    def snapshot(self) -> Dict:
+        """The same collection as one plain dict (for the JSONL
+        flusher): counters/gauges -> value (non-finite -> None — a
+        dead callback provider must not poison the JSONL stream with
+        bare NaN tokens strict parsers reject), histograms -> {count,
+        sum, p50, p95, p99}. Labeled children key as name{a=x,b=y}."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            fams = [self._families[n] for n in sorted(self._families)]
+        for fam in fams:
+            for values, child in fam.children():
+                key = fam.name + _labels_text(fam.labelnames, values)
+                if fam.kind in ("counter", "gauge"):
+                    v = child.value
+                    out[key] = v if math.isfinite(v) else None
+                else:
+                    out[key] = {"count": child.count, "sum": child.sum,
+                                "p50": child.percentile(0.50),
+                                "p95": child.percentile(0.95),
+                                "p99": child.percentile(0.99)}
+        return out
+
+
+_default = Registry()
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    """The process-global registry — where the training side, the
+    recompile guards of ``nnet.Net``, and anything without its own
+    registry record. Servers default to their own registry so two
+    servers' gauges cannot fight (see serve/server.py)."""
+    return _default

@@ -1,0 +1,303 @@
+"""Request-scoped span tracer: a bounded ring of host-side spans,
+exportable as Chrome-trace JSON (Perfetto / chrome://tracing).
+
+The XPlane trace (utils/profiler.py:trace) answers "what did the DEVICE
+do" for one bounded capture window; it is far too heavy to leave on
+under live traffic, and it knows nothing about requests. This tracer is
+the complement: always-on, host-side, request-scoped. Every span is a
+``(name, cat, ts, dur, tid, args)`` record appended to a lock-guarded
+ring buffer (``collections.deque(maxlen=capacity)`` — old spans fall off
+the back, memory is bounded no matter how long the server lives).
+
+Track model (the ``tid`` axis in the exported trace):
+
+* ``TID_TRAIN`` — the training round loop: one ``train_round`` span per
+  round with aggregate ``feed_wait`` / ``step_dispatch`` /
+  ``metric_sync`` child spans (cli.py records them from StepStats
+  totals, so they are per-round AGGREGATES laid end to end, not exact
+  intervals).
+* ``TID_ENGINE`` — work shared across requests: one ``decode_tick``
+  span per batched tick (args: how many rows decoded — NOT one span per
+  row, the no-per-token-allocation rule), one ``spec_draft`` span per
+  drafter pass.
+* ``REQ_TID_BASE + rid`` — one track per request carrying its span
+  tree: ``request`` (submit -> terminal) over ``queue_wait`` ->
+  ``prefix_restore`` -> ``prefill_chunk``* -> ``decode`` (covers the
+  ticks; args: tokens) with ``spec_verify`` spans inside it ->
+  ``retire``. Perfetto nests them by time containment.
+
+Cost discipline: recording is a ``perf_counter`` pair, one tuple, one
+lock-guarded deque append — no formatting, no wall-clock syscall, no
+allocation proportional to tokens. ``sample = N`` records only every
+Nth request's track (engine/train tracks are unaffected); ``enabled =
+False`` turns every record call into one attribute check.
+
+Slow-request exemplars: ``note_slow(rid, ...)`` captures the request's
+span tree as its own Chrome-trace dict into a small bounded exemplar
+deque, optionally auto-writing ``slow-req-<rid>.trace.json`` into a
+configured directory — the server calls it for any request whose TTFT
+or total latency exceeds ``obs_slow_ms`` (serve/server.py), so the
+evidence for a latency spike is saved at the moment it happens instead
+of asking the operator to reproduce it.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Span", "Tracer", "get_tracer", "configure", "request_tid",
+           "spans_to_chrome", "TID_ENGINE", "TID_TRAIN", "REQ_TID_BASE"]
+
+TID_ENGINE = 1
+TID_TRAIN = 2
+REQ_TID_BASE = 100
+
+
+class Span(collections.namedtuple("Span",
+                                  ["name", "cat", "ts", "dur", "tid",
+                                   "args"])):
+    """One recorded span: ``ts``/``dur`` in seconds on the tracer's
+    monotonic clock (perf_counter; ``ts`` is absolute perf_counter time,
+    export rebases onto the tracer epoch). ``dur`` 0.0 renders as an
+    instant. ``args`` is a small dict or None."""
+    __slots__ = ()
+
+
+def request_tid(rid: int) -> int:
+    return REQ_TID_BASE + int(rid)
+
+
+def _thread_meta(tids) -> List[Dict]:
+    names = {TID_ENGINE: "engine", TID_TRAIN: "train"}
+    out = []
+    for tid in sorted(tids):
+        name = names.get(tid, "request %d" % (tid - REQ_TID_BASE)
+                         if tid >= REQ_TID_BASE else "track %d" % tid)
+        out.append({"ph": "M", "name": "thread_name", "pid": 0,
+                    "tid": tid, "args": {"name": name}})
+    return out
+
+
+def spans_to_chrome(spans: List[Dict],
+                    other_data: Optional[Dict] = None) -> Dict:
+    """Span dicts (``{name, cat, ts, dur, tid, args}``, ts/dur in
+    SECONDS — the ``dump_jsonl`` line schema) as a Chrome-trace JSON
+    object: complete ("X") events in microseconds plus thread-name
+    metadata. The ONE place the event schema is built — both
+    ``Tracer.chrome_trace`` and ``tools/cxn_trace.py`` render through
+    here, so the two writers cannot drift. Zero spans still yields a
+    valid, loadable trace."""
+    events = _thread_meta({s["tid"] for s in spans})
+    for s in spans:
+        ev = {"name": s["name"], "cat": s.get("cat") or "obs", "ph": "X",
+              "ts": round(s["ts"] * 1e6, 3),
+              "dur": round(s["dur"] * 1e6, 3), "pid": 0, "tid": s["tid"]}
+        if s.get("args"):
+            ev["args"] = s["args"]
+        events.append(ev)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"format": "cxxnet_tpu.obs.trace/1"}}
+    if other_data:
+        doc["otherData"].update(other_data)
+    return doc
+
+
+class Tracer:
+    """Bounded ring of spans; see module docstring."""
+
+    def __init__(self, capacity: int = 65536, enabled: bool = True,
+                 sample: int = 1, slow_dir: str = ""):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self.enabled = bool(enabled)
+        self.sample = max(1, int(sample))
+        self.slow_dir = slow_dir
+        # export epoch: monotonic origin + the wall time it corresponds
+        # to, so exported ts values start near 0 and the trace metadata
+        # can still date the capture
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+        self.exemplars: collections.deque = collections.deque(maxlen=8)
+        self.dropped = 0        # spans pushed out of the ring (approx.)
+        # slow-dump throttle: under saturation EVERY request can cross
+        # obs_slow_ms, and note_slow runs on the scheduler thread — an
+        # unthrottled makedirs+json.dump per retire would amplify the
+        # very overload it is diagnosing (and write files without
+        # bound). The in-memory exemplar deque still records every slow
+        # request (bounded by maxlen); only the FILE dump is limited.
+        self.slow_write_interval_s = 1.0
+        self._last_slow_write = float("-inf")
+
+    # ---------------------------------------------------------- recording
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None,
+                  sample: Optional[int] = None,
+                  slow_dir: Optional[str] = None) -> "Tracer":
+        """Adjust knobs in place; resizing the ring keeps the newest
+        spans. Returns self (so ``get_tracer().configure(...)``
+        chains)."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if sample is not None:
+                self.sample = max(1, int(sample))
+            if slow_dir is not None:
+                self.slow_dir = slow_dir
+            if capacity is not None and \
+                    int(capacity) != self._ring.maxlen:
+                self._ring = collections.deque(
+                    self._ring, maxlen=max(1, int(capacity)))
+        return self
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def should_sample(self, rid: int) -> bool:
+        """Whether request ``rid``'s track is recorded (the scheduler
+        checks ONCE at submit/admit and carries the answer on the
+        request, not per tick)."""
+        return self.enabled and (int(rid) % self.sample == 0)
+
+    def add(self, name: str, ts: float, dur: float, tid: int,
+            cat: str = "", args: Optional[Dict] = None) -> None:
+        """Record one externally timed span (``ts`` = perf_counter
+        start, ``dur`` seconds)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(Span(name, cat, ts, dur, tid, args))
+
+    def instant(self, name: str, tid: int, cat: str = "",
+                args: Optional[Dict] = None) -> None:
+        self.add(name, time.perf_counter(), 0.0, tid, cat, args)
+
+    @contextlib.contextmanager
+    def span(self, name: str, tid: int, cat: str = "",
+             args: Optional[Dict] = None):
+        """Measure the enclosed region (no-op-cheap when disabled)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, t0, time.perf_counter() - t0, tid, cat, args)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    # ------------------------------------------------------------ reading
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def spans(self, tid: Optional[int] = None) -> List[Span]:
+        """Snapshot of the ring (oldest first), optionally one track."""
+        with self._lock:
+            snap = list(self._ring)
+        if tid is None:
+            return snap
+        return [s for s in snap if s.tid == tid]
+
+    def spans_for_request(self, rid: int) -> List[Span]:
+        return self.spans(request_tid(rid))
+
+    # ------------------------------------------------------------- export
+    def chrome_trace(self, spans: Optional[List[Span]] = None) -> Dict:
+        """The ring (or ``spans``) as a Chrome-trace JSON object
+        (``spans_to_chrome`` with ts rebased onto the tracer epoch, plus
+        the capture's wall-clock epoch in ``otherData``)."""
+        if spans is None:
+            spans = self.spans()
+        return spans_to_chrome(
+            [{"name": s.name, "cat": s.cat, "ts": s.ts - self._epoch,
+              "dur": s.dur, "tid": s.tid, "args": s.args}
+             for s in spans],
+            {"epoch_unix_s": self._epoch_wall,
+             "dropped_spans": self.dropped})
+
+    def write_chrome(self, path: str,
+                     spans: Optional[List[Span]] = None) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(spans), f)
+        return path
+
+    def dump_jsonl(self, path: str) -> int:
+        """Raw span dump, one JSON object per line (the input format of
+        ``tools/cxn_trace.py export``/``summary``); returns the span
+        count written. Line schema: {name, cat, ts, dur, tid, args} with
+        ts rebased to the tracer epoch (seconds)."""
+        spans = self.spans()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps({
+                    "name": s.name, "cat": s.cat or "obs",
+                    "ts": s.ts - self._epoch, "dur": s.dur,
+                    "tid": s.tid, "args": s.args or {}}) + "\n")
+        return len(spans)
+
+    # ---------------------------------------------------- slow exemplars
+    def note_slow(self, rid: int, reason: str = "",
+                  args: Optional[Dict] = None) -> Optional[Dict]:
+        """Capture request ``rid``'s span tree (whatever of it is still
+        in the ring) as its own Chrome-trace dict: kept in
+        ``self.exemplars`` and auto-written to
+        ``<slow_dir>/slow-req-<rid>.trace.json`` when a dump directory
+        is configured. Returns the dict (None when tracing is off or
+        the request left no spans — e.g. sampled out)."""
+        spans = self.spans_for_request(rid)
+        if not spans:
+            return None
+        doc = self.chrome_trace(spans)
+        doc["otherData"]["slow_reason"] = reason
+        if args:
+            doc["otherData"].update(args)
+        self.exemplars.append((int(rid), reason, doc))
+        path = ""
+        now = time.perf_counter()
+        if self.slow_dir and \
+                now - self._last_slow_write >= self.slow_write_interval_s:
+            self._last_slow_write = now
+            try:
+                os.makedirs(self.slow_dir, exist_ok=True)
+                path = os.path.join(self.slow_dir,
+                                    "slow-req-%d.trace.json" % rid)
+                with open(path, "w") as f:
+                    json.dump(doc, f)
+            except OSError:
+                path = ""           # dump dir gone: keep the exemplar
+        from ..utils import profiler
+        profiler.log("obs: slow request %d (%s)%s"
+                     % (rid, reason,
+                        " -> %s" % path if path else ""), level="warn")
+        return doc
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer — what the CLI, the wrapper's
+    ``Net.trace_export()``, and (by default) every InferenceServer
+    record into. Tests wanting isolation construct their own Tracer and
+    pass it explicitly."""
+    return _tracer
+
+
+def configure(**kw) -> Tracer:
+    """``get_tracer().configure(...)`` shorthand (cli.py's obs_* keys
+    land here)."""
+    return _tracer.configure(**kw)

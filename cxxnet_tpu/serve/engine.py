@@ -477,7 +477,7 @@ class DecodeEngine:
     def __init__(self, cfg: GPTConfig, params: Dict, slots: int,
                  prefill_chunk: int = 64, recompile_limit: int = 0,
                  recompile_strict: bool = True, abstract: bool = False,
-                 spec_len: int = 0):
+                 spec_len: int = 0, obs_registry=None):
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -542,16 +542,26 @@ class DecodeEngine:
         if recompile_limit > 0:
             from ..analysis.recompile import RecompileGuard
             from ..utils import profiler
+            on_trip = None
+            if obs_registry is not None:
+                # every trip — strict or log-only — lands in the unified
+                # registry, so a scraper sees compiled-signature churn
+                # without parsing the human log
+                from ..analysis.recompile import trip_counter
+                trips = trip_counter(obs_registry)
+                on_trip = lambda name: trips.labels(name).inc()
             self._guard = RecompileGuard(
                 lambda sig: None, "serve_prefill", recompile_limit,
-                strict=bool(recompile_strict), log=profiler.log)
+                strict=bool(recompile_strict), log=profiler.warn,
+                on_trip=on_trip)
             # the verify program gets its OWN signature count: its one
             # legitimate signature must not share headroom with the
             # prefill/chunk programs', and a trip should name spec_len —
             # the only dimension that can drift there
             self._vguard = RecompileGuard(
                 lambda sig: None, "serve_verify_chunk", recompile_limit,
-                strict=bool(recompile_strict), log=profiler.log)
+                strict=bool(recompile_strict), log=profiler.warn,
+                on_trip=on_trip)
 
     def _count_program(self, sig: str) -> None:
         """Register one prefill/chunk program fetch with the guard; the

@@ -33,6 +33,16 @@ entirely (match/insert become no-ops).
 Thread-safety: all methods run on the server's single scheduler thread
 (the same discipline as serve/scheduler.py); the unit tests drive it
 directly from one thread.
+
+Observability (doc/observability.md): the traffic counters below
+(``hits`` / ``misses`` / ``hit_tokens`` / ``prompt_tokens`` /
+``evictions`` / ``inserted_chunks``) plus ``nbytes`` / ``chunks`` are
+read at collection time by the server's obs registry as the
+``cxn_prefix_*`` metric family — plain attribute increments here, zero
+added cost on the admit path. The first LRU eviction logs once
+(``profiler.warn``): steady-state churn is normal, but the moment the
+budget first binds is the operational signal that ``serve_prefix_mb``
+is sized below the working set.
 """
 
 from __future__ import annotations
@@ -107,6 +117,7 @@ class PrefixCache:
         self.prompt_tokens = 0      # prompt tokens across all lookups
         self.evictions = 0
         self.inserted_chunks = 0
+        self._budget_warned = False
 
     def _tick(self) -> int:
         self._clock += 1
@@ -230,6 +241,14 @@ class PrefixCache:
         freed mid-sweep join the NEXT round's snapshot), so an eviction
         burst costs O(rounds * n log n) instead of a per-victim scan."""
         n = 0
+        if self._bytes > self.budget and not self._budget_warned:
+            self._budget_warned = True
+            from ..utils import profiler
+            profiler.warn(
+                "prefix cache reached its %.1f MiB budget (%d chunks "
+                "resident); LRU eviction begins — raise serve_prefix_mb "
+                "if the hit rate drops" % (self.budget / 2.0 ** 20,
+                                           self.chunks))
         while self._bytes > self.budget:
             sweep = sorted((nd for nd in self._nodes if nd.refs == 0),
                            key=lambda nd: nd.last_used)

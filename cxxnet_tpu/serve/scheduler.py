@@ -39,6 +39,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs.trace import TID_ENGINE, request_tid
 from ..utils import profiler
 
 __all__ = ["SamplingParams", "Request", "SlotScheduler"]
@@ -97,11 +98,13 @@ class Request:
 
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
-                 "error", "done", "slot")
+                 "error", "done", "slot", "traced")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  params: SamplingParams, submit_t: float):
         self.rid = rid
+        self.traced = False     # span recording on for this request
+        #                         (set once at admit: tracer sampling)
         self.prompt = prompt
         self.params = params
         self.submit_t = submit_t
@@ -128,9 +131,15 @@ class SlotScheduler:
 
     def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
                  on_finish=None, prefix_cache=None, drafters=None,
-                 spec_mode: str = "off", spec_len: int = 0):
+                 spec_mode: str = "off", spec_len: int = 0, tracer=None):
         self.engine = engine
         self.stats = stats or profiler.StepStats()
+        # request-scoped span recording (obs/trace.py): None = off.
+        # Per-request spans go on the request's own track; work shared
+        # across rows (the batched tick, a drafter pass) goes on
+        # TID_ENGINE — one span per tick, NOT one per row, so the tick
+        # loop stays free of per-token allocation.
+        self.tracer = tracer
         self.on_finish = on_finish      # called with each request that
         #                                 reaches a terminal state here
         self.chunk = int(engine.chunk)  # 0 = legacy whole-prompt
@@ -244,12 +253,23 @@ class SlotScheduler:
         self._spec_try[slot] = self._spec_hit[slot] = 0
         self._spec_off[slot] = False
         self.stats.record(profiler.QUEUE_WAIT, req.admit_t - req.submit_t)
+        tr = self.tracer
+        if tr is not None and tr.should_sample(req.rid):
+            req.traced = True
+            tr.add(profiler.QUEUE_WAIT, req.submit_t,
+                   req.admit_t - req.submit_t, request_tid(req.rid),
+                   cat="serve")
         self.admit_order.append(req.rid)
         key = np.asarray(jax.random.PRNGKey(p.seed), np.uint32)
         if self.chunk <= 0:
+            t0 = time.perf_counter()
             with self.stats.phase(profiler.PREFILL):
                 tok = self.engine.prefill(slot, req.prompt, key,
                                           p.temperature, p.top_k, p.top_p)
+            if req.traced:
+                tr.add(profiler.PREFILL, t0, time.perf_counter() - t0,
+                       request_tid(req.rid), cat="serve",
+                       args={"n_prompt": len(req.prompt)})
             # commit this admit's QUEUE_WAIT/PREFILL as their own stats
             # step: folding them into the next tick's end_step would sum
             # every admit since the last tick into one sample (skewing
@@ -261,8 +281,13 @@ class SlotScheduler:
             return
         start = 0
         if self.prefix is not None:
+            t0 = time.perf_counter()
             with self.stats.phase(profiler.PREFIX_COPY):
                 start = self.prefix.copy_into(slot, req.prompt)
+            if req.traced:
+                tr.add("prefix_restore", t0, time.perf_counter() - t0,
+                       request_tid(req.rid), cat="serve",
+                       args={"restored_tokens": start})
         self.stats.end_step()       # commit QUEUE_WAIT (+ PREFIX_COPY)
         req.status = "prefill"
         self._pending[slot] = {"req": req, "key": key, "next": start}
@@ -284,6 +309,7 @@ class SlotScheduler:
         end = min(start + self.chunk, n)
         toks = np.zeros(self.chunk, np.int32)
         toks[:end - start] = req.prompt[start:end]
+        t0 = time.perf_counter()
         with self.stats.phase(profiler.PREFILL_CHUNK):
             tok = self.engine.prefill_chunk(slot, toks, start, end - start,
                                             st["key"], p.temperature,
@@ -293,6 +319,11 @@ class SlotScheduler:
                 # sample is fetched — mid-prompt chunks stay async so
                 # they pipeline on device
                 tok = int(tok)
+        if req.traced:
+            self.tracer.add(profiler.PREFILL_CHUNK, t0,
+                            time.perf_counter() - t0,
+                            request_tid(req.rid), cat="serve",
+                            args={"start": start, "n": end - start})
         self.stats.end_step()       # one chunk = one stats step
         self.prefill_chunks += 1
         st["next"] = end
@@ -336,6 +367,7 @@ class SlotScheduler:
 
     def _retire(self, req: Request, status: str, error: str = "") -> None:
         slot = req.slot
+        t_retire = time.perf_counter()
         if self._pending[slot] is not None:     # cancelled mid-prefill
             # _pending and _prefill_q are always mutated together on the
             # scheduler thread, so membership is an invariant — a
@@ -357,6 +389,24 @@ class SlotScheduler:
         self._fold[slot] = 0
         self._free.append(slot)
         req.finish(status, error)
+        if req.traced:
+            tid = request_tid(req.rid)
+            tr = self.tracer
+            if req.first_token_t is not None:
+                # ONE span covering every tick the request decoded
+                # through (args carry the token count) — the per-request
+                # record stays O(1) in tokens, the per-tick detail lives
+                # on the shared TID_ENGINE track
+                tr.add("decode", req.first_token_t,
+                       t_retire - req.first_token_t, tid, cat="serve",
+                       args={"tokens": len(req.tokens)})
+            tr.add("retire", t_retire, req.done_t - t_retire, tid,
+                   cat="serve", args={"status": status})
+            tr.add("request", req.submit_t, req.done_t - req.submit_t,
+                   tid, cat="serve",
+                   args={"rid": req.rid, "status": status,
+                         "prompt_tokens": len(req.prompt),
+                         "tokens": len(req.tokens)})
         if self.on_finish is not None:
             self.on_finish(req)
 
@@ -413,6 +463,7 @@ class SlotScheduler:
         if not want:
             return 0
         drafts: dict = {}
+        t_draft = time.perf_counter()
         with self.stats.phase(profiler.SPEC_DRAFT):
             for name, drafter in self.drafters.items():
                 slots = {s for s, (m, _) in want.items() if m == name}
@@ -424,6 +475,12 @@ class SlotScheduler:
                     for s in slots}
                 drafts.update(drafter.draft(
                     ctxs, {s: want[s][1] for s in slots}))
+        if self.tracer is not None and self.tracer.enabled:
+            # one engine-track span per drafter pass (it is batched
+            # across rows), mirroring the tick's shared-span discipline
+            self.tracer.add(profiler.SPEC_DRAFT, t_draft,
+                            time.perf_counter() - t_draft, TID_ENGINE,
+                            cat="serve", args={"rows": len(want)})
         n = 0
         for slot, d in drafts.items():
             nd = len(d)
@@ -434,11 +491,20 @@ class SlotScheduler:
             buf = np.zeros(K + 1, np.int32)
             buf[0] = self._tok[slot]
             buf[1:1 + nd] = d
+            t0 = time.perf_counter()
             with self.stats.phase(profiler.SPEC_VERIFY):
                 n_acc, emit = self.engine.verify_chunk(
                     slot, buf, int(self._pos[slot]), nd,
                     self._keys[slot], int(self._fold[slot]),
                     p.temperature, p.top_k, p.top_p)
+            if req.traced:
+                # a verify forward is a per-slot dispatch emitting up to
+                # K+1 tokens, so one span per FORWARD is O(1)/token-
+                # batch, not per-token
+                self.tracer.add(profiler.SPEC_VERIFY, t0,
+                                time.perf_counter() - t0,
+                                request_tid(req.rid), cat="serve",
+                                args={"drafted": nd, "accepted": n_acc})
             self.spec_forwards += 1
             self.spec_drafted += nd
             self.spec_accepted += n_acc
@@ -485,10 +551,18 @@ class SlotScheduler:
         decoding = self.decoding
         if decoding == 0:
             return 0
+        t0 = time.perf_counter()
         with self.stats.phase(profiler.DECODE_TICK):
             nxt = self.engine.tick(self._tok, self._pos, self._keys,
                                    self._fold, self._temp, self._topk,
                                    self._topp)
+        if self.tracer is not None and self.tracer.enabled:
+            # ONE span per batched tick on the shared engine track —
+            # per-request tick spans would be a per-token allocation in
+            # the hot loop, exactly what the obs cost budget forbids
+            self.tracer.add(profiler.DECODE_TICK, t0,
+                            time.perf_counter() - t0, TID_ENGINE,
+                            cat="serve", args={"decoding": decoding})
         self.ticks += 1
         self.active_row_ticks += decoding
         for slot, req in enumerate(self._req):

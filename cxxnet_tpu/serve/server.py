@@ -12,10 +12,16 @@ queue rejects at submit time with a reason (``QueueFullError``) instead
 of buffering unboundedly — the caller decides whether to retry, shed, or
 block (``block=True``, what the CLI's stdin loop uses).
 
-Observability: per-request TTFT / per-token latency and the scheduler's
-prefill / decode_tick / queue_wait phases (utils/profiler.py) are
-summarized as p50/p95/p99 by :meth:`metrics`, alongside queue-depth,
-slot-occupancy and batch-efficiency gauges.
+Observability (doc/observability.md): per-request TTFT / per-token
+latency and the scheduler's prefill / decode_tick / queue_wait phases
+(utils/profiler.py) are summarized as p50/p95/p99 by :meth:`metrics`,
+alongside queue-depth, slot-occupancy and batch-efficiency gauges. The
+same signals feed the unified obs registry — :meth:`metrics_text` is
+the Prometheus exposition — and every request's lifecycle is recorded
+as a span tree in the obs tracer (queue_wait -> prefix_restore ->
+prefill chunks -> decode -> spec verifies -> retire), exportable as
+Chrome-trace JSON; ``slow_ms`` auto-dumps the tree of any request that
+crosses the latency threshold.
 
 Shutdown: ``shutdown(drain=True)`` stops admissions, finishes every
 queued + in-flight request, then joins the thread and drops the caches;
@@ -36,6 +42,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils import profiler
 from .engine import DecodeEngine
 from .scheduler import Request, SamplingParams, SlotScheduler
@@ -44,6 +52,13 @@ __all__ = ["InferenceServer", "ServeResult", "AdmissionError",
            "QueueFullError"]
 
 _server_seq = itertools.count()
+# rids are PROCESS-unique, not per-server: the span tracer keys request
+# tracks by rid (obs/trace.py request_tid), and the default tracer is
+# the process-global one whose ring outlives any single server — a
+# per-server counter would land two servers' (or a restarted server's)
+# different requests on the same exported track and corrupt slow-request
+# exemplars
+_rid_seq = itertools.count()
 
 
 class AdmissionError(RuntimeError):
@@ -85,7 +100,8 @@ class InferenceServer:
                  prefill_chunk: int = 64, prefill_budget: int = 1,
                  prefix_mb: float = 32.0, recompile_limit: int = 0,
                  recompile_strict: bool = True, spec_mode: str = "off",
-                 spec_len: int = 4, spec_model=None):
+                 spec_len: int = 4, spec_model=None, tracer=None,
+                 registry=None, slow_ms: float = 0.0):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -103,7 +119,18 @@ class InferenceServer:
         (max draft tokens per forward, one compiled verify signature
         server-wide). Greedy speculative output is bit-identical to the
         non-speculative path; sampled output is identical in
-        distribution (doc/serving.md)."""
+        distribution (doc/serving.md).
+
+        Observability (doc/observability.md): ``tracer`` is the span
+        recorder — None uses the process-global
+        ``obs.trace.get_tracer()`` (on by default, ring-bounded); pass
+        a private Tracer for isolation or one with ``enabled=False``
+        to opt out. ``registry`` is the obs metrics registry — None
+        gives this server its OWN Registry (two servers' gauges must
+        not fight over one name); :meth:`metrics_text` exposes it as
+        Prometheus text. ``slow_ms`` > 0 arms the slow-request
+        exemplar hook: any request whose TTFT or total latency exceeds
+        it has its span tree auto-dumped (``Tracer.note_slow``)."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -121,11 +148,17 @@ class InferenceServer:
         self._defaults = defaults or SamplingParams()
         if timeout_ms and not self._defaults.timeout_ms:
             self._defaults = replace(self._defaults, timeout_ms=timeout_ms)
+        self._tracer = tracer if tracer is not None \
+            else obs_trace.get_tracer()
+        self._registry = registry if registry is not None \
+            else obs_metrics.Registry()
+        self._slow_ms = float(slow_ms)
         self._engine = DecodeEngine(
             cfg, params, slots, prefill_chunk=prefill_chunk,
             recompile_limit=recompile_limit,
             recompile_strict=recompile_strict,
-            spec_len=spec_len if spec_mode != "off" else 0)
+            spec_len=spec_len if spec_mode != "off" else 0,
+            obs_registry=self._registry)
         self._prefill_budget = int(prefill_budget)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
@@ -140,17 +173,31 @@ class InferenceServer:
                 dcfg, dparams = spec_model
                 self._drafters["model"] = ModelDrafter(
                     dcfg, dparams, slots, target_cfg=cfg)
-        self._stats = profiler.StepStats()
+        # StepStats feeds the registry (utils/profiler.py observer):
+        # every phase sample lands in the mergeable per-phase histogram
+        # as well as the StepStats percentile window
+        self._phase_h = self._registry.histogram(
+            "cxn_serve_phase_seconds",
+            "per-phase scheduler durations (queue_wait, prefill_chunk, "
+            "prefix_copy, decode_tick, spec_draft, spec_verify)",
+            labelnames=("phase",))
+        # every admitted request observes queue_wait, so the series must
+        # exist (count 0) even before the first observation — overload
+        # monitors alert on its absence, not just its value
+        self._phase_h.labels(profiler.QUEUE_WAIT)
+        self._stats = profiler.StepStats(
+            observer=lambda name, s: self._phase_h.labels(name).observe(s))
         self._sched = SlotScheduler(self._engine, self._stats,
                                     on_finish=self._record_done,
                                     prefix_cache=self._prefix,
                                     drafters=self._drafters,
                                     spec_mode=spec_mode,
-                                    spec_len=self._engine.spec_len)
+                                    spec_len=self._engine.spec_len,
+                                    tracer=self._tracer)
         self._queue: collections.deque = collections.deque()
         self._queue_cap = queue
         self._cond = threading.Condition()
-        self._rid = itertools.count()
+        self._rid = _rid_seq
         self._closing = False           # no new submits
         self._drain = True              # finish queued work on shutdown?
         self._stopped = threading.Event()
@@ -159,14 +206,131 @@ class InferenceServer:
         # does not grow with requests served (percentiles then describe
         # the most recent window)
         self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
-                        "timeout": 0, "cancelled": 0}
+                        "timeout": 0, "cancelled": 0, "expired": 0}
         self._ttft_s: collections.deque = collections.deque(maxlen=4096)
         self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
         self._queue_depth_max = 0
+        self._register_obs()
         self._thread = threading.Thread(
             target=self._loop,
             name="cxn-serve-scheduler-%d" % next(_server_seq), daemon=True)
         self._thread.start()
+
+    # --------------------------------------------------------------- obs
+    def _register_obs(self) -> None:
+        """Register this server's metric catalog (doc/observability.md)
+        in the registry. Counters that already exist as monotonic ints
+        on the scheduler / prefix cache / request-count dict are
+        exposed as CALLBACK counters (obs/metrics.py) — collection-time
+        reads, zero added work on the increment paths; the latency
+        histograms are real observations (submit/terminal paths only,
+        never the tick loop)."""
+        r = self._registry
+        sc = self._sched
+        # every callback-backed name is remembered so shutdown() can
+        # freeze it to its terminal value (the registry must not keep
+        # the dead server — engine params, KV pool — alive, nor report
+        # its stale attributes as live)
+        cb = self._obs_cb_names = []
+
+        def cb_counter(name, help_, fn):
+            cb.append(name)
+            r.counter(name, help_, fn=fn)
+
+        def cb_gauge(name, help_, fn):
+            cb.append(name)
+            r.gauge(name, help_, fn=fn)
+
+        for key, help_ in (
+                ("submitted", "requests accepted into the admission "
+                              "queue"),
+                ("completed", "requests finished ok"),
+                ("rejected", "requests refused at admission "
+                             "(bad params or queue full)"),
+                ("timeout", "requests that reached a terminal timeout "
+                            "(queue-deadline expiry included)"),
+                ("expired", "requests whose queue deadline passed "
+                            "before a slot freed (subset of timeout)"),
+                ("cancelled", "requests cancelled by shutdown/abort")):
+            cb_counter("cxn_serve_%s_total" % key, help_,
+                       lambda k=key: self._counts[k])
+        for attr, help_ in (
+                ("ticks", "batched decode steps run"),
+                ("tokens_generated", "tokens emitted across all "
+                                     "requests"),
+                ("prefill_chunks", "chunk-prefill steps run"),
+                ("requests_prefilled", "requests whose prefill "
+                                       "completed"),
+                ("spec_forwards", "speculative verify forwards run"),
+                ("spec_drafted", "draft tokens proposed"),
+                ("spec_accepted", "draft tokens accepted"),
+                ("spec_emitted", "tokens appended by verify forwards"),
+                ("spec_rollbacks", "verify forwards that rejected a "
+                                   "suffix"),
+                ("spec_backoffs", "requests that stopped speculating "
+                                  "(accept-rate back-off)")):
+            cb_counter("cxn_serve_%s_total" % attr, help_,
+                       lambda a=attr: getattr(sc, a))
+        cb_gauge("cxn_serve_queue_depth", "requests waiting in the "
+                 "admission queue", lambda: len(self._queue))
+        cb_gauge("cxn_serve_queue_depth_max", "high-water queue depth "
+                 "since start/reset", lambda: self._queue_depth_max)
+        cb_gauge("cxn_serve_slots", "KV slot-pool size",
+                 lambda: self._engine.slots)
+        cb_gauge("cxn_serve_slot_occupancy", "occupied slot fraction",
+                 sc.occupancy)
+        cb_gauge("cxn_serve_batch_efficiency", "mean fraction of slot "
+                 "rows doing useful work per tick", sc.batch_efficiency)
+        cb_gauge("cxn_serve_kv_cache_bytes", "slot-pool K/V bytes",
+                 self._engine.cache_bytes)
+        pc = self._prefix
+        if pc is not None:
+            for attr, help_ in (
+                    ("hits", "admits that restored >= 1 cached chunk"),
+                    ("misses", "admits that restored none"),
+                    ("hit_tokens", "prompt tokens restored from the "
+                                   "prefix cache"),
+                    ("prompt_tokens", "prompt tokens across all "
+                                      "lookups"),
+                    ("evictions", "cached chunks LRU-evicted"),
+                    ("inserted_chunks", "chunks copied into the trie")):
+                cb_counter("cxn_prefix_%s_total" % attr, help_,
+                           lambda a=attr: getattr(pc, a))
+            cb_gauge("cxn_prefix_cache_bytes", "prefix-trie K/V bytes",
+                     lambda: pc.nbytes)
+            cb_gauge("cxn_prefix_cache_chunks", "chunks resident in the "
+                     "prefix trie", lambda: pc.chunks)
+        # latency histograms (fixed log-spaced buckets -> mergeable
+        # across replicas); cxn_serve_phase_seconds was registered with
+        # the StepStats observer in __init__
+        self._ttft_h = r.histogram(
+            "cxn_serve_ttft_seconds",
+            "submit -> first token (queue wait included)")
+        self._gap_h = r.histogram(
+            "cxn_serve_token_gap_seconds",
+            "mean inter-token gap per completed request")
+        # the recompile-trip family always exists (pre-touched at 0) so
+        # the exported catalog is stable whether or not a guard is armed
+        from ..analysis.recompile import trip_counter
+        trips = trip_counter(r)
+        trips.labels("serve_prefill")
+        trips.labels("serve_verify_chunk")
+
+    @property
+    def registry(self):
+        """The obs metrics registry this server reports into."""
+        return self._registry
+
+    @property
+    def tracer(self):
+        """The span tracer this server records into."""
+        return self._tracer
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the full serving catalog
+        (serving + prefix-cache + speculative + recompile-guard
+        metrics) — the scrape payload."""
+        return self._registry.to_prometheus()
 
     # ------------------------------------------------------------ submit
     @property
@@ -179,7 +343,12 @@ class InferenceServer:
 
     def _reject(self, reason: str) -> None:
         """Count + raise an unservable-request rejection, so the
-        'rejected' metric agrees with the ERR lines callers emit."""
+        'rejected' metric agrees with the ERR lines callers emit. No
+        queue-wait sample here: a bad-params rejection never interacted
+        with the queue, and a misbehaving client spamming invalid
+        requests must not flood the wait histogram with zeros (only the
+        queue-FULL shed path in submit() records the zero-wait sample —
+        that one really was turned away at the door by load)."""
         with self._cond:
             self._counts["rejected"] += 1
         raise AdmissionError(reason)
@@ -221,6 +390,7 @@ class InferenceServer:
             while len(self._queue) >= self._queue_cap:
                 if not block:
                     self._counts["rejected"] += 1
+                    self._phase_h.labels(profiler.QUEUE_WAIT).observe(0.0)
                     raise QueueFullError(
                         "admission queue full (%d queued, %d/%d slots "
                         "busy); retry later or submit(block=True)"
@@ -260,51 +430,96 @@ class InferenceServer:
                            error=handle.error)
 
     # -------------------------------------------------------------- loop
-    def _expire_queued_locked(self, now: float) -> None:
+    def _expire_queued_locked(self, now: float) -> List[Request]:
         """Finish queued requests whose deadline passed (FIFO order is
-        preserved for the survivors)."""
+        preserved for the survivors). Returns the expired requests so
+        the caller can run the slow-exemplar hook on them OUTSIDE the
+        lock (``note_slow`` does file I/O) — an expired request is
+        exactly the kind of worst offender ``obs_slow_ms`` exists to
+        capture."""
         if not any(r.deadline is not None for r in self._queue):
-            return
+            return []
         keep = collections.deque()
+        expired: List[Request] = []
         for req in self._queue:
             if req.deadline is not None and now > req.deadline:
+                expired.append(req)
                 self._counts["timeout"] += 1
+                self._counts["expired"] += 1
+                # an expired request DID wait — record its full queue
+                # time, or overload reads as low queue-wait percentiles
+                # (only the admitted survivors would contribute). Runs
+                # on the scheduler thread, so StepStats is safe here;
+                # the observer forwards it to the registry histogram.
+                self._stats.record(profiler.QUEUE_WAIT,
+                                   now - req.submit_t)
+                self._stats.end_step()
                 req.finish("timeout",
                            "expired after %.0f ms in queue"
                            % ((now - req.submit_t) * 1e3))
+                if self._tracer.should_sample(req.rid):
+                    # the span tree of a request that never got a slot:
+                    # queue_wait + the terminal root, nothing else
+                    tid = obs_trace.request_tid(req.rid)
+                    self._tracer.add(profiler.QUEUE_WAIT, req.submit_t,
+                                     now - req.submit_t, tid,
+                                     cat="serve")
+                    self._tracer.add("request", req.submit_t,
+                                     req.done_t - req.submit_t, tid,
+                                     cat="serve",
+                                     args={"rid": req.rid,
+                                           "status": "timeout",
+                                           "expired": True})
             else:
                 keep.append(req)
         if len(keep) != len(self._queue):
             self._queue = keep
             self._cond.notify_all()
+        return expired
 
     def _loop(self) -> None:
         admitted = []
         try:
             while True:
                 admitted = []
-                with self._cond:
-                    now = time.perf_counter()
-                    self._expire_queued_locked(now)
-                    if self._closing and not self._drain:
-                        break
-                    n_free = self._sched.free_slots   # slots shrink only
-                    #   when admit() runs below, outside this lock
-                    while n_free > 0 and self._queue:
-                        admitted.append(self._queue.popleft())
-                        n_free -= 1
-                        self._cond.notify_all()     # space for blocked submits
-                    if not admitted and self._sched.active == 0:
-                        if self._closing and not self._queue:
+                expired = []
+                try:
+                    with self._cond:
+                        now = time.perf_counter()
+                        expired = self._expire_queued_locked(now)
+                        if self._closing and not self._drain:
                             break
-                        # truly idle: active == 0 means every slot is
-                        # free, so the pop loop above drained the queue —
-                        # nothing can expire while we sleep. Every
-                        # mutation path (submit, shutdown) notifies, so
-                        # an untimed wait parks the thread completely
-                        # instead of polling
-                        self._cond.wait()
-                        continue
+                        n_free = self._sched.free_slots   # slots shrink
+                        #   only when admit() runs below, outside this
+                        #   lock
+                        while n_free > 0 and self._queue:
+                            admitted.append(self._queue.popleft())
+                            n_free -= 1
+                            self._cond.notify_all()   # space for blocked
+                            #                           submits
+                        if not admitted and self._sched.active == 0:
+                            if self._closing and not self._queue:
+                                break
+                            # truly idle: active == 0 means every slot
+                            # is free, so the pop loop above drained the
+                            # queue — nothing can expire while we sleep.
+                            # Every mutation path (submit, shutdown)
+                            # notifies, so an untimed wait parks the
+                            # thread completely instead of polling. A
+                            # pass that just expired requests skips the
+                            # park so their exemplar dump (below) isn't
+                            # deferred until the next submit.
+                            if not expired:
+                                self._cond.wait()
+                            continue
+                finally:
+                    # slow-exemplar hook outside the lock (note_slow
+                    # does file I/O); a finally so the break/continue
+                    # exits above cannot skip it — expired requests are
+                    # exactly the worst offenders obs_slow_ms exists
+                    # to capture
+                    for req in expired:
+                        self._maybe_slow(req)
                 for req in admitted:            # device work outside the
                     self._sched.admit(req)      # lock
                 # at most prefill_budget chunk steps per pass, so a long
@@ -358,12 +573,34 @@ class InferenceServer:
         if req.status != "ok":
             self._counts["cancelled" if req.status == "cancelled"
                          else req.status] += 1
+            self._maybe_slow(req)
             return
         self._counts["completed"] += 1
-        self._ttft_s.append(req.first_token_t - req.submit_t)
+        ttft = req.first_token_t - req.submit_t
+        self._ttft_s.append(ttft)
+        self._ttft_h.observe(ttft)
         if len(req.tokens) > 1:
-            self._tok_gap_s.append((req.done_t - req.first_token_t)
-                                   / (len(req.tokens) - 1))
+            gap = ((req.done_t - req.first_token_t)
+                   / (len(req.tokens) - 1))
+            self._tok_gap_s.append(gap)
+            self._gap_h.observe(gap)
+        self._maybe_slow(req)
+
+    def _maybe_slow(self, req: Request) -> None:
+        """The slow-request exemplar hook (obs_slow_ms): a request whose
+        TTFT or total latency crossed the threshold gets its span tree
+        dumped NOW, while the spans are still in the ring."""
+        if self._slow_ms <= 0:
+            return
+        total_ms = (req.done_t - req.submit_t) * 1e3
+        ttft_ms = ((req.first_token_t - req.submit_t) * 1e3
+                   if req.first_token_t is not None else total_ms)
+        if ttft_ms > self._slow_ms or total_ms > self._slow_ms:
+            self._tracer.note_slow(
+                req.rid,
+                "ttft %.1f ms, total %.1f ms over obs_slow_ms=%g"
+                % (ttft_ms, total_ms, self._slow_ms),
+                args={"status": req.status})
 
     # ----------------------------------------------------------- control
     def drain(self, timeout: Optional[float] = None) -> None:
@@ -382,6 +619,11 @@ class InferenceServer:
             self._cond.notify_all()
         self._stopped.wait(timeout)
         self._thread.join(timeout)
+        # freeze this server's callback metrics at their terminal
+        # values: the registry stops pinning the engine/KV pool, and a
+        # post-shutdown scrape reports the honest drained state instead
+        # of evaluating a dead object (obs/metrics.py:Registry.freeze)
+        self._registry.freeze(self._obs_cb_names)
 
     def close(self) -> None:
         self.shutdown(drain=False)
@@ -407,14 +649,13 @@ class InferenceServer:
             "requests": dict(self._counts),
             "ttft_ms": ms(self._ttft_s),
             "token_ms": ms(self._tok_gap_s),
-            "queue_wait_ms": ms(st._phases.get(profiler.QUEUE_WAIT, [])),
-            "prefill_ms": ms(st._phases.get(profiler.PREFILL, [])),
-            "prefill_chunk_ms": ms(st._phases.get(profiler.PREFILL_CHUNK,
-                                                  [])),
-            "prefix_copy_ms": ms(st._phases.get(profiler.PREFIX_COPY, [])),
-            "decode_tick_ms": ms(st._phases.get(profiler.DECODE_TICK, [])),
-            "spec_draft_ms": ms(st._phases.get(profiler.SPEC_DRAFT, [])),
-            "spec_verify_ms": ms(st._phases.get(profiler.SPEC_VERIFY, [])),
+            "queue_wait_ms": ms(st.samples(profiler.QUEUE_WAIT)),
+            "prefill_ms": ms(st.samples(profiler.PREFILL)),
+            "prefill_chunk_ms": ms(st.samples(profiler.PREFILL_CHUNK)),
+            "prefix_copy_ms": ms(st.samples(profiler.PREFIX_COPY)),
+            "decode_tick_ms": ms(st.samples(profiler.DECODE_TICK)),
+            "spec_draft_ms": ms(st.samples(profiler.SPEC_DRAFT)),
+            "spec_verify_ms": ms(st.samples(profiler.SPEC_VERIFY)),
             "queue_depth": {"now": depth, "max": self._queue_depth_max},
             "slot_occupancy": sc.occupancy(),
             "batch_efficiency": sc.batch_efficiency(),
@@ -476,3 +717,13 @@ class InferenceServer:
             # traffic counters only: cached chunks stay warm — a bench's
             # measured pass is supposed to see the steady state
             self._prefix.reset_counters()
+        # the registry histograms must reset WITH the counters they are
+        # read against — otherwise a post-reset scrape shows
+        # ttft_seconds_count > completed_total (the callback counters
+        # read the zeroed dicts, the histograms would still carry the
+        # warm pass)
+        self._ttft_h.reset()
+        self._gap_h.reset()
+        for _, child in self._registry.get(
+                "cxn_serve_phase_seconds").children():
+            child.reset()

@@ -54,9 +54,13 @@ interleaves per-slot verify chunks with the shared decode tick.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import numpy as np
+
+from ..obs.trace import TID_ENGINE, get_tracer
+from ..utils import profiler
 
 __all__ = ["NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
            "speculative_decode"]
@@ -260,7 +264,12 @@ class SpeculativeDecoder:
     stream costs."""
 
     def __init__(self, cfg, params: Dict, batch: int, spec_len: int = 4,
-                 mode: str = "ngram", model=None):
+                 mode: str = "ngram", model=None, tracer=None):
+        """``tracer``: obs span recorder for the offline decode loop
+        (doc/observability.md) — None uses the process-global tracer,
+        so ``gpt_decode(speculative=...)`` runs show up on the same
+        TID_ENGINE track as serving ticks; pass one with
+        ``enabled=False`` to opt out."""
         from .engine import DecodeEngine
         if mode not in ("ngram", "model"):
             raise ValueError("speculative mode must be 'ngram' or "
@@ -280,6 +289,7 @@ class SpeculativeDecoder:
                                         target_cfg=cfg)
         else:
             self.drafter = NgramDrafter(self.spec_len)
+        self.tracer = tracer if tracer is not None else get_tracer()
         # observability: filled per decode() call
         self.stats = {"forwards": 0, "drafted": 0, "accepted": 0,
                       "rollbacks": 0, "ticks": 0, "tokens": 0}
@@ -330,10 +340,19 @@ class SpeculativeDecoder:
             want = {i: min(K, max_new - len(toks[i]) - 1) for i in live
                     if max_new - len(toks[i]) >= 2
                     and int(pos[i]) + K + 1 <= eng.row_len}
+            tr = self.tracer if self.tracer.enabled else None
+            t0 = time.perf_counter()
             drafts = self.drafter.draft(
                 {i: np.concatenate([prompt[i],
                                     np.asarray(toks[i], np.int32)])
                  for i in want}, want) if want else {}
+            if tr is not None and want:
+                # mirror the serving scheduler's shared-span discipline:
+                # one engine-track span per batched drafter pass / per
+                # verify forward / per tick, never one per token
+                tr.add(profiler.SPEC_DRAFT, t0, time.perf_counter() - t0,
+                       TID_ENGINE, cat="spec_offline",
+                       args={"rows": len(want)})
             for i, d in drafts.items():
                 nd = len(d)
                 if nd < 1:
@@ -341,9 +360,16 @@ class SpeculativeDecoder:
                 buf = np.zeros(K + 1, np.int32)
                 buf[0] = last[i]
                 buf[1:1 + nd] = d
+                t0 = time.perf_counter()
                 n_acc, emit = eng.verify_chunk(
                     i, buf, int(pos[i]), nd, keys[i], int(fold[i]),
                     temperature, top_k, top_p)
+                if tr is not None:
+                    tr.add(profiler.SPEC_VERIFY, t0,
+                           time.perf_counter() - t0, TID_ENGINE,
+                           cat="spec_offline",
+                           args={"row": i, "drafted": nd,
+                                 "accepted": int(n_acc)})
                 emitted = [int(t) for t in d[:n_acc]] + [int(emit)]
                 self.stats["forwards"] += 1
                 self.stats["drafted"] += nd
@@ -363,8 +389,14 @@ class SpeculativeDecoder:
                 for i in tick_rows:
                     t_pos[i] = pos[i]
                     t_temp[i] = temp_row[i]
+                t0 = time.perf_counter()
                 nxt = eng.tick(last, t_pos, keys, fold, t_temp, topk_row,
                                topp_row)
+                if tr is not None:
+                    tr.add(profiler.DECODE_TICK, t0,
+                           time.perf_counter() - t0, TID_ENGINE,
+                           cat="spec_offline",
+                           args={"decoding": len(tick_rows)})
                 self.stats["ticks"] += 1
                 for i in tick_rows:
                     toks[i].append(int(nxt[i]))

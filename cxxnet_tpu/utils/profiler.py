@@ -28,7 +28,7 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["StepStats", "trace", "annotate", "step_annotation", "get_time",
-           "percentiles", "log", "FEED_WAIT", "STEP_DISPATCH",
+           "percentiles", "log", "warn", "FEED_WAIT", "STEP_DISPATCH",
            "METRIC_SYNC", "PREFILL", "PREFILL_CHUNK", "PREFIX_COPY",
            "DECODE_TICK", "QUEUE_WAIT", "SPEC_DRAFT", "SPEC_VERIFY",
            "LINT"]
@@ -82,13 +82,26 @@ def get_time() -> float:
     return time.perf_counter()
 
 
-def log(msg: str) -> None:
-    """Timestamped host-side log line on stderr — the runtime channel for
-    subsystem findings (the CXN_LINT startup audit routes through here so
-    lint output lands in the same stream as the metric lines)."""
+def log(msg: str, level: str = "info") -> None:
+    """Timestamped, leveled host-side log line on stderr — the runtime
+    channel for subsystem findings (the CXN_LINT startup audit, the
+    serve path's banners and fallback notices, and the obs slow-request
+    exemplars all route through here, so human logs carry the same
+    wall timestamps as the obs JSONL snapshot lines and the two streams
+    interleave coherently). ``level`` is ``"info"`` (default) or
+    ``"warn"``; warnings are tagged ``[WARN]`` so they grep apart."""
     import sys
-    sys.stderr.write("[%s] %s\n" % (time.strftime("%H:%M:%S"), msg))
+    if level not in ("info", "warn"):
+        raise ValueError("log level must be 'info' or 'warn', got %r"
+                         % (level,))
+    tag = " [WARN]" if level == "warn" else ""
+    sys.stderr.write("[%s]%s %s\n" % (time.strftime("%H:%M:%S"), tag, msg))
     sys.stderr.flush()
+
+
+def warn(msg: str) -> None:
+    """``log(msg, level="warn")`` shorthand."""
+    log(msg, level="warn")
 
 
 class StepStats:
@@ -106,9 +119,16 @@ class StepStats:
         print(stats.summary())   # then stats.clear() for the next round
     """
 
-    def __init__(self, batch_size: int = 0, max_steps: int = 100000) -> None:
+    def __init__(self, batch_size: int = 0, max_steps: int = 100000,
+                 observer=None) -> None:
+        """``observer``: optional ``(phase_name, seconds)`` callable
+        invoked once per phase at each ``end_step`` — how StepStats
+        feeds the obs metrics registry (the server wires it to
+        per-phase histograms, obs/metrics.py) instead of callers
+        reaching into the private sample dicts."""
         self.batch_size = batch_size
         self.max_steps = max_steps
+        self.observer = observer
         self._phases: Dict[str, List[float]] = {}
         self._current: Dict[str, float] = {}
         self._round_start = get_time()
@@ -133,8 +153,16 @@ class StepStats:
             lst = self._phases.setdefault(name, [])
             if len(lst) < self.max_steps:
                 lst.append(dt)
+            if self.observer is not None:
+                self.observer(name, dt)
         self._current.clear()
         self.num_steps += 1
+
+    def samples(self, name: str) -> List[float]:
+        """Per-step durations recorded for a phase (empty when it never
+        ran) — the public read surface; summaries should go through
+        this or :meth:`percentiles`, not the private dicts."""
+        return list(self._phases.get(name, []))
 
     def clear(self) -> None:
         self._phases.clear()

@@ -191,7 +191,8 @@ class Net:
                     prefill_budget: int = 1, prefix_mb: float = 32.0,
                     recompile_limit: int = 0, recompile_strict: bool = True,
                     spec_mode: str = "off", spec_len: int = 4,
-                    spec_model=None, **defaults) -> None:
+                    spec_model=None, slow_ms: float = 0.0, tracer=None,
+                    registry=None, **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -209,7 +210,14 @@ class Net:
         overrides ride in ``serve_submit(spec_mode=..., spec_len=...)``.
         ``defaults`` seed the per-request SamplingParams (max_tokens /
         temperature / top_k / top_p / seed / eos / spec_mode /
-        spec_len)."""
+        spec_len).
+
+        Observability (doc/observability.md): ``slow_ms`` arms the
+        slow-request span-tree exemplar dump; ``tracer`` / ``registry``
+        override the span tracer (default: the process-global one —
+        what :meth:`trace_export` reads) and the metrics registry
+        (default: a server-private one — what :meth:`metrics_text`
+        renders)."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
         if getattr(self, "_server", None) is not None:
@@ -223,7 +231,8 @@ class Net:
             prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
             prefix_mb=prefix_mb, recompile_limit=recompile_limit,
             recompile_strict=recompile_strict, spec_mode=spec_mode,
-            spec_len=spec_len, spec_model=spec_model,
+            spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
+            tracer=tracer, registry=registry,
             defaults=SamplingParams(**defaults))
 
     def _serving(self):
@@ -257,6 +266,32 @@ class Net:
         if srv is not None:
             srv.shutdown(drain=drain)
             self._server = None
+
+    # -- observability (doc/observability.md) -------------------------
+    def metrics_text(self) -> str:
+        """Prometheus text exposition. While serving, the running
+        server's registry (serving + prefix-cache + speculative +
+        recompile-guard metrics — the scrape payload); otherwise the
+        process-global registry (training counters, trainer recompile
+        trips)."""
+        srv = getattr(self, "_server", None)
+        if srv is not None:
+            return srv.metrics_text()
+        from .obs.metrics import default_registry
+        return default_registry().to_prometheus()
+
+    def trace_export(self, path: Optional[str] = None):
+        """The process-global span tracer's ring as a Chrome-trace JSON
+        object (obs/trace.py; loadable in Perfetto /
+        chrome://tracing). ``path`` also writes it to a file; returns
+        the dict either way."""
+        import json
+        from .obs.trace import get_tracer
+        doc = get_tracer().chrome_trace()
+        if path:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
 
     # -- static analysis (doc/lint.md) --------------------------------
     def lint(self, compile: bool = False):

@@ -46,8 +46,10 @@ def _no_leaked_background_threads():
     # scheduler + printer + any speculative drafter workers (cxn-spec-*:
     # the naming contract for future async drafters — today's drafters
     # run on the scheduler thread, but a leak check that predates the
-    # first worker is the cheap kind)
-    prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec")
+    # first worker is the cheap kind) + the obs metrics flusher
+    # (cxn-obs-flusher-*, obs/export.py — a leaked one keeps appending
+    # JSONL snapshots to a closed test's tmp file forever)
+    prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec", "cxn-obs")
     deadline = time.time() + 5.0
     while True:
         leaked = [t.name for t in threading.enumerate()

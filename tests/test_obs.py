@@ -1,0 +1,741 @@
+"""Observability subsystem (cxxnet_tpu/obs/): the unified metrics
+registry (Counter/Gauge/Histogram with Prometheus exposition and
+mergeable fixed-bucket percentiles), the request-scoped span tracer
+(bounded ring, Chrome-trace export, slow-request exemplars), the export
+plumbing (JSONL flusher, end-of-task dumps, tools/cxn_trace.py), and the
+serving integration — a scripted mixed workload (chunked prefill +
+prefix hit + speculative) must leave one complete, schema-valid span
+tree per request, and expired/rejected requests must contribute to the
+queue-wait distribution instead of silently dropping out of it."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.obs import (Counter, Gauge, Histogram, MetricsFlusher,
+                            Registry, TIME_BUCKETS, export_run)
+from cxxnet_tpu.obs.trace import (REQ_TID_BASE, TID_ENGINE, Tracer,
+                                  get_tracer, request_tid)
+from cxxnet_tpu.serve import AdmissionError, InferenceServer
+from cxxnet_tpu.utils import profiler
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+def _cxn_trace_mod():
+    spec = importlib.util.spec_from_file_location(
+        "cxn_trace", os.path.join(_REPO, "tools", "cxn_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- metrics
+def test_counter_monotonic_and_callback():
+    r = Registry()
+    c = r.counter("t_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    live = [7]
+    cb = r.counter("t_live_total", fn=lambda: live[0])
+    assert cb.value == 7
+    with pytest.raises(RuntimeError):
+        cb.inc()
+
+
+def test_gauge_set_inc_and_dead_callback_nan():
+    r = Registry()
+    g = r.gauge("t_gauge")
+    g.set(4.0)
+    g.inc(-1.5)
+    assert g.value == 2.5
+
+    def dead():
+        raise RuntimeError("provider gone")
+
+    bad = r.gauge("t_dead", fn=dead)
+    with pytest.raises(RuntimeError):
+        bad.set(1.0)                    # callback gauge: read-only
+    with pytest.raises(RuntimeError):
+        bad.inc()
+    assert np.isnan(bad.value)          # a dead provider must not
+    #                                     kill the scrape...
+    assert "t_dead NaN" in r.to_prometheus()    # ...nor the exposition
+    snap = r.snapshot()
+    assert snap["t_dead"] is None       # nor poison the JSONL stream
+    json.dumps(snap, allow_nan=False)   # strict-JSON-clean
+
+
+def test_registry_reregister_rebinds_callback_and_pins_buckets():
+    """Re-registering a callback metric rebinds it to the NEW provider
+    (a restarted server sharing a registry must not leave the exported
+    names reading its dead predecessor), and re-registering a histogram
+    with different buckets is an error, never a silent keep."""
+    r = Registry()
+    a = [1]
+    r.counter("t_live_total", fn=lambda: a[0])
+    b = [7]
+    c = r.counter("t_live_total", fn=lambda: b[0])
+    assert c.value == 7                 # latest provider wins
+    lab = r.gauge("t_lab", labelnames=("k",), fn=lambda: a[0])
+    lab.labels("x")
+    r.gauge("t_lab", labelnames=("k",), fn=lambda: b[0])
+    assert lab.labels("x").value == 7   # existing children rebound
+    assert lab.labels("y").value == 7   # new children use the new fn
+    r.histogram("t_h", buckets=(1.0, 2.0))
+    r.histogram("t_h", buckets=(1.0, 2.0))      # same geometry: fine
+    with pytest.raises(ValueError):
+        r.histogram("t_h", buckets=(5.0, 6.0))
+
+
+def test_registry_freeze_releases_owner_and_keeps_values():
+    """Registry.freeze: callback metrics become their terminal values
+    (the honest drained state keeps exporting) and the provider object
+    is RELEASED — a stopped server must not be pinned by its registry."""
+    import gc
+    import weakref
+
+    class Owner:
+        def __init__(self):
+            self.n = 5
+
+    r = Registry()
+    owner = Owner()
+    ref = weakref.ref(owner)
+    r.counter("t_owned_total", fn=lambda: owner.n)
+    r.gauge("t_owned_gauge", fn=lambda: owner.n * 2)
+    r.freeze(["t_owned_total", "t_owned_gauge", "t_absent"])
+    del owner
+    gc.collect()
+    assert ref() is None                # closure dropped
+    snap = r.snapshot()
+    assert snap["t_owned_total"] == 5   # terminal values survive
+    assert snap["t_owned_gauge"] == 10
+
+
+def test_shared_registry_server_restart_reads_live_server():
+    """The rebind end to end: server B re-registering into A's registry
+    takes over every callback metric instead of exporting A's frozen
+    state."""
+    reg = Registry()
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         tracer=Tracer(enabled=False),
+                         registry=reg) as a:
+        h = a.submit(np.arange(4, dtype=np.int32), max_tokens=2)
+        assert a.result(h, timeout=300).status == "ok"
+        assert "cxn_serve_submitted_total 1" in a.metrics_text()
+    # A's shutdown froze its callbacks at their terminal values: the
+    # post-shutdown scrape reports the honest drained state without
+    # evaluating (or pinning) the dead server
+    after = reg.snapshot()
+    assert after["cxn_serve_submitted_total"] == 1
+    assert after["cxn_serve_slot_occupancy"] == 0.0
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         tracer=Tracer(enabled=False),
+                         registry=reg) as b:
+        assert b.registry is reg
+        assert "cxn_serve_submitted_total 0" in b.metrics_text()
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    r = Registry()
+    a = r.counter("shared_total")
+    b = r.counter("shared_total")
+    assert a is b                       # two subsystems share one
+    with pytest.raises(ValueError):
+        r.gauge("shared_total")
+    lab = r.counter("lab_total", labelnames=("k",))
+    lab.labels("x").inc()
+    assert lab.labels("x") is lab.labels("x")
+    with pytest.raises(ValueError):
+        lab.labels("x", "y")            # arity mismatch
+    with pytest.raises(ValueError):
+        lab.default                     # labeled family has no default
+
+
+def test_histogram_buckets_deterministic_and_strict():
+    # the mergeability precondition: every process computes the SAME
+    # bounds (pure function of constants)
+    from cxxnet_tpu.obs.metrics import _log_spaced
+    assert TIME_BUCKETS == _log_spaced(1e-5, 100.0, 4)
+    assert list(TIME_BUCKETS) == sorted(TIME_BUCKETS)
+    with pytest.raises(ValueError):
+        Histogram(buckets=(1.0, 1.0, 2.0))
+    with pytest.raises(ValueError):
+        Histogram(buckets=(2.0, 1.0))
+
+
+def test_histogram_merge_equals_combined():
+    """The router property: merging replicas then asking for p95 equals
+    observing everything in one histogram."""
+    rs = np.random.RandomState(0)
+    xs = rs.exponential(0.01, 200)
+    ys = rs.exponential(0.10, 100)
+    a, b, both = Histogram(), Histogram(), Histogram()
+    for x in xs:
+        a.observe(x)
+        both.observe(x)
+    for y in ys:
+        b.observe(y)
+        both.observe(y)
+    a.merge(b)
+    assert a.count == both.count == 300
+    assert a.counts() == both.counts()
+    for q in (0.5, 0.95, 0.99):
+        assert a.percentile(q) == both.percentile(q)
+    with pytest.raises(ValueError):
+        a.merge(Histogram(buckets=(1.0, 2.0)))
+
+
+def test_histogram_percentile_bucket_resolution_and_empty():
+    h = Histogram()
+    assert h.percentile(0.5) == 0.0     # empty window -> 0, not NaN
+    h.observe(float("nan"))             # poison dropped
+    h.observe(float("inf"))
+    assert h.count == 0
+    for v in (0.001,) * 99 + (1.0,):
+        h.observe(v)
+    p50, p99 = h.percentile(0.50), h.percentile(0.995)
+    assert 0.001 <= p50 <= 0.002        # within one log-bucket
+    assert p99 >= 1.0
+
+
+def test_prometheus_exposition_schema():
+    r = Registry()
+    r.counter("cxn_x_total", "things done").inc(3)
+    r.gauge("cxn_g", "a level").set(1.5)
+    h = r.histogram("cxn_d_seconds", "latency")
+    h.observe(0.001)
+    h.observe(0.5)
+    lab = r.counter("cxn_l_total", "labeled", labelnames=("k",))
+    lab.labels("a").inc()
+    text = r.to_prometheus()
+    lines = text.strip().splitlines()
+    assert "# TYPE cxn_x_total counter" in lines
+    assert "# HELP cxn_x_total things done" in lines
+    assert "cxn_x_total 3" in lines
+    assert "# TYPE cxn_g gauge" in lines
+    assert "cxn_g 1.5" in lines
+    assert 'cxn_l_total{k="a"} 1' in lines
+    # histogram: cumulative buckets, +Inf == _count, sum present
+    buckets = [l for l in lines if l.startswith("cxn_d_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts)     # cumulative -> monotone
+    assert buckets[-1].startswith('cxn_d_seconds_bucket{le="+Inf"}')
+    assert counts[-1] == 2
+    assert any(l.startswith("cxn_d_seconds_sum ") for l in lines)
+    assert "cxn_d_seconds_count 2" in lines
+    snap = r.snapshot()
+    assert snap["cxn_x_total"] == 3
+    assert snap["cxn_d_seconds"]["count"] == 2
+    assert snap['cxn_l_total{k="a"}'] == 1
+
+
+# -------------------------------------------------------------- tracer
+def test_ring_eviction_bound_pinned():
+    tr = Tracer(capacity=16)
+    for i in range(100):
+        tr.add("s%d" % i, float(i), 1.0, TID_ENGINE)
+    assert len(tr) == 16                # memory bound holds
+    assert tr.dropped == 84
+    names = [s.name for s in tr.spans()]
+    assert names[0] == "s84" and names[-1] == "s99"   # newest retained
+    tr.clear()
+    assert len(tr) == 0 and tr.dropped == 0
+
+
+def test_zero_span_export_is_valid_json(tmp_path):
+    tr = Tracer()
+    doc = json.loads(json.dumps(tr.chrome_trace()))
+    assert doc["traceEvents"] == []
+    assert doc["otherData"]["format"] == "cxxnet_tpu.obs.trace/1"
+    path = tr.write_chrome(str(tmp_path / "empty.trace.json"))
+    with open(path) as f:
+        assert json.load(f)["traceEvents"] == []
+    assert tr.dump_jsonl(str(tmp_path / "empty.spans.jsonl")) == 0
+
+
+def _validate_chrome(doc):
+    """Chrome-trace JSON schema the satellite pins: every event is a
+    complete ("X") or metadata ("M") record with the fields Perfetto
+    needs, timestamps rebased near zero in microseconds."""
+    assert isinstance(doc["traceEvents"], list)
+    tids_meta = set()
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M"), ev
+        assert isinstance(ev["name"], str) and "pid" in ev and "tid" in ev
+        if ev["ph"] == "M":
+            assert ev["name"] == "thread_name"
+            tids_meta.add(ev["tid"])
+        else:
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert ev["cat"]
+    # every track that has spans is named
+    assert {e["tid"] for e in doc["traceEvents"]
+            if e["ph"] == "X"} <= tids_meta
+    return doc
+
+
+def test_chrome_trace_schema_and_track_names():
+    tr = Tracer()
+    t0 = time.perf_counter()
+    tr.add("decode_tick", t0, 0.001, TID_ENGINE, cat="serve",
+           args={"decoding": 2})
+    tr.add("queue_wait", t0, 0.002, request_tid(3), cat="serve")
+    doc = _validate_chrome(tr.chrome_trace())
+    names = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert names[TID_ENGINE] == "engine"
+    assert names[request_tid(3)] == "request 3"
+
+
+def test_sampling_knob_and_disabled_tracer():
+    tr = Tracer(sample=2)
+    assert tr.should_sample(0) and tr.should_sample(4)
+    assert not tr.should_sample(1) and not tr.should_sample(3)
+    tr.enabled = False
+    assert not tr.should_sample(0)
+    tr.add("x", 0.0, 1.0, TID_ENGINE)
+    with tr.span("y", TID_ENGINE):
+        pass
+    assert len(tr) == 0                 # disabled -> nothing recorded
+    tr.configure(enabled=True, capacity=4, sample=1)
+    for i in range(8):
+        tr.instant("s%d" % i, TID_ENGINE)
+    assert len(tr) == 4
+    tr.configure(capacity=2)            # resize keeps the newest
+    assert [s.name for s in tr.spans()] == ["s6", "s7"]
+
+
+def test_note_slow_exemplar(tmp_path, capfd):
+    tr = Tracer(slow_dir=str(tmp_path / "slow"))
+    assert tr.note_slow(5, "never recorded") is None
+    tid = request_tid(5)
+    t0 = time.perf_counter()
+    tr.add("queue_wait", t0, 0.01, tid, cat="serve")
+    tr.add("request", t0, 0.02, tid, cat="serve", args={"rid": 5})
+    doc = tr.note_slow(5, "ttft over threshold")
+    assert doc["otherData"]["slow_reason"] == "ttft over threshold"
+    assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 2
+    with open(tmp_path / "slow" / "slow-req-5.trace.json") as f:
+        _validate_chrome(json.load(f))
+    assert (5, "ttft over threshold", doc) in list(tr.exemplars)
+    assert "[WARN]" in capfd.readouterr().err
+
+
+# ---------------------------------------------------- profiler surface
+def test_log_levels(capfd):
+    profiler.log("plain line")
+    profiler.warn("scary line")
+    err = capfd.readouterr().err
+    lines = err.strip().splitlines()
+    assert len(lines) == 2
+    assert lines[0].startswith("[") and "plain line" in lines[0]
+    assert "[WARN]" not in lines[0]
+    assert "[WARN] scary line" in lines[1]
+    with pytest.raises(ValueError):
+        profiler.log("x", level="debug")
+
+
+def test_stepstats_observer_feeds_registry():
+    h = Registry().histogram("t_phase_seconds", labelnames=("phase",))
+    st = profiler.StepStats(
+        observer=lambda name, s: h.labels(name).observe(s))
+    st.record(profiler.QUEUE_WAIT, 0.002)
+    with st.phase(profiler.DECODE_TICK):
+        pass
+    st.end_step()
+    assert h.labels(profiler.QUEUE_WAIT).count == 1
+    assert h.labels(profiler.DECODE_TICK).count == 1
+    assert st.samples(profiler.QUEUE_WAIT) == [0.002]
+    assert st.samples("never_ran") == []
+
+
+# ------------------------------------------------------------- export
+def test_metrics_flusher_jsonl_and_clean_shutdown(tmp_path):
+    r = Registry()
+    c = r.counter("t_total")
+    path = str(tmp_path / "m.jsonl")
+    with pytest.raises(ValueError):
+        MetricsFlusher(r, path, interval_s=0)
+    with pytest.raises(OSError):        # fail fast on the caller's
+        MetricsFlusher(r, str(tmp_path / "no_dir" / "m.jsonl"),
+                       interval_s=0.05)  # thread, not one interval in
+    fl = MetricsFlusher(r, path, interval_s=0.05,
+                        extra=lambda: {"task": "test"})
+    assert any(t.name.startswith("cxn-obs-flusher")
+               for t in threading.enumerate())
+    c.inc(2)
+    deadline = time.time() + 5
+    while fl.flushes < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    fl.close()
+    fl.close()                          # idempotent
+    assert not any(t.name.startswith("cxn-obs-flusher")
+                   for t in threading.enumerate())
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) >= 2
+    for line in lines:
+        assert line["task"] == "test" and "ts" in line
+    assert lines[-1]["metrics"]["t_total"] == 2   # final flush ran
+
+
+def test_export_run_writes_all_three(tmp_path):
+    r = Registry()
+    r.counter("t_total").inc()
+    tr = Tracer()
+    tr.instant("x", TID_ENGINE)
+    prefix = str(tmp_path / "run")
+    paths = export_run(prefix, r, tr)
+    assert sorted(os.path.basename(p) for p in paths) == [
+        "run.prom", "run.spans.jsonl", "run.trace.json"]
+    with open(prefix + ".trace.json") as f:
+        _validate_chrome(json.load(f))
+    assert "t_total 1" in open(prefix + ".prom").read()
+    assert len(open(prefix + ".spans.jsonl").readlines()) == 1
+
+
+def test_cxn_trace_export_and_summary(tmp_path, capsys):
+    tr = Tracer()
+    t0 = time.perf_counter()
+    for rid, dur in ((0, 0.05), (1, 0.20), (2, 0.01)):
+        tid = request_tid(rid)
+        tr.add("queue_wait", t0, dur / 10, tid, cat="serve")
+        tr.add("request", t0, dur, tid, cat="serve",
+               args={"rid": rid, "status": "ok", "prompt_tokens": 4,
+                     "tokens": 8})
+    tr.add("decode_tick", t0, 0.002, TID_ENGINE, cat="serve")
+    raw = str(tmp_path / "run.spans.jsonl")
+    assert tr.dump_jsonl(raw) == 7
+    mod = _cxn_trace_mod()
+    out = str(tmp_path / "out.trace.json")
+    assert mod.main(["export", raw, "-o", out]) == 0
+    with open(out) as f:
+        doc = _validate_chrome(json.load(f))
+    assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 7
+    # idempotent: exporting the Chrome form passes through unchanged
+    assert mod.main(["export", out, "-o",
+                     str(tmp_path / "again.trace.json")]) == 0
+    capsys.readouterr()
+    assert mod.main(["summary", raw, "--top", "2"]) == 0
+    text = capsys.readouterr().out
+    assert "7 spans, 3 requests" in text
+    # top-2 slowest: rid 1 (200 ms) then rid 0 (50 ms); rid 2 cut
+    pos1, pos0 = text.find("200.0"), text.find("50.0")
+    assert 0 < pos1 < pos0 and "10.0" not in text.split("breakdown")[0]
+    assert "queue_wait" in text and "decode_tick" in text
+
+
+# ------------------------------------------- serving span-tree workload
+def _spans_by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+def test_scripted_workload_span_tree_deterministic(tmp_path):
+    """The satellite's scripted 3-request mixed workload: chunked
+    prefill (A), prefix hit (B, shares A's first 2 chunks), speculative
+    (C, repetitive prompt for the ngram drafter). Run sequentially so
+    the span tree per request is deterministic; every request must
+    leave one COMPLETE tree — queue_wait -> (prefix_restore) ->
+    prefill_chunk* -> decode -> (spec_verify) -> retire under a single
+    request root — and the Chrome export must validate."""
+    rs = np.random.RandomState(0)
+    a = rs.randint(0, CFG.vocab_size, (13,)).astype(np.int32)
+    b = np.concatenate([a[:8],
+                        rs.randint(0, CFG.vocab_size,
+                                   (5,)).astype(np.int32)])
+    c = np.asarray([1, 2, 3, 4] * 3, np.int32)       # ngram bait
+    tr = Tracer()
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         prefix_mb=8.0, spec_mode="ngram", spec_len=2,
+                         tracer=tr) as srv:
+        ha = srv.submit(a, max_tokens=5, spec_mode="off")
+        ra = srv.result(ha, timeout=300)
+        hb = srv.submit(b, max_tokens=4, spec_mode="off")
+        rb = srv.result(hb, timeout=300)
+        hc = srv.submit(c, max_tokens=6)
+        rc = srv.result(hc, timeout=300)
+        for r in (ra, rb, rc):
+            assert r.status == "ok", (r.status, r.error)
+        spec_forwards = srv.metrics()["spec_forwards"]
+    # shutdown joined the scheduler thread: the ring is final now
+    # (rids come from the handles — they are process-global, not 0/1/2)
+    ta = _spans_by_name(tr.spans_for_request(ha.rid))
+    tb = _spans_by_name(tr.spans_for_request(hb.rid))
+    tc = _spans_by_name(tr.spans_for_request(hc.rid))
+
+    # A: 13-token prompt, chunk 4 -> 4 chunk steps, no prefix to hit
+    assert len(ta["prefill_chunk"]) == 4
+    assert [s.args["start"] for s in ta["prefill_chunk"]] == [0, 4, 8, 12]
+    assert "prefix_restore" not in ta or \
+        ta["prefix_restore"][0].args["restored_tokens"] == 0
+    # B: A's retired row cached its chunks -> first 2 chunks restored,
+    # prefill resumes at token 8 (2 more chunk steps: 8..12, 12..13)
+    assert tb["prefix_restore"][0].args["restored_tokens"] == 8
+    assert [s.args["start"] for s in tb["prefill_chunk"]] == [8, 12]
+    # C: the drafter ran -> per-request verify spans with the accept
+    # counts the registry saw
+    assert spec_forwards > 0
+    assert len(tc["spec_verify"]) == spec_forwards
+    assert sum(s.args["drafted"] for s in tc["spec_verify"]) \
+        == srv.registry.snapshot()["cxn_serve_spec_drafted_total"]
+
+    for rid, t, req_prompt, res in ((ha.rid, ta, a, ra),
+                                    (hb.rid, tb, b, rb),
+                                    (hc.rid, tc, c, rc)):
+        root, = t["request"]
+        assert root.args["status"] == "ok" and root.args["rid"] == rid
+        assert root.args["prompt_tokens"] == len(req_prompt)
+        assert root.args["tokens"] == len(res.tokens) - len(req_prompt)
+        decode, = t["decode"]
+        assert decode.args["tokens"] == root.args["tokens"]
+        assert len(t["queue_wait"]) == 1 and len(t["retire"]) == 1
+        # time containment: every child lies inside the request root
+        # (the nesting Perfetto renders), modulo clock-read jitter
+        eps = 1e-4
+        for name, spans in t.items():
+            if name == "request":
+                continue
+            for s in spans:
+                assert s.ts >= root.ts - eps
+                assert s.ts + s.dur <= root.ts + root.dur + eps
+    # shared engine track: batched ticks + drafter passes, never
+    # per-request
+    eng = _spans_by_name(tr.spans(TID_ENGINE))
+    assert len(eng["decode_tick"]) > 0
+    assert len(eng["spec_draft"]) > 0
+    _validate_chrome(tr.chrome_trace())
+    # and the whole ring round-trips through the offline tool
+    raw = str(tmp_path / "wl.spans.jsonl")
+    tr.dump_jsonl(raw)
+    mod = _cxn_trace_mod()
+    assert mod.main(["export", raw]) == 0
+    # default out strips the .spans.jsonl suffix (no wl.spans.trace.json)
+    with open(str(tmp_path / "wl.trace.json")) as f:
+        _validate_chrome(json.load(f))
+
+
+def test_slow_request_exemplar_via_server(tmp_path):
+    """obs_slow_ms end to end: any served request outlasts a 0.001 ms
+    threshold, so its span tree is dumped at completion."""
+    tr = Tracer(slow_dir=str(tmp_path))
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         tracer=tr, slow_ms=0.001) as srv:
+        h = srv.submit(np.arange(5, dtype=np.int32), max_tokens=3)
+        assert srv.result(h, timeout=300).status == "ok"
+    assert tr.exemplars
+    rid, reason, doc = tr.exemplars[0]
+    # rids are process-global (span tracks must not collide across
+    # servers), so pin against the handle, not a literal
+    assert rid == h.rid and "over obs_slow_ms" in reason
+    with open(tmp_path / ("slow-req-%d.trace.json" % rid)) as f:
+        doc = _validate_chrome(json.load(f))
+    assert {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"} \
+        >= {"queue_wait", "decode", "retire", "request"}
+
+
+# ------------------------------------- overload accounting (satellite)
+def test_expired_request_contributes_queue_wait():
+    """A request that expires in the queue must still contribute its
+    full wait to the queue-wait distribution (and count as expired) —
+    otherwise overload reads as LOW queue-wait percentiles because only
+    the admitted survivors report."""
+    tr = Tracer()
+    with InferenceServer(CFG, PARAMS, slots=1, queue=8, prefill_chunk=4,
+                         tracer=tr, slow_ms=0.5) as srv:
+        hold = srv.submit(np.arange(4, dtype=np.int32), max_tokens=30)
+        doomed = srv.submit(np.arange(6, dtype=np.int32), max_tokens=2,
+                            timeout_ms=1.0)
+        res = srv.result(doomed, timeout=300)
+        assert res.status == "timeout" and "expired" in res.error
+        srv.result(hold, timeout=300)
+        snap = srv.registry.snapshot()
+        m = srv.metrics()
+    assert snap["cxn_serve_expired_total"] == 1
+    assert snap["cxn_serve_timeout_total"] == 1
+    assert m["requests"]["expired"] == 1
+    # its >= 1 ms wait landed in both the StepStats window and the
+    # registry histogram
+    assert m["queue_wait_ms"]["p99"] >= 1.0
+    h = snap['cxn_serve_phase_seconds{phase="queue_wait"}']
+    assert h["count"] >= 2 and h["sum"] >= 1e-3
+    # and it left a span tree: queue_wait + a terminal root marked
+    # expired, nothing else (it never got a slot)
+    t = _spans_by_name(tr.spans_for_request(doomed.rid))
+    assert set(t) == {"queue_wait", "request"}
+    assert t["request"][0].args["expired"] is True
+    assert t["queue_wait"][0].dur >= 1e-3
+    # the worst offenders must not dodge the slow-exemplar hook just
+    # because they expired in the queue instead of retiring from a slot
+    assert doomed.rid in {rid for rid, _, _ in tr.exemplars}
+
+
+def test_rejected_request_counted_with_zero_wait():
+    """A queue-FULL shed observes a ZERO queue-wait sample (turned away
+    at the door by load = shortest possible wait — dropping it would
+    bias the distribution the other way under overload), but a
+    bad-params rejection contributes NOTHING: it never interacted with
+    the queue, and a client spamming invalid requests must not flood
+    the wait histogram with zeros."""
+    from cxxnet_tpu.serve import QueueFullError
+    with InferenceServer(CFG, PARAMS, slots=1, queue=1,
+                         prefill_chunk=4, tracer=Tracer(enabled=False)) \
+            as srv:
+        with pytest.raises(AdmissionError):
+            srv.submit(np.zeros((0,), np.int32))     # bad params
+        h = srv.registry.snapshot()[
+            'cxn_serve_phase_seconds{phase="queue_wait"}']
+        assert h["count"] == 0                       # no sample
+        hold = srv.submit(np.arange(4, dtype=np.int32), max_tokens=30)
+        deadline = time.time() + 60
+        while srv.queue_depth() > 0 and time.time() < deadline:
+            time.sleep(0.005)       # wait for hold to occupy the slot
+        filler = srv.submit(np.arange(4, dtype=np.int32), max_tokens=2)
+        with pytest.raises(QueueFullError):
+            srv.submit(np.arange(4, dtype=np.int32), max_tokens=2)
+        snap = srv.registry.snapshot()
+        assert snap["cxn_serve_rejected_total"] == 2
+        h = snap['cxn_serve_phase_seconds{phase="queue_wait"}']
+        assert h["count"] >= 1 and h["p50"] <= TIME_BUCKETS[0]  # the shed
+        srv.result(hold, timeout=300)
+        srv.result(filler, timeout=300)
+
+
+# ------------------------------------------------- exposition coverage
+def test_metrics_text_covers_all_families():
+    """The acceptance catalog: one exposition carries serving,
+    prefix-cache, speculative, and recompile-guard metrics."""
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         prefix_mb=8.0, spec_mode="ngram", spec_len=2,
+                         recompile_limit=8, tracer=Tracer(enabled=False)) \
+            as srv:
+        h = srv.submit(np.asarray([1, 2, 3, 4] * 3, np.int32),
+                       max_tokens=5)
+        assert srv.result(h, timeout=300).status == "ok"
+        text = srv.metrics_text()
+    for name in ("cxn_serve_submitted_total", "cxn_serve_completed_total",
+                 "cxn_serve_expired_total", "cxn_serve_queue_depth",
+                 "cxn_serve_slot_occupancy", "cxn_serve_batch_efficiency",
+                 "cxn_serve_kv_cache_bytes", "cxn_serve_ttft_seconds",
+                 "cxn_serve_token_gap_seconds", "cxn_serve_phase_seconds",
+                 "cxn_prefix_hits_total", "cxn_prefix_evictions_total",
+                 "cxn_prefix_cache_bytes", "cxn_serve_spec_forwards_total",
+                 "cxn_serve_spec_accepted_total",
+                 "cxn_serve_spec_backoffs_total",
+                 "cxn_recompile_trips_total"):
+        assert "# TYPE %s " % name in text, name
+    assert 'cxn_recompile_trips_total{fn="serve_prefill"} 0' in text
+    assert 'cxn_recompile_trips_total{fn="serve_verify_chunk"} 0' in text
+    assert "cxn_serve_submitted_total 1" in text
+    assert "cxn_serve_completed_total 1" in text
+    # two servers get DISTINCT registries: gauges cannot fight
+    with InferenceServer(CFG, PARAMS, slots=1, queue=2, prefill_chunk=4,
+                         tracer=Tracer(enabled=False)) as other:
+        assert other.registry is not srv.registry
+        assert "cxn_serve_submitted_total 0" in other.metrics_text()
+
+
+def test_offline_speculative_records_engine_spans():
+    """gpt_decode(speculative=...) shows up on the engine track too:
+    the offline decoder mirrors the scheduler's shared-span
+    discipline."""
+    tr = get_tracer()
+    tr.clear()
+    prompt = np.asarray([[1, 2, 3, 4] * 3], np.int32)
+    stats = {}
+    out = gpt_decode(PARAMS, jax.numpy.asarray(prompt), 6, CFG,
+                     speculative={"mode": "ngram", "spec_len": 2,
+                                  "stats": stats})
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(gpt_decode(
+            PARAMS, jax.numpy.asarray(prompt), 6, CFG)))
+    eng = _spans_by_name(tr.spans(TID_ENGINE))
+    tr.clear()
+    assert stats["forwards"] > 0
+    assert len(eng.get("spec_verify", [])) == stats["forwards"]
+    assert len(eng.get("spec_draft", [])) > 0
+    assert len(eng.get("decode_tick", [])) == stats["ticks"]
+
+
+# ------------------------------------------------------------ CLI e2e
+def test_cli_serve_obs_export(tmp_path, capfd, monkeypatch):
+    """The acceptance run: task=serve with obs_trace=1 + obs_export
+    writes a Perfetto-loadable Chrome trace with one complete span tree
+    per request, periodic JSONL metric snapshots, and a final
+    Prometheus exposition covering the serving catalog."""
+    import io as _io
+
+    from cxxnet_tpu.cli import LearnTask
+    from cxxnet_tpu.models import gpt_lm_config
+
+    corpus = tmp_path / "corpus.bin"
+    corpus.write_bytes(np.tile(np.arange(16, dtype=np.uint16),
+                               40).tobytes())
+    conf = tmp_path / "gpt.conf"
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=8, dev="cpu:0", eta=0.2)
+    conf.write_text("""
+data = train
+iter = lm
+    path_data = "%s"
+    token_dtype = uint16
+    seq_len = 16
+    stride = 8
+iter = end
+%s
+num_round = 1
+save_model = 1
+model_dir = %s
+""" % (corpus, cfg, tmp_path / "models"))
+    assert LearnTask().run([str(conf)]) == 0
+    capfd.readouterr()
+    get_tracer().clear()                # only this run's spans below
+    prefix = str(tmp_path / "obs")
+    monkeypatch.setattr("sys.stdin",
+                        _io.StringIO("0 1 2 3\n4 5 6 7 8\n"))
+    assert LearnTask().run([
+        str(conf), "task=serve",
+        "model_in=%s" % (tmp_path / "models" / "0001.model"),
+        "num_gen=4", "serve_slots=2", "serve_queue=4",
+        "obs_trace=1", "obs_export=%s" % prefix,
+        "obs_export_interval_s=0.1"]) == 0
+    out, err = capfd.readouterr()
+    assert "obs: telemetry written to" in err
+    with open(prefix + ".trace.json") as f:
+        doc = _validate_chrome(json.load(f))
+    roots = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "request"]
+    assert len(roots) == 2              # one complete tree per request
+    for root in roots:
+        assert root["args"]["status"] == "ok"
+        tid = root["tid"]
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["tid"] == tid}
+        assert names >= {"queue_wait", "decode", "retire", "request"}
+        assert any(n.startswith("prefill") for n in names)
+    prom = open(prefix + ".prom").read()
+    assert "cxn_serve_completed_total 2" in prom
+    assert "cxn_serve_ttft_seconds_bucket" in prom
+    lines = [json.loads(l) for l in open(prefix + ".metrics.jsonl")]
+    assert lines and lines[-1]["task"] == "serve"
+    assert lines[-1]["metrics"]["cxn_serve_completed_total"] == 2
+    # tracer leaves no state behind for the next test
+    get_tracer().clear()

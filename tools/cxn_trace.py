@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""cxn-trace: offline tooling for obs span dumps (doc/observability.md).
+
+Subcommands:
+
+  export  <spans.jsonl> [-o out.trace.json]
+      Convert a raw span dump (``Tracer.dump_jsonl`` /
+      ``obs_export``'s ``<prefix>.spans.jsonl``) into Chrome-trace
+      JSON, loadable in Perfetto (https://ui.perfetto.dev) or
+      chrome://tracing. Already-converted Chrome JSON passes through
+      unchanged, so the command is idempotent.
+
+  summary <spans.jsonl | trace.json> [--top N]
+      Human triage without a trace viewer: the top-N slowest requests
+      (by the ``request`` root span) and a per-phase time breakdown
+      (count / total / mean / max per span name) from either file
+      format.
+
+The serve loop writes these files when ``obs_export = <prefix>`` is
+set; ``wrapper.Net.trace_export()`` produces the Chrome form directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from cxxnet_tpu.obs.trace import (REQ_TID_BASE,    # noqa: E402
+                                  spans_to_chrome)
+
+
+def load_spans(path: str):
+    """Either input format -> (spans, other_data): a flat span list of
+    {name, cat, ts, dur, tid, args} with ts/dur in SECONDS, plus the
+    source's ``otherData`` metadata (epoch, dropped-span count, slow
+    reason — empty for JSONL input, which carries none) so a re-export
+    can carry it through instead of erasing it."""
+    with open(path) as f:
+        text = f.read()
+    # sniff: a Chrome trace is ONE JSON document with traceEvents; a
+    # span dump is one JSON object PER LINE (whole-text parse fails on
+    # the second line)
+    doc = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict) and "traceEvents" in parsed:
+            doc = parsed
+    except json.JSONDecodeError:
+        pass
+    if doc is not None:
+        spans = []
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            spans.append({"name": ev["name"], "cat": ev.get("cat", ""),
+                          "ts": ev["ts"] / 1e6, "dur": ev["dur"] / 1e6,
+                          "tid": ev.get("tid", 0),
+                          "args": ev.get("args", {})})
+        other = {k: v for k, v in doc.get("otherData", {}).items()
+                 if k != "format"}       # spans_to_chrome re-stamps it
+        return spans, other
+    spans = []
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise SystemExit("%s:%d: not a span JSONL line (%s)"
+                             % (path, i + 1, e))
+        for k in ("name", "ts", "dur", "tid"):
+            if k not in rec:
+                raise SystemExit("%s:%d: span line missing %r"
+                                 % (path, i + 1, k))
+        rec.setdefault("cat", "")
+        rec.setdefault("args", {})
+        spans.append(rec)
+    return spans, {}
+
+
+def _default_out(path: str) -> str:
+    """<base>.trace.json with the known suffixes stripped first, so
+    exporting run.spans.jsonl gives run.trace.json and re-exporting
+    run.trace.json overwrites it in place (idempotent) instead of
+    scattering run.trace.trace.json."""
+    for suffix in (".spans.jsonl", ".trace.json"):
+        if path.endswith(suffix):
+            return path[:-len(suffix)] + ".trace.json"
+    return path.rsplit(".", 1)[0] + ".trace.json"
+
+
+def cmd_export(args) -> int:
+    spans, other = load_spans(args.file)
+    out = args.out or _default_out(args.file)
+    with open(out, "w") as f:
+        json.dump(spans_to_chrome(spans, other), f)
+    print("cxn-trace: %d spans -> %s (open in https://ui.perfetto.dev "
+          "or chrome://tracing)" % (len(spans), out))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    spans, _ = load_spans(args.file)
+    roots = [s for s in spans
+             if s["name"] == "request" and s["tid"] >= REQ_TID_BASE]
+    roots.sort(key=lambda s: -s["dur"])
+    print("%d spans, %d requests" % (len(spans), len(roots)))
+    if roots:
+        print("\nslowest %d requests:" % min(args.top, len(roots)))
+        print("  %-8s %10s %-9s %8s %8s" % ("rid", "total_ms", "status",
+                                            "prompt", "tokens"))
+        for s in roots[:args.top]:
+            a = s["args"]
+            print("  %-8s %10.1f %-9s %8s %8s"
+                  % (a.get("rid", s["tid"] - REQ_TID_BASE),
+                     s["dur"] * 1e3, a.get("status", "?"),
+                     a.get("prompt_tokens", "-"), a.get("tokens", "-")))
+    phases: Dict[str, List[float]] = {}
+    for s in spans:
+        if s["name"] != "request":
+            phases.setdefault(s["name"], []).append(s["dur"])
+    if phases:
+        print("\nper-phase breakdown:")
+        print("  %-16s %7s %12s %10s %10s" % ("phase", "count",
+                                              "total_ms", "mean_ms",
+                                              "max_ms"))
+        for name in sorted(phases, key=lambda n: -sum(phases[n])):
+            v = phases[name]
+            print("  %-16s %7d %12.1f %10.3f %10.3f"
+                  % (name, len(v), sum(v) * 1e3,
+                     sum(v) / len(v) * 1e3, max(v) * 1e3))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cxn-trace", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ex = sub.add_parser("export", help="span JSONL -> Chrome-trace JSON")
+    ex.add_argument("file")
+    ex.add_argument("-o", "--out", default="")
+    ex.set_defaults(fn=cmd_export)
+    sm = sub.add_parser("summary", help="top-N slowest requests + "
+                                        "per-phase breakdown")
+    sm.add_argument("file")
+    sm.add_argument("--top", type=int, default=10)
+    sm.set_defaults(fn=cmd_summary)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
