@@ -35,6 +35,8 @@ RULES = {
                         "number of times"),
     "CXN206": ("warning", "weak-typed step input (re-specializes against "
                           "strong-typed callers)"),
+    "CXN207": ("error", "AOT lower+compile time exceeds the pinned "
+                        "lint_compile_budget_s budget"),
 }
 
 

@@ -92,25 +92,43 @@ def format_step_info(info: Dict) -> str:
     task=lint, the CXN_LINT hook, and tools/cxn_lint.py all print this)."""
     cc = ", ".join("%s=%d" % (k, v)
                    for k, v in info["collectives"].items() if v)
-    return "%s: donated %d aliased %d collectives {%s}" % (
-        info["label"], info["donated"], info["aliased"], cc or "none")
+    return "%s: donated %d aliased %d collectives {%s} compile %.2fs" % (
+        info["label"], info["donated"], info["aliased"], cc or "none",
+        info.get("compile_s", 0.0))
 
 
 def audit_jit(fn, args: tuple, label: str,
               donate_argnums: Sequence[int] = (),
               static_argnums: Sequence[int] = (),
-              collective_budget: Optional[int] = None
+              collective_budget: Optional[int] = None,
+              compile_budget_s: Optional[float] = None
               ) -> Tuple[List[Finding], Dict]:
     """Audit one jitted function AOT. Returns (findings, info) where info
-    carries the raw counts ({"collectives", "donated", "aliased"})."""
+    carries the raw counts ({"collectives", "donated", "aliased"}) plus
+    the step's measured AOT lower+compile seconds ("compile_s") — the
+    compile-time baseline the AOT-executable-cache roadmap item needs,
+    gated in CI by ``compile_budget_s`` (CXN207) the same way
+    collective counts are by ``lint_collective_budget``."""
+    import time
     import warnings
     findings: List[Finding] = []
+    t0 = time.perf_counter()
     with warnings.catch_warnings(record=True) as wrec:
         warnings.simplefilter("always")
         lowered = fn.lower(*args)
-    stable = lowered.as_text()
+    lower_s = time.perf_counter() - t0
+    stable = lowered.as_text()      # text render excluded from the budget
+    t1 = time.perf_counter()
     compiled = lowered.compile()
+    compile_s = lower_s + time.perf_counter() - t1
     hlo = compiled.as_text()
+    if compile_budget_s is not None and compile_budget_s > 0 \
+            and compile_s > compile_budget_s:
+        findings.append(Finding(
+            "CXN207", "%s: AOT lower+compile took %.2fs, over the "
+            "pinned budget %gs (lint_compile_budget_s) — a compile-"
+            "time regression slows every cold start and CI run"
+            % (label, compile_s, compile_budget_s)))
 
     # ---- donation ---------------------------------------------------
     requested = _requested_donations(args, donate_argnums, static_argnums)
@@ -185,7 +203,8 @@ def audit_jit(fn, args: tuple, label: str,
                collective_budget)))
     info = {"label": label, "collectives": counts,
             "donated": requested,
-            "aliased": len(donors & compiled_aliased)}
+            "aliased": len(donors & compiled_aliased),
+            "compile_s": compile_s}
     return findings, info
 
 
@@ -234,19 +253,27 @@ def net_step_specs(net) -> List[Tuple[str, object, tuple, tuple, tuple]]:
     ]
 
 
-def audit_net(net, collective_budget: Optional[int] = None
+def audit_net(net, collective_budget: Optional[int] = None,
+              compile_budget_s: Optional[float] = None
               ) -> Tuple[LintReport, List[Dict]]:
-    """Audit all four Net jit steps; returns (report, per-step info)."""
+    """Audit all four Net jit steps; returns (report, per-step info).
+    Budgets default to the net's ``lint_collective_budget`` /
+    ``lint_compile_budget_s`` config keys (-1 / 0 = unbudgeted)."""
     report = LintReport()
     infos = []
     budget = collective_budget
     if budget is None:
         budget = getattr(net, "lint_collective_budget", -1)
         budget = budget if budget >= 0 else None
+    cbudget = compile_budget_s
+    if cbudget is None:
+        cbudget = getattr(net, "lint_compile_budget_s", 0.0)
+        cbudget = cbudget if cbudget > 0 else None
     for label, fn, args, donate, static in net_step_specs(net):
         findings, info = audit_jit(fn, args, label, donate_argnums=donate,
                                    static_argnums=static,
-                                   collective_budget=budget)
+                                   collective_budget=budget,
+                                   compile_budget_s=cbudget)
         report.extend(findings)
         infos.append(info)
     return report, infos
@@ -254,7 +281,8 @@ def audit_net(net, collective_budget: Optional[int] = None
 
 def audit_serve_engine(engine, n_prompt: int = 8,
                        collective_budget: Optional[int] = None,
-                       donate: Optional[bool] = None
+                       donate: Optional[bool] = None,
+                       compile_budget_s: Optional[float] = None
                        ) -> Tuple[LintReport, List[Dict]]:
     """Audit the serve engine's prefill (one representative prompt
     length), the chunk-prefill step (when the engine runs chunked —
@@ -272,7 +300,8 @@ def audit_serve_engine(engine, n_prompt: int = 8,
             n_prompt=n_prompt, donate=donate):
         findings, info = audit_jit(fn, args, label,
                                    donate_argnums=donate_nums,
-                                   collective_budget=collective_budget)
+                                   collective_budget=collective_budget,
+                                   compile_budget_s=compile_budget_s)
         report.extend(findings)
         infos.append(info)
     return report, infos

@@ -109,6 +109,17 @@ class LearnTask:
         #                           (raw spans), <prefix>.prom (final
         #                           exposition); empty = no files
         self.obs_export_interval_s = 10.0   # JSONL snapshot period
+        self.prof_every = 64      # device/compiler observatory cadence
+        #                           for task=serve: one blocking device-
+        #                           time sample per program per N
+        #                           executions (live MFU / bandwidth
+        #                           gauges; 0 = off). The TRAINER reads
+        #                           its own `prof_every` config key
+        #                           (default 0 — a sample costs the
+        #                           async feed a device sync).
+        self.prof_reps = 3        # task=prof: timed executions per
+        #                           program (best-of) for the roofline
+        #                           table's measured column
         self.net: Optional[Net] = None
         self.itr_train = None
         self._train_feed = None   # DevicePrefetcher over itr_train (async)
@@ -214,6 +225,10 @@ class LearnTask:
             self.obs_export = val
         elif name == "obs_export_interval_s":
             self.obs_export_interval_s = float(val)
+        elif name == "prof_every":
+            self.prof_every = int(val)
+        elif name == "prof_reps":
+            self.prof_reps = int(val)
         elif name == "output_format":
             self.output_format = 1 if val == "txt" else 0
         self.cfg.append((name, val))
@@ -289,6 +304,8 @@ class LearnTask:
                 self.task_extract()
             elif self.task == "generate":
                 self.task_generate()
+            elif self.task == "prof":
+                self.task_prof()
             else:
                 raise ValueError("unknown task %r" % self.task)
         return 0
@@ -407,7 +424,10 @@ class LearnTask:
                 return
             self.continue_training = 0
         if self.model_in == "NULL":
-            assert self.task == "train", "must specify model_in if not training"
+            # prof runs fine on random init: cost/memory/compile time
+            # are properties of the program, not the weights
+            assert self.task in ("train", "prof"), \
+                "must specify model_in if not training"
             self.net = Net(self._trainer_cfg())
             self.net.init_model()
         elif self.task == "finetune":
@@ -475,11 +495,12 @@ class LearnTask:
             # section config first, then globals — matching the reference's
             # CreateIterator-then-InitIter(defcfg) order (cxxnet_main.cpp:254-262)
             full = scfg + defcfg + extra
-            if sflag == 1 and self.task not in ("pred", "generate", "serve"):
+            if sflag == 1 and self.task not in ("pred", "generate", "serve",
+                                                "prof"):
                 assert self.itr_train is None, "can only have one data section"
                 self.itr_train = create_iterator(full)
             elif sflag == 2 and self.task not in ("pred", "generate",
-                                                  "serve"):
+                                                  "serve", "prof"):
                 self.itr_evals.append(create_iterator(full))
                 self.eval_names.append(sname)
             elif sflag == 3 and self.task in ("pred", "extract"):
@@ -796,6 +817,54 @@ class LearnTask:
             dnet.init_model()
         return net_gpt_export(dnet)
 
+    def task_prof(self) -> None:
+        """``task=prof``: the device & compiler observatory's offline
+        report (doc/observability.md, ``tools/cxn_prof.py`` is the CI
+        wrapper). Extracts the XLA cost/memory model of every compiled
+        program the config would run — the trainer's four jitted steps,
+        plus the serve engine's prefill-chunk / verify-chunk / tick for
+        GPT-shaped configs — times each AOT executable ``prof_reps``
+        times on zero-filled inputs, and prints the per-program
+        roofline table (FLOPs, bytes, arithmetic intensity, peak
+        memory, compile seconds, measured time, MFU, achieved-bandwidth
+        fraction) followed by the device-memory ledger and per-label
+        compile-time totals. The metric gauges land in the process
+        registry, so ``obs_export`` snapshots them like any task."""
+        from .obs import devprof
+        from .obs.metrics import default_registry
+        reg = default_registry()
+        table = devprof.profile_net(self.net, registry=reg,
+                                    time_reps=self.prof_reps)
+        from .utils.config import ConfigError
+        try:
+            from .nnet.lm import net_gpt_export
+            gcfg, gparams = net_gpt_export(self.net)
+        except ConfigError as e:
+            print("prof: serve programs skipped (not GPT-shaped: %s)" % e)
+        else:
+            from .serve.engine import DecodeEngine
+            # a real (2-slot) engine so the serve programs can be TIMED,
+            # not just costed; spec_len > 0 always — prof reports the
+            # verify program whether or not serving would arm it
+            eng = DecodeEngine(gcfg, gparams, slots=2,
+                               prefill_chunk=self.serve_prefill_chunk,
+                               spec_len=max(1, self.spec_len))
+            table.merge(devprof.profile_engine(
+                eng, registry=reg, time_reps=self.prof_reps))
+            eng.close()
+        print(table.format_roofline())
+        ledger = devprof.register_net_pools(self.net)
+        rec = ledger.reconcile()
+        print("device memory: " + ", ".join(
+            "%s %.1f MiB" % (k, v / (1 << 20))
+            for k, v in list(rec["pools"].items())
+            + [("live_total", rec["live_total"]),
+               ("unaccounted", rec["unaccounted"])]))
+        totals = devprof.compile_watch().totals
+        if totals:
+            print("compile seconds: " + ", ".join(
+                "%s %.2fs" % (k, v) for k, v in sorted(totals.items())))
+
     def task_serve(self) -> None:
         """Online serving: keep the model hot behind a request queue (the
         continuous-batching scheduler, doc/serving.md). Line-oriented
@@ -838,7 +907,8 @@ class LearnTask:
                               spec_mode=self.spec_mode,
                               spec_len=self.spec_len,
                               spec_model=self._spec_model_export(),
-                              slow_ms=self.obs_slow_ms)
+                              slow_ms=self.obs_slow_ms,
+                              prof_every=self.prof_every)
         if not self.silent:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (

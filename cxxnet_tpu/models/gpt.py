@@ -418,6 +418,21 @@ def gpt_init(key: jax.Array, cfg: GPTConfig) -> Dict:
     }
 
 
+def gpt_num_params(params: Dict) -> int:
+    """Total parameter count of a param tree (any pytree of arrays:
+    the functional GPT tree or a config-DSL ``Net.params``) — the N of
+    every 6*N-per-token FLOP estimate. bench.py's analytic MFU counts
+    through this one definition, so the analytic and cost-model MFU
+    lines are computed over the same model."""
+    total = 0
+    for w in jax.tree_util.tree_leaves(params):
+        n = 1
+        for d in w.shape:
+            n *= int(d)
+        total += n
+    return total
+
+
 def _with_data_axis(spec: P, shape, mesh: Mesh) -> P:
     """ZeRO placement: additionally shard the first free (unsharded,
     divisible) dim over ``data``. XLA all-gathers the tensor at its use
@@ -1075,8 +1090,19 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                     int8=bool(int8_weights and fused),
                     fold_head=fold_head, top_k=int(top_k),
                     top_p=float(top_p))
+
+    # compile-time accounting (obs/devprof.py): a first-call compile of
+    # any decode signature lands in cxn_compile_seconds{fn="gpt_decode"}
+    # — the per-signature compile storm the AOT-cache roadmap item
+    # wants measured is exactly this label's growth
+    from ..obs.devprof import compile_attribution
+
+    def _run(f):
+        with compile_attribution("gpt_decode"):
+            return f(params, prompt, rng)
+
     try:
-        return fn(params, prompt, rng)
+        return _run(fn)
     except Exception as e:                              # noqa: BLE001
         # the supported() VMEM estimate is approximate; a Mosaic scoped-
         # vmem compile OOM on a large shape degrades to the XLA scan
@@ -1104,7 +1130,7 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                             fold_head=False, top_k=int(top_k),
                             top_p=float(top_p))
             try:
-                return fn(params, prompt, rng)
+                return _run(fn)
             except Exception as e2:                     # noqa: BLE001
                 msg2 = str(e2).lower()
                 if "vmem" not in msg2 and not ("scoped" in msg2
@@ -1122,13 +1148,13 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
                         False, int8=False, fold_head=False,
                         top_k=int(top_k), top_p=float(top_p))
-        return fn(params, prompt, rng)
+        return _run(fn)
 
 
 def gpt_data_sharding(mesh: Mesh) -> NamedSharding:
     return batch_sharding(mesh)
 
 
-__all__ = ["GPTConfig", "gpt_init", "gpt_logits", "gpt_loss", "gpt_decode",
-           "gpt_opt_init", "make_train_step", "gpt_place",
-           "gpt_param_shardings", "gpt_opt_shardings"]
+__all__ = ["GPTConfig", "gpt_init", "gpt_num_params", "gpt_logits",
+           "gpt_loss", "gpt_decode", "gpt_opt_init", "make_train_step",
+           "gpt_place", "gpt_param_shardings", "gpt_opt_shardings"]

@@ -95,6 +95,15 @@ class Net:
         self.lint_recompile_limit = 0
         self.lint_recompile_strict = 1
         self.lint_collective_budget = -1
+        # per-step AOT compile-time budget for the compiled-step audit
+        # (CXN207; 0 = unbudgeted) — the compile-time regression gate
+        # tools/cxn_lint.py --compile enforces in CI
+        self.lint_compile_budget_s = 0.0
+        # device/compiler observatory (obs/devprof.py): one BLOCKING
+        # device-time sample per prof_every train steps publishing
+        # cxn_program_seconds / cxn_mfu gauges; 0 (default) keeps the
+        # async-dispatch hot loop completely sync-free
+        self.prof_every = 0
         self.train_metrics = MetricSet()
         self.eval_metrics = MetricSet()
         for k, v in g.defcfg:
@@ -162,6 +171,10 @@ class Net:
                 self.lint_recompile_strict = int(v)
             elif k == "lint_collective_budget":
                 self.lint_collective_budget = int(v)
+            elif k == "lint_compile_budget_s":
+                self.lint_compile_budget_s = float(v)
+            elif k == "prof_every":
+                self.prof_every = int(v)
             elif k.startswith("metric"):
                 self.train_metrics.configure(k, v)
                 self.eval_metrics.configure(k, v)
@@ -332,6 +345,21 @@ class Net:
         from ..obs.metrics import default_registry
         self._obs_steps = default_registry().counter(
             "cxn_train_steps_total", "jitted train steps dispatched")
+        # device/compiler observatory (obs/devprof.py): the process
+        # registry is a compile-accounting sink — every compile this
+        # net triggers lands in cxn_compile_seconds{fn=net_update|...}
+        # — and `prof_every` arms the cadence-gated step sampler. Its
+        # MFU gauges stay silent until a cost table exists
+        # (devprof.profile_net / task=prof fills it; extracting one
+        # here would double every startup compile unasked).
+        from ..obs import devprof
+        devprof.compile_watch().add_sink(default_registry())
+        self._prof_sampler = None
+        self._cost_table = getattr(self, "_cost_table", None)
+        if self.prof_every > 0:
+            self._prof_sampler = devprof.LiveSampler(
+                default_registry(), cadence=self.prof_every,
+                table=self._cost_table)
         if self.lint_recompile_limit > 0:
             # cxn-lint recompilation guard: each hot step errors when its
             # abstract input signature changes more than N times — the
@@ -429,6 +457,11 @@ class Net:
                 self.gsum, opt_sh if self.shard_optimizer >= 2 else param_sh)
         self._reset_train_accum()
         self.metric_sync_count = 0      # train-metric device->host folds
+        # device-memory ledger pools (obs/devprof.py): params/opt_state
+        # predicted bytes as collection-time callbacks in the process
+        # registry — a rebuilt or second Net rebinds them (latest wins)
+        from ..obs import devprof
+        devprof.register_net_pools(self)
 
     def _reset_train_accum(self) -> None:
         """Fresh on-device (sum, count) train-metric accumulators — one
@@ -781,19 +814,37 @@ class Net:
         rng = jax.random.fold_in(self._rng, self.epoch_counter)
         epoch = jnp.asarray(self.epoch_counter, jnp.int32)
         self.sample_counter += 1
+        from ..obs import devprof
+        prof = self._prof_sampler
         if self.update_period == 1:
-            (self.params, self.opt_state, self.states, self._train_accum,
-             loss, mouts) = self._jit_update(
-                 self.params, self.opt_state, self.states, self._train_accum,
-                 db.data, db.extras, db.label, db.mask, rng, epoch)
+            t0 = prof.begin("net_update") if prof is not None else None
+            with devprof.compile_attribution("net_update"):
+                (self.params, self.opt_state, self.states,
+                 self._train_accum, loss, mouts) = self._jit_update(
+                     self.params, self.opt_state, self.states,
+                     self._train_accum, db.data, db.extras, db.label,
+                     db.mask, rng, epoch)
+            if t0 is not None:
+                # the one sampled step pays the device sync the async
+                # hot loop otherwise never does — that IS the sample
+                jax.block_until_ready(loss)
+                prof.end("net_update", t0)
         else:
-            (self.gsum, self.states, self._train_accum, loss,
-             mouts) = self._jit_accum(
-                 self.gsum, self.params, self.states, self._train_accum,
-                 db.data, db.extras, db.label, db.mask, rng, epoch)
+            t0 = prof.begin("net_accum") if prof is not None else None
+            with devprof.compile_attribution("net_accum"):
+                (self.gsum, self.states, self._train_accum, loss,
+                 mouts) = self._jit_accum(
+                     self.gsum, self.params, self.states,
+                     self._train_accum, db.data, db.extras, db.label,
+                     db.mask, rng, epoch)
+            if t0 is not None:
+                jax.block_until_ready(loss)
+                prof.end("net_accum", t0)
             if self.sample_counter % self.update_period == 0:
-                self.params, self.opt_state, self.gsum = self._jit_apply(
-                    self.params, self.opt_state, self.gsum, epoch)
+                with devprof.compile_attribution("net_apply"):
+                    (self.params, self.opt_state,
+                     self.gsum) = self._jit_apply(
+                        self.params, self.opt_state, self.gsum, epoch)
         self.epoch_counter += 1
         self._obs_steps.inc()
         if self._metric_mode == "host":

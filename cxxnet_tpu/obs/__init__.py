@@ -1,22 +1,29 @@
 """Observability subsystem (doc/observability.md): request-scoped span
 tracing with Chrome-trace export (obs/trace.py), the unified
 Counter/Gauge/Histogram metrics registry with Prometheus text
-exposition (obs/metrics.py), and the export plumbing — periodic JSONL
-snapshots plus end-of-task dumps (obs/export.py).
+exposition (obs/metrics.py), the export plumbing — periodic JSONL
+snapshots plus end-of-task dumps (obs/export.py) — and the device &
+compiler observatory (obs/devprof.py: per-program XLA cost/memory
+model, live MFU/bandwidth sampling, the device-memory ledger, and
+compile-time accounting; imported as a submodule —
+``from cxxnet_tpu.obs import devprof`` — so the base package stays
+light).
 
 Surfaces: CLI ``obs_trace`` / ``obs_trace_buffer`` / ``obs_slow_ms`` /
-``obs_export`` / ``obs_export_interval_s`` keys (doc/config.md),
-``wrapper.Net.trace_export()`` / ``wrapper.Net.metrics_text()``, and
-``tools/cxn_trace.py export|summary`` for offline trace files.
+``obs_export`` / ``obs_export_interval_s`` / ``prof_every`` /
+``prof_reps`` keys (doc/config.md), ``task=prof``,
+``wrapper.Net.trace_export()`` / ``metrics_text()`` / ``profile()``,
+``tools/cxn_trace.py export|summary`` for offline trace files, and
+``tools/cxn_prof.py`` for the roofline report + bench regression gate.
 """
 
-from .metrics import (Counter, Gauge, Histogram, Registry, TIME_BUCKETS,
-                      default_registry)
+from .metrics import (BYTES_BUCKETS, Counter, Gauge, Histogram, Registry,
+                      TIME_BUCKETS, default_registry)
 from .trace import (REQ_TID_BASE, TID_ENGINE, TID_TRAIN, Span, Tracer,
                     configure, get_tracer, request_tid)
 from .export import MetricsFlusher, export_run
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
-           "default_registry", "Span", "Tracer", "configure",
-           "get_tracer", "request_tid", "TID_ENGINE", "TID_TRAIN",
-           "REQ_TID_BASE", "MetricsFlusher", "export_run"]
+           "BYTES_BUCKETS", "default_registry", "Span", "Tracer",
+           "configure", "get_tracer", "request_tid", "TID_ENGINE",
+           "TID_TRAIN", "REQ_TID_BASE", "MetricsFlusher", "export_run"]

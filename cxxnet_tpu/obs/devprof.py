@@ -1,0 +1,831 @@
+"""Device & compiler observatory: per-program cost/memory model, live
+MFU sampling, the device-memory ledger, and compile-time accounting.
+
+The request tracer (obs/trace.py) answers "where did this request's
+wall-clock go"; nothing before this module answered "what is the DEVICE
+doing". Every jitted program the repo runs — the trainer's four steps
+(``net_update`` / ``net_accum`` / ``net_apply`` / ``net_forward``) and
+the serve engine's programs (``serve_prefill`` / ``serve_prefill_chunk``
+/ ``serve_verify_chunk`` / ``serve_tick``) — is a fixed executable with
+knowable FLOPs, bytes moved, and peak memory, all sitting in XLA's own
+``cost_analysis()`` / ``memory_analysis()``. This module extracts them
+through the same AOT path the compiled-step audit uses
+(``fn.lower(...).compile()`` on the abstract specs of
+``analysis/step_audit.py:net_step_specs`` and
+``DecodeEngine.lint_specs``, which previously threw the compiled object
+away) and turns them into four observables:
+
+* **static cost table** (:class:`CostTable`) — per program: FLOPs, HBM
+  bytes accessed, peak / argument / output / temp memory, compile
+  seconds, keyed by program name + abstract signature. Published as
+  ``cxn_program_flops{fn=}`` / ``cxn_program_bytes_accessed{fn=}`` /
+  ``cxn_program_peak_bytes{fn=}`` gauges and rendered as a roofline
+  table (``task=prof`` / ``tools/cxn_prof.py``).
+* **live per-program timing** (:class:`LiveSampler`) — ONE blocking
+  device-time sample every ``prof_every`` executions (the hot path is
+  otherwise untouched: a non-sampled call costs one dict increment).
+  Each sample lands in the ``cxn_program_seconds{fn=}`` histogram and
+  refreshes ``cxn_mfu{fn=}`` and ``cxn_achieved_bw_frac{fn=}`` against
+  the hardware peaks of :func:`hw_peaks` — the ONE source of truth
+  bench.py's MFU lines now read instead of a hand-pinned constant.
+* **device-memory ledger** (:class:`DeviceLedger`) —
+  ``cxn_device_bytes{pool=params|opt_state|kv_slots|prefix_cache|
+  spec_draft}`` callback gauges reconciling the pools' PREDICTED sizes
+  against the measured ``jax.live_arrays()`` total (``pool=live_total``
+  / ``pool=unaccounted``): the memory-headroom signal the paged-KV and
+  sharded-serving roadmap items need per row / per replica.
+* **compile-time accounting** (:class:`CompileWatch`) — a
+  ``jax.monitoring`` duration listener summing every
+  ``/jax/core/compile/*`` event into ``cxn_compile_seconds{fn=}``
+  (attributed to the program being dispatched via a thread-local
+  label) plus one ``compile`` span per backend compile on the engine
+  trace track — so AOT-executable-cache wins (ROADMAP item 4) are
+  measurable before that cache exists.
+
+Availability: ``cost_analysis``/``memory_analysis`` support varies by
+backend and jax version. Extraction NEVER raises for that — a program
+whose analyses are missing gets ``available=False`` with an
+"unavailable on this backend" note, the roofline table prints the note,
+and the gauges for that program are simply absent (the guarded path is
+pinned on CPU by tests/test_devprof.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["HWPeaks", "hw_peaks", "ProgramCost", "CostTable",
+           "profile_net", "profile_engine", "LiveSampler", "DeviceLedger",
+           "CompileWatch", "compile_watch", "compile_attribution",
+           "tree_nbytes", "register_net_pools", "DEFAULT_PROF_EVERY"]
+
+# default live-sampling cadence (task=serve's `prof_every`): one blocked
+# sample per program per 64 executions — under 2% of executions even if
+# every sample cost a full extra step, and in practice far less (the
+# tick already syncs per call, so its sample adds only bookkeeping)
+DEFAULT_PROF_EVERY = 64
+
+HWPeaks = collections.namedtuple("HWPeaks", ["flops", "bytes_per_s",
+                                             "source"])
+
+# device_kind substring -> (peak bf16 matmul FLOP/s, HBM bytes/s) for
+# one chip. v5e is the bench rig's chip and the historical denominator
+# of every recorded MFU (bench.py rounds 4-10), so it is also the
+# fallback for unknown kinds — an unknown backend keeps the trajectory
+# comparable instead of dividing by a made-up number.
+_PEAKS_BY_KIND = (
+    ("v5 lite", (197e12, 819e9)),
+    ("v5e", (197e12, 819e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v6", (918e12, 1640e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v3", (123e12, 900e9)),
+    ("v2", (45e12, 700e9)),
+)
+_FALLBACK_PEAKS = (197e12, 819e9)
+
+
+def hw_peaks(flops: float = 0.0, bytes_per_s: float = 0.0) -> HWPeaks:
+    """Peak FLOP/s + HBM bytes/s of ONE local device — the denominator
+    of every MFU / achieved-bandwidth fraction this module publishes
+    (bench.py imports this instead of pinning its own constant).
+    Explicit arguments win, then the ``CXN_PEAK_FLOPS`` /
+    ``CXN_PEAK_BW`` environment overrides, then the device-kind table;
+    an unrecognized kind (CPU included) falls back to the v5e numbers
+    with ``source`` saying so — the absolute MFU is then meaningless
+    but still monotone in achieved throughput, which is what the
+    regression gate compares."""
+    env_f = float(os.environ.get("CXN_PEAK_FLOPS", "0") or 0)
+    env_b = float(os.environ.get("CXN_PEAK_BW", "0") or 0)
+    f = flops or env_f
+    b = bytes_per_s or env_b
+    if f and b:
+        return HWPeaks(f, b, "explicit")
+    kind = ""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:               # no backend at all: stay importable
+        pass
+    for sub, (kf, kb) in _PEAKS_BY_KIND:
+        if sub in kind.lower():
+            return HWPeaks(f or kf, b or kb, "device_kind:%s" % kind)
+    df, db = _FALLBACK_PEAKS
+    return HWPeaks(f or df, b or db,
+                   "assumed:v5e (device_kind %r unrecognized)"
+                   % (kind or "none"))
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree; ShapeDtypeStruct
+    leaves count their would-be size (so abstract engines predict the
+    same ledger numbers their real twins measure)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        total += int(n)
+    return total
+
+
+# --------------------------------------------------------------- cost table
+@dataclasses.dataclass
+class ProgramCost:
+    """One compiled program's static cost/memory row. ``-1.0`` means
+    "the backend did not report this field"; ``available`` is False only
+    when NEITHER analysis yielded anything (the guarded path)."""
+    name: str
+    signature: str = ""
+    flops: float = -1.0
+    bytes_accessed: float = -1.0
+    argument_bytes: float = -1.0
+    output_bytes: float = -1.0
+    temp_bytes: float = -1.0
+    alias_bytes: float = -1.0
+    generated_code_bytes: float = -1.0
+    peak_bytes: float = -1.0
+    compile_s: float = 0.0
+    measured_s: float = 0.0         # best timed execution (0 = untimed)
+    available: bool = True
+    # the label covers MANY compiled programs (the legacy whole-prompt
+    # prefill: one per prompt length) — this row describes one
+    # representative shape, so live samples must not divide its FLOPs
+    # by another shape's time (LiveSampler skips the MFU/bw gauges)
+    variable_shape: bool = False
+    note: str = ""
+
+    def arithmetic_intensity(self) -> float:
+        """FLOPs per HBM byte — the roofline x-axis."""
+        if self.flops > 0 and self.bytes_accessed > 0:
+            return self.flops / self.bytes_accessed
+        return 0.0
+
+    def mfu(self, dt: float, peaks: HWPeaks) -> float:
+        return self.flops / dt / peaks.flops \
+            if self.flops > 0 and dt > 0 else 0.0
+
+    def bw_frac(self, dt: float, peaks: HWPeaks) -> float:
+        return self.bytes_accessed / dt / peaks.bytes_per_s \
+            if self.bytes_accessed > 0 and dt > 0 else 0.0
+
+
+def _cost_from_compiled(name: str, compiled,
+                        signature: str = "") -> ProgramCost:
+    """Guarded extraction of cost_analysis()/memory_analysis() from an
+    XLA compiled executable. Never raises: a backend without either
+    analysis yields an ``available=False`` row whose note names what
+    was missing (the "unavailable on this backend" contract)."""
+    pc = ProgramCost(name=name, signature=signature)
+    notes = []
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):       # one dict per device
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        pc.flops = float(ca.get("flops", -1.0))
+        pc.bytes_accessed = float(ca.get("bytes accessed", -1.0))
+        if not ca:
+            notes.append("cost_analysis empty")
+    except Exception as e:                      # noqa: BLE001
+        notes.append("cost_analysis unavailable on this backend (%s)"
+                     % (type(e).__name__,))
+    try:
+        ma = compiled.memory_analysis()
+        pc.argument_bytes = float(ma.argument_size_in_bytes)
+        pc.output_bytes = float(ma.output_size_in_bytes)
+        pc.temp_bytes = float(ma.temp_size_in_bytes)
+        pc.alias_bytes = float(ma.alias_size_in_bytes)
+        pc.generated_code_bytes = float(ma.generated_code_size_in_bytes)
+        # peak device footprint while the program runs: everything it
+        # must hold at once, minus the donated (aliased) overlap. This
+        # is the number the KV-slot / replica headroom math subtracts
+        # from HBM capacity.
+        pc.peak_bytes = max(0.0, pc.argument_bytes + pc.output_bytes
+                            + pc.temp_bytes - pc.alias_bytes)
+    except Exception as e:                      # noqa: BLE001
+        notes.append("memory_analysis unavailable on this backend (%s)"
+                     % (type(e).__name__,))
+    pc.available = pc.flops >= 0 or pc.peak_bytes >= 0
+    pc.note = "; ".join(notes)
+    return pc
+
+
+def _fmt_qty(v: float, unit: str = "") -> str:
+    """Engineering-notation cell for the roofline table (1.23G, 45.6M)."""
+    if v < 0:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if v >= scale:
+            return "%.2f%s%s" % (v / scale, suffix, unit)
+    return "%.0f%s" % (v, unit)
+
+
+class CostTable:
+    """Ordered {program name -> :class:`ProgramCost`} plus the hardware
+    peaks it is read against. The single renderer for the roofline
+    table — ``task=prof``, ``tools/cxn_prof.py`` and tests all print
+    through :meth:`format_roofline`, so the surfaces cannot drift."""
+
+    def __init__(self, peaks: Optional[HWPeaks] = None):
+        self.peaks = peaks or hw_peaks()
+        self.programs: Dict[str, ProgramCost] = {}
+
+    def add(self, pc: ProgramCost) -> ProgramCost:
+        self.programs[pc.name] = pc
+        return pc
+
+    def get(self, name: str) -> Optional[ProgramCost]:
+        return self.programs.get(name)
+
+    def names(self) -> List[str]:
+        return list(self.programs)
+
+    def __len__(self) -> int:
+        return len(self.programs)
+
+    def merge(self, other: "CostTable") -> "CostTable":
+        for pc in other.programs.values():
+            self.add(pc)
+        return self
+
+    def publish(self, registry) -> None:
+        """Static per-program gauges into an obs registry (the catalog
+        rows of doc/observability.md). Unavailable fields publish
+        nothing — an absent series is honest, a 0 or -1 is not."""
+        flops = registry.gauge("cxn_program_flops",
+                               "XLA cost-model FLOPs per execution",
+                               labelnames=("fn",))
+        byts = registry.gauge("cxn_program_bytes_accessed",
+                              "XLA cost-model HBM bytes per execution",
+                              labelnames=("fn",))
+        peak = registry.gauge("cxn_program_peak_bytes",
+                              "peak device bytes while the program runs "
+                              "(arg + output + temp - aliased)",
+                              labelnames=("fn",))
+        comp = registry.gauge("cxn_program_compile_seconds",
+                              "AOT lower+compile seconds of the cost-"
+                              "table extraction", labelnames=("fn",))
+        for pc in self.programs.values():
+            if pc.flops >= 0:
+                flops.labels(pc.name).set(pc.flops)
+            if pc.bytes_accessed >= 0:
+                byts.labels(pc.name).set(pc.bytes_accessed)
+            if pc.peak_bytes >= 0:
+                peak.labels(pc.name).set(pc.peak_bytes)
+            comp.labels(pc.name).set(pc.compile_s)
+
+    def rows(self) -> List[Dict]:
+        out = []
+        for pc in self.programs.values():
+            out.append({
+                "fn": pc.name, "flops": pc.flops,
+                "bytes": pc.bytes_accessed,
+                "intensity": pc.arithmetic_intensity(),
+                "peak_bytes": pc.peak_bytes,
+                "compile_s": pc.compile_s,
+                "measured_ms": pc.measured_s * 1e3,
+                "mfu": pc.mfu(pc.measured_s, self.peaks),
+                "bw_frac": pc.bw_frac(pc.measured_s, self.peaks),
+                "available": pc.available, "note": pc.note,
+            })
+        return out
+
+    def format_roofline(self) -> str:
+        """The per-program roofline table: FLOPs, bytes, arithmetic
+        intensity, peak memory, compile time, measured time, MFU and
+        achieved-bandwidth fraction (the last three only for timed
+        rows)."""
+        lines = ["peaks: %s FLOP/s, %s/s HBM (%s)"
+                 % (_fmt_qty(self.peaks.flops),
+                    _fmt_qty(self.peaks.bytes_per_s, "B"),
+                    self.peaks.source),
+                 "%-20s %10s %10s %8s %10s %9s %11s %7s %7s"
+                 % ("program", "flops", "bytes", "flop/B", "peak_mem",
+                    "compile", "measured", "mfu", "bw")]
+        for r in self.rows():
+            pc = self.programs[r["fn"]]
+            if not pc.available:
+                lines.append("%-20s %s" % (r["fn"], pc.note
+                                           or "unavailable"))
+                continue
+            def pct(v):
+                # CPU runs against TPU peaks sit far below 0.01%; an
+                # adaptive format keeps them readable instead of 0.00%
+                return "%.2f%%" % (100 * v) if v >= 1e-4 \
+                    else "%.1e" % v
+            ms = "%.3fms" % r["measured_ms"] if r["measured_ms"] > 0 \
+                else "-"
+            mfu = pct(r["mfu"]) if r["measured_ms"] > 0 \
+                and r["flops"] > 0 else "-"
+            bw = pct(r["bw_frac"]) \
+                if r["measured_ms"] > 0 and r["bytes"] > 0 else "-"
+            lines.append(
+                "%-20s %10s %10s %8.1f %10s %8.2fs %11s %7s %7s"
+                % (r["fn"], _fmt_qty(r["flops"]),
+                   _fmt_qty(r["bytes"], "B"), r["intensity"],
+                   _fmt_qty(r["peak_bytes"], "B"), r["compile_s"], ms,
+                   mfu, bw))
+            if pc.note:
+                lines.append("%-20s   (%s)" % ("", pc.note))
+        return "\n".join(lines)
+
+
+# process-wide extraction cache: AOT lower+compile of the SAME program
+# at the SAME abstract signature yields the same cost row, and a server
+# restarting (or a test building many servers over one config) must not
+# pay XLA again for a number that cannot have changed. Program identity
+# is the jit OBJECT itself (held by weakref, id-checked): two different
+# programs can share a label and arg shapes — a remat=1 net's update
+# step, a different-n_head engine's tick with identical fused weight
+# shapes — so (label, signature) alone would alias their rows. The
+# engine's module-level lru_cached program constructors return one
+# stable object per config, which is exactly the restart case the
+# cache exists for; a rebuilt Net gets fresh jit objects and honestly
+# re-extracts.
+_COST_CACHE: Dict[tuple, tuple] = {}        # key -> (weakref(fn), row)
+_COST_CACHE_LOCK = threading.Lock()
+
+
+def _signature_of(args) -> tuple:
+    from ..analysis.recompile import abstract_signature
+    return abstract_signature(tuple(args))
+
+
+def extract_program(fn, args, label: str,
+                    use_cache: bool = True) -> Tuple[ProgramCost, object]:
+    """AOT lower+compile ``fn`` at ``args`` and extract its cost row.
+    Returns ``(cost, compiled)``; ``compiled`` is None on a cache hit
+    (the executable is only rebuilt when a caller needs to RUN it —
+    pass ``use_cache=False`` to force one). Compile time is recorded in
+    the row and attributed to the ``devprof`` label in the compile
+    accounting (it is observatory overhead, not the run's own compile
+    cost)."""
+    import weakref
+    sig = _signature_of(args)
+    key = (label, id(fn), sig)
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:               # unweakrefable wrapper: no caching
+        ref = None
+    if use_cache and ref is not None:
+        with _COST_CACHE_LOCK:
+            hit = _COST_CACHE.get(key)
+        # valid only while the SAME fn object is alive — a dead object
+        # whose id was recycled must not serve another program's row
+        if hit is not None and hit[0]() is fn:
+            return dataclasses.replace(hit[1]), None
+    t0 = time.perf_counter()
+    with compile_attribution("devprof"):
+        lowered = fn.lower(*args)
+        compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    pc = _cost_from_compiled(label, compiled, signature=str(hash(sig)))
+    pc.compile_s = compile_s
+    if ref is not None:
+        with _COST_CACHE_LOCK:
+            # prune rows whose program died (their ids may be recycled)
+            for k in [k for k, (r, _) in _COST_CACHE.items()
+                      if r() is None]:
+                del _COST_CACHE[k]
+            _COST_CACHE[key] = (ref, dataclasses.replace(pc))
+    return pc, compiled
+
+
+def _materialize(args, static_argnums=()):
+    """Concrete zero-filled twins of abstract/real args (static argnums
+    dropped — an AOT executable is called without them). Real arrays
+    are replaced by fresh zeros too: a donating executable DELETES its
+    donated input buffers on every backend, so the caller's live params
+    or KV pool must never be handed to a timing run."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if x is None:
+            return None
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        z = jnp.zeros(tuple(shape), dtype)
+        # match the executable's expected input shardings exactly: an
+        # AOT compiled call rejects arrays on the wrong placement (the
+        # specs carry real mesh shardings — step_audit.net_step_specs)
+        sh = getattr(x, "sharding", None)
+        if sh is not None:
+            z = jax.device_put(z, sh)
+        return z
+
+    return [jax.tree_util.tree_map(leaf, a)
+            for i, a in enumerate(args) if i not in static_argnums]
+
+
+def _time_compiled(compiled, margs, reps: int,
+                   feedback: Optional[Dict[int, int]] = None) -> float:
+    """Best-of-``reps`` wall seconds for one execution of an AOT
+    compiled program (one warm-up first). ``feedback`` maps output
+    index -> argument index for donated buffers — the executable
+    deletes those inputs, so each rep feeds the matching outputs back
+    (run_steps' idiom, generalized)."""
+    import jax
+
+    def run():
+        out = compiled(*margs)
+        jax.block_until_ready(out)
+        if feedback:
+            for oi, ai in feedback.items():
+                margs[ai] = out[oi]
+        return out
+
+    run()                                   # warm-up / lazy init
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# output index -> donated argument index of the trainer steps (from
+# Net._compile_steps' donate_argnums and the step return layouts) —
+# what lets the timing loop re-feed donated buffers
+_NET_FEEDBACK = {
+    "net_update": {0: 0, 1: 1, 2: 2, 3: 3},
+    "net_accum": {0: 0, 2: 3},
+    "net_apply": {0: 0, 1: 1, 2: 2},
+    "net_forward": None,
+}
+
+
+def profile_net(net, registry=None, time_reps: int = 0) -> CostTable:
+    """Cost table for the trainer's four jitted steps, from the same
+    real-mesh-sharded abstract specs the compiled-step audit uses.
+    ``time_reps > 0`` also RUNS each AOT executable on zero-filled
+    inputs (best-of-reps, donated buffers fed back) and fills
+    ``measured_s`` -> the roofline MFU columns. Publishes into
+    ``registry`` when given, and hands the table to the net's live
+    sampler (if armed) so ``cxn_mfu{fn=net_*}`` gauges have FLOPs."""
+    from ..analysis.step_audit import net_step_specs
+    table = CostTable()
+    for label, fn, args, _donate, static in net_step_specs(net):
+        pc, compiled = extract_program(fn, args, label,
+                                       use_cache=time_reps == 0)
+        if time_reps > 0:
+            if compiled is None:
+                _, compiled = extract_program(fn, args, label,
+                                              use_cache=False)
+            margs = _materialize(args, static_argnums=static)
+            pc.measured_s = _time_compiled(compiled, margs, time_reps,
+                                           _NET_FEEDBACK.get(label))
+        table.add(pc)
+    if registry is not None:
+        table.publish(registry)
+    net._cost_table = table
+    sampler = getattr(net, "_prof_sampler", None)
+    if sampler is not None and sampler.table is None:
+        sampler.table = table
+    return table
+
+
+def profile_engine(engine, registry=None, time_reps: int = 0,
+                   n_prompt: int = 8) -> CostTable:
+    """Cost table for the serve engine's compiled programs
+    (``DecodeEngine.lint_specs`` rows: legacy prefill, the chunk-prefill
+    step, the speculative verify step when armed, the shared tick) —
+    the engine's OWN variants, donation included, so ``peak_bytes`` is
+    the production program's footprint (a non-donated twin would count
+    the whole slot pool twice, overstating peak by the aliased K/V).
+    ``time_reps > 0`` times the executables on zero-filled inputs
+    (never the engine's live caches — a donating executable deletes
+    its donated inputs), feeding each rep's output caches back like
+    the trainer timing does. The legacy ``serve_prefill`` row is
+    marked ``variable_shape``: it is one representative prompt length
+    of a per-length program family, so live samples keep its timing
+    histogram but skip the MFU/bandwidth gauges."""
+    table = CostTable()
+    for label, fn, args, nums in engine.lint_specs(n_prompt=n_prompt):
+        pc, compiled = extract_program(fn, args, label,
+                                       use_cache=time_reps == 0)
+        if label == "serve_prefill":
+            pc.variable_shape = True
+            pc.note = (pc.note + "; " if pc.note else "") + \
+                "one compiled program per prompt length — row is " \
+                "n_prompt=%d" % n_prompt
+        if time_reps > 0:
+            if compiled is None:
+                _, compiled = extract_program(fn, args, label,
+                                              use_cache=False)
+            margs = _materialize(args)
+            # every engine program returns (cache_k, cache_v, ...) and
+            # donates those cache args when donation is armed
+            feedback = dict(enumerate(nums)) if nums else None
+            pc.measured_s = _time_compiled(compiled, margs, time_reps,
+                                           feedback)
+        table.add(pc)
+    if registry is not None:
+        table.publish(registry)
+    return table
+
+
+# ------------------------------------------------------------ live sampling
+class LiveSampler:
+    """Cadence-gated device timing for running programs. The owner
+    (DecodeEngine / Net.update) brackets each program call with
+    ``t0 = sampler.begin(name)`` / ``sampler.end(name, t0)``: ``begin``
+    returns a start time only every ``cadence``-th execution (else
+    None — one dict increment, the whole hot-path cost), and the owner
+    blocks on the program's outputs before ``end`` so the sample spans
+    real device time. Each sample feeds the
+    ``cxn_program_seconds{fn=}`` histogram, bumps
+    ``cxn_prof_samples_total{fn=}``, and — when the cost table knows
+    the program's FLOPs/bytes — refreshes ``cxn_mfu{fn=}`` and
+    ``cxn_achieved_bw_frac{fn=}`` against :func:`hw_peaks`.
+
+    Single-threaded by design, like the scheduler that drives it; the
+    registry children it updates are themselves thread-safe."""
+
+    def __init__(self, registry, cadence: int = DEFAULT_PROF_EVERY,
+                 table: Optional[CostTable] = None,
+                 peaks: Optional[HWPeaks] = None, tracer=None):
+        from .metrics import TIME_BUCKETS
+        self.cadence = max(0, int(cadence))
+        self.table = table
+        self.peaks = peaks or (table.peaks if table else hw_peaks())
+        self._tracer = tracer
+        self._counts: Dict[str, int] = {}
+        self.samples: Dict[str, int] = {}
+        self.dropped: Dict[str, int] = {}   # compile-contaminated
+        self._sec = registry.histogram(
+            "cxn_program_seconds",
+            "sampled wall seconds per program execution (one blocking "
+            "sample per prof_every executions)", labelnames=("fn",),
+            buckets=TIME_BUCKETS)
+        self._n = registry.counter(
+            "cxn_prof_samples_total",
+            "blocking device-time samples taken", labelnames=("fn",))
+        self._ndrop = registry.counter(
+            "cxn_prof_samples_dropped_total",
+            "samples discarded because a compile ran inside the timed "
+            "window (first call at a new shape)", labelnames=("fn",))
+        self._mfu = registry.gauge(
+            "cxn_mfu", "achieved model FLOPs utilization of the last "
+            "sampled execution", labelnames=("fn",))
+        self._bw = registry.gauge(
+            "cxn_achieved_bw_frac", "achieved HBM bandwidth fraction of "
+            "the last sampled execution", labelnames=("fn",))
+
+    def executions(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def begin(self, name: str) -> Optional[tuple]:
+        """Opaque timing token every ``cadence``-th execution, else
+        None. The token carries the process compile-seconds total at
+        start: a sampled call that happens to be a program's FIRST
+        call at a new shape (the legacy prefill's per-length family, a
+        trainer recompile boundary) would otherwise record jaxpr-trace
+        + XLA-compile time as an execution sample — a ~1000x outlier
+        in the histogram — so ``end`` drops any sample whose window
+        saw a compile."""
+        n = self._counts.get(name, 0) + 1
+        self._counts[name] = n
+        if self.cadence and n % self.cadence == 0:
+            return (time.perf_counter(), _watch.total_seconds())
+        return None
+
+    def end(self, name: str, token: tuple) -> float:
+        t0, c0 = token
+        dt = time.perf_counter() - t0
+        if _watch.total_seconds() > c0:
+            self.dropped[name] = self.dropped.get(name, 0) + 1
+            self._ndrop.labels(name).inc()
+            return dt
+        self.record(name, dt)
+        return dt
+
+    def record(self, name: str, dt: float) -> None:
+        self.samples[name] = self.samples.get(name, 0) + 1
+        self._sec.labels(name).observe(dt)
+        self._n.labels(name).inc()
+        pc = self.table.get(name) if self.table is not None else None
+        if pc is not None and pc.available and dt > 0 \
+                and not pc.variable_shape:
+            if pc.flops > 0:
+                self._mfu.labels(name).set(pc.mfu(dt, self.peaks))
+            if pc.bytes_accessed > 0:
+                self._bw.labels(name).set(pc.bw_frac(dt, self.peaks))
+        if self._tracer is not None:
+            from .trace import TID_ENGINE
+            self._tracer.add("prof_sample", time.perf_counter() - dt, dt,
+                             TID_ENGINE, cat="prof", args={"fn": name})
+
+
+# ------------------------------------------------------------------- ledger
+class DeviceLedger:
+    """Predicted-vs-measured device memory: named pools register a
+    callback returning their PREDICTED bytes (the slot pool's formula,
+    the prefix trie's accounting, the param tree's leaf sum), published
+    as ``cxn_device_bytes{pool=}`` callback gauges with zero hot-path
+    cost; ``pool="live_total"`` is the measured ``jax.live_arrays()``
+    sum and ``pool="unaccounted"`` the difference — growth there is the
+    leak/fragmentation signal no single pool's formula would show."""
+
+    def __init__(self, registry):
+        self._pools: Dict[str, Callable[[], float]] = {}
+        self._fam = registry.gauge(
+            "cxn_device_bytes",
+            "device-memory ledger: predicted bytes per pool, plus the "
+            "measured live_total and the unaccounted remainder",
+            labelnames=("pool",))
+        self._fam.labels("live_total", fn=self.live_total_bytes)
+        self._fam.labels("unaccounted",
+                         fn=lambda: self.live_total_bytes()
+                         - self.accounted_bytes())
+
+    def register(self, pool: str, fn: Callable[[], float]) -> None:
+        self._pools[pool] = fn
+        self._fam.labels(pool, fn=lambda: float(fn()))
+
+    def pool_bytes(self, pool: str) -> float:
+        fn = self._pools.get(pool)
+        try:
+            return float(fn()) if fn is not None else 0.0
+        except Exception:           # a dead provider reads as empty
+            return 0.0
+
+    def accounted_bytes(self) -> float:
+        return sum(self.pool_bytes(p) for p in self._pools)
+
+    @staticmethod
+    def live_total_bytes() -> float:
+        import jax
+        total = 0
+        for a in jax.live_arrays():
+            try:
+                total += a.nbytes
+            except Exception:       # deleted between list and read
+                pass
+        return float(total)
+
+    def reconcile(self) -> Dict:
+        """One consistent snapshot: per-pool predicted bytes, their sum,
+        the measured live total, and the unaccounted remainder
+        (``live_total - accounted``; other subsystems' arrays — e.g. a
+        second net's params — land there, so it is a floor-zero signal
+        only within one owner's process)."""
+        pools = {p: self.pool_bytes(p) for p in self._pools}
+        accounted = sum(pools.values())
+        live = self.live_total_bytes()
+        return {"pools": pools, "accounted": accounted,
+                "live_total": live, "unaccounted": live - accounted}
+
+
+def register_net_pools(net, registry=None) -> DeviceLedger:
+    """The trainer's ledger pools (params / opt_state) in the
+    process-global registry. Re-registering (a rebuilt or second Net)
+    rebinds the callbacks to the NEWEST net — the registry's
+    latest-provider-wins restart semantics. The closures hold the net
+    by WEAKREF: a process-lifetime registry must not pin a dropped
+    net's params + optimizer state (gigabytes of HBM at flagship
+    scale) — a dead net's pools honestly read 0."""
+    import weakref
+    from .metrics import default_registry
+    ledger = DeviceLedger(registry if registry is not None
+                          else default_registry())
+    ref = weakref.ref(net)
+
+    def pool(attr):
+        def read():
+            n = ref()
+            return tree_nbytes(getattr(n, attr)) if n is not None else 0.0
+        return read
+
+    ledger.register("params", pool("params"))
+    ledger.register("opt_state", pool("opt_state"))
+    return ledger
+
+
+# ------------------------------------------------- compile-time accounting
+class CompileWatch:
+    """Process-global compile-time accounting over ``jax.monitoring``
+    duration events: every ``/jax/core/compile/*`` duration (jaxpr
+    trace + MLIR lowering + backend compile) is summed under the label
+    of the program currently being dispatched on that thread
+    (:func:`compile_attribution`; ``"unattributed"`` otherwise) and
+    fanned out to every attached sink — ``cxn_compile_seconds{fn=}``
+    counters per registry, plus one ``compile`` span per backend
+    compile on each sink tracer's engine track. The listener installs
+    once per process and costs nothing between compiles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._installed = False
+        self._tls = threading.local()
+        self._sinks: List[tuple] = []       # (registry, tracer or None)
+        self.totals: Dict[str, float] = {}  # label -> seconds (all events)
+
+    # ------------------------------------------------------------ plumbing
+    def _install(self) -> None:
+        with self._lock:
+            if self._installed:
+                return
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(
+                    self._on_event)
+                self._installed = True
+            except Exception:       # jax without monitoring: stay inert
+                pass
+
+    def current_label(self) -> str:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else "unattributed"
+
+    def total_seconds(self) -> float:
+        """All compile seconds observed so far, any label — the
+        LiveSampler's compile-in-window detector (a changed total
+        across a timed region means the region paid a compile)."""
+        with self._lock:
+            return sum(self.totals.values())
+
+    @contextlib.contextmanager
+    def attribute(self, label: str):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(label)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def add_sink(self, registry, tracer=None) -> None:
+        """Attach a registry (and optional tracer) to receive compile
+        events; the counter family is pre-created so the series exists
+        (empty) before the first compile. Idempotent per registry."""
+        self._install()
+        registry.counter("cxn_compile_seconds",
+                         "seconds spent tracing/lowering/XLA-compiling, "
+                         "by the program label being dispatched",
+                         labelnames=("fn",))
+        with self._lock:
+            if not any(r is registry for r, _ in self._sinks):
+                self._sinks.append((registry, tracer))
+
+    def remove_sink(self, registry) -> None:
+        with self._lock:
+            self._sinks = [(r, t) for r, t in self._sinks
+                           if r is not registry]
+
+    # -------------------------------------------------------------- events
+    def _on_event(self, name: str, duration: float, **kw) -> None:
+        if "/jax/core/compile/" not in name:
+            return
+        label = self.current_label()
+        with self._lock:
+            self.totals[label] = self.totals.get(label, 0.0) + duration
+            sinks = list(self._sinks)
+        backend = name.endswith("backend_compile_duration")
+        for registry, tracer in sinks:
+            try:
+                registry.counter("cxn_compile_seconds",
+                                 labelnames=("fn",)).labels(label)\
+                    .inc(duration)
+                if tracer is not None and backend:
+                    from .trace import TID_ENGINE
+                    tracer.add("compile",
+                               time.perf_counter() - duration, duration,
+                               TID_ENGINE, cat="compile",
+                               args={"fn": label})
+            except Exception:       # a dead sink must not break compiles
+                pass
+
+
+_watch = CompileWatch()
+
+
+def compile_watch() -> CompileWatch:
+    """The process-global :class:`CompileWatch` (Net and the serve
+    engine attribute through it; servers/CLI attach their registries as
+    sinks)."""
+    return _watch
+
+
+def compile_attribution(label: str):
+    """``compile_watch().attribute(label)`` shorthand — wrap a jitted
+    call so any compile it triggers lands under ``label`` in
+    ``cxn_compile_seconds{fn=}``."""
+    return _watch.attribute(label)

@@ -41,7 +41,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
-           "default_registry"]
+           "BYTES_BUCKETS", "default_registry"]
 
 
 def _log_spaced(lo: float, hi: float, per_decade: int) -> Tuple[float, ...]:
@@ -62,6 +62,13 @@ def _log_spaced(lo: float, hi: float, per_decade: int) -> Tuple[float, ...]:
 # for every duration histogram in the process, so ANY two histograms
 # with these buckets merge.
 TIME_BUCKETS = _log_spaced(1e-5, 100.0, 4)
+
+# the shared size geometry: 256 B .. ~1 TiB, 2 buckets per decade. A
+# memory/size histogram observed into TIME_BUCKETS lands entirely in
+# the +Inf bucket (its top bound is ~158); this is the same mergeable
+# fixed-boundary construction at byte scale. ``Registry.histogram``
+# takes ``buckets=`` for geometries neither constant fits.
+BYTES_BUCKETS = _log_spaced(256.0, 1e12, 2)
 
 
 def _fmt(v: float) -> str:
@@ -269,15 +276,27 @@ class _Family:
         if not labelnames:
             self._children[()] = make()
 
-    def labels(self, *values) -> object:
+    def labels(self, *values, fn: Optional[Callable[[], float]] = None
+               ) -> object:
+        """Get-or-create the child for one label tuple. ``fn`` binds a
+        PER-CHILD callback provider (how the device-memory ledger gives
+        each ``cxn_device_bytes{pool=...}`` child its own live reader —
+        the family-level ``fn`` of ``counter``/``gauge`` applies one
+        provider to every child, which only fits unlabeled families);
+        re-passing ``fn`` rebinds the child, latest provider wins."""
         if len(values) != len(self.labelnames):
             raise ValueError("metric %s wants labels %s, got %r"
                              % (self.name, self.labelnames, values))
+        if fn is not None and self.kind == "histogram":
+            raise ValueError("metric %s: histograms cannot be "
+                             "callback-backed" % self.name)
         key = tuple(str(v) for v in values)
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = self._make()
+            if fn is not None:
+                child._fn = fn
             return child
 
     @property

@@ -91,6 +91,7 @@ from jax import lax
 
 from ..models.gpt import (GPTConfig, _block_core_fusedqkv, _fuse_qkv_blocks,
                           _layernorm)
+from ..obs.devprof import compile_attribution
 from ..ops.attention import local_attention
 from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
                             sample_rows)
@@ -534,6 +535,12 @@ class DecodeEngine:
         # donating the caches halves peak HBM on real chips; CPU (the test
         # mesh) ignores donation with a warning, so gate on the backend
         self._donate = jax.default_backend() != "cpu"
+        # live per-program device timing (obs/devprof.py): the server
+        # arms this with a LiveSampler when `prof_every` > 0 — one
+        # blocking sample every N executions of each program, a dict
+        # increment otherwise; None (the default) costs one attribute
+        # check per call
+        self._prof = None
         # compiled prefill/chunk signature counting (lint_recompile_limit
         # for the serve engine): the lru_caches above silently absorb a
         # per-prompt-length compile storm; the guard makes it loud
@@ -562,6 +569,15 @@ class DecodeEngine:
                 lambda sig: None, "serve_verify_chunk", recompile_limit,
                 strict=bool(recompile_strict), log=profiler.warn,
                 on_trip=on_trip)
+
+    def set_profiler(self, prof) -> None:
+        """Arm live per-program device timing (an
+        ``obs.devprof.LiveSampler`` or None to disarm). Each program
+        call asks the sampler once; only every Nth execution is timed —
+        the timed call blocks on the program's outputs (the tick and
+        verify already do; a sampled prefill chunk gives up its
+        pipelining for that one call), the rest are untouched."""
+        self._prof = prof
 
     def _count_program(self, sig: str) -> None:
         """Register one prefill/chunk program fetch with the guard; the
@@ -657,13 +673,20 @@ class DecodeEngine:
         n = int(len(prompt))
         self._count_program("n_prompt=%d" % n)
         fn = _prefill_fn(self._cfg_key, n, self.row_len, self._donate)
-        self.cache_k, self.cache_v, tok = fn(
-            self._blocks, self._outer, self.cache_k, self.cache_v,
-            jnp.asarray(np.asarray(prompt, np.int32))[None],
-            jnp.asarray(slot, jnp.int32), jnp.asarray(key),
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
-        return int(tok)
+        t0 = self._prof.begin("serve_prefill") \
+            if self._prof is not None else None
+        with compile_attribution("serve_prefill"):
+            self.cache_k, self.cache_v, tok = fn(
+                self._blocks, self._outer, self.cache_k, self.cache_v,
+                jnp.asarray(np.asarray(prompt, np.int32))[None],
+                jnp.asarray(slot, jnp.int32), jnp.asarray(key),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32))
+        tok = int(tok)                      # host fetch: the sync point
+        if t0 is not None:
+            self._prof.end("serve_prefill", t0)
+        return tok
 
     def prefill_chunk(self, slot: int, toks: np.ndarray, start: int,
                       n_valid: int, key: np.ndarray, temperature: float,
@@ -684,12 +707,22 @@ class DecodeEngine:
         self._count_program("chunk=%d" % self.chunk)
         fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
                                self._donate)
-        self.cache_k, self.cache_v, tok = fn(
-            self._blocks, self._outer, self.cache_k, self.cache_v,
-            jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
-            jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
-            jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
+        t0 = self._prof.begin("serve_prefill_chunk") \
+            if self._prof is not None else None
+        with compile_attribution("serve_prefill_chunk"):
+            self.cache_k, self.cache_v, tok = fn(
+                self._blocks, self._outer, self.cache_k, self.cache_v,
+                jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32),
+                jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32))
+        if t0 is not None:
+            # the one sampled call pays the sync the unsampled path
+            # deliberately avoids — that IS the measurement
+            jax.block_until_ready(tok)
+            self._prof.end("serve_prefill_chunk", t0)
         return tok
 
     def verify_chunk(self, slot: int, toks: np.ndarray, pos: int,
@@ -716,14 +749,22 @@ class DecodeEngine:
         if self._vguard is not None:
             self._vguard("spec_len=%d" % k)
         fn = _verify_fn(self._cfg_key, k, self._donate)
-        self.cache_k, self.cache_v, n_acc, emit = fn(
-            self._blocks, self._outer, self.cache_k, self.cache_v,
-            jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
-            jnp.asarray(pos, jnp.int32), jnp.asarray(n_draft, jnp.int32),
-            jnp.asarray(key), jnp.asarray(fold, jnp.int32),
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32))
-        return int(n_acc), int(emit)
+        t0 = self._prof.begin("serve_verify_chunk") \
+            if self._prof is not None else None
+        with compile_attribution("serve_verify_chunk"):
+            self.cache_k, self.cache_v, n_acc, emit = fn(
+                self._blocks, self._outer, self.cache_k, self.cache_v,
+                jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+                jnp.asarray(pos, jnp.int32),
+                jnp.asarray(n_draft, jnp.int32),
+                jnp.asarray(key), jnp.asarray(fold, jnp.int32),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_k, jnp.int32),
+                jnp.asarray(top_p, jnp.float32))
+        out = int(n_acc), int(emit)         # host fetch: the sync point
+        if t0 is not None:
+            self._prof.end("serve_verify_chunk", t0)
+        return out
 
     def extract_row_chunks(self, slot: int, start: int, n_chunks: int):
         """Copy ``n_chunks`` contiguous chunks' K/V out of ``slot``'s row
@@ -757,9 +798,17 @@ class DecodeEngine:
         a slot row's sample stream identical to the offline path's.
         Returns the (slots,) next tokens, synchronized."""
         fn = _tick_fn(self._cfg_key, self._donate)
-        self.cache_k, self.cache_v, nxt = fn(
-            self._blocks, self._outer, self.cache_k, self.cache_v,
-            jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(keys),
-            jnp.asarray(fold), jnp.asarray(temp), jnp.asarray(top_k),
-            jnp.asarray(top_p))
-        return np.asarray(nxt)
+        t0 = self._prof.begin("serve_tick") \
+            if self._prof is not None else None
+        with compile_attribution("serve_tick"):
+            self.cache_k, self.cache_v, nxt = fn(
+                self._blocks, self._outer, self.cache_k, self.cache_v,
+                jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(keys),
+                jnp.asarray(fold), jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p))
+        out = np.asarray(nxt)               # host fetch: the sync point —
+        #                                     a sampled tick adds only
+        #                                     the perf_counter pair
+        if t0 is not None:
+            self._prof.end("serve_tick", t0)
+        return out

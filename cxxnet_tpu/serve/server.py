@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import devprof
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils import profiler
@@ -101,7 +102,8 @@ class InferenceServer:
                  prefix_mb: float = 32.0, recompile_limit: int = 0,
                  recompile_strict: bool = True, spec_mode: str = "off",
                  spec_len: int = 4, spec_model=None, tracer=None,
-                 registry=None, slow_ms: float = 0.0):
+                 registry=None, slow_ms: float = 0.0,
+                 prof_every: int = 0):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -130,7 +132,17 @@ class InferenceServer:
         not fight over one name); :meth:`metrics_text` exposes it as
         Prometheus text. ``slow_ms`` > 0 arms the slow-request
         exemplar hook: any request whose TTFT or total latency exceeds
-        it has its span tree auto-dumped (``Tracer.note_slow``)."""
+        it has its span tree auto-dumped (``Tracer.note_slow``).
+        ``prof_every`` > 0 arms the device/compiler observatory
+        (obs/devprof.py): the engine's per-program cost table is
+        extracted once at construction (AOT, no execution) and ONE
+        blocking device-time sample is taken every ``prof_every``
+        executions of each program, publishing ``cxn_program_*`` /
+        ``cxn_mfu`` / ``cxn_achieved_bw_frac`` gauges; 0 (default)
+        leaves the hot path entirely untouched. The device-memory
+        ledger (``cxn_device_bytes{pool=}``) and compile-time
+        accounting (``cxn_compile_seconds{fn=}``) are always on — both
+        are collection-time callbacks with zero steady-state cost."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -173,6 +185,22 @@ class InferenceServer:
                 dcfg, dparams = spec_model
                 self._drafters["model"] = ModelDrafter(
                     dcfg, dparams, slots, target_cfg=cfg)
+        # device/compiler observatory (obs/devprof.py): compile-time
+        # accounting always (this registry becomes a CompileWatch sink,
+        # so every compile the server triggers lands in
+        # cxn_compile_seconds{fn=} + a `compile` span on the engine
+        # track); the cost table + live MFU sampler only when armed —
+        # extraction AOT-compiles every engine program once, which is
+        # startup cost a prof_every=0 server must not pay
+        devprof.compile_watch().add_sink(self._registry, self._tracer)
+        self._prof_sampler = None
+        if prof_every > 0:
+            table = devprof.profile_engine(self._engine,
+                                           registry=self._registry)
+            self._prof_sampler = devprof.LiveSampler(
+                self._registry, cadence=prof_every, table=table,
+                tracer=self._tracer)
+            self._engine.set_profiler(self._prof_sampler)
         # StepStats feeds the registry (utils/profiler.py observer):
         # every phase sample lands in the mergeable per-phase histogram
         # as well as the StepStats percentile window
@@ -300,6 +328,27 @@ class InferenceServer:
                      lambda: pc.nbytes)
             cb_gauge("cxn_prefix_cache_chunks", "chunks resident in the "
                      "prefix trie", lambda: pc.chunks)
+        # device-memory ledger (doc/observability.md): predicted bytes
+        # per pool as callback gauges, reconciled against the measured
+        # jax.live_arrays() total at collection time. `params` covers
+        # the ENGINE's weight copies (the fused block dict + outer
+        # tree), not the caller's original export — the caller's tree
+        # shows up in `unaccounted` until it is dropped.
+        cb.append("cxn_device_bytes")
+        eng = self._engine
+        self._ledger = devprof.DeviceLedger(r)
+        self._ledger.register(
+            "params", lambda: devprof.tree_nbytes((eng._blocks,
+                                                   eng._outer)))
+        self._ledger.register("kv_slots", eng.cache_bytes)
+        if pc is not None:
+            self._ledger.register("prefix_cache", lambda: pc.nbytes)
+        md = self._drafters.get("model")
+        if md is not None:
+            self._ledger.register(
+                "spec_draft",
+                lambda: md.engine.cache_bytes() + devprof.tree_nbytes(
+                    (md.engine._blocks, md.engine._outer)))
         # latency histograms (fixed log-spaced buckets -> mergeable
         # across replicas); cxn_serve_phase_seconds was registered with
         # the StepStats observer in __init__
@@ -624,6 +673,9 @@ class InferenceServer:
         # post-shutdown scrape reports the honest drained state instead
         # of evaluating a dead object (obs/metrics.py:Registry.freeze)
         self._registry.freeze(self._obs_cb_names)
+        # and stop routing process compile events into a dead server's
+        # registry (the CompileWatch sink holds a reference to it)
+        devprof.compile_watch().remove_sink(self._registry)
 
     def close(self) -> None:
         self.shutdown(drain=False)
@@ -663,6 +715,9 @@ class InferenceServer:
             "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
             "kv_cache_bytes": self._engine.cache_bytes(),
+            # device-memory ledger snapshot (obs/devprof.py): predicted
+            # bytes per pool vs the measured jax.live_arrays() total
+            "device_bytes": self._ledger.reconcile(),
             # chunked prefill + prefix reuse gauges (doc/serving.md):
             # hit rate is FRACTION OF PROMPT TOKENS restored from the
             # prefix cache; chunks/req is the mean chunk steps a request

@@ -192,7 +192,8 @@ class Net:
                     recompile_limit: int = 0, recompile_strict: bool = True,
                     spec_mode: str = "off", spec_len: int = 4,
                     spec_model=None, slow_ms: float = 0.0, tracer=None,
-                    registry=None, **defaults) -> None:
+                    registry=None, prof_every: int = 0,
+                    **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -217,7 +218,10 @@ class Net:
         override the span tracer (default: the process-global one —
         what :meth:`trace_export` reads) and the metrics registry
         (default: a server-private one — what :meth:`metrics_text`
-        renders)."""
+        renders); ``prof_every`` arms the device/compiler observatory
+        (obs/devprof.py — per-program cost table + one blocking
+        device-time sample per N executions publishing live
+        ``cxn_mfu{fn=}`` gauges; 0 = off, the CLI serves with 64)."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
         if getattr(self, "_server", None) is not None:
@@ -232,7 +236,7 @@ class Net:
             prefix_mb=prefix_mb, recompile_limit=recompile_limit,
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
-            tracer=tracer, registry=registry,
+            tracer=tracer, registry=registry, prof_every=prof_every,
             defaults=SamplingParams(**defaults))
 
     def _serving(self):
@@ -279,6 +283,26 @@ class Net:
             return srv.metrics_text()
         from .obs.metrics import default_registry
         return default_registry().to_prometheus()
+
+    def profile(self, time_reps: int = 3):
+        """Device & compiler observatory over this net's four jitted
+        train steps (obs/devprof.py; the CLI twin is ``task = prof``):
+        AOT-extracts each program's XLA cost/memory model, times the
+        executables ``time_reps`` times on zero-filled inputs
+        (``time_reps=0`` skips timing), publishes the
+        ``cxn_program_*`` gauges into the process registry — which
+        also gives a ``prof_every``-armed net's live ``cxn_mfu{fn=}``
+        gauges their FLOPs — and returns the
+        :class:`~cxxnet_tpu.obs.devprof.CostTable` (print
+        ``.format_roofline()`` for the human table)."""
+        from .obs import devprof
+        from .obs.metrics import default_registry
+        if not self._net._initialized:
+            raise RuntimeError("profile() needs an initialized net "
+                               "(call init_model or load_model first)")
+        return devprof.profile_net(self._net,
+                                   registry=default_registry(),
+                                   time_reps=time_reps)
 
     def trace_export(self, path: Optional[str] = None):
         """The process-global span tracer's ring as a Chrome-trace JSON

@@ -14,8 +14,12 @@ compiled steps (pass 2: donation aliasing, dtype promotion, host
 transfers, collectives); for a GPT-shaped config it also audits the
 serve engine's prefill / chunk-prefill / tick executables — plus the
 speculative ``serve_verify_chunk`` program when the config enables it
-(``spec_mode`` != off) — the programs ``task=serve`` runs. ``k=v``
-args are CLI-style overrides linted as line-less pairs.
+(``spec_mode`` != off) — the programs ``task=serve`` runs. Every
+audited step's line now reports its AOT lower+compile seconds, and
+``lint_compile_budget_s=<s>`` turns that into a CI gate: any step
+compiling over the budget fails the lint with CXN207, so compile-time
+regressions are caught the same way collective-count regressions are.
+``k=v`` args are CLI-style overrides linted as line-less pairs.
 
 Exit codes: 0 clean (warnings allowed), 1 lint errors, 2 usage error.
 """
@@ -76,7 +80,13 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                spec_len=(task.spec_len
                                          if task.spec_mode != "off"
                                          else 0))
-            serve_report, serve_infos = audit_serve_engine(eng)
+            # the serve executables ride under the same compile-time
+            # budget as the trainer steps (CXN207): pass
+            # lint_compile_budget_s=<s> to gate compile regressions in
+            # CI the way lint_collective_budget gates collectives
+            cbudget = getattr(net, "lint_compile_budget_s", 0.0) or None
+            serve_report, serve_infos = audit_serve_engine(
+                eng, compile_budget_s=cbudget)
             report.extend(serve_report.findings)
             infos += serve_infos
         if verbose:

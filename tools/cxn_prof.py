@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""cxn-prof: the device & compiler observatory's CLI
+(doc/observability.md).
+
+Roofline mode::
+
+    python tools/cxn_prof.py <config> [k=v ...]
+
+Builds the config's net (random init unless ``model_in=`` is given) and
+prints the per-program roofline table — FLOPs, HBM bytes, arithmetic
+intensity, peak memory, compile seconds, measured time, MFU and
+achieved-bandwidth fraction — for the trainer's four jitted steps and,
+for GPT-shaped configs, the serve engine's prefill / prefill-chunk /
+verify-chunk / tick programs (``cxxnet_tpu.obs.devprof``; this is a
+thin wrapper over ``task=prof``, so the two surfaces cannot drift).
+``prof_reps=N`` controls the timing best-of; ``prof_reps=0`` skips
+execution entirely (cost model only, no device time).
+
+Diff mode — the bench regression gate::
+
+    python tools/cxn_prof.py --diff OLD.json NEW.json [--tol 0.10]
+                             [--cell-tol metric=frac ...]
+
+Compares two bench snapshots (the ``BENCH_rXX.json`` line-per-metric
+format bench.py emits) cell by cell with per-cell tolerance bands:
+direction comes from each cell's unit (ms / % lines regress UP,
+throughput/fraction/ratio lines regress DOWN), the base tolerance is
+``--tol`` (default 10%), a cell that records its own best-of ``band``
+widens its tolerance by the observed run-to-run spread, and
+``--cell-tol`` pins per-cell overrides for known-noisy lines. Exit 1
+when any cell regressed beyond its band — the CI gate; identical
+snapshots always pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# units where a SMALLER value is better — everything else (tokens/sec,
+# images/sec, fraction, ratio) regresses downward
+_LOWER_IS_BETTER = ("ms", "ms/token", "%", "sec", "s")
+
+# built-in extra tolerance for cells whose recorded history shows
+# run-to-run swings a flat 10% band would flag as phantom regressions
+# (doc/performance.md / doc/serving.md record the spreads)
+_DEFAULT_CELL_TOL = {
+    "moe_dispatch_tokens_per_sec": 0.15,
+    "serve_tokens_per_sec": 0.20,
+    "serve_p95_ttft_ms": 0.25,
+    "serve_p95_ttft_ms_prefill_heavy": 0.25,
+    "serve_prefix_hit_tokens_per_sec": 0.20,
+    "serve_spec_tokens_per_sec": 0.20,
+    "gpt_decode_spec_ms_per_token": 0.20,
+    "obs_overhead_pct": 1.0,        # a percentage-point-scale cell:
+    #                                 gate it on the <= 2% budget in
+    #                                 bench.py, not on relative drift
+    "train_feed_overlap": 0.15,
+    "lint_wall_ms": 0.50,
+}
+
+
+def load_bench(path: str) -> dict:
+    """{metric: record} from a bench snapshot. Accepts both shapes the
+    repo produces: bench.py's own stdout (one JSON object per line,
+    non-metric noise skipped) and the driver-recorded ``BENCH_rXX.json``
+    wrapper (one document whose ``tail`` string embeds those lines)."""
+    with open(path) as f:
+        text = f.read()
+    lines = text.splitlines()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+            lines = doc["tail"].splitlines()
+        elif isinstance(doc, dict) and "metric" in doc:
+            lines = [text]
+    except json.JSONDecodeError:
+        pass                        # line-per-metric stdout capture
+    out = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    if not out:
+        raise SystemExit("%s: no bench metric lines found" % path)
+    return out
+
+
+def _band_spread(rec: dict) -> float:
+    """Relative run-to-run spread a cell recorded about itself (the
+    MoE cell's ``band=[lo, best]``) — 0 when absent."""
+    band = rec.get("band")
+    if not (isinstance(band, (list, tuple)) and len(band) == 2):
+        return 0.0
+    lo, hi = sorted(float(b) for b in band)
+    return (hi - lo) / hi if hi > 0 else 0.0
+
+
+def diff_cells(old: dict, new: dict, tol: float = 0.10,
+               cell_tol: dict = None) -> tuple:
+    """Per-cell comparison; returns (rows, regressions). Each row is
+    {metric, old, new, delta, tol, verdict} with verdict one of
+    ok | REGRESSED | improved | new | gone."""
+    cell_tol = dict(_DEFAULT_CELL_TOL, **(cell_tol or {}))
+    rows, regressions = [], []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o is None or n is None:
+            rows.append({"metric": name, "old": o and o["value"],
+                         "new": n and n["value"], "delta": 0.0,
+                         "tol": 0.0, "verdict": "new" if o is None
+                         else "gone"})
+            continue
+        ov, nv = float(o["value"]), float(n["value"])
+        lower_better = o.get("unit", "") in _LOWER_IS_BETTER
+        # worse-direction relative change; band spread from EITHER
+        # snapshot widens the tolerance (the cell itself measured that
+        # much noise between best-of reps in one run)
+        cell = max(tol, cell_tol.get(name, 0.0)) \
+            + 1.5 * max(_band_spread(o), _band_spread(n))
+        if ov == 0.0:
+            delta = 0.0
+        elif lower_better:
+            delta = (nv - ov) / abs(ov)
+        else:
+            delta = (ov - nv) / abs(ov)
+        verdict = "ok"
+        if delta > cell:
+            verdict = "REGRESSED"
+            regressions.append(name)
+        elif delta < -cell:
+            verdict = "improved"
+        rows.append({"metric": name, "old": ov, "new": nv,
+                     "delta": delta, "tol": cell, "verdict": verdict})
+    return rows, regressions
+
+
+def cmd_diff(old_path: str, new_path: str, tol: float,
+             cell_tol: dict) -> int:
+    rows, regressions = diff_cells(load_bench(old_path),
+                                   load_bench(new_path), tol, cell_tol)
+    print("%-36s %12s %12s %8s %6s  %s"
+          % ("metric", "old", "new", "delta", "tol", "verdict"))
+    for r in rows:
+        fmt = lambda v: "-" if v is None else "%.4g" % v
+        print("%-36s %12s %12s %7.1f%% %5.0f%%  %s"
+              % (r["metric"], fmt(r["old"]), fmt(r["new"]),
+                 100 * r["delta"], 100 * r["tol"], r["verdict"]))
+    if regressions:
+        print("cxn-prof: %d cell(s) REGRESSED beyond tolerance: %s"
+              % (len(regressions), ", ".join(regressions)))
+        return 1
+    print("cxn-prof: no regressions (%d cells compared)"
+          % sum(1 for r in rows if r["verdict"] != "new"
+                and r["verdict"] != "gone"))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    if "--diff" in argv:
+        argv.remove("--diff")
+        tol = 0.10
+        cell_tol = {}
+        if "--tol" in argv:
+            i = argv.index("--tol")
+            tol = float(argv[i + 1])
+            del argv[i:i + 2]
+        while "--cell-tol" in argv:
+            i = argv.index("--cell-tol")
+            k, v = argv[i + 1].split("=", 1)
+            cell_tol[k] = float(v)
+            del argv[i:i + 2]
+        if len(argv) != 2:
+            print("cxn-prof --diff needs exactly OLD.json NEW.json",
+                  file=sys.stderr)
+            return 2
+        return cmd_diff(argv[0], argv[1], tol, cell_tol)
+    # roofline mode: hand off to the CLI's task=prof (one surface);
+    # trailing k=v pairs ride through as overrides
+    if not os.path.exists(argv[0]):
+        print("cannot open config %r" % argv[0], file=sys.stderr)
+        return 2
+    from cxxnet_tpu.cli import main as cli_main
+    return cli_main([argv[0], "task=prof"] + argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
