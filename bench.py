@@ -43,6 +43,14 @@ Prints one JSON line per metric, in this order:
                                      SAME trace through the legacy
                                      whole-prompt prefill — >1 means
                                      chunking + reuse cut p95 TTFT)
+ 12a. serve_tokens_per_mib          (paged KV cache: the PREFIX_CELL
+                                     trace at 4x request concurrency,
+                                     dense vs paged under the SAME KV
+                                     MiB budget; vs_baseline = paged /
+                                     dense tokens-per-MiB — >= 1.5 is
+                                     the round-13 acceptance gate)
+ 12a'. serve_p95_ttft_ms_paged      (same paged run's p95 TTFT;
+                                     vs_baseline = dense p95 / paged)
  12b. serve_spec_tokens_per_sec     (speculative serving: n-gram drafter
                                      on a repetitive-suffix trace;
                                      vs_baseline = the same trace served
@@ -718,6 +726,56 @@ def bench_serve_prefill_heavy():
          whole_prefill_p95_ms=round(m0["ttft_ms"]["p95"], 1))
 
 
+def bench_serve_paged():
+    """Paged KV cache cell (round 13, doc/serving.md "Paged KV cache"):
+    the PREFIX_CELL shared-prefix Poisson trace at 4x the request
+    concurrency of ``slots``, served under the SAME KV MiB budget by
+    (a) the dense slot pool — ``slots`` rows, each pinning a full
+    chunk-padded row — and (b) the paged engine with 4x the slots over
+    a block pool of the same bytes (shared prefix blocks held once,
+    zero-copy, preemption/swap under pressure). Emits
+    ``serve_tokens_per_mib`` (steady-state tokens/s per KV MiB;
+    vs_baseline = paged / dense — the capacity-efficiency headline,
+    acceptance gate >= 1.5) and ``serve_p95_ttft_ms_paged``
+    (vs_baseline = dense p95 / paged p95)."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+
+    c = dict(PREFIX_CELL)
+    c["n_requests"] = 4 * c["slots"]
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_prefix_trace(c)
+    # the shared TOTAL KV budget: what `slots` dense rows pin plus the
+    # dense arm's prefix-trie copies (its trie is memory ON TOP of the
+    # slot pool; the paged trie lives INSIDE the block pool, so the
+    # paged arm gets the same total as one kv_mb pool)
+    row_len = (c["seq"] + c["chunk"] - 1) // c["chunk"] * c["chunk"]
+    hd = c["feat"] // c["heads"]
+    prefix_mb = 16.0
+    mib = (2 * c["layers"] * c["slots"] * c["heads"] * row_len * hd * 2
+           / 2.0 ** 20) + prefix_mb
+    kw = dict(queue=c["n_requests"], prefill_chunk=c["chunk"],
+              prefill_budget=c["budget"], prefix_mb=prefix_mb)
+    wall_d, md = run_serve_trace(cfg, params, trace, slots=c["slots"],
+                                 paged=False, **kw)
+    wall_p, mp = run_serve_trace(cfg, params, trace,
+                                 slots=4 * c["slots"], kv_mb=mib, **kw)
+    tpm_d = md["tokens_generated"] / wall_d / mib
+    tpm_p = mp["tokens_generated"] / wall_p / mib
+    emit("serve_tokens_per_mib", tpm_p, "tokens/sec/MiB",
+         tpm_p / max(tpm_d, 1e-9),
+         dense_tokens_per_mib=round(tpm_d, 4), kv_mib=round(mib, 1),
+         paged_slots=4 * c["slots"], dense_slots=c["slots"],
+         swaps_out=mp["paged"]["swaps_out"],
+         cow_faults=mp["paged"]["cow_faults"])
+    emit("serve_p95_ttft_ms_paged", mp["ttft_ms"]["p95"], "ms",
+         md["ttft_ms"]["p95"] / max(mp["ttft_ms"]["p95"], 1e-9),
+         dense_p95_ms=round(md["ttft_ms"]["p95"], 1))
+
+
 def serve_spec_trace(cfg, params, cell=None):
     """Seeded repetitive-suffix serving trace: [(gap_s, prompt,
     max_tokens)] with Poisson open-loop arrivals — every prompt is a
@@ -859,8 +917,8 @@ def main() -> int:
     rc = 0
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
-               bench_serve_prefill_heavy, bench_serve_spec,
-               bench_obs_overhead, bench_lint):
+               bench_serve_prefill_heavy, bench_serve_paged,
+               bench_serve_spec, bench_obs_overhead, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -10,7 +10,12 @@ counting signatures counts compilations without touching jax internals.
 
 Wired into :class:`~cxxnet_tpu.nnet.net.Net` via the
 ``lint_recompile_limit`` config key (0 = off) and enabled by default by
-the ``CXN_LINT`` runtime hook (doc/lint.md).
+the ``CXN_LINT`` runtime hook (doc/lint.md). The serve engine arms one
+guard per compiled program family — prefill/chunk, verify, and (paged
+engines) the batched tick, whose counted signature carries the
+block-table shape, so a drifting table would surface as a CXN205 trip
+naming the drift rather than a silent second compilation (the
+one-signature discipline doc/serving.md's paged section leans on).
 """
 
 from __future__ import annotations

@@ -284,14 +284,21 @@ def audit_serve_engine(engine, n_prompt: int = 8,
                        donate: Optional[bool] = None,
                        compile_budget_s: Optional[float] = None
                        ) -> Tuple[LintReport, List[Dict]]:
-    """Audit the serve engine's prefill (one representative prompt
-    length), the chunk-prefill step (when the engine runs chunked —
-    its donation aliasing matters double: the chunk program runs
-    ceil(n/chunk) times per admit), the speculative
-    ``serve_verify_chunk`` step (when the engine was built with a
-    ``spec_len`` — a verify forward runs once per speculative window,
-    so an unaliased cache there would copy the whole slot pool every
-    few tokens), and the shared decode tick. ``donate`` overrides the
+    """Audit the serve engine's compiled programs. Dense engine: the
+    prefill (one representative prompt length), the chunk-prefill step
+    (when the engine runs chunked — its donation aliasing matters
+    double: the chunk program runs ceil(n/chunk) times per admit), the
+    speculative ``serve_verify_chunk`` step (when the engine was built
+    with a ``spec_len`` — a verify forward runs once per speculative
+    window, so an unaliased cache there would copy the whole slot pool
+    every few tokens), and the shared decode tick. PAGED engine: the
+    paged chunk-prefill / verify / tick programs with abstract
+    block-table inputs (engine.lint_specs supplies the table
+    ShapeDtypeStructs), so the audit pins the BLOCK POOL's donation
+    aliasing — an unaliased pool would copy every block per token —
+    and sees exactly the one compiled signature each program holds
+    (a drifting table shape at runtime trips the engine's
+    RecompileGuard as CXN205 instead). ``donate`` overrides the
     engine's backend-gated donation choice — tests pass True to pin
     the aliasing contract even on the CPU mesh."""
     report = LintReport()

@@ -85,6 +85,18 @@ class LearnTask:
         #                                 interleaved per decode tick
         self.serve_prefix_mb = 32.0     # task=serve: shared-prefix KV
         #                                 cache budget in MiB (0 = off)
+        self.serve_paged = 1      # task=serve: paged KV cache — block
+        #                           pool + per-row block tables, COW
+        #                           prefix sharing, preemption/swap
+        #                           (0 = dense slot rows; forced dense
+        #                           when serve_prefill_chunk = 0)
+        self.serve_block_size = 0   # KV block width in tokens (0 = the
+        #                             prefill chunk; must divide it)
+        self.serve_num_blocks = 0   # block-pool size (0 = auto: dense-
+        #                             equivalent rows + trie headroom,
+        #                             or serve_kv_mb when set)
+        self.serve_kv_mb = 0.0    # block-pool MiB budget for auto-
+        #                           sizing (0 = slots-equivalent formula)
         self.spec_mode = "off"    # speculative decoding draft source:
         #                           off | ngram (prompt lookup) | model
         self.spec_len = 4         # draft tokens verified per forward
@@ -200,6 +212,14 @@ class LearnTask:
             self.serve_prefill_budget = int(val)
         elif name == "serve_prefix_mb":
             self.serve_prefix_mb = float(val)
+        elif name == "serve_paged":
+            self.serve_paged = int(val)
+        elif name == "serve_block_size":
+            self.serve_block_size = int(val)
+        elif name == "serve_num_blocks":
+            self.serve_num_blocks = int(val)
+        elif name == "serve_kv_mb":
+            self.serve_kv_mb = float(val)
         elif name == "spec_mode":
             self.spec_mode = val
         elif name == "spec_len":
@@ -842,13 +862,23 @@ class LearnTask:
         except ConfigError as e:
             print("prof: serve programs skipped (not GPT-shaped: %s)" % e)
         else:
-            from .serve.engine import DecodeEngine
+            from .serve.engine import DecodeEngine, auto_num_blocks
             # a real (2-slot) engine so the serve programs can be TIMED,
             # not just costed; spec_len > 0 always — prof reports the
-            # verify program whether or not serving would arm it
+            # verify program whether or not serving would arm it. The
+            # engine mirrors the serving mode: paged (block pool sized
+            # for the 2 prof slots) unless serve_paged=0 / chunk=0.
+            nb = 0
+            if self.serve_paged and self.serve_prefill_chunk > 0:
+                nb = (self.serve_num_blocks or auto_num_blocks(
+                    gcfg, 2, self.serve_prefill_chunk,
+                    block_size=self.serve_block_size,
+                    kv_mb=self.serve_kv_mb))
             eng = DecodeEngine(gcfg, gparams, slots=2,
                                prefill_chunk=self.serve_prefill_chunk,
-                               spec_len=max(1, self.spec_len))
+                               spec_len=max(1, self.spec_len),
+                               num_blocks=nb,
+                               block_size=self.serve_block_size)
             table.merge(devprof.profile_engine(
                 eng, registry=reg, time_reps=self.prof_reps))
             eng.close()
@@ -878,7 +908,11 @@ class LearnTask:
         ``serve_slots``/``serve_queue``/``serve_timeout_ms`` size the
         scheduler; ``serve_prefill_chunk``/``serve_prefill_budget``/
         ``serve_prefix_mb`` shape the chunked prefill + prefix-reuse path
-        (doc/serving.md). An explicit ``lint_recompile_limit`` (or the
+        (doc/serving.md); ``serve_paged``/``serve_block_size``/
+        ``serve_num_blocks``/``serve_kv_mb`` shape the paged KV cache
+        (block tables, zero-copy prefix sharing, preemption/swap —
+        on by default; ``serve_paged=0`` restores the dense slot pool).
+        An explicit ``lint_recompile_limit`` (or the
         CXN_LINT default) extends the recompilation guard to the serve
         engine's prefill/chunk programs. A final metrics summary
         (p50/p95/p99 TTFT, tokens/s, batch efficiency, prefix hit rate)
@@ -901,6 +935,10 @@ class LearnTask:
                               prefill_chunk=self.serve_prefill_chunk,
                               prefill_budget=self.serve_prefill_budget,
                               prefix_mb=self.serve_prefix_mb,
+                              paged=bool(self.serve_paged),
+                              block_size=self.serve_block_size,
+                              num_blocks=self.serve_num_blocks,
+                              kv_mb=self.serve_kv_mb,
                               recompile_limit=self.net.lint_recompile_limit,
                               recompile_strict=bool(
                                   self.net.lint_recompile_strict),
@@ -915,6 +953,12 @@ class LearnTask:
                     self.serve_prefill_chunk,
                     "%g MiB" % self.serve_prefix_mb
                     if self.serve_prefix_mb > 0 else "off")
+                if self.serve_paged:
+                    eng = srv._engine
+                    mode += (", paged KV (%d blocks x %d tokens, "
+                             "%.1f MiB)"
+                             % (eng.num_blocks, eng.block_size,
+                                eng.cache_bytes() / 2.0 ** 20))
             else:
                 mode = "whole-prompt prefill, prefix cache off"
             if self.spec_mode != "off":
@@ -1004,6 +1048,13 @@ class LearnTask:
                         m["prefill_chunks_per_req"],
                         "hit %.0f%%" % (100.0 * m["prefix_hit_rate"])
                         if m["prefix_cache"] is not None else "cache off")
+                    if m["paged"] is not None:
+                        extra += ("; paged: %d/%d blocks free, "
+                                  "%d swaps, %d COW faults"
+                                  % (m["paged"]["blocks"]["free"],
+                                     m["paged"]["num_blocks"],
+                                     m["paged"]["swaps_out"],
+                                     m["paged"]["cow_faults"]))
                 else:
                     extra = "whole-prompt prefill"
                 if self.spec_mode != "off":

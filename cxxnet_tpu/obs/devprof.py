@@ -645,6 +645,7 @@ class DeviceLedger:
 
     def __init__(self, registry):
         self._pools: Dict[str, Callable[[], float]] = {}
+        self._host: set = set()
         self._fam = registry.gauge(
             "cxn_device_bytes",
             "device-memory ledger: predicted bytes per pool, plus the "
@@ -655,8 +656,17 @@ class DeviceLedger:
                          fn=lambda: self.live_total_bytes()
                          - self.accounted_bytes())
 
-    def register(self, pool: str, fn: Callable[[], float]) -> None:
+    def register(self, pool: str, fn: Callable[[], float],
+                 device: bool = True) -> None:
+        """``device=False`` marks a HOST-memory pool (e.g. the serve
+        engine's ``swap_host`` buffer of preempted rows): it is
+        published as a ``cxn_device_bytes{pool=}`` gauge for visibility
+        but EXCLUDED from ``accounted`` — ``jax.live_arrays()`` can
+        never see it, so counting it would drive ``unaccounted``
+        negative and bury the leak signal."""
         self._pools[pool] = fn
+        if not device:
+            self._host.add(pool)
         self._fam.labels(pool, fn=lambda: float(fn()))
 
     def pool_bytes(self, pool: str) -> float:
@@ -667,7 +677,8 @@ class DeviceLedger:
             return 0.0
 
     def accounted_bytes(self) -> float:
-        return sum(self.pool_bytes(p) for p in self._pools)
+        return sum(self.pool_bytes(p) for p in self._pools
+                   if p not in self._host)
 
     @staticmethod
     def live_total_bytes() -> float:
@@ -687,7 +698,8 @@ class DeviceLedger:
         second net's params — land there, so it is a floor-zero signal
         only within one owner's process)."""
         pools = {p: self.pool_bytes(p) for p in self._pools}
-        accounted = sum(pools.values())
+        accounted = sum(v for p, v in pools.items()
+                        if p not in self._host)
         live = self.live_total_bytes()
         return {"pools": pools, "accounted": accounted,
                 "live_total": live, "unaccounted": live - accounted}

@@ -12,8 +12,9 @@ Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
 (reference-style API), and CLI ``task = serve`` (cli.py).
 """
 
-from .engine import DecodeEngine
-from .prefix_cache import PrefixCache
+from .engine import DecodeEngine, auto_num_blocks
+from .paged import BlockManager, BlockPoolExhausted
+from .prefix_cache import PagedPrefixCache, PrefixCache
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      ServeResult)
@@ -21,5 +22,6 @@ from .speculative import ModelDrafter, NgramDrafter, SpeculativeDecoder
 
 __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
-           "AdmissionError", "QueueFullError", "NgramDrafter",
-           "ModelDrafter", "SpeculativeDecoder"]
+           "PagedPrefixCache", "BlockManager", "BlockPoolExhausted",
+           "auto_num_blocks", "AdmissionError", "QueueFullError",
+           "NgramDrafter", "ModelDrafter", "SpeculativeDecoder"]

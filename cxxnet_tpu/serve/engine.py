@@ -34,6 +34,23 @@ This engine re-cuts the same math at the granularity a scheduler needs:
   real draft count are traced, so mixed n-gram hit lengths share one
   compiled signature (its own RecompileGuard enforces that).
 
+**Paged mode** (``num_blocks > 0``, the server's default): the dense
+slot pool is replaced by a global block pool ``(n_layer, num_blocks,
+n_head, block_size, head_dim)`` plus per-row ``int32`` block tables
+(serve/paged.py). The chunk-prefill / tick / verify programs are re-cut
+as scatter/gather through TRACED block indices at a FIXED block size
+(default = the prefill chunk), so each keeps exactly one compiled
+signature while occupancy scales with tokens in flight instead of
+``slots * row_len``. Prefix sharing becomes zero-copy (shared blocks
+with refcounts, copy-on-write on first write into a shared block —
+serve/prefix_cache.py:PagedPrefixCache), and rows can be preempted to a
+host swap buffer and resumed bit-identically (swap_out_row /
+swap_in_row; policy in serve/scheduler.py). Served tokens stay
+bit-identical to the dense path and to solo ``gpt_decode``: the gather
+rebuilds the exact logical (H, row_len, d) rows the dense programs read
+— garbage in a table's unallocated tail is masked to an exact 0.0
+contribution, the same invariant dense stale rows lean on.
+
 Compiled-program hygiene: every prefill/chunk program fetch is counted
 by a :class:`~cxxnet_tpu.analysis.recompile.RecompileGuard` when
 ``recompile_limit > 0`` — a mixed-length trace through the legacy path
@@ -96,7 +113,59 @@ from ..ops.attention import local_attention
 from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
                             sample_rows)
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "auto_num_blocks"]
+
+
+def _paged_geometry(cfg, prefill_chunk: int, block_size: int):
+    """The ONE source of paged-cache geometry — ``(chunk, block_size,
+    row_len, blocks_per_row, block_bytes)`` — shared by
+    :func:`auto_num_blocks`, the :class:`DecodeEngine` ctor, and
+    :meth:`DecodeEngine.block_bytes`, so a sizing budget can never
+    desynchronize from the engine's actual block layout. Validates the
+    paged preconditions (chunked prefill on, block size divides the
+    seq_len-clamped chunk)."""
+    chunk = min(int(prefill_chunk), cfg.seq_len)
+    if chunk <= 0:
+        raise ValueError(
+            "paged KV cache requires chunked prefill "
+            "(serve_prefill_chunk > 0); the legacy whole-prompt path "
+            "is dense-only")
+    bs = int(block_size) or chunk
+    if bs < 1 or chunk % bs:
+        raise ValueError(
+            "serve_block_size=%d must be >= 1 and divide the prefill "
+            "chunk %d (chunk windows and prefix-cache nodes must cover "
+            "whole blocks; with seq_len=%d the chunk is clamped to "
+            "min(serve_prefill_chunk, seq_len))"
+            % (int(block_size), chunk, cfg.seq_len))
+    row_len = (cfg.seq_len + chunk - 1) // chunk * chunk
+    itemsize = 2 if cfg.dtype == "bfloat16" else 4
+    block_bytes = (2 * cfg.n_layer * cfg.n_head * bs
+                   * (cfg.feat // cfg.n_head) * itemsize)
+    return chunk, bs, row_len, row_len // bs, block_bytes
+
+
+def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
+                    block_size: int = 0, prefix_mb: float = 0.0,
+                    kv_mb: float = 0.0) -> int:
+    """Block-pool sizing for the paged engine — the ONE formula the
+    server, the CLI, and the lint tool share (geometry from
+    :func:`_paged_geometry`, the same helper the engine ctor uses). An
+    explicit ``kv_mb`` MiB budget wins: ``floor(kv_mb MiB /
+    block_bytes)`` blocks (the DecodeEngine ctor rejects a budget that
+    cannot hold one full row plus the garbage block). Otherwise:
+    dense-equivalent capacity (``slots`` full rows) plus prefix-trie
+    headroom (``prefix_mb`` worth of blocks, capped at another
+    ``slots`` rows so a huge trie budget cannot balloon the pool) plus
+    the reserved garbage block — a strict superset of what the dense
+    pool could ever hold, so the default upgrade never loses capacity
+    (doc/serving.md memory formula)."""
+    _, _, _, bpr, block_bytes = _paged_geometry(cfg, prefill_chunk,
+                                                block_size)
+    if kv_mb > 0:
+        return int(kv_mb * (1 << 20) // block_bytes)
+    prefix_blocks = int(prefix_mb * (1 << 20) // block_bytes)
+    return slots * bpr + min(prefix_blocks, slots * bpr) + 1
 
 
 def _attn_cached_rows(q, ck, cv, pos):
@@ -468,17 +537,259 @@ def _insert_prefix_fn(cfg_key: tuple, n_tokens: int, donate: bool):
     return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
 
+# --------------------------------------------------------------- paged
+# The paged programs re-cut the three dense serve programs over a global
+# block pool (n_layer, num_blocks, n_head, block_size, head_dim) plus
+# traced int32 block tables (serve/paged.py). Every K/V write becomes a
+# position-wise SCATTER — position p lands at physical block
+# table[p // bs], offset p % bs — and every attention read a GATHER of
+# the row's blocks back into the same logical (H, row_len, d) layout the
+# dense programs use, so the arithmetic downstream of the gather is the
+# dense path's bit for bit (same einsums, same f32 softmax, same -1e30
+# mask; garbage blocks in a table's unallocated tail are masked to an
+# exact 0.0 contribution exactly like a dense row's stale tail). Block
+# size, blocks-per-row and the table SHAPES are static — slot, position
+# and the table VALUES are traced — so each program keeps exactly one
+# compiled signature across mixed lengths, occupancy, and any block
+# placement (the RecompileGuard pins it).
+
+
+def _gather_row(pool, table, n_head, bs):
+    """One row's logical K or V cache (1, H, row_len, d) gathered from
+    the pool through its (bpr,) block table."""
+    blk = pool[table]                               # (bpr, H, bs, d)
+    hd = pool.shape[-1]
+    return jnp.transpose(blk, (1, 0, 2, 3)).reshape(
+        n_head, table.shape[0] * bs, hd)[None]
+
+
+def _gather_rows(pool, table, n_head, bs):
+    """All slot rows' logical caches (slots, H, row_len, d) gathered
+    from the pool through the (slots, bpr) block table."""
+    blk = pool[table]                               # (b, bpr, H, bs, d)
+    b, bpr = table.shape
+    hd = pool.shape[-1]
+    return jnp.transpose(blk, (0, 2, 1, 3, 4)).reshape(
+        b, n_head, bpr * bs, hd)
+
+
+@functools.lru_cache(maxsize=16)
+def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
+    """Paged batched decode tick: same math as ``_tick_fn`` with the
+    per-row dus replaced by a block scatter and the cache row reads by a
+    table gather. Parked rows scatter into whatever their table's last
+    entry points at — the garbage block for free/prefilling rows — and
+    their output is discarded; a decode row always writes its own
+    position before attending to it (write-before-attend, the invariant
+    every reuse argument leans on)."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+
+    def impl(blocks, outer, pool_k, pool_v, table, tok, pos, keys, fold,
+             temp, top_k, top_p):
+        h = (outer["emb"][tok][:, None, :]
+             + outer["pos"][jnp.minimum(pos, cfg.seq_len - 1)][:, None, :]
+             ).astype(dtype)
+        # physical write target per row: block table[pos // bs] at
+        # offset pos % bs (pos <= row_len - 1 always, so the logical
+        # block index stays inside the table)
+        blk = jnp.take_along_axis(table, (pos // bs)[:, None],
+                                  axis=1)[:, 0]
+        off = pos % bs
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                # scatter each row's (H, d) K/V into its own block, then
+                # gather the updated logical rows for attention
+                pk = pool_k.at[l, blk, :, off, :].set(k[:, 0])
+                pv = pool_v.at[l, blk, :, off, :].set(v[:, 0])
+                ck = _gather_rows(pk[l], table, cfg.n_head, bs)
+                cv = _gather_rows(pv[l], table, cfg.n_head, bs)
+                return _attn_cached_rows(q, ck, cv, pos), (pk, pv)
+
+            h, (pool_k, pool_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (b, V)
+        keys_t = jax.vmap(jax.random.fold_in)(keys, fold)
+        nxt = sample_rows(logits, keys_t, temp, top_k, top_p)
+        return pool_k, pool_v, nxt
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+@functools.lru_cache(maxsize=16)
+def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
+                            bpr: int, donate: bool):
+    """Paged chunk-prefill step: ``_prefill_chunk_fn``'s math with the
+    row dus/slice replaced by a per-position block scatter and a table
+    gather. The caller (engine.reserve_window) has already allocated —
+    and COW-privatized — every block covering [start, start + chunk),
+    so the scatter only ever lands in blocks this row owns alone."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+
+    def impl(blocks, outer, pool_k, pool_v, table, toks, start, n_valid,
+             key, temp, top_k, top_p):
+        pidx = jnp.clip(start + jnp.arange(chunk), 0, cfg.seq_len - 1)
+        h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
+        wpos = start + jnp.arange(chunk)
+        blkw = table[jnp.clip(wpos // bs, 0, bpr - 1)]      # (chunk,)
+        offw = wpos % bs
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                pk = pool_k.at[l, blkw, :, offw, :].set(k[0])
+                pv = pool_v.at[l, blkw, :, offw, :].set(v[0])
+                row_k = _gather_row(pk[l], table, cfg.n_head, bs)
+                row_v = _gather_row(pv[l], table, cfg.n_head, bs)
+                return _attn_chunk(q, row_k, row_v, start), (pk, pv)
+
+            h, (pool_k, pool_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        last = lax.dynamic_slice_in_dim(h, n_valid - 1, 1, axis=1)
+        hl = _layernorm(last, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
+        k0 = jax.random.fold_in(key, 0)
+        tok = sample_rows(logits, k0[None], temp[None], top_k[None],
+                          top_p[None])
+        return pool_k, pool_v, tok[0]
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+@functools.lru_cache(maxsize=16)
+def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
+                     donate: bool):
+    """Paged draft-and-verify step: ``_verify_fn``'s math over block
+    scatter/gather. All K+1 candidate positions were reserved (and
+    COW-privatized) before dispatch, which is exactly why a rejected
+    draft needs no rollback copy: the stale candidate K/V sits in
+    privately-owned blocks beyond the row's accepted position,
+    unreachable by the position mask until overwritten."""
+    cfg = GPTConfig(*cfg_key)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    identity = lambda t: t
+    rows = spec_len + 1
+
+    def impl(blocks, outer, pool_k, pool_v, table, toks, pos, n_draft,
+             key, fold, temp, top_k, top_p):
+        pidx = jnp.clip(pos + jnp.arange(rows), 0, cfg.seq_len - 1)
+        h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
+        wpos = pos + jnp.arange(rows)
+        blkw = table[jnp.clip(wpos // bs, 0, bpr - 1)]      # (K+1,)
+        offw = wpos % bs
+        for l in range(cfg.n_layer):
+            p = {k: w[l] for k, w in blocks.items()}
+
+            def attn(q, k, v, l=l):
+                pk = pool_k.at[l, blkw, :, offw, :].set(k[0])
+                pv = pool_v.at[l, blkw, :, offw, :].set(v[0])
+                row_k = _gather_row(pk[l], table, cfg.n_head, bs)
+                row_v = _gather_row(pv[l], table, cfg.n_head, bs)
+                return _attn_verify(q, row_k, row_v, pos), (pk, pv)
+
+            h, (pool_k, pool_v) = _block_core_fusedqkv(
+                p, h, cfg.n_head, attn, identity)
+        hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
+        logits = hl[0] @ outer["head"].astype(hl.dtype)     # (K+1, V)
+        folds = fold + jnp.arange(rows)
+        keys_r = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, folds)
+        draft = toks[0, 1:]                                 # (spec_len,)
+        bshape = (spec_len,)
+        acc_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(
+            keys_r[:spec_len])
+        acc = accept_draft_rows(
+            logits[:spec_len], draft, acc_keys,
+            jnp.broadcast_to(temp, bshape), jnp.broadcast_to(top_k, bshape),
+            jnp.broadcast_to(top_p, bshape))
+        acc = acc & (jnp.arange(spec_len) < n_draft)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [acc, jnp.zeros((1,), bool)])).astype(jnp.int32)
+        la = jnp.take(logits, n_acc, axis=0)[None]
+        da = jnp.where(n_acc >= n_draft, -1,
+                       jnp.take(draft, jnp.minimum(n_acc, spec_len - 1)))
+        ke = jax.random.fold_in(jnp.take(keys_r, n_acc, axis=0), 2)
+        emit = residual_sample_rows(la, da[None], ke[None],
+                                    jnp.asarray(temp)[None],
+                                    jnp.asarray(top_k)[None],
+                                    jnp.asarray(top_p)[None])[0]
+        return pool_k, pool_v, n_acc, emit
+
+    return jax.jit(impl, donate_argnums=(2, 3) if donate else ())
+
+
+@functools.lru_cache(maxsize=16)
+def _copy_block_fn(cfg_key: tuple, bs: int, donate: bool):
+    """Jitted copy-on-write fault: duplicate one physical block's K/V
+    (all layers) into a freshly-allocated block — traced src/dst, one
+    compiled signature no matter which blocks fault."""
+    cfg = GPTConfig(*cfg_key)
+    hd = cfg.feat // cfg.n_head
+    size = (cfg.n_layer, 1, cfg.n_head, bs, hd)
+
+    def impl(pool_k, pool_v, src, dst):
+        bk = lax.dynamic_slice(pool_k, (0, src, 0, 0, 0), size)
+        bv = lax.dynamic_slice(pool_v, (0, src, 0, 0, 0), size)
+        pk = lax.dynamic_update_slice(pool_k, bk, (0, dst, 0, 0, 0))
+        pv = lax.dynamic_update_slice(pool_v, bv, (0, dst, 0, 0, 0))
+        return pk, pv
+
+    return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
+
+
+@functools.lru_cache(maxsize=16)
+def _gather_blocks_fn(cfg_key: tuple, bs: int, bpr: int):
+    """Jitted swap-out copy: gather ``bpr`` blocks (padded id vector —
+    pad entries read the garbage block, the host slices them off) out of
+    the pool in one dispatch. Fixed gather width = one compiled
+    signature for every row size; pools NOT donated (the pool keeps
+    serving)."""
+    def impl(pool_k, pool_v, ids):
+        return pool_k[:, ids], pool_v[:, ids]   # (L, bpr, H, bs, d)
+
+    return jax.jit(impl)
+
+
+@functools.lru_cache(maxsize=16)
+def _scatter_blocks_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
+    """Jitted swap-in restore: scatter a padded (L, bpr, H, bs, d) host
+    buffer back into freshly-allocated blocks — the paged analogue of
+    the dense dus-per-cache restore path. Pad entries target the
+    garbage block (id 0), which exists to absorb exactly this kind of
+    write."""
+    def impl(pool_k, pool_v, bufk, bufv, ids):
+        return pool_k.at[:, ids].set(bufk), pool_v.at[:, ids].set(bufv)
+
+    return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
+
+
 class DecodeEngine:
-    """Owns the slot-pool KV caches and drives the jitted programs (one
-    chunk-prefill step, legacy prefill per prompt length, one shared
-    tick, chunk extract/insert for the prefix cache). Host-side state is
-    the caller's job (serve/scheduler.py); this class only moves
-    tensors."""
+    """Owns the KV cache — the dense slot pool, or the paged block pool
+    plus block tables (``num_blocks > 0``) — and drives the jitted
+    programs (one chunk-prefill step, legacy prefill per prompt length,
+    one shared tick, one verify step, plus the paged COW/swap copies).
+    Host-side state is the caller's job (serve/scheduler.py); this
+    class only moves tensors and owns the
+    :class:`~cxxnet_tpu.serve.paged.BlockManager` bookkeeping."""
 
     def __init__(self, cfg: GPTConfig, params: Dict, slots: int,
                  prefill_chunk: int = 64, recompile_limit: int = 0,
                  recompile_strict: bool = True, abstract: bool = False,
-                 spec_len: int = 0, obs_registry=None):
+                 spec_len: int = 0, obs_registry=None,
+                 num_blocks: int = 0, block_size: int = 0):
+        """``num_blocks`` > 0 selects the PAGED cache: a global block
+        pool of that many fixed-size blocks (``block_size`` tokens each;
+        0 = the prefill chunk) indexed by per-row block tables, with
+        copy-on-write prefix sharing and host swap support. 0 (the
+        engine-level default) keeps the dense slot pool. Paging requires
+        chunked prefill (``prefill_chunk`` > 0) and a ``block_size``
+        that divides the (seq_len-clamped) chunk, so chunk windows and
+        prefix-trie nodes always cover whole blocks."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -512,6 +823,22 @@ class DecodeEngine:
         # seq_len - 1 could never all be verified inside one row anyway
         # (the verify writes spec_len + 1 rows from a decode position)
         self.spec_len = min(int(spec_len), max(cfg.seq_len - 1, 0))
+        # paged cache geometry: block_size defaults to the prefill
+        # chunk, and must divide it so every chunk window and every
+        # prefix-trie node covers whole blocks (sub-chunk block sizes
+        # buy finer-grained occupancy at the same alignment guarantees).
+        # _paged_geometry is the shared source of this layout — the
+        # same helper auto_num_blocks sizes budgets with, so a kv_mb
+        # pool can never disagree with the engine's actual blocks.
+        self.paged = int(num_blocks) > 0
+        self.num_blocks = int(num_blocks) if self.paged else 0
+        if self.paged:
+            _, self.block_size, row_len_g, _, self._block_bytes = \
+                _paged_geometry(cfg, prefill_chunk, block_size)
+            assert row_len_g == self.row_len
+        else:
+            self.block_size = 0
+            self._block_bytes = 0
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         # fused QKV once per server lifetime (models/gpt.py does this once
         # per decode CALL; a server amortizes it over every request); an
@@ -521,7 +848,20 @@ class DecodeEngine:
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
                                               "lnf_b", "head")}
         hd = cfg.feat // cfg.n_head
-        shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
+        if self.paged:
+            self.bpr = self.row_len // self.block_size
+            shape = (cfg.n_layer, self.num_blocks, cfg.n_head,
+                     self.block_size, hd)
+            # host-side bookkeeping (free list, refcounts, tables);
+            # validates num_blocks >= bpr + 1 so one full row always
+            # fits. The abstract (audit-only) engine still builds it —
+            # the manager is pure host state, and lint_specs wants bpr.
+            from .paged import BlockManager
+            self.manager = BlockManager(self.num_blocks, slots, self.bpr)
+        else:
+            self.bpr = 0
+            self.manager = None
+            shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
         if abstract:
             # audit-only engine (tools/cxn_lint.py --compile): the cache
             # leaves are ShapeDtypeStructs, so lint_specs can AOT-lower
@@ -546,6 +886,7 @@ class DecodeEngine:
         # per-prompt-length compile storm; the guard makes it loud
         self._guard = None
         self._vguard = None
+        self._tguard = None
         if recompile_limit > 0:
             from ..analysis.recompile import RecompileGuard
             from ..utils import profiler
@@ -569,6 +910,16 @@ class DecodeEngine:
                 lambda sig: None, "serve_verify_chunk", recompile_limit,
                 strict=bool(recompile_strict), log=profiler.warn,
                 on_trip=on_trip)
+            if self.paged:
+                # the paged tick's one legitimate signature is pinned
+                # separately: its block-table shape (slots x bpr) is
+                # part of the counted signature, so a drifting table
+                # shape trips CXN205 naming the drift instead of
+                # silently compiling a second program
+                self._tguard = RecompileGuard(
+                    lambda sig: None, "serve_tick", recompile_limit,
+                    strict=bool(recompile_strict), log=profiler.warn,
+                    on_trip=on_trip)
 
     def set_profiler(self, prof) -> None:
         """Arm live per-program device timing (an
@@ -600,6 +951,14 @@ class DecodeEngine:
         acceptance bound, pinned by tests/test_speculative.py."""
         return self._vguard.signatures if self._vguard is not None else ()
 
+    @property
+    def tick_signatures(self) -> tuple:
+        """Distinct compiled paged-tick signatures seen so far (empty
+        when the guard is off or the engine is dense). One fixed
+        (slots x bpr) block-table shape = one signature across every
+        occupancy mix — pinned by tests/test_serve_paged.py."""
+        return self._tguard.signatures if self._tguard is not None else ()
+
     def lint_specs(self, n_prompt: int = 8, donate: Optional[bool] = None):
         """(label, jitted fn, abstract args, donate_argnums) rows for the
         compiled-step audit (analysis/step_audit.py): prefill at one
@@ -613,6 +972,42 @@ class DecodeEngine:
         nums = (2, 3) if don else ()
         f32, i32, key = jnp.float32, jnp.int32, SDS((2,), jnp.uint32)
         b = self.slots
+        if self.paged:
+            # the paged engine's three programs, audited with abstract
+            # block-table inputs (the tables are traced data, so the
+            # audit sees exactly the one compiled signature each holds)
+            row_t = SDS((self.bpr,), i32)
+            chunk_args = (self._blocks, self._outer, self.cache_k,
+                          self.cache_v, row_t, SDS((1, self.chunk), i32),
+                          SDS((), i32), SDS((), i32), key, SDS((), f32),
+                          SDS((), i32), SDS((), f32))
+            specs = [
+                ("serve_prefill_chunk",
+                 _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
+                                         self.block_size, self.bpr, don),
+                 chunk_args, nums)]
+            if self.spec_len:
+                verify_args = (self._blocks, self._outer, self.cache_k,
+                               self.cache_v, row_t,
+                               SDS((1, self.spec_len + 1), i32),
+                               SDS((), i32), SDS((), i32), key,
+                               SDS((), i32), SDS((), f32), SDS((), i32),
+                               SDS((), f32))
+                specs.append(
+                    ("serve_verify_chunk",
+                     _verify_paged_fn(self._cfg_key, self.spec_len,
+                                      self.block_size, self.bpr, don),
+                     verify_args, nums))
+            tick_args = (self._blocks, self._outer, self.cache_k,
+                         self.cache_v, SDS((b, self.bpr), i32),
+                         SDS((b,), i32), SDS((b,), i32),
+                         SDS((b, 2), jnp.uint32), SDS((b,), i32),
+                         SDS((b,), f32), SDS((b,), i32), SDS((b,), f32))
+            specs.append(
+                ("serve_tick",
+                 _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
+                                don), tick_args, nums))
+            return specs
         prefill_args = (self._blocks, self._outer, self.cache_k,
                         self.cache_v, SDS((1, n_prompt), i32),
                         SDS((), i32), key, SDS((), f32), SDS((), i32),
@@ -650,11 +1045,13 @@ class DecodeEngine:
         return specs
 
     def cache_bytes(self) -> int:
-        """Slot-pool K/V bytes: 2 * layers * slots * heads * row_len *
-        head_dim * itemsize (row_len is chunk-padded seq_len). The
-        serving TOTAL adds the prefix cache on top — up to
-        ``serve_prefix_mb`` more, reported as ``prefix_cache_bytes`` in
-        InferenceServer.metrics() (doc/serving.md memory formula)."""
+        """KV-cache device bytes. Dense: 2 * layers * slots * heads *
+        row_len * head_dim * itemsize (row_len is chunk-padded seq_len),
+        with the prefix cache's copies on top (``prefix_cache_bytes``).
+        Paged: 2 * layers * num_blocks * heads * block_size * head_dim *
+        itemsize — the WHOLE pool, prefix-cache-resident blocks
+        included, since the trie's shared blocks live inside it
+        (doc/serving.md memory formula)."""
         if self.cache_k is None:        # closed (metrics after shutdown)
             return 0
         return 2 * self.cache_k.size * self.cache_k.dtype.itemsize
@@ -670,6 +1067,10 @@ class DecodeEngine:
         token (synchronized — the host needs it for EOS/TTFT anyway).
         The legacy whole-prompt path: one compiled program PER prompt
         length."""
+        if self.paged:
+            raise RuntimeError("whole-prompt prefill is dense-only; the "
+                               "paged engine admits through "
+                               "prefill_chunk")
         n = int(len(prompt))
         self._count_program("n_prompt=%d" % n)
         fn = _prefill_fn(self._cfg_key, n, self.row_len, self._donate)
@@ -704,15 +1105,35 @@ class DecodeEngine:
         if toks.size != self.chunk:
             raise ValueError("prefill_chunk expects exactly %d tokens, "
                              "got %d" % (self.chunk, toks.size))
-        self._count_program("chunk=%d" % self.chunk)
-        fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
-                               self._donate)
+        if self.paged:
+            m = self.manager
+            if (int(start) + self.chunk) > m.nblocks[slot] \
+                    * self.block_size:
+                raise RuntimeError(
+                    "prefill window [%d, %d) not reserved for slot %d "
+                    "(call reserve_window first)"
+                    % (int(start), int(start) + self.chunk, slot))
+            # the block-table shape rides in the counted signature: a
+            # drifting table shape would be a second compiled program
+            self._count_program("chunk=%d/table=%d" % (self.chunk,
+                                                       self.bpr))
+            fn = _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
+                                         self.block_size, self.bpr,
+                                         self._donate)
+            args = (jnp.asarray(m.table[slot]),)
+        else:
+            self._count_program("chunk=%d" % self.chunk)
+            fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
+                                   self._donate)
+            args = ()
         t0 = self._prof.begin("serve_prefill_chunk") \
             if self._prof is not None else None
         with compile_attribution("serve_prefill_chunk"):
             self.cache_k, self.cache_v, tok = fn(
                 self._blocks, self._outer, self.cache_k, self.cache_v,
-                jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+                *args,
+                jnp.asarray(toks)[None],
+                *(() if self.paged else (jnp.asarray(slot, jnp.int32),)),
                 jnp.asarray(start, jnp.int32),
                 jnp.asarray(n_valid, jnp.int32),
                 jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
@@ -746,15 +1167,31 @@ class DecodeEngine:
         if int(pos) + k + 1 > self.row_len:
             raise ValueError("verify window [%d, %d) exceeds row_len %d"
                              % (int(pos), int(pos) + k + 1, self.row_len))
-        if self._vguard is not None:
-            self._vguard("spec_len=%d" % k)
-        fn = _verify_fn(self._cfg_key, k, self._donate)
+        if self.paged:
+            m = self.manager
+            if (int(pos) + k + 1) > m.nblocks[slot] * self.block_size:
+                raise RuntimeError(
+                    "verify window [%d, %d) not reserved for slot %d "
+                    "(call reserve_window first)"
+                    % (int(pos), int(pos) + k + 1, slot))
+            if self._vguard is not None:
+                self._vguard("spec_len=%d/table=%d" % (k, self.bpr))
+            fn = _verify_paged_fn(self._cfg_key, k, self.block_size,
+                                  self.bpr, self._donate)
+            args = (jnp.asarray(m.table[slot]),)
+        else:
+            if self._vguard is not None:
+                self._vguard("spec_len=%d" % k)
+            fn = _verify_fn(self._cfg_key, k, self._donate)
+            args = ()
         t0 = self._prof.begin("serve_verify_chunk") \
             if self._prof is not None else None
         with compile_attribution("serve_verify_chunk"):
             self.cache_k, self.cache_v, n_acc, emit = fn(
                 self._blocks, self._outer, self.cache_k, self.cache_v,
-                jnp.asarray(toks)[None], jnp.asarray(slot, jnp.int32),
+                *args,
+                jnp.asarray(toks)[None],
+                *(() if self.paged else (jnp.asarray(slot, jnp.int32),)),
                 jnp.asarray(pos, jnp.int32),
                 jnp.asarray(n_draft, jnp.int32),
                 jnp.asarray(key), jnp.asarray(fold, jnp.int32),
@@ -770,7 +1207,11 @@ class DecodeEngine:
         """Copy ``n_chunks`` contiguous chunks' K/V out of ``slot``'s row
         from offset ``start`` in one dispatch (the prefix cache's
         copy-out at retire); returns chunk-major stacked (n_chunks,
-        n_layer, n_head, chunk, head_dim) arrays."""
+        n_layer, n_head, chunk, head_dim) arrays. Dense-only: the paged
+        trie shares blocks by id (PagedPrefixCache) and never copies."""
+        if self.paged:
+            raise RuntimeError("extract_row_chunks is dense-only; the "
+                               "paged prefix cache shares blocks by id")
         fn = _extract_chunks_fn(self._cfg_key, self.chunk, int(n_chunks))
         return fn(self.cache_k, self.cache_v, jnp.asarray(slot, jnp.int32),
                   jnp.asarray(start, jnp.int32))
@@ -779,7 +1220,10 @@ class DecodeEngine:
         """Restore a whole matched prefix (``ks``/``vs``: equal-length
         sequences of chunk K/V pairs, contiguous from position 0) into
         ``slot``'s row in ONE jitted call — one dus per cache total
-        instead of one per chunk."""
+        instead of one per chunk. Dense-only (see extract_row_chunks)."""
+        if self.paged:
+            raise RuntimeError("insert_row_prefix is dense-only; the "
+                               "paged prefix cache shares blocks by id")
         fn = _insert_prefix_fn(self._cfg_key, len(ks) * self.chunk,
                                self._donate)
         self.cache_k, self.cache_v = fn(
@@ -797,12 +1241,21 @@ class DecodeEngine:
         token index in ITS OWN request — the fold_in schedule that makes
         a slot row's sample stream identical to the offline path's.
         Returns the (slots,) next tokens, synchronized."""
-        fn = _tick_fn(self._cfg_key, self._donate)
+        if self.paged:
+            if self._tguard is not None:
+                self._tguard("slots=%d/table=%d" % (self.slots, self.bpr))
+            fn = _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
+                                self._donate)
+            args = (jnp.asarray(self.manager.table),)
+        else:
+            fn = _tick_fn(self._cfg_key, self._donate)
+            args = ()
         t0 = self._prof.begin("serve_tick") \
             if self._prof is not None else None
         with compile_attribution("serve_tick"):
             self.cache_k, self.cache_v, nxt = fn(
                 self._blocks, self._outer, self.cache_k, self.cache_v,
+                *args,
                 jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(keys),
                 jnp.asarray(fold), jnp.asarray(temp), jnp.asarray(top_k),
                 jnp.asarray(top_p))
@@ -812,3 +1265,112 @@ class DecodeEngine:
         if t0 is not None:
             self._prof.end("serve_tick", t0)
         return out
+
+    # --------------------------------------------------- paged plumbing
+    def block_bytes(self) -> int:
+        """Device bytes of ONE K/V block pair (all layers) — from the
+        shared _paged_geometry, the same figure auto_num_blocks sizes
+        budgets with."""
+        return self._block_bytes
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        bs = self.block_size
+        return (int(n_tokens) + bs - 1) // bs
+
+    def reserve_window(self, slot: int, p0: int, p1: int,
+                       what: str = "write window") -> None:
+        """Make positions [p0, p1) of ``slot``'s row writable: allocate
+        the missing blocks and copy-on-write-fault any SHARED block the
+        window touches (a prefix-cache hit's blocks, or any block
+        another owner still references). All-or-nothing: the total
+        allocation is pre-flighted, so a
+        :class:`~cxxnet_tpu.serve.paged.BlockPoolExhausted` leaves both
+        the manager and the device pool untouched — the scheduler
+        evicts / preempts and retries. Runs BEFORE the write program
+        dispatches; this ordering is what makes speculative rollback
+        free (rejected drafts sit in already-private blocks)."""
+        m = self.manager
+        bs = self.block_size
+        first, last = int(p0) // bs, (int(p1) - 1) // bs
+        have = m.nblocks[slot]
+        grow = max(0, last + 1 - have)
+        cow = [bi for bi in range(first, min(last, have - 1) + 1)
+               if m.ref[m.table[slot, bi]] > 1]
+        m.require(grow + len(cow), what)
+        don = self._donate
+        for bi in cow:
+            src = int(m.table[slot, bi])
+            dst = m.alloc("copy-on-write fault")
+            fn = _copy_block_fn(self._cfg_key, bs, don)
+            self.cache_k, self.cache_v = fn(
+                self.cache_k, self.cache_v, jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+            m.table[slot, bi] = dst
+            m.decref(src)
+            m.cow_faults += 1
+        for _ in range(grow):
+            m.append_new(slot, what)
+
+    def attach_shared(self, slot: int, block_ids) -> None:
+        """Append shared blocks (a prefix-cache hit) to ``slot``'s
+        table: refcount bumps only, zero K/V copies."""
+        self.manager.append_shared(slot, block_ids)
+
+    def row_block_ids(self, slot: int, lo: int, hi: int):
+        """Physical ids of ``slot``'s logical blocks [lo, hi) — what the
+        paged prefix cache takes ownership refs on at donation."""
+        return self.manager.row_blocks(slot, lo, hi)
+
+    def release_row(self, slot: int) -> int:
+        """Drop every block ref ``slot`` holds (retire / cancel); shared
+        blocks live on through the trie or other rows. Returns blocks
+        actually freed."""
+        return self.manager.release_row(slot)
+
+    def swap_out_row(self, slot: int) -> Dict:
+        """Preemption: copy the CONTENT of every block in ``slot``'s
+        table to host memory and release the row's refs — shared prefix
+        blocks included (the copy makes the resume self-contained even
+        if the trie evicts the prefix meanwhile). Returns the swap
+        record ``{"k", "v", "n", "nbytes"}`` that
+        :meth:`swap_in_row` restores bit-identically."""
+        m = self.manager
+        n = m.nblocks[slot]
+        ids = np.zeros(self.bpr, np.int32)
+        ids[:n] = m.table[slot, :n]
+        fn = _gather_blocks_fn(self._cfg_key, self.block_size, self.bpr)
+        bk, bv = fn(self.cache_k, self.cache_v, jnp.asarray(ids))
+        bk = np.asarray(bk)[:, :n].copy()
+        bv = np.asarray(bv)[:, :n].copy()
+        m.release_row(slot)
+        return {"k": bk, "v": bv, "n": n,
+                "nbytes": bk.nbytes + bv.nbytes}
+
+    def swap_in_row(self, slot: int, rec: Dict) -> None:
+        """Resume a preempted row: allocate ``rec["n"]`` fresh blocks
+        (caller pre-flighted availability), rebuild the table, and
+        scatter the host buffers back — the paged analogue of the dense
+        dus-per-cache restore path. Every restored block is private
+        (ref 1); prefix sharing for a resumed row is rebuilt only by
+        its next admission, never mid-flight."""
+        m = self.manager
+        n = int(rec["n"])
+        m.require(n, "swap-in")
+        ids = np.zeros(self.bpr, np.int32)
+        for i in range(n):
+            b = m.alloc("swap-in")
+            m.append(slot, b)
+            ids[i] = b
+        cfg = self.cfg
+        hd = cfg.feat // cfg.n_head
+        shape = (cfg.n_layer, self.bpr, cfg.n_head, self.block_size, hd)
+        bufk = np.zeros(shape, np.dtype(self.dtype))
+        bufv = np.zeros(shape, np.dtype(self.dtype))
+        bufk[:, :n] = rec["k"]
+        bufv[:, :n] = rec["v"]
+        fn = _scatter_blocks_fn(self._cfg_key, self.block_size, self.bpr,
+                                self._donate)
+        self.cache_k, self.cache_v = fn(
+            self.cache_k, self.cache_v, jnp.asarray(bufk),
+            jnp.asarray(bufv), jnp.asarray(ids))

@@ -1,0 +1,185 @@
+"""Paged KV allocator: fixed-size blocks, refcounts, copy-on-write.
+
+The dense slot pool charges every admitted request for the full
+chunk-padded ``seq_len`` — a 6-token prompt generating 10 tokens pins
+``row_len`` positions of K/V in every layer for its whole lifetime, and
+concurrency is hard-capped at ``serve_slots`` no matter how short the
+live sequences are. The paged layout (vLLM's PagedAttention idea, re-cut
+for this engine's one-compiled-signature discipline) splits the cache
+into a global pool of fixed-size **blocks**
+
+    (n_layer, num_blocks, n_head, block_size, head_dim)
+
+and gives each slot row an ``int32`` **block table** mapping logical
+block index -> physical block id. Occupancy then scales with *tokens in
+flight*: a row holds ``ceil(tokens / block_size)`` blocks, not
+``row_len`` positions, and the same physical block can back several
+rows' tables at once (shared prompt prefixes, the trie in
+serve/prefix_cache.py).
+
+This module is the HOST side only — pure bookkeeping, no jax imports.
+:class:`BlockManager` owns the free list, the per-block refcounts, and
+the per-slot tables; the device side (gather/scatter through traced
+block indices, the COW block copy, swap in/out) lives in
+serve/engine.py, and the *policy* (when to evict the trie, whom to
+preempt) in serve/scheduler.py.
+
+Invariants the rest of the serving stack leans on:
+
+* **Block 0 is the garbage block.** It is never handed out by
+  :meth:`BlockManager.alloc`; every unallocated table entry points at
+  it, so the batched tick's unconditional parked-row write and a padded
+  swap-in scatter always have a harmless landing spot, and the paged
+  gather always reads in-bounds memory (masked to an exact 0.0
+  contribution by the attention's position mask, the same invariant
+  dense recycled rows lean on).
+* **A block with ``ref > 1`` is shared and therefore read-only.** Every
+  write window must run :meth:`~cxxnet_tpu.serve.engine.DecodeEngine.
+  reserve_window` first, which faults shared blocks to private copies
+  (copy-on-write) BEFORE the program writes — never after, which is why
+  a speculative verify whose drafts are rejected needs no rollback copy
+  (the window was privately owned before the forward ran).
+* **Refcounts are ownership counts**: one per row table referencing the
+  block plus one per prefix-trie node holding it. ``decref`` to zero
+  returns the block to the free list; nothing else ever does. At server
+  drain every row is released and the trie cleared, so
+  ``free_count == num_blocks - 1`` (all but the garbage block) — pinned
+  by tests/test_serve_paged.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["BlockManager", "BlockPoolExhausted"]
+
+
+class BlockPoolExhausted(RuntimeError):
+    """An allocation needed more free blocks than the pool holds right
+    now. ``short`` is how many blocks the request is short by — the
+    scheduler uses it to size trie eviction / preemption before
+    retrying. Raised BEFORE any state is mutated, so a caught exhaustion
+    leaves the manager and the device pool consistent."""
+
+    def __init__(self, short: int, what: str = "allocation"):
+        super().__init__(
+            "KV block pool exhausted: %s needs %d more free block(s) "
+            "(evict prefix-cache blocks, preempt a row, or raise "
+            "serve_num_blocks / serve_kv_mb)" % (what, short))
+        self.short = int(short)
+
+
+class BlockManager:
+    """Free list + refcounts + per-slot block tables for one engine's
+    block pool. Single-threaded by design (the server's scheduler
+    thread), like every other piece of serve host state."""
+
+    def __init__(self, num_blocks: int, slots: int, blocks_per_row: int):
+        if num_blocks < blocks_per_row + 1:
+            raise ValueError(
+                "serve_num_blocks=%d cannot hold one full row: need >= "
+                "blocks_per_row + 1 = %d (the +1 is the reserved garbage "
+                "block; raise serve_num_blocks / serve_kv_mb or shrink "
+                "seq_len)" % (num_blocks, blocks_per_row + 1))
+        self.num_blocks = int(num_blocks)
+        self.bpr = int(blocks_per_row)
+        self.slots = int(slots)
+        # block 0 reserved: parked writes / padded swap scatters land
+        # there, and a ref of 1 keeps it permanently off the free list
+        self.ref = np.zeros(self.num_blocks, np.int32)
+        self.ref[0] = 1
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        # logical -> physical per slot row; unallocated entries stay 0
+        # (the garbage block), which keeps every traced gather in-bounds
+        self.table = np.zeros((self.slots, self.bpr), np.int32)
+        self.nblocks = [0] * self.slots     # valid entries per row
+        # traffic counters (read by the obs registry at collection time)
+        self.cow_faults = 0
+        self.allocated_total = 0
+
+    # ------------------------------------------------------------ state
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def counts(self) -> Dict[str, int]:
+        """{"free", "private", "shared"} block counts (garbage block
+        excluded). ``shared`` = referenced by more than one owner (rows
+        and/or trie nodes) — the blocks copy-on-write protects."""
+        shared = int((self.ref[1:] > 1).sum())
+        private = int((self.ref[1:] == 1).sum())
+        return {"free": len(self._free), "private": private,
+                "shared": shared}
+
+    def used_tokens_capacity(self, block_size: int) -> int:
+        """Token capacity of the allocatable pool (garbage excluded)."""
+        return (self.num_blocks - 1) * int(block_size)
+
+    # ------------------------------------------------------ alloc / ref
+    def alloc(self, what: str = "allocation") -> int:
+        if not self._free:
+            raise BlockPoolExhausted(1, what)
+        b = self._free.pop()
+        self.ref[b] = 1
+        self.allocated_total += 1
+        return b
+
+    def require(self, n: int, what: str = "allocation") -> None:
+        """Raise :class:`BlockPoolExhausted` unless ``n`` blocks are
+        free — the pre-flight check that keeps multi-block operations
+        all-or-nothing."""
+        if n > len(self._free):
+            raise BlockPoolExhausted(n - len(self._free), what)
+
+    def incref(self, b: int) -> None:
+        assert b != 0, "the garbage block is never shared"
+        self.ref[b] += 1
+
+    def decref(self, b: int) -> bool:
+        """Drop one ownership ref; returns True when the block was freed
+        (refcount reached zero)."""
+        assert b != 0 and self.ref[b] > 0, "bad decref of block %d" % b
+        self.ref[b] -= 1
+        if self.ref[b] == 0:
+            self._free.append(b)
+            return True
+        return False
+
+    # ------------------------------------------------------- row tables
+    def append(self, slot: int, b: int) -> None:
+        """Append an (already ref-owned) block to ``slot``'s table."""
+        i = self.nblocks[slot]
+        assert i < self.bpr, "row %d table full" % slot
+        self.table[slot, i] = b
+        self.nblocks[slot] = i + 1
+
+    def append_new(self, slot: int, what: str = "row growth") -> int:
+        b = self.alloc(what)
+        self.append(slot, b)
+        return b
+
+    def append_shared(self, slot: int, ids) -> None:
+        """Append shared blocks (a prefix-cache hit) to ``slot``'s
+        table: one ref per block for this row, zero K/V copies."""
+        for b in ids:
+            self.incref(int(b))
+            self.append(slot, int(b))
+
+    def row_blocks(self, slot: int, lo: int = 0, hi: int = -1) -> List[int]:
+        """Physical block ids of ``slot``'s logical blocks [lo, hi)."""
+        if hi < 0:
+            hi = self.nblocks[slot]
+        return [int(b) for b in self.table[slot, lo:hi]]
+
+    def release_row(self, slot: int) -> int:
+        """Drop every block ref this row holds (retire / swap-out /
+        cancel); shared blocks survive through their other owners.
+        Returns how many blocks were actually freed."""
+        freed = 0
+        for i in range(self.nblocks[slot]):
+            freed += bool(self.decref(int(self.table[slot, i])))
+        self.table[slot, :] = 0
+        self.nblocks[slot] = 0
+        return freed

@@ -30,6 +30,13 @@ and no in-flight copy — so an interior node can never be evicted from
 under its children and a chain stays contiguous. Budget 0 disables reuse
 entirely (match/insert become no-ops).
 
+Two trie flavors share this module: :class:`PrefixCache` (dense engine —
+nodes hold K/V COPIES extracted from retired rows, restored by a jitted
+dus at admit) and :class:`PagedPrefixCache` (paged engine — nodes hold
+BLOCK IDS with refcounts: hits append shared blocks to the admitting
+row's table with zero copies, donation happens at prefill completion so
+LIVE rows share too, and copy-on-write protects the shared blocks).
+
 Thread-safety: all methods run on the server's single scheduler thread
 (the same discipline as serve/scheduler.py); the unit tests drive it
 directly from one thread.
@@ -49,7 +56,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "PagedPrefixCache"]
 
 
 class _Node:
@@ -276,6 +283,293 @@ class PrefixCache:
         """Drop every cached chunk (server shutdown)."""
         for node in self._nodes:
             node.k = node.v = None
+            node.children = {}
+            node.parent = None
+        self._nodes = {}
+        self._children = {}
+        self._bytes = 0
+
+
+class _PagedNode:
+    """One cached chunk in the PAGED trie: the payload is a tuple of
+    physical block IDS (chunk // block_size of them) the trie holds an
+    ownership ref on — never a K/V copy."""
+
+    __slots__ = ("tokens", "blocks", "parent", "children", "refs",
+                 "last_used")
+
+    def __init__(self, tokens: tuple, blocks: tuple,
+                 parent: Optional["_PagedNode"]):
+        self.tokens = tokens
+        self.blocks = blocks
+        self.parent = parent
+        self.children: Dict[tuple, "_PagedNode"] = {}
+        self.refs = 0               # child chunks
+        self.last_used = 0
+
+
+class PagedPrefixCache:
+    """Zero-copy shared-prefix reuse over the paged block pool: the same
+    chunk-granular token-trie as :class:`PrefixCache`, but each node
+    holds BLOCK IDS instead of host K/V copies.
+
+    * **Hit** (``copy_into``): the matched chain's block ids are
+      appended to the admitting row's block table with one refcount bump
+      per block — zero device copies, zero recompute. The row and the
+      trie (and any other live row that hit the same prefix) now share
+      physical blocks; copy-on-write in engine.reserve_window keeps the
+      sharing safe if a write window ever lands in one.
+    * **Donation** (``donate_from_row``): at PREFILL COMPLETION — not
+      retire — the row's complete prompt chunks are offered to the trie,
+      which takes one ownership ref per block. Donating from a LIVE row
+      is what extends prefix sharing to concurrent traffic: a burst of
+      same-prefix requests hits the first request's blocks the moment
+      its prefill lands, instead of waiting for it to retire.
+    * **Eviction**: LRU over refcount-0 leaf nodes, under the
+      ``serve_prefix_mb`` byte budget (``node_bytes`` per node, the
+      blocks' pool bytes) — plus ``evict_blocks(n)``, the pool-pressure
+      path the scheduler calls before preempting a row: it ignores the
+      byte budget and frees LRU nodes until ``n`` pool blocks actually
+      returned to the free list. A node whose blocks are still
+      borrowed by live rows frees nothing immediately (the rows keep
+      their refs) but stops retaining them once those rows release.
+
+    Counter semantics (hits / misses / hit_tokens / prompt_tokens /
+    evictions / inserted_chunks) match :class:`PrefixCache`, so the
+    server's ``cxn_prefix_*`` metric family and ``prefix_hit_rate``
+    gauge read identically in both modes."""
+
+    def __init__(self, engine, budget_bytes: int):
+        if not getattr(engine, "paged", False):
+            raise ValueError("PagedPrefixCache needs a paged engine "
+                             "(num_blocks > 0); dense engines use "
+                             "PrefixCache")
+        self.engine = engine
+        self.chunk = int(engine.chunk)
+        self.cpb = self.chunk // engine.block_size   # blocks per chunk
+        self.budget = int(budget_bytes)
+        self.node_bytes = engine.block_bytes() * self.cpb
+        self._children: Dict[tuple, _PagedNode] = {}
+        self._nodes: Dict[_PagedNode, None] = {}
+        self._clock = 0
+        self._bytes = 0
+        self.reset_counters()
+
+    # ------------------------------------------------------------- state
+    @property
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    @property
+    def nbytes(self) -> int:
+        """Pool bytes RETAINED by the trie (nodes * node_bytes); a
+        subset of the block pool's total, not memory on top of it."""
+        return self._bytes
+
+    @property
+    def chunks(self) -> int:
+        return len(self._nodes)
+
+    def reset_counters(self) -> None:
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.prompt_tokens = 0
+        self.evictions = 0
+        self.inserted_chunks = 0
+        self._budget_warned = False
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _chunk_key(self, prompt, i: int) -> tuple:
+        c = self.chunk
+        return tuple(int(t) for t in prompt[i * c:(i + 1) * c])
+
+    # ------------------------------------------------------------- match
+    def match(self, prompt) -> List[_PagedNode]:
+        """Longest cached complete-chunk chain prefixing ``prompt``,
+        capped strictly before the final token (the final chunk must
+        run to sample the request's first token with its own key)."""
+        if not self.enabled:
+            return []
+        out: List[_PagedNode] = []
+        children = self._children
+        for i in range((len(prompt) - 1) // self.chunk):
+            node = children.get(self._chunk_key(prompt, i))
+            if node is None:
+                break
+            out.append(node)
+            children = node.children
+        return out
+
+    def match_tokens(self, prompt) -> int:
+        """Tokens a hit would restore (the admission gate's estimate —
+        no refcounts are touched)."""
+        return len(self.match(prompt)) * self.chunk
+
+    def copy_into(self, slot: int, prompt) -> int:
+        """Append the longest cached prefix's shared blocks to
+        ``slot``'s block table (one incref per block, NO device copy);
+        returns tokens restored. The dense method name is kept so the
+        scheduler drives both cache kinds identically."""
+        if not self.enabled:
+            return 0
+        self.prompt_tokens += len(prompt)
+        nodes = self.match(prompt)
+        if not nodes:
+            self.misses += 1
+            return 0
+        now = self._tick()
+        ids = []
+        for nd in nodes:
+            nd.last_used = now
+            ids.extend(nd.blocks)
+        self.engine.attach_shared(slot, ids)
+        self.hits += 1
+        restored = len(nodes) * self.chunk
+        self.hit_tokens += restored
+        return restored
+
+    # ------------------------------------------------------------ donate
+    def donate_from_row(self, slot: int, prompt) -> int:
+        """Offer ``slot``'s complete prompt chunks to the trie: the trie
+        takes one ownership ref per block of each not-yet-cached chunk
+        (zero copies — the blocks stay exactly where they are). Returns
+        chunks added. Safe from a LIVE row: the donated blocks cover
+        positions < len(prompt), and every later write the row makes
+        lands at >= len(prompt) (chunk pads included — windows are
+        block-aligned), so the row never writes into what it donated;
+        if it somehow did, reserve_window's COW fault would protect the
+        share anyway."""
+        if not self.enabled:
+            return 0
+        n_chunks = len(prompt) // self.chunk
+        n_chunks = min(n_chunks, self.budget // max(1, self.node_bytes))
+        if not n_chunks:
+            return 0
+        now = self._tick()
+        keys = [self._chunk_key(prompt, i) for i in range(n_chunks)]
+        children = self._children
+        parent: Optional[_PagedNode] = None
+        i = 0
+        while i < n_chunks:
+            node = children.get(keys[i])
+            if node is None:
+                break
+            node.last_used = now
+            parent = node
+            children = node.children
+            i += 1
+        added = 0
+        m = self.engine.manager
+        for j in range(i, n_chunks):
+            blocks = tuple(self.engine.row_block_ids(
+                slot, j * self.cpb, (j + 1) * self.cpb))
+            for b in blocks:
+                m.incref(b)
+            node = _PagedNode(keys[j], blocks, parent)
+            node.last_used = now
+            children[keys[j]] = node
+            if parent is not None:
+                parent.refs += 1
+            self._nodes[node] = None
+            self._bytes += self.node_bytes
+            self.inserted_chunks += 1
+            added += 1
+            parent = node
+            children = node.children
+        self.evict_to_budget()
+        return added
+
+    # ------------------------------------------------------------- evict
+    def evict_to_budget(self) -> int:
+        """LRU-evict refcount-0 leaf nodes until the byte budget holds
+        (same sweep discipline as the dense trie)."""
+        n = 0
+        if self._bytes > self.budget and not self._budget_warned:
+            self._budget_warned = True
+            from ..utils import profiler
+            profiler.warn(
+                "paged prefix trie reached its %.1f MiB budget (%d "
+                "chunks retained); LRU eviction begins — raise "
+                "serve_prefix_mb if the hit rate drops"
+                % (self.budget / 2.0 ** 20, self.chunks))
+        while self._bytes > self.budget:
+            sweep = sorted((nd for nd in self._nodes if nd.refs == 0),
+                           key=lambda nd: nd.last_used)
+            if not sweep:
+                break
+            for node in sweep:
+                if self._bytes <= self.budget:
+                    break
+                self._remove(node)
+                self.evictions += 1
+                n += 1
+        return n
+
+    def evict_blocks(self, n_blocks: int) -> int:
+        """Pool-pressure eviction: free LRU nodes (budget ignored) until
+        ``n_blocks`` blocks actually hit the free list or nothing
+        evictable remains; returns blocks freed. Borrowed nodes (live
+        rows still hold refs on their blocks) free nothing now — the
+        scheduler falls through to preemption in that case."""
+        freed = 0
+        m = self.engine.manager
+        while freed < n_blocks:
+            # only evict nodes whose removal actually frees a block:
+            # a node whose blocks are ALL borrowed by live rows yields
+            # nothing now, and dropping it would annihilate the cache
+            # (and every future hit on that chain) for zero reclaimed
+            # memory — leave it, fall through to preemption instead
+            sweep = sorted(
+                (nd for nd in self._nodes if nd.refs == 0
+                 and any(m.ref[b] == 1 for b in nd.blocks)),
+                key=lambda nd: nd.last_used)
+            if not sweep:
+                break
+            for node in sweep:
+                if freed >= n_blocks:
+                    break
+                before = m.free_count
+                self._remove(node)
+                self.evictions += 1
+                freed += m.free_count - before
+        return freed
+
+    def reclaimable_blocks(self) -> int:
+        """Blocks eviction could eventually free (every block the trie
+        ALONE owns — the sweep cascades tail-first, so interior nodes
+        count too once their leaves go) — the admission gate's headroom
+        estimate. Blocks borrowed by live rows are excluded: evicting
+        their nodes frees nothing until the rows release."""
+        m = self.engine.manager
+        n = 0
+        for nd in self._nodes:
+            n += sum(1 for b in nd.blocks if m.ref[b] == 1)
+        return n
+
+    def _remove(self, node: _PagedNode) -> None:
+        parent = node.parent
+        siblings = parent.children if parent is not None else self._children
+        del siblings[node.tokens]
+        if parent is not None:
+            parent.refs -= 1
+        del self._nodes[node]
+        self._bytes -= self.node_bytes
+        m = self.engine.manager
+        for b in node.blocks:
+            m.decref(b)
+        node.blocks = ()
+
+    def clear(self) -> None:
+        """Release every trie block ref (server shutdown)."""
+        m = self.engine.manager
+        for node in self._nodes:
+            for b in node.blocks:
+                m.decref(b)
+            node.blocks = ()
             node.children = {}
             node.parent = None
         self._nodes = {}

@@ -24,6 +24,30 @@ Per scheduler pass (driven by serve/server.py's loop):
    short requests leave the batch the moment they finish instead of
    convoying behind long ones.
 
+**Paged mode** (the engine owns a block pool instead of dense rows,
+serve/paged.py) adds block policy on top of the same loop:
+
+* every device write is preceded by ``engine.reserve_window`` — block
+  allocation plus copy-on-write faults for shared blocks — wrapped in
+  :meth:`SlotScheduler._reserve`, which on pool exhaustion first evicts
+  prefix-trie blocks (LRU, cheapest — they are a cache) and then
+  **preempts** the youngest-admitted other row: its blocks are swapped
+  to a host buffer, its slot freed, and the request parked on a resume
+  list. Speculative verifies never preempt (speculation is optional
+  work — the row just ticks instead this pass);
+* prefix donation moves from retire to PREFILL COMPLETION
+  (``donate_from_row``), so live rows share blocks with concurrent
+  same-prefix traffic at zero copies;
+* swapped requests RESUME with strict priority over new admissions
+  (``resume_swapped``, oldest admit first) the moment a slot and their
+  blocks are available — the swap-in restore is bit-exact, so a
+  preempted request's tokens are identical to an undisturbed run;
+* admission is gated on block headroom (``admissible``): the queue head
+  only claims a slot when its prompt's blocks (minus the prefix-cache
+  hit it would get) fit in free + trie-reclaimable blocks, so thousands
+  of queued requests degrade into orderly waiting instead of admit/
+  preempt thrash.
+
 The scheduler is single-threaded by design (only the server's scheduler
 thread calls it); cross-thread state (the admission queue, completion
 events) lives in the server.
@@ -133,6 +157,7 @@ class SlotScheduler:
                  on_finish=None, prefix_cache=None, drafters=None,
                  spec_mode: str = "off", spec_len: int = 0, tracer=None):
         self.engine = engine
+        self.paged = bool(getattr(engine, "paged", False))
         self.stats = stats or profiler.StepStats()
         # request-scoped span recording (obs/trace.py): None = off.
         # Per-request spans go on the request's own track; work shared
@@ -203,6 +228,15 @@ class SlotScheduler:
         # request ids in admission order (bounded: diagnostic window, not
         # a full history — a hot server admits forever)
         self.admit_order: collections.deque = collections.deque(maxlen=4096)
+        # paged preemption/swap state: records of swapped-out rows
+        # awaiting resume ({"req", "phase", host K/V buffers, decode or
+        # prefill cursor}), plus the traffic counters the obs registry
+        # reads. swap_host_bytes tracks the LIVE host buffer footprint
+        # (the `swap_host` ledger pool), not a cumulative total.
+        self._swapped: List[dict] = []
+        self.swaps_out = 0
+        self.swaps_in = 0
+        self.swap_host_bytes = 0
 
     # ------------------------------------------------------------- state
     @property
@@ -234,6 +268,220 @@ class SlotScheduler:
         if not self.ticks:
             return 0.0
         return self.active_row_ticks / float(self.ticks * self.engine.slots)
+
+    @property
+    def swapped_pending(self) -> int:
+        """Preempted requests waiting to resume (paged mode)."""
+        return len(self._swapped)
+
+    def live_tokens(self) -> int:
+        """Cache positions written and still live across occupied rows
+        (decoding rows' current position + prefilling rows' consumed
+        prompt) — the numerator of token-level KV utilization."""
+        t = 0
+        for slot, req in enumerate(self._req):
+            if req is not None:
+                t += int(self._pos[slot])
+        for slot in self._prefill_q:
+            st = self._pending[slot]
+            if st is not None:
+                t += int(st["next"])
+        return t
+
+    def kv_token_utilization(self) -> float:
+        """Token-level KV utilization in [0, 1]. Paged: PHYSICAL —
+        allocated blocks / allocatable pool (shared blocks counted
+        once, however many rows' tables reference them; trie-retained
+        blocks count as used — they hold real K/V). Dense:
+        live_tokens / (slots * row_len), which reads LOW by
+        construction — every admitted row pins row_len positions
+        regardless of its length — exactly the waste paging removes
+        (doc/serving.md). A logical-token numerator would double-count
+        shared prefixes and read over 1.0 under heavy sharing."""
+        eng = self.engine
+        if self.paged:
+            usable = eng.num_blocks - 1
+            used = usable - eng.manager.free_count
+            return used / float(max(1, usable))
+        return self.live_tokens() / float(max(1, eng.slots * eng.row_len))
+
+    # ----------------------------------------------------- block policy
+    def admission_need(self, req: Request) -> int:
+        """Blocks this request's admission will ALLOCATE: its prompt
+        (plus one decode block), minus the prefix-cache hit it would
+        get RIGHT NOW (same-prefix requests popped in one burst get no
+        credit for each other's not-yet-donated chunks — conservative,
+        which is the safe direction for a gate)."""
+        if not self.paged:
+            return 0
+        eng = self.engine
+        need = eng.blocks_for(len(req.prompt) + 1)
+        if self.prefix is not None:
+            need -= self.prefix.match_tokens(req.prompt) \
+                // eng.block_size
+        return max(0, need)
+
+    def admission_claim(self, req: Request) -> int:
+        """Credit this admission consumes from the gate's free +
+        reclaimable pot: allocations AND borrowed prefix-hit blocks —
+        a hit pins its trie chain (refcounts rise past 1), so those
+        blocks stop being reclaimable the moment the admit runs. The
+        full prompt block count is exactly need + hit."""
+        if not self.paged:
+            return 0
+        return self.engine.blocks_for(len(req.prompt) + 1)
+
+    def admissible(self, req: Request, claimed: int = 0) -> bool:
+        """Paged admission gate: can ``req`` be backed by free +
+        trie-reclaimable blocks, AFTER subtracting ``claimed`` — the
+        credit (admission_claim) already promised to requests popped
+        earlier in the same scheduler pass? Their allocations happen
+        later, outside the admission lock, and their prefix hits pin
+        trie blocks that reclaimable_blocks still counts — so without
+        ``claimed`` a burst would over-admit against a pot that hasn't
+        moved yet and preempt-thrash the just-admitted rows. Dense
+        mode admits on slots alone (the dense pool pre-pays every
+        row). FIFO is preserved — the server stops popping at the
+        first inadmissible head rather than searching the queue for
+        smaller requests."""
+        if not self.paged:
+            return True
+        need = self.admission_need(req)
+        if need <= 0:
+            return True
+        avail = self.engine.manager.free_count - int(claimed)
+        if avail < need and self.prefix is not None:
+            avail += self.prefix.reclaimable_blocks()
+        return avail >= need
+
+    def _reserve(self, slot: int, p0: int, p1: int,
+                 allow_preempt: bool = True,
+                 what: str = "write window") -> bool:
+        """Make [p0, p1) of ``slot``'s row writable, creating room by
+        (1) evicting prefix-trie blocks, then (2) preempting the
+        youngest-admitted OTHER row, until the engine's reserve_window
+        succeeds. Terminates: every retry either freed trie blocks or
+        removed a row, both finite. Returns False only when the pool
+        cannot hold the window at all (with num_blocks >= bpr + 1 that
+        means allow_preempt=False and no trie headroom)."""
+        if not self.paged:
+            return True
+        from .paged import BlockPoolExhausted
+        while True:
+            try:
+                self.engine.reserve_window(slot, p0, p1, what=what)
+                return True
+            except BlockPoolExhausted as e:
+                if self.prefix is not None \
+                        and self.prefix.evict_blocks(e.short) > 0:
+                    continue
+                if allow_preempt and self._preempt_one(exclude=slot):
+                    continue
+                return False
+
+    def _preempt_one(self, exclude: int) -> bool:
+        """Swap out the lowest-priority occupied row (the youngest
+        admit — it has done the least work and re-queues behind the
+        least history), never ``exclude``. Decoding and still-
+        prefilling rows are both fair game; returns False when no
+        victim exists."""
+        victim, t_adm = None, -1.0
+        for slot, req in enumerate(self._req):
+            if req is not None and slot != exclude \
+                    and req.admit_t > t_adm:
+                victim, t_adm = slot, req.admit_t
+        for slot in self._prefill_q:
+            st = self._pending[slot]
+            if st is not None and slot != exclude \
+                    and st["req"].admit_t > t_adm:
+                victim, t_adm = slot, st["req"].admit_t
+        if victim is None:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Swap ``slot``'s blocks to host and park its request on the
+        resume list. The record carries everything a bit-exact resume
+        needs: the decode cursor (pos / fold / last token) or the
+        prefill cursor (next), the PRNG key, and the blocks' contents."""
+        st = self._pending[slot]
+        if st is not None:                  # mid-prefill victim
+            req, key = st["req"], st["key"]
+            rec = {"req": req, "key": key, "phase": "prefill",
+                   "next": st["next"]}
+            self._pending[slot] = None
+            self._prefill_q.remove(slot)
+        else:
+            req = self._req[slot]
+            rec = {"req": req, "key": self._keys[slot].copy(),
+                   "phase": "decode", "tok": int(self._tok[slot]),
+                   "pos": int(self._pos[slot]),
+                   "fold": int(self._fold[slot])}
+            self._req[slot] = None
+        rec["spec"] = (int(self._spec_try[slot]),
+                       int(self._spec_hit[slot]), self._spec_off[slot])
+        swap = self.engine.swap_out_row(slot)
+        rec.update(swap)
+        req.status = "swapped"
+        req.slot = None
+        self._tok[slot] = 0
+        self._pos[slot] = self._park
+        self._fold[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._free.append(slot)
+        self._swapped.append(rec)
+        self.swaps_out += 1
+        self.swap_host_bytes += rec["nbytes"]
+
+    def resume_swapped(self) -> int:
+        """Swap preempted requests back in — oldest admit first, one per
+        free slot, as soon as their blocks fit (evicting trie blocks if
+        that closes the gap). Called by the server each pass BEFORE new
+        admissions, so a preempted request can never be starved by
+        fresh traffic. Returns how many resumed."""
+        n = 0
+        while self._swapped and self._free:
+            rec = min(self._swapped, key=lambda r: r["req"].admit_t)
+            need = rec["n"]
+            m = self.engine.manager
+            if need > m.free_count:
+                short = need - m.free_count
+                if self.prefix is not None \
+                        and self.prefix.evict_blocks(short) > 0:
+                    continue
+                break                       # wait for retires
+            self._swapped.remove(rec)
+            slot = self._free.pop()
+            self.engine.swap_in_row(slot, rec)
+            self.swaps_in += 1
+            self.swap_host_bytes -= rec["nbytes"]
+            req = rec["req"]
+            req.slot = slot
+            for d in self.drafters.values():
+                d.reset(slot)
+            self._spec_try[slot], self._spec_hit[slot], \
+                self._spec_off[slot] = rec["spec"]
+            self._keys[slot] = rec["key"]
+            p = req.params
+            if rec["phase"] == "prefill":
+                req.status = "prefill"
+                self._pending[slot] = {"req": req, "key": rec["key"],
+                                       "next": rec["next"]}
+                self._prefill_q.append(slot)
+            else:
+                req.status = "active"
+                self._tok[slot] = rec["tok"]
+                self._pos[slot] = rec["pos"]
+                self._fold[slot] = rec["fold"]
+                self._temp[slot] = p.temperature
+                self._topk[slot] = p.top_k
+                self._topp[slot] = p.top_p
+                self._req[slot] = req
+            n += 1
+        return n
 
     # ------------------------------------------------------------- admit
     def admit(self, req: Request) -> None:
@@ -309,6 +557,16 @@ class SlotScheduler:
         end = min(start + self.chunk, n)
         toks = np.zeros(self.chunk, np.int32)
         toks[:end - start] = req.prompt[start:end]
+        # paged: allocate (and COW-privatize) the chunk's full write
+        # window first — the program writes chunk tokens at start even
+        # when fewer are valid (the padded final chunk)
+        if self.paged and not self._reserve(slot, start,
+                                            start + self.chunk,
+                                            what="prefill chunk"):
+            # unreachable with num_blocks >= bpr + 1 (a lone row always
+            # fits once the trie is evicted and every other row swapped)
+            raise RuntimeError("block pool cannot hold one prefill "
+                               "window; serve_num_blocks is too small")
         t0 = time.perf_counter()
         with self.stats.phase(profiler.PREFILL_CHUNK):
             tok = self.engine.prefill_chunk(slot, toks, start, end - start,
@@ -345,6 +603,14 @@ class SlotScheduler:
         req.status = "active"
         req.tokens.append(tok)
         self.tokens_generated += 1
+        if self.paged and self.prefix is not None:
+            # eager donation: the row's complete prompt chunks join the
+            # trie NOW (zero-copy ownership refs), so concurrent
+            # same-prefix requests share this LIVE row's blocks instead
+            # of waiting for it to retire
+            with self.stats.phase(profiler.PREFIX_COPY):
+                self.prefix.donate_from_row(slot, req.prompt)
+            self.stats.end_step()
         if self._finished(req, tok):
             self._retire(req, "ok")
             return
@@ -374,12 +640,17 @@ class SlotScheduler:
             # ValueError here is a real bug, not a race to paper over
             self._pending[slot] = None
             self._prefill_q.remove(slot)
-        elif status == "ok" and self.prefix is not None:
-            # offer the row's complete prompt chunks to the prefix cache
-            # BEFORE the slot is recycled (the copy-out reads the row)
+        elif status == "ok" and self.prefix is not None and not self.paged:
+            # dense path: offer the row's complete prompt chunks to the
+            # prefix cache BEFORE the slot is recycled (the copy-out
+            # reads the row). Paged rows donated at prefill completion.
             with self.stats.phase(profiler.PREFIX_COPY):
                 self.prefix.insert_from_row(slot, req.prompt)
             self.stats.end_step()
+        if self.paged:
+            # drop the row's block refs; blocks donated to the trie (or
+            # shared with other live rows) survive through their refs
+            self.engine.release_row(slot)
         self._req[slot] = None
         self._temp[slot] = 0.0
         self._topk[slot] = 0
@@ -458,6 +729,14 @@ class SlotScheduler:
             if k_eff < 1 or remaining < 2:
                 continue
             if int(self._pos[slot]) + K + 1 > self.engine.row_len:
+                continue
+            if self.paged and not self._reserve(
+                    slot, int(self._pos[slot]),
+                    int(self._pos[slot]) + K + 1, allow_preempt=False,
+                    what="speculative verify window"):
+                # speculation is optional work: under block pressure the
+                # row just ticks this pass instead of preempting a
+                # neighbor to make room for drafts
                 continue
             want[slot] = (mode, k_eff)
         if not want:
@@ -548,6 +827,21 @@ class SlotScheduler:
         """One batched decode step; returns the number of still-decoding
         slots afterwards. Rows still in chunk prefill are skipped (their
         device rows are parked dummies)."""
+        if self.paged:
+            # every decoding row writes its position's K/V this tick:
+            # allocate boundary-crossing blocks and COW-privatize shared
+            # ones up front, preempting the youngest other row under
+            # pool pressure (a preempted victim drops out of this tick)
+            for slot in [s for s, r in enumerate(self._req)
+                         if r is not None]:
+                if self._req[slot] is None:
+                    continue            # preempted by an earlier reserve
+                pos = int(self._pos[slot])
+                if not self._reserve(slot, pos, pos + 1,
+                                     what="decode tick"):
+                    raise RuntimeError("block pool cannot hold one "
+                                       "decode position; "
+                                       "serve_num_blocks is too small")
         decoding = self.decoding
         if decoding == 0:
             return 0
@@ -594,4 +888,12 @@ class SlotScheduler:
             if st is not None:
                 self._retire(st["req"], "cancelled", "server shutdown")
                 n += 1
+        for rec in self._swapped:           # swapped-out requests hold
+            req = rec["req"]                # no slot — finish directly
+            req.finish("cancelled", "server shutdown")
+            if self.on_finish is not None:
+                self.on_finish(req)
+            n += 1
+        self._swapped = []
+        self.swap_host_bytes = 0
         return n

@@ -2,8 +2,14 @@
 
 ``submit(prompt, params) -> handle`` / ``result(handle)`` over a bounded
 admission queue, with a dedicated scheduler thread driving the
-continuous-batching loop (serve/scheduler.py) against the slot-pool
-decode engine (serve/engine.py). Prefill runs CHUNKED by default
+continuous-batching loop (serve/scheduler.py) against the decode engine
+(serve/engine.py) — by default the PAGED engine: a global KV block pool
+with per-row block tables, zero-copy copy-on-write prefix sharing, and
+preemption/swap of rows to host memory under pool pressure, so admitted
+concurrency scales with tokens in flight instead of being hard-capped
+at ``slots * seq_len`` worth of dense rows (doc/serving.md "Paged KV
+cache"; ``paged=False`` restores the dense pool). Prefill runs CHUNKED
+by default
 (``prefill_chunk`` tokens per jitted step, at most ``prefill_budget``
 chunks interleaved with each decode tick) with shared-prefix KV reuse
 (serve/prefix_cache.py, ``prefix_mb`` byte budget); ``prefill_chunk=0``
@@ -103,7 +109,9 @@ class InferenceServer:
                  recompile_strict: bool = True, spec_mode: str = "off",
                  spec_len: int = 4, spec_model=None, tracer=None,
                  registry=None, slow_ms: float = 0.0,
-                 prof_every: int = 0):
+                 prof_every: int = 0, paged: bool = True,
+                 block_size: int = 0, num_blocks: int = 0,
+                 kv_mb: float = 0.0):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -133,6 +141,20 @@ class InferenceServer:
         Prometheus text. ``slow_ms`` > 0 arms the slow-request
         exemplar hook: any request whose TTFT or total latency exceeds
         it has its span tree auto-dumped (``Tracer.note_slow``).
+        Paged KV cache (the default; doc/serving.md "Paged KV cache"):
+        ``paged=True`` with chunking replaces the dense slot pool by a
+        global block pool + per-row block tables — occupancy scales
+        with tokens in flight, prefix sharing is zero-copy
+        (copy-on-write protected), and under pool pressure the
+        scheduler preempts rows to a host swap buffer and resumes them
+        bit-identically. ``block_size`` is the block's token width
+        (0 = the prefill chunk; must divide it), ``num_blocks`` the
+        pool size (0 = auto: dense-equivalent ``slots`` rows plus trie
+        headroom, or ``kv_mb`` MiB when given — the explicit budget
+        wins over the formula). ``paged=False`` or
+        ``prefill_chunk=0`` keeps the dense pool (one row per slot —
+        still the better layout when every request runs near seq_len).
+
         ``prof_every`` > 0 arms the device/compiler observatory
         (obs/devprof.py): the engine's per-program cost table is
         extracted once at construction (AOT, no execution) and ONE
@@ -165,18 +187,31 @@ class InferenceServer:
         self._registry = registry if registry is not None \
             else obs_metrics.Registry()
         self._slow_ms = float(slow_ms)
+        self._paged = bool(paged) and prefill_chunk > 0
+        nb = 0
+        if self._paged:
+            from .engine import auto_num_blocks
+            nb = int(num_blocks) if num_blocks > 0 else auto_num_blocks(
+                cfg, slots, prefill_chunk, block_size=block_size,
+                prefix_mb=prefix_mb, kv_mb=kv_mb)
         self._engine = DecodeEngine(
             cfg, params, slots, prefill_chunk=prefill_chunk,
             recompile_limit=recompile_limit,
             recompile_strict=recompile_strict,
             spec_len=spec_len if spec_mode != "off" else 0,
-            obs_registry=self._registry)
+            obs_registry=self._registry,
+            num_blocks=nb, block_size=block_size if self._paged else 0)
         self._prefill_budget = int(prefill_budget)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
-            from .prefix_cache import PrefixCache
-            self._prefix = PrefixCache(self._engine,
-                                       int(prefix_mb * (1 << 20)))
+            if self._paged:
+                from .prefix_cache import PagedPrefixCache
+                self._prefix = PagedPrefixCache(
+                    self._engine, int(prefix_mb * (1 << 20)))
+            else:
+                from .prefix_cache import PrefixCache
+                self._prefix = PrefixCache(self._engine,
+                                           int(prefix_mb * (1 << 20)))
         self._drafters = {}
         if spec_mode != "off":
             from .speculative import ModelDrafter, NgramDrafter
@@ -309,8 +344,34 @@ class InferenceServer:
                  sc.occupancy)
         cb_gauge("cxn_serve_batch_efficiency", "mean fraction of slot "
                  "rows doing useful work per tick", sc.batch_efficiency)
-        cb_gauge("cxn_serve_kv_cache_bytes", "slot-pool K/V bytes",
+        cb_gauge("cxn_serve_kv_cache_bytes", "KV cache device bytes "
+                 "(dense slot pool, or the whole paged block pool)",
                  self._engine.cache_bytes)
+        # token-level utilization alongside row occupancy: the dense
+        # gauge charges every row its full row_len, so only the paged
+        # engine can push this toward 1.0 (doc/observability.md)
+        cb_gauge("cxn_serve_kv_utilization", "live cache tokens / total "
+                 "KV token capacity", sc.kv_token_utilization)
+        if self._paged:
+            mgr = self._engine.manager
+            for key, help_ in (
+                    ("free", "unallocated KV blocks"),
+                    ("shared", "KV blocks with more than one owner "
+                               "(rows and/or prefix-trie nodes) — "
+                               "copy-on-write protected"),
+                    ("private", "KV blocks owned by exactly one row or "
+                                "trie node")):
+                cb_gauge("cxn_blocks_%s" % key, help_,
+                         lambda k=key: mgr.counts()[k])
+            cb_counter("cxn_swap_out_total", "rows preempted to the "
+                       "host swap buffer", lambda: sc.swaps_out)
+            cb_counter("cxn_swap_in_total", "preempted rows resumed "
+                       "from the host swap buffer", lambda: sc.swaps_in)
+            cb_counter("cxn_cow_faults_total", "shared blocks "
+                       "copy-on-write faulted to private copies",
+                       lambda: mgr.cow_faults)
+            cb_gauge("cxn_swap_host_bytes", "host bytes holding "
+                     "swapped-out rows' K/V", lambda: sc.swap_host_bytes)
         pc = self._prefix
         if pc is not None:
             for attr, help_ in (
@@ -340,9 +401,20 @@ class InferenceServer:
         self._ledger.register(
             "params", lambda: devprof.tree_nbytes((eng._blocks,
                                                    eng._outer)))
-        self._ledger.register("kv_slots", eng.cache_bytes)
-        if pc is not None:
-            self._ledger.register("prefix_cache", lambda: pc.nbytes)
+        if self._paged:
+            # `kv_blocks` is the WHOLE block pool (trie-resident blocks
+            # included — they live inside it, so a separate prefix pool
+            # would double-count); `swap_host` is HOST memory holding
+            # preempted rows, published for visibility but excluded
+            # from the device reconciliation (device=False)
+            self._ledger.register("kv_blocks", eng.cache_bytes)
+            self._ledger.register("swap_host",
+                                  lambda: self._sched.swap_host_bytes,
+                                  device=False)
+        else:
+            self._ledger.register("kv_slots", eng.cache_bytes)
+            if pc is not None:
+                self._ledger.register("prefix_cache", lambda: pc.nbytes)
         md = self._drafters.get("model")
         if md is not None:
             self._ledger.register(
@@ -541,24 +613,50 @@ class InferenceServer:
                         n_free = self._sched.free_slots   # slots shrink
                         #   only when admit() runs below, outside this
                         #   lock
-                        while n_free > 0 and self._queue:
-                            admitted.append(self._queue.popleft())
+                        # swapped (preempted) requests resume with
+                        # strict priority over fresh admissions — and
+                        # the paged admissible() gate stops popping at
+                        # the first queue head whose blocks don't fit,
+                        # so overload waits in the queue instead of
+                        # thrashing the pool with admit/preempt cycles.
+                        # `claimed` carries the blocks promised to
+                        # requests popped EARLIER IN THIS PASS (their
+                        # allocations run later, outside this lock), so
+                        # a burst can't over-admit against a free_count
+                        # that hasn't moved yet.
+                        claimed = 0
+                        while n_free > 0 and self._queue \
+                                and not self._sched.swapped_pending \
+                                and self._sched.admissible(
+                                    self._queue[0], claimed):
+                            req = self._queue.popleft()
+                            claimed += self._sched.admission_claim(req)
+                            admitted.append(req)
                             n_free -= 1
                             self._cond.notify_all()   # space for blocked
                             #                           submits
-                        if not admitted and self._sched.active == 0:
+                        if not admitted and self._sched.active == 0 \
+                                and not self._sched.swapped_pending:
                             if self._closing and not self._queue:
                                 break
                             # truly idle: active == 0 means every slot
-                            # is free, so the pop loop above drained the
-                            # queue — nothing can expire while we sleep.
-                            # Every mutation path (submit, shutdown)
-                            # notifies, so an untimed wait parks the
-                            # thread completely instead of polling. A
-                            # pass that just expired requests skips the
-                            # park so their exemplar dump (below) isn't
-                            # deferred until the next submit.
-                            if not expired:
+                            # is free and (queue empty) nothing can
+                            # expire while we sleep; every mutation
+                            # path (submit, shutdown) notifies, so an
+                            # untimed wait parks the thread instead of
+                            # polling. An inadmissible queue head with
+                            # every slot free should be impossible
+                            # (full trie eviction always fits one
+                            # valid prompt) — the timed wait below is
+                            # the belt-and-braces fallback so an
+                            # estimate bug degrades to a 50 ms poll,
+                            # never a deadlock. A pass that just
+                            # expired requests skips the park so their
+                            # exemplar dump (below) isn't deferred to
+                            # the next submit.
+                            if self._queue:
+                                self._cond.wait(0.05)
+                            elif not expired:
                                 self._cond.wait()
                             continue
                 finally:
@@ -569,6 +667,12 @@ class InferenceServer:
                     # to capture
                     for req in expired:
                         self._maybe_slow(req)
+                # preempted requests come back FIRST (strict priority —
+                # the pop loop above did not admit while any were
+                # pending), then fresh admissions; both are device work
+                # and run outside the lock
+                if self._sched.swapped_pending:
+                    self._sched.resume_swapped()
                 for req in admitted:            # device work outside the
                     self._sched.admit(req)      # lock
                 # at most prefill_budget chunk steps per pass, so a long
@@ -710,7 +814,23 @@ class InferenceServer:
             "spec_verify_ms": ms(st.samples(profiler.SPEC_VERIFY)),
             "queue_depth": {"now": depth, "max": self._queue_depth_max},
             "slot_occupancy": sc.occupancy(),
+            # token-level utilization ALONGSIDE row occupancy: the dense
+            # pool charges every row its full row_len, so only the paged
+            # engine can drive this toward 1.0 — the gauge the paged
+            # capacity win shows up in (doc/serving.md)
+            "kv_token_utilization": sc.kv_token_utilization(),
             "batch_efficiency": sc.batch_efficiency(),
+            # paged-engine health: block economy + preemption/swap
+            # traffic (None when the dense pool serves)
+            "paged": ({
+                "num_blocks": self._engine.num_blocks,
+                "block_size": self._engine.block_size,
+                "blocks": self._engine.manager.counts(),
+                "cow_faults": self._engine.manager.cow_faults,
+                "swaps_out": sc.swaps_out, "swaps_in": sc.swaps_in,
+                "swapped_pending": sc.swapped_pending,
+                "swap_host_bytes": sc.swap_host_bytes,
+            } if self._paged else None),
             "ticks": sc.ticks,
             "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
@@ -768,6 +888,12 @@ class InferenceServer:
         self._sched.spec_emitted = 0
         self._sched.spec_rollbacks = 0
         self._sched.spec_backoffs = 0
+        self._sched.swaps_out = 0
+        self._sched.swaps_in = 0
+        if self._paged:
+            # traffic counter only — block refcounts/tables are live
+            # state a reset must not touch
+            self._engine.manager.cow_faults = 0
         if self._prefix is not None:
             # traffic counters only: cached chunks stay warm — a bench's
             # measured pass is supposed to see the steady state

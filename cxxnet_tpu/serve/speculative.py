@@ -50,6 +50,18 @@ b-slot engine, per-iteration draft + verify per row, stragglers and
 no-draft rows advanced by the ordinary batched tick. The serving
 integration lives in serve/scheduler.py (``spec_steps``), which
 interleaves per-slot verify chunks with the shared decode tick.
+
+Paged serving note: under the paged KV cache the scheduler reserves the
+verify window's blocks — allocation plus copy-on-write faults for any
+shared block — BEFORE the forward dispatches (speculation never
+preempts a neighbor for room; an unreservable window just skips the
+draft this pass and the row ticks normally). Rollback therefore stays
+free: a rejected draft's stale K/V already sits in privately-owned
+blocks beyond the accepted position, so no COW fault — and no copy of
+any kind — happens on rejection. The offline decoder's engine keeps the
+dense pool (equal-length offline batches are its sweet spot), as does
+the :class:`ModelDrafter` mirror engine — draft rows are all the same
+short horizon, exactly the shape dense rows price correctly.
 """
 
 from __future__ import annotations

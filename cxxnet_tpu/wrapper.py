@@ -193,14 +193,24 @@ class Net:
                     spec_mode: str = "off", spec_len: int = 4,
                     spec_model=None, slow_ms: float = 0.0, tracer=None,
                     registry=None, prof_every: int = 0,
+                    paged: bool = True, block_size: int = 0,
+                    num_blocks: int = 0, kv_mb: float = 0.0,
                     **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
         prefill (0 = legacy whole-prompt prefill), ``prefix_mb`` budgets
         the shared-prefix KV cache (0 disables reuse), and
+        ``paged``/``block_size``/``num_blocks``/``kv_mb`` shape the
+        paged KV cache — on by default: a global block pool with
+        per-row block tables, zero-copy copy-on-write prefix sharing,
+        and preemption/swap to host under pool pressure, so admitted
+        concurrency scales with tokens in flight (``num_blocks=0``
+        auto-sizes to dense-equivalent capacity plus trie headroom, or
+        to a ``kv_mb`` MiB budget; ``paged=False`` keeps the dense slot
+        pool — doc/serving.md "Paged KV cache").
         ``recompile_limit`` extends the recompilation guard to the
-        engine's prefill/chunk/verify programs
+        engine's prefill/chunk/verify/tick programs
         (``recompile_strict=False`` logs CXN205 instead of raising, the
         CLI's ``lint_recompile_strict=0`` mode).
 
@@ -237,7 +247,8 @@ class Net:
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
             tracer=tracer, registry=registry, prof_every=prof_every,
-            defaults=SamplingParams(**defaults))
+            paged=paged, block_size=block_size, num_blocks=num_blocks,
+            kv_mb=kv_mb, defaults=SamplingParams(**defaults))
 
     def _serving(self):
         srv = getattr(self, "_server", None)

@@ -170,6 +170,10 @@ def test_partial_availability_keeps_memory_side():
 
 # ------------------------------------------------------------------- ledger
 def test_ledger_reconciles_for_small_serve_config():
+    """Paged server (the default): `kv_blocks` is the whole block pool
+    (trie-resident blocks live INSIDE it — no separate prefix pool, no
+    double count) and `swap_host` is a HOST pool: published as a gauge
+    but excluded from the device reconciliation."""
     import gc
     gc.collect()
     srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=8)
@@ -179,19 +183,21 @@ def test_ledger_reconciles_for_small_serve_config():
         rec = srv.metrics()["device_bytes"]
         eng = srv._engine
         # the pools' predictions are exact for what they model
-        assert rec["pools"]["kv_slots"] == eng.cache_bytes()
+        assert rec["pools"]["kv_blocks"] == eng.cache_bytes()
         assert rec["pools"]["params"] == devprof.tree_nbytes(
             (eng._blocks, eng._outer))
-        assert rec["pools"]["prefix_cache"] == srv._prefix.nbytes
+        assert rec["pools"]["swap_host"] == 0       # nothing preempted
+        assert "prefix_cache" not in rec["pools"]   # inside kv_blocks
+        # accounted = DEVICE pools only (swap_host is host memory)
         assert rec["accounted"] == pytest.approx(
-            sum(rec["pools"].values()))
+            sum(v for p, v in rec["pools"].items() if p != "swap_host"))
         # the measured live total covers at least the accounted pools
         # (module-level PARAMS etc. land in `unaccounted`, never below)
         assert rec["live_total"] >= rec["accounted"] * 0.99
         assert rec["live_total"] == rec["accounted"] + rec["unaccounted"]
         # exposed as cxn_device_bytes{pool=} gauges
         snap = srv.registry.snapshot()
-        assert snap['cxn_device_bytes{pool="kv_slots"}'] == \
+        assert snap['cxn_device_bytes{pool="kv_blocks"}'] == \
             eng.cache_bytes()
         assert snap['cxn_device_bytes{pool="live_total"}'] >= \
             rec["accounted"] * 0.99
@@ -199,6 +205,29 @@ def test_ledger_reconciles_for_small_serve_config():
         srv.shutdown()
     # post-shutdown the frozen gauges report the drained state without
     # evaluating (or pinning) the dead engine
+    snap = srv.registry.snapshot()
+    assert snap['cxn_device_bytes{pool="kv_blocks"}'] == 0
+
+
+def test_ledger_reconciles_for_dense_serve_config():
+    """paged=False keeps the dense pools: kv_slots + the prefix trie's
+    own (copied) bytes."""
+    import gc
+    gc.collect()
+    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=8,
+                          paged=False)
+    try:
+        h = srv.submit(np.arange(6, dtype=np.int32) % 32, max_tokens=8)
+        assert srv.result(h).status == "ok"
+        rec = srv.metrics()["device_bytes"]
+        eng = srv._engine
+        assert rec["pools"]["kv_slots"] == eng.cache_bytes()
+        assert rec["pools"]["prefix_cache"] == srv._prefix.nbytes
+        assert rec["accounted"] == pytest.approx(
+            sum(rec["pools"].values()))
+        assert rec["live_total"] >= rec["accounted"] * 0.99
+    finally:
+        srv.shutdown()
     snap = srv.registry.snapshot()
     assert snap['cxn_device_bytes{pool="kv_slots"}'] == 0
 

@@ -248,15 +248,24 @@ def test_scheduler_crash_cancels_each_request_exactly_once():
     slots) must finish every in-flight request exactly once: the
     scheduler retires the ones it tracks, the server's sweep only
     touches untracked ones — no double finish, no double count."""
+    import threading
     rs = np.random.RandomState(8)
     srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4)
     boom = RuntimeError("injected chunk failure")
+    submitted = threading.Event()
 
     def exploding(*a, **kw):
+        # hold the crash until every submit has landed — otherwise the
+        # scheduler thread can race the submit loop, shut the server
+        # down, and turn later submits into AdmissionErrors (a pre-
+        # existing flake this event removes; the crash still happens
+        # mid-pass with requests admitted, which is the point)
+        submitted.wait(30)
         raise boom
 
     srv._engine.prefill_chunk = exploding
     handles = [srv.submit(_prompt(rs, 9), max_tokens=4) for _ in range(3)]
+    submitted.set()
     results = [srv.result(h, timeout=60) for h in handles]
     srv.shutdown(drain=False)
     assert [r.status for r in results] == ["cancelled"] * 3

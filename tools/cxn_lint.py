@@ -12,9 +12,11 @@ tests/test_lint.py wires it into pytest). ``--compile`` additionally
 builds the net (init_model on the default backend) and audits the
 compiled steps (pass 2: donation aliasing, dtype promotion, host
 transfers, collectives); for a GPT-shaped config it also audits the
-serve engine's prefill / chunk-prefill / tick executables — plus the
-speculative ``serve_verify_chunk`` program when the config enables it
-(``spec_mode`` != off) — the programs ``task=serve`` runs. Every
+serve engine's executables — the PAGED chunk-prefill / tick (and
+``serve_verify_chunk`` when ``spec_mode`` != off) programs with
+abstract block-table inputs by default, or the dense prefill / chunk /
+tick set under ``serve_paged=0`` — the programs ``task=serve`` runs,
+with the block pool's donation aliasing pinned. Every
 audited step's line now reports its AOT lower+compile seconds, and
 ``lint_compile_budget_s=<s>`` turns that into a CI gate: any step
 compiling over the budget fails the lint with CXN207, so compile-time
@@ -70,13 +72,26 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                 print("  (not GPT-shaped: serve-engine audit skipped)")
         if gcfg is not None:
             from cxxnet_tpu.analysis import audit_serve_engine
-            from cxxnet_tpu.serve.engine import DecodeEngine
+            from cxxnet_tpu.serve.engine import (DecodeEngine,
+                                                 auto_num_blocks)
             # abstract engine: the audit AOT-lowers against
-            # ShapeDtypeStruct caches, so no slot-pool KV is allocated
-            # for a lint step that never executes anything
+            # ShapeDtypeStruct caches, so no KV pool is allocated for a
+            # lint step that never executes anything. The engine
+            # mirrors the config's serving mode — paged by default, so
+            # the audited programs (block-table gather/scatter, pool
+            # donation aliasing) are the ones task=serve actually runs.
+            nb = 0
+            if task.serve_paged and task.serve_prefill_chunk > 0:
+                nb = (task.serve_num_blocks or auto_num_blocks(
+                    gcfg, task.serve_slots, task.serve_prefill_chunk,
+                    block_size=task.serve_block_size,
+                    prefix_mb=task.serve_prefix_mb,
+                    kv_mb=task.serve_kv_mb))
             eng = DecodeEngine(gcfg, gparams, slots=2,
                                prefill_chunk=task.serve_prefill_chunk,
                                abstract=True,
+                               num_blocks=nb,
+                               block_size=task.serve_block_size,
                                spec_len=(task.spec_len
                                          if task.spec_mode != "off"
                                          else 0))
