@@ -97,6 +97,24 @@ class LearnTask:
         #                             or serve_kv_mb when set)
         self.serve_kv_mb = 0.0    # block-pool MiB budget for auto-
         #                           sizing (0 = slots-equivalent formula)
+        self.serve_chaos = ""     # fault-injection spec (chaos harness;
+        #                           grammar in serve/resilience.py, e.g.
+        #                           "tick_raise:0.01,seed:7"; the
+        #                           CXN_CHAOS env var overrides; empty =
+        #                           true no-op)
+        self.serve_max_restarts = 3     # engine rebuild budget: faults
+        #                                 beyond it fail in-flight
+        #                                 requests typed
+        self.serve_watchdog_ms = 0.0    # stalled-loop watchdog: no
+        #                                 scheduler pass for this long ->
+        #                                 teardown + replay restart
+        #                                 (0 = off; must exceed the
+        #                                 worst-case compile of one pass)
+        self.serve_degrade = 1    # graceful-degradation ladder: under
+        #                           sustained overload disable spec ->
+        #                           stop prefix admission -> shed
+        #                           deadline-doomed queued requests with
+        #                           retry_after_ms hints (0 = off)
         self.spec_mode = "off"    # speculative decoding draft source:
         #                           off | ngram (prompt lookup) | model
         self.spec_len = 4         # draft tokens verified per forward
@@ -220,6 +238,14 @@ class LearnTask:
             self.serve_num_blocks = int(val)
         elif name == "serve_kv_mb":
             self.serve_kv_mb = float(val)
+        elif name == "serve_chaos":
+            self.serve_chaos = val
+        elif name == "serve_max_restarts":
+            self.serve_max_restarts = int(val)
+        elif name == "serve_watchdog_ms":
+            self.serve_watchdog_ms = float(val)
+        elif name == "serve_degrade":
+            self.serve_degrade = int(val)
         elif name == "spec_mode":
             self.spec_mode = val
         elif name == "spec_len":
@@ -946,7 +972,11 @@ class LearnTask:
                               spec_len=self.spec_len,
                               spec_model=self._spec_model_export(),
                               slow_ms=self.obs_slow_ms,
-                              prof_every=self.prof_every)
+                              prof_every=self.prof_every,
+                              chaos=self.serve_chaos,
+                              max_restarts=self.serve_max_restarts,
+                              watchdog_ms=self.serve_watchdog_ms,
+                              degrade=bool(self.serve_degrade))
         if not self.silent:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (
@@ -964,6 +994,10 @@ class LearnTask:
             if self.spec_mode != "off":
                 mode += ", speculative %s x%d" % (self.spec_mode,
                                                   self.spec_len)
+            if srv.fault_injector is not None:
+                mode += ", CHAOS armed (%s)" % srv.fault_injector.spec
+            if self.serve_watchdog_ms > 0:
+                mode += ", watchdog %.0f ms" % self.serve_watchdog_ms
             # through the leveled logger, not a bare stderr print: the
             # serve path's human lines carry timestamps so they
             # interleave coherently with the obs JSONL snapshots
@@ -1063,6 +1097,16 @@ class LearnTask:
                               % (100.0 * m["accept_rate"],
                                  m["spec_tokens_per_forward"],
                                  100.0 * m["spec_rollback_rate"]))
+                res = m["resilience"]
+                if res["restarts"] or res["replayed"] or res["shed"] \
+                        or res["faults_injected"]:
+                    extra += ("; resilience: %d restart(s), %d "
+                              "replayed, %d shed, faults %s"
+                              % (res["restarts"], res["replayed"],
+                                 res["shed"],
+                                 {k: v for k, v in
+                                  res["faults_injected"].items()
+                                  if v} or "none"))
                 profiler.log(
                     "serve: %d ok / %d timeout / %d rejected; "
                     "ttft p50 %.1f / p95 %.1f / p99 %.1f ms; "

@@ -19,7 +19,12 @@ Track model (the ``tid`` axis in the exported trace):
 * ``TID_ENGINE`` — work shared across requests: one ``decode_tick``
   span per batched tick (args: how many rows decoded — NOT one span per
   row, the no-per-token-allocation rule), one ``spec_draft`` span per
-  drafter pass.
+  drafter pass, and the ``recovery`` span tree (teardown -> rebuild ->
+  replay) an engine restart leaves behind (serve/resilience.py).
+* ``TID_CONTROL`` — supervisory events: degradation-ladder rung
+  transitions, load-shed batches, per-request replay markers — the
+  track an operator reads to see WHY the engine track looks the way it
+  does.
 * ``REQ_TID_BASE + rid`` — one track per request carrying its span
   tree: ``request`` (submit -> terminal) over ``queue_wait`` ->
   ``prefix_restore`` -> ``prefill_chunk``* -> ``decode`` (covers the
@@ -52,10 +57,12 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Span", "Tracer", "get_tracer", "configure", "request_tid",
-           "spans_to_chrome", "TID_ENGINE", "TID_TRAIN", "REQ_TID_BASE"]
+           "spans_to_chrome", "TID_ENGINE", "TID_TRAIN", "TID_CONTROL",
+           "REQ_TID_BASE"]
 
 TID_ENGINE = 1
 TID_TRAIN = 2
+TID_CONTROL = 3
 REQ_TID_BASE = 100
 
 
@@ -74,7 +81,8 @@ def request_tid(rid: int) -> int:
 
 
 def _thread_meta(tids) -> List[Dict]:
-    names = {TID_ENGINE: "engine", TID_TRAIN: "train"}
+    names = {TID_ENGINE: "engine", TID_TRAIN: "train",
+             TID_CONTROL: "control"}
     out = []
     for tid in sorted(tids):
         name = names.get(tid, "request %d" % (tid - REQ_TID_BASE)

@@ -15,6 +15,9 @@ Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
 from .engine import DecodeEngine, auto_num_blocks
 from .paged import BlockManager, BlockPoolExhausted
 from .prefix_cache import PagedPrefixCache, PrefixCache
+from .resilience import (DegradationLadder, EngineFailedError,
+                         FaultInjector, InjectedFault,
+                         SwapCorruptionError)
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      ServeResult)
@@ -24,4 +27,6 @@ __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
            "PagedPrefixCache", "BlockManager", "BlockPoolExhausted",
            "auto_num_blocks", "AdmissionError", "QueueFullError",
-           "NgramDrafter", "ModelDrafter", "SpeculativeDecoder"]
+           "NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
+           "FaultInjector", "DegradationLadder", "InjectedFault",
+           "SwapCorruptionError", "EngineFailedError"]

@@ -112,6 +112,8 @@ from ..obs.devprof import compile_attribution
 from ..ops.attention import local_attention
 from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
                             sample_rows)
+from .paged import BlockPoolExhausted
+from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
 __all__ = ["DecodeEngine", "auto_num_blocks"]
 
@@ -781,7 +783,8 @@ class DecodeEngine:
                  prefill_chunk: int = 64, recompile_limit: int = 0,
                  recompile_strict: bool = True, abstract: bool = False,
                  spec_len: int = 0, obs_registry=None,
-                 num_blocks: int = 0, block_size: int = 0):
+                 num_blocks: int = 0, block_size: int = 0,
+                 injector=None):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -881,6 +884,10 @@ class DecodeEngine:
         # increment otherwise; None (the default) costs one attribute
         # check per call
         self._prof = None
+        # chaos harness (serve/resilience.py FaultInjector, armed via
+        # serve_chaos / CXN_CHAOS): None when off — every injection
+        # point below costs exactly one `is not None` check
+        self._inj = injector
         # compiled prefill/chunk signature counting (lint_recompile_limit
         # for the serve engine): the lru_caches above silently absorb a
         # per-prompt-length compile storm; the guard makes it loud
@@ -1241,6 +1248,14 @@ class DecodeEngine:
         token index in ITS OWN request — the fold_in schedule that makes
         a slot row's sample stream identical to the offline path's.
         Returns the (slots,) next tokens, synchronized."""
+        if self._inj is not None:
+            if self._inj.fire("tick_hang"):
+                # stalls up to hang_ms; raises InjectedFault instead if
+                # a recovery releases hangs first (the watchdog path)
+                self._inj.hang()
+            if self._inj.fire("tick_raise"):
+                raise InjectedFault("chaos point 'tick_raise': injected "
+                                    "decode-tick exception")
         if self.paged:
             if self._tguard is not None:
                 self._tguard("slots=%d/table=%d" % (self.slots, self.bpr))
@@ -1290,6 +1305,11 @@ class DecodeEngine:
         evicts / preempts and retries. Runs BEFORE the write program
         dispatches; this ordering is what makes speculative rollback
         free (rejected drafts sit in already-private blocks)."""
+        if self._inj is not None and self._inj.fire("reserve"):
+            # chaos: exhaust the pool mid-reserve — exercises the
+            # make-room escapes (trie evict, preempt, swap) for real
+            raise BlockPoolExhausted(1, "fault injection "
+                                        "(chaos point 'reserve')")
         m = self.manager
         bs = self.block_size
         first, last = int(p0) // bs, (int(p1) - 1) // bs
@@ -1333,8 +1353,13 @@ class DecodeEngine:
         table to host memory and release the row's refs — shared prefix
         blocks included (the copy makes the resume self-contained even
         if the trie evicts the prefix meanwhile). Returns the swap
-        record ``{"k", "v", "n", "nbytes"}`` that
-        :meth:`swap_in_row` restores bit-identically."""
+        record ``{"k", "v", "n", "nbytes", "crc"}`` that
+        :meth:`swap_in_row` restores bit-identically — ``crc`` is the
+        host-buffer checksum swap-in verifies, so a corrupted buffer
+        fails loudly (typed) instead of resuming a garbage bit-stream."""
+        if self._inj is not None and self._inj.fire("swap_out"):
+            raise InjectedFault("chaos point 'swap_out': injected "
+                                "swap-out I/O failure")
         m = self.manager
         n = m.nblocks[slot]
         ids = np.zeros(self.bpr, np.int32)
@@ -1345,7 +1370,8 @@ class DecodeEngine:
         bv = np.asarray(bv)[:, :n].copy()
         m.release_row(slot)
         return {"k": bk, "v": bv, "n": n,
-                "nbytes": bk.nbytes + bv.nbytes}
+                "nbytes": bk.nbytes + bv.nbytes,
+                "crc": swap_checksum(bk, bv)}
 
     def swap_in_row(self, slot: int, rec: Dict) -> None:
         """Resume a preempted row: allocate ``rec["n"]`` fresh blocks
@@ -1353,7 +1379,24 @@ class DecodeEngine:
         scatter the host buffers back — the paged analogue of the dense
         dus-per-cache restore path. Every restored block is private
         (ref 1); prefix sharing for a resumed row is rebuilt only by
-        its next admission, never mid-flight."""
+        its next admission, never mid-flight.
+
+        The host buffers are checksum-verified FIRST — before any
+        allocation — so a corrupted buffer raises
+        :class:`~cxxnet_tpu.serve.resilience.SwapCorruptionError` with
+        the manager untouched; the scheduler then replays the request
+        from its journal record instead of resuming garbage."""
+        if self._inj is not None and self._inj.fire("swap_in"):
+            # chaos: corrupt the host buffer in transit — the checksum
+            # below must catch it (the injected flip, not the raise,
+            # is the fault: it exercises the detection path)
+            rec["k"].view(np.uint8).flat[0] ^= 0xFF
+        if "crc" in rec and swap_checksum(rec["k"], rec["v"]) != rec["crc"]:
+            raise SwapCorruptionError(
+                "swap-in checksum mismatch for a %d-block row (host "
+                "buffer corrupted in transit); resuming would replay a "
+                "garbage bit-stream — the request is replayed from its "
+                "journal record instead" % int(rec["n"]))
         m = self.manager
         n = int(rec["n"])
         m.require(n, "swap-in")

@@ -183,3 +183,34 @@ class BlockManager:
         self.table[slot, :] = 0
         self.nblocks[slot] = 0
         return freed
+
+    # ------------------------------------------------------------ audit
+    def check_consistency(self, trie_refs: int = 0) -> None:
+        """Refcount/free-list audit (the chaos soak's leak oracle,
+        tests/test_resilience.py): every non-garbage block is either on
+        the free list with ref 0, or off it with ref equal to its owner
+        count — ``sum(row table refs) + trie_refs``. Raises
+        AssertionError naming the first inconsistent block. ``trie_refs``
+        is the total ownership refs the prefix trie holds (0 after
+        ``clear()``)."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "free list has duplicates"
+        row_refs = np.zeros(self.num_blocks, np.int64)
+        for slot in range(self.slots):
+            for i in range(self.nblocks[slot]):
+                row_refs[self.table[slot, i]] += 1
+        for b in range(1, self.num_blocks):
+            if b in free:
+                assert self.ref[b] == 0, \
+                    "block %d on the free list with ref %d" % (b,
+                                                               self.ref[b])
+                assert row_refs[b] == 0, \
+                    "block %d on the free list but in %d row table(s)" \
+                    % (b, row_refs[b])
+            else:
+                assert self.ref[b] > 0, \
+                    "block %d neither free nor referenced" % b
+        total_refs = int(self.ref[1:].sum())
+        assert total_refs == int(row_refs[1:].sum()) + int(trie_refs), \
+            "refcount drift: %d refs held vs %d row refs + %d trie refs" \
+            % (total_refs, int(row_refs[1:].sum()), trie_refs)

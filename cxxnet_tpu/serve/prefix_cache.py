@@ -550,6 +550,13 @@ class PagedPrefixCache:
             n += sum(1 for b in nd.blocks if m.ref[b] == 1)
         return n
 
+    def trie_refs(self) -> int:
+        """Total block OWNERSHIP refs the trie currently holds (one per
+        block per node) — the ``trie_refs`` input of
+        :meth:`~cxxnet_tpu.serve.paged.BlockManager.check_consistency`,
+        the chaos soak's refcount-leak oracle."""
+        return sum(len(nd.blocks) for nd in self._nodes)
+
     def _remove(self, node: _PagedNode) -> None:
         parent = node.parent
         siblings = parent.children if parent is not None else self._children

@@ -1,0 +1,418 @@
+"""Serving resilience: fault injection, deterministic replay, watchdog,
+and the graceful-degradation ladder.
+
+The serving stack (engine -> scheduler -> server) is a long-lived
+process multiplexing many requests over one set of device buffers — a
+single unhandled exception or hang inside a tick, swap, or drafter pass
+used to kill the engine and every in-flight request with it. This
+module is the host-side half of the fix; the wiring lives in
+serve/server.py (supervisor, watchdog, recovery), serve/scheduler.py
+(journal bookkeeping, fault containment for optional work), and
+serve/engine.py (injection points, swap checksums).
+
+**Why recovery is cheap here**: served tokens are pinned bit-identical
+to solo ``gpt_decode`` via the deterministic per-request
+``fold_in(key, token_index)`` schedule, so every request is fully
+re-executable from ``(prompt, SamplingParams, emitted-token count)``
+alone — no KV snapshotting, no logit checkpoints. The
+:class:`ReplayJournal` records exactly that, and recovery = tear the
+pool down, rebuild the engine cold, and push the journaled requests
+back through the normal admit path. Already-emitted tokens are verified
+bit-identical as they are regenerated (``replay_expect`` on the
+request; greedy is exact, sampled resumes on the pinned key schedule so
+the distribution is unchanged — the same key indices produce the same
+draws).
+
+**Fault injection** (:class:`FaultInjector`): named chaos points at
+every hazard the stack already has, armed by ``serve_chaos=<spec>`` /
+the ``CXN_CHAOS`` env var with a deterministic per-point seed. Spec
+grammar (comma-separated, ``:`` separates key and value)::
+
+    point:prob      arm `point` at probability `prob` per call
+    point@N         fire exactly on the Nth call to `point` (one-shot)
+    all:prob        arm every point at `prob`
+    seed:N          deterministic RNG seed (default 0)
+    hang_ms:N       how long an injected hang stalls (default 2000)
+
+Points: ``reserve`` (BlockPoolExhausted mid-reserve), ``swap_out`` /
+``swap_in`` (host round-trip I/O failure / buffer corruption),
+``drafter`` (drafter exception), ``prefix_restore`` (restore failure),
+``tick_raise`` (tick raising), ``tick_hang`` (tick stalling). An empty
+spec yields no injector at all — ``serve_chaos`` off is a true no-op
+(the hot path pays one ``is not None`` check).
+
+**Degradation ladder** (:class:`DegradationLadder`): overload is met
+with targeted load-shedding instead of collapse, driven by the gauges
+the server already keeps (queue depth, block headroom, reserve stalls,
+optionally p95 tick) with hysteresis so the rungs do not flap:
+
+    rung 1  disable speculative decode (optional work, costs verifies)
+    rung 2  stop prefix-cache admission (no new trie inserts/donations)
+    rung 3  deadline-aware shedding of queued requests, and rejections
+            carry a ``retry_after_ms`` hint
+
+The server surfaces the state as SERVING / DEGRADED / DRAINING /
+FAILED in ``health()``, the ``cxn_serve_state`` gauge, and the obs
+trace's ``control`` track (doc/serving.md "Resilience").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+import zlib
+from typing import Dict, List, Optional
+
+__all__ = ["FaultInjector", "ReplayJournal", "DegradationLadder",
+           "InjectedFault", "SwapCorruptionError", "EngineFailedError",
+           "SupersededError", "reset_for_replay", "live_journals",
+           "STATE_SERVING", "STATE_DEGRADED", "STATE_DRAINING",
+           "STATE_FAILED", "STATE_CODES"]
+
+STATE_SERVING = "SERVING"
+STATE_DEGRADED = "DEGRADED"
+STATE_DRAINING = "DRAINING"
+STATE_FAILED = "FAILED"
+# numeric encoding for the cxn_serve_state gauge (doc/observability.md)
+STATE_CODES = {STATE_SERVING: 0, STATE_DEGRADED: 1, STATE_DRAINING: 2,
+               STATE_FAILED: 3}
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the chaos harness (never by real code paths) —
+    distinguishable in logs from an organic bug, handled identically by
+    the recovery machinery (that is the point of injecting it)."""
+
+
+class SwapCorruptionError(RuntimeError):
+    """A swapped-out row's host buffer failed its checksum at swap-in.
+    The row's K/V is untrusted and must NOT be resumed; the scheduler
+    routes the request to a journal replay instead (serve/scheduler.py
+    ``resume_swapped``)."""
+
+
+class EngineFailedError(RuntimeError):
+    """The engine faulted more than ``serve_max_restarts`` times; the
+    server is permanently failed. In-flight requests finish with status
+    ``error`` carrying this message, and further submits raise it."""
+
+
+class SupersededError(RuntimeError):
+    """Raised inside a scheduler that a recovery has marked dead: a
+    previously-hung loop thread woke up after the watchdog already
+    rebuilt the stack, and must unwind without mutating shared request
+    state (its engine, slots, and caches were all discarded)."""
+
+
+# ------------------------------------------------------------------ chaos
+class FaultInjector:
+    """Deterministic chaos harness; see the module docstring for the
+    spec grammar. Single-threaded discipline like the rest of serve
+    host state (only the scheduler thread calls :meth:`fire`);
+    :meth:`release_hangs` is the one cross-thread entry point and is
+    condition-guarded."""
+
+    POINTS = ("reserve", "swap_out", "swap_in", "drafter",
+              "prefix_restore", "tick_raise", "tick_hang")
+
+    def __init__(self, seed: int = 0, hang_ms: float = 2000.0):
+        self.spec = ""
+        self.seed = int(seed)
+        self.hang_ms = float(hang_ms)
+        self.armed = True           # tests disarm around warmup passes
+        self._prob: Dict[str, float] = {}
+        self._at: Dict[str, int] = {}
+        self._calls = {p: 0 for p in self.POINTS}
+        self.counts = {p: 0 for p in self.POINTS}
+        self._rngs: Dict[str, object] = {}
+        # injected hangs wait on this condition so a recovery (or
+        # shutdown) can interrupt them instead of sleeping out the
+        # full hang_ms on an abandoned thread
+        self._cv = threading.Condition()
+        self._release_gen = 0
+
+    @classmethod
+    def from_spec(cls, spec: str) -> Optional["FaultInjector"]:
+        """Parse a ``serve_chaos`` / ``CXN_CHAOS`` spec; empty -> None
+        (chaos fully off costs nothing — no object, no checks beyond
+        ``is not None``)."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        inj = cls()
+        inj.spec = spec
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "@" in item:
+                point, _, n = item.partition("@")
+                point = point.strip()
+                if point not in cls.POINTS:
+                    raise ValueError(
+                        "serve_chaos: unknown injection point %r "
+                        "(points: %s)" % (point, ", ".join(cls.POINTS)))
+                inj._at[point] = int(n)
+                continue
+            key, sep, val = item.partition(":")
+            key = key.strip()
+            if not sep:
+                raise ValueError("serve_chaos: malformed item %r "
+                                 "(want point:prob, point@N, seed:N, "
+                                 "hang_ms:N or all:prob)" % item)
+            if key == "seed":
+                inj.seed = int(val)
+            elif key == "hang_ms":
+                inj.hang_ms = float(val)
+            elif key == "all":
+                p = float(val)
+                for point in cls.POINTS:
+                    inj._prob[point] = p
+            elif key in cls.POINTS:
+                inj._prob[key] = float(val)
+            else:
+                raise ValueError(
+                    "serve_chaos: unknown injection point %r "
+                    "(points: %s)" % (key, ", ".join(cls.POINTS)))
+        return inj
+
+    def _rng(self, point: str):
+        rng = self._rngs.get(point)
+        if rng is None:
+            import numpy as np
+            # stable per-point stream: independent of how points
+            # interleave at runtime, and of python's salted hash()
+            rng = self._rngs[point] = np.random.RandomState(
+                (self.seed * 1000003 + zlib.crc32(point.encode()))
+                & 0x7FFFFFFF)
+        return rng
+
+    def fire(self, point: str) -> bool:
+        """One roll of the dice for ``point``; True = inject the fault
+        now. The CALL SITE decides the manifestation (raise, corrupt a
+        buffer, stall) — this method only counts and decides."""
+        if not self.armed:
+            return False
+        at = self._at.get(point)
+        prob = self._prob.get(point, 0.0)
+        if at is None and prob <= 0.0:
+            return False
+        self._calls[point] += 1
+        hit = at is not None and self._calls[point] == at
+        if not hit and prob > 0.0 \
+                and float(self._rng(point).random_sample()) < prob:
+            hit = True
+        if hit:
+            self.counts[point] += 1
+        return hit
+
+    def hang(self) -> None:
+        """An injected stall: block up to ``hang_ms``. If a recovery
+        (or shutdown) releases hangs first, raise :class:`InjectedFault`
+        so the abandoned thread UNWINDS instead of resuming mid-pass on
+        a scheduler that no longer owns the engine; an undisturbed
+        timeout returns normally — a transient stall, not a fault."""
+        with self._cv:
+            gen = self._release_gen
+            deadline = time.perf_counter() + self.hang_ms / 1e3
+            while self._release_gen == gen:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return
+                self._cv.wait(remaining)
+        raise InjectedFault("injected hang interrupted by recovery")
+
+    def release_hangs(self) -> None:
+        """Wake every in-flight injected hang (they raise). Called by
+        the supervisor at recovery and at shutdown."""
+        with self._cv:
+            self._release_gen += 1
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------- journal
+_journals: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_journals() -> List["ReplayJournal"]:
+    """Journals still alive in this process (tests/conftest.py leak
+    fixture: a non-empty journal after teardown means a server died
+    without finishing — or finalizing — its admitted requests)."""
+    return list(_journals)
+
+
+class ReplayJournal:
+    """The server's record of every admitted-but-unfinished request,
+    in admission order. One entry = one request object, which already
+    carries everything a bit-exact replay needs: the prompt, the
+    SamplingParams (seed included), and the tokens emitted so far.
+    Single-threaded discipline (scheduler thread), except for reads
+    under the server's recovery lock."""
+
+    def __init__(self):
+        self._entries: Dict[int, object] = {}   # rid -> Request, ordered
+        _journals.add(self)
+
+    def add(self, req) -> None:
+        self._entries[req.rid] = req
+
+    def remove(self, req) -> None:
+        self._entries.pop(req.rid, None)
+
+    def requests(self) -> List[object]:
+        """Live entries in admission order."""
+        return list(self._entries.values())
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def reset_for_replay(req) -> None:
+    """Rewind a journaled request for re-execution through the normal
+    admit path.
+
+    GREEDY requests (temperature 0) get a hard token pin: the longest
+    stream ever produced becomes ``replay_expect`` and the regenerated
+    stream is verified against it token by token before any NEW token
+    extends it — greedy output is the argmax chain, bit-identical no
+    matter how the replayed run batches, speculates, or pages.
+
+    SAMPLED requests resume on the pinned per-token fold_in key
+    schedule (same seed, same key indices), so the output DISTRIBUTION
+    is unchanged — but they are not token-pinned: a speculative verify
+    may accept a different draft prefix on replay (draft windows depend
+    on occupancy and pool pressure), emitting a different —
+    distribution-equal — token where the rejection lands, exactly as
+    two independent serves of the same sampled request may differ on a
+    spec-enabled server.
+
+    The queue deadline is cleared either way: the request was already
+    admitted once, and expiring it for the engine's fault would punish
+    the caller for the server's failure."""
+    if req.params.temperature > 0:
+        req.replay_expect = None
+    else:
+        prev = getattr(req, "replay_expect", None)
+        if prev is None or len(req.tokens) >= len(prev):
+            # a second crash mid-replay keeps the ORIGINAL (longer)
+            # pin: the tokens regenerated so far were verified against
+            # it, so both prefixes agree
+            req.replay_expect = list(req.tokens)
+    req.tokens = []
+    req.status = "queued"
+    req.slot = None
+    req.deadline = None
+
+
+def swap_checksum(bk, bv) -> int:
+    """Cheap host-buffer checksum for the swap round trip (crc32 over
+    both contiguous K/V buffers) — a corrupted buffer fails loudly at
+    resume instead of resuming a garbage bit-stream."""
+    import numpy as np
+    return zlib.crc32(np.ascontiguousarray(bv),
+                      zlib.crc32(np.ascontiguousarray(bk)))
+
+
+# ----------------------------------------------------------------- ladder
+class DegradationLadder:
+    """Graceful-degradation state machine with hysteresis; see the
+    module docstring for the rungs. ``evaluate`` is called once per
+    scheduler pass with the gauges the server already keeps — a few
+    float compares, no allocation.
+
+    Hysteresis: a rung is climbed only after ``up_hold`` consecutive
+    hot evaluations and descended only after ``down_hold`` consecutive
+    cool ones; the band between ``*_lo`` and ``*_hi`` thresholds resets
+    both streaks, so the ladder neither flaps on a noisy gauge nor
+    relaxes while pressure is merely catching its breath."""
+
+    MAX_RUNG = 3
+
+    def __init__(self, enabled: bool = True, queue_hi: float = 0.85,
+                 queue_lo: float = 0.30, headroom_lo: float = 0.05,
+                 headroom_hi: float = 0.25, up_hold: int = 3,
+                 down_hold: int = 16, tick_budget_ms: float = 0.0):
+        self.enabled = bool(enabled)
+        self.queue_hi = float(queue_hi)
+        self.queue_lo = float(queue_lo)
+        self.headroom_lo = float(headroom_lo)
+        self.headroom_hi = float(headroom_hi)
+        self.up_hold = int(up_hold)
+        self.down_hold = int(down_hold)
+        # p95 decode-tick budget in ms (0 = signal off); the server
+        # samples its StepStats percentile periodically when armed
+        self.tick_budget_ms = float(tick_budget_ms)
+        self.rung = 0
+        self.sheds = 0              # requests shed at rung 3 (server inc)
+        self.transitions = 0
+        self._up = 0
+        self._down = 0
+        self._stall = False
+
+    def note_stall(self) -> None:
+        """A reserve/admission stall (the 50 ms park) since the last
+        evaluation — a hot signal regardless of queue depth: the pool
+        cannot place the queue head even though a slot is free."""
+        self._stall = True
+
+    def evaluate(self, queue_frac: float, headroom: Optional[float],
+                 tick_p95_ms: Optional[float] = None) -> int:
+        """One hysteresis step; returns the (possibly new) rung.
+        ``queue_frac`` = queue depth / capacity; ``headroom`` = free +
+        reclaimable blocks / usable pool (None for the dense engine);
+        ``tick_p95_ms`` only participates when ``tick_budget_ms`` > 0
+        and a fresh sample is passed."""
+        if not self.enabled:
+            return 0
+        stall = self._stall
+        self._stall = False
+        hot = queue_frac >= self.queue_hi or stall \
+            or (headroom is not None and headroom <= self.headroom_lo) \
+            or (self.tick_budget_ms > 0 and tick_p95_ms is not None
+                and tick_p95_ms > self.tick_budget_ms)
+        cool = queue_frac <= self.queue_lo and not stall \
+            and (headroom is None or headroom >= self.headroom_hi) \
+            and (self.tick_budget_ms <= 0 or tick_p95_ms is None
+                 or tick_p95_ms <= self.tick_budget_ms)
+        if hot:
+            self._up += 1
+            self._down = 0
+            if self._up >= self.up_hold and self.rung < self.MAX_RUNG:
+                self.rung += 1
+                self.transitions += 1
+                self._up = 0
+        elif cool:
+            self._down += 1
+            self._up = 0
+            if self._down >= self.down_hold and self.rung > 0:
+                self.rung -= 1
+                self.transitions += 1
+                self._down = 0
+        else:
+            self._up = 0
+            self._down = 0
+        return self.rung
+
+    # ------------------------------------------------------- the effects
+    @property
+    def spec_enabled(self) -> bool:
+        """Rung 1 disables speculative decoding (optional work: greedy
+        identity is untouched, only tokens-per-forward drops)."""
+        return self.rung < 1
+
+    @property
+    def prefix_admission(self) -> bool:
+        """Rung 2 stops prefix-cache admission (no new trie inserts or
+        live-row donations; existing nodes still serve hits and remain
+        evictable under pool pressure)."""
+        return self.rung < 2
+
+    @property
+    def shedding(self) -> bool:
+        """Rung 3 sheds queued requests that cannot meet their deadline
+        and attaches ``retry_after_ms`` hints to rejections."""
+        return self.rung >= 3

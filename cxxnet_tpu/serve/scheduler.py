@@ -65,6 +65,7 @@ import numpy as np
 
 from ..obs.trace import TID_ENGINE, request_tid
 from ..utils import profiler
+from .resilience import InjectedFault, SupersededError, SwapCorruptionError
 
 __all__ = ["SamplingParams", "Request", "SlotScheduler"]
 
@@ -81,6 +82,14 @@ __all__ = ["SamplingParams", "Request", "SlotScheduler"]
 # doc/serving.md's round-10 cells).
 SPEC_BACKOFF_PROBE = 8
 SPEC_BACKOFF_MIN = 0.3
+
+# drafter fault containment (serve/resilience.py): a drafter exception
+# skips speculation for the pass (identity is untouched — greedy
+# speculative output equals the plain tick stream), and a drafter that
+# fails this many passes IN A ROW is disabled for the server's lifetime
+# — a persistently-broken draft model must not cost a try + warn on
+# every pass forever
+DRAFTER_FAULT_LIMIT = 3
 
 
 @dataclasses.dataclass
@@ -122,7 +131,8 @@ class Request:
 
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
-                 "error", "done", "slot", "traced")
+                 "error", "done", "slot", "traced", "replay_expect",
+                 "retry_after_ms")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  params: SamplingParams, submit_t: float):
@@ -142,8 +152,20 @@ class Request:
         self.error = ""
         self.done = threading.Event()
         self.slot: Optional[int] = None
+        # crash recovery (serve/resilience.py): the verified token
+        # prefix a replayed request must regenerate bit-identically
+        # (None = never replayed), and the back-off hint a shed /
+        # rejected request carries out through its ServeResult
+        self.replay_expect: Optional[List[int]] = None
+        self.retry_after_ms = 0.0
 
     def finish(self, status: str, error: str = "") -> None:
+        """First terminal state wins: a request failed by the recovery
+        supervisor must not be re-finished as `cancelled` when the
+        shutdown sweep later walks the same rows — the waiter in
+        result() has already been released with the typed error."""
+        if self.done.is_set():
+            return
         self.status = status
         self.error = error
         self.done_t = time.perf_counter()
@@ -155,7 +177,8 @@ class SlotScheduler:
 
     def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
                  on_finish=None, prefix_cache=None, drafters=None,
-                 spec_mode: str = "off", spec_len: int = 0, tracer=None):
+                 spec_mode: str = "off", spec_len: int = 0, tracer=None,
+                 injector=None, on_swap_corrupt=None):
         self.engine = engine
         self.paged = bool(getattr(engine, "paged", False))
         self.stats = stats or profiler.StepStats()
@@ -172,8 +195,11 @@ class SlotScheduler:
         # speculative decoding (serve/speculative.py): available drafter
         # objects by name, the server-default mode, and the verify
         # window (the engine's compiled spec_len — per-request overrides
-        # can only lower the draft count inside it)
-        self.drafters = dict(drafters or {})
+        # can only lower the draft count inside it). The dict is SHARED
+        # with the server (not copied): disabling a persistently-faulty
+        # drafter here must also flip the server's spec gate off, or it
+        # would keep dispatching no-op spec passes forever
+        self.drafters = drafters if drafters is not None else {}
         self.spec_mode = spec_mode if self.drafters else "off"
         self.spec_len = min(int(spec_len), engine.spec_len) \
             if engine.spec_len else 0
@@ -237,6 +263,22 @@ class SlotScheduler:
         self.swaps_out = 0
         self.swaps_in = 0
         self.swap_host_bytes = 0
+        # resilience (serve/resilience.py): the chaos injector (None =
+        # off), the server's swap-corruption replay hook, the
+        # degradation ladder's prefix-admission switch (rung 2), the
+        # superseded flag a recovery sets on the OLD scheduler so an
+        # abandoned (previously hung) loop thread unwinds instead of
+        # mutating replayed requests, and the fault-containment counters
+        self._inj = injector
+        self.on_swap_corrupt = on_swap_corrupt
+        self.prefix_admission = True
+        self.dead = False
+        self._owner = None      # thread allowed past the dead flag
+        self.swap_corruptions = 0
+        self.drafter_faults = 0
+        self.prefix_restore_faults = 0
+        self.replay_mismatches = 0
+        self._drafter_streak: dict = {}     # name -> consecutive faults
 
     # ------------------------------------------------------------- state
     @property
@@ -304,6 +346,48 @@ class SlotScheduler:
             used = usable - eng.manager.free_count
             return used / float(max(1, usable))
         return self.live_tokens() / float(max(1, eng.slots * eng.row_len))
+
+    # ------------------------------------------------------- resilience
+    def supersede(self) -> None:
+        """Mark this scheduler dead to every thread but the CALLER: a
+        recovery (or the budget-exhausted finalizer) abandons the loop
+        thread that may still be inside a device call here — when that
+        thread finally returns it must unwind without appending tokens
+        (the requests were rewound for replay) or touching slots it no
+        longer owns — while the superseding thread itself may still
+        drive the terminal cancel/fail sweep through the same
+        scheduler."""
+        self._owner = threading.get_ident()
+        self.dead = True
+
+    def _check_live(self) -> None:
+        """Raise :class:`SupersededError` on a dead scheduler unless
+        the calling thread is the one that superseded it (see
+        :meth:`supersede`). Called at every state-mutation entry point
+        that follows a device call."""
+        if self.dead and threading.get_ident() != self._owner:
+            raise SupersededError(
+                "scheduler superseded by engine recovery")
+
+    def _emit(self, slot: int, req: Request, tok: int) -> Optional[str]:
+        """Append one generated token to ``req``, verifying it against
+        the replay journal's expected prefix when the request is being
+        replayed after a crash (serve/resilience.py): the deterministic
+        fold_in key schedule makes regeneration bit-exact, so any
+        divergence means corrupted replay state — the request must fail
+        typed, never silently continue on a forked stream. Returns the
+        error message on divergence, None otherwise."""
+        self._check_live()
+        exp = req.replay_expect
+        i = len(req.tokens)
+        req.tokens.append(tok)
+        self.tokens_generated += 1
+        if exp is not None and i < len(exp) and int(exp[i]) != int(tok):
+            self.replay_mismatches += 1
+            return ("deterministic replay diverged at token %d: "
+                    "expected %d, regenerated %d (request %d)"
+                    % (i, int(exp[i]), int(tok), req.rid))
+        return None
 
     # ----------------------------------------------------- block policy
     def admission_need(self, req: Request) -> int:
@@ -444,6 +528,7 @@ class SlotScheduler:
         fresh traffic. Returns how many resumed."""
         n = 0
         while self._swapped and self._free:
+            self._check_live()
             rec = min(self._swapped, key=lambda r: r["req"].admit_t)
             need = rec["n"]
             m = self.engine.manager
@@ -455,7 +540,26 @@ class SlotScheduler:
                 break                       # wait for retires
             self._swapped.remove(rec)
             slot = self._free.pop()
-            self.engine.swap_in_row(slot, rec)
+            try:
+                self.engine.swap_in_row(slot, rec)
+            except SwapCorruptionError as e:
+                # the host buffer failed its checksum: resuming would
+                # replay garbage bits. Fail CONTAINED — drop the swap
+                # record, give the slot back, and route the request to
+                # a deterministic journal replay (the server hook); the
+                # engine and every other row are untouched.
+                self._free.append(slot)
+                self.swap_host_bytes -= rec["nbytes"]
+                self.swap_corruptions += 1
+                profiler.warn("serve: %s" % e)
+                req = rec["req"]
+                if self.on_swap_corrupt is not None:
+                    self.on_swap_corrupt(req)
+                else:
+                    req.finish("error", str(e))
+                    if self.on_finish is not None:
+                        self.on_finish(req)
+                continue
             self.swaps_in += 1
             self.swap_host_bytes -= rec["nbytes"]
             req = rec["req"]
@@ -492,6 +596,7 @@ class SlotScheduler:
         immediately (max_tokens == 1, or the first token is EOS)."""
         import jax
 
+        self._check_live()
         slot = self._free.pop()
         p = req.params
         req.slot = slot
@@ -531,7 +636,24 @@ class SlotScheduler:
         if self.prefix is not None:
             t0 = time.perf_counter()
             with self.stats.phase(profiler.PREFIX_COPY):
-                start = self.prefix.copy_into(slot, req.prompt)
+                try:
+                    if self._inj is not None \
+                            and self._inj.fire("prefix_restore"):
+                        raise InjectedFault("chaos point "
+                                            "'prefix_restore'")
+                    start = self.prefix.copy_into(slot, req.prompt)
+                except SupersededError:
+                    raise
+                except Exception as e:
+                    # a failed restore is a MISS, not a fatality: start
+                    # the chunk prefill from position 0, which rewrites
+                    # (COW-faulting first, in paged mode) whatever the
+                    # partial restore left in the row
+                    self.prefix_restore_faults += 1
+                    profiler.warn("serve: prefix restore failed for "
+                                  "request %d (%s); prefilling from "
+                                  "scratch" % (req.rid, e))
+                    start = 0
             if req.traced:
                 tr.add("prefix_restore", t0, time.perf_counter() - t0,
                        request_tid(req.rid), cat="serve",
@@ -577,6 +699,7 @@ class SlotScheduler:
                 # sample is fetched — mid-prompt chunks stay async so
                 # they pipeline on device
                 tok = int(tok)
+        self._check_live()
         if req.traced:
             self.tracer.add(profiler.PREFILL_CHUNK, t0,
                             time.perf_counter() - t0,
@@ -601,13 +724,18 @@ class SlotScheduler:
         p = req.params
         req.first_token_t = time.perf_counter()
         req.status = "active"
-        req.tokens.append(tok)
-        self.tokens_generated += 1
-        if self.paged and self.prefix is not None:
+        err = self._emit(slot, req, tok)
+        if err is not None:
+            self._retire(req, "error", err)
+            return
+        if self.paged and self.prefix is not None \
+                and self.prefix_admission:
             # eager donation: the row's complete prompt chunks join the
             # trie NOW (zero-copy ownership refs), so concurrent
             # same-prefix requests share this LIVE row's blocks instead
-            # of waiting for it to retire
+            # of waiting for it to retire. Degradation rung 2 switches
+            # prefix_admission off — under pool pressure new donations
+            # only pin blocks the make-room loop then has to evict.
             with self.stats.phase(profiler.PREFIX_COPY):
                 self.prefix.donate_from_row(slot, req.prompt)
             self.stats.end_step()
@@ -632,6 +760,7 @@ class SlotScheduler:
         return p.eos is not None and tok == p.eos
 
     def _retire(self, req: Request, status: str, error: str = "") -> None:
+        self._check_live()
         slot = req.slot
         t_retire = time.perf_counter()
         if self._pending[slot] is not None:     # cancelled mid-prefill
@@ -640,7 +769,8 @@ class SlotScheduler:
             # ValueError here is a real bug, not a race to paper over
             self._pending[slot] = None
             self._prefill_q.remove(slot)
-        elif status == "ok" and self.prefix is not None and not self.paged:
+        elif status == "ok" and self.prefix is not None \
+                and not self.paged and self.prefix_admission:
             # dense path: offer the row's complete prompt chunks to the
             # prefix cache BEFORE the slot is recycled (the copy-out
             # reads the row). Paged rows donated at prefill completion.
@@ -742,6 +872,7 @@ class SlotScheduler:
         if not want:
             return 0
         drafts: dict = {}
+        disabled = []
         t_draft = time.perf_counter()
         with self.stats.phase(profiler.SPEC_DRAFT):
             for name, drafter in self.drafters.items():
@@ -752,8 +883,48 @@ class SlotScheduler:
                     [self._req[s].prompt,
                      np.asarray(self._req[s].tokens, np.int32)])
                     for s in slots}
-                drafts.update(drafter.draft(
-                    ctxs, {s: want[s][1] for s in slots}))
+                try:
+                    if self._inj is not None \
+                            and self._inj.fire("drafter"):
+                        raise InjectedFault("chaos point 'drafter'")
+                    drafts.update(drafter.draft(
+                        ctxs, {s: want[s][1] for s in slots}))
+                    self._drafter_streak[name] = 0
+                except SupersededError:
+                    raise
+                except Exception as e:
+                    # a drafter is OPTIONAL work: contain the fault —
+                    # the rows just tick plain this pass (identity is
+                    # untouched; only tokens-per-forward drops) — and
+                    # resync the drafter's per-slot mirror state, which
+                    # a mid-catch-up failure may have desynchronized
+                    self.drafter_faults += 1
+                    streak = self._drafter_streak.get(name, 0) + 1
+                    self._drafter_streak[name] = streak
+                    profiler.warn("serve: %s drafter failed (%s); "
+                                  "rows tick plain this pass"
+                                  % (name, e))
+                    for s in slots:
+                        drafter.reset(s)
+                    if streak >= DRAFTER_FAULT_LIMIT:
+                        disabled.append(name)
+        for name in disabled:
+            profiler.warn("serve: %s drafter disabled after %d "
+                          "consecutive faults" % (name,
+                                                  DRAFTER_FAULT_LIMIT))
+            drafter = self.drafters.pop(name, None)
+            if drafter is not None:
+                try:
+                    # release its resources NOW (a ModelDrafter pins a
+                    # whole mirror-engine KV pool on device) — it will
+                    # never draft again; close() is idempotent, so the
+                    # server's shutdown sweep re-closing it is harmless
+                    drafter.close()
+                except Exception as e:
+                    profiler.warn("serve: closing disabled %s drafter "
+                                  "failed (%s)" % (name, e))
+            if self.spec_mode == name:
+                self.spec_mode = "off"
         if self.tracer is not None and self.tracer.enabled:
             # one engine-track span per drafter pass (it is batched
             # across rows), mirroring the tick's shared-span discipline
@@ -812,11 +983,13 @@ class SlotScheduler:
         plain recycled-slot stale data). Returns the count actually
         appended — what the per-forward emission gauge may count."""
         for i, tok in enumerate(emitted):
-            req.tokens.append(tok)
-            self.tokens_generated += 1
+            err = self._emit(slot, req, tok)
             self._tok[slot] = tok
             self._pos[slot] += 1
             self._fold[slot] += 1
+            if err is not None:
+                self._retire(req, "error", err)
+                return i + 1
             if self._finished(req, tok):
                 self._retire(req, "ok")
                 return i + 1
@@ -863,9 +1036,10 @@ class SlotScheduler:
             if req is None:
                 continue
             tok = int(nxt[slot])
-            req.tokens.append(tok)
-            self.tokens_generated += 1
-            if self._finished(req, tok):
+            err = self._emit(slot, req, tok)
+            if err is not None:
+                self._retire(req, "error", err)
+            elif self._finished(req, tok):
                 self._retire(req, "ok")
             else:
                 self._tok[slot] = tok
@@ -875,22 +1049,25 @@ class SlotScheduler:
         return self.decoding
 
     # ------------------------------------------------------------- drain
-    def cancel_active(self) -> int:
-        """Abort every in-flight request — decoding AND mid-prefill
-        (non-drain shutdown); returns how many were cancelled."""
+    def cancel_active(self, status: str = "cancelled",
+                      error: str = "server shutdown") -> int:
+        """Finish every in-flight request — decoding AND mid-prefill —
+        with the given terminal status (non-drain shutdown cancels; a
+        permanently-failed engine fails them typed, serve/resilience.py
+        EngineFailedError); returns how many were finished."""
         n = 0
         for req in list(self._req):
             if req is not None:
-                self._retire(req, "cancelled", "server shutdown")
+                self._retire(req, status, error)
                 n += 1
         for slot in list(self._prefill_q):
             st = self._pending[slot]
             if st is not None:
-                self._retire(st["req"], "cancelled", "server shutdown")
+                self._retire(st["req"], status, error)
                 n += 1
         for rec in self._swapped:           # swapped-out requests hold
             req = rec["req"]                # no slot — finish directly
-            req.finish("cancelled", "server shutdown")
+            req.finish(status, error)
             if self.on_finish is not None:
                 self.on_finish(req)
             n += 1

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass, replace
@@ -51,12 +52,27 @@ import numpy as np
 from ..obs import devprof
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
+from ..obs.trace import TID_CONTROL, TID_ENGINE
 from ..utils import profiler
 from .engine import DecodeEngine
+from .resilience import (STATE_CODES, STATE_DEGRADED, STATE_DRAINING,
+                         STATE_FAILED, STATE_SERVING, DegradationLadder,
+                         EngineFailedError, FaultInjector, ReplayJournal,
+                         SupersededError, reset_for_replay)
 from .scheduler import Request, SamplingParams, SlotScheduler
 
 __all__ = ["InferenceServer", "ServeResult", "AdmissionError",
-           "QueueFullError"]
+           "QueueFullError", "EngineFailedError"]
+
+# monotonic scheduler counters that survive an engine rebuild: recovery
+# replaces the SlotScheduler, but the obs registry's callback counters
+# must never go backwards (serve/resilience.py)
+_SCHED_CARRY = ("ticks", "active_row_ticks", "tokens_generated",
+                "prefill_chunks", "requests_prefilled", "spec_forwards",
+                "spec_drafted", "spec_accepted", "spec_emitted",
+                "spec_rollbacks", "spec_backoffs", "swaps_out",
+                "swaps_in", "swap_corruptions", "drafter_faults",
+                "prefix_restore_faults", "replay_mismatches")
 
 _server_seq = itertools.count()
 # rids are PROCESS-unique, not per-server: the span tracer keys request
@@ -77,20 +93,34 @@ class AdmissionError(RuntimeError):
 
 
 class QueueFullError(AdmissionError):
-    """Backpressure: the bounded admission queue is at capacity."""
+    """Backpressure: the bounded admission queue is at capacity (or the
+    degradation ladder shed the request at the door). ``retry_after_ms``
+    > 0 is the server's back-off hint — the estimated time for the
+    current backlog to drain enough to admit a retry."""
+
+    def __init__(self, reason: str, retry_after_ms: float = 0.0):
+        if retry_after_ms > 0:
+            reason += " (retry_after_ms=%d)" % int(retry_after_ms)
+        super().__init__(reason)
+        self.retry_after_ms = float(retry_after_ms)
 
 
 @dataclass
 class ServeResult:
     """Terminal state of one request. ``tokens`` is the FULL sequence
     (prompt + generated), matching ``gpt_decode``'s return layout;
-    empty for non-ok statuses."""
-    status: str                     # ok | timeout | cancelled
+    empty for non-ok statuses. Statuses: ``ok`` | ``timeout`` |
+    ``cancelled`` | ``shed`` (degradation-ladder load shedding —
+    ``retry_after_ms`` carries the back-off hint) | ``error`` (typed
+    failure: replay divergence, swap corruption with no replay hook, or
+    a permanently-failed engine — serve/resilience.py)."""
+    status: str
     tokens: np.ndarray
     error: str = ""
     ttft_ms: float = 0.0            # submit -> first token (incl. queue)
     ms_per_token: float = 0.0       # mean inter-token gap after the first
     queue_ms: float = 0.0           # submit -> admit
+    retry_after_ms: float = 0.0     # shed/rejected: back-off hint
 
 
 class InferenceServer:
@@ -111,7 +141,9 @@ class InferenceServer:
                  registry=None, slow_ms: float = 0.0,
                  prof_every: int = 0, paged: bool = True,
                  block_size: int = 0, num_blocks: int = 0,
-                 kv_mb: float = 0.0):
+                 kv_mb: float = 0.0, chaos: str = "",
+                 max_restarts: int = 3, watchdog_ms: float = 0.0,
+                 degrade: bool = True):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -164,7 +196,26 @@ class InferenceServer:
         leaves the hot path entirely untouched. The device-memory
         ledger (``cxn_device_bytes{pool=}``) and compile-time
         accounting (``cxn_compile_seconds{fn=}``) are always on — both
-        are collection-time callbacks with zero steady-state cost."""
+        are collection-time callbacks with zero steady-state cost.
+
+        Resilience (serve/resilience.py, doc/serving.md "Resilience"):
+        an engine-fatal fault (a tick/prefill/swap raising, or — with
+        ``watchdog_ms`` > 0 — the loop stalling that long) tears the
+        pool down, rebuilds the engine COLD, and replays every admitted
+        request from its journal record through the normal admit path,
+        already-emitted tokens verified bit-identical as they
+        regenerate; ``max_restarts`` bounds the rebuilds (beyond it
+        in-flight requests fail with a typed
+        :class:`~cxxnet_tpu.serve.resilience.EngineFailedError` and
+        further submits raise it). ``chaos`` arms the
+        :class:`~cxxnet_tpu.serve.resilience.FaultInjector` (grammar in
+        resilience.py; the ``CXN_CHAOS`` env var overrides); empty =
+        true no-op. ``degrade`` enables the graceful-degradation
+        ladder: under sustained overload it disables speculation, then
+        prefix-cache admission, then sheds deadline-doomed queued
+        requests with ``retry_after_ms`` hints; :meth:`health` and the
+        ``cxn_serve_state`` gauge surface SERVING / DEGRADED /
+        DRAINING / FAILED."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -179,6 +230,12 @@ class InferenceServer:
         if spec_mode == "model" and spec_model is None:
             raise ValueError("spec_mode='model' needs spec_model="
                              "(draft_cfg, draft_params)")
+        if max_restarts < 0:
+            raise ValueError("serve_max_restarts must be >= 0, got %d"
+                             % max_restarts)
+        if watchdog_ms < 0:
+            raise ValueError("serve_watchdog_ms must be >= 0, got %g"
+                             % watchdog_ms)
         self._defaults = defaults or SamplingParams()
         if timeout_ms and not self._defaults.timeout_ms:
             self._defaults = replace(self._defaults, timeout_ms=timeout_ms)
@@ -188,20 +245,121 @@ class InferenceServer:
             else obs_metrics.Registry()
         self._slow_ms = float(slow_ms)
         self._paged = bool(paged) and prefill_chunk > 0
+        # resilience state (serve/resilience.py): the chaos injector
+        # (CXN_CHAOS env wins over the config spec — the operator's
+        # override), the replay journal, the degradation ladder, and
+        # the supervisor's restart accounting. `_gen` is the loop
+        # generation: the watchdog bumps it when it abandons a hung
+        # scheduler thread and starts a fresh one — the abandoned
+        # thread sees the mismatch and unwinds without touching state.
+        self._inj = FaultInjector.from_spec(
+            os.environ.get("CXN_CHAOS", "") or chaos)
+        self._max_restarts = int(max_restarts)
+        self._watchdog_ms = float(watchdog_ms)
+        self._journal = ReplayJournal()
+        self._ladder = DegradationLadder(enabled=bool(degrade))
+        self._restarts = 0
+        self._replayed = 0
+        self._reserve_stalls = 0
+        self._failed: Optional[EngineFailedError] = None
+        self._ema_req_s = 0.0           # EMA of admit->done, feeds the
+        #                                 retry_after_ms / shed estimates
+        self._gen = 0
+        self._recover_lock = threading.RLock()
+        self._heartbeat = time.perf_counter()
+        self._parked = False            # loop idle-parked (watchdog skips)
         nb = 0
         if self._paged:
             from .engine import auto_num_blocks
             nb = int(num_blocks) if num_blocks > 0 else auto_num_blocks(
                 cfg, slots, prefill_chunk, block_size=block_size,
                 prefix_mb=prefix_mb, kv_mb=kv_mb)
-        self._engine = DecodeEngine(
-            cfg, params, slots, prefill_chunk=prefill_chunk,
-            recompile_limit=recompile_limit,
-            recompile_strict=recompile_strict,
-            spec_len=spec_len if spec_mode != "off" else 0,
-            obs_registry=self._registry,
-            num_blocks=nb, block_size=block_size if self._paged else 0)
+        # everything the recovery supervisor needs to rebuild the
+        # device-facing stack from scratch (engine, prefix cache,
+        # drafters, scheduler) — _build_stack() reads only this
+        self._build = dict(
+            cfg=cfg, params=params, slots=slots,
+            prefill_chunk=prefill_chunk, recompile_limit=recompile_limit,
+            recompile_strict=recompile_strict, spec_mode=spec_mode,
+            spec_len=spec_len, spec_model=spec_model, prefix_mb=prefix_mb,
+            nb=nb, block_size=block_size, prof_every=prof_every)
         self._prefill_budget = int(prefill_budget)
+        # device/compiler observatory (obs/devprof.py): compile-time
+        # accounting always (this registry becomes a CompileWatch sink,
+        # so every compile the server triggers lands in
+        # cxn_compile_seconds{fn=} + a `compile` span on the engine
+        # track); the cost table + live MFU sampler only when armed —
+        # extraction AOT-compiles every engine program once, which is
+        # startup cost a prof_every=0 server must not pay
+        devprof.compile_watch().add_sink(self._registry, self._tracer)
+        # StepStats feeds the registry (utils/profiler.py observer):
+        # every phase sample lands in the mergeable per-phase histogram
+        # as well as the StepStats percentile window
+        self._phase_h = self._registry.histogram(
+            "cxn_serve_phase_seconds",
+            "per-phase scheduler durations (queue_wait, prefill_chunk, "
+            "prefix_copy, decode_tick, spec_draft, spec_verify)",
+            labelnames=("phase",))
+        # every admitted request observes queue_wait, so the series must
+        # exist (count 0) even before the first observation — overload
+        # monitors alert on its absence, not just its value
+        self._phase_h.labels(profiler.QUEUE_WAIT)
+        self._stats = profiler.StepStats(
+            observer=lambda name, s: self._phase_h.labels(name).observe(s))
+        self._queue: collections.deque = collections.deque()
+        self._queue_cap = queue
+        self._cond = threading.Condition()
+        self._rid = _rid_seq
+        self._closing = False           # no new submits
+        self._drain = True              # finish queued work on shutdown?
+        self._stopped = threading.Event()
+        # counters + per-request latency samples for metrics(); the
+        # sample reservoirs are bounded so a long-lived server's memory
+        # does not grow with requests served (percentiles then describe
+        # the most recent window)
+        self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
+                        "timeout": 0, "cancelled": 0, "expired": 0,
+                        "shed": 0, "error": 0}
+        self._ttft_s: collections.deque = collections.deque(maxlen=4096)
+        self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
+        self._queue_depth_max = 0
+        self._build_stack()
+        self._register_obs()
+        self._idx = next(_server_seq)
+        self._watch_stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(0,),
+            name="cxn-serve-scheduler-%d" % self._idx, daemon=True)
+        self._thread.start()
+        self._watch_thread = None
+        if self._watchdog_ms > 0:
+            self._watch_thread = threading.Thread(
+                target=self._watch,
+                name="cxn-serve-watchdog-%d" % self._idx, daemon=True)
+            self._watch_thread.start()
+
+    def _build_stack(self) -> None:
+        """Build — or, after an engine-fatal fault, REBUILD — the
+        device-facing stack: engine, prefix cache, drafters, scheduler.
+        Recovery restarts COLD by design (empty slots, free block pool,
+        empty trie): correctness never depends on cache contents, only
+        capacity and latency do, and a cold trie refills from the
+        replayed traffic itself. The jitted programs are module-level
+        lru caches keyed by config, so a rebuild reuses every compiled
+        executable — teardown + rebuild is host bookkeeping plus one
+        pool allocation, not a recompile."""
+        b = self._build
+        cfg, slots, spec_mode = b["cfg"], b["slots"], b["spec_mode"]
+        prefill_chunk, prefix_mb = b["prefill_chunk"], b["prefix_mb"]
+        self._engine = DecodeEngine(
+            cfg, b["params"], slots, prefill_chunk=prefill_chunk,
+            recompile_limit=b["recompile_limit"],
+            recompile_strict=b["recompile_strict"],
+            spec_len=b["spec_len"] if spec_mode != "off" else 0,
+            obs_registry=self._registry,
+            num_blocks=b["nb"],
+            block_size=b["block_size"] if self._paged else 0,
+            injector=self._inj)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             if self._paged:
@@ -217,67 +375,27 @@ class InferenceServer:
             from .speculative import ModelDrafter, NgramDrafter
             self._drafters["ngram"] = NgramDrafter(self._engine.spec_len)
             if spec_mode == "model":
-                dcfg, dparams = spec_model
+                dcfg, dparams = b["spec_model"]
                 self._drafters["model"] = ModelDrafter(
                     dcfg, dparams, slots, target_cfg=cfg)
-        # device/compiler observatory (obs/devprof.py): compile-time
-        # accounting always (this registry becomes a CompileWatch sink,
-        # so every compile the server triggers lands in
-        # cxn_compile_seconds{fn=} + a `compile` span on the engine
-        # track); the cost table + live MFU sampler only when armed —
-        # extraction AOT-compiles every engine program once, which is
-        # startup cost a prof_every=0 server must not pay
-        devprof.compile_watch().add_sink(self._registry, self._tracer)
         self._prof_sampler = None
-        if prof_every > 0:
+        if b["prof_every"] > 0:
             table = devprof.profile_engine(self._engine,
                                            registry=self._registry)
             self._prof_sampler = devprof.LiveSampler(
-                self._registry, cadence=prof_every, table=table,
+                self._registry, cadence=b["prof_every"], table=table,
                 tracer=self._tracer)
             self._engine.set_profiler(self._prof_sampler)
-        # StepStats feeds the registry (utils/profiler.py observer):
-        # every phase sample lands in the mergeable per-phase histogram
-        # as well as the StepStats percentile window
-        self._phase_h = self._registry.histogram(
-            "cxn_serve_phase_seconds",
-            "per-phase scheduler durations (queue_wait, prefill_chunk, "
-            "prefix_copy, decode_tick, spec_draft, spec_verify)",
-            labelnames=("phase",))
-        # every admitted request observes queue_wait, so the series must
-        # exist (count 0) even before the first observation — overload
-        # monitors alert on its absence, not just its value
-        self._phase_h.labels(profiler.QUEUE_WAIT)
-        self._stats = profiler.StepStats(
-            observer=lambda name, s: self._phase_h.labels(name).observe(s))
         self._sched = SlotScheduler(self._engine, self._stats,
                                     on_finish=self._record_done,
                                     prefix_cache=self._prefix,
                                     drafters=self._drafters,
                                     spec_mode=spec_mode,
                                     spec_len=self._engine.spec_len,
-                                    tracer=self._tracer)
-        self._queue: collections.deque = collections.deque()
-        self._queue_cap = queue
-        self._cond = threading.Condition()
-        self._rid = _rid_seq
-        self._closing = False           # no new submits
-        self._drain = True              # finish queued work on shutdown?
-        self._stopped = threading.Event()
-        # counters + per-request latency samples for metrics(); the
-        # sample reservoirs are bounded so a long-lived server's memory
-        # does not grow with requests served (percentiles then describe
-        # the most recent window)
-        self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
-                        "timeout": 0, "cancelled": 0, "expired": 0}
-        self._ttft_s: collections.deque = collections.deque(maxlen=4096)
-        self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
-        self._queue_depth_max = 0
-        self._register_obs()
-        self._thread = threading.Thread(
-            target=self._loop,
-            name="cxn-serve-scheduler-%d" % next(_server_seq), daemon=True)
-        self._thread.start()
+                                    tracer=self._tracer,
+                                    injector=self._inj,
+                                    on_swap_corrupt=self._replay_one)
+        self._sched.prefix_admission = self._ladder.prefix_admission
 
     # --------------------------------------------------------------- obs
     def _register_obs(self) -> None:
@@ -314,7 +432,10 @@ class InferenceServer:
                             "(queue-deadline expiry included)"),
                 ("expired", "requests whose queue deadline passed "
                             "before a slot freed (subset of timeout)"),
-                ("cancelled", "requests cancelled by shutdown/abort")):
+                ("cancelled", "requests cancelled by shutdown/abort"),
+                ("error", "requests failed typed (replay divergence, "
+                          "swap corruption, engine permanently "
+                          "failed)")):
             cb_counter("cxn_serve_%s_total" % key, help_,
                        lambda k=key: self._counts[k])
         for attr, help_ in (
@@ -334,6 +455,49 @@ class InferenceServer:
                                   "(accept-rate back-off)")):
             cb_counter("cxn_serve_%s_total" % attr, help_,
                        lambda a=attr: getattr(sc, a))
+        # resilience catalog (serve/resilience.py, doc/observability.md)
+        # — registered whether or not chaos / the watchdog is armed, so
+        # the exported name set is stable across configurations
+        cb_gauge("cxn_serve_state", "serving state (0=SERVING, "
+                 "1=DEGRADED, 2=DRAINING, 3=FAILED)",
+                 lambda: STATE_CODES[self.health()["state"]])
+        cb_gauge("cxn_serve_degrade_rung", "degradation-ladder rung "
+                 "(0=normal .. 3=shedding)", lambda: self._ladder.rung)
+        cb_counter("cxn_engine_restarts_total", "engine teardown+rebuild "
+                   "recoveries (fault or watchdog)",
+                   lambda: self._restarts)
+        cb_counter("cxn_replayed_requests_total", "admitted requests "
+                   "re-queued for deterministic replay after a recovery "
+                   "or swap corruption", lambda: self._replayed)
+        cb_counter("cxn_reserve_stalls_total", "scheduler passes parked "
+                   "because the queue head's blocks could not be placed "
+                   "(make-room escapes exhausted)",
+                   lambda: self._reserve_stalls)
+        cb_counter("cxn_swap_corruptions_total", "swap-in host buffers "
+                   "that failed their checksum (row replayed)",
+                   lambda: sc.swap_corruptions)
+        cb_counter("cxn_drafter_faults_total", "contained drafter "
+                   "exceptions (rows ticked plain that pass)",
+                   lambda: sc.drafter_faults)
+        cb_counter("cxn_prefix_restore_faults_total", "contained prefix-"
+                   "restore failures (treated as cache misses)",
+                   lambda: sc.prefix_restore_faults)
+        cb.append("cxn_faults_injected_total")
+        inj = self._inj
+        fam = r.counter("cxn_faults_injected_total",
+                        "chaos faults injected by point "
+                        "(serve_chaos / CXN_CHAOS)",
+                        labelnames=("point",))
+        for point in FaultInjector.POINTS:
+            # pre-touched so the catalog is stable; callback-backed only
+            # when an injector is armed
+            fam.labels(point, fn=(lambda p=point: inj.counts[p])
+                       if inj is not None else None)
+        self._shed_c = r.counter(
+            "cxn_shed_requests_total",
+            "queued requests shed by the degradation ladder",
+            labelnames=("rung",))
+        self._shed_c.labels("3")        # shedding is the rung-3 effect
         cb_gauge("cxn_serve_queue_depth", "requests waiting in the "
                  "admission queue", lambda: len(self._queue))
         cb_gauge("cxn_serve_queue_depth_max", "high-water queue depth "
@@ -447,6 +611,18 @@ class InferenceServer:
         """The span tracer this server records into."""
         return self._tracer
 
+    @property
+    def fault_injector(self):
+        """The armed chaos injector (None when ``serve_chaos`` is off).
+        Tests disarm it (``.armed = False``) around warm-up passes so
+        compile-time passes don't consume deterministic `@N` shots."""
+        return self._inj
+
+    @property
+    def ladder(self):
+        """The degradation ladder (serve/resilience.py)."""
+        return self._ladder
+
     def metrics_text(self) -> str:
         """Prometheus text exposition of the full serving catalog
         (serving + prefix-cache + speculative + recompile-guard
@@ -506,8 +682,32 @@ class InferenceServer:
                          % (p.spec_mode,
                             ", ".join(sorted(self._drafters)) or "none"))
         with self._cond:
+            if self._failed is not None:
+                self._counts["rejected"] += 1
+                raise EngineFailedError(str(self._failed))
             if self._closing:
                 raise AdmissionError("server is shutting down")
+            if self._ladder.shedding and not block and p.timeout_ms > 0 \
+                    and self._ema_req_s > 0:
+                # non-blocking submits only: a block=True caller (the
+                # CLI stdin loop) asked to WAIT, and the queue-resident
+                # shed still protects it if its deadline turns hopeless
+                # rung-3 door check: a deadline the current backlog
+                # cannot possibly meet is shed NOW with a back-off
+                # hint, not queued to expire after wasting queue space
+                eta_ms = ((len(self._queue) + 1) * self._ema_req_s
+                          / max(1, self._engine.slots)) * 1e3
+                if eta_ms > p.timeout_ms:
+                    self._counts["rejected"] += 1
+                    self._counts["shed"] += 1
+                    self._shed_c.labels(str(self._ladder.rung)).inc()
+                    self._ladder.sheds += 1
+                    self._phase_h.labels(profiler.QUEUE_WAIT).observe(0.0)
+                    raise QueueFullError(
+                        "overload shed at admission: estimated queue "
+                        "wait %.0f ms exceeds timeout_ms=%.0f"
+                        % (eta_ms, p.timeout_ms),
+                        retry_after_ms=self._retry_after_ms())
             while len(self._queue) >= self._queue_cap:
                 if not block:
                     self._counts["rejected"] += 1
@@ -516,8 +716,11 @@ class InferenceServer:
                         "admission queue full (%d queued, %d/%d slots "
                         "busy); retry later or submit(block=True)"
                         % (len(self._queue), self._sched.active,
-                           self._engine.slots))
+                           self._engine.slots),
+                        retry_after_ms=self._retry_after_ms())
                 self._cond.wait()
+                if self._failed is not None:
+                    raise EngineFailedError(str(self._failed))
                 if self._closing:
                     raise AdmissionError("server is shutting down")
             req = Request(next(self._rid), prompt, p, time.perf_counter())
@@ -548,7 +751,8 @@ class InferenceServer:
                                queue_ms=(handle.admit_t
                                          - handle.submit_t) * 1e3)
         return ServeResult(handle.status, np.zeros((0,), np.int32),
-                           error=handle.error)
+                           error=handle.error,
+                           retry_after_ms=handle.retry_after_ms)
 
     # -------------------------------------------------------------- loop
     def _expire_queued_locked(self, now: float) -> List[Request]:
@@ -598,137 +802,505 @@ class InferenceServer:
             self._cond.notify_all()
         return expired
 
-    def _loop(self) -> None:
-        admitted = []
+    def _loop(self, gen: int) -> None:
+        """The scheduler loop for one engine GENERATION. A fault on
+        this thread recovers in place (same generation); a watchdog
+        recovery bumps ``self._gen`` and starts a fresh thread — this
+        one then unwinds without finalizing (the new thread owns the
+        state, and this one's engine/scheduler references were already
+        discarded)."""
         try:
-            while True:
-                admitted = []
-                expired = []
+            while self._gen == gen:
                 try:
-                    with self._cond:
-                        now = time.perf_counter()
-                        expired = self._expire_queued_locked(now)
-                        if self._closing and not self._drain:
-                            break
-                        n_free = self._sched.free_slots   # slots shrink
-                        #   only when admit() runs below, outside this
-                        #   lock
-                        # swapped (preempted) requests resume with
-                        # strict priority over fresh admissions — and
-                        # the paged admissible() gate stops popping at
-                        # the first queue head whose blocks don't fit,
-                        # so overload waits in the queue instead of
-                        # thrashing the pool with admit/preempt cycles.
-                        # `claimed` carries the blocks promised to
-                        # requests popped EARLIER IN THIS PASS (their
-                        # allocations run later, outside this lock), so
-                        # a burst can't over-admit against a free_count
-                        # that hasn't moved yet.
-                        claimed = 0
-                        while n_free > 0 and self._queue \
-                                and not self._sched.swapped_pending \
-                                and self._sched.admissible(
-                                    self._queue[0], claimed):
-                            req = self._queue.popleft()
-                            claimed += self._sched.admission_claim(req)
-                            admitted.append(req)
-                            n_free -= 1
-                            self._cond.notify_all()   # space for blocked
-                            #                           submits
-                        if not admitted and self._sched.active == 0 \
-                                and not self._sched.swapped_pending:
-                            if self._closing and not self._queue:
-                                break
-                            # truly idle: active == 0 means every slot
-                            # is free and (queue empty) nothing can
-                            # expire while we sleep; every mutation
-                            # path (submit, shutdown) notifies, so an
-                            # untimed wait parks the thread instead of
-                            # polling. An inadmissible queue head with
-                            # every slot free should be impossible
-                            # (full trie eviction always fits one
-                            # valid prompt) — the timed wait below is
-                            # the belt-and-braces fallback so an
-                            # estimate bug degrades to a 50 ms poll,
-                            # never a deadlock. A pass that just
-                            # expired requests skips the park so their
-                            # exemplar dump (below) isn't deferred to
-                            # the next submit.
-                            if self._queue:
-                                self._cond.wait(0.05)
-                            elif not expired:
-                                self._cond.wait()
-                            continue
-                finally:
-                    # slow-exemplar hook outside the lock (note_slow
-                    # does file I/O); a finally so the break/continue
-                    # exits above cannot skip it — expired requests are
-                    # exactly the worst offenders obs_slow_ms exists
-                    # to capture
-                    for req in expired:
-                        self._maybe_slow(req)
-                # preempted requests come back FIRST (strict priority —
-                # the pop loop above did not admit while any were
-                # pending), then fresh admissions; both are device work
-                # and run outside the lock
-                if self._sched.swapped_pending:
-                    self._sched.resume_swapped()
-                for req in admitted:            # device work outside the
-                    self._sched.admit(req)      # lock
-                # at most prefill_budget chunk steps per pass, so a long
-                # prompt's prefill cannot stall the decode tick for more
-                # than one chunk's duration (whole-prompt admits already
-                # ran inside admit() when chunking is off)
-                for _ in range(self._prefill_budget):
-                    if not self._sched.prefill_step():
+                    if not self._pass():
                         break
-                # draft-and-verify before the tick: each eligible row
-                # banks up to spec_len + 1 tokens from ONE verify
-                # forward, then the shared tick advances every decoding
-                # row (verified rows included) by one more
-                if self._drafters and self._sched.decoding:
-                    self._sched.spec_steps()
-                if self._sched.decoding:
-                    self._sched.tick()
+                except Exception as e:
+                    if self._gen != gen or isinstance(e, SupersededError):
+                        return          # superseded by a watchdog restart
+                    if self._closing and not self._drain:
+                        break           # aborting anyway: don't rebuild
+                    if not self._recover(
+                            "%s: %s" % (type(e).__name__, e), gen):
+                        break           # restart budget exhausted
+                    if self._gen != gen:
+                        return
         finally:
-            # reached on shutdown OR on an unexpected scheduler-thread
-            # exception (e.g. a compile OOM in prefill): either way the
-            # server must stop ACCEPTING — otherwise submits would queue
-            # forever with no thread to serve them and result() would
-            # hang — and every request still in flight must reach a
-            # terminal state so result() returns
+            if self._gen == gen:
+                self._finalize()
+
+    def _pass(self) -> bool:
+        """One scheduler pass (expire / shed / admit / resume / prefill
+        / speculate / tick / ladder); returns False when the loop
+        should exit. Every device call runs OUTSIDE the admission
+        lock."""
+        sched = self._sched
+        admitted = []
+        expired = []
+        shed = []
+        try:
             with self._cond:
-                self._closing = True
-                for req in self._queue:
-                    self._counts["cancelled"] += 1
-                    req.finish("cancelled", "server shutdown")
-                self._queue.clear()
-                self._cond.notify_all()
-            # retire every scheduler-tracked request FIRST (counted via
-            # _record_done), so the sweep below only touches requests
-            # the scheduler never took ownership of — popped but not
-            # admit()ed, or crashed mid-admit before being tracked — and
-            # nothing is finished (or counted) twice
-            self._sched.cancel_active()
-            for req in admitted:
-                if not req.done.is_set():
-                    self._counts["cancelled"] += 1
-                    req.finish("cancelled", "server shutdown")
-            if self._prefix is not None:
-                self._prefix.clear()        # drop the cached chunk K/V
-            for d in self._drafters.values():
-                d.close()                   # drop the draft slot pool
+                now = time.perf_counter()
+                expired = self._expire_queued_locked(now)
+                if self._closing and not self._drain:
+                    return False
+                if self._ladder.shedding:
+                    shed = self._shed_queued_locked(now)
+                n_free = sched.free_slots   # slots shrink only when
+                #   admit() runs below, outside this lock
+                # swapped (preempted) requests resume with strict
+                # priority over fresh admissions — and the paged
+                # admissible() gate stops popping at the first queue
+                # head whose blocks don't fit, so overload waits in the
+                # queue instead of thrashing the pool with admit/preempt
+                # cycles. `claimed` carries the blocks promised to
+                # requests popped EARLIER IN THIS PASS (their
+                # allocations run later, outside this lock), so a burst
+                # can't over-admit against a free_count that hasn't
+                # moved yet.
+                claimed = 0
+                while n_free > 0 and self._queue \
+                        and not sched.swapped_pending \
+                        and sched.admissible(self._queue[0], claimed):
+                    req = self._queue.popleft()
+                    # journal BEFORE any device work: from this moment
+                    # until its terminal state, the request is replayed
+                    # after an engine-fatal fault (serve/resilience.py)
+                    self._journal.add(req)
+                    claimed += sched.admission_claim(req)
+                    admitted.append(req)
+                    n_free -= 1
+                    self._cond.notify_all()   # space for blocked submits
+                if not admitted and sched.active == 0 \
+                        and not sched.swapped_pending:
+                    if self._closing and not self._queue:
+                        return False
+                    # truly idle: active == 0 means every slot is free
+                    # and (queue empty) nothing can expire while we
+                    # sleep; every mutation path (submit, shutdown)
+                    # notifies, so an untimed wait parks the thread
+                    # instead of polling. An inadmissible queue head
+                    # with every slot free is the make-room loop's
+                    # terminal stall — all three escapes (trie evict,
+                    # preempt, swap) exhausted — so it is COUNTED
+                    # (cxn_reserve_stalls_total) and fed to the
+                    # degradation ladder instead of silently parked;
+                    # the 50 ms wait keeps it a poll, never a deadlock.
+                    # A pass that just expired/shed requests skips the
+                    # park so their exemplar dump isn't deferred to the
+                    # next submit.
+                    if self._queue:
+                        self._reserve_stalls += 1
+                        self._ladder.note_stall()
+                        self._evaluate_ladder()
+                        self._cond.wait(0.05)
+                    elif not expired and not shed:
+                        self._evaluate_ladder()
+                        self._parked = True
+                        try:
+                            self._cond.wait()
+                        finally:
+                            # beat BEFORE unparking: the watchdog must
+                            # never observe parked=False with a stale
+                            # heartbeat on a just-woken healthy loop
+                            self._beat()
+                            self._parked = False
+                    self._beat()
+                    return True
+        finally:
+            # slow-exemplar hook outside the lock (note_slow does file
+            # I/O); a finally so the early returns above cannot skip it
+            # — expired/shed requests are exactly the worst offenders
+            # obs_slow_ms exists to capture
+            for req in expired:
+                self._maybe_slow(req)
+            for req in shed:
+                self._maybe_slow(req)
+        # preempted requests come back FIRST (strict priority — the pop
+        # loop above did not admit while any were pending), then fresh
+        # admissions; both are device work and run outside the lock
+        if sched.swapped_pending:
+            sched.resume_swapped()
+        for req in admitted:                # device work outside the
+            sched.admit(req)                # lock
+        # at most prefill_budget chunk steps per pass, so a long
+        # prompt's prefill cannot stall the decode tick for more than
+        # one chunk's duration (whole-prompt admits already ran inside
+        # admit() when chunking is off)
+        for _ in range(self._prefill_budget):
+            if not sched.prefill_step():
+                break
+        # draft-and-verify before the tick: each eligible row banks up
+        # to spec_len + 1 tokens from ONE verify forward, then the
+        # shared tick advances every decoding row (verified rows
+        # included) by one more. Degradation rung 1 skips speculation:
+        # it is optional work whose verifies cost dispatches the
+        # saturated engine needs for ticks.
+        if self._drafters and sched.decoding \
+                and self._ladder.spec_enabled:
+            sched.spec_steps()
+        if sched.decoding:
+            sched.tick()
+        self._evaluate_ladder()
+        self._beat()
+        return True
+
+    def _beat(self) -> None:
+        """Heartbeat: one completed scheduler pass (the watchdog's
+        liveness signal)."""
+        self._heartbeat = time.perf_counter()
+
+    def _finalize(self) -> None:
+        """Terminal shutdown: stop accepting, resolve EVERY outstanding
+        request exactly once, drop the caches, release the stopped
+        event. Reached on drain/abort shutdown and — with the typed
+        EngineFailedError status — when the restart budget is
+        exhausted."""
+        err = self._failed
+        status = "error" if err is not None else "cancelled"
+        msg = str(err) if err is not None else "server shutdown"
+        with self._cond:
+            self._closing = True
+            for req in self._queue:
+                self._counts[status] += 1
+                req.finish(status, msg)
+            self._queue.clear()
+            self._cond.notify_all()
+        # retire every scheduler-tracked request FIRST (counted via
+        # _record_done, which also drops them from the journal), so the
+        # journal sweep below only touches requests the scheduler never
+        # took ownership of — popped but not admit()ed, or crashed
+        # mid-admit — and nothing is finished (or counted) twice
+        # (Request.finish is first-wins)
+        self._sched.cancel_active(status, msg)
+        for req in self._journal.requests():
+            if not req.done.is_set():
+                self._counts[status] += 1
+                req.finish(status, msg)
+        self._journal.clear()
+        if self._prefix is not None:
+            self._prefix.clear()        # drop the cached chunk K/V
+        for d in self._drafters.values():
+            d.close()                   # drop the draft slot pool
+        self._engine.close()
+        self._stopped.set()
+
+    # --------------------------------------------------------- recovery
+    def _recover(self, reason: str, gen: int) -> bool:
+        """Exception-path recovery, on the loop thread itself. Returns
+        False when the loop should exit (budget exhausted, or a
+        concurrent watchdog recovery superseded this thread)."""
+        with self._recover_lock:
+            if self._gen != gen:
+                return False            # watchdog got here first
+            ok = self._do_recover(reason)
+            self._beat()                # recovery was progress
+            return ok
+
+    def _do_recover(self, reason: str) -> bool:
+        """Tear down the pool, rebuild the engine cold, and requeue the
+        journaled requests for deterministic replay (module docstring
+        of serve/resilience.py). Caller holds ``_recover_lock``.
+        Returns False when ``serve_max_restarts`` is exhausted — the
+        server is then permanently FAILED and the caller finalizes."""
+        t0 = time.perf_counter()
+        self._restarts += 1
+        if self._inj is not None:
+            # wake any injected hang NOW: an abandoned thread sleeping
+            # inside the old engine must unwind, not resume a pass on
+            # state this recovery is about to discard
+            self._inj.release_hangs()
+        tr = self._tracer
+        if self._restarts > self._max_restarts:
+            self._failed = EngineFailedError(
+                "engine failed %d time(s), exceeding serve_max_restarts"
+                "=%d; last fault: %s"
+                % (self._restarts, self._max_restarts, reason))
+            profiler.warn("serve: %s" % self._failed)
+            # the FAILED path keeps the old scheduler for the terminal
+            # sweep, but only THIS thread may drive it — a hung loop
+            # thread waking mid-device-call must still unwind instead
+            # of re-retiring requests _finalize already failed
+            self._sched.supersede()
+            if tr.enabled:
+                tr.instant("engine_failed", TID_CONTROL,
+                           cat="resilience",
+                           args={"reason": reason,
+                                 "restarts": self._restarts})
+            return False
+        profiler.warn("serve: engine fault (%s) -- restart %d/%d: "
+                      "tearing down and rebuilding cold"
+                      % (reason, self._restarts, self._max_restarts))
+        old = self._sched
+        old.supersede()                 # an abandoned thread that wakes
+        #                                 inside this scheduler unwinds
+        old_prefix = self._prefix
+        old_manager = self._engine.manager if self._paged else None
+        t_teardown = time.perf_counter()
+        try:
             self._engine.close()
-            self._stopped.set()
+        except Exception:
+            pass                        # the engine is being discarded
+        if self._prefix is not None:
+            try:
+                self._prefix.clear()
+            except Exception:
+                pass
+        for d in self._drafters.values():
+            try:
+                d.close()
+            except Exception:
+                pass
+        t_rebuild = time.perf_counter()
+        self._build_stack()
+        for attr in _SCHED_CARRY:       # registry counters stay monotone
+            setattr(self._sched, attr, getattr(old, attr))
+        # the prefix-cache and block-manager traffic counters back other
+        # callback counters (cxn_prefix_*_total, cxn_cow_faults_total) —
+        # carry them onto the cold-rebuilt objects for the same reason
+        if self._prefix is not None and old_prefix is not None:
+            for attr in ("hits", "misses", "hit_tokens", "prompt_tokens",
+                         "evictions", "inserted_chunks"):
+                setattr(self._prefix, attr, getattr(old_prefix, attr))
+        if old_manager is not None and self._paged:
+            self._engine.manager.cow_faults = old_manager.cow_faults
+        self._register_obs()            # rebind callbacks to the new
+        #                                 engine/scheduler (latest wins)
+        t_replay = time.perf_counter()
+        reqs = [r for r in self._journal.requests()
+                if not r.done.is_set()]
+        self._journal.clear()
+        for req in reqs:
+            reset_for_replay(req)
+        with self._cond:
+            # replayed requests go to the FRONT in admission order —
+            # they were admitted once and must not requeue behind
+            # traffic that arrived after them (cap overflow is fine:
+            # they already held their queue slot)
+            for req in reversed(reqs):
+                self._queue.appendleft(req)
+            self._cond.notify_all()
+        self._replayed += len(reqs)
+        t1 = time.perf_counter()
+        if tr.enabled:
+            # the recovery span tree on the ENGINE track: a restart is
+            # visible in Perfetto exactly where the ticks stop
+            tr.add("teardown", t_teardown, t_rebuild - t_teardown,
+                   TID_ENGINE, cat="resilience")
+            tr.add("rebuild", t_rebuild, t_replay - t_rebuild,
+                   TID_ENGINE, cat="resilience")
+            tr.add("replay", t_replay, t1 - t_replay, TID_ENGINE,
+                   cat="resilience", args={"requests": len(reqs)})
+            tr.add("recovery", t0, t1 - t0, TID_ENGINE, cat="resilience",
+                   args={"reason": reason, "restart": self._restarts,
+                         "replayed": len(reqs)})
+        profiler.warn("serve: engine rebuilt cold in %.0f ms (restart "
+                      "%d/%d), replaying %d in-flight request(s)"
+                      % ((t1 - t0) * 1e3, self._restarts,
+                         self._max_restarts, len(reqs)))
+        return True
+
+    def _replay_one(self, req: Request) -> None:
+        """Single-request replay (the scheduler's swap-corruption hook):
+        the row's host buffer was untrusted, so the request is rewound
+        and re-queued through the normal admit path — the deterministic
+        key schedule regenerates its verified tokens bit-identically."""
+        self._journal.remove(req)
+        reset_for_replay(req)
+        self._replayed += 1
+        if self._tracer.enabled:
+            self._tracer.instant("replay_request", TID_CONTROL,
+                                 cat="resilience",
+                                 args={"rid": req.rid,
+                                       "why": "swap corruption"})
+        with self._cond:
+            self._queue.appendleft(req)
+            self._cond.notify_all()
+
+    def _watch(self) -> None:
+        """Watchdog thread (``cxn-serve-watchdog-*``): a scheduler loop
+        that has not completed a pass within ``serve_watchdog_ms``
+        while un-parked work exists is declared hung — the generation
+        is bumped (abandoning the stuck thread: when its device call
+        finally returns, or its injected hang is released, it sees the
+        mismatch and unwinds), the stack is rebuilt, and a fresh loop
+        thread takes over. Hangs become restarts instead of silent
+        deadlocks; the restart budget still applies."""
+        thresh = self._watchdog_ms / 1e3
+        period = max(0.005, min(thresh / 4.0, 0.25))
+        while not self._watch_stop.wait(period):
+            if self._stopped.is_set():
+                return
+            if self._parked:
+                continue                # idle park, not a hang
+            if time.perf_counter() - self._heartbeat < thresh:
+                continue
+            with self._recover_lock:
+                if self._stopped.is_set() or self._failed is not None:
+                    return
+                if self._parked or \
+                        time.perf_counter() - self._heartbeat < thresh:
+                    continue            # progressed while we waited
+                self._gen += 1
+                gen = self._gen
+                if self._do_recover(
+                        "watchdog: no scheduler pass completed in "
+                        "%.0f ms" % self._watchdog_ms):
+                    self._beat()
+                    self._thread = threading.Thread(
+                        target=self._loop, args=(gen,),
+                        name="cxn-serve-scheduler-%d-r%d"
+                        % (self._idx, self._restarts), daemon=True)
+                    self._thread.start()
+                else:
+                    self._finalize()
+                    return
+
+    # ----------------------------------------------------------- ladder
+    def _evaluate_ladder(self) -> None:
+        """One degradation-ladder step per scheduler pass (a few float
+        compares): queue pressure, paged block headroom (free +
+        trie-reclaimable over the usable pool), and any reserve stall
+        noted since the last step. Rung transitions are logged, traced
+        on the control track, and pushed to the scheduler's
+        prefix-admission switch."""
+        lad = self._ladder
+        if not lad.enabled:
+            return
+        before = lad.rung
+        with self._cond:
+            depth = len(self._queue)
+        qf = depth / float(self._queue_cap)
+        headroom = None
+        if self._paged:
+            m = self._engine.manager
+            usable = max(1, self._engine.num_blocks - 1)
+            free = m.free_count
+            if self._prefix is not None:
+                free += self._prefix.reclaimable_blocks()
+            headroom = free / float(usable)
+        lad.evaluate(qf, headroom)
+        if lad.rung != before:
+            self._sched.prefix_admission = lad.prefix_admission
+            profiler.warn(
+                "serve: degradation rung %d -> %d (queue %.0f%%, "
+                "headroom %s) — %s"
+                % (before, lad.rung, 100.0 * qf,
+                   "%.0f%%" % (100.0 * headroom)
+                   if headroom is not None else "n/a",
+                   "speculation off" if lad.rung == 1 else
+                   "prefix admission off" if lad.rung == 2 else
+                   "shedding" if lad.rung >= 3 else "recovered"
+                   if lad.rung == 0 else "degraded"))
+            if self._tracer.enabled:
+                self._tracer.instant(
+                    "degrade_rung", TID_CONTROL, cat="resilience",
+                    args={"from": before, "to": lad.rung,
+                          "queue_frac": round(qf, 3),
+                          "headroom": (round(headroom, 3)
+                                       if headroom is not None
+                                       else None)})
+
+    def _retry_after_ms(self) -> float:
+        """Back-off hint for a shed/rejected request: the estimated
+        time for the current backlog to drain one queue slot's worth of
+        work — queue depth x the EMA of admit->done over the slot
+        count, floored at 50 ms."""
+        ema = self._ema_req_s if self._ema_req_s > 0 else 0.05
+        depth = len(self._queue)
+        return max(50.0,
+                   depth * ema / max(1, self._engine.slots) * 1e3)
+
+    def _shed_queued_locked(self, now: float) -> List[Request]:
+        """Rung-3 deadline-aware shedding (caller holds the lock): a
+        queued request whose estimated admission time already overruns
+        its deadline is finished as ``shed`` NOW, with a
+        ``retry_after_ms`` hint, instead of rotting in the queue until
+        expiry — the queue space goes to requests that can still make
+        it, which is what keeps admitted-request TTFT bounded under
+        overload. Requests without deadlines are never shed (they wait
+        by contract)."""
+        ema = self._ema_req_s
+        if ema <= 0 or not any(r.deadline is not None
+                               for r in self._queue):
+            return []
+        keep = collections.deque()
+        shed: List[Request] = []
+        slots = max(1, self._engine.slots)
+        pos = 0
+        for req in self._queue:
+            eta = now + (pos + 1) * ema / slots
+            if req.deadline is not None and eta > req.deadline:
+                retry = self._retry_after_ms()
+                req.retry_after_ms = retry
+                self._counts["shed"] += 1
+                self._ladder.sheds += 1
+                self._shed_c.labels(str(self._ladder.rung)).inc()
+                self._stats.record(profiler.QUEUE_WAIT,
+                                   now - req.submit_t)
+                self._stats.end_step()
+                req.finish("shed",
+                           "load shed at degradation rung %d: estimated "
+                           "admission %.0f ms past deadline; retry "
+                           "after %.0f ms"
+                           % (self._ladder.rung,
+                              (eta - req.deadline) * 1e3, retry))
+                shed.append(req)
+            else:
+                keep.append(req)
+                pos += 1
+        if shed:
+            self._queue = keep
+            self._cond.notify_all()
+            if self._tracer.enabled:
+                self._tracer.instant("shed", TID_CONTROL,
+                                     cat="resilience",
+                                     args={"count": len(shed),
+                                           "rung": self._ladder.rung})
+        return shed
+
+    def health(self) -> Dict:
+        """Liveness + degradation snapshot (doc/serving.md
+        "Resilience"): ``state`` is SERVING / DEGRADED (ladder rung >
+        0) / DRAINING (shutdown in progress) / FAILED (restart budget
+        exhausted — submits raise EngineFailedError); ``retry_after_ms``
+        carries the shed hint while rung 3 holds."""
+        if self._failed is not None:
+            state = STATE_FAILED
+        elif self._closing:
+            state = STATE_DRAINING
+        elif self._ladder.rung > 0:
+            state = STATE_DEGRADED
+        else:
+            state = STATE_SERVING
+        return {
+            "state": state,
+            "rung": self._ladder.rung,
+            "restarts": self._restarts,
+            "max_restarts": self._max_restarts,
+            "replayed": self._replayed,
+            "shed": self._ladder.sheds,
+            "reserve_stalls": self._reserve_stalls,
+            "queue_depth": len(self._queue),
+            "retry_after_ms": (self._retry_after_ms()
+                               if self._ladder.shedding else 0.0),
+            "watchdog_ms": self._watchdog_ms,
+            "chaos": self._inj.spec if self._inj is not None else "",
+        }
 
     def _record_done(self, req: Request) -> None:
         """Scheduler on_finish hook (scheduler-thread only)."""
+        self._journal.remove(req)       # terminal: nothing to replay
         if req.status != "ok":
             self._counts["cancelled" if req.status == "cancelled"
                          else req.status] += 1
             self._maybe_slow(req)
             return
         self._counts["completed"] += 1
+        if req.admit_t is not None:
+            # EMA of admit->done feeds the shed / retry_after estimates
+            dur = req.done_t - req.admit_t
+            self._ema_req_s = dur if self._ema_req_s <= 0 \
+                else 0.2 * dur + 0.8 * self._ema_req_s
         ttft = req.first_token_t - req.submit_t
         self._ttft_s.append(ttft)
         self._ttft_h.observe(ttft)
@@ -770,8 +1342,16 @@ class InferenceServer:
             self._closing = True
             self._drain = drain
             self._cond.notify_all()
+        if self._inj is not None:
+            # an injected hang must not outlive the server: the stalled
+            # thread raises, the loop sees closing, and (drain) recovery
+            # or (abort) finalize proceeds
+            self._inj.release_hangs()
         self._stopped.wait(timeout)
+        self._watch_stop.set()
         self._thread.join(timeout)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout)
         # freeze this server's callback metrics at their terminal
         # values: the registry stops pinning the engine/KV pool, and a
         # post-shutdown scrape reports the honest drained state instead
@@ -831,6 +1411,22 @@ class InferenceServer:
                 "swapped_pending": sc.swapped_pending,
                 "swap_host_bytes": sc.swap_host_bytes,
             } if self._paged else None),
+            # resilience snapshot (serve/resilience.py): restart/replay
+            # accounting, fault-containment counters, ladder state
+            "resilience": {
+                "state": self.health()["state"],
+                "rung": self._ladder.rung,
+                "restarts": self._restarts,
+                "replayed": self._replayed,
+                "shed": self._ladder.sheds,
+                "reserve_stalls": self._reserve_stalls,
+                "swap_corruptions": sc.swap_corruptions,
+                "drafter_faults": sc.drafter_faults,
+                "prefix_restore_faults": sc.prefix_restore_faults,
+                "replay_mismatches": sc.replay_mismatches,
+                "faults_injected": (dict(self._inj.counts)
+                                    if self._inj is not None else {}),
+            },
             "ticks": sc.ticks,
             "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
@@ -890,6 +1486,10 @@ class InferenceServer:
         self._sched.spec_backoffs = 0
         self._sched.swaps_out = 0
         self._sched.swaps_in = 0
+        self._sched.swap_corruptions = 0
+        self._sched.drafter_faults = 0
+        self._sched.prefix_restore_faults = 0
+        self._reserve_stalls = 0
         if self._paged:
             # traffic counter only — block refcounts/tables are live
             # state a reset must not touch

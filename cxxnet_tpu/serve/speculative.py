@@ -180,11 +180,22 @@ class ModelDrafter:
 
     def reset(self, slot: int) -> None:
         """A new request owns ``slot`` — its mirror row restarts from
-        position 0 (catch-up rewrites it; stale tail is masked)."""
+        position 0 (catch-up rewrites it; stale tail is masked). Also
+        the scheduler's fault-containment hook: a draft pass that threw
+        mid-catch-up may have advanced ``_synced`` past what the mirror
+        row actually holds, so the containing scheduler resets every
+        involved slot before the next pass (serve/scheduler.py)."""
         self._synced[slot] = 0
 
     def close(self) -> None:
+        """Idempotent; a closed drafter fails loudly on the next draft
+        (the engine recovery path rebuilds drafters from scratch —
+        serve/resilience.py — so a draft through a torn-down pool is a
+        supervisor bug, not a condition to limp through)."""
+        self.closed = True
         self.engine.close()
+
+    closed = False
 
     def _catch_up(self, slot: int, ctx: np.ndarray) -> int:
         """Consume ``ctx[synced:]`` into the mirror row via the chunk
@@ -214,6 +225,9 @@ class ModelDrafter:
 
     def draft(self, contexts: Dict[int, np.ndarray],
               lens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        if self.closed:
+            raise RuntimeError("ModelDrafter is closed (its slot pool "
+                               "was torn down)")
         if not contexts:
             return {}
         drafts: Dict[int, list] = {}

@@ -195,6 +195,8 @@ class Net:
                     registry=None, prof_every: int = 0,
                     paged: bool = True, block_size: int = 0,
                     num_blocks: int = 0, kv_mb: float = 0.0,
+                    chaos: str = "", max_restarts: int = 3,
+                    watchdog_ms: float = 0.0, degrade: bool = True,
                     **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
@@ -231,7 +233,19 @@ class Net:
         renders); ``prof_every`` arms the device/compiler observatory
         (obs/devprof.py — per-program cost table + one blocking
         device-time sample per N executions publishing live
-        ``cxn_mfu{fn=}`` gauges; 0 = off, the CLI serves with 64)."""
+        ``cxn_mfu{fn=}`` gauges; 0 = off, the CLI serves with 64).
+
+        Resilience (serve/resilience.py, doc/serving.md "Resilience"):
+        an engine-fatal fault or — with ``watchdog_ms`` > 0 — a stalled
+        loop tears the pool down, rebuilds the engine cold, and replays
+        every admitted request bit-identically from its journal record;
+        ``max_restarts`` bounds the rebuilds (typed EngineFailedError
+        beyond it). ``chaos`` arms the fault-injection harness
+        (``CXN_CHAOS`` env overrides; empty = true no-op) and
+        ``degrade`` the graceful-degradation ladder (spec off ->
+        prefix admission off -> deadline-aware shedding with
+        ``retry_after_ms`` hints); :meth:`serve_health` reports
+        SERVING / DEGRADED / DRAINING / FAILED."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams
         if getattr(self, "_server", None) is not None:
@@ -248,7 +262,9 @@ class Net:
             spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
             tracer=tracer, registry=registry, prof_every=prof_every,
             paged=paged, block_size=block_size, num_blocks=num_blocks,
-            kv_mb=kv_mb, defaults=SamplingParams(**defaults))
+            kv_mb=kv_mb, chaos=chaos, max_restarts=max_restarts,
+            watchdog_ms=watchdog_ms, degrade=degrade,
+            defaults=SamplingParams(**defaults))
 
     def _serving(self):
         srv = getattr(self, "_server", None)
@@ -273,6 +289,13 @@ class Net:
         """Serving health snapshot (p50/p95/p99 TTFT and tick latencies,
         queue depth, slot occupancy, batch efficiency)."""
         return self._serving().metrics()
+
+    def serve_health(self) -> Dict:
+        """Liveness + degradation snapshot (doc/serving.md
+        "Resilience"): state SERVING / DEGRADED / DRAINING / FAILED,
+        the ladder rung, restart/replay/shed accounting, and the
+        current ``retry_after_ms`` hint while shedding."""
+        return self._serving().health()
 
     def serve_stop(self, drain: bool = True) -> None:
         """Stop the server (``drain=True`` finishes in-flight + queued

@@ -49,6 +49,8 @@ def _no_leaked_background_threads():
     # first worker is the cheap kind) + the obs metrics flusher
     # (cxn-obs-flusher-*, obs/export.py — a leaked one keeps appending
     # JSONL snapshots to a closed test's tmp file forever)
+    # (the "cxn-serve" prefix also covers the resilience layer's
+    # watchdog threads, cxn-serve-watchdog-* — serve/server.py)
     prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec", "cxn-obs")
     deadline = time.time() + 5.0
     while True:
@@ -59,3 +61,12 @@ def _no_leaked_background_threads():
         time.sleep(0.05)
     assert not leaked, \
         "framework background threads leaked past teardown: %s" % leaked
+    # replay-journal leak check (round 15): a server that shut down
+    # finalizes every journaled request and clears its journal — a
+    # non-empty journal after teardown means admitted requests were
+    # abandoned without a terminal state (result() would hang forever)
+    from cxxnet_tpu.serve.resilience import live_journals
+    leaked_j = [j for j in live_journals() if len(j)]
+    assert not leaked_j, \
+        "replay journals leaked %s admitted request(s) past teardown" \
+        % [len(j) for j in leaked_j]

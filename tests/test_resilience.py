@@ -1,0 +1,651 @@
+"""Serving resilience layer (serve/resilience.py + wiring): fault
+injection, deterministic request replay, watchdog restart, degradation
+ladder, swap checksums, and the trainer's nan_recover + async-prefetch
+interaction.
+
+The acceptance matrix: for every chaos point (pool exhaustion, swap
+failure, swap corruption, drafter fault, prefix-restore failure, tick
+exception, tick hang) the engine either completes every in-flight
+request with tokens BIT-IDENTICAL to the fault-free run (greedy exact;
+sampled on the pinned fold_in schedule) or fails it with a typed error
+— no hangs, no leaked blocks or threads (conftest fixture), restart
+count bounded by serve_max_restarts.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (DecodeEngine, EngineFailedError,
+                              FaultInjector, InferenceServer,
+                              QueueFullError, Request, SamplingParams,
+                              SlotScheduler)
+from cxxnet_tpu.serve.resilience import (DegradationLadder, ReplayJournal,
+                                         reset_for_replay)
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, **kw):
+    seed = kw.pop("seed", 0)
+    t = kw.get("temperature", 0.0)
+    rng = jax.random.PRNGKey(seed) if t > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 rng=rng, **kw))[0]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm_programs():
+    """Compile every serve program for CFG once (the jitted fns are
+    module-level lru caches keyed by config), so watchdog thresholds in
+    the tests below measure PASSES, not first-call compiles."""
+    rs = np.random.RandomState(99)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=2) as srv:
+        h = srv.submit(_prompt(rs, 6), max_tokens=4)
+        assert srv.result(h, timeout=300).status == "ok"
+
+
+# ----------------------------------------------------------- unit: chaos
+def test_chaos_spec_grammar_and_determinism():
+    inj = FaultInjector.from_spec(
+        "tick_raise:0.5,swap_in@3,seed:7,hang_ms:123")
+    assert inj.seed == 7 and inj.hang_ms == 123.0
+    # @N one-shot: fires exactly on the Nth call, never again
+    assert [inj.fire("swap_in") for _ in range(5)] == \
+        [False, False, True, False, False]
+    assert inj.counts["swap_in"] == 1
+    # probability rolls are deterministic per (seed, point)
+    a = FaultInjector.from_spec("tick_raise:0.3,seed:11")
+    b = FaultInjector.from_spec("tick_raise:0.3,seed:11")
+    seq_a = [a.fire("tick_raise") for _ in range(200)]
+    assert seq_a == [b.fire("tick_raise") for _ in range(200)]
+    assert 20 < sum(seq_a) < 110          # ~0.3 of 200
+    # all:p arms every point; disarm gates everything
+    c = FaultInjector.from_spec("all:1.0")
+    assert all(c.fire(p) for p in FaultInjector.POINTS)
+    c.armed = False
+    assert not any(c.fire(p) for p in FaultInjector.POINTS)
+
+
+def test_chaos_spec_off_and_errors():
+    assert FaultInjector.from_spec("") is None
+    assert FaultInjector.from_spec("  ") is None
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultInjector.from_spec("tick_rase:0.1")
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultInjector.from_spec("nope@3")
+    with pytest.raises(ValueError, match="malformed"):
+        FaultInjector.from_spec("tick_raise")
+
+
+# ---------------------------------------------------------- unit: ladder
+def test_ladder_hysteresis_and_effects():
+    lad = DegradationLadder(up_hold=2, down_hold=3)
+    assert lad.rung == 0 and lad.spec_enabled and lad.prefix_admission
+    # one hot eval is not enough (hysteresis)
+    lad.evaluate(1.0, None)
+    assert lad.rung == 0
+    lad.evaluate(1.0, None)
+    assert lad.rung == 1 and not lad.spec_enabled and lad.prefix_admission
+    # the middle band resets the streak: no climb, no descent
+    lad.evaluate(0.5, None)
+    lad.evaluate(1.0, None)
+    assert lad.rung == 1
+    for _ in range(4):
+        lad.evaluate(1.0, None)
+    assert lad.rung == 3 and lad.shedding and not lad.prefix_admission
+    assert lad.evaluate(1.0, None) == 3     # capped at MAX_RUNG
+    # cool-down needs down_hold consecutive calm evals per rung
+    for _ in range(3):
+        lad.evaluate(0.0, None)
+    assert lad.rung == 2
+    for _ in range(6):
+        lad.evaluate(0.0, None)
+    assert lad.rung == 0
+
+
+def test_ladder_stall_and_headroom_signals():
+    lad = DegradationLadder(up_hold=1)
+    lad.note_stall()
+    lad.evaluate(0.0, None)                 # stall alone is hot
+    assert lad.rung == 1
+    lad.evaluate(0.0, 0.01)                 # headroom <= lo is hot
+    assert lad.rung == 2
+    # a disabled ladder never moves
+    off = DegradationLadder(enabled=False, up_hold=1)
+    off.note_stall()
+    assert off.evaluate(1.0, 0.0) == 0
+
+
+def test_ladder_tick_budget_signal():
+    lad = DegradationLadder(up_hold=1, tick_budget_ms=5.0)
+    lad.evaluate(0.0, None, tick_p95_ms=50.0)
+    assert lad.rung == 1
+    # without a budget the tick signal is inert
+    lad2 = DegradationLadder(up_hold=1)
+    lad2.evaluate(0.0, None, tick_p95_ms=50.0)
+    assert lad2.rung == 0
+
+
+# --------------------------------------------------- unit: replay pieces
+def test_reset_for_replay_and_journal():
+    j = ReplayJournal()
+    req = Request(1, np.arange(4, dtype=np.int32), SamplingParams(),
+                  time.perf_counter())
+    req.params = SamplingParams(timeout_ms=5.0)
+    req.deadline = time.perf_counter() + 0.005
+    req.tokens = [3, 1, 4]
+    j.add(req)
+    assert len(j) == 1 and j.requests() == [req]
+    reset_for_replay(req)
+    assert req.replay_expect == [3, 1, 4]
+    assert req.tokens == [] and req.status == "queued"
+    assert req.deadline is None             # admitted once: never expires
+    # a second crash mid-replay keeps the ORIGINAL (longer) pin
+    req.tokens = [3, 1]
+    reset_for_replay(req)
+    assert req.replay_expect == [3, 1, 4]
+    # ...unless the replay got further than the pin
+    req.tokens = [3, 1, 4, 1, 5]
+    reset_for_replay(req)
+    assert req.replay_expect == [3, 1, 4, 1, 5]
+    j.remove(req)
+    assert len(j) == 0
+
+
+def test_replay_mismatch_fails_typed():
+    """A replayed request whose regenerated token diverges from the
+    journaled prefix is failed typed, not silently forked."""
+    eng = DecodeEngine(CFG, PARAMS, slots=1, prefill_chunk=4,
+                       num_blocks=30)
+    sched = SlotScheduler(eng)
+    req = Request(7, np.arange(4, dtype=np.int32), SamplingParams(),
+                  time.perf_counter())
+    req.replay_expect = [5, 9]
+    assert sched._emit(0, req, 5) is None           # matches the pin
+    err = sched._emit(0, req, 8)                    # diverges
+    assert err is not None and "replay diverged at token 1" in err
+    assert sched.replay_mismatches == 1
+    eng.close()
+
+
+def test_request_finish_is_first_wins():
+    req = Request(2, np.arange(3, dtype=np.int32), SamplingParams(),
+                  time.perf_counter())
+    req.finish("error", "engine failed")
+    req.finish("cancelled", "server shutdown")
+    assert req.status == "error" and req.error == "engine failed"
+
+
+# ------------------------------------------------------- the chaos matrix
+def test_tick_exception_recovers_and_replays_bit_identical():
+    """An injected tick exception mid-stream: the supervisor rebuilds
+    the engine cold and replays every in-flight request — final tokens
+    bit-identical to the fault-free oracle, restart counted, recovery
+    spans on the engine track."""
+    from cxxnet_tpu.obs.trace import TID_ENGINE, Tracer
+    rs = np.random.RandomState(0)
+    tracer = Tracer(enabled=True)
+    cases = [
+        dict(p=_prompt(rs, 3), max_tokens=8),
+        dict(p=_prompt(rs, 9), max_tokens=6, temperature=0.8, top_k=5,
+             top_p=0.9, seed=7),
+        dict(p=_prompt(rs, 13), max_tokens=5, temperature=1.2, seed=3),
+        dict(p=_prompt(rs, 6), max_tokens=7),
+    ]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         chaos="tick_raise@3", tracer=tracer) as srv:
+        hs = [srv.submit(c["p"], **{k: v for k, v in c.items()
+                                    if k != "p"}) for c in cases]
+        res = [srv.result(h, timeout=300) for h in hs]
+        m = srv.metrics()
+        text = srv.metrics_text()
+        assert srv.health()["state"] == "SERVING"
+    assert [r.status for r in res] == ["ok"] * 4
+    for c, r in zip(cases, res):
+        kw = {k: v for k, v in c.items() if k not in ("p", "max_tokens")}
+        np.testing.assert_array_equal(r.tokens,
+                                      _ref(c["p"], c["max_tokens"], **kw))
+    assert m["resilience"]["restarts"] == 1
+    assert m["resilience"]["replayed"] >= 1
+    assert m["resilience"]["replay_mismatches"] == 0
+    assert m["resilience"]["faults_injected"]["tick_raise"] == 1
+    assert "cxn_engine_restarts_total 1" in text
+    assert 'cxn_faults_injected_total{point="tick_raise"} 1' in text
+    # the recovery span tree landed on the engine track
+    names = [s.name for s in tracer.spans(TID_ENGINE)]
+    for want in ("teardown", "rebuild", "replay", "recovery"):
+        assert want in names, names
+
+
+def test_tick_hang_without_watchdog_is_a_transient_stall():
+    """hang_ms expires with no watchdog armed: the tick resumes
+    normally — a stall, not a fault; zero restarts."""
+    rs = np.random.RandomState(1)
+    p = _prompt(rs, 5)
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4, prefill_chunk=4,
+                         chaos="tick_hang@2,hang_ms:300") as srv:
+        t0 = time.perf_counter()
+        res = srv.result(srv.submit(p, max_tokens=6), timeout=300)
+        dt = time.perf_counter() - t0
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(p, 6))
+    assert dt >= 0.3                        # the stall really happened
+    assert m["resilience"]["restarts"] == 0
+    assert m["resilience"]["faults_injected"]["tick_hang"] == 1
+
+
+def test_tick_hang_watchdog_converts_to_restart():
+    """A hang far longer than serve_watchdog_ms: the watchdog abandons
+    the stuck thread, rebuilds, and replays — tokens identical, restart
+    counted, and the total wall time is far below the hang length."""
+    rs = np.random.RandomState(2)
+    cases = [(_prompt(rs, 5), 8), (_prompt(rs, 9), 6)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         chaos="tick_hang@3,hang_ms:60000",
+                         watchdog_ms=800.0) as srv:
+        t0 = time.perf_counter()
+        hs = [srv.submit(p, max_tokens=n) for p, n in cases]
+        res = [srv.result(h, timeout=300) for h in hs]
+        dt = time.perf_counter() - t0
+        m = srv.metrics()
+    assert [r.status for r in res] == ["ok"] * 2
+    for (p, n), r in zip(cases, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, n))
+    assert m["resilience"]["restarts"] == 1
+    assert m["resilience"]["replayed"] >= 1
+    assert dt < 30.0, dt                    # nowhere near the 60 s hang
+
+
+def test_reserve_exhaustion_injection_is_absorbed():
+    """Injected BlockPoolExhausted mid-reserve drives the real make-room
+    escapes (trie evict, preempt, swap) — or at worst a replay — and
+    every request still matches the oracle."""
+    rs = np.random.RandomState(3)
+    prompts = [_prompt(rs, 6) for _ in range(4)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         prefix_mb=1.0, max_restarts=10,
+                         chaos="reserve:0.2,seed:5") as srv:
+        hs = [srv.submit(p, max_tokens=8) for p in prompts]
+        res = [srv.result(h, timeout=300) for h in hs]
+        m = srv.metrics()
+    assert [r.status for r in res] == ["ok"] * 4
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, 8))
+    assert m["resilience"]["faults_injected"]["reserve"] >= 1
+    assert m["resilience"]["restarts"] <= 10
+
+
+def test_swap_out_failure_recovers_bit_identical():
+    """A tiny pool forces preemption; the first swap-out raises an
+    injected I/O failure — engine-fatal, so the supervisor replays the
+    whole working set. Every request still equals the oracle."""
+    rs = np.random.RandomState(6)
+    prompts = [_prompt(rs, 6) for _ in range(3)]
+    srv = InferenceServer(CFG, PARAMS, slots=3, queue=8, prefill_chunk=4,
+                          prefix_mb=0.0, num_blocks=15,
+                          chaos="swap_out@1")
+    hs = [srv.submit(p, max_tokens=20) for p in prompts]
+    res = [srv.result(h, timeout=300) for h in hs]
+    m = srv.metrics()
+    srv.shutdown()
+    assert [r.status for r in res] == ["ok"] * 3
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, 20))
+    assert m["resilience"]["faults_injected"]["swap_out"] == 1
+    assert m["resilience"]["restarts"] == 1
+    assert m["resilience"]["replayed"] >= 1
+
+
+def test_swap_in_corruption_checksum_catches_and_replays_row():
+    """A corrupted swap-in host buffer fails its checksum: the row is
+    NOT resumed from garbage — the one request replays through the
+    journal (no engine restart) and still matches the oracle."""
+    rs = np.random.RandomState(7)
+    prompts = [_prompt(rs, 6) for _ in range(3)]
+    srv = InferenceServer(CFG, PARAMS, slots=3, queue=8, prefill_chunk=4,
+                          prefix_mb=0.0, num_blocks=15,
+                          chaos="swap_in@1")
+    hs = [srv.submit(p, max_tokens=20) for p in prompts]
+    res = [srv.result(h, timeout=300) for h in hs]
+    m = srv.metrics()
+    srv.shutdown()
+    assert [r.status for r in res] == ["ok"] * 3
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, 20))
+    assert m["resilience"]["faults_injected"]["swap_in"] == 1
+    assert m["resilience"]["swap_corruptions"] == 1
+    assert m["resilience"]["restarts"] == 0     # contained, no rebuild
+    assert m["resilience"]["replayed"] >= 1
+    assert m["resilience"]["replay_mismatches"] == 0
+
+
+def test_drafter_fault_contained_and_identity_kept():
+    """Drafter exceptions are contained (rows tick plain that pass) and
+    a persistently-failing drafter is disabled — greedy output stays
+    bit-identical throughout."""
+    rs = np.random.RandomState(8)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base, base])     # n-gram bait
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=2,
+                         chaos="drafter:1.0") as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=10), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(prompt, 10))
+    assert m["resilience"]["drafter_faults"] >= 1
+    assert m["resilience"]["restarts"] == 0
+    assert m["spec_forwards"] == 0          # every draft pass faulted
+
+
+def test_prefix_restore_fault_degrades_to_miss():
+    """An injected prefix-restore failure is treated as a cache miss:
+    the prompt prefills from scratch and the tokens are unchanged."""
+    rs = np.random.RandomState(9)
+    shared = _prompt(rs, 8)
+    prompts = [np.concatenate([shared, _prompt(rs, k)]) for k in (3, 5)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         prefix_mb=1.0,
+                         chaos="prefix_restore:1.0") as srv:
+        hs = [srv.submit(p, max_tokens=5) for p in prompts]
+        res = [srv.result(h, timeout=300) for h in hs]
+        m = srv.metrics()
+    assert [r.status for r in res] == ["ok"] * 2
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, 5))
+    assert m["resilience"]["prefix_restore_faults"] >= 1
+    assert m["resilience"]["restarts"] == 0
+
+
+def test_max_restarts_exhausted_fails_typed_no_hang():
+    """Every tick raises: the restart budget burns down and the server
+    FAILS typed — in-flight requests get EngineFailedError-status
+    results (no hang), later submits raise it, shutdown stays clean."""
+    rs = np.random.RandomState(10)
+    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                          chaos="tick_raise:1.0", max_restarts=2)
+    hs = [srv.submit(_prompt(rs, 4), max_tokens=6) for _ in range(3)]
+    res = [srv.result(h, timeout=120) for h in hs]
+    assert [r.status for r in res] == ["error"] * 3
+    assert all("serve_max_restarts" in r.error for r in res)
+    assert srv.health()["state"] == "FAILED"
+    with pytest.raises(EngineFailedError, match="serve_max_restarts"):
+        srv.submit(_prompt(rs, 4))
+    m = srv.metrics()
+    srv.shutdown()
+    assert m["resilience"]["restarts"] == 3     # 2 allowed + the fatal one
+    assert m["requests"]["error"] == 3
+    assert srv.health()["state"] == "FAILED"    # sticky after shutdown
+
+
+def test_chaos_env_var_overrides_config(monkeypatch):
+    monkeypatch.setenv("CXN_CHAOS", "tick_raise@1")
+    rs = np.random.RandomState(11)
+    p = _prompt(rs, 5)
+    with InferenceServer(CFG, PARAMS, slots=1, queue=4,
+                         prefill_chunk=4, chaos="") as srv:
+        assert srv.fault_injector is not None
+        assert srv.fault_injector.spec == "tick_raise@1"
+        res = srv.result(srv.submit(p, max_tokens=4), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(p, 4))
+    assert m["resilience"]["restarts"] == 1
+
+
+# ------------------------------------------------------ degradation ladder
+def test_overload_degrades_sheds_and_hints_retry():
+    """The acceptance overload trace: sustained queue pressure climbs
+    the ladder to shedding; rejections and shed results carry
+    retry_after_ms; every ADMITTED request still completes; health and
+    the state gauge read DEGRADED."""
+    rs = np.random.RandomState(12)
+    srv = InferenceServer(CFG, PARAMS, slots=1, queue=6, prefill_chunk=4,
+                          prefix_mb=0.0)
+    srv.ladder.up_hold = 1              # climb one rung per hot pass
+    try:
+        # seed the service-time EMA with one clean request
+        assert srv.result(srv.submit(_prompt(rs, 4), max_tokens=8),
+                          timeout=300).status == "ok"
+        holder = srv.submit(_prompt(rs, 4), max_tokens=36)
+        deadline = time.time() + 60     # wait for admission so the six
+        while holder.status == "queued" and time.time() < deadline:
+            time.sleep(0.002)           # fills below own the whole queue
+        fill = [srv.submit(_prompt(rs, 4), max_tokens=24)
+                for _ in range(6)]
+        # plain queue-full rejection carries the back-off hint
+        with pytest.raises(QueueFullError) as e1:
+            srv.submit(_prompt(rs, 4), max_tokens=2)
+        assert e1.value.retry_after_ms > 0
+        # the ladder reaches shedding while the holder decodes with a
+        # full queue (3 hot passes at up_hold=1)
+        deadline = time.time() + 60
+        while srv.ladder.rung < 3 and time.time() < deadline:
+            time.sleep(0.002)
+        assert srv.ladder.rung == 3
+        h = srv.health()
+        assert h["state"] == "DEGRADED" and h["retry_after_ms"] > 0
+        # a deadline the backlog cannot meet is shed AT THE DOOR
+        with pytest.raises(QueueFullError) as e2:
+            srv.submit(_prompt(rs, 4), max_tokens=24, timeout_ms=1.0)
+        assert "overload shed" in str(e2.value)
+        assert e2.value.retry_after_ms > 0
+        # gauges read while the overload holds (the ladder cools on its
+        # own hysteresis once the queue drains)
+        text = srv.metrics_text()
+        assert 'cxn_shed_requests_total{rung="3"}' in text
+        assert "cxn_serve_degrade_rung 3" in text
+        assert "cxn_serve_state 1" in text      # DEGRADED
+        # queue-resident shedding: slip one past the door estimate, then
+        # make the backlog estimate hopeless — it is shed with a hint
+        # instead of rotting to expiry
+        srv._ema_req_s = 0.0
+        doomed = srv.submit(_prompt(rs, 4), max_tokens=24,
+                            timeout_ms=2000.0, block=True)
+        srv._ema_req_s = 100.0
+        res_doomed = srv.result(doomed, timeout=300)
+        assert res_doomed.status == "shed", res_doomed
+        assert res_doomed.retry_after_ms > 0
+        assert "retry after" in res_doomed.error
+        srv._ema_req_s = 0.05
+        # every admitted request completes despite the overload
+        assert srv.result(holder, timeout=300).status == "ok"
+        assert all(srv.result(h2, timeout=300).status == "ok"
+                   for h2 in fill)
+        m = srv.metrics()
+        assert m["requests"]["shed"] >= 2       # door + queue shed
+        assert m["resilience"]["shed"] >= 2
+    finally:
+        srv.shutdown()
+    assert srv.health()["state"] == "DRAINING"
+
+
+def test_degrade_off_never_moves():
+    rs = np.random.RandomState(13)
+    with InferenceServer(CFG, PARAMS, slots=1, queue=2, prefill_chunk=4,
+                         degrade=False) as srv:
+        hs = [srv.submit(_prompt(rs, 4), max_tokens=10, block=True)
+              for _ in range(5)]
+        assert all(srv.result(h, timeout=300).status == "ok"
+                   for h in hs)
+        assert srv.ladder.rung == 0
+        assert srv.health()["state"] == "SERVING"
+
+
+def test_reserve_stall_counter_and_degraded_trigger():
+    """The make-room loop's terminal stall (queue head unplaceable with
+    every slot free) is COUNTED and drives the ladder hot — no more
+    silent 50 ms parking. The organic trigger needs an estimate bug
+    (num_blocks >= bpr + 1 guarantees one row always fits), so the
+    admission gate is held shut for a few passes to pin the path."""
+    rs = np.random.RandomState(14)
+    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4)
+    srv.ladder.up_hold = 1
+    try:
+        sched = srv._sched
+        orig = sched.admissible
+        deny = {"n": 0}
+
+        def gate(req, claimed=0):
+            if deny["n"] < 3:
+                deny["n"] += 1
+                return False
+            return orig(req, claimed)
+
+        sched.admissible = gate
+        res = srv.result(srv.submit(_prompt(rs, 5), max_tokens=4),
+                         timeout=300)
+        assert res.status == "ok"
+        m = srv.metrics()
+        assert m["resilience"]["reserve_stalls"] >= 3
+        assert srv.ladder.transitions >= 1      # the stall ran it hot
+        assert "cxn_reserve_stalls_total" in srv.metrics_text()
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------- the soak
+@pytest.mark.slow
+def test_chaos_soak_mixed_traffic_bit_identical():
+    """Every injection point armed at low probability over mixed
+    chunked / prefix-hit / speculative / paged traffic: zero hangs,
+    zero leaked blocks (refcount audit) or threads (conftest), final
+    outputs bit-identical to the fault-free oracle, restarts within
+    budget."""
+    rs = np.random.RandomState(15)
+    shared = _prompt(rs, 8)
+    cases = []
+    for i in range(24):
+        kind = i % 4
+        if kind == 0:
+            p = np.concatenate([shared, _prompt(rs, 1 + i % 7)])
+        elif kind == 1:
+            base = _prompt(rs, 4 + i % 3)
+            p = np.concatenate([base, base, base])      # n-gram bait
+        else:
+            p = _prompt(rs, 3 + (i * 5) % 17)
+        kw = {}
+        if kind == 3:
+            # sampled cases pin against the solo oracle, so they opt
+            # out of speculation per-request: sampled + spec is
+            # distribution-preserving, not bit-exact (greedy cases keep
+            # speculating — their argmax chain IS exact)
+            kw = dict(temperature=0.8, top_k=5, top_p=0.9, seed=i,
+                      spec_mode="off")
+        cases.append((p, 4 + i % 9, kw))
+    srv = InferenceServer(
+        CFG, PARAMS, slots=3, queue=32, prefill_chunk=4, prefix_mb=0.5,
+        num_blocks=24, spec_mode="ngram", spec_len=2, max_restarts=50,
+        watchdog_ms=2000.0,
+        chaos="all:0.01,seed:21,hang_ms:400")
+    try:
+        hs = [srv.submit(p, max_tokens=n, **kw) for p, n, kw in cases]
+        res = [srv.result(h, timeout=600) for h in hs]
+        m = srv.metrics()
+        assert [r.status for r in res] == ["ok"] * len(cases)
+        for (p, n, kw), r in zip(cases, res):
+            okw = {k: v for k, v in kw.items() if k != "spec_mode"}
+            np.testing.assert_array_equal(r.tokens, _ref(p, n, **okw))
+        assert m["resilience"]["restarts"] <= 50
+        assert m["resilience"]["replay_mismatches"] == 0
+        assert sum(m["resilience"]["faults_injected"].values()) >= 1
+        # refcount/leak audit on the FINAL engine after all rows retired
+        eng, pc = srv._engine, srv._prefix
+        eng.manager.check_consistency(trie_refs=pc.trie_refs())
+    finally:
+        srv.shutdown()
+    # post-drain: every block back on the free list
+    eng = srv._engine
+    assert eng.manager.free_count == eng.num_blocks - 1
+    eng.manager.check_consistency(trie_refs=0)
+
+
+# -------------------------------------------- trainer: nan_recover + feed
+def test_nan_recover_rebuilds_async_feed(tmp_path, capfd, monkeypatch):
+    """cli.py:_task_train_rounds recovery path under the async device
+    feed: when nan_recover reloads the snapshot (replacing self.net),
+    the OLD DevicePrefetcher — bound to the dead trainer, holding
+    in-flight placed batches — must be closed and a NEW one built over
+    the reloaded net; the old feed's batches are discarded, not fed."""
+    from test_train_e2e import CONF, write_idx_images, write_idx_labels
+
+    from cxxnet_tpu.cli import LearnTask
+    from cxxnet_tpu.io.device_prefetch import DevicePrefetcher
+    from cxxnet_tpu.nnet.net import Net as CoreNet
+
+    d = tmp_path / "mnist"
+    d.mkdir()
+    rs = np.random.RandomState(42)
+    protos = rs.rand(10, 8, 8) * 255
+    y = rs.randint(0, 10, 96)
+    x = np.clip(protos[y] + rs.randn(96, 8, 8) * 20, 0,
+                255).astype(np.uint8)
+    write_idx_images(str(d / "train-img.gz"), x)
+    write_idx_labels(str(d / "train-lab.gz"), y)
+    write_idx_images(str(d / "test-img.gz"), x[:32])
+    write_idx_labels(str(d / "test-lab.gz"), y[:32])
+    md = tmp_path / "models"
+    conf = tmp_path / "mnist.conf"
+    conf.write_text(CONF.format(d=d, md=md))
+    # a snapshot to recover from
+    assert LearnTask().run([str(conf), "num_round=1", "max_round=1",
+                            "save_model=1", "silent=1"]) == 0
+    capfd.readouterr()
+
+    events = []
+    orig_init = DevicePrefetcher.__init__
+    orig_close = DevicePrefetcher.close
+
+    def rec_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        events.append(("new", self))
+
+    def rec_close(self):
+        events.append(("close", self))
+        orig_close(self)
+
+    monkeypatch.setattr(DevicePrefetcher, "__init__", rec_init)
+    monkeypatch.setattr(DevicePrefetcher, "close", rec_close)
+
+    orig_ll = CoreNet.last_loss
+    calls = {"n": 0}
+
+    def nan_once(self):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            return float("nan")
+        return orig_ll(self)
+
+    monkeypatch.setattr(CoreNet, "last_loss", nan_once)
+
+    task = LearnTask()
+    assert task.run([str(conf), "num_round=2", "max_round=4",
+                     "nan_check=1", "nan_recover=1", "save_model=0",
+                     "prefetch_to_device=2", "silent=1"]) == 0
+    err = capfd.readouterr().err
+    assert "divergent loss detected" in err
+    assert "recovered from snapshot" in err
+    # exactly two feeds: the diverged round's, then the restarted
+    # round's — and the old one was CLOSED before the new one existed
+    kinds = [k for k, _ in events]
+    assert kinds[:3] == ["new", "close", "new"], kinds
+    feeds = [obj for k, obj in events if k == "new"]
+    assert len(feeds) == 2
+    # the old feed placed batches that were then discarded, and the new
+    # feed is bound to the RELOADED net — the old net's in-flight
+    # batches can never reach the restarted trainer
+    assert feeds[0].placed >= 1
+    assert feeds[1].place_fn.__self__ is task.net
+    assert feeds[0].place_fn.__self__ is not task.net

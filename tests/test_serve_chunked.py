@@ -243,14 +243,19 @@ def test_long_prompt_prefill_does_not_stall_active_row():
     assert ha.done_t < hb.first_token_t, (ha.done_t, hb.first_token_t)
 
 
-def test_scheduler_crash_cancels_each_request_exactly_once():
-    """A device-call failure mid-pass (after requests were admitted into
-    slots) must finish every in-flight request exactly once: the
-    scheduler retires the ones it tracks, the server's sweep only
-    touches untracked ones — no double finish, no double count."""
+def test_scheduler_crash_without_restart_budget_fails_exactly_once():
+    """A device-call failure mid-pass with the restart budget OFF
+    (serve_max_restarts=0) must finish every in-flight request exactly
+    once with the typed EngineFailedError status: the scheduler retires
+    the ones it tracks, the journal sweep only touches untracked ones —
+    no double finish, no double count. (With the default budget the
+    same crash RECOVERS instead — tests/test_resilience.py.)"""
     import threading
+
+    from cxxnet_tpu.serve import EngineFailedError
     rs = np.random.RandomState(8)
-    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4)
+    srv = InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                          max_restarts=0)
     boom = RuntimeError("injected chunk failure")
     submitted = threading.Event()
 
@@ -267,11 +272,16 @@ def test_scheduler_crash_cancels_each_request_exactly_once():
     handles = [srv.submit(_prompt(rs, 9), max_tokens=4) for _ in range(3)]
     submitted.set()
     results = [srv.result(h, timeout=60) for h in handles]
+    assert [r.status for r in results] == ["error"] * 3
+    assert all("serve_max_restarts" in r.error for r in results)
+    assert srv.health()["state"] == "FAILED"
+    with pytest.raises(EngineFailedError):
+        srv.submit(_prompt(rs, 4))
     srv.shutdown(drain=False)
-    assert [r.status for r in results] == ["cancelled"] * 3
     m = srv.metrics()
-    assert m["requests"]["cancelled"] == 3, m["requests"]
+    assert m["requests"]["error"] == 3, m["requests"]
     assert m["requests"]["submitted"] == 3
+    assert m["resilience"]["restarts"] == 1
 
 
 # --------------------------------------------------------- step audit
