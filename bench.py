@@ -51,6 +51,19 @@ Prints one JSON line per metric, in this order:
                                      the round-13 acceptance gate)
  12a'. serve_p95_ttft_ms_paged      (same paged run's p95 TTFT;
                                      vs_baseline = dense p95 / paged)
+ 12a''. serve_tokens_per_sec_fused  (fused paged-attention kernel: the
+                                     serve_paged trace served by the
+                                     paged engine with the fused Pallas
+                                     tick/verify vs the XLA gather
+                                     formulation; vs_baseline = fused /
+                                     gather tokens/s — the arms are
+                                     identical (ratio ~1.0) on backends
+                                     where the kernel is unsupported
+                                     and both resolve to gather, which
+                                     is itself the off-switch no-op
+                                     check; cxn_mfu{fn=serve_tick}
+                                     rides along as an attribute,
+                                     round 16)
  12b. serve_spec_tokens_per_sec     (speculative serving: n-gram drafter
                                      on a repetitive-suffix trace;
                                      vs_baseline = the same trace served
@@ -776,6 +789,50 @@ def bench_serve_paged():
          dense_p95_ms=round(md["ttft_ms"]["p95"], 1))
 
 
+def bench_serve_fused():
+    """Fused paged-attention cell (round 16, doc/serving.md "Fused
+    paged attention"): the SAME shared-prefix Poisson trace as
+    bench_serve_paged's paged arm, served twice by the paged engine —
+    ``serve_fused_attn=1`` (the default: fused Pallas block-table-walk
+    tick/verify wherever the backend supports the kernel) vs
+    ``serve_fused_attn=0`` (the XLA gather formulation, the
+    bit-reference). Emits ``serve_tokens_per_sec_fused`` with
+    vs_baseline = fused / gather. On a TPU the fused arm must be >= the
+    gather arm (the kernel removes the gathered-cache HBM round trip);
+    on backends where the kernel is unsupported both arms resolve to
+    gather (``fused_active: false``) and the ratio pins the off-switch
+    as a true no-op (~1.0). Both arms run the devprof live sampler so
+    ``cxn_mfu{fn=serve_tick}`` lands in the roofline trend — reported
+    here as the ``mfu_serve_tick`` attribute."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.obs.metrics import Registry
+
+    c = dict(PREFIX_CELL)
+    c["n_requests"] = 4 * c["slots"]
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_prefix_trace(c)
+    kw = dict(queue=c["n_requests"], prefill_chunk=c["chunk"],
+              prefill_budget=c["budget"], prefix_mb=16.0,
+              slots=c["slots"], prof_every=16)
+    reg_f = Registry()
+    wall_f, mf = run_serve_trace(cfg, params, trace, fused_attn=True,
+                                 registry=reg_f, **kw)
+    wall_g, mg = run_serve_trace(cfg, params, trace, fused_attn=False,
+                                 **kw)
+    tps_f = mf["tokens_generated"] / wall_f
+    tps_g = mg["tokens_generated"] / wall_g
+    mfu = reg_f.snapshot().get('cxn_mfu{fn="serve_tick"}')
+    emit("serve_tokens_per_sec_fused", tps_f, "tokens/sec",
+         tps_f / max(tps_g, 1e-9),
+         fused_active=bool(mf["paged"]["fused_attn"]),
+         gather_tokens_per_sec=round(tps_g, 1),
+         mfu_serve_tick=(round(mfu, 6) if mfu is not None else None))
+
+
 def serve_spec_trace(cfg, params, cell=None):
     """Seeded repetitive-suffix serving trace: [(gap_s, prompt,
     max_tokens)] with Poisson open-loop arrivals — every prompt is a
@@ -918,7 +975,8 @@ def main() -> int:
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
                bench_serve_prefill_heavy, bench_serve_paged,
-               bench_serve_spec, bench_obs_overhead, bench_lint):
+               bench_serve_fused, bench_serve_spec, bench_obs_overhead,
+               bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -37,6 +37,9 @@ RULES = {
                           "strong-typed callers)"),
     "CXN207": ("error", "AOT lower+compile time exceeds the pinned "
                         "lint_compile_budget_s budget"),
+    "CXN208": ("error", "explicit index clip materialized as a "
+                        "standalone entry-computation clamp instead of "
+                        "folding into its gather/scatter fusion"),
 }
 
 

@@ -87,22 +87,54 @@ def collective_counts(hlo_text: str) -> Dict[str, int]:
             for op in _COLLECTIVE_OPS}
 
 
+def entry_clamp_count(hlo_text: str) -> int:
+    """Standalone ``clamp`` instructions in the optimized HLO's ENTRY
+    computation. The paged serve programs clip their position/block
+    indices explicitly (engine.py documents the clip as free); this is
+    the check that keeps that claim honest: a clamp that XLA fused into
+    a gather/scatter fusion lives in a fusion sub-computation and
+    counts 0 here, while a clamp materialized as its own entry-level
+    instruction (an extra HLO pass over the index tensor) counts — and
+    trips CXN208 in the serve audit."""
+    in_entry = False
+    depth = 0
+    n = 0
+    seen = 0
+    for ln in hlo_text.splitlines():
+        if not in_entry and ln.startswith("ENTRY "):
+            in_entry = True
+        if in_entry:
+            seen += 1
+            n += ln.count(" clamp(")
+            depth += ln.count("{") - ln.count("}")
+            if depth <= 0 and seen > 1:
+                break
+    return n
+
+
 def format_step_info(info: Dict) -> str:
     """One human line per audited step's info dict (the single renderer —
     task=lint, the CXN_LINT hook, and tools/cxn_lint.py all print this)."""
     cc = ", ".join("%s=%d" % (k, v)
                    for k, v in info["collectives"].items() if v)
-    return "%s: donated %d aliased %d collectives {%s} compile %.2fs" % (
+    line = "%s: donated %d aliased %d collectives {%s} compile %.2fs" % (
         info["label"], info["donated"], info["aliased"], cc or "none",
         info.get("compile_s", 0.0))
+    if "entry_clamps" in info:
+        # the serve audit's clip-fold assertion (CXN208): "folded" means
+        # every explicit index clip fused into its gather/scatter
+        line += " clip=%s" % ("folded" if info["entry_clamps"] == 0
+                              else "%d materialized"
+                              % info["entry_clamps"])
+    return line
 
 
 def audit_jit(fn, args: tuple, label: str,
               donate_argnums: Sequence[int] = (),
               static_argnums: Sequence[int] = (),
               collective_budget: Optional[int] = None,
-              compile_budget_s: Optional[float] = None
-              ) -> Tuple[List[Finding], Dict]:
+              compile_budget_s: Optional[float] = None,
+              check_clip: bool = False) -> Tuple[List[Finding], Dict]:
     """Audit one jitted function AOT. Returns (findings, info) where info
     carries the raw counts ({"collectives", "donated", "aliased"}) plus
     the step's measured AOT lower+compile seconds ("compile_s") — the
@@ -205,6 +237,15 @@ def audit_jit(fn, args: tuple, label: str,
             "donated": requested,
             "aliased": len(donors & compiled_aliased),
             "compile_s": compile_s}
+    if check_clip:
+        info["entry_clamps"] = entry_clamp_count(hlo)
+        if info["entry_clamps"] > 0:
+            findings.append(Finding(
+                "CXN208", "%s: %d standalone clamp instruction(s) in "
+                "the entry computation — the explicit index clip did "
+                "NOT fold into its gather/scatter fusion, so every "
+                "step pays an extra HLO pass the engine documents as "
+                "free" % (label, info["entry_clamps"])))
     return findings, info
 
 
@@ -298,17 +339,25 @@ def audit_serve_engine(engine, n_prompt: int = 8,
     aliasing — an unaliased pool would copy every block per token —
     and sees exactly the one compiled signature each program holds
     (a drifting table shape at runtime trips the engine's
-    RecompileGuard as CXN205 instead). ``donate`` overrides the
-    engine's backend-gated donation choice — tests pass True to pin
-    the aliasing contract even on the CPU mesh."""
+    RecompileGuard as CXN205 instead). The audited tick/verify are the
+    engine's RESOLVED variants — the fused Pallas block-table-walk
+    programs when ``engine.fused_attn`` is on, the XLA gather programs
+    otherwise — and the paged rows additionally assert the explicit
+    index clips folded into their fusions (CXN208,
+    :func:`entry_clamp_count`; the ``clip=folded`` column of the step
+    table). ``donate`` overrides the engine's backend-gated donation
+    choice — tests pass True to pin the aliasing contract even on the
+    CPU mesh."""
     report = LintReport()
     infos = []
+    paged = bool(getattr(engine, "paged", False))
     for label, fn, args, donate_nums in engine.lint_specs(
             n_prompt=n_prompt, donate=donate):
         findings, info = audit_jit(fn, args, label,
                                    donate_argnums=donate_nums,
                                    collective_budget=collective_budget,
-                                   compile_budget_s=compile_budget_s)
+                                   compile_budget_s=compile_budget_s,
+                                   check_clip=paged)
         report.extend(findings)
         infos.append(info)
     return report, infos

@@ -97,6 +97,12 @@ class LearnTask:
         #                             or serve_kv_mb when set)
         self.serve_kv_mb = 0.0    # block-pool MiB budget for auto-
         #                           sizing (0 = slots-equivalent formula)
+        self.serve_fused_attn = 1   # fused Pallas paged-attention for
+        #                             the tick/verify programs where the
+        #                             backend supports it (0 = the XLA
+        #                             gather formulation, the
+        #                             bit-reference; CXN_FUSED_ATTN=0
+        #                             env force-disables too)
         self.serve_chaos = ""     # fault-injection spec (chaos harness;
         #                           grammar in serve/resilience.py, e.g.
         #                           "tick_raise:0.01,seed:7"; the
@@ -238,6 +244,8 @@ class LearnTask:
             self.serve_num_blocks = int(val)
         elif name == "serve_kv_mb":
             self.serve_kv_mb = float(val)
+        elif name == "serve_fused_attn":
+            self.serve_fused_attn = int(val)
         elif name == "serve_chaos":
             self.serve_chaos = val
         elif name == "serve_max_restarts":
@@ -904,7 +912,8 @@ class LearnTask:
                                prefill_chunk=self.serve_prefill_chunk,
                                spec_len=max(1, self.spec_len),
                                num_blocks=nb,
-                               block_size=self.serve_block_size)
+                               block_size=self.serve_block_size,
+                               fused_attn=bool(self.serve_fused_attn))
             table.merge(devprof.profile_engine(
                 eng, registry=reg, time_reps=self.prof_reps))
             eng.close()
@@ -965,6 +974,7 @@ class LearnTask:
                               block_size=self.serve_block_size,
                               num_blocks=self.serve_num_blocks,
                               kv_mb=self.serve_kv_mb,
+                              fused_attn=bool(self.serve_fused_attn),
                               recompile_limit=self.net.lint_recompile_limit,
                               recompile_strict=bool(
                                   self.net.lint_recompile_strict),
@@ -986,9 +996,11 @@ class LearnTask:
                 if self.serve_paged:
                     eng = srv._engine
                     mode += (", paged KV (%d blocks x %d tokens, "
-                             "%.1f MiB)"
+                             "%.1f MiB, %s attention)"
                              % (eng.num_blocks, eng.block_size,
-                                eng.cache_bytes() / 2.0 ** 20))
+                                eng.cache_bytes() / 2.0 ** 20,
+                                "fused" if eng.fused_attn
+                                else "gather"))
             else:
                 mode = "whole-prompt prefill, prefix cache off"
             if self.spec_mode != "off":

@@ -538,6 +538,18 @@ def profile_engine(engine, registry=None, time_reps: int = 0,
         table.add(pc)
     if registry is not None:
         table.publish(registry)
+        if getattr(engine, "paged", False):
+            # pool geometry next to the program rows: a cost/MFU drift
+            # caused by a changed block-table width (kv_mb resize, a
+            # different block_size) is attributable from the scrape
+            # alone instead of needing the server config
+            bprg = registry.gauge(
+                "cxn_program_block_table_width",
+                "paged block-table width (blocks per row) compiled "
+                "into the serve programs", labelnames=("fn",))
+            for name in table.names():
+                if name.startswith("serve_"):
+                    bprg.labels(name).set(engine.bpr)
     return table
 
 

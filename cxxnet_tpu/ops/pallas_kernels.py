@@ -51,13 +51,20 @@ from jax.experimental.pallas import tpu as pltpu
 
 _INTERPRET = False      # flipped by tests on CPU
 
+# jax < 0.5 names the Mosaic compiler-params class TPUCompilerParams;
+# newer releases renamed it CompilerParams — same fields either way
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
 
 def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct for pallas_call that survives a ``check_vma``
     shard_map: when tracing inside one (e.g. the gpipe body), the output
     must carry the same varying-mesh-axes set as the input, or shard_map
     rejects it (JAX >= 0.9)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)   # jax < 0.6 has no typeof
+    vma = getattr(typeof(like), "vma", None) if typeof is not None \
+        else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -528,7 +535,7 @@ def _flash_fwd_bhnd(qt, kt, vt, causal: bool, block_q, block_k,
             pltpu.VMEM((bq, 1), jnp.float32),      # running max
             pltpu.VMEM((bq, 1), jnp.float32),      # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -744,7 +751,7 @@ def _flash_bwd_bhnd(qt, kt, vt, lse, delta, dot, causal, block_q, block_k,
         out_specs=q_by_q,
         out_shape=_out_struct((b, h, n, d), out_dtype or qt.dtype, qt),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -764,7 +771,7 @@ def _flash_bwd_bhnd(qt, kt, vt, lse, delta, dot, causal, block_q, block_k,
                    _out_struct((b, h, n, d), out_dtype or vt.dtype, vt)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -1455,6 +1462,150 @@ def cached_attention(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
         interpret=_INTERPRET,
     )(jnp.asarray(pos, jnp.int32).reshape(1), q, ck, cv)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fused paged-attention decode (serve_tick / serve_verify_chunk)
+# ---------------------------------------------------------------------------
+# The paged serve programs' gather formulation (serve/engine.py
+# _gather_rows + _attn_cached_rows/_attn_verify) makes XLA materialize
+# every row's logical (H, row_len, d) K/V cache in HBM before attention
+# — a copy the hardware never needed. This kernel walks each row's block
+# table DIRECTLY: grid (rows, blocks_per_row) with the table and the
+# per-row positions as scalar-prefetch operands, so each grid step DMAs
+# exactly ONE physical (H, bs, d) block of each pool out of HBM into a
+# VMEM-resident row image, and the q·K / masked softmax / ·V chain runs
+# in the same pass — gathered caches exist only in VMEM, never in HBM.
+#
+# Numerics contract (serve/engine.py fused_attn_tolerance — the ONE
+# place it is defined): the compute step reproduces the gather
+# reference's arithmetic EXACTLY — q and the row image cast to f32, one
+# head-batched dot_general (batch dim = heads, the einsum's own dims),
+# the same / sqrt(d), the same -1e30 position mask, jax.nn.softmax, and
+# a head-batched f32 ·V — so in interpret mode on CPU the fused and
+# gather programs are bit-identical (pinned by tests/test_serve_fused.py;
+# a per-head 2-D dot formulation measurably diverges in f32 low-order
+# bits because XLA lowers differently-shaped contractions with different
+# reduction orders). On a real TPU the Mosaic lowering may still differ
+# from XLA's in low-order bits, which is what the tolerance helper's
+# accelerator branch bounds.
+#
+# Masking carries the whole correctness argument, same as the gather
+# path: garbage blocks (a table's unallocated tail points at block 0)
+# and parked rows only ever contribute score columns strictly above the
+# row's position, which the -1e30 mask softmaxes to an exact 0.0.
+
+def paged_attention_geometry_ok(n_head: int, bpr: int, block_size: int,
+                                head_dim: int,
+                                itemsize: int = 2) -> bool:
+    """The TPU-geometry half of the fused-attention gate: lane-friendly
+    head_dim / sublane-aligned block size, and the two (H, row_len, d)
+    VMEM row images within budget. Split out so surfaces that audit
+    off-TPU (tools/cxn_lint.py arming interpret mode) can still decide
+    whether a REAL TPU would resolve fused or gather for this geometry
+    — auditing a fused program production would never run pins the
+    wrong executable."""
+    s = bpr * block_size
+    if 2 * n_head * s * head_dim * itemsize > 12 * 1024 * 1024:
+        return False
+    return head_dim % 128 in (0, 64) and block_size % 8 == 0
+
+
+def paged_attention_supported(n_head: int, bpr: int, block_size: int,
+                              head_dim: int, itemsize: int = 2) -> bool:
+    """True when :func:`paged_attention` may serve this geometry:
+    TPU backend (or interpret mode under test — there the geometry
+    limits are waived, so tiny differential-test models run), the
+    off-switch ``CXN_FUSED_ATTN=0`` not thrown, and
+    :func:`paged_attention_geometry_ok`. Beyond any of these the
+    engine keeps the XLA gather formulation (doc/serving.md \"Fused
+    paged attention\" records when and why)."""
+    if os.environ.get("CXN_FUSED_ATTN", "1") == "0":
+        return False
+    if not use_pallas():
+        return False
+    if _INTERPRET:
+        return True         # differential testing: no alignment limits
+    return paged_attention_geometry_ok(n_head, bpr, block_size,
+                                       head_dim, itemsize)
+
+
+def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                       k_scr, v_scr, *, bs: int, bpr: int, n_head: int,
+                       rows: int):
+    """One grid step = one (slot row, logical block): copy the DMA'd
+    physical block into the row image scratch; the LAST block of each
+    row runs the attention over the completed image. Scalar-prefetched
+    ``table`` drives the block DMAs (the index_map reads it), so the
+    gather IS the block pipeline — no HBM intermediate ever exists."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k_scr[:, pl.dslice(j * bs, bs), :] = k_ref[0, 0]
+    v_scr[:, pl.dslice(j * bs, bs), :] = v_ref[0, 0]
+
+    @pl.when(j == bpr - 1)
+    def _finalize():
+        s_len = bpr * bs
+        d = q_ref.shape[-1]
+        # EXACT mirror of _attn_cached_rows/_attn_verify (serve/engine
+        # .py): head-major f32 q, ONE head-batched dot (batch dim 0 =
+        # heads — the einsum's own contraction), then / sqrt(d)
+        qh = jnp.swapaxes(q_ref[0], 0, 1).astype(jnp.float32)  # (H, R, d)
+        sc = jax.lax.dot_general(
+            qh, k_scr[:].astype(jnp.float32),
+            (((2,), (2,)), ((0,), (0,)))) / (d ** 0.5)         # (H, R, S)
+        kpos = jax.lax.broadcasted_iota(jnp.int32,
+                                        (n_head, rows, s_len), 2)
+        qpos = pos_ref[i] + jax.lax.broadcasted_iota(
+            jnp.int32, (n_head, rows, s_len), 1)
+        w = jax.nn.softmax(jnp.where(kpos <= qpos, sc, _NEG_INF),
+                           axis=-1)
+        o = jax.lax.dot_general(
+            w, v_scr[:].astype(jnp.float32),
+            (((2,), (1,)), ((0,), (0,))))                      # (H, R, d)
+        o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
+
+
+def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
+                    block_size: int):
+    """Fused block-table gather + cached attention for the paged decode
+    programs. ``q`` (b, R, H, d) — R = 1 for the batched tick, K+1 for
+    the draft-and-verify step; ``pool_k``/``pool_v`` the WHOLE
+    (L, num_blocks, H, bs, d) pools (only the table's blocks of
+    ``layer`` are ever DMA'd); ``table`` (b, bpr) int32 physical block
+    ids; ``pos`` (b,) int32 — query r of row i is masked at absolute
+    position ``pos[i] + r``, the union of the tick's (R=1) and the
+    verify's masking semantics. Returns (b, R, H, d) in q's dtype."""
+    b, rows, n_head, d = q.shape
+    bpr = table.shape[1]
+    bs = int(block_size)
+    kern = functools.partial(_paged_attn_kernel, bs=bs, bpr=bpr,
+                             n_head=n_head, rows=rows)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, bpr),
+        in_specs=[
+            pl.BlockSpec((1, rows, n_head, d),
+                         lambda i, j, tab, pp: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1, n_head, bs, d),
+                         lambda i, j, tab, pp: (layer, tab[i, j],
+                                                0, 0, 0)),
+            pl.BlockSpec((1, 1, n_head, bs, d),
+                         lambda i, j, tab, pp: (layer, tab[i, j],
+                                                0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, n_head, d),
+                               lambda i, j, tab, pp: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_head, bpr * bs, d), pool_k.dtype),
+            pltpu.VMEM((n_head, bpr * bs, d), pool_v.dtype),
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=_out_struct((b, rows, n_head, d), q.dtype, q),
+        interpret=_INTERPRET,
+    )(table, pos, q, pool_k, pool_v)
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,8 @@ Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
 (reference-style API), and CLI ``task = serve`` (cli.py).
 """
 
-from .engine import DecodeEngine, auto_num_blocks
+from .engine import (DecodeEngine, assert_fused_allclose, auto_num_blocks,
+                     fused_attn_tolerance)
 from .paged import BlockManager, BlockPoolExhausted
 from .prefix_cache import PagedPrefixCache, PrefixCache
 from .resilience import (DegradationLadder, EngineFailedError,
@@ -26,7 +27,8 @@ from .speculative import ModelDrafter, NgramDrafter, SpeculativeDecoder
 __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
            "PagedPrefixCache", "BlockManager", "BlockPoolExhausted",
-           "auto_num_blocks", "AdmissionError", "QueueFullError",
+           "auto_num_blocks", "fused_attn_tolerance",
+           "assert_fused_allclose", "AdmissionError", "QueueFullError",
            "NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
            "FaultInjector", "DegradationLadder", "InjectedFault",
            "SwapCorruptionError", "EngineFailedError"]

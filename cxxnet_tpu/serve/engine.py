@@ -65,11 +65,27 @@ in the same per-row form, ``ops/sampling.py`` with the per-request
 ``fold_in(key, token_index)`` schedule), so a request served from any
 slot — including a recycled one — produces the same tokens as running it
 alone through ``gpt_decode``'s XLA scan path with the same params and
-seed (pinned by tests on the CPU mesh). Where the offline path engages
-its fused Pallas kernel instead (single TPU shard), its low-order logit
-bits can differ from any XLA formulation — including gpt_decode's own
-fallback — so the cross-path guarantee there is distribution-level, not
-bit-level.
+seed (pinned by tests on the CPU mesh). Kernel-vs-XLA numeric contracts
+are defined in ONE place, :func:`fused_attn_tolerance` — exact under
+interpret mode on CPU, a bounded ULP band on a real TPU — and every
+differential test pins through :func:`assert_fused_allclose` instead of
+per-test ad-hoc ``allclose`` settings. (The offline ``gpt_decode``
+whole-step kernel predates that helper's exact-on-CPU guarantee; its
+accelerator band is the same TPU branch of the contract.)
+
+**Fused paged attention** (the paged default wherever
+``ops.pallas_kernels.paged_attention_supported`` holds, i.e. on TPU
+backends — ``serve_fused_attn=0`` / ``CXN_FUSED_ATTN=0`` restores the
+gather formulation, which also remains the fallback for unsupported
+geometries and the bit-reference the fused path is pinned against): the
+tick and verify programs route their attention reads through one Pallas
+pass per layer that walks the block table directly — per-block K/V
+tiles DMA from the global pool into a VMEM row image fused with q·K,
+the position-masked softmax, and the ·V product — so the gathered
+logical caches the XLA formulation materializes in HBM never exist.
+The K/V scatter (and with it every cache byte) is shared with the
+gather path; garbage block 0 and parked rows mask to an exact 0.0
+inside the kernel exactly as they do outside it.
 
 Recycled-slot safety: every attention mask admits only positions <= the
 querying row's own position, and every admitted position was written by
@@ -115,7 +131,50 @@ from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
 from .paged import BlockPoolExhausted
 from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
-__all__ = ["DecodeEngine", "auto_num_blocks"]
+__all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
+           "assert_fused_allclose"]
+
+
+def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
+    """The ONE fused-vs-gather numeric contract (every differential test
+    pins through :func:`assert_fused_allclose`; nothing defines its own
+    ad-hoc ``allclose`` settings).
+
+    * **Interpret mode / CPU** (``pallas_kernels._INTERPRET``, or any
+      non-TPU backend): EXACT — ``rtol = atol = 0``, any dtype. The
+      fused kernel's compute step reproduces the gather reference's
+      arithmetic op for op (head-batched f32 dots, the same mask
+      constant, the same ``jax.nn.softmax``), so the interpret-mode
+      lowering is bit-identical by construction.
+    * **TPU**: bounded ULP in the COMPARED dtype — the Mosaic lowering
+      of the same ops may round differently in the last bits (dot
+      tiling, transcendental tables). For f32 outputs that is a few
+      f32 ULP on O(1) values; bf16 outputs round both arms to 8
+      mantissa bits, so a last-bit disagreement is one bf16 ULP
+      (~2^-8 relative) and the band must be sized in bf16 ULPs, not
+      f32's. ``dtype`` selects the band (None = f32's).
+
+    This replaces the per-path prose caveat the serve module used to
+    carry: the contract is now executable, in one place."""
+    import jax as _jax
+    from ..ops import pallas_kernels as _pk
+    if _pk._INTERPRET or _jax.default_backend() != "tpu":
+        return {"rtol": 0.0, "atol": 0.0}
+    if dtype is not None and jnp.dtype(dtype) == jnp.bfloat16:
+        # two bf16 ULP relative (2^-8 each), atol for near-zero values
+        return {"rtol": 2.0 / 256, "atol": 2.0 / 256}
+    return {"rtol": 2e-6, "atol": 2e-6}
+
+
+def assert_fused_allclose(actual, desired, err_msg: str = "") -> None:
+    """Assert fused-vs-gather agreement under the shared tolerance
+    contract (exact in interpret mode / on CPU, bounded ULP — in the
+    compared dtype — on TPU)."""
+    tol = fused_attn_tolerance(getattr(desired, "dtype", None))
+    np.testing.assert_allclose(
+        np.asarray(actual, np.float64 if tol["rtol"] else None),
+        np.asarray(desired, np.float64 if tol["rtol"] else None),
+        err_msg=err_msg, **tol)
 
 
 def _paged_geometry(cfg, prefill_chunk: int, block_size: int):
@@ -576,14 +635,26 @@ def _gather_rows(pool, table, n_head, bs):
 
 
 @functools.lru_cache(maxsize=16)
-def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
+def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
+                   fused: bool = False):
     """Paged batched decode tick: same math as ``_tick_fn`` with the
     per-row dus replaced by a block scatter and the cache row reads by a
     table gather. Parked rows scatter into whatever their table's last
     entry points at — the garbage block for free/prefilling rows — and
     their output is discarded; a decode row always writes its own
     position before attending to it (write-before-attend, the invariant
-    every reuse argument leans on)."""
+    every reuse argument leans on).
+
+    ``fused`` replaces the XLA gather + attention by ONE Pallas pass
+    per layer (ops/pallas_kernels.py:paged_attention): the kernel walks
+    the block table directly, so the gathered logical rows are never
+    materialized in HBM. The scatter (and with it the cache bytes) is
+    IDENTICAL either way; only the attention read path changes, under
+    the fused_attn_tolerance contract. The flag is part of this lru
+    key — a fused and a gather engine over one config are different
+    compiled programs — but deliberately NOT part of any RecompileGuard
+    signature string (the guard counts traffic-driven drift, and the
+    flag is fixed at engine construction)."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     identity = lambda t: t
@@ -604,9 +675,14 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
 
             def attn(q, k, v, l=l):
                 # scatter each row's (H, d) K/V into its own block, then
-                # gather the updated logical rows for attention
+                # attend: fused = the Pallas block-table walk; gather =
+                # materialize the logical rows and reuse the dense math
                 pk = pool_k.at[l, blk, :, off, :].set(k[:, 0])
                 pv = pool_v.at[l, blk, :, off, :].set(v[:, 0])
+                if fused:
+                    from ..ops.pallas_kernels import paged_attention
+                    return paged_attention(q, pk, pv, table, pos, l,
+                                           bs), (pk, pv)
                 ck = _gather_rows(pk[l], table, cfg.n_head, bs)
                 cv = _gather_rows(pv[l], table, cfg.n_head, bs)
                 return _attn_cached_rows(q, ck, cv, pos), (pk, pv)
@@ -638,8 +714,15 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
              key, temp, top_k, top_p):
         pidx = jnp.clip(start + jnp.arange(chunk), 0, cfg.seq_len - 1)
         h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
-        wpos = start + jnp.arange(chunk)
-        blkw = table[jnp.clip(wpos // bs, 0, bpr - 1)]      # (chunk,)
+        # write positions clamped INTO the row: a partial-tail prefix
+        # hit resumes prefill at a non-block-aligned start, so the
+        # final chunk's pad positions can run past row_len — clamping
+        # the POSITION (not just the block index) parks those writes at
+        # the row's last slot (beyond every live position, rewritten
+        # before any read — the standard write-before-attend argument)
+        # instead of aliasing offset-of-overflow onto a live block
+        wpos = jnp.minimum(start + jnp.arange(chunk), bpr * bs - 1)
+        blkw = table[wpos // bs]                            # (chunk,)
         offw = wpos % bs
         for l in range(cfg.n_layer):
             p = {k: w[l] for k, w in blocks.items()}
@@ -666,13 +749,18 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
 
 @functools.lru_cache(maxsize=16)
 def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
-                     donate: bool):
+                     donate: bool, fused: bool = False):
     """Paged draft-and-verify step: ``_verify_fn``'s math over block
     scatter/gather. All K+1 candidate positions were reserved (and
     COW-privatized) before dispatch, which is exactly why a rejected
     draft needs no rollback copy: the stale candidate K/V sits in
     privately-owned blocks beyond the row's accepted position,
-    unreachable by the position mask until overwritten."""
+    unreachable by the position mask until overwritten.
+
+    ``fused`` routes the attention read through the same Pallas
+    block-table kernel as the tick, widened to K+1 query rows (query r
+    masked at ``pos + r`` — exactly ``_attn_verify``'s semantics); the
+    scatter and the accept/emit logic are untouched."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     identity = lambda t: t
@@ -691,6 +779,11 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
             def attn(q, k, v, l=l):
                 pk = pool_k.at[l, blkw, :, offw, :].set(k[0])
                 pv = pool_v.at[l, blkw, :, offw, :].set(v[0])
+                if fused:
+                    from ..ops.pallas_kernels import paged_attention
+                    return paged_attention(
+                        q, pk, pv, table[None],
+                        jnp.reshape(pos, (1,)), l, bs), (pk, pv)
                 row_k = _gather_row(pk[l], table, cfg.n_head, bs)
                 row_v = _gather_row(pv[l], table, cfg.n_head, bs)
                 return _attn_verify(q, row_k, row_v, pos), (pk, pv)
@@ -784,7 +877,7 @@ class DecodeEngine:
                  recompile_strict: bool = True, abstract: bool = False,
                  spec_len: int = 0, obs_registry=None,
                  num_blocks: int = 0, block_size: int = 0,
-                 injector=None):
+                 injector=None, fused_attn: bool = True):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -792,7 +885,15 @@ class DecodeEngine:
         engine-level default) keeps the dense slot pool. Paging requires
         chunked prefill (``prefill_chunk`` > 0) and a ``block_size``
         that divides the (seq_len-clamped) chunk, so chunk windows and
-        prefix-trie nodes always cover whole blocks."""
+        prefix-trie nodes always cover whole blocks.
+
+        ``fused_attn`` (paged only): arm the fused Pallas
+        block-table-walk attention for the tick and verify programs
+        wherever ``paged_attention_supported`` holds — it auto-resolves
+        OFF on unsupported backends/geometries (the XLA gather
+        formulation then runs, bit-reference semantics), and
+        ``CXN_FUSED_ATTN=0`` force-disables it process-wide. The
+        resolved state is ``self.fused_attn``."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -853,6 +954,16 @@ class DecodeEngine:
         hd = cfg.feat // cfg.n_head
         if self.paged:
             self.bpr = self.row_len // self.block_size
+            # fused paged attention: requested AND the backend/geometry
+            # supports the kernel (TPU, or interpret mode under test) —
+            # anything else keeps the gather formulation, so a CPU test
+            # mesh and an odd geometry degrade silently to the
+            # bit-reference path instead of failing to compile
+            from ..ops.pallas_kernels import paged_attention_supported
+            self.fused_attn = bool(fused_attn) and \
+                paged_attention_supported(
+                    cfg.n_head, self.bpr, self.block_size, hd,
+                    2 if cfg.dtype == "bfloat16" else 4)
             shape = (cfg.n_layer, self.num_blocks, cfg.n_head,
                      self.block_size, hd)
             # host-side bookkeeping (free list, refcounts, tables);
@@ -864,6 +975,7 @@ class DecodeEngine:
         else:
             self.bpr = 0
             self.manager = None
+            self.fused_attn = False
             shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
         if abstract:
             # audit-only engine (tools/cxn_lint.py --compile): the cache
@@ -988,6 +1100,11 @@ class DecodeEngine:
                           self.cache_v, row_t, SDS((1, self.chunk), i32),
                           SDS((), i32), SDS((), i32), key, SDS((), f32),
                           SDS((), i32), SDS((), f32))
+            # the audited tick/verify are the engine's OWN variants —
+            # fused when self.fused_attn resolved on (the Pallas call
+            # AOT-lowers like any op), gather otherwise — so the audit
+            # pins the donation aliasing of the programs that actually
+            # serve
             specs = [
                 ("serve_prefill_chunk",
                  _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
@@ -1003,7 +1120,8 @@ class DecodeEngine:
                 specs.append(
                     ("serve_verify_chunk",
                      _verify_paged_fn(self._cfg_key, self.spec_len,
-                                      self.block_size, self.bpr, don),
+                                      self.block_size, self.bpr, don,
+                                      self.fused_attn),
                      verify_args, nums))
             tick_args = (self._blocks, self._outer, self.cache_k,
                          self.cache_v, SDS((b, self.bpr), i32),
@@ -1013,7 +1131,7 @@ class DecodeEngine:
             specs.append(
                 ("serve_tick",
                  _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                don), tick_args, nums))
+                                don, self.fused_attn), tick_args, nums))
             return specs
         prefill_args = (self._blocks, self._outer, self.cache_k,
                         self.cache_v, SDS((1, n_prompt), i32),
@@ -1182,9 +1300,13 @@ class DecodeEngine:
                     "(call reserve_window first)"
                     % (int(pos), int(pos) + k + 1, slot))
             if self._vguard is not None:
+                # NB the counted signature string deliberately does NOT
+                # carry the fused/gather flag: it is fixed at engine
+                # construction, not traffic-driven drift
                 self._vguard("spec_len=%d/table=%d" % (k, self.bpr))
             fn = _verify_paged_fn(self._cfg_key, k, self.block_size,
-                                  self.bpr, self._donate)
+                                  self.bpr, self._donate,
+                                  self.fused_attn)
             args = (jnp.asarray(m.table[slot]),)
         else:
             if self._vguard is not None:
@@ -1258,9 +1380,11 @@ class DecodeEngine:
                                     "decode-tick exception")
         if self.paged:
             if self._tguard is not None:
+                # fused/gather is NOT in the counted signature (fixed at
+                # construction; only traffic-driven drift should count)
                 self._tguard("slots=%d/table=%d" % (self.slots, self.bpr))
             fn = _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                self._donate)
+                                self._donate, self.fused_attn)
             args = (jnp.asarray(self.manager.table),)
         else:
             fn = _tick_fn(self._cfg_key, self._donate)

@@ -291,21 +291,30 @@ class PrefixCache:
 
 
 class _PagedNode:
-    """One cached chunk in the PAGED trie: the payload is a tuple of
-    physical block IDS (chunk // block_size of them) the trie holds an
-    ownership ref on — never a K/V copy."""
+    """One cached chunk — or partial-tail — in the PAGED trie: the
+    payload is a tuple of physical block IDS the trie holds an
+    ownership ref on, never a K/V copy. ``valid`` is how many leading
+    tokens of the node's blocks hold real K/V: a complete chunk node
+    has ``valid == chunk`` and whole blocks; a TAIL node (the prompt
+    suffix beyond the last complete chunk) has ``valid < chunk`` and
+    its last block only partially filled — positions past ``valid`` in
+    that block are garbage the attention position mask renders inert,
+    which is what makes sub-block sharing free (doc/serving.md)."""
 
     __slots__ = ("tokens", "blocks", "parent", "children", "refs",
-                 "last_used")
+                 "last_used", "valid", "nbytes")
 
     def __init__(self, tokens: tuple, blocks: tuple,
-                 parent: Optional["_PagedNode"]):
+                 parent: Optional["_PagedNode"], valid: int,
+                 nbytes: int):
         self.tokens = tokens
         self.blocks = blocks
         self.parent = parent
         self.children: Dict[tuple, "_PagedNode"] = {}
         self.refs = 0               # child chunks
         self.last_used = 0
+        self.valid = int(valid)
+        self.nbytes = int(nbytes)
 
 
 class PagedPrefixCache:
@@ -319,6 +328,14 @@ class PagedPrefixCache:
       trie (and any other live row that hit the same prefix) now share
       physical blocks; copy-on-write in engine.reserve_window keeps the
       sharing safe if a write window ever lands in one.
+    * **Partial tails** (sub-block sharing): the prompt suffix beyond
+      the last complete chunk is donated too, as one terminal node with
+      a per-node ``valid`` token count — its last block is only
+      partially filled, and the garbage beyond ``valid`` is inert under
+      the attention position mask (the same invariant recycled rows and
+      the fused kernel's garbage-block reads lean on). A hit on a tail
+      restores a NON-aligned prefix; chunk prefill resumes mid-block
+      and the row's first write COW-faults the shared tail block.
     * **Donation** (``donate_from_row``): at PREFILL COMPLETION — not
       retire — the row's complete prompt chunks are offered to the trie,
       which takes one ownership ref per block. Donating from a LIVE row
@@ -389,31 +406,68 @@ class PagedPrefixCache:
 
     # ------------------------------------------------------------- match
     def match(self, prompt) -> List[_PagedNode]:
-        """Longest cached complete-chunk chain prefixing ``prompt``,
-        capped strictly before the final token (the final chunk must
-        run to sample the request's first token with its own key)."""
+        """Longest cached chain prefixing ``prompt`` — complete chunk
+        nodes, optionally terminated by one partial-TAIL node — capped
+        strictly before the final token (the final chunk must run to
+        sample the request's first token with its own key). A tail
+        node's K/V is valid for any prompt it PREFIXES: K/V at
+        position i depends only on tokens 0..i, so exact-tuple child
+        lookup is right for whole chunks but the tail wants the longest
+        stored suffix that prefixes the remainder."""
         if not self.enabled:
             return []
         out: List[_PagedNode] = []
         children = self._children
+        matched = 0
         for i in range((len(prompt) - 1) // self.chunk):
             node = children.get(self._chunk_key(prompt, i))
             if node is None:
                 break
             out.append(node)
             children = node.children
+            matched += self.chunk
+        tail = self._match_tail(children, prompt, matched)
+        if tail is not None:
+            out.append(tail)
         return out
+
+    def _match_tail(self, children: Dict, prompt,
+                    matched: int) -> Optional[_PagedNode]:
+        """Longest partial-tail node under ``children`` whose tokens
+        prefix ``prompt[matched:]``, leaving at least the final prompt
+        token to recompute. Tail nodes carry fewer than ``chunk``
+        tokens, so they can never collide with a chunk key; the scan is
+        linear over the (few) children — tails are terminal leaves, so
+        there is no chain to walk."""
+        cap = len(prompt) - 1 - matched
+        if cap < 1:
+            return None
+        best = None
+        for node in children.values():
+            v = node.valid
+            if v >= self.chunk or v > cap:
+                continue                # a chunk node, or too long
+            if best is not None and v <= best.valid:
+                continue
+            if node.tokens == tuple(int(t)
+                                    for t in prompt[matched:matched + v]):
+                best = node
+        return best
 
     def match_tokens(self, prompt) -> int:
         """Tokens a hit would restore (the admission gate's estimate —
         no refcounts are touched)."""
-        return len(self.match(prompt)) * self.chunk
+        return sum(nd.valid for nd in self.match(prompt))
 
     def copy_into(self, slot: int, prompt) -> int:
         """Append the longest cached prefix's shared blocks to
         ``slot``'s block table (one incref per block, NO device copy);
-        returns tokens restored. The dense method name is kept so the
-        scheduler drives both cache kinds identically."""
+        returns tokens restored — NOT necessarily block- or
+        chunk-aligned when a partial tail matched: chunk prefill then
+        resumes mid-block, and the row's first write there
+        copy-on-write-faults the shared tail block (reserve_window).
+        The dense method name is kept so the scheduler drives both
+        cache kinds identically."""
         if not self.enabled:
             return 0
         self.prompt_tokens += len(prompt)
@@ -423,32 +477,35 @@ class PagedPrefixCache:
             return 0
         now = self._tick()
         ids = []
+        restored = 0
         for nd in nodes:
             nd.last_used = now
             ids.extend(nd.blocks)
+            restored += nd.valid
         self.engine.attach_shared(slot, ids)
         self.hits += 1
-        restored = len(nodes) * self.chunk
         self.hit_tokens += restored
         return restored
 
     # ------------------------------------------------------------ donate
     def donate_from_row(self, slot: int, prompt) -> int:
-        """Offer ``slot``'s complete prompt chunks to the trie: the trie
-        takes one ownership ref per block of each not-yet-cached chunk
+        """Offer ``slot``'s prompt K/V to the trie: one ownership ref
+        per block of each not-yet-cached complete chunk, PLUS a
+        partial-TAIL node for the suffix beyond the last complete chunk
         (zero copies — the blocks stay exactly where they are). Returns
-        chunks added. Safe from a LIVE row: the donated blocks cover
-        positions < len(prompt), and every later write the row makes
-        lands at >= len(prompt) (chunk pads included — windows are
-        block-aligned), so the row never writes into what it donated;
-        if it somehow did, reserve_window's COW fault would protect the
-        share anyway."""
+        nodes added. Safe from a LIVE row: the donated blocks cover
+        positions < len(prompt); a later row write past the donated
+        region either lands in fresh blocks (block-aligned case) or
+        inside the shared tail block, where reserve_window's
+        copy-on-write fault privatizes the row's copy FIRST — the
+        trie's prefix bytes are immutable either way. Donating the
+        partial tail therefore costs the donor at most one COW block
+        copy on its next write — the price of sub-block sharing, paid
+        once per donation, not per reader."""
         if not self.enabled:
             return 0
-        n_chunks = len(prompt) // self.chunk
-        n_chunks = min(n_chunks, self.budget // max(1, self.node_bytes))
-        if not n_chunks:
-            return 0
+        total = len(prompt) // self.chunk
+        n_chunks = min(total, self.budget // max(1, self.node_bytes))
         now = self._tick()
         keys = [self._chunk_key(prompt, i) for i in range(n_chunks)]
         children = self._children
@@ -463,25 +520,57 @@ class PagedPrefixCache:
             children = node.children
             i += 1
         added = 0
-        m = self.engine.manager
         for j in range(i, n_chunks):
             blocks = tuple(self.engine.row_block_ids(
                 slot, j * self.cpb, (j + 1) * self.cpb))
-            for b in blocks:
-                m.incref(b)
-            node = _PagedNode(keys[j], blocks, parent)
-            node.last_used = now
+            node = self._add_node(keys[j], blocks, parent, self.chunk,
+                                  now)
             children[keys[j]] = node
-            if parent is not None:
-                parent.refs += 1
-            self._nodes[node] = None
-            self._bytes += self.node_bytes
             self.inserted_chunks += 1
             added += 1
             parent = node
             children = node.children
+        # partial tail: the suffix beyond the last complete chunk joins
+        # as ONE terminal node with a per-node valid length (its last
+        # block only partially filled — masked garbage beyond). Only
+        # when the complete chain is fully resident (a budget-capped
+        # chain would dangle the tail mid-prompt) and the tail leaves
+        # the final token to recompute on a future hit.
+        tail = len(prompt) - total * self.chunk
+        bs = self.engine.block_size
+        nblk = (tail + bs - 1) // bs
+        if n_chunks == total and 1 <= tail < self.chunk \
+                and nblk * self.engine.block_bytes() <= self.budget:
+            key = tuple(int(t) for t in prompt[total * self.chunk:])
+            node = children.get(key)
+            if node is not None:
+                node.last_used = now
+            else:
+                blocks = tuple(self.engine.row_block_ids(
+                    slot, total * self.cpb, total * self.cpb + nblk))
+                node = self._add_node(key, blocks, parent, tail, now)
+                children[key] = node
+                self.inserted_chunks += 1
+                added += 1
         self.evict_to_budget()
         return added
+
+    def _add_node(self, key: tuple, blocks: tuple,
+                  parent: Optional[_PagedNode], valid: int,
+                  now: int) -> _PagedNode:
+        """Ref the blocks and wire one node under ``parent`` (the
+        caller links it into the right children dict)."""
+        m = self.engine.manager
+        for b in blocks:
+            m.incref(b)
+        node = _PagedNode(key, blocks, parent, valid,
+                          len(blocks) * self.engine.block_bytes())
+        node.last_used = now
+        if parent is not None:
+            parent.refs += 1
+        self._nodes[node] = None
+        self._bytes += node.nbytes
+        return node
 
     # ------------------------------------------------------------- evict
     def evict_to_budget(self) -> int:
@@ -564,7 +653,7 @@ class PagedPrefixCache:
         if parent is not None:
             parent.refs -= 1
         del self._nodes[node]
-        self._bytes -= self.node_bytes
+        self._bytes -= node.nbytes
         m = self.engine.manager
         for b in node.blocks:
             m.decref(b)

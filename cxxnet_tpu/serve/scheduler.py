@@ -681,10 +681,16 @@ class SlotScheduler:
         toks[:end - start] = req.prompt[start:end]
         # paged: allocate (and COW-privatize) the chunk's full write
         # window first — the program writes chunk tokens at start even
-        # when fewer are valid (the padded final chunk)
-        if self.paged and not self._reserve(slot, start,
-                                            start + self.chunk,
-                                            what="prefill chunk"):
+        # when fewer are valid (the padded final chunk). The window is
+        # clamped to row_len: after a partial-tail prefix hit, start is
+        # NOT chunk-aligned, so the final window can run past the row —
+        # the chunk program clamps those pad writes to the row's last
+        # position (engine._prefill_chunk_paged_fn), and the reserve
+        # must not ask for blocks beyond the table either.
+        if self.paged and not self._reserve(
+                slot, start,
+                min(start + self.chunk, self.engine.row_len),
+                what="prefill chunk"):
             # unreachable with num_blocks >= bpr + 1 (a lone row always
             # fits once the trie is evicted and every other row swapped)
             raise RuntimeError("block pool cannot hold one prefill "

@@ -141,9 +141,9 @@ class InferenceServer:
                  registry=None, slow_ms: float = 0.0,
                  prof_every: int = 0, paged: bool = True,
                  block_size: int = 0, num_blocks: int = 0,
-                 kv_mb: float = 0.0, chaos: str = "",
-                 max_restarts: int = 3, watchdog_ms: float = 0.0,
-                 degrade: bool = True):
+                 kv_mb: float = 0.0, fused_attn: bool = True,
+                 chaos: str = "", max_restarts: int = 3,
+                 watchdog_ms: float = 0.0, degrade: bool = True):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -186,6 +186,13 @@ class InferenceServer:
         wins over the formula). ``paged=False`` or
         ``prefill_chunk=0`` keeps the dense pool (one row per slot —
         still the better layout when every request runs near seq_len).
+        ``fused_attn`` (paged only, default on): route the tick/verify
+        attention reads through the fused Pallas block-table-walk
+        kernel wherever ``ops.pallas_kernels.paged_attention_supported``
+        holds — it auto-resolves off on unsupported backends (the CPU
+        test mesh) and geometries, and ``serve_fused_attn=0`` /
+        ``CXN_FUSED_ATTN=0`` force the XLA gather formulation (the
+        bit-reference path; doc/serving.md "Fused paged attention").
 
         ``prof_every`` > 0 arms the device/compiler observatory
         (obs/devprof.py): the engine's per-program cost table is
@@ -282,7 +289,8 @@ class InferenceServer:
             prefill_chunk=prefill_chunk, recompile_limit=recompile_limit,
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, prefix_mb=prefix_mb,
-            nb=nb, block_size=block_size, prof_every=prof_every)
+            nb=nb, block_size=block_size, prof_every=prof_every,
+            fused_attn=bool(fused_attn))
         self._prefill_budget = int(prefill_budget)
         # device/compiler observatory (obs/devprof.py): compile-time
         # accounting always (this registry becomes a CompileWatch sink,
@@ -359,7 +367,7 @@ class InferenceServer:
             obs_registry=self._registry,
             num_blocks=b["nb"],
             block_size=b["block_size"] if self._paged else 0,
-            injector=self._inj)
+            injector=self._inj, fused_attn=b["fused_attn"])
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             if self._paged:
@@ -1405,6 +1413,7 @@ class InferenceServer:
             "paged": ({
                 "num_blocks": self._engine.num_blocks,
                 "block_size": self._engine.block_size,
+                "fused_attn": self._engine.fused_attn,
                 "blocks": self._engine.manager.counts(),
                 "cow_faults": self._engine.manager.cow_faults,
                 "swaps_out": sc.swaps_out, "swaps_in": sc.swaps_in,

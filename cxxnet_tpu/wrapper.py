@@ -195,9 +195,9 @@ class Net:
                     registry=None, prof_every: int = 0,
                     paged: bool = True, block_size: int = 0,
                     num_blocks: int = 0, kv_mb: float = 0.0,
-                    chaos: str = "", max_restarts: int = 3,
-                    watchdog_ms: float = 0.0, degrade: bool = True,
-                    **defaults) -> None:
+                    fused_attn: bool = True, chaos: str = "",
+                    max_restarts: int = 3, watchdog_ms: float = 0.0,
+                    degrade: bool = True, **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -210,7 +210,11 @@ class Net:
         concurrency scales with tokens in flight (``num_blocks=0``
         auto-sizes to dense-equivalent capacity plus trie headroom, or
         to a ``kv_mb`` MiB budget; ``paged=False`` keeps the dense slot
-        pool — doc/serving.md "Paged KV cache").
+        pool — doc/serving.md "Paged KV cache"). ``fused_attn`` routes
+        the paged tick/verify attention through the fused Pallas
+        block-table-walk kernel where the backend supports it
+        (``False`` or ``CXN_FUSED_ATTN=0`` pins the XLA gather
+        bit-reference — doc/serving.md "Fused paged attention").
         ``recompile_limit`` extends the recompilation guard to the
         engine's prefill/chunk/verify/tick programs
         (``recompile_strict=False`` logs CXN205 instead of raising, the
@@ -262,9 +266,9 @@ class Net:
             spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
             tracer=tracer, registry=registry, prof_every=prof_every,
             paged=paged, block_size=block_size, num_blocks=num_blocks,
-            kv_mb=kv_mb, chaos=chaos, max_restarts=max_restarts,
-            watchdog_ms=watchdog_ms, degrade=degrade,
-            defaults=SamplingParams(**defaults))
+            kv_mb=kv_mb, fused_attn=fused_attn, chaos=chaos,
+            max_restarts=max_restarts, watchdog_ms=watchdog_ms,
+            degrade=degrade, defaults=SamplingParams(**defaults))
 
     def _serving(self):
         srv = getattr(self, "_server", None)

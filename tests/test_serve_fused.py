@@ -1,0 +1,373 @@
+"""Fused paged-attention decode kernel + partial-tail prefix sharing.
+
+The load-bearing invariants of the fused serving path
+(ops/pallas_kernels.py:paged_attention, the engine's fused tick/verify
+variants, PagedPrefixCache partial tails):
+
+1. **shared tolerance contract** — fused-vs-gather agreement is defined
+   ONCE (serve.engine.fused_attn_tolerance): EXACT in interpret mode on
+   the CPU mesh (these tests), bounded ULP on a real TPU. Every
+   differential here asserts through assert_fused_allclose — no
+   per-test ad-hoc allclose settings.
+2. **bit identity** — with the kernel armed (interpret mode), served
+   tokens AND cache bytes equal the gather path's and the solo
+   ``gpt_decode`` oracle under every admission shape: chunked,
+   prefix-hit, partial-tail hit, speculative, recycled rows,
+   preempt/swap/resume, chaos recovery.
+3. **off-switch is a true no-op** — ``fused_attn=False`` /
+   ``CXN_FUSED_ATTN=0`` resolve to the gather programs.
+4. **compiled-program hygiene** — one signature per fused program
+   across mixed traffic; the RecompileGuard signature strings do NOT
+   carry the fused/gather flag; the fused programs audit fully
+   donation-aliased with every index clip folded (CXN208).
+5. **partial tails** — the trie donates/restores the prompt suffix
+   beyond the last complete chunk (per-node valid length, masked
+   garbage past it), so a hit restores MORE than chunk-granular
+   matching could, bit-identically.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import cxxnet_tpu.ops.pallas_kernels as pk
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (DecodeEngine, InferenceServer,
+                              assert_fused_allclose, fused_attn_tolerance)
+from cxxnet_tpu.serve.engine import (_attn_cached_rows, _attn_verify,
+                                     _gather_row, _gather_rows)
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+@pytest.fixture(autouse=True)
+def interpret(monkeypatch):
+    """Arm Pallas interpret mode: the fused kernel runs (and AOT-lowers)
+    on the CPU mesh, and the tolerance contract's exact branch
+    applies."""
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, **kw):
+    seed = kw.pop("seed", 0)
+    t = kw.get("temperature", 0.0)
+    rng = jax.random.PRNGKey(seed) if t > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 rng=rng, **kw))[0]
+
+
+# --------------------------------------------------------------- kernel
+def test_kernel_exact_vs_gather_reference():
+    """paged_attention against the gather reference (_gather_rows +
+    _attn_cached_rows for the tick shape, _gather_row + _attn_verify
+    for the verify shape), both jitted, f32 AND bf16: exact under the
+    interpret-mode branch of the shared contract — including garbage
+    (id 0) table entries, which the position mask must zero."""
+    rs = np.random.RandomState(0)
+    L, NB, H, bs, d = 2, 20, CFG.n_head, 4, CFG.feat // CFG.n_head
+    b, bpr = 3, 6
+    for dtype in (jax.numpy.float32, jax.numpy.bfloat16):
+        pool_k = jax.numpy.asarray(rs.randn(L, NB, H, bs, d), dtype)
+        pool_v = jax.numpy.asarray(rs.randn(L, NB, H, bs, d), dtype)
+        table = np.zeros((b, bpr), np.int32)
+        table[0, :3] = [5, 9, 2]            # rest: garbage block 0
+        table[1, :5] = [7, 11, 1, 3, 8]
+        table[2, :2] = [4, 6]
+        table = jax.numpy.asarray(table)
+        pos = jax.numpy.asarray([9, 17, 6], jax.numpy.int32)
+        q = jax.numpy.asarray(rs.randn(b, 1, H, d), dtype)
+
+        @jax.jit
+        def gather_tick(q, pk_, pv_, table, pos):
+            ck = _gather_rows(pk_[1], table, H, bs)
+            cv = _gather_rows(pv_[1], table, H, bs)
+            return _attn_cached_rows(q, ck, cv, pos)
+
+        @jax.jit
+        def fused_tick(q, pk_, pv_, table, pos):
+            return pk.paged_attention(q, pk_, pv_, table, pos, 1, bs)
+
+        assert_fused_allclose(fused_tick(q, pool_k, pool_v, table, pos),
+                              gather_tick(q, pool_k, pool_v, table, pos),
+                              "tick %s" % dtype.__name__)
+
+        R = 4
+        qv = jax.numpy.asarray(rs.randn(1, R, H, d), dtype)
+        vpos = jax.numpy.asarray(9, jax.numpy.int32)
+
+        @jax.jit
+        def gather_verify(q, pk_, pv_, table, pos):
+            ck = _gather_row(pk_[0], table[0], H, bs)
+            cv = _gather_row(pv_[0], table[0], H, bs)
+            return _attn_verify(q, ck, cv, pos)
+
+        @jax.jit
+        def fused_verify(q, pk_, pv_, table, pos):
+            return pk.paged_attention(q, pk_, pv_, table[:1],
+                                      jax.numpy.reshape(pos, (1,)), 0, bs)
+
+        assert_fused_allclose(
+            fused_verify(qv, pool_k, pool_v, table, vpos),
+            gather_verify(qv, pool_k, pool_v, table, vpos),
+            "verify %s" % dtype.__name__)
+
+
+def test_tolerance_contract_exact_here():
+    """On the CPU mesh with interpret armed, the shared contract's
+    exact branch applies — rtol = atol = 0, not an ad-hoc epsilon."""
+    assert fused_attn_tolerance() == {"rtol": 0.0, "atol": 0.0}
+
+
+# ------------------------------------------------- served-token identity
+def test_fused_vs_gather_vs_oracle_mixed_workload():
+    """The tentpole differential: a mixed workload — non-multiple
+    lengths, sampling, shared prefixes, recycled rows — served with the
+    fused kernel armed produces tokens IDENTICAL to the gather path
+    and the solo gpt_decode oracle, and the final pools agree under the
+    shared contract (exact here)."""
+    rs = np.random.RandomState(0)
+    shared = _prompt(rs, 12)
+    cases = [
+        dict(p=_prompt(rs, 3), max_tokens=5),
+        dict(p=_prompt(rs, 9), max_tokens=6, temperature=0.8, top_k=5,
+             top_p=0.9, seed=7),
+        dict(p=np.concatenate([shared, _prompt(rs, 3)]), max_tokens=5,
+             temperature=0.7, seed=2),
+        dict(p=np.concatenate([shared, _prompt(rs, 5)]), max_tokens=5),
+        dict(p=_prompt(rs, 13), max_tokens=5),
+    ]
+    outs = {}
+    for fused in (True, False):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                             prefill_chunk=4, fused_attn=fused) as srv:
+            hs = [srv.submit(c["p"], **{k: v for k, v in c.items()
+                                        if k != "p"}) for c in cases]
+            outs[fused] = [srv.result(h, timeout=300) for h in hs]
+            m = srv.metrics()
+            assert m["paged"]["fused_attn"] is fused
+        assert all(r.status == "ok" for r in outs[fused])
+    for c, rf, rg in zip(cases, outs[True], outs[False]):
+        kw = {k: v for k, v in c.items() if k not in ("p", "max_tokens")}
+        ref = _ref(c["p"], c["max_tokens"], **kw)
+        np.testing.assert_array_equal(rf.tokens, ref)
+        np.testing.assert_array_equal(rf.tokens, rg.tokens)
+
+
+def test_fused_speculative_identity():
+    """Greedy speculative serving through the FUSED verify program
+    stays bit-identical to the solo oracle."""
+    rs = np.random.RandomState(3)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base, base])     # n-gram bait
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=3,
+                         fused_attn=True) as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=8), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(prompt, 8))
+    assert m["paged"]["fused_attn"] and m["spec_forwards"] >= 1
+
+
+def test_fused_swap_resume_identity_under_tiny_pool():
+    """Preempt -> swap -> resume with the fused kernel armed: a pool
+    ~2x smaller than the working set still serves every request the
+    oracle's exact tokens (the kernel reads whatever blocks the resume
+    scattered — sharing/swap policy is untouched by the read path)."""
+    rs = np.random.RandomState(6)
+    prompts = [_prompt(rs, 6) for _ in range(3)]
+    srv = InferenceServer(CFG, PARAMS, slots=3, queue=8, prefill_chunk=4,
+                          prefix_mb=0.0, num_blocks=15, fused_attn=True)
+    hs = [srv.submit(p, max_tokens=20) for p in prompts]
+    res = [srv.result(h, timeout=300) for h in hs]
+    m = srv.metrics()
+    srv.shutdown()
+    assert [r.status for r in res] == ["ok"] * 3
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r.tokens, _ref(p, 20))
+    assert m["paged"]["swaps_out"] >= 1 and m["paged"]["swaps_in"] >= 1
+
+
+def test_chaos_recovery_bit_identical_with_fused_kernel():
+    """PR 9's recovery contract survives the fused kernel: an injected
+    tick fault tears the engine down, the replayed request regenerates
+    through the FUSED programs, and the stream stays bit-identical."""
+    rs = np.random.RandomState(11)
+    prompt = _prompt(rs, 7)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         fused_attn=True, chaos="tick_raise@3",
+                         max_restarts=3) as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=10), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(prompt, 10))
+    assert m["resilience"]["restarts"] >= 1
+    assert m["resilience"]["replayed"] >= 1
+
+
+# ---------------------------------------------------------- off-switch
+def test_off_switch_param_resolves_gather():
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, fused_attn=False)
+    assert eng.fused_attn is False
+    eng.close()
+
+
+def test_off_switch_env_true_noop(monkeypatch):
+    """CXN_FUSED_ATTN=0 force-disables resolution even where the
+    kernel is supported, and the served stream is the gather path's."""
+    monkeypatch.setenv("CXN_FUSED_ATTN", "0")
+    rs = np.random.RandomState(4)
+    prompt = _prompt(rs, 9)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                         prefill_chunk=4) as srv:
+        assert srv.metrics()["paged"]["fused_attn"] is False
+        res = srv.result(srv.submit(prompt, max_tokens=6), timeout=300)
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(prompt, 6))
+
+
+# ------------------------------------------- compiled-program hygiene
+def test_one_compiled_signature_fused_across_mixed_traffic():
+    """30 mixed-length requests through a strict RecompileGuard with
+    the fused kernel armed: chunk, tick, and verify each keep ONE
+    compiled signature (the acceptance bound)."""
+    rs = np.random.RandomState(9)
+    with InferenceServer(CFG, PARAMS, slots=3, queue=64, prefill_chunk=4,
+                         recompile_limit=1, recompile_strict=True,
+                         spec_mode="ngram", spec_len=2,
+                         fused_attn=True) as srv:
+        hs = [srv.submit(_prompt(rs, 1 + (i * 7) % 20), max_tokens=3)
+              for i in range(30)]
+        assert all(srv.result(h, timeout=300).status == "ok"
+                   for h in hs)
+        eng = srv._engine
+        assert eng.fused_attn
+        assert len(eng.prefill_signatures) == 1, eng.prefill_signatures
+        assert len(eng.tick_signatures) == 1, eng.tick_signatures
+        assert len(eng.verify_signatures) <= 1
+
+
+def test_guard_signatures_do_not_carry_fused_flag():
+    """The fused/gather choice is fixed at construction, so it must
+    NOT appear in any RecompileGuard signature string — a fused and a
+    gather engine over the same traffic count IDENTICAL signatures
+    (the flag can never read as a drifting leaf)."""
+    rs = np.random.RandomState(2)
+    prompt = _prompt(rs, 6)
+    sigs = {}
+    for fused in (True, False):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                             prefill_chunk=4, recompile_limit=2,
+                             spec_mode="ngram", spec_len=2,
+                             fused_attn=fused) as srv:
+            srv.result(srv.submit(np.concatenate([prompt, prompt]),
+                                  max_tokens=4), timeout=300)
+            eng = srv._engine
+            sigs[fused] = (eng.prefill_signatures, eng.tick_signatures,
+                           eng.verify_signatures)
+    assert sigs[True] == sigs[False], sigs
+    for group in sigs[True]:
+        for s in group:
+            assert "fused" not in s and "gather" not in s, s
+
+
+def test_fused_audit_fully_aliased_and_clip_folded():
+    """cxn-lint pass 2 on the FUSED engine: chunk/verify/tick audit
+    with both pool buffers donation-aliased end to end AND every
+    explicit index clip folded into its fusion (CXN208 /
+    entry_clamps == 0 — the step table's clip=folded column)."""
+    from cxxnet_tpu.analysis import audit_serve_engine
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, spec_len=2, abstract=True,
+                       fused_attn=True)
+    assert eng.fused_attn
+    report, infos = audit_serve_engine(eng, donate=True)
+    assert report.ok(), report.format()
+    assert [i["label"] for i in infos] == [
+        "serve_prefill_chunk", "serve_verify_chunk", "serve_tick"]
+    for info in infos:
+        assert info["donated"] == 2 and info["aliased"] == 2, info
+        assert info["entry_clamps"] == 0, info
+
+
+def test_block_table_width_gauge_published():
+    """The observatory surfaces the compiled block-table width next to
+    the per-program cost rows (cxn_program_block_table_width{fn=}), so
+    pool-geometry changes are attributable from a scrape."""
+    from cxxnet_tpu.obs.devprof import profile_engine
+    from cxxnet_tpu.obs.metrics import Registry
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, fused_attn=False)
+    reg = Registry()
+    profile_engine(eng, registry=reg)
+    snap = reg.snapshot()
+    key = 'cxn_program_block_table_width{fn="serve_tick"}'
+    assert snap.get(key) == eng.bpr, sorted(
+        k for k in snap if k.startswith("cxn_program_block_table"))
+    eng.close()
+
+
+# ------------------------------------------------------- partial tails
+def test_partial_tail_prefix_hit_restores_sub_chunk_tokens():
+    """Two prompts sharing an 11-token prefix at chunk 4: chunk-granular
+    matching could restore at most 8 tokens, the partial tail brings
+    the hit to 11 — and the hit stream stays bit-identical to the solo
+    oracle (the restored tail block's garbage past `valid` is masked,
+    the first write into it COW-faults)."""
+    rs = np.random.RandomState(12)
+    shared = _prompt(rs, 11)
+    p_a = shared
+    p_b = np.concatenate([shared, _prompt(rs, 5)])
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         prefix_mb=1.0, fused_attn=True) as srv:
+        res_a = srv.result(srv.submit(p_a, max_tokens=4), timeout=300)
+        res_b = srv.result(srv.submit(p_b, max_tokens=6), timeout=300)
+        hit = srv.metrics()["prefix_cache"]["hit_tokens"]
+    assert res_a.status == "ok" and res_b.status == "ok"
+    np.testing.assert_array_equal(res_a.tokens, _ref(p_a, 4))
+    np.testing.assert_array_equal(res_b.tokens, _ref(p_b, 6))
+    assert hit >= 11, hit        # > the 8 chunk-granular tokens
+
+
+def test_partial_tail_trie_unit():
+    """Trie-level pin: donation creates ONE terminal tail node with a
+    per-node valid length and ceil(valid/bs) block refs; matching a
+    longer prompt returns it; eviction hands the blocks back and the
+    refcount audit stays clean."""
+    from cxxnet_tpu.serve.prefix_cache import PagedPrefixCache
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, fused_attn=False)
+    cache = PagedPrefixCache(eng, 1 << 20)
+    rs = np.random.RandomState(13)
+    prompt = _prompt(rs, 11)            # 2 chunks + 3-token tail
+    key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+    for start in range(0, 11, 4):
+        end = min(start + 4, 11)
+        eng.reserve_window(0, start, start + 4)
+        buf = np.zeros(4, np.int32)
+        buf[:end - start] = prompt[start:end]
+        eng.prefill_chunk(0, buf, start, end - start, key, 0.0, 0, 1.0)
+    added = cache.donate_from_row(0, prompt)
+    assert added == 3                   # 2 chunk nodes + 1 tail node
+    tail = [nd for nd in cache._nodes if nd.valid < cache.chunk]
+    assert len(tail) == 1 and tail[0].valid == 3
+    assert len(tail[0].blocks) == 1     # ceil(3 / bs=4)
+    assert cache.match_tokens(np.concatenate(
+        [prompt, _prompt(rs, 4)])) == 11
+    # the donor's own prompt must not over-match (final token rule):
+    # chain capped at 10 -> complete chunks 8 + no 3-token tail room
+    assert cache.match_tokens(prompt) == 8
+    m = eng.manager
+    eng.release_row(0)
+    cache.clear()
+    m.check_consistency(trie_refs=0)
+    assert m.free_count == eng.num_blocks - 1
+    eng.close()

@@ -87,21 +87,57 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     block_size=task.serve_block_size,
                     prefix_mb=task.serve_prefix_mb,
                     kv_mb=task.serve_kv_mb))
-            eng = DecodeEngine(gcfg, gparams, slots=2,
-                               prefill_chunk=task.serve_prefill_chunk,
-                               abstract=True,
-                               num_blocks=nb,
-                               block_size=task.serve_block_size,
-                               spec_len=(task.spec_len
-                                         if task.spec_mode != "off"
-                                         else 0))
-            # the serve executables ride under the same compile-time
-            # budget as the trainer steps (CXN207): pass
-            # lint_compile_budget_s=<s> to gate compile regressions in
-            # CI the way lint_collective_budget gates collectives
-            cbudget = getattr(net, "lint_compile_budget_s", 0.0) or None
-            serve_report, serve_infos = audit_serve_engine(
-                eng, compile_budget_s=cbudget)
+            # fused-attention audit off-TPU: the production default is
+            # the fused Pallas tick/verify, but the kernel only
+            # compiles on TPU backends — arm interpret mode for the
+            # audit so CI (the CPU mesh) still AOT-lowers and pins THE
+            # FUSED programs' donation aliasing, not a gather stand-in.
+            # Only for geometries a real TPU would resolve FUSED,
+            # though: interpret mode waives the kernel's geometry
+            # limits, and auditing a fused program production would
+            # fall back from pins the wrong executable.
+            import jax as _jax
+            from cxxnet_tpu.ops import pallas_kernels as _pk
+            geom_ok = False
+            if nb > 0:
+                from cxxnet_tpu.serve.engine import _paged_geometry
+                _, bs_, _, bpr_, _ = _paged_geometry(
+                    gcfg, task.serve_prefill_chunk,
+                    task.serve_block_size)
+                geom_ok = _pk.paged_attention_geometry_ok(
+                    gcfg.n_head, bpr_, bs_,
+                    gcfg.feat // gcfg.n_head,
+                    2 if gcfg.dtype == "bfloat16" else 4)
+            arm = bool(geom_ok and task.serve_fused_attn
+                       and os.environ.get("CXN_FUSED_ATTN", "1") != "0"
+                       and _jax.default_backend() != "tpu"
+                       and not _pk._INTERPRET)
+            if arm and verbose:
+                print("  (fused paged attention audited in Pallas "
+                      "interpret mode on this backend)")
+            old_interp = _pk._INTERPRET
+            try:
+                if arm:
+                    _pk._INTERPRET = True
+                eng = DecodeEngine(gcfg, gparams, slots=2,
+                                   prefill_chunk=task.serve_prefill_chunk,
+                                   abstract=True,
+                                   num_blocks=nb,
+                                   block_size=task.serve_block_size,
+                                   spec_len=(task.spec_len
+                                             if task.spec_mode != "off"
+                                             else 0),
+                                   fused_attn=bool(task.serve_fused_attn))
+                # the serve executables ride under the same compile-time
+                # budget as the trainer steps (CXN207): pass
+                # lint_compile_budget_s=<s> to gate compile regressions
+                # in CI the way lint_collective_budget gates collectives
+                cbudget = getattr(net, "lint_compile_budget_s", 0.0) \
+                    or None
+                serve_report, serve_infos = audit_serve_engine(
+                    eng, compile_budget_s=cbudget)
+            finally:
+                _pk._INTERPRET = old_interp
             report.extend(serve_report.findings)
             infos += serve_infos
         if verbose:

@@ -56,6 +56,12 @@ _DEFAULT_CELL_TOL = {
     "serve_p95_ttft_ms_prefill_heavy": 0.25,
     "serve_prefix_hit_tokens_per_sec": 0.20,
     "serve_spec_tokens_per_sec": 0.20,
+    "serve_tokens_per_sec_fused": 0.25,     # open-loop serve cell noise;
+    #                                         direction comes from the
+    #                                         tokens/sec unit (regresses
+    #                                         DOWN), band matches the
+    #                                         other serve trace cells
+    "serve_tokens_per_mib": 0.20,
     "gpt_decode_spec_ms_per_token": 0.20,
     "obs_overhead_pct": 1.0,        # a percentage-point-scale cell:
     #                                 gate it on the <= 2% budget in
