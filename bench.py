@@ -64,6 +64,29 @@ Prints one JSON line per metric, in this order:
                                      check; cxn_mfu{fn=serve_tick}
                                      rides along as an attribute,
                                      round 16)
+ 12a3. serve_tokens_per_sec_tp2     (tensor-parallel serving: the
+                                     REPL_CELL trace served by the tp=2
+                                     gather-form TP engine — KV pool
+                                     head-sharded over a 2-device mesh
+                                     — vs the single-device engine;
+                                     tokens bit-identical, so the
+                                     ratio is pure partitioning
+                                     overhead on a shared-core CPU rig
+                                     and the memory-per-chip win on a
+                                     real one, round 17)
+ 12a4. serve_tokens_per_sec_replicated (2 engine replicas behind the
+                                     prefix/health router vs one
+                                     engine; ~Nx on N-device rigs,
+                                     pinned honest on shared cores,
+                                     round 17)
+ 12a5. serve_goodput_replicated_kill (completed-request fraction with
+                                     an engine chaos-killed mid-trace,
+                                     restart budget 0: the router
+                                     replays the dead replica's
+                                     requests on the survivor;
+                                     vs_baseline = router / single
+                                     completed fraction — the
+                                     availability headline, round 17)
  12b. serve_spec_tokens_per_sec     (speculative serving: n-gram drafter
                                      on a repetitive-suffix trace;
                                      vs_baseline = the same trace served
@@ -113,6 +136,17 @@ import numpy as np
 # flagship and the rest of the zoo.
 os.environ.setdefault("LIBTPU_INIT_ARGS",
                       "--xla_tpu_scoped_vmem_limit_kib=65536")
+
+# forced virtual host devices for the sharded/replicated serving cells
+# (round 17): affects only the HOST (CPU) platform — a no-op on real
+# TPU rigs — and gives the CPU rig the multi-device mesh serve_tp
+# needs (tests/conftest.py forces the same for the suite). Must happen
+# before jax initializes, which is why it sits at module import.
+if "xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 BASELINE_IMAGES_PER_SEC = 800.0
 # hardware peaks (FLOP/s + HBM bytes/s) come from the devprof
@@ -682,14 +716,18 @@ def serve_prefix_trace(cell=None):
              int(m)) for g, s, m in zip(gaps, suff, maxt)]
 
 
-def run_serve_trace(cfg, params, trace, **server_kw):
+def run_serve_trace(cfg, params, trace, replicas=1, **server_kw):
     """One warmed open-loop pass of ``trace`` through an InferenceServer
-    built with ``server_kw``; returns (wall seconds, metrics). The warm
-    pass compiles every program AND fills the prefix cache, so the
-    measured pass sees the steady state."""
-    from cxxnet_tpu.serve import InferenceServer
+    (or, with ``replicas`` > 1, a ServeRouter over that many engine
+    replicas) built with ``server_kw``; returns (wall seconds,
+    metrics). The warm pass compiles every program AND fills the
+    prefix cache, so the measured pass sees the steady state."""
+    from cxxnet_tpu.serve import InferenceServer, ServeRouter
 
-    srv = InferenceServer(cfg, params, **server_kw)
+    if replicas > 1:
+        srv = ServeRouter(cfg, params, replicas=replicas, **server_kw)
+    else:
+        srv = InferenceServer(cfg, params, **server_kw)
     try:
         for h in [srv.submit(p, max_tokens=m) for _, p, m in trace]:
             srv.result(h)
@@ -831,6 +869,132 @@ def bench_serve_fused():
          fused_active=bool(mf["paged"]["fused_attn"]),
          gather_tokens_per_sec=round(tps_g, 1),
          mfu_serve_tick=(round(mfu, 6) if mfu is not None else None))
+
+
+# the sharded/replicated serving cell (round 17, doc/serving.md
+# "Sharded & replicated serving"): small geometry — the POINT on a CPU
+# rig is exercising the real partitioned programs / router machinery
+# end to end and recording honest CPU-scaled ratios, not FLOPs. On this
+# rig `nproc` is 1: a single XLA engine already owns the core, so
+# neither TP (adds collectives + resharding on one core) nor in-process
+# replication (two schedulers sharing one core) can beat 1.0x wall-
+# clock — the recorded vs_baseline ratios pin the MACHINERY'S overhead
+# honestly, while the multi-chip win (1/tp KV bytes per chip, N cores
+# serving N replicas) is the TPU rig's to record. What replication DOES
+# win on any rig is availability, so the cell also measures goodput
+# under a chaos-killed engine: the router replays the dead replica's
+# requests on the survivor (completed fraction ~1.0) while the single
+# engine fails every in-flight + later request.
+REPL_CELL = dict(layers=2, heads=4, feat=64, seq=128, vocab=256,
+                 slots=2, n_requests=24, mean_gap_ms=1.0, seed=11,
+                 chunk=16, max_new=(24, 48))
+
+
+def _repl_model():
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+
+    c = REPL_CELL
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"],
+                    feat=c["feat"], n_microbatch=1)
+    return c, cfg, gpt_init(jax.random.PRNGKey(0), cfg)
+
+
+def _repl_trace(c):
+    rs = np.random.RandomState(c["seed"])
+    lens = rs.choice([8, 16], c["n_requests"])
+    maxt = rs.choice(list(c["max_new"]), c["n_requests"])
+    gaps = rs.exponential(c["mean_gap_ms"] / 1e3, c["n_requests"])
+    return [(float(g),
+             rs.randint(0, c["vocab"], (int(l),)).astype(np.int32),
+             int(m)) for g, l, m in zip(gaps, lens, maxt)]
+
+
+def bench_serve_sharded():
+    """TP-sharded serving cell: the same Poisson trace served by the
+    single-device engine and by the tp=2 gather-form TP engine (KV
+    pool head-sharded over a 2-device mesh — on CPU, two forced host
+    devices). Emits ``serve_tokens_per_sec_tp2`` with vs_baseline =
+    tp2 / tp1; tokens are bit-identical by construction (the identity
+    the test suite pins), so the ratio is pure partitioning overhead
+    on this rig and pure memory-per-chip win on a real one."""
+    import jax
+
+    c, cfg, params = _repl_model()
+    trace = _repl_trace(c)
+    kw = dict(slots=c["slots"], queue=c["n_requests"],
+              prefill_chunk=c["chunk"])
+    wall_1, m1 = run_serve_trace(cfg, params, trace, **kw)
+    tps1 = m1["tokens_generated"] / wall_1
+    if len(jax.devices()) < 2:
+        emit("serve_tokens_per_sec_tp2", tps1, "tokens/sec", 1.0,
+             skipped="needs >= 2 devices")
+        return
+    wall_2, m2 = run_serve_trace(cfg, params, trace, tp=2, **kw)
+    tps2 = m2["tokens_generated"] / wall_2
+    emit("serve_tokens_per_sec_tp2", tps2, "tokens/sec",
+         tps2 / max(tps1, 1e-9),
+         tp1_tokens_per_sec=round(tps1, 1),
+         kv_bytes_per_shard=m2["kv_cache_bytes"] // 2)
+
+
+def bench_serve_replicated():
+    """Replicated-router cell: the trace served by ONE engine vs TWO
+    engine replicas behind the prefix/health router. Emits
+    ``serve_tokens_per_sec_replicated`` (vs_baseline = router / single
+    — the aggregate-throughput headline, ~Nx on an N-device rig, pinned
+    honest on shared cores) and ``serve_goodput_replicated_kill``: the
+    completed-request fraction when an engine is chaos-killed
+    mid-trace (restart budget 0) — the router replays the dead
+    replica's requests on the survivor, the single engine fails
+    everything from the kill on. vs_baseline there = router completed /
+    single completed, the availability win replication exists for."""
+    c, cfg, params = _repl_model()
+    trace = _repl_trace(c)
+    kw = dict(slots=c["slots"], queue=c["n_requests"],
+              prefill_chunk=c["chunk"])
+    wall_1, m1 = run_serve_trace(cfg, params, trace, **kw)
+    tps1 = m1["tokens_generated"] / wall_1
+    wall_r, mr = run_serve_trace(cfg, params, trace, replicas=2, **kw)
+    tps_r = mr["tokens_generated"] / wall_r
+    emit("serve_tokens_per_sec_replicated", tps_r, "tokens/sec",
+         tps_r / max(tps1, 1e-9),
+         single_tokens_per_sec=round(tps1, 1),
+         routed=mr["routed"], failovers=mr["failovers"])
+
+    # availability under a mid-trace engine kill (chaos tick_raise@N,
+    # restart budget 0): count completed requests, not tokens — a dead
+    # engine's unfinished + rejected requests are the outage
+    from cxxnet_tpu.serve import (EngineFailedError, InferenceServer,
+                                  QueueFullError, ServeRouter)
+
+    def goodput(server):
+        ok = 0
+        handles = []
+        try:
+            for gap, p, m in trace:
+                time.sleep(gap)
+                try:
+                    handles.append(server.submit(p, max_tokens=m))
+                except (EngineFailedError, QueueFullError):
+                    pass
+            for h in handles:
+                if server.result(h, timeout=600).status == "ok":
+                    ok += 1
+        finally:
+            server.shutdown(drain=False)
+        return ok / float(len(trace))
+
+    kill = "tick_raise@40"
+    g_single = goodput(InferenceServer(cfg, params, chaos=kill,
+                                       max_restarts=0, **kw))
+    g_router = goodput(ServeRouter(cfg, params, replicas=2,
+                                   chaos=(kill, ""), max_restarts=0,
+                                   **kw))
+    emit("serve_goodput_replicated_kill", g_router, "fraction",
+         g_router / max(g_single, 1e-9),
+         single_goodput=round(g_single, 3))
 
 
 def serve_spec_trace(cfg, params, cell=None):
@@ -975,8 +1139,9 @@ def main() -> int:
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
                bench_serve_prefill_heavy, bench_serve_paged,
-               bench_serve_fused, bench_serve_spec, bench_obs_overhead,
-               bench_lint):
+               bench_serve_fused, bench_serve_sharded,
+               bench_serve_replicated, bench_serve_spec,
+               bench_obs_overhead, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

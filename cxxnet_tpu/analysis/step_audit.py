@@ -82,6 +82,26 @@ def _main_signature_donors(stable: str) -> Tuple[set, Dict[int, str]]:
     return donors, types
 
 
+def _arg_sharding_specs(args: Sequence) -> List[str]:
+    """Sorted distinct non-trivial PartitionSpec strings carried by the
+    abstract args (ShapeDtypeStructs with ``sharding=`` — how
+    net_step_specs and a TP serve engine's lint_specs pass real mesh
+    placements into the AOT lower). Replicated/unspecified leaves are
+    skipped: the interesting fact is WHAT is sharded, not that scalars
+    are not."""
+    import jax
+    specs = set()
+    for a in args:
+        for leaf in jax.tree_util.tree_leaves(a):
+            sh = getattr(leaf, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            if spec is None:
+                continue
+            if any(ax is not None for ax in tuple(spec)):
+                specs.add(str(spec))
+    return sorted(specs)
+
+
 def collective_counts(hlo_text: str) -> Dict[str, int]:
     return {op: len(re.findall(r"\b%s(?:-start)?\(" % op, hlo_text))
             for op in _COLLECTIVE_OPS}
@@ -126,6 +146,10 @@ def format_step_info(info: Dict) -> str:
         line += " clip=%s" % ("folded" if info["entry_clamps"] == 0
                               else "%d materialized"
                               % info["entry_clamps"])
+    if info.get("shardings"):
+        # a sharded audit names its input placements, so the step table
+        # shows the executable was partitioned (not a 1-device lookalike)
+        line += " sharded[%s]" % "; ".join(info["shardings"])
     return line
 
 
@@ -236,7 +260,13 @@ def audit_jit(fn, args: tuple, label: str,
     info = {"label": label, "collectives": counts,
             "donated": requested,
             "aliased": len(donors & compiled_aliased),
-            "compile_s": compile_s}
+            "compile_s": compile_s,
+            # the distinct non-trivial PartitionSpecs of the abstract
+            # inputs — how a sharded audit PROVES the executable was
+            # lowered against real mesh shardings (the TP serve audit
+            # asserts the KV pool's head-axis spec shows up here;
+            # tests/test_serve_tp.py)
+            "shardings": _arg_sharding_specs(args)}
     if check_clip:
         info["entry_clamps"] = entry_clamp_count(hlo)
         if info["entry_clamps"] > 0:

@@ -116,6 +116,22 @@ class LearnTask:
         #                                 teardown + replay restart
         #                                 (0 = off; must exceed the
         #                                 worst-case compile of one pass)
+        self.serve_tp = 0         # task=serve: tensor-parallel shard
+        #                           count for the decode engine (0/1 =
+        #                           single device; needs n_head % tp ==
+        #                           0, chunked prefill, and tp local
+        #                           devices — gather-form TP, served
+        #                           tokens bit-identical;
+        #                           doc/serving.md "Sharded &
+        #                           replicated serving")
+        self.serve_replicas = 1   # task=serve: data-parallel engine
+        #                           replicas behind the prefix- and
+        #                           health-aware router (serve/router
+        #                           .py); 1 = plain single server
+        self.serve_router = "prefix"    # router policy: "prefix"
+        #                           (longest prefix-affinity match,
+        #                           load breaks ties) or "rr"
+        #                           (round-robin)
         self.serve_degrade = 1    # graceful-degradation ladder: under
         #                           sustained overload disable spec ->
         #                           stop prefix admission -> shed
@@ -254,6 +270,12 @@ class LearnTask:
             self.serve_watchdog_ms = float(val)
         elif name == "serve_degrade":
             self.serve_degrade = int(val)
+        elif name == "serve_tp":
+            self.serve_tp = int(val)
+        elif name == "serve_replicas":
+            self.serve_replicas = int(val)
+        elif name == "serve_router":
+            self.serve_router = val
         elif name == "spec_mode":
             self.spec_mode = val
         elif name == "spec_len":
@@ -965,28 +987,40 @@ class LearnTask:
         # the same config pairs, including the CXN_LINT-injected limit 8
         # / non-strict defaults) also govern the serve engine's compiled
         # prefill/chunk signature count
-        srv = InferenceServer(cfg, params, slots=self.serve_slots,
-                              queue=self.serve_queue, defaults=defaults,
-                              prefill_chunk=self.serve_prefill_chunk,
-                              prefill_budget=self.serve_prefill_budget,
-                              prefix_mb=self.serve_prefix_mb,
-                              paged=bool(self.serve_paged),
-                              block_size=self.serve_block_size,
-                              num_blocks=self.serve_num_blocks,
-                              kv_mb=self.serve_kv_mb,
-                              fused_attn=bool(self.serve_fused_attn),
-                              recompile_limit=self.net.lint_recompile_limit,
-                              recompile_strict=bool(
-                                  self.net.lint_recompile_strict),
-                              spec_mode=self.spec_mode,
-                              spec_len=self.spec_len,
-                              spec_model=self._spec_model_export(),
-                              slow_ms=self.obs_slow_ms,
-                              prof_every=self.prof_every,
-                              chaos=self.serve_chaos,
-                              max_restarts=self.serve_max_restarts,
-                              watchdog_ms=self.serve_watchdog_ms,
-                              degrade=bool(self.serve_degrade))
+        server_kw = dict(slots=self.serve_slots,
+                         queue=self.serve_queue, defaults=defaults,
+                         prefill_chunk=self.serve_prefill_chunk,
+                         prefill_budget=self.serve_prefill_budget,
+                         prefix_mb=self.serve_prefix_mb,
+                         paged=bool(self.serve_paged),
+                         block_size=self.serve_block_size,
+                         num_blocks=self.serve_num_blocks,
+                         kv_mb=self.serve_kv_mb,
+                         fused_attn=bool(self.serve_fused_attn),
+                         recompile_limit=self.net.lint_recompile_limit,
+                         recompile_strict=bool(
+                             self.net.lint_recompile_strict),
+                         spec_mode=self.spec_mode,
+                         spec_len=self.spec_len,
+                         spec_model=self._spec_model_export(),
+                         slow_ms=self.obs_slow_ms,
+                         prof_every=self.prof_every,
+                         chaos=self.serve_chaos,
+                         max_restarts=self.serve_max_restarts,
+                         watchdog_ms=self.serve_watchdog_ms,
+                         degrade=bool(self.serve_degrade),
+                         tp=self.serve_tp)
+        routed = self.serve_replicas > 1
+        if routed:
+            # replicated serving: N engines behind the prefix- and
+            # health-aware router — same stdin/stdout contract, requests
+            # spread (and failed over) across replicas (serve/router.py)
+            from .serve import ServeRouter
+            srv = ServeRouter(cfg, params,
+                              replicas=self.serve_replicas,
+                              policy=self.serve_router, **server_kw)
+        else:
+            srv = InferenceServer(cfg, params, **server_kw)
         if not self.silent:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (
@@ -994,7 +1028,7 @@ class LearnTask:
                     "%g MiB" % self.serve_prefix_mb
                     if self.serve_prefix_mb > 0 else "off")
                 if self.serve_paged:
-                    eng = srv._engine
+                    eng = (srv.servers[0] if routed else srv)._engine
                     mode += (", paged KV (%d blocks x %d tokens, "
                              "%.1f MiB, %s attention)"
                              % (eng.num_blocks, eng.block_size,
@@ -1003,11 +1037,17 @@ class LearnTask:
                                 else "gather"))
             else:
                 mode = "whole-prompt prefill, prefix cache off"
+            if self.serve_tp > 1:
+                mode += ", tp=%d (KV head-sharded)" % self.serve_tp
+            if routed:
+                mode += ", %d replicas (%s router)" % (
+                    self.serve_replicas, self.serve_router)
             if self.spec_mode != "off":
                 mode += ", speculative %s x%d" % (self.spec_mode,
                                                   self.spec_len)
-            if srv.fault_injector is not None:
-                mode += ", CHAOS armed (%s)" % srv.fault_injector.spec
+            inj = (srv.servers[0] if routed else srv).fault_injector
+            if inj is not None:
+                mode += ", CHAOS armed (%s)" % inj.spec
             if self.serve_watchdog_ms > 0:
                 mode += ", watchdog %.0f ms" % self.serve_watchdog_ms
             # through the leveled logger, not a bare stderr print: the
@@ -1062,7 +1102,11 @@ class LearnTask:
 
         try:
             es = contextlib.ExitStack()
-            es.enter_context(self._obs_run(srv.registry))
+            # telemetry export follows replica 0 when routed (one JSONL
+            # stream; the MERGED cross-replica payload is
+            # srv.metrics_text() — doc/observability.md)
+            es.enter_context(self._obs_run(
+                srv.servers[0].registry if routed else srv.registry))
             for line in sys.stdin:
                 line = line.strip()
                 if not line:
@@ -1085,7 +1129,22 @@ class LearnTask:
                 feed.notify()
             out_thread.join()
             m = srv.metrics()
-            if not self.silent:
+            if routed and not self.silent:
+                # aggregate summary: the per-replica detail lives in the
+                # merged scrape payload (metrics_text)
+                p95s = ", ".join(
+                    "%.1f" % r["ttft_ms"]["p95"] for r in m["replicas"])
+                profiler.log(
+                    "serve: %d ok / %d timeout / %d rejected over %d "
+                    "replicas (routed %s, %d affinity hits, %d "
+                    "failovers); ttft p95 per replica [%s] ms; %d "
+                    "tokens" % (m["requests"]["completed"],
+                                m["requests"]["timeout"],
+                                m["requests"]["rejected"],
+                                self.serve_replicas, m["routed"],
+                                m["affinity_hits"], m["failovers"],
+                                p95s, m["tokens_generated"]))
+            if not routed and not self.silent:
                 # gauge text follows the serving mode, so a legacy run
                 # reads "prefix cache off" instead of a misleading
                 # "prefix hit 0%" (disabled, not ineffective)

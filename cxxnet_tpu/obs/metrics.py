@@ -41,7 +41,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
-           "BYTES_BUCKETS", "default_registry"]
+           "BYTES_BUCKETS", "default_registry", "merged_prometheus"]
 
 
 def _log_spaced(lo: float, hi: float, per_decade: int) -> Tuple[float, ...]:
@@ -322,6 +322,29 @@ class _Family:
                 child._fn = fn
 
 
+def _render_histogram(out: List[str], name: str, labelnames, values,
+                      child: "Histogram") -> None:
+    """Append one histogram series (cumulative ``_bucket`` lines +
+    ``_sum``/``_count``) in the text exposition format — the ONE
+    renderer behind ``Registry.to_prometheus`` and both series kinds of
+    :func:`merged_prometheus` (a formatting fix applied here cannot
+    desynchronize the per-replica and aggregate renderings the merge
+    property compares)."""
+    lt = _labels_text(labelnames, values)
+    counts = child.counts()
+    cum = 0
+    for bound, c in zip(child.buckets, counts):
+        cum += c
+        out.append('%s_bucket%s %d' % (
+            name, _labels_text(labelnames, values,
+                               'le="%s"' % _fmt(bound)), cum))
+    cum += counts[-1]
+    out.append('%s_bucket%s %d' % (
+        name, _labels_text(labelnames, values, 'le="+Inf"'), cum))
+    out.append("%s_sum%s %s" % (name, lt, _fmt(child.sum)))
+    out.append("%s_count%s %d" % (name, lt, child.count))
+
+
 class Registry:
     """Get-or-create metric registry. Creating the same name twice with
     the same kind returns the SAME family (so two subsystems can share a
@@ -417,28 +440,13 @@ class Registry:
                 out.append("# HELP %s %s" % (fam.name, fam.help))
             out.append("# TYPE %s %s" % (fam.name, fam.kind))
             for values, child in fam.children():
-                lt = _labels_text(fam.labelnames, values)
                 if fam.kind in ("counter", "gauge"):
-                    out.append("%s%s %s" % (fam.name, lt,
-                                            _fmt(child.value)))
+                    out.append("%s%s %s" % (
+                        fam.name, _labels_text(fam.labelnames, values),
+                        _fmt(child.value)))
                     continue
-                counts = child.counts()
-                cum = 0
-                for bound, c in zip(child.buckets, counts):
-                    cum += c
-                    out.append('%s_bucket%s %d' % (
-                        fam.name,
-                        _labels_text(fam.labelnames, values,
-                                     'le="%s"' % _fmt(bound)),
-                        cum))
-                cum += counts[-1]
-                out.append('%s_bucket%s %d' % (
-                    fam.name,
-                    _labels_text(fam.labelnames, values, 'le="+Inf"'),
-                    cum))
-                out.append("%s_sum%s %s" % (fam.name, lt,
-                                            _fmt(child.sum)))
-                out.append("%s_count%s %d" % (fam.name, lt, child.count))
+                _render_histogram(out, fam.name, fam.labelnames, values,
+                                  child)
         return "\n".join(out) + ("\n" if out else "")
 
     def snapshot(self) -> Dict:
@@ -462,6 +470,69 @@ class Registry:
                                 "p95": child.percentile(0.95),
                                 "p99": child.percentile(0.99)}
         return out
+
+
+def merged_prometheus(registries: Dict[str, Registry],
+                      label: str = "replica") -> str:
+    """Cross-replica Prometheus exposition — the serve router's one
+    scrape payload (serve/router.py). ``registries`` maps a label value
+    (the replica index) to that replica's registry; the output keeps
+    every EXISTING metric name and label set, adds ``label=\"<value>\"``
+    to each per-replica series, and — for histograms — additionally
+    emits an AGGREGATE series (no replica label) built with
+    :meth:`Histogram.merge`, so the merged percentiles equal a single
+    histogram that observed the union of every replica's observations
+    (the fixed-bucket mergeability contract the module docstring
+    promises; pinned end-to-end in tests/test_obs.py). Counters and
+    gauges stay per-replica only: their cross-replica sum is one PromQL
+    ``sum by`` away, while a histogram's is not — merging buckets is
+    exactly what this function exists to do.
+
+    A name registered with different kinds/labels/buckets across
+    replicas is skipped with an exposition comment instead of rendering
+    a self-contradictory family (replicas are built from one config, so
+    this only fires on operator error)."""
+    out: List[str] = []
+    keys = sorted(registries)
+    names: List[str] = []
+    for k in keys:
+        for n in registries[k].names():
+            if n not in names:
+                names.append(n)
+    names.sort()
+    for name in names:
+        fams = [(k, registries[k].get(name)) for k in keys
+                if registries[k].get(name) is not None]
+        first = fams[0][1]
+        if any(f.kind != first.kind or f.labelnames != first.labelnames
+               or f._buckets != first._buckets for _, f in fams):
+            out.append("# %s skipped: kind/label/bucket mismatch "
+                       "across replicas" % name)
+            continue
+        if first.help:
+            out.append("# HELP %s %s" % (name, first.help))
+        out.append("# TYPE %s %s" % (name, first.kind))
+        lnames = first.labelnames + (label,)
+        agg: Dict[Tuple[str, ...], Histogram] = {}
+        for k, fam in fams:
+            for values, child in fam.children():
+                lvals = values + (k,)
+                if fam.kind in ("counter", "gauge"):
+                    out.append("%s%s %s" % (
+                        name, _labels_text(lnames, lvals),
+                        _fmt(child.value)))
+                    continue
+                _render_histogram(out, name, lnames, lvals, child)
+                a = agg.get(values)
+                if a is None:
+                    a = agg[values] = Histogram(child.buckets)
+                a.merge(child)
+        # the aggregate histogram series: same name, NO replica label —
+        # the union-of-observations payload
+        for values in sorted(agg):
+            _render_histogram(out, name, first.labelnames, values,
+                              agg[values])
+    return "\n".join(out) + ("\n" if out else "")
 
 
 _default = Registry()

@@ -9,7 +9,11 @@ across all active slots, and immediate retirement of finished sequences
 — so mixed-length traffic interleaves instead of convoying.
 
 Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
-(reference-style API), and CLI ``task = serve`` (cli.py).
+(reference-style API), and CLI ``task = serve`` (cli.py). Scale-out:
+``serve_tp`` shards one engine over a model-axis mesh (gather-form TP,
+bit-identical tokens — engine.py module docstring), and ``ServeRouter``
+(router.py) runs N engine replicas behind one prefix- and health-aware
+submit API with replay-based failover and merged metrics.
 """
 
 from .engine import (DecodeEngine, assert_fused_allclose, auto_num_blocks,
@@ -19,6 +23,7 @@ from .prefix_cache import PagedPrefixCache, PrefixCache
 from .resilience import (DegradationLadder, EngineFailedError,
                          FaultInjector, InjectedFault,
                          SwapCorruptionError)
+from .router import RouterHandle, ServeRouter
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      ServeResult)
@@ -31,4 +36,5 @@ __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "assert_fused_allclose", "AdmissionError", "QueueFullError",
            "NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
            "FaultInjector", "DegradationLadder", "InjectedFault",
-           "SwapCorruptionError", "EngineFailedError"]
+           "SwapCorruptionError", "EngineFailedError", "ServeRouter",
+           "RouterHandle"]

@@ -109,6 +109,34 @@ single-position dus/mask layout assumes one shared ``pos``. The measured
 fused-kernel batch amortization (ops/pallas_kernels.py) is the obvious
 next lever — a per-row-position variant is future work, noted in
 doc/serving.md.
+
+**Tensor-parallel serving** (``mesh`` with a > 1 ``model`` axis,
+doc/serving.md "Sharded & replicated serving"): the serve programs are
+partitioned by GSPMD in the GATHER form of megatron TP — the
+``fullc_gather`` descendant (parallel/sharding.py), not the psum form
+the pipelined trainer uses inside shard_map. Every block matmul weight
+is sharded on its OUTPUT dimension (w_qkv / w_proj / w_mlp1 / w_mlp2
+all 1/N per shard), the KV pool is sharded on the HEAD axis — axis 2
+of both the dense ``(L, slots, H, row, hd)`` and the paged ``(L,
+blocks, H, bs, hd)`` layout, so per-head K/V blocks live whole on one
+shard and the host-side block tables stay shard-agnostic — and the
+sharded activations are re-replicated (all-gather) at the block-math
+boundaries the engine already controls (the ``attn`` callbacks and the
+block body's ``reduce`` hook). The row/psum form would split the
+contraction of w_proj / w_mlp2 into per-shard partial sums whose f32
+accumulation order differs from the single-device dot; the gather form
+keeps every contraction whole on every shard, so collectives move data
+but never re-associate arithmetic — TP-sharded decode is BIT-IDENTICAL
+to the single-device engine (greedy and sampled), pinned by
+tests/test_serve_tp.py on the forced multi-device CPU mesh. Cost: one
+all-gather per matmul boundary (~4 per layer, plus the qkv-split
+reshards) and the embedding/LM head replicated; the fused paged-
+attention kernel is a Mosaic custom call GSPMD cannot partition, so
+tp > 1 pins the XLA gather-attention fallback (the support gate
+already evaluates the LOCAL head count ``n_head // tp``, so a future
+shard_map wrap only has to drop the pin). RecompileGuard signatures
+carry the mesh shape — the same program traced over two mesh shapes is
+two compiled executables and must count as such.
 """
 
 from __future__ import annotations
@@ -132,7 +160,8 @@ from .paged import BlockPoolExhausted
 from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
 __all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
-           "assert_fused_allclose"]
+           "assert_fused_allclose", "serve_param_shardings",
+           "serve_kv_sharding", "serve_tp_size"]
 
 
 def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
@@ -229,6 +258,75 @@ def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
     return slots * bpr + min(prefix_blocks, slots * bpr) + 1
 
 
+# ------------------------------------------------------------------ TP
+# Gather-form tensor parallelism for the serve programs (module
+# docstring): weights sharded on OUTPUT dims, KV pools on the head
+# axis, activations re-replicated at the boundaries below. The helpers
+# all degrade to identity with mesh=None, so the single-device programs
+# are byte-for-byte the ones this PR inherited.
+
+
+def serve_tp_size(mesh) -> int:
+    """The model-axis size of ``mesh`` (1 for None / no model axis) —
+    the one definition of "is this engine tensor-parallel"."""
+    if mesh is None:
+        return 1
+    from ..parallel.mesh import MODEL_AXIS
+    return int(mesh.shape.get(MODEL_AXIS, 1))
+
+
+def serve_param_shardings(mesh):
+    """NamedShardings for the engine's fused block dict + outer tree —
+    the gather form: every matmul weight sharded on its OUTPUT dim
+    (full contractions per shard — the bit-identity invariant), biases
+    sharded to match their matmul's output, LN params and the
+    embedding/head replicated. One table so the engine ctor, the
+    abstract (audit) engine, and tests cannot drift."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import MODEL_AXIS
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    rep = ns()
+    col = ns(None, None, MODEL_AXIS)        # (L, in, out): out sharded
+    vec = ns(None, MODEL_AXIS)              # (L, out) bias
+    blocks = {"w_qkv": col, "b_qkv": vec, "w_proj": col,
+              "w_mlp1": col, "b_mlp1": vec, "w_mlp2": col,
+              "ln1_g": rep, "ln1_b": rep, "ln2_g": rep, "ln2_b": rep,
+              "b_proj": rep, "b_mlp2": rep}
+    outer = {k: rep for k in ("emb", "pos", "lnf_g", "lnf_b", "head")}
+    return blocks, outer
+
+
+def serve_kv_sharding(mesh):
+    """The KV pool's NamedSharding: head axis (axis 2 of BOTH the dense
+    (L, slots, H, row, hd) and the paged (L, blocks, H, bs, hd)
+    layout) over the model axis, everything else replicated — per-head
+    K/V blocks live whole on one shard, and the host-side block tables
+    index physical blocks exactly as on one device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import MODEL_AXIS
+    return NamedSharding(mesh, P(None, None, MODEL_AXIS, None, None))
+
+
+def _tp_ops(mesh):
+    """``(gather, pin_kv)`` constraint hooks for one program build:
+    ``gather`` re-replicates an activation (an all-gather — pure data
+    movement, bit-exact; it doubles as the block body's ``reduce``
+    hook, constraining each output-sharded matmul product back to
+    replicated), ``pin_kv`` keeps a cache/pool head-sharded through
+    its scatter update (and pins the donated output's sharding to the
+    input's, so donation aliasing survives partitioning). Both are
+    identity with mesh=None."""
+    if mesh is None:
+        ident = lambda t: t
+        return ident, ident
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    kv = serve_kv_sharding(mesh)
+    gather = lambda t: lax.with_sharding_constraint(t, rep)
+    pin_kv = lambda t: lax.with_sharding_constraint(t, kv)
+    return gather, pin_kv
+
+
 def _attn_cached_rows(q, ck, cv, pos):
     """Per-row cached attention: q (b, 1, H, d) against head-major caches
     (b, H, S, d), each row masked at its OWN position ``pos`` (b,) —
@@ -248,14 +346,16 @@ def _attn_cached_rows(q, ck, cv, pos):
 
 
 @functools.lru_cache(maxsize=16)
-def _tick_fn(cfg_key: tuple, donate: bool):
+def _tick_fn(cfg_key: tuple, donate: bool, mesh=None):
     """Jitted batched decode tick for one model config — module-level and
     lru-cached (the models/gpt.py:_decode_fn idiom) so every server over
     the same config shares one compiled program; the slot count is a
-    traced dimension, not part of the key."""
+    traced dimension, not part of the key. ``mesh`` (part of the key —
+    two mesh shapes are two compiled programs) arms the gather-form TP
+    constraints; None leaves the program untouched."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
 
     def impl(blocks, outer, cache_k, cache_v, tok, pos, keys, fold, temp,
              top_k, top_p):
@@ -285,12 +385,13 @@ def _tick_fn(cfg_key: tuple, donate: bool):
                     lambda c, u, pp: lax.dynamic_update_slice(
                         c, u, (l, 0, pp, 0)),
                     in_axes=(1, 0, 0), out_axes=1)
-                ck = upd(cache_k, kh, pos)
-                cv = upd(cache_v, vh, pos)
-                return _attn_cached_rows(q, ck[l], cv[l], pos), (ck, cv)
+                ck = pin_kv(upd(cache_k, kh, pos))
+                cv = pin_kv(upd(cache_v, vh, pos))
+                return gather(_attn_cached_rows(q, ck[l], cv[l], pos)), \
+                    (ck, cv)
 
             h, (cache_k, cache_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (b, V)
         keys_t = jax.vmap(jax.random.fold_in)(keys, fold)
@@ -369,7 +470,7 @@ def _attn_chunk(q, ck, cv, start):
 
 
 @functools.lru_cache(maxsize=16)
-def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool):
+def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool, mesh=None):
     """Jitted chunk-prefill step: consume ``chunk`` tokens into a slot
     row starting at a traced offset ``start``, attending over the row's
     already-written cache — ONE compiled program serves every prompt
@@ -384,7 +485,7 @@ def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool):
     caches through xs->ys as a full copy per layer."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
     hd = cfg.feat // cfg.n_head
 
     def impl(blocks, outer, cache_k, cache_v, toks, slot, start, n_valid,
@@ -405,17 +506,17 @@ def _prefill_chunk_fn(cfg_key: tuple, chunk: int, donate: bool):
                 # attend the chunk's queries over the updated row
                 kh = jnp.transpose(k, (0, 2, 1, 3))[None]   # (1,1,H,C,d)
                 vh = jnp.transpose(v, (0, 2, 1, 3))[None]
-                ck = lax.dynamic_update_slice(cache_k, kh,
-                                              (l, slot, 0, start, 0))
-                cv = lax.dynamic_update_slice(cache_v, vh,
-                                              (l, slot, 0, start, 0))
+                ck = pin_kv(lax.dynamic_update_slice(
+                    cache_k, kh, (l, slot, 0, start, 0)))
+                cv = pin_kv(lax.dynamic_update_slice(
+                    cache_v, vh, (l, slot, 0, start, 0)))
                 size = (1, 1, cfg.n_head, row_len, hd)
                 row_k = lax.dynamic_slice(ck, (l, slot, 0, 0, 0), size)[0]
                 row_v = lax.dynamic_slice(cv, (l, slot, 0, 0, 0), size)[0]
-                return _attn_chunk(q, row_k, row_v, start), (ck, cv)
+                return gather(_attn_chunk(q, row_k, row_v, start)), (ck, cv)
 
             h, (cache_k, cache_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         last = lax.dynamic_slice_in_dim(h, n_valid - 1, 1, axis=1)
         hl = _layernorm(last, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
@@ -452,7 +553,7 @@ def _attn_verify(q, ck, cv, pos):
 
 
 @functools.lru_cache(maxsize=16)
-def _verify_fn(cfg_key: tuple, spec_len: int, donate: bool):
+def _verify_fn(cfg_key: tuple, spec_len: int, donate: bool, mesh=None):
     """Jitted draft-and-verify step (``serve_verify_chunk``): process
     ``spec_len + 1`` tokens — the row's last emitted token plus
     ``spec_len`` (padded) draft tokens — through the target model in ONE
@@ -485,7 +586,7 @@ def _verify_fn(cfg_key: tuple, spec_len: int, donate: bool):
     dus straight into the stacked caches — the tick/chunk idiom."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
     hd = cfg.feat // cfg.n_head
     rows = spec_len + 1
 
@@ -506,17 +607,17 @@ def _verify_fn(cfg_key: tuple, spec_len: int, donate: bool):
                 # then attend the queries over the updated row
                 kh = jnp.transpose(k, (0, 2, 1, 3))[None]   # (1,1,H,K+1,d)
                 vh = jnp.transpose(v, (0, 2, 1, 3))[None]
-                ck = lax.dynamic_update_slice(cache_k, kh,
-                                              (l, slot, 0, pos, 0))
-                cv = lax.dynamic_update_slice(cache_v, vh,
-                                              (l, slot, 0, pos, 0))
+                ck = pin_kv(lax.dynamic_update_slice(
+                    cache_k, kh, (l, slot, 0, pos, 0)))
+                cv = pin_kv(lax.dynamic_update_slice(
+                    cache_v, vh, (l, slot, 0, pos, 0)))
                 size = (1, 1, cfg.n_head, row_len, hd)
                 row_k = lax.dynamic_slice(ck, (l, slot, 0, 0, 0), size)[0]
                 row_v = lax.dynamic_slice(cv, (l, slot, 0, 0, 0), size)[0]
-                return _attn_verify(q, row_k, row_v, pos), (ck, cv)
+                return gather(_attn_verify(q, row_k, row_v, pos)), (ck, cv)
 
             h, (cache_k, cache_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[0] @ outer["head"].astype(hl.dtype)     # (K+1, V)
         # one fold index per candidate emitted token; greedy ignores keys
@@ -636,7 +737,7 @@ def _gather_rows(pool, table, n_head, bs):
 
 @functools.lru_cache(maxsize=16)
 def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
-                   fused: bool = False):
+                   fused: bool = False, mesh=None):
     """Paged batched decode tick: same math as ``_tick_fn`` with the
     per-row dus replaced by a block scatter and the cache row reads by a
     table gather. Parked rows scatter into whatever their table's last
@@ -657,7 +758,7 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
     flag is fixed at engine construction)."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
 
     def impl(blocks, outer, pool_k, pool_v, table, tok, pos, keys, fold,
              temp, top_k, top_p):
@@ -677,18 +778,18 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
                 # scatter each row's (H, d) K/V into its own block, then
                 # attend: fused = the Pallas block-table walk; gather =
                 # materialize the logical rows and reuse the dense math
-                pk = pool_k.at[l, blk, :, off, :].set(k[:, 0])
-                pv = pool_v.at[l, blk, :, off, :].set(v[:, 0])
+                pk = pin_kv(pool_k.at[l, blk, :, off, :].set(k[:, 0]))
+                pv = pin_kv(pool_v.at[l, blk, :, off, :].set(v[:, 0]))
                 if fused:
                     from ..ops.pallas_kernels import paged_attention
                     return paged_attention(q, pk, pv, table, pos, l,
                                            bs), (pk, pv)
                 ck = _gather_rows(pk[l], table, cfg.n_head, bs)
                 cv = _gather_rows(pv[l], table, cfg.n_head, bs)
-                return _attn_cached_rows(q, ck, cv, pos), (pk, pv)
+                return gather(_attn_cached_rows(q, ck, cv, pos)), (pk, pv)
 
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (b, V)
         keys_t = jax.vmap(jax.random.fold_in)(keys, fold)
@@ -700,7 +801,7 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
 
 @functools.lru_cache(maxsize=16)
 def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
-                            bpr: int, donate: bool):
+                            bpr: int, donate: bool, mesh=None):
     """Paged chunk-prefill step: ``_prefill_chunk_fn``'s math with the
     row dus/slice replaced by a per-position block scatter and a table
     gather. The caller (engine.reserve_window) has already allocated —
@@ -708,7 +809,7 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
     so the scatter only ever lands in blocks this row owns alone."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
 
     def impl(blocks, outer, pool_k, pool_v, table, toks, start, n_valid,
              key, temp, top_k, top_p):
@@ -728,14 +829,15 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
             p = {k: w[l] for k, w in blocks.items()}
 
             def attn(q, k, v, l=l):
-                pk = pool_k.at[l, blkw, :, offw, :].set(k[0])
-                pv = pool_v.at[l, blkw, :, offw, :].set(v[0])
+                pk = pin_kv(pool_k.at[l, blkw, :, offw, :].set(k[0]))
+                pv = pin_kv(pool_v.at[l, blkw, :, offw, :].set(v[0]))
                 row_k = _gather_row(pk[l], table, cfg.n_head, bs)
                 row_v = _gather_row(pv[l], table, cfg.n_head, bs)
-                return _attn_chunk(q, row_k, row_v, start), (pk, pv)
+                return gather(_attn_chunk(q, row_k, row_v, start)), \
+                    (pk, pv)
 
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         last = lax.dynamic_slice_in_dim(h, n_valid - 1, 1, axis=1)
         hl = _layernorm(last, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
@@ -749,7 +851,7 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
 
 @functools.lru_cache(maxsize=16)
 def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
-                     donate: bool, fused: bool = False):
+                     donate: bool, fused: bool = False, mesh=None):
     """Paged draft-and-verify step: ``_verify_fn``'s math over block
     scatter/gather. All K+1 candidate positions were reserved (and
     COW-privatized) before dispatch, which is exactly why a rejected
@@ -763,7 +865,7 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
     scatter and the accept/emit logic are untouched."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    identity = lambda t: t
+    gather, pin_kv = _tp_ops(mesh)
     rows = spec_len + 1
 
     def impl(blocks, outer, pool_k, pool_v, table, toks, pos, n_draft,
@@ -777,8 +879,8 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
             p = {k: w[l] for k, w in blocks.items()}
 
             def attn(q, k, v, l=l):
-                pk = pool_k.at[l, blkw, :, offw, :].set(k[0])
-                pv = pool_v.at[l, blkw, :, offw, :].set(v[0])
+                pk = pin_kv(pool_k.at[l, blkw, :, offw, :].set(k[0]))
+                pv = pin_kv(pool_v.at[l, blkw, :, offw, :].set(v[0]))
                 if fused:
                     from ..ops.pallas_kernels import paged_attention
                     return paged_attention(
@@ -786,10 +888,11 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
                         jnp.reshape(pos, (1,)), l, bs), (pk, pv)
                 row_k = _gather_row(pk[l], table, cfg.n_head, bs)
                 row_v = _gather_row(pv[l], table, cfg.n_head, bs)
-                return _attn_verify(q, row_k, row_v, pos), (pk, pv)
+                return gather(_attn_verify(q, row_k, row_v, pos)), \
+                    (pk, pv)
 
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, identity)
+                p, h, cfg.n_head, attn, gather)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[0] @ outer["head"].astype(hl.dtype)     # (K+1, V)
         folds = fold + jnp.arange(rows)
@@ -877,7 +980,7 @@ class DecodeEngine:
                  recompile_strict: bool = True, abstract: bool = False,
                  spec_len: int = 0, obs_registry=None,
                  num_blocks: int = 0, block_size: int = 0,
-                 injector=None, fused_attn: bool = True):
+                 injector=None, fused_attn: bool = True, mesh=None):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -893,12 +996,38 @@ class DecodeEngine:
         OFF on unsupported backends/geometries (the XLA gather
         formulation then runs, bit-reference semantics), and
         ``CXN_FUSED_ATTN=0`` force-disables it process-wide. The
-        resolved state is ``self.fused_attn``."""
+        resolved state is ``self.fused_attn``.
+
+        ``mesh`` (a ``jax.sharding.Mesh`` whose ``model`` axis is > 1)
+        arms gather-form tensor-parallel serving (module docstring):
+        weights sharded on output dims, the KV pool on the head axis,
+        decode bit-identical to the single-device engine. Requires
+        chunked prefill and ``n_head`` divisible by the model-axis
+        size. A mesh WITHOUT a > 1 model axis is placement-only: the
+        single-device programs run untouched, but the engine's params
+        and caches are committed to that mesh's device — how the
+        router places replica i on its own device block instead of
+        every replica defaulting onto device 0."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
             raise ValueError("feat %d not divisible by n_head %d"
                              % (cfg.feat, cfg.n_head))
+        self.tp = serve_tp_size(mesh)
+        self.mesh = mesh if self.tp > 1 else None
+        if self.tp > 1:
+            if cfg.n_head % self.tp:
+                raise ValueError(
+                    "serve_tp: n_head %d must be divisible by the "
+                    "model-axis size %d (the KV pool shards whole "
+                    "heads)" % (cfg.n_head, self.tp))
+            if int(prefill_chunk) <= 0:
+                raise ValueError(
+                    "serve_tp requires chunked prefill "
+                    "(serve_prefill_chunk > 0): the legacy whole-"
+                    "prompt prefill compiles one program per prompt "
+                    "length, which a sharded engine must not multiply "
+                    "by mesh shapes")
         if prefill_chunk < 0:
             raise ValueError("serve_prefill_chunk must be >= 0 "
                              "(0 = whole-prompt prefill), got %d"
@@ -951,6 +1080,42 @@ class DecodeEngine:
                         if abstract else _fuse_qkv_blocks(params["blocks"]))
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
                                               "lnf_b", "head")}
+        if self.tp > 1:
+            # gather-form TP placement (module docstring): weights on
+            # their output-dim shardings, embedding/head replicated. An
+            # abstract (audit-only) engine attaches the SAME shardings
+            # to ShapeDtypeStructs, so the AOT audit lowers exactly the
+            # partitioned programs a real TP engine runs.
+            bsh, osh = serve_param_shardings(self.mesh)
+            if abstract:
+                self._blocks = {
+                    k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                            sharding=bsh[k])
+                    for k, v in self._blocks.items()}
+                self._outer = {
+                    k: jax.ShapeDtypeStruct(jnp.shape(v),
+                                            jnp.result_type(v),
+                                            sharding=osh[k])
+                    for k, v in self._outer.items()}
+            else:
+                self._blocks = {k: jax.device_put(v, bsh[k])
+                                for k, v in self._blocks.items()}
+                self._outer = {k: jax.device_put(v, osh[k])
+                               for k, v in self._outer.items()}
+        elif mesh is not None and not abstract:
+            # placement-only mesh (model axis 1): commit the weights to
+            # the mesh's device so this engine computes there — jit
+            # follows its committed inputs, no program change
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._blocks = jax.device_put(self._blocks, rep)
+            self._outer = jax.device_put(self._outer, rep)
+        # RecompileGuard signatures carry the mesh shape: the same
+        # program traced over two mesh shapes is two compiled
+        # executables, and the guard must count it as such
+        self._sig_suffix = ("/mesh=%s" % "x".join(
+            str(s) for s in self.mesh.devices.shape)) if self.tp > 1 \
+            else ""
         hd = cfg.feat // cfg.n_head
         if self.paged:
             self.bpr = self.row_len // self.block_size
@@ -958,12 +1123,18 @@ class DecodeEngine:
             # supports the kernel (TPU, or interpret mode under test) —
             # anything else keeps the gather formulation, so a CPU test
             # mesh and an odd geometry degrade silently to the
-            # bit-reference path instead of failing to compile
+            # bit-reference path instead of failing to compile. Under
+            # TP the gate sees the LOCAL head count (each shard holds
+            # n_head / tp whole heads), but tp > 1 currently PINS the
+            # gather fallback regardless: the kernel is a Mosaic custom
+            # call GSPMD cannot partition — the shard_map wrap that
+            # would run it per-shard is the noted follow-up, and only
+            # has to drop the tp == 1 term below
             from ..ops.pallas_kernels import paged_attention_supported
             self.fused_attn = bool(fused_attn) and \
                 paged_attention_supported(
-                    cfg.n_head, self.bpr, self.block_size, hd,
-                    2 if cfg.dtype == "bfloat16" else 4)
+                    cfg.n_head // self.tp, self.bpr, self.block_size, hd,
+                    2 if cfg.dtype == "bfloat16" else 4) and self.tp == 1
             shape = (cfg.n_layer, self.num_blocks, cfg.n_head,
                      self.block_size, hd)
             # host-side bookkeeping (free list, refcounts, tables);
@@ -977,13 +1148,28 @@ class DecodeEngine:
             self.manager = None
             self.fused_attn = False
             shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
+        kv_sh = serve_kv_sharding(self.mesh) if self.tp > 1 else None
+        if kv_sh is None and mesh is not None and not abstract:
+            # placement-only mesh: the caches live with the weights
+            from jax.sharding import NamedSharding, PartitionSpec
+            kv_sh = NamedSharding(mesh, PartitionSpec())
         if abstract:
             # audit-only engine (tools/cxn_lint.py --compile): the cache
             # leaves are ShapeDtypeStructs, so lint_specs can AOT-lower
             # every program without allocating a single device byte;
             # prefill/tick calls on such an engine are a usage error
-            self.cache_k = jax.ShapeDtypeStruct(shape, self.dtype)
-            self.cache_v = jax.ShapeDtypeStruct(shape, self.dtype)
+            self.cache_k = jax.ShapeDtypeStruct(shape, self.dtype,
+                                                sharding=kv_sh)
+            self.cache_v = jax.ShapeDtypeStruct(shape, self.dtype,
+                                                sharding=kv_sh)
+        elif kv_sh is not None:
+            # head-sharded pool: each shard holds n_head / tp whole
+            # heads of every block/row — 1/tp of the KV bytes per chip,
+            # the serving-memory lever TP exists for
+            self.cache_k = jax.device_put(jnp.zeros(shape, self.dtype),
+                                          kv_sh)
+            self.cache_v = jax.device_put(jnp.zeros(shape, self.dtype),
+                                          kv_sh)
         else:
             self.cache_k = jnp.zeros(shape, self.dtype)
             self.cache_v = jnp.zeros(shape, self.dtype)
@@ -1052,9 +1238,11 @@ class DecodeEngine:
     def _count_program(self, sig: str) -> None:
         """Register one prefill/chunk program fetch with the guard; the
         signature string carries the drifting dimension's name, so a
-        CXN205 trip reads e.g. \"leaf 0: 'n_prompt=17' -> 'n_prompt=23'\"."""
+        CXN205 trip reads e.g. \"leaf 0: 'n_prompt=17' -> 'n_prompt=23'\".
+        A TP engine's signatures additionally carry the mesh shape
+        (``/mesh=1x1x1x1x2``): two mesh shapes are two executables."""
         if self._guard is not None:
-            self._guard(sig)
+            self._guard(sig + self._sig_suffix)
 
     @property
     def prefill_signatures(self) -> tuple:
@@ -1108,7 +1296,8 @@ class DecodeEngine:
             specs = [
                 ("serve_prefill_chunk",
                  _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
-                                         self.block_size, self.bpr, don),
+                                         self.block_size, self.bpr, don,
+                                         mesh=self.mesh),
                  chunk_args, nums)]
             if self.spec_len:
                 verify_args = (self._blocks, self._outer, self.cache_k,
@@ -1121,7 +1310,7 @@ class DecodeEngine:
                     ("serve_verify_chunk",
                      _verify_paged_fn(self._cfg_key, self.spec_len,
                                       self.block_size, self.bpr, don,
-                                      self.fused_attn),
+                                      self.fused_attn, mesh=self.mesh),
                      verify_args, nums))
             tick_args = (self._blocks, self._outer, self.cache_k,
                          self.cache_v, SDS((b, self.bpr), i32),
@@ -1131,21 +1320,26 @@ class DecodeEngine:
             specs.append(
                 ("serve_tick",
                  _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                don, self.fused_attn), tick_args, nums))
+                                don, self.fused_attn, mesh=self.mesh),
+                 tick_args, nums))
             return specs
-        prefill_args = (self._blocks, self._outer, self.cache_k,
-                        self.cache_v, SDS((1, n_prompt), i32),
-                        SDS((), i32), key, SDS((), f32), SDS((), i32),
-                        SDS((), f32))
         tick_args = (self._blocks, self._outer, self.cache_k, self.cache_v,
                      SDS((b,), i32), SDS((b,), i32),
                      SDS((b, 2), jnp.uint32), SDS((b,), i32),
                      SDS((b,), f32), SDS((b,), i32), SDS((b,), f32))
-        specs = [
-            ("serve_prefill",
-             _prefill_fn(self._cfg_key, n_prompt, self.row_len, don),
-             prefill_args, nums),
-        ]
+        specs = []
+        if self.tp == 1:
+            # the legacy whole-prompt admit is single-device-only (a TP
+            # engine mandates chunked prefill — see the ctor), so a
+            # sharded audit must not lower an unsharded lookalike
+            prefill_args = (self._blocks, self._outer, self.cache_k,
+                            self.cache_v, SDS((1, n_prompt), i32),
+                            SDS((), i32), key, SDS((), f32), SDS((), i32),
+                            SDS((), f32))
+            specs.append(
+                ("serve_prefill",
+                 _prefill_fn(self._cfg_key, n_prompt, self.row_len, don),
+                 prefill_args, nums))
         if self.chunk:
             chunk_args = (self._blocks, self._outer, self.cache_k,
                           self.cache_v, SDS((1, self.chunk), i32),
@@ -1153,7 +1347,8 @@ class DecodeEngine:
                           SDS((), f32), SDS((), i32), SDS((), f32))
             specs.append(
                 ("serve_prefill_chunk",
-                 _prefill_chunk_fn(self._cfg_key, self.chunk, don),
+                 _prefill_chunk_fn(self._cfg_key, self.chunk, don,
+                                   mesh=self.mesh),
                  chunk_args, nums))
         if self.spec_len:
             verify_args = (self._blocks, self._outer, self.cache_k,
@@ -1163,10 +1358,12 @@ class DecodeEngine:
                            SDS((), f32))
             specs.append(
                 ("serve_verify_chunk",
-                 _verify_fn(self._cfg_key, self.spec_len, don),
+                 _verify_fn(self._cfg_key, self.spec_len, don,
+                            mesh=self.mesh),
                  verify_args, nums))
         specs.append(
-            ("serve_tick", _tick_fn(self._cfg_key, don), tick_args, nums))
+            ("serve_tick", _tick_fn(self._cfg_key, don, mesh=self.mesh),
+             tick_args, nums))
         return specs
 
     def cache_bytes(self) -> int:
@@ -1244,12 +1441,12 @@ class DecodeEngine:
                                                        self.bpr))
             fn = _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
                                          self.block_size, self.bpr,
-                                         self._donate)
+                                         self._donate, mesh=self.mesh)
             args = (jnp.asarray(m.table[slot]),)
         else:
             self._count_program("chunk=%d" % self.chunk)
             fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
-                                   self._donate)
+                                   self._donate, mesh=self.mesh)
             args = ()
         t0 = self._prof.begin("serve_prefill_chunk") \
             if self._prof is not None else None
@@ -1302,16 +1499,19 @@ class DecodeEngine:
             if self._vguard is not None:
                 # NB the counted signature string deliberately does NOT
                 # carry the fused/gather flag: it is fixed at engine
-                # construction, not traffic-driven drift
-                self._vguard("spec_len=%d/table=%d" % (k, self.bpr))
+                # construction, not traffic-driven drift (the mesh
+                # shape rides along — see _count_program)
+                self._vguard("spec_len=%d/table=%d%s"
+                             % (k, self.bpr, self._sig_suffix))
             fn = _verify_paged_fn(self._cfg_key, k, self.block_size,
                                   self.bpr, self._donate,
-                                  self.fused_attn)
+                                  self.fused_attn, mesh=self.mesh)
             args = (jnp.asarray(m.table[slot]),)
         else:
             if self._vguard is not None:
-                self._vguard("spec_len=%d" % k)
-            fn = _verify_fn(self._cfg_key, k, self._donate)
+                self._vguard("spec_len=%d%s" % (k, self._sig_suffix))
+            fn = _verify_fn(self._cfg_key, k, self._donate,
+                            mesh=self.mesh)
             args = ()
         t0 = self._prof.begin("serve_verify_chunk") \
             if self._prof is not None else None
@@ -1382,12 +1582,14 @@ class DecodeEngine:
             if self._tguard is not None:
                 # fused/gather is NOT in the counted signature (fixed at
                 # construction; only traffic-driven drift should count)
-                self._tguard("slots=%d/table=%d" % (self.slots, self.bpr))
+                self._tguard("slots=%d/table=%d%s"
+                             % (self.slots, self.bpr, self._sig_suffix))
             fn = _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                self._donate, self.fused_attn)
+                                self._donate, self.fused_attn,
+                                mesh=self.mesh)
             args = (jnp.asarray(self.manager.table),)
         else:
-            fn = _tick_fn(self._cfg_key, self._donate)
+            fn = _tick_fn(self._cfg_key, self._donate, mesh=self.mesh)
             args = ()
         t0 = self._prof.begin("serve_tick") \
             if self._prof is not None else None

@@ -1,0 +1,525 @@
+"""Replicated serving: N data-parallel engine replicas behind one
+prefix- and health-aware front door (doc/serving.md "Sharded &
+replicated serving").
+
+One :class:`~cxxnet_tpu.serve.server.InferenceServer` is one engine —
+one scheduler thread, one KV pool, one prefix trie, one failure domain.
+The :class:`ServeRouter` runs ``replicas`` of them over the SAME
+``(cfg, params)`` export (the TensorFlow paper's replicated-dataflow
+regime, arxiv 1605.08695; each replica may itself be TP-sharded over a
+mesh — ``tp`` in ``server_kw`` composes) and keeps the single-server
+submit/result surface:
+
+* **routing** weighs prefix-cache AFFINITY against load: the router
+  keeps a chunk-granular fingerprint trie of the prompts it sent to
+  each replica (crc32 of each chunk-aligned prefix — a hash hit can
+  only misroute, never corrupt, so fingerprints beat storing tokens),
+  and scores candidates by longest-prefix match first, then by the
+  health-derived load signal (``health()``: degradation rung +
+  admission-queue fraction — exactly the gauges ``cxn_serve_state`` /
+  ``cxn_serve_degrade_rung`` export). Same-prefix traffic converges on
+  the replica whose KV trie already holds the prefix (the zero-copy hit
+  serves from shared blocks), while an overloaded or degraded replica
+  sheds new traffic to its peers. ``policy="rr"`` replaces the scoring
+  with plain round-robin (the A/B baseline).
+
+* **failover** reuses PR 9's replay machinery verbatim: every live
+  request is tracked in a :class:`~cxxnet_tpu.serve.resilience
+  .ReplayJournal`; when a replica goes FAILED (restart budget
+  exhausted), each of its in-flight requests is rewound with
+  :func:`~cxxnet_tpu.serve.resilience.reset_for_replay` — the greedy
+  token prefix it already emitted becomes the ``replay_expect`` pin —
+  and re-admitted on a healthy replica via
+  :meth:`~cxxnet_tpu.serve.server.InferenceServer.adopt`. The
+  deterministic per-request ``fold_in`` key schedule makes the
+  regenerated stream bit-identical (greedy; sampled resumes on the
+  pinned schedule), and the survivor's ``_emit`` verifies the pin token
+  by token — a divergent replay fails typed, never silently. The
+  caller's handle never changes: :meth:`result` chases the migration.
+
+* **drain** is the same path run deliberately: :meth:`drain_replica`
+  stops routing to a replica, abort-stops it, and migrates its live
+  requests to the survivors — live-request migration as a maintenance
+  verb, not just a failure response.
+
+* **observability**: :meth:`metrics_text` is ONE scrape payload —
+  every per-replica ``cxn_serve_*`` series gains a ``replica=`` label
+  (names unchanged), and the latency histograms additionally emit an
+  aggregate series merged with ``Histogram.merge`` (fixed log-spaced
+  buckets, so the merged payload equals the union of per-replica
+  observations — the property obs/metrics.py was built for, pinned in
+  tests/test_obs.py).
+
+Thread-safety: the router's own state (tries, journal, handle map,
+routing counters) is lock-guarded; each replica keeps its own internal
+discipline. ``submit``/``result`` may be called from any thread, like
+the single server's.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from .resilience import (STATE_DRAINING, STATE_FAILED, EngineFailedError,
+                         ReplayJournal, reset_for_replay)
+from .scheduler import Request, SamplingParams
+from .server import AdmissionError, InferenceServer, QueueFullError
+
+__all__ = ["ServeRouter", "RouterHandle"]
+
+
+class RouterHandle:
+    """The router's request handle: stable across migrations. ``req``
+    points at the CURRENT replica-owned Request (re-pointed by a
+    failover/drain migration); ``rid`` is the process-unique request
+    id, shared by every incarnation."""
+
+    __slots__ = ("prompt", "params", "req", "replica", "migrations")
+
+    def __init__(self, req: Request, replica: int):
+        self.prompt = req.prompt
+        self.params = req.params
+        self.req = req
+        self.replica = replica
+        self.migrations = 0
+
+    @property
+    def rid(self) -> int:
+        return self.req.rid
+
+
+class _AffinityTrie:
+    """Chunk-granular prompt-prefix fingerprints for ONE replica: crc32
+    of every chunk-aligned prefix of every prompt routed there, LRU-
+    bounded. ``match`` returns the longest chunk-aligned prefix (in
+    tokens) this replica has seen — the router's affinity score. A
+    crc collision can only inflate a score (misroute one request);
+    nothing downstream trusts it, so fingerprints beat storing token
+    tuples at O(n^2) bytes per prompt."""
+
+    def __init__(self, chunk: int, cap: int = 4096):
+        self.chunk = max(1, int(chunk))
+        self.cap = int(cap)
+        self._keys: "collections.OrderedDict" = collections.OrderedDict()
+
+    def _crcs(self, prompt):
+        # running crc over successive chunks: crc32(p[:end]) chained as
+        # crc32(chunk, prev) — identical values to hashing each prefix
+        # from scratch, but O(n) bytes total instead of O(n^2) per
+        # note/match call (this runs per candidate replica per submit)
+        p = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        crc = 0
+        for end in range(self.chunk, p.size + 1, self.chunk):
+            crc = zlib.crc32(p[end - self.chunk:end].tobytes(), crc)
+            yield end, crc
+
+    def note(self, prompt) -> None:
+        for _, crc in self._crcs(prompt):
+            self._keys[crc] = None
+            self._keys.move_to_end(crc)
+        while len(self._keys) > self.cap:
+            self._keys.popitem(last=False)
+
+    def match(self, prompt) -> int:
+        n = 0
+        for end, crc in self._crcs(prompt):
+            if crc not in self._keys:
+                break
+            self._keys.move_to_end(crc)
+            n = end
+        return n
+
+
+class ServeRouter:
+    """N engine replicas behind one submit/result API (module
+    docstring). ``server_kw`` is forwarded to every replica's
+    :class:`InferenceServer` (slots, prefill_chunk, paged, spec, tp,
+    chaos, ... — ``chaos`` may also be a per-replica sequence, which is
+    how the chaos tests kill exactly one replica). Each replica owns
+    its metrics registry; passing ``registry`` is rejected — scrape
+    the merged payload via :meth:`metrics_text`."""
+
+    def __init__(self, cfg, params, *, replicas: int = 2,
+                 policy: str = "prefix", affinity_cap: int = 4096,
+                 chaos: Union[str, Sequence[str]] = "", **server_kw):
+        if replicas < 1:
+            raise ValueError("serve_replicas must be >= 1, got %d"
+                             % replicas)
+        if policy not in ("prefix", "rr"):
+            raise ValueError("serve_router policy must be 'prefix' or "
+                             "'rr', got %r" % (policy,))
+        if "registry" in server_kw:
+            raise ValueError("ServeRouter replicas own their registries "
+                             "(per-replica label sets); scrape the "
+                             "merged payload via metrics_text()")
+        if isinstance(chaos, str):
+            chaos_list = [chaos] * replicas
+        else:
+            chaos_list = list(chaos)
+            if len(chaos_list) != replicas:
+                raise ValueError(
+                    "per-replica chaos spec list has %d entries for %d "
+                    "replicas" % (len(chaos_list), replicas))
+        self.policy = policy
+        chunk = int(server_kw.get("prefill_chunk", 64)) or 64
+        # per-replica device placement: with enough local devices for
+        # disjoint blocks, replica i serves from devices
+        # [i*tp, (i+1)*tp) — its own mesh (tensor-parallel when tp > 1,
+        # placement-only otherwise), so N replicas actually occupy N
+        # device blocks instead of all defaulting onto device 0. With
+        # fewer devices the replicas share (the CPU CI regime, where
+        # one core backs everything anyway); an explicit ``mesh`` in
+        # server_kw is respected verbatim for every replica.
+        if "mesh" not in server_kw:
+            import jax as _jax
+
+            from ..parallel.mesh import make_mesh
+            tp = int(server_kw.pop("tp", 0) or 0)
+            need = max(1, tp)
+            devs = _jax.devices()
+            if len(devs) >= replicas * need:
+                srv_args = [dict(server_kw, mesh=make_mesh(
+                    devices=devs[i * need:(i + 1) * need],
+                    model_parallel=need)) for i in range(replicas)]
+            else:
+                srv_args = [dict(server_kw, tp=tp)] * replicas
+        else:
+            srv_args = [dict(server_kw)] * replicas
+        self._servers: List[InferenceServer] = []
+        try:
+            for i in range(replicas):
+                self._servers.append(InferenceServer(
+                    cfg, params, chaos=chaos_list[i], **srv_args[i]))
+        except Exception:
+            for s in self._servers:
+                s.shutdown(drain=False)
+            raise
+        self._lock = threading.RLock()
+        self._tries = [_AffinityTrie(chunk, affinity_cap)
+                       for _ in range(replicas)]
+        self._routable = [True] * replicas
+        self._swept = [False] * replicas
+        # rid -> current Request / RouterHandle: the router's OWN
+        # replay journal (PR 9's class — the conftest leak check sees
+        # it, so a router that abandons admitted requests fails tests
+        # the same way a server would)
+        self._journal = ReplayJournal()
+        self._handles: Dict[int, RouterHandle] = {}
+        self._rr = itertools.count()
+        self.routed = [0] * replicas        # submits sent to replica i
+        self.affinity_hits = 0              # routed by a prefix match
+        self.failovers = 0                  # failed-replica migrations
+        self.drain_migrations = 0           # drain-initiated migrations
+
+    # ------------------------------------------------------------ routing
+    @property
+    def replicas(self) -> int:
+        return len(self._servers)
+
+    @property
+    def servers(self) -> List[InferenceServer]:
+        """The replica servers (read-only use: tests, metrics)."""
+        return list(self._servers)
+
+    def _load(self, i: int) -> float:
+        """The health-derived load signal: admission-queue fraction
+        plus the degradation rung (a DEGRADED replica is shedding
+        optional work — new traffic belongs on its peers first)."""
+        s = self._servers[i]
+        h = s.health()
+        return (h["queue_depth"] / float(max(1, s.queue_capacity))
+                + h["rung"])
+
+    def _candidates(self, exclude=()) -> List[int]:
+        out = []
+        for i, s in enumerate(self._servers):
+            if i in exclude or not self._routable[i]:
+                continue
+            if s.health()["state"] in (STATE_FAILED, STATE_DRAINING):
+                continue
+            out.append(i)
+        return out
+
+    def _route(self, prompt, exclude=()) -> Optional[int]:
+        """Pick a replica for ``prompt`` (None = nobody healthy).
+        Policy "prefix": longest affinity match wins, load breaks ties
+        (and decides for cold prompts); "rr": round-robin over the
+        healthy set."""
+        cands = self._candidates(exclude)
+        if not cands:
+            return None
+        if self.policy == "rr" or len(cands) == 1:
+            return cands[next(self._rr) % len(cands)]
+        scored = []
+        for i in cands:
+            scored.append((-self._tries[i].match(prompt), self._load(i),
+                           i))
+        scored.sort()
+        best = scored[0]
+        if -best[0] > 0:
+            self.affinity_hits += 1
+        return best[2]
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               block: bool = False, **overrides) -> RouterHandle:
+        """Route one request to a replica; returns a RouterHandle for
+        :meth:`result`. A replica answering with backpressure
+        (QueueFullError) spills to the next-best healthy replica; the
+        error is re-raised only when EVERY healthy replica refuses.
+        Raises EngineFailedError when no healthy replica remains."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._sweep_failed()
+        tried: set = set()
+        last_err: Optional[Exception] = None
+        while True:
+            with self._lock:
+                idx = self._route(prompt, exclude=tried)
+            if idx is None:
+                if isinstance(last_err, AdmissionError):
+                    raise last_err
+                raise EngineFailedError(
+                    "no healthy replica left to route to (%d replicas: "
+                    "failed/draining/refusing)" % len(self._servers))
+            try:
+                req = self._servers[idx].submit(prompt, params,
+                                                block=block, **overrides)
+            except QueueFullError as e:
+                tried.add(idx)
+                last_err = e
+                continue
+            except EngineFailedError as e:
+                tried.add(idx)
+                last_err = e
+                self._sweep_failed()
+                continue
+            except AdmissionError as e:
+                # a replica that started draining/closing between the
+                # routing decision and the submit refuses with a plain
+                # AdmissionError — spill to a peer like backpressure. A
+                # VALIDATION rejection (bad prompt/params) re-raises:
+                # every replica would refuse it for the same reason,
+                # and retrying elsewhere only masks the message.
+                if self._servers[idx].health()["state"] \
+                        != STATE_DRAINING:
+                    raise
+                tried.add(idx)
+                last_err = e
+                continue
+            handle = RouterHandle(req, idx)
+            with self._lock:
+                self._tries[idx].note(prompt)
+                self.routed[idx] += 1
+                self._journal.add(req)
+                self._handles[req.rid] = handle
+            return handle
+
+    def result(self, handle: RouterHandle, timeout=None):
+        """Block for the handle's terminal ServeResult, chasing
+        failover/drain migrations: a request whose replica died (typed
+        ``error`` from a FAILED engine) or was drained out from under
+        it (``cancelled`` by a replica the router took out of rotation)
+        is replayed on a survivor and this call keeps waiting on the
+        new incarnation — the caller never sees the intermediate
+        failure. A waiter that wakes DURING drain_replica (the abort
+        resolves its request before the drain's own migration sweep
+        runs) migrates the request itself; _failover's lock + the
+        replica-changed check make the two paths race-safe (whoever
+        gets the lock first migrates, the other chases)."""
+        while True:
+            req, idx = handle.req, handle.replica
+            res = self._servers[idx].result(req, timeout=timeout)
+            if handle.req is not req:
+                continue                    # migrated while we waited
+            if res.status == "error" \
+                    and self._servers[idx].health()["state"] \
+                    == STATE_FAILED and self._failover(handle, idx):
+                continue
+            if res.status == "cancelled" and not self._routable[idx] \
+                    and self._failover(handle, idx):
+                continue                    # drained out from under us
+            with self._lock:
+                self._journal.remove(handle.req)
+                self._handles.pop(handle.req.rid, None)
+            return res
+
+    # ----------------------------------------------------------- failover
+    def _rewind(self, req: Request) -> Request:
+        """A fresh Request carrying everything a bit-exact replay needs
+        (serve/resilience.py): prompt, params (seed included), and the
+        emitted-token prefix as the ``replay_expect`` pin."""
+        new = Request(req.rid, req.prompt, req.params, req.submit_t)
+        new.tokens = list(req.tokens)
+        new.replay_expect = req.replay_expect
+        reset_for_replay(new)
+        return new
+
+    def _failover(self, handle: RouterHandle, from_idx: int) -> bool:
+        """Migrate one live request off ``from_idx`` (failed or
+        draining). False = nowhere to go (the caller returns the typed
+        error)."""
+        with self._lock:
+            if handle.replica != from_idx \
+                    or handle.migrations >= len(self._servers):
+                return handle.replica != from_idx
+            target = self._route(handle.prompt, exclude={from_idx})
+            if target is None:
+                return False
+            new = self._rewind(handle.req)
+            try:
+                self._servers[target].adopt(new)
+            except (AdmissionError, EngineFailedError):
+                return False
+            self._journal.remove(handle.req)
+            self._journal.add(new)
+            self._handles.pop(handle.req.rid, None)
+            self._handles[new.rid] = handle
+            handle.req = new
+            handle.replica = target
+            handle.migrations += 1
+            self._tries[target].note(handle.prompt)
+            self.failovers += 1
+            return True
+
+    def _sweep_failed(self) -> None:
+        """Proactively migrate every live handle off a replica that
+        went FAILED (its _finalize already resolved them all with the
+        typed error — terminal, so the rewind pin is complete). Waiters
+        inside result() would migrate lazily anyway; the sweep covers
+        handles nobody is waiting on yet."""
+        with self._lock:
+            stale = [i for i, s in enumerate(self._servers)
+                     if not self._swept[i]
+                     and s.health()["state"] == STATE_FAILED]
+            victims = [(i, h) for i in stale
+                       for h in list(self._handles.values())
+                       if h.replica == i]
+            for i in stale:
+                self._swept[i] = True
+        for i, h in victims:
+            if h.req.done.is_set() and h.req.status == "error":
+                self._failover(h, i)
+
+    def drain_replica(self, idx: int, migrate: bool = True) -> int:
+        """Take replica ``idx`` out of rotation and migrate its live
+        requests to the survivors (the deliberate-maintenance twin of
+        failover). The replica is abort-stopped — its in-flight work
+        resolves ``cancelled`` — and every router-tracked request is
+        replayed elsewhere from its journal pin. Returns the number of
+        requests migrated."""
+        if not 0 <= idx < len(self._servers):
+            raise ValueError("no replica %d (have %d)"
+                             % (idx, len(self._servers)))
+        with self._lock:
+            self._routable[idx] = False
+            victims = [h for h in self._handles.values()
+                       if h.replica == idx]
+        self._servers[idx].shutdown(drain=False)
+        moved = 0
+        if migrate:
+            for h in victims:
+                # only requests the ABORT interrupted are replayed:
+                # 'cancelled' (the abort's own status) and 'error'. A
+                # request that already reached 'ok'/'timeout'/'shed'
+                # keeps its terminal outcome — resurrecting a timed-out
+                # request would re-run it with its deadline stripped.
+                if h.req.done.is_set() \
+                        and h.req.status in ("cancelled", "error") \
+                        and self._failover(h, idx):
+                    moved += 1
+                    with self._lock:
+                        # re-attributed under the lock: a waiter's
+                        # concurrent _failover increments race here
+                        self.drain_migrations += 1
+                        self.failovers -= 1
+        return moved
+
+    # ------------------------------------------------------------ surface
+    def health(self) -> Dict:
+        """Aggregate + per-replica health: ``state`` is SERVING while
+        any routable replica serves, DEGRADED when every survivor is
+        degraded, FAILED when none is left."""
+        per = [s.health() for s in self._servers]
+        live = [h for i, h in enumerate(per)
+                if self._routable[i]
+                and h["state"] not in (STATE_FAILED, STATE_DRAINING)]
+        if not live:
+            state = STATE_FAILED
+        elif all(h["state"] == "DEGRADED" for h in live):
+            state = "DEGRADED"
+        else:
+            state = "SERVING"
+        return {"state": state, "replicas": per,
+                "routable": list(self._routable),
+                "failovers": self.failovers,
+                "drain_migrations": self.drain_migrations}
+
+    def metrics(self) -> Dict:
+        """Aggregate serving snapshot: summed request counters and
+        token counts, per-replica snapshots, and the router's own
+        routing/failover accounting."""
+        per = [s.metrics() for s in self._servers]
+        counts: Dict[str, int] = {}
+        for m in per:
+            for k, v in m["requests"].items():
+                counts[k] = counts.get(k, 0) + v
+        return {
+            "requests": counts,
+            "tokens_generated": sum(m["tokens_generated"] for m in per),
+            "ticks": sum(m["ticks"] for m in per),
+            "routed": list(self.routed),
+            "affinity_hits": self.affinity_hits,
+            "failovers": self.failovers,
+            "drain_migrations": self.drain_migrations,
+            "replicas": per,
+        }
+
+    def metrics_text(self) -> str:
+        """The merged Prometheus scrape payload: per-replica series
+        labeled ``replica=``, histograms additionally aggregated via
+        ``Histogram.merge`` (obs/metrics.py:merged_prometheus)."""
+        return obs_metrics.merged_prometheus(
+            {str(i): s.registry for i, s in enumerate(self._servers)})
+
+    def reset_metrics(self) -> None:
+        """Zero the measurement window on every replica AND the
+        router's own routing/failover accounting, so a post-reset
+        snapshot is internally consistent (bench warm-pass
+        isolation)."""
+        for s in self._servers:
+            s.reset_metrics()
+        with self._lock:
+            self.routed = [0] * len(self._servers)
+            self.affinity_hits = 0
+            self.failovers = 0
+            self.drain_migrations = 0
+
+    def drain(self, timeout=None) -> None:
+        """Finish everything in flight on every replica, then stop
+        (shutdown(drain=True) — the single server's contract)."""
+        self.shutdown(drain=True, timeout=timeout)
+
+    def shutdown(self, drain: bool = True, timeout=None) -> None:
+        """Stop every replica (idempotent); ``drain=True`` finishes
+        queued + in-flight work first."""
+        for s in self._servers:
+            s.shutdown(drain=drain, timeout=timeout)
+        with self._lock:
+            self._journal.clear()
+            self._handles.clear()
+
+    def close(self) -> None:
+        self.shutdown(drain=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=not any(exc))

@@ -143,7 +143,8 @@ class InferenceServer:
                  block_size: int = 0, num_blocks: int = 0,
                  kv_mb: float = 0.0, fused_attn: bool = True,
                  chaos: str = "", max_restarts: int = 3,
-                 watchdog_ms: float = 0.0, degrade: bool = True):
+                 watchdog_ms: float = 0.0, degrade: bool = True,
+                 tp: int = 0, mesh=None):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -222,7 +223,18 @@ class InferenceServer:
         prefix-cache admission, then sheds deadline-doomed queued
         requests with ``retry_after_ms`` hints; :meth:`health` and the
         ``cxn_serve_state`` gauge surface SERVING / DEGRADED /
-        DRAINING / FAILED."""
+        DRAINING / FAILED.
+
+        Tensor-parallel serving (doc/serving.md "Sharded & replicated
+        serving"): ``tp`` > 1 builds a ``model``-axis mesh over the
+        first ``tp`` local devices and shards the decode engine across
+        it — weights on their output dims, the KV pool on the head
+        axis, served tokens bit-identical to the single-device engine
+        (gather-form TP, serve/engine.py module docstring). Requires
+        chunked prefill and ``n_head`` divisible by ``tp``; the fused
+        paged-attention kernel resolves to the gather fallback under
+        TP. Pass ``mesh`` to serve over an explicit pre-built mesh
+        instead (``tp`` is then ignored)."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -275,6 +287,21 @@ class InferenceServer:
         self._recover_lock = threading.RLock()
         self._heartbeat = time.perf_counter()
         self._parked = False            # loop idle-parked (watchdog skips)
+        if mesh is None and tp and int(tp) > 1:
+            import jax as _jax
+
+            from ..parallel.mesh import make_mesh
+            devs = _jax.devices()
+            if len(devs) < int(tp):
+                raise ValueError(
+                    "serve_tp=%d needs %d devices, found %d (on CPU, "
+                    "set XLA_FLAGS=--xla_force_host_platform_device_"
+                    "count=%d before jax initializes)"
+                    % (tp, tp, len(devs), tp))
+            mesh = make_mesh(devices=devs[:int(tp)],
+                             model_parallel=int(tp))
+        from .engine import serve_tp_size
+        self._tp = serve_tp_size(mesh)
         nb = 0
         if self._paged:
             from .engine import auto_num_blocks
@@ -290,7 +317,7 @@ class InferenceServer:
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, prefix_mb=prefix_mb,
             nb=nb, block_size=block_size, prof_every=prof_every,
-            fused_attn=bool(fused_attn))
+            fused_attn=bool(fused_attn), mesh=mesh)
         self._prefill_budget = int(prefill_budget)
         # device/compiler observatory (obs/devprof.py): compile-time
         # accounting always (this registry becomes a CompileWatch sink,
@@ -367,7 +394,8 @@ class InferenceServer:
             obs_registry=self._registry,
             num_blocks=b["nb"],
             block_size=b["block_size"] if self._paged else 0,
-            injector=self._inj, fused_attn=b["fused_attn"])
+            injector=self._inj, fused_attn=b["fused_attn"],
+            mesh=b["mesh"])
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             if self._paged:
@@ -512,6 +540,8 @@ class InferenceServer:
                  "since start/reset", lambda: self._queue_depth_max)
         cb_gauge("cxn_serve_slots", "KV slot-pool size",
                  lambda: self._engine.slots)
+        cb_gauge("cxn_serve_tp", "tensor-parallel shard count of the "
+                 "decode engine (1 = single device)", lambda: self._tp)
         cb_gauge("cxn_serve_slot_occupancy", "occupied slot fraction",
                  sc.occupancy)
         cb_gauge("cxn_serve_batch_efficiency", "mean fraction of slot "
@@ -642,9 +672,42 @@ class InferenceServer:
     def slots(self) -> int:
         return self._engine.slots
 
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel shard count of the decode engine (1 =
+        single-device)."""
+        return self._tp
+
+    @property
+    def queue_capacity(self) -> int:
+        """The admission queue bound (the router's load-signal
+        denominator)."""
+        return self._queue_cap
+
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def adopt(self, req: Request) -> None:
+        """Admit an EXISTING Request object — the router's failover /
+        drain migration path (serve/router.py): the request was rewound
+        with :func:`~cxxnet_tpu.serve.resilience.reset_for_replay` (its
+        verified greedy prefix pinned in ``replay_expect``), and this
+        server regenerates it through the normal admit path exactly
+        like PR 9's single-node replay. Migrations bypass the queue cap
+        (the request already held — and lost — capacity on another
+        replica) and count into ``cxn_replayed_requests_total``."""
+        with self._cond:
+            if self._failed is not None:
+                raise EngineFailedError(str(self._failed))
+            if self._closing:
+                raise AdmissionError("server is shutting down")
+            self._queue.append(req)
+            self._counts["submitted"] += 1
+            self._replayed += 1
+            self._queue_depth_max = max(self._queue_depth_max,
+                                        len(self._queue))
+            self._cond.notify_all()
 
     def _reject(self, reason: str) -> None:
         """Count + raise an unservable-request rejection, so the
@@ -1439,6 +1502,7 @@ class InferenceServer:
             "ticks": sc.ticks,
             "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
+            "tp": self._tp,
             "kv_cache_bytes": self._engine.cache_bytes(),
             # device-memory ledger snapshot (obs/devprof.py): predicted
             # bytes per pool vs the measured jax.live_arrays() total

@@ -197,7 +197,9 @@ class Net:
                     num_blocks: int = 0, kv_mb: float = 0.0,
                     fused_attn: bool = True, chaos: str = "",
                     max_restarts: int = 3, watchdog_ms: float = 0.0,
-                    degrade: bool = True, **defaults) -> None:
+                    degrade: bool = True, tp: int = 0,
+                    replicas: int = 1, router_policy: str = "prefix",
+                    **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -249,26 +251,54 @@ class Net:
         ``degrade`` the graceful-degradation ladder (spec off ->
         prefix admission off -> deadline-aware shedding with
         ``retry_after_ms`` hints); :meth:`serve_health` reports
-        SERVING / DEGRADED / DRAINING / FAILED."""
+        SERVING / DEGRADED / DRAINING / FAILED.
+
+        Sharded & replicated serving (doc/serving.md): ``tp`` > 1
+        shards the decode engine over a model-axis mesh of the first
+        ``tp`` local devices (gather-form TP — KV pool head-sharded,
+        weights on their output dims, served tokens bit-identical to
+        the single-device engine; needs ``n_head % tp == 0`` and
+        chunked prefill). ``replicas`` > 1 runs that many engine
+        replicas behind the prefix- and health-aware router
+        (serve/router.py; ``router_policy`` ∈ prefix | rr) — submit /
+        result / metrics / health keep working, failover replays live
+        requests on survivors, and :meth:`metrics_text` becomes the
+        merged per-replica scrape payload."""
         from .nnet.lm import net_gpt_export
-        from .serve import InferenceServer, SamplingParams
+        from .serve import InferenceServer, SamplingParams, ServeRouter
         if getattr(self, "_server", None) is not None:
             raise RuntimeError("serve_start: server already running "
                                "(call serve_stop first)")
         if isinstance(spec_model, Net):
             spec_model = net_gpt_export(spec_model._net)
         cfg, params = net_gpt_export(self._net)
-        self._server = InferenceServer(
-            cfg, params, slots=slots, queue=queue, timeout_ms=timeout_ms,
+        kw = dict(
+            slots=slots, queue=queue, timeout_ms=timeout_ms,
             prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
             prefix_mb=prefix_mb, recompile_limit=recompile_limit,
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, slow_ms=slow_ms,
-            tracer=tracer, registry=registry, prof_every=prof_every,
+            tracer=tracer, prof_every=prof_every,
             paged=paged, block_size=block_size, num_blocks=num_blocks,
             kv_mb=kv_mb, fused_attn=fused_attn, chaos=chaos,
             max_restarts=max_restarts, watchdog_ms=watchdog_ms,
-            degrade=degrade, defaults=SamplingParams(**defaults))
+            degrade=degrade, tp=tp,
+            defaults=SamplingParams(**defaults))
+        if replicas > 1:
+            # each replica owns its registry; the merged payload is
+            # metrics_text() (a caller-supplied registry would make the
+            # replicas' gauges fight over one name set) — surface the
+            # conflict instead of silently leaving the registry empty
+            if registry is not None:
+                raise ValueError(
+                    "serve_start(replicas=%d, registry=...): replicas "
+                    "own their registries; scrape the merged payload "
+                    "via metrics_text()" % replicas)
+            self._server = ServeRouter(cfg, params, replicas=replicas,
+                                       policy=router_policy, **kw)
+        else:
+            self._server = InferenceServer(cfg, params,
+                                           registry=registry, **kw)
 
     def _serving(self):
         srv = getattr(self, "_server", None)

@@ -201,6 +201,90 @@ def test_histogram_merge_equals_combined():
         a.merge(Histogram(buckets=(1.0, 2.0)))
 
 
+def test_merged_prometheus_union_and_replica_labels():
+    """obs/metrics.py:merged_prometheus — the router's scrape payload:
+    per-replica series gain a replica= label under the UNCHANGED metric
+    names, and every histogram additionally emits an aggregate series
+    whose buckets equal one histogram that observed the union of the
+    replicas' observations (Histogram.merge end to end)."""
+    from cxxnet_tpu.obs.metrics import Registry, merged_prometheus
+    rs = np.random.RandomState(3)
+    regs = {str(i): Registry() for i in range(2)}
+    union = Histogram()
+    for i, reg in enumerate(regs.values()):
+        reg.counter("cxn_serve_completed_total", "done").inc(10 + i)
+        reg.gauge("cxn_serve_queue_depth", "depth").set(i)
+        h = reg.histogram("cxn_serve_ttft_seconds", "ttft")
+        ph = reg.histogram("cxn_serve_phase_seconds", "phases",
+                           labelnames=("phase",))
+        for x in rs.exponential(0.01 * (i + 1), 50):
+            h.observe(x)
+            union.observe(x)
+            ph.labels("decode_tick").observe(x)
+    txt = merged_prometheus(regs)
+    # per-replica series under the original names
+    assert 'cxn_serve_completed_total{replica="0"} 10' in txt
+    assert 'cxn_serve_completed_total{replica="1"} 11' in txt
+    assert 'cxn_serve_queue_depth{replica="1"} 1' in txt
+    assert ('cxn_serve_phase_seconds_count{phase="decode_tick",'
+            'replica="0"} 50') in txt
+    # the aggregate histogram equals the union of observations: its
+    # rendered bucket lines match a single all-observing histogram's
+    one = Registry()
+    agg = one.histogram("cxn_serve_ttft_seconds", "ttft")
+    agg.merge(union)
+    want = [l for l in one.to_prometheus().splitlines()
+            if l.startswith("cxn_serve_ttft_seconds_bucket{le=")]
+    got = [l for l in txt.splitlines()
+           if l.startswith("cxn_serve_ttft_seconds_bucket{le=")]
+    assert got == want
+    assert "cxn_serve_ttft_seconds_count 100" in txt
+    # a kind mismatch across replicas is skipped loudly, not rendered
+    regs["0"].counter("cxn_oops_total")
+    regs["1"].gauge("cxn_oops_total")
+    txt2 = merged_prometheus(regs)
+    assert "cxn_oops_total skipped" in txt2
+
+
+def test_router_merged_payload_equals_union_of_replicas():
+    """End-to-end: a 2-replica ServeRouter's metrics_text() aggregate
+    TTFT histogram equals the union of the replicas' observations, and
+    the per-replica cxn_serve_* series carry replica= labels without
+    breaking any existing scrape name."""
+    import jax
+
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.obs.metrics import Histogram as H
+    from cxxnet_tpu.serve import ServeRouter
+    cfg = GPTConfig(vocab_size=32, seq_len=32, n_layer=1, n_head=2,
+                    feat=8, n_microbatch=1)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(5)
+    with ServeRouter(cfg, params, replicas=2, slots=2, queue=8,
+                     prefill_chunk=4) as rt:
+        hs = [rt.submit(rs.randint(0, 32, (n,)).astype(np.int32),
+                        max_tokens=4) for n in (5, 9, 3, 7)]
+        for h in hs:
+            assert rt.result(h, timeout=300).status == "ok"
+        txt = rt.metrics_text()
+        union = H()
+        per = 0
+        for s in rt.servers:
+            child = s.registry.get("cxn_serve_ttft_seconds").default
+            union.merge(child)
+            per += child.count
+    # aggregate series == union of the two replicas' observations
+    assert per == 4
+    assert "cxn_serve_ttft_seconds_count %d" % union.count in txt
+    assert ("cxn_serve_ttft_seconds_sum %s"
+            % ("%r" % union.sum if union.sum != int(union.sum)
+               else str(int(union.sum)))) in txt
+    # every replica serves under its own label, names unchanged
+    for i in range(2):
+        assert 'cxn_serve_state{replica="%d"} 0' % i in txt
+        assert 'cxn_serve_tp{replica="%d"} 1' % i in txt
+
+
 def test_histogram_percentile_bucket_resolution_and_empty():
     h = Histogram()
     assert h.percentile(0.5) == 0.0     # empty window -> 0, not NaN

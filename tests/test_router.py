@@ -1,0 +1,324 @@
+"""Replicated serving router (serve/router.py): prefix- and health-
+aware routing, chaos-kill failover with bit-identical replay on
+survivors, drain as live-request migration, and the merged metrics
+surface. The obs-side merge property (router payload == union of
+per-replica observations) is pinned in tests/test_obs.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (EngineFailedError, InferenceServer,
+                              QueueFullError, ServeRouter)
+from cxxnet_tpu.serve.resilience import STATE_FAILED
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, temperature=0.0, seed=0):
+    rng = jax.random.PRNGKey(seed) if temperature > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 temperature=temperature, rng=rng))[0]
+
+
+KW = dict(slots=2, queue=16, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm_programs():
+    """Compile the serve programs once (module-level lru caches)."""
+    rs = np.random.RandomState(99)
+    with InferenceServer(CFG, PARAMS, **KW) as srv:
+        h = srv.submit(_prompt(rs, 6), max_tokens=4)
+        assert srv.result(h, timeout=300).status == "ok"
+
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="replicas"):
+        ServeRouter(CFG, PARAMS, replicas=0, **KW)
+    with pytest.raises(ValueError, match="policy"):
+        ServeRouter(CFG, PARAMS, replicas=2, policy="best", **KW)
+    with pytest.raises(ValueError, match="registries"):
+        ServeRouter(CFG, PARAMS, replicas=2, registry=object(), **KW)
+    with pytest.raises(ValueError, match="chaos"):
+        ServeRouter(CFG, PARAMS, replicas=2, chaos=("a", "b", "c"), **KW)
+
+
+def test_router_identity_and_spread():
+    """Mixed traffic over 2 replicas: every stream equals the solo
+    oracle (the replicas serve the same export) and both replicas see
+    work."""
+    rs = np.random.RandomState(0)
+    jobs = [(_prompt(rs, n), 5) for n in (6, 11, 3, 17, 7, 9)]
+    refs = [_ref(p, m) for p, m in jobs]
+    with ServeRouter(CFG, PARAMS, replicas=2, **KW) as rt:
+        hs = [rt.submit(p, max_tokens=m) for p, m in jobs]
+        for (p, m), h, r in zip(jobs, hs, refs):
+            res = rt.result(h, timeout=300)
+            assert res.status == "ok"
+            assert np.array_equal(res.tokens, r)
+        assert sum(rt.routed) == len(jobs)
+        assert all(n > 0 for n in rt.routed)
+        m = rt.metrics()
+        assert m["requests"]["completed"] == len(jobs)
+        assert m["failovers"] == 0
+
+
+def test_router_prefix_affinity_converges():
+    """Two distinct shared-prefix families: once a family's first
+    request lands somewhere, the rest of the family follows it (the
+    replica whose paged trie holds the prefix serves the zero-copy
+    hit)."""
+    rs = np.random.RandomState(1)
+    fam_a = _prompt(rs, 12)
+    fam_b = _prompt(rs, 12)
+    with ServeRouter(CFG, PARAMS, replicas=2, **KW) as rt:
+        homes = {}
+        for fam, key in ((fam_a, "a"), (fam_b, "b")):
+            for i in range(3):
+                p = np.concatenate([fam, _prompt(rs, 2 + i)])
+                h = rt.submit(p, max_tokens=4)
+                assert rt.result(h, timeout=300).status == "ok"
+                homes.setdefault(key, []).append(h.replica)
+        # each family converges on one replica after its first request
+        for key, seen in homes.items():
+            assert len(set(seen[1:])) == 1, homes
+        assert rt.affinity_hits >= 4
+        # and the affinity actually fed the paged prefix cache: the
+        # home replica's trie served hit tokens for the family
+        hits = sum(s.metrics()["prefix_cache"]["hit_tokens"]
+                   for s in rt.servers)
+        assert hits > 0
+
+
+def test_router_rr_policy_round_robins():
+    rs = np.random.RandomState(2)
+    with ServeRouter(CFG, PARAMS, replicas=2, policy="rr", **KW) as rt:
+        hs = [rt.submit(_prompt(rs, 6), max_tokens=3) for _ in range(6)]
+        for h in hs:
+            assert rt.result(h, timeout=300).status == "ok"
+        assert rt.routed == [3, 3]
+        assert rt.affinity_hits == 0
+
+
+def test_router_failover_chaos_kill_bit_identical_and_monotone():
+    """The acceptance pin: a replica chaos-killed mid-stream (restart
+    budget 0 -> FAILED) has its in-flight requests replayed on the
+    survivor with greedy streams bit-identical to the fault-free
+    oracle, and the aggregate counters stay monotone."""
+    rs = np.random.RandomState(3)
+    jobs = [(_prompt(rs, n), 8) for n in (6, 11, 3, 17, 7, 9)]
+    refs = [_ref(p, m) for p, m in jobs]
+    with ServeRouter(CFG, PARAMS, replicas=2, max_restarts=0,
+                     chaos=("tick_raise@4", ""), **KW) as rt:
+        before = rt.metrics()["requests"]
+        hs = [rt.submit(p, max_tokens=m) for p, m in jobs]
+        for (p, m), h, r in zip(jobs, hs, refs):
+            res = rt.result(h, timeout=300)
+            assert res.status == "ok", (res.status, res.error)
+            assert np.array_equal(res.tokens, r), (res.tokens, r)
+        after = rt.metrics()["requests"]
+        assert rt.failovers > 0
+        assert rt.servers[0].health()["state"] == STATE_FAILED
+        # monotone aggregates: nothing went backwards, every submitted
+        # request reached ok on SOME replica exactly once
+        for k in after:
+            assert after[k] >= before[k], (k, before, after)
+        assert after["completed"] == len(jobs)
+        # the survivor's replay counter saw the migrations
+        assert rt.servers[1].metrics()["resilience"]["replayed"] \
+            == rt.failovers
+        # new submissions keep working, routed onto the survivor
+        h = rt.submit(jobs[0][0], max_tokens=4)
+        assert h.replica == 1
+        assert rt.result(h, timeout=300).status == "ok"
+        # router health: degraded fleet but still serving
+        assert rt.health()["state"] == "SERVING"
+
+
+def test_router_failover_preserves_sampled_schedule():
+    """A sampled request migrated mid-stream resumes on the pinned
+    fold_in schedule: with speculation off its tokens equal the solo
+    sampled oracle even across the kill."""
+    rs = np.random.RandomState(4)
+    jobs = [(_prompt(rs, 7), 8, dict(temperature=0.8, seed=i))
+            for i in range(4)]
+    refs = [_ref(p, m, temperature=0.8, seed=ov["seed"])
+            for p, m, ov in jobs]
+    with ServeRouter(CFG, PARAMS, replicas=2, max_restarts=0,
+                     chaos=("tick_raise@3", ""), **KW) as rt:
+        hs = [rt.submit(p, max_tokens=m, **ov) for p, m, ov in jobs]
+        for h, r in zip(hs, refs):
+            res = rt.result(h, timeout=300)
+            assert res.status == "ok"
+            assert np.array_equal(res.tokens, r)
+
+
+def test_router_drain_migrates_live_requests():
+    rs = np.random.RandomState(5)
+    jobs = [(_prompt(rs, 9), 24) for _ in range(4)]
+    refs = [_ref(p, m) for p, m in jobs]
+    with ServeRouter(CFG, PARAMS, replicas=2, **KW) as rt:
+        hs = [rt.submit(p, max_tokens=m) for p, m in jobs]
+        victims = [h for h in hs if h.replica == 0]
+        moved = rt.drain_replica(0)
+        assert moved == len([h for h in victims])
+        assert rt.drain_migrations == moved
+        for h, r in zip(hs, refs):
+            res = rt.result(h, timeout=300)
+            assert res.status == "ok"
+            assert np.array_equal(res.tokens, r)
+        # replica 0 is out of rotation: everything new lands on 1
+        h = rt.submit(jobs[0][0], max_tokens=3)
+        assert h.replica == 1
+        assert rt.result(h, timeout=300).status == "ok"
+        assert rt.health()["routable"] == [False, True]
+
+
+def test_router_replicas_on_disjoint_device_blocks():
+    """With enough local devices, replica i's engine lives on its own
+    device block — tp=1 replicas get one device each (placement-only
+    mesh), tp=2 replicas get disjoint 2-device meshes — so an N-device
+    rig actually runs N engines in parallel instead of stacking them
+    on device 0."""
+    rs = np.random.RandomState(8)
+    for tp in (0, 2):
+        with ServeRouter(CFG, PARAMS, replicas=2, tp=tp, **KW) as rt:
+            devs = [frozenset(s._engine.cache_k.devices())
+                    for s in rt.servers]
+            assert devs[0].isdisjoint(devs[1]), (tp, devs)
+            assert all(len(d) == max(1, tp) for d in devs)
+            h = rt.submit(_prompt(rs, 6), max_tokens=4)
+            res = rt.result(h, timeout=300)
+            assert res.status == "ok"
+            assert np.array_equal(res.tokens, _ref(h.prompt, 4))
+
+
+def test_router_drain_migrates_under_active_waiters():
+    """The drain race: callers already blocked in result() while
+    drain_replica aborts their replica must get the MIGRATED outcome
+    (bit-identical tokens from the survivor), never the intermediate
+    'cancelled' the abort resolves their first incarnation with."""
+    import threading
+    rs = np.random.RandomState(9)
+    jobs = [(_prompt(rs, 9), 24) for _ in range(4)]
+    refs = [_ref(p, m) for p, m in jobs]
+    with ServeRouter(CFG, PARAMS, replicas=2, **KW) as rt:
+        hs = [rt.submit(p, max_tokens=m) for p, m in jobs]
+        out = [None] * len(hs)
+
+        def wait(i, h):
+            out[i] = rt.result(h, timeout=300)
+
+        ths = [threading.Thread(target=wait, args=(i, h))
+               for i, h in enumerate(hs)]
+        for t in ths:
+            t.start()
+        rt.drain_replica(0)
+        for t in ths:
+            t.join(300)
+        for res, r in zip(out, refs):
+            assert res is not None and res.status == "ok", res
+            assert np.array_equal(res.tokens, r)
+
+
+def test_router_all_replicas_failed_is_typed():
+    rs = np.random.RandomState(6)
+    with ServeRouter(CFG, PARAMS, replicas=2, max_restarts=0,
+                     chaos=("tick_raise@1", "tick_raise@1"), **KW) as rt:
+        hs = [rt.submit(_prompt(rs, 6), max_tokens=6) for _ in range(2)]
+        # both engines die on their first tick; with no survivor the
+        # typed error surfaces instead of a hang
+        res = [rt.result(h, timeout=300) for h in hs]
+        assert all(r.status == "error" for r in res)
+        assert rt.health()["state"] == STATE_FAILED
+        with pytest.raises(EngineFailedError):
+            rt.submit(_prompt(rs, 5), max_tokens=3)
+
+
+def test_cli_task_serve_replicated_tp(tmp_path, capfd, monkeypatch):
+    """task=serve with serve_replicas=2 AND serve_tp=2 — the full
+    composition through the CLI: outputs in submission order and
+    token-identical to task=generate on the same snapshot, router
+    summary on stderr."""
+    import io as _io
+
+    from cxxnet_tpu.cli import LearnTask
+    from cxxnet_tpu.models import gpt_lm_config
+
+    corpus = tmp_path / "corpus.bin"
+    toks = np.tile(np.arange(16, dtype=np.uint16), 40)
+    corpus.write_bytes(toks.tobytes())
+    conf = tmp_path / "gpt.conf"
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=8, dev="cpu:0", eta=0.2)
+    conf.write_text("""
+data = train
+iter = lm
+    path_data = "%s"
+    token_dtype = uint16
+    seq_len = 16
+    stride = 8
+iter = end
+%s
+num_round = 1
+save_model = 1
+model_dir = %s
+""" % (corpus, cfg, tmp_path / "models"))
+    assert LearnTask().run([str(conf)]) == 0
+    model = tmp_path / "models" / "0001.model"
+
+    prompts = tmp_path / "p.txt"
+    gen_out = tmp_path / "g.txt"
+    want = []
+    for line in ("0 1 2 3", "4 5 6 7 8"):
+        prompts.write_text(line + "\n")
+        assert LearnTask().run([
+            str(conf), "task=generate", "model_in=%s" % model,
+            "prompt_file=%s" % prompts, "num_gen=4",
+            "generate_out=%s" % gen_out]) == 0
+        want.append(gen_out.read_text().split())
+    capfd.readouterr()
+
+    monkeypatch.setattr("sys.stdin",
+                        _io.StringIO("0 1 2 3\n4 5 6 7 8\n"))
+    assert LearnTask().run([
+        str(conf), "task=serve", "model_in=%s" % model, "num_gen=4",
+        "serve_slots=2", "serve_queue=4", "serve_prefill_chunk=4",
+        "serve_replicas=2", "serve_tp=2"]) == 0
+    out, err = capfd.readouterr()
+    rows = [l.split() for l in out.strip().splitlines()
+            if l and l[0].isdigit()]
+    assert rows == want
+    assert "2 replicas (prefix router)" in err
+    assert "tp=2" in err
+    assert "over 2 replicas" in err
+
+
+def test_router_queue_full_spills_to_peer():
+    """Backpressure on the preferred replica spills the submit to the
+    other one instead of bouncing the client."""
+    rs = np.random.RandomState(7)
+    fam = _prompt(rs, 8)
+    with ServeRouter(CFG, PARAMS, replicas=2, slots=1, queue=1,
+                     prefill_chunk=4) as rt:
+        # pin the family onto replica A, then flood it: affinity says A
+        # but A's queue of 1 fills — later submits must land on B, and
+        # only when BOTH queues are full does QueueFullError surface
+        hs = []
+        with pytest.raises(QueueFullError):
+            for i in range(12):
+                hs.append(rt.submit(
+                    np.concatenate([fam, _prompt(rs, 2)]), max_tokens=16))
+        assert len(set(h.replica for h in hs)) == 2
+        for h in hs:
+            assert rt.result(h, timeout=300).status == "ok"

@@ -1,0 +1,255 @@
+"""Tensor-parallel serving (gather-form TP, serve/engine.py module
+docstring; doc/serving.md "Sharded & replicated serving").
+
+The acceptance matrix on the forced multi-device CPU mesh
+(tests/conftest.py): TP-sharded decode is BIT-IDENTICAL to the
+single-device engine and to solo ``gpt_decode`` — greedy AND sampled,
+since the gather form never splits a contraction — across chunked
+prefill, prefix hits, recycled slots, speculative decoding, and paged
+preemption/swap; the step audit sees the head-axis KV pool shardings
+and zero all-reduces with donation aliasing intact; RecompileGuard
+signatures carry the mesh shape; and the fused paged-attention kernel
+pins the gather fallback under TP (the support gate evaluates the
+LOCAL head count), with ``CXN_FUSED_ATTN=0`` still a no-op.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.parallel.mesh import make_mesh
+from cxxnet_tpu.serve import DecodeEngine, InferenceServer
+from cxxnet_tpu.serve.engine import (serve_kv_sharding,
+                                     serve_param_shardings,
+                                     serve_tp_size)
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+
+def _mesh(tp=2):
+    return make_mesh(devices=jax.devices()[:tp], model_parallel=tp)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, temperature=0.0, seed=0, **kw):
+    rng = jax.random.PRNGKey(seed) if temperature > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 temperature=temperature, rng=rng,
+                                 **kw))[0]
+
+
+def _serve_all(srv, jobs):
+    """jobs: [(prompt, max_tokens, overrides)] -> token arrays, order
+    preserved; every request must finish ok."""
+    hs = [srv.submit(p, max_tokens=m, **ov) for p, m, ov in jobs]
+    out = []
+    for h in hs:
+        r = srv.result(h, timeout=300)
+        assert r.status == "ok", (r.status, r.error)
+        out.append(r.tokens)
+    return out
+
+
+# ------------------------------------------------------------ validation
+def test_tp_requires_divisible_heads_and_chunking():
+    cfg3 = GPTConfig(vocab_size=32, seq_len=32, n_layer=1, n_head=3,
+                     feat=18, n_microbatch=1)
+    with pytest.raises(ValueError, match="divisible by the model-axis"):
+        DecodeEngine(cfg3, gpt_init(jax.random.PRNGKey(0), cfg3), 2,
+                     prefill_chunk=4, mesh=_mesh())
+    with pytest.raises(ValueError, match="chunked prefill"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=0, mesh=_mesh())
+    # a mesh without a >1 model axis is plain single-device serving
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, abstract=True,
+                       mesh=make_mesh(devices=jax.devices()[:1]))
+    assert eng.tp == 1 and eng.mesh is None
+    assert serve_tp_size(None) == 1
+
+
+def test_server_tp_needs_enough_devices():
+    with pytest.raises(ValueError, match="devices"):
+        InferenceServer(CFG, PARAMS, slots=2, tp=99)
+
+
+# ------------------------------------------------------- token identity
+def test_tp_paged_bit_identical_mixed_traffic():
+    """TP=2 paged serving: greedy AND sampled streams equal solo
+    gpt_decode and the tp=1 engine across mixed lengths, shared-prefix
+    hits, and recycled slots (more requests than slots)."""
+    rs = np.random.RandomState(0)
+    shared = _prompt(rs, 9)
+    jobs = []
+    for i, n in enumerate((6, 11, 3, 17, 7, 5)):
+        jobs.append((_prompt(rs, n), 6, {}))
+    jobs.append((np.concatenate([shared, _prompt(rs, 4)]), 5, {}))
+    jobs.append((np.concatenate([shared, _prompt(rs, 2)]), 5, {}))
+    # sampled rows: the gather form keeps logits bit-identical, so even
+    # sampled tokens match the offline path exactly
+    jobs.append((_prompt(rs, 8), 6,
+                 dict(temperature=0.9, top_k=8, seed=3)))
+    refs = [_ref(p, m, **ov) for p, m, ov in jobs]
+    for tp in (1, 2):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                             prefill_chunk=4, tp=tp) as srv:
+            assert srv.tp == tp
+            got = _serve_all(srv, jobs)
+        for g, r in zip(got, refs):
+            assert np.array_equal(g, r), (tp, g, r)
+
+
+def test_tp_dense_bit_identical():
+    rs = np.random.RandomState(1)
+    jobs = [(_prompt(rs, n), 6, {}) for n in (6, 11, 3)]
+    refs = [_ref(p, m) for p, m, _ in jobs]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         paged=False, tp=2) as srv:
+        got = _serve_all(srv, jobs)
+    for g, r in zip(got, refs):
+        assert np.array_equal(g, r)
+
+
+def test_tp_speculative_greedy_identical():
+    rs = np.random.RandomState(2)
+    # repetitive suffixes so the n-gram drafter actually proposes
+    base = _prompt(rs, 5)
+    jobs = [(np.concatenate([base, base, base[:2]]), 8, {}),
+            (_prompt(rs, 7), 8, {})]
+    refs = [_ref(p, m) for p, m, _ in jobs]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=3, tp=2) as srv:
+        got = _serve_all(srv, jobs)
+    for g, r in zip(got, refs):
+        assert np.array_equal(g, r)
+
+
+def test_tp_preempt_swap_resume_identity():
+    """A pool small enough to force preemption + host swap under TP:
+    the swap gather/scatter programs run over the head-sharded pool and
+    the resumed rows stay bit-exact."""
+    rs = np.random.RandomState(3)
+    jobs = [(_prompt(rs, 12), 10, {}) for _ in range(4)]
+    refs = [_ref(p, m) for p, m, _ in jobs]
+    with InferenceServer(CFG, PARAMS, slots=4, queue=8, prefill_chunk=4,
+                         num_blocks=14, tp=2, degrade=False) as srv:
+        got = _serve_all(srv, jobs)
+        m = srv.metrics()["paged"]
+    for g, r in zip(got, refs):
+        assert np.array_equal(g, r)
+    # the tiny pool really exercised preemption + swap (14 blocks hold
+    # ~2 of the 4 rows; measured 2 swap round trips at this geometry)
+    assert m["swaps_out"] > 0 and m["swaps_in"] > 0
+
+
+# ----------------------------------------------------------- step audit
+def test_tp_audit_shardings_collectives_donation():
+    """The compiled-step audit over the TP engine: abstract inputs
+    carry the REAL mesh shardings (the head-axis KV pool spec shows up
+    in the step info), donation aliasing survives partitioning, the
+    collective count fits a pinned budget, and — the bit-identity
+    invariant made structural — there are ZERO all-reduces: the gather
+    form moves data, it never re-associates a contraction."""
+    from cxxnet_tpu.analysis.step_audit import (audit_serve_engine,
+                                                format_step_info)
+    mesh = _mesh()
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, abstract=True,
+                       num_blocks=30, spec_len=2, mesh=mesh)
+    report, infos = audit_serve_engine(eng, donate=True,
+                                       collective_budget=8 * CFG.n_layer)
+    assert report.ok(), report.format()
+    assert {i["label"] for i in infos} == {
+        "serve_prefill_chunk", "serve_verify_chunk", "serve_tick"}
+    kv_spec = str(serve_kv_sharding(mesh).spec)
+    for info in infos:
+        assert kv_spec in info["shardings"], info
+        assert info["collectives"]["all-reduce"] == 0, info
+        assert info["collectives"]["all-gather"] > 0, info
+        assert info["aliased"] == info["donated"] == 2, info
+        assert "sharded[" in format_step_info(info)
+    # an unsharded engine's audit reports no shardings (no regression
+    # in the single-device step table)
+    eng1 = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, abstract=True,
+                        num_blocks=30)
+    _, infos1 = audit_serve_engine(eng1, donate=True)
+    assert all(not i["shardings"] for i in infos1)
+
+
+def test_tp_param_shardings_cover_fused_blocks():
+    """serve_param_shardings names a placement for every leaf the fused
+    block dict actually holds — a renamed weight would KeyError at
+    engine construction, not silently replicate."""
+    from cxxnet_tpu.models.gpt import _fuse_qkv_blocks
+    blocks = jax.eval_shape(_fuse_qkv_blocks, PARAMS["blocks"])
+    bsh, osh = serve_param_shardings(_mesh())
+    assert set(bsh) == set(blocks)
+    assert set(osh) == {"emb", "pos", "lnf_g", "lnf_b", "head"}
+
+
+# ------------------------------------------------- guard + fused + obs
+def test_tp_guard_signatures_carry_mesh_and_stay_single():
+    rs = np.random.RandomState(4)
+    jobs = [(_prompt(rs, n), 4, {}) for n in (3, 9, 14, 6)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         recompile_limit=4, tp=2) as srv:
+        _serve_all(srv, jobs)
+        eng = srv._engine
+        assert len(eng.prefill_signatures) == 1
+        assert len(eng.tick_signatures) == 1
+        for sig in eng.prefill_signatures + eng.tick_signatures:
+            assert "/mesh=" in str(sig), sig
+    # the single-device engine's signatures stay suffix-free: tp=1 and
+    # tp>1 programs can never collapse onto one counted signature
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         recompile_limit=4) as srv:
+        _serve_all(srv, jobs[:1])
+        assert all("/mesh=" not in str(s)
+                   for s in srv._engine.prefill_signatures)
+
+
+def test_tp_fused_attn_pins_gather_fallback(monkeypatch):
+    """Under TP the fused Pallas kernel resolves OFF (a Mosaic custom
+    call GSPMD cannot partition) — the support gate sees the LOCAL head
+    count, the engine pins the gather fallback, and CXN_FUSED_ATTN=0
+    remains a no-op: streams are identical with the flag on, off, or
+    env-killed."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    # even with interpret mode waiving geometry limits (the gate would
+    # say yes for the local heads), tp > 1 keeps the gather form
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, abstract=True,
+                       num_blocks=30, mesh=_mesh(), fused_attn=True)
+    assert eng.fused_attn is False
+    monkeypatch.setattr(pk, "_INTERPRET", False)
+    rs = np.random.RandomState(6)
+    jobs = [(_prompt(rs, 7), 5, {})]
+    refs = [_ref(p, m) for p, m, _ in jobs]
+    for env in (None, "0"):
+        if env is None:
+            monkeypatch.delenv("CXN_FUSED_ATTN", raising=False)
+        else:
+            monkeypatch.setenv("CXN_FUSED_ATTN", env)
+        with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                             prefill_chunk=4, tp=2,
+                             fused_attn=True) as srv:
+            assert srv._engine.fused_attn is False
+            got = _serve_all(srv, jobs)
+        assert np.array_equal(got[0], refs[0])
+
+
+def test_tp_metrics_and_kv_sharding_live():
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         tp=2) as srv:
+        m = srv.metrics()
+        assert m["tp"] == 2
+        assert "cxn_serve_tp 2" in srv.metrics_text()
+        # the live pool really is head-sharded over the model axis
+        spec = srv._engine.cache_k.sharding.spec
+        assert tuple(spec) == (None, None, "model", None, None)
+        # per-shard bytes are half the logical pool
+        shard = next(iter(srv._engine.cache_k.addressable_shards))
+        assert shard.data.size == srv._engine.cache_k.size // 2

@@ -108,7 +108,31 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     gcfg.n_head, bpr_, bs_,
                     gcfg.feat // gcfg.n_head,
                     2 if gcfg.dtype == "bfloat16" else 4)
-            arm = bool(geom_ok and task.serve_fused_attn
+            # TP-sharded serve audit (serve_tp > 1): build the model-
+            # axis mesh over the local devices and audit the PARTITIONED
+            # executables — real mesh shardings on the abstract inputs,
+            # donation aliasing and collective counts of the programs a
+            # sharded task=serve actually runs. On CPU CI export
+            # XLA_FLAGS=--xla_force_host_platform_device_count=<N>
+            # before invoking this tool (tests/conftest.py does the
+            # same for the suite).
+            tp = int(getattr(task, "serve_tp", 0) or 0)
+            mesh = None
+            if tp > 1:
+                devs = _jax.devices()
+                if len(devs) < tp:
+                    print("cxn-lint: serve_tp=%d needs %d devices, "
+                          "found %d — set XLA_FLAGS=--xla_force_host_"
+                          "platform_device_count=%d before jax "
+                          "initializes" % (tp, tp, len(devs), tp),
+                          file=sys.stderr)
+                    return 2
+                from cxxnet_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(devices=devs[:tp], model_parallel=tp)
+            # fused attention cannot be audited under TP (the Pallas
+            # kernel is a custom call GSPMD cannot partition; the
+            # engine pins the gather fallback there — serve/engine.py)
+            arm = bool(geom_ok and task.serve_fused_attn and tp <= 1
                        and os.environ.get("CXN_FUSED_ATTN", "1") != "0"
                        and _jax.default_backend() != "tpu"
                        and not _pk._INTERPRET)
@@ -127,15 +151,21 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                    spec_len=(task.spec_len
                                              if task.spec_mode != "off"
                                              else 0),
-                                   fused_attn=bool(task.serve_fused_attn))
+                                   fused_attn=bool(task.serve_fused_attn),
+                                   mesh=mesh)
                 # the serve executables ride under the same compile-time
                 # budget as the trainer steps (CXN207): pass
                 # lint_compile_budget_s=<s> to gate compile regressions
                 # in CI the way lint_collective_budget gates collectives
+                # — and, sharded, under the same collective budget
+                # (CXN204) the trainer's partitioned steps use
                 cbudget = getattr(net, "lint_compile_budget_s", 0.0) \
                     or None
+                colbudget = getattr(net, "lint_collective_budget", -1)
                 serve_report, serve_infos = audit_serve_engine(
-                    eng, compile_budget_s=cbudget)
+                    eng, compile_budget_s=cbudget,
+                    collective_budget=(colbudget if colbudget >= 0
+                                       else None))
             finally:
                 _pk._INTERPRET = old_interp
             report.extend(serve_report.findings)

@@ -62,6 +62,16 @@ _DEFAULT_CELL_TOL = {
     #                                         DOWN), band matches the
     #                                         other serve trace cells
     "serve_tokens_per_mib": 0.20,
+    "serve_tokens_per_sec_tp2": 0.30,       # tiny-geometry trace cells:
+    #                                         dispatch-bound on CPU, so
+    "serve_tokens_per_sec_replicated": 0.30,  # scheduler-thread timing
+    #                                         noise dominates (round 17)
+    "serve_goodput_replicated_kill": 0.10,  # a fraction in [0, 1]: the
+    #                                         router replays a killed
+    #                                         replica's requests, so
+    #                                         this regresses DOWN from
+    #                                         ~1.0 only when failover
+    #                                         breaks
     "gpt_decode_spec_ms_per_token": 0.20,
     "obs_overhead_pct": 1.0,        # a percentage-point-scale cell:
     #                                 gate it on the <= 2% budget in
