@@ -87,6 +87,17 @@ Prints one JSON line per metric, in this order:
                                      vs_baseline = router / single
                                      completed fraction — the
                                      availability headline, round 17)
+ 12a6. serve_goodput_guaranteed_overload (multi-tenant SLO cell: a
+                                     3x-overload Poisson trace with a
+                                     G/S/B tenant mix — the guaranteed
+                                     tenant's completion fraction must
+                                     hold 1.0 while best-effort sheds
+                                     with finite retry hints)
+ 12a7. serve_p95_ttft_ms_guaranteed_overload (same trace: guaranteed
+                                     p95 TTFT; vs_baseline = the
+                                     untenanted global-FIFO server's
+                                     guaranteed p95 / tenanted — the
+                                     latency-isolation win)
  12b. serve_spec_tokens_per_sec     (speculative serving: n-gram drafter
                                      on a repetitive-suffix trace;
                                      vs_baseline = the same trace served
@@ -997,6 +1008,104 @@ def bench_serve_replicated():
          single_goodput=round(g_single, 3))
 
 
+def bench_serve_tenanted():
+    """Multi-tenant SLO cell (doc/serving.md "Multi-tenant SLOs"): a
+    3x-overload Poisson trace with a guaranteed / standard /
+    best_effort tenant mix (1/4 : 1/4 : 1/2) served by a tenanted
+    server — guaranteed submits block at the door (an SLO client waits,
+    never drops) and carries no deadline; standard and best-effort
+    carry tenant-default deadlines (tight for best-effort), so rung-3
+    shedding lands on the best-effort class first. Emits
+    ``serve_goodput_guaranteed_overload`` (guaranteed completion
+    fraction; the acceptance gate is 1.0 — vs_baseline IS the value)
+    and ``serve_p95_ttft_ms_guaranteed_overload`` (the guaranteed
+    tenant's p95 TTFT under overload; vs_baseline = the SAME trace
+    through an UNTENANTED server's global FIFO / global ladder — > 1
+    means tenancy bought the paying tenant latency isolation).
+    Best-effort sheds ride along as fields, with the minimum observed
+    finite ``retry_after_ms`` hint."""
+    import time as _time
+
+    from cxxnet_tpu.serve import InferenceServer, QueueFullError
+
+    c, cfg, params = _repl_model()
+    rs = np.random.RandomState(c["seed"] + 31)
+    n = 36
+    tenants = rs.choice(["gold", "std", "free"], n, p=[0.25, 0.25, 0.5])
+    lens = rs.choice([8, 16], n)
+    maxt = rs.choice(list(c["max_new"]), n)
+    prompts = [rs.randint(0, c["vocab"], (int(l),)).astype(np.int32)
+               for l in lens]
+    kw = dict(slots=c["slots"], queue=12, prefill_chunk=c["chunk"])
+
+    # calibration: closed-loop service rate of this trace on this rig,
+    # warmed — the denominator that makes "3x overload" honest
+    srv = InferenceServer(cfg, params, **kw)
+    try:
+        for _ in range(2):
+            t0 = _time.perf_counter()
+            hs = [srv.submit(p, max_tokens=int(m))
+                  for p, m in zip(prompts[:12], maxt[:12])]
+            for h in hs:
+                srv.result(h)
+            cal_wall = _time.perf_counter() - t0
+    finally:
+        srv.shutdown()
+    rate = 12.0 / cal_wall                  # requests/sec at capacity
+    gaps = rs.exponential(1.0 / (3.0 * rate), n)
+    # deadlines via tenant defaults: best_effort gets ~2 service
+    # times, standard ~8 — the shed pressure lands inverse-priority
+    svc_ms = 1e3 / rate * c["slots"]
+    spec = ("gold:prio=G;std:prio=S,timeout_ms=%.0f;"
+            "free:prio=B,timeout_ms=%.0f" % (8 * svc_ms, 2 * svc_ms))
+
+    def run(tenanted):
+        srv = InferenceServer(
+            cfg, params, tenants=spec if tenanted else "", **kw)
+        out = {"gold_ttft": [], "gold_ok": 0, "shed": 0, "retry": []}
+        try:
+            handles = []
+            for gap, t, p, m in zip(gaps, tenants, prompts, maxt):
+                _time.sleep(float(gap))
+                try:
+                    handles.append((t, srv.submit(
+                        p, max_tokens=int(m), tenant=str(t),
+                        block=(t == "gold"))))
+                except QueueFullError as e:
+                    if e.retry_after_ms > 0:
+                        out["retry"].append(e.retry_after_ms)
+                    out["shed"] += 1
+            for t, h in handles:
+                res = srv.result(h, timeout=600)
+                if t == "gold" and res.status == "ok":
+                    out["gold_ok"] += 1
+                    out["gold_ttft"].append(res.ttft_ms)
+                elif res.status == "shed":
+                    out["shed"] += 1
+                    if res.retry_after_ms > 0:
+                        out["retry"].append(res.retry_after_ms)
+        finally:
+            srv.shutdown()
+        return out
+
+    mt = run(tenanted=True)
+    mu = run(tenanted=False)
+    gold_total = int(sum(1 for t in tenants if t == "gold"))
+    g = mt["gold_ok"] / float(max(1, gold_total))
+    p95_t = float(np.percentile(mt["gold_ttft"], 95)) \
+        if mt["gold_ttft"] else 0.0
+    p95_u = float(np.percentile(mu["gold_ttft"], 95)) \
+        if mu["gold_ttft"] else 0.0
+    emit("serve_goodput_guaranteed_overload", g, "fraction", g,
+         be_shed=mt["shed"],
+         min_retry_after_ms=(round(min(mt["retry"]), 1)
+                             if mt["retry"] else None),
+         overload_factor=3.0)
+    emit("serve_p95_ttft_ms_guaranteed_overload", p95_t, "ms",
+         p95_u / max(p95_t, 1e-9),
+         untenanted_p95_ms=round(p95_u, 1))
+
+
 def serve_spec_trace(cfg, params, cell=None):
     """Seeded repetitive-suffix serving trace: [(gap_s, prompt,
     max_tokens)] with Poisson open-loop arrivals — every prompt is a
@@ -1140,8 +1249,8 @@ def main() -> int:
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
                bench_serve_prefill_heavy, bench_serve_paged,
                bench_serve_fused, bench_serve_sharded,
-               bench_serve_replicated, bench_serve_spec,
-               bench_obs_overhead, bench_lint):
+               bench_serve_replicated, bench_serve_tenanted,
+               bench_serve_spec, bench_obs_overhead, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -137,6 +137,15 @@ class LearnTask:
         #                           stop prefix admission -> shed
         #                           deadline-doomed queued requests with
         #                           retry_after_ms hints (0 = off)
+        self.serve_tenants = ""   # multi-tenant SLO policies (serve/
+        #                           tenancy.py): "name:prio=G,
+        #                           blocks=40%,qps=50;..." — priority
+        #                           classes, queue/slot/KV-block
+        #                           quotas, token-bucket rate limits,
+        #                           default deadlines; tenant-aware
+        #                           degradation ladder with emergency
+        #                           rung 4. Empty = untenanted (a
+        #                           pinned no-op).
         self.spec_mode = "off"    # speculative decoding draft source:
         #                           off | ngram (prompt lookup) | model
         self.spec_len = 4         # draft tokens verified per forward
@@ -270,6 +279,8 @@ class LearnTask:
             self.serve_watchdog_ms = float(val)
         elif name == "serve_degrade":
             self.serve_degrade = int(val)
+        elif name == "serve_tenants":
+            self.serve_tenants = val
         elif name == "serve_tp":
             self.serve_tp = int(val)
         elif name == "serve_replicas":
@@ -1009,7 +1020,8 @@ class LearnTask:
                          max_restarts=self.serve_max_restarts,
                          watchdog_ms=self.serve_watchdog_ms,
                          degrade=bool(self.serve_degrade),
-                         tp=self.serve_tp)
+                         tp=self.serve_tp,
+                         tenants=self.serve_tenants)
         routed = self.serve_replicas > 1
         if routed:
             # replicated serving: N engines behind the prefix- and
@@ -1045,6 +1057,11 @@ class LearnTask:
             if self.spec_mode != "off":
                 mode += ", speculative %s x%d" % (self.spec_mode,
                                                   self.spec_len)
+            ten = (srv.servers[0] if routed else srv).tenancy
+            if ten is not None:
+                mode += ", tenants [%s]" % ", ".join(
+                    "%s=%s" % (t, ten.policy_for(t).priority[0].upper())
+                    for t in ten.label_names())
             inj = (srv.servers[0] if routed else srv).fault_injector
             if inj is not None:
                 mode += ", CHAOS armed (%s)" % inj.spec
@@ -1100,6 +1117,36 @@ class LearnTask:
                 handles.append(h)
                 feed.notify()
 
+        # graceful preemption (save_on_preempt=1, default — the
+        # trainer's SIGTERM discipline applied to serving): SIGTERM —
+        # what a pod scheduler sends before reclaiming the slice —
+        # stops ADMISSION (later submits are rejected with
+        # retry_after_ms hints while the server reports DRAINING),
+        # finishes every queued + in-flight request instead of killing
+        # live streams mid-token, flushes the obs exports, and exits 0.
+        import signal
+
+        class _ServePreempt(Exception):
+            pass
+
+        # the handler raises ONLY while armed (the stdin loop): a
+        # SIGTERM landing after EOF — or a scheduler RE-sending the
+        # signal while the drain below already runs — must not abort
+        # the drain it asked for; it just (re)records the flag
+        armed = [True]
+
+        def _on_term(signum, frame):
+            self._preempted = signum
+            if armed[0]:
+                armed[0] = False
+                raise _ServePreempt()
+
+        old_handler = None
+        if self.save_on_preempt:
+            try:
+                old_handler = signal.signal(signal.SIGTERM, _on_term)
+            except ValueError:          # not the main thread
+                old_handler = None
         try:
             es = contextlib.ExitStack()
             # telemetry export follows replica 0 when routed (one JSONL
@@ -1107,22 +1154,32 @@ class LearnTask:
             # srv.metrics_text() — doc/observability.md)
             es.enter_context(self._obs_run(
                 srv.servers[0].registry if routed else srv.registry))
-            for line in sys.stdin:
-                line = line.strip()
-                if not line:
-                    continue
-                # one bad line must not take down the serving loop: it
-                # gets its ERR output slot and the stream continues
-                try:
-                    ids = [int(t) for t in line.split()]
-                    # block=True: the stdin loop IS the backpressure — a
-                    # full queue pauses reading instead of dropping
-                    emit(srv.submit(ids, block=True))
-                except ValueError:
-                    emit("ERR rejected: unparseable prompt line "
-                         "(want space-separated ints)")
-                except AdmissionError as e:
-                    emit("ERR rejected: %s" % e.reason)
+            try:
+                for line in sys.stdin:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    # one bad line must not take down the serving loop:
+                    # it gets its ERR output slot and the stream
+                    # continues
+                    try:
+                        ids = [int(t) for t in line.split()]
+                        # block=True: the stdin loop IS the
+                        # backpressure — a full queue pauses reading
+                        # instead of dropping
+                        emit(srv.submit(ids, block=True))
+                    except ValueError:
+                        emit("ERR rejected: unparseable prompt line "
+                             "(want space-separated ints)")
+                    except AdmissionError as e:
+                        emit("ERR rejected: %s" % e.reason)
+            except _ServePreempt:
+                profiler.log(
+                    "serve: SIGTERM — graceful preemption: admission "
+                    "closing, draining in-flight requests (rejections "
+                    "during the drain carry retry_after_ms hints)")
+            armed[0] = False            # EOF path: later SIGTERMs only
+            #                             set the flag, the drain runs
             srv.drain()
             with feed:
                 eof[0] = True
@@ -1189,6 +1246,8 @@ class LearnTask:
                        m["ttft_ms"]["p99"], m["batch_efficiency"],
                        m["ticks"], extra))
         finally:
+            if old_handler is not None:
+                signal.signal(signal.SIGTERM, old_handler)
             srv.shutdown(drain=False)       # idempotent after drain()
             try:
                 with feed:                  # wake the printer on the

@@ -26,15 +26,17 @@ from .resilience import (DegradationLadder, EngineFailedError,
 from .router import RouterHandle, ServeRouter
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
-                     ServeResult)
+                     QuotaExceededError, ServeResult)
 from .speculative import ModelDrafter, NgramDrafter, SpeculativeDecoder
+from .tenancy import TenantPolicy, TenantRegistry, TokenBucket
 
 __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
            "PagedPrefixCache", "BlockManager", "BlockPoolExhausted",
            "auto_num_blocks", "fused_attn_tolerance",
            "assert_fused_allclose", "AdmissionError", "QueueFullError",
-           "NgramDrafter", "ModelDrafter", "SpeculativeDecoder",
-           "FaultInjector", "DegradationLadder", "InjectedFault",
-           "SwapCorruptionError", "EngineFailedError", "ServeRouter",
-           "RouterHandle"]
+           "QuotaExceededError", "NgramDrafter", "ModelDrafter",
+           "SpeculativeDecoder", "FaultInjector", "DegradationLadder",
+           "InjectedFault", "SwapCorruptionError", "EngineFailedError",
+           "ServeRouter", "RouterHandle", "TenantPolicy",
+           "TenantRegistry", "TokenBucket"]

@@ -37,9 +37,11 @@ grammar (comma-separated, ``:`` separates key and value)::
 Points: ``reserve`` (BlockPoolExhausted mid-reserve), ``swap_out`` /
 ``swap_in`` (host round-trip I/O failure / buffer corruption),
 ``drafter`` (drafter exception), ``prefix_restore`` (restore failure),
-``tick_raise`` (tick raising), ``tick_hang`` (tick stalling). An empty
-spec yields no injector at all — ``serve_chaos`` off is a true no-op
-(the hot path pays one ``is not None`` check).
+``tick_raise`` (tick raising), ``tick_hang`` (tick stalling),
+``admit`` (a fault inside the admission/quota path — fails that ONE
+submit typed, the server and every other request are untouched). An
+empty spec yields no injector at all — ``serve_chaos`` off is a true
+no-op (the hot path pays one ``is not None`` check).
 
 **Degradation ladder** (:class:`DegradationLadder`): overload is met
 with targeted load-shedding instead of collapse, driven by the gauges
@@ -50,6 +52,18 @@ optionally p95 tick) with hysteresis so the rungs do not flap:
     rung 2  stop prefix-cache admission (no new trie inserts/donations)
     rung 3  deadline-aware shedding of queued requests, and rejections
             carry a ``retry_after_ms`` hint
+    rung 4  EMERGENCY (tenancy-armed servers only): even guaranteed-
+            class requests become sheddable
+
+With ``serve_tenants`` armed the ladder is tenant-aware: shedding
+walks classes in inverse priority (all best-effort requests are
+considered before any standard one; guaranteed only at rung 4), and
+climbing past rung 3 requires pressure from the PROTECTED classes
+alone — a best-effort flood can never push paying tenants onto the
+emergency rung (``class_queue_frac`` in :meth:`~DegradationLadder
+.evaluate`; :meth:`~DegradationLadder.shed_classes` exposes which
+classes the current rung touches). Untenanted servers keep the
+original 3-rung ladder bit-identically (``max_rung`` stays 3).
 
 The server surfaces the state as SERVING / DEGRADED / DRAINING /
 FAILED in ``health()``, the ``cxn_serve_state`` gauge, and the obs
@@ -114,7 +128,7 @@ class FaultInjector:
     condition-guarded."""
 
     POINTS = ("reserve", "swap_out", "swap_in", "drafter",
-              "prefix_restore", "tick_raise", "tick_hang")
+              "prefix_restore", "tick_raise", "tick_hang", "admit")
 
     def __init__(self, seed: int = 0, hang_ms: float = 2000.0):
         self.spec = ""
@@ -330,13 +344,20 @@ class DegradationLadder:
     both streaks, so the ladder neither flaps on a noisy gauge nor
     relaxes while pressure is merely catching its breath."""
 
-    MAX_RUNG = 3
+    MAX_RUNG = 3            # the untenanted ceiling (shedding)
+    EMERGENCY_RUNG = 4      # tenant-aware servers only: guaranteed
+    #                         requests become sheddable
 
     def __init__(self, enabled: bool = True, queue_hi: float = 0.85,
                  queue_lo: float = 0.30, headroom_lo: float = 0.05,
                  headroom_hi: float = 0.25, up_hold: int = 3,
-                 down_hold: int = 16, tick_budget_ms: float = 0.0):
+                 down_hold: int = 16, tick_budget_ms: float = 0.0,
+                 max_rung: int = 0):
         self.enabled = bool(enabled)
+        # 0 = the classic 3-rung ladder; a tenancy-armed server raises
+        # this to EMERGENCY_RUNG (4) — rung 4 is only reachable when
+        # the PROTECTED classes are themselves hot (evaluate)
+        self.max_rung = int(max_rung) if max_rung > 0 else self.MAX_RUNG
         self.queue_hi = float(queue_hi)
         self.queue_lo = float(queue_lo)
         self.headroom_lo = float(headroom_lo)
@@ -360,12 +381,20 @@ class DegradationLadder:
         self._stall = True
 
     def evaluate(self, queue_frac: float, headroom: Optional[float],
-                 tick_p95_ms: Optional[float] = None) -> int:
+                 tick_p95_ms: Optional[float] = None,
+                 class_queue_frac: Optional[Dict[str, float]] = None
+                 ) -> int:
         """One hysteresis step; returns the (possibly new) rung.
         ``queue_frac`` = queue depth / capacity; ``headroom`` = free +
         reclaimable blocks / usable pool (None for the dense engine);
         ``tick_p95_ms`` only participates when ``tick_budget_ms`` > 0
-        and a fresh sample is passed."""
+        and a fresh sample is passed. ``class_queue_frac`` (tenancy-
+        armed servers) maps priority class -> that class's queue
+        fraction: climbing from rung 3 to the emergency rung requires
+        the PROTECTED (non-best-effort) classes alone to be over
+        ``queue_hi`` — rung 3's best-effort shedding must have failed
+        to relieve the paying tenants before guaranteed traffic is
+        ever touched."""
         if not self.enabled:
             return 0
         stall = self._stall
@@ -378,10 +407,16 @@ class DegradationLadder:
             and (headroom is None or headroom >= self.headroom_hi) \
             and (self.tick_budget_ms <= 0 or tick_p95_ms is None
                  or tick_p95_ms <= self.tick_budget_ms)
+        protected = sum(v for k, v in (class_queue_frac or {}).items()
+                        if k != "best_effort")
         if hot:
             self._up += 1
             self._down = 0
-            if self._up >= self.up_hold and self.rung < self.MAX_RUNG:
+            limit = self.max_rung
+            if self.rung >= self.MAX_RUNG and limit > self.MAX_RUNG \
+                    and protected < self.queue_hi:
+                limit = self.MAX_RUNG
+            if self._up >= self.up_hold and self.rung < limit:
                 self.rung += 1
                 self.transitions += 1
                 self._up = 0
@@ -395,6 +430,16 @@ class DegradationLadder:
         else:
             self._up = 0
             self._down = 0
+        # the emergency rung is HELD only while the protected classes
+        # are themselves hot: a lingering best-effort flood (global
+        # pressure still high) must not keep guaranteed requests
+        # sheddable once the paying tenants' own pressure subsided —
+        # demotion to rung 3 is immediate (shedding best-effort there
+        # is the correct and sufficient response), while re-climbing
+        # pays the full up_hold hysteresis again.
+        if self.rung >= self.EMERGENCY_RUNG and protected < self.queue_hi:
+            self.rung = self.MAX_RUNG
+            self.transitions += 1
         return self.rung
 
     # ------------------------------------------------------- the effects
@@ -416,3 +461,22 @@ class DegradationLadder:
         """Rung 3 sheds queued requests that cannot meet their deadline
         and attaches ``retry_after_ms`` hints to rejections."""
         return self.rung >= 3
+
+    @staticmethod
+    def classes_for(rung: int):
+        """Which priority classes the given rung's SHEDDING touches, in
+        the order they are walked (inverse priority): rungs 1-2 shed
+        nothing (their effects — spec off, prefix admission off — are
+        class-global), rung 3 sheds best-effort then standard, rung 4
+        (emergency) adds guaranteed. Untenanted requests are class
+        ``standard``, so the classic rung-3 behavior is unchanged."""
+        if rung >= DegradationLadder.EMERGENCY_RUNG:
+            return ("best_effort", "standard", "guaranteed")
+        if rung >= 3:
+            return ("best_effort", "standard")
+        return ()
+
+    def shed_classes(self):
+        """The classes the CURRENT rung may shed (see
+        :meth:`classes_for`)."""
+        return self.classes_for(self.rung)

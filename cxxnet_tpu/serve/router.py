@@ -70,7 +70,8 @@ from ..obs import metrics as obs_metrics
 from .resilience import (STATE_DRAINING, STATE_FAILED, EngineFailedError,
                          ReplayJournal, reset_for_replay)
 from .scheduler import Request, SamplingParams
-from .server import AdmissionError, InferenceServer, QueueFullError
+from .server import (AdmissionError, InferenceServer, QueueFullError,
+                     QuotaExceededError)
 
 __all__ = ["ServeRouter", "RouterHandle"]
 
@@ -217,6 +218,8 @@ class ServeRouter:
         self.affinity_hits = 0              # routed by a prefix match
         self.failovers = 0                  # failed-replica migrations
         self.drain_migrations = 0           # drain-initiated migrations
+        self.quota_spills = 0               # tenant-quota rejections
+        #                                     spilled to a peer replica
 
     # ------------------------------------------------------------ routing
     @property
@@ -272,17 +275,26 @@ class ServeRouter:
                block: bool = False, **overrides) -> RouterHandle:
         """Route one request to a replica; returns a RouterHandle for
         :meth:`result`. A replica answering with backpressure
-        (QueueFullError) spills to the next-best healthy replica; the
-        error is re-raised only when EVERY healthy replica refuses.
-        Raises EngineFailedError when no healthy replica remains."""
+        (QueueFullError) — or a tenant-quota rejection
+        (QuotaExceededError; per-replica quota/rate state, so a peer
+        may well have budget) — spills to the next-best healthy
+        replica; the error is re-raised only when EVERY healthy
+        replica refuses, and then with the MINIMUM ``retry_after_ms``
+        across the rejecting peers (plus that replica's id in the
+        reason) — not whichever peer happened to answer last, whose
+        hint may be arbitrarily pessimistic. Raises EngineFailedError
+        when no healthy replica remains."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._sweep_failed()
         tried: set = set()
         last_err: Optional[Exception] = None
+        rejects = []            # (retry_after_ms, replica, error)
         while True:
             with self._lock:
                 idx = self._route(prompt, exclude=tried)
             if idx is None:
+                if rejects:
+                    raise self._aggregate_rejection(rejects)
                 if isinstance(last_err, AdmissionError):
                     raise last_err
                 raise EngineFailedError(
@@ -294,6 +306,10 @@ class ServeRouter:
             except QueueFullError as e:
                 tried.add(idx)
                 last_err = e
+                rejects.append((e.retry_after_ms, idx, e))
+                if isinstance(e, QuotaExceededError):
+                    with self._lock:
+                        self.quota_spills += 1
                 continue
             except EngineFailedError as e:
                 tried.add(idx)
@@ -350,12 +366,31 @@ class ServeRouter:
                 self._handles.pop(handle.req.rid, None)
             return res
 
+    @staticmethod
+    def _aggregate_rejection(rejects):
+        """Every healthy replica rejected the submit: aggregate the
+        hints instead of parroting the last answer. The raised error
+        carries the MINIMUM ``retry_after_ms`` across peers and names
+        the replica it came from — the honest fleet-wide back-off (the
+        soonest any replica expects room). A quota rejection stays
+        typed QuotaExceededError so callers keep the per-tenant
+        signal."""
+        ms, idx, err = min(rejects, key=lambda t: (t[0], t[1]))
+        reason = ("all %d replica(s) rejected the submit; earliest "
+                  "capacity at replica %d" % (len(rejects), idx))
+        if isinstance(err, QuotaExceededError):
+            return QuotaExceededError(reason, retry_after_ms=ms,
+                                      tenant=err.tenant, kind=err.kind)
+        return QueueFullError(reason, retry_after_ms=ms)
+
     # ----------------------------------------------------------- failover
     def _rewind(self, req: Request) -> Request:
         """A fresh Request carrying everything a bit-exact replay needs
-        (serve/resilience.py): prompt, params (seed included), and the
-        emitted-token prefix as the ``replay_expect`` pin."""
-        new = Request(req.rid, req.prompt, req.params, req.submit_t)
+        (serve/resilience.py): prompt, params (seed included), tenant
+        label, and the emitted-token prefix as the ``replay_expect``
+        pin."""
+        new = Request(req.rid, req.prompt, req.params, req.submit_t,
+                      tenant=req.tenant)
         new.tokens = list(req.tokens)
         new.replay_expect = req.replay_expect
         reset_for_replay(new)
@@ -478,6 +513,7 @@ class ServeRouter:
             "affinity_hits": self.affinity_hits,
             "failovers": self.failovers,
             "drain_migrations": self.drain_migrations,
+            "quota_spills": self.quota_spills,
             "replicas": per,
         }
 
@@ -500,6 +536,7 @@ class ServeRouter:
             self.affinity_hits = 0
             self.failovers = 0
             self.drain_migrations = 0
+            self.quota_spills = 0
 
     def drain(self, timeout=None) -> None:
         """Finish everything in flight on every replica, then stop

@@ -132,11 +132,17 @@ class Request:
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
                  "error", "done", "slot", "traced", "replay_expect",
-                 "retry_after_ms")
+                 "retry_after_ms", "tenant")
 
     def __init__(self, rid: int, prompt: np.ndarray,
-                 params: SamplingParams, submit_t: float):
+                 params: SamplingParams, submit_t: float,
+                 tenant: str = ""):
         self.rid = rid
+        # multi-tenant SLOs (serve/tenancy.py): the RESOLVED tenant
+        # label ("" on an untenanted server) — keys the scheduler's
+        # quota accounting, the priority ordering, and the tenant=
+        # metric labels; survives recovery replay and router failover
+        self.tenant = tenant
         self.traced = False     # span recording on for this request
         #                         (set once at admit: tracer sampling)
         self.prompt = prompt
@@ -178,7 +184,7 @@ class SlotScheduler:
     def __init__(self, engine, stats: Optional[profiler.StepStats] = None,
                  on_finish=None, prefix_cache=None, drafters=None,
                  spec_mode: str = "off", spec_len: int = 0, tracer=None,
-                 injector=None, on_swap_corrupt=None):
+                 injector=None, on_swap_corrupt=None, tenancy=None):
         self.engine = engine
         self.paged = bool(getattr(engine, "paged", False))
         self.stats = stats or profiler.StepStats()
@@ -279,6 +285,74 @@ class SlotScheduler:
         self.prefix_restore_faults = 0
         self.replay_mismatches = 0
         self._drafter_streak: dict = {}     # name -> consecutive faults
+        # multi-tenant SLOs (serve/tenancy.py): the TenantRegistry (None
+        # = untenanted, every branch below short-circuits), live
+        # per-tenant accounting — slots occupied and blocks CHARGED
+        # (one admission_claim per admitted row, credited back at
+        # retire/abort/preempt, re-charged at resume) — and the
+        # per-slot charge memo that makes the credit exact however the
+        # row leaves its slot. Scheduler-thread only, like every other
+        # host gauge here.
+        self.tenancy = tenancy
+        self.tenant_slots: dict = {}
+        self.tenant_blocks: dict = {}
+        self._slot_charge = [0] * n
+
+    # ----------------------------------------------------------- tenancy
+    def _rank(self, req: Request) -> int:
+        """Sacrifice rank (higher = preempted/shed first): every
+        request ranks `standard` on an untenanted server, so every
+        (rank, age) ordering below degenerates to the original
+        age-only order — the pinned no-op."""
+        if self.tenancy is None:
+            return 1
+        return self.tenancy.rank_of(req.tenant)
+
+    def _tenant_charge(self, req: Request, blocks: int) -> None:
+        if self.tenancy is None:
+            return
+        t = req.tenant
+        self._slot_charge[req.slot] = blocks
+        self.tenant_slots[t] = self.tenant_slots.get(t, 0) + 1
+        self.tenant_blocks[t] = self.tenant_blocks.get(t, 0) + blocks
+
+    def _tenant_credit(self, req: Request, slot: int) -> None:
+        if self.tenancy is None:
+            return
+        t = req.tenant
+        self.tenant_slots[t] = self.tenant_slots.get(t, 0) - 1
+        self.tenant_blocks[t] = self.tenant_blocks.get(t, 0) \
+            - self._slot_charge[slot]
+        self._slot_charge[slot] = 0
+
+    def tenant_usage(self, name: str):
+        """(occupied slots, charged blocks) for one tenant — the quota
+        accounting the exactness tests pin (both return to 0 when the
+        tenant's last request retires, aborts, or is preempted)."""
+        return (self.tenant_slots.get(name, 0),
+                self.tenant_blocks.get(name, 0))
+
+    def tenant_blocked(self, req: Request, claims: dict) -> bool:
+        """Would admitting ``req`` NOW exceed its tenant's slot or
+        block quota? ``claims`` maps tenant -> (slots, blocks) already
+        promised to requests popped earlier in the same scheduler pass
+        (their charges land later, outside the admission lock — the
+        same over-admit hazard ``admissible``'s ``claimed`` guards
+        globally). A blocked tenant's request is SKIPPED by the pop
+        loop, never blocking other tenants queued behind it."""
+        if self.tenancy is None:
+            return False
+        pol = self.tenancy.policy_for(req.tenant)
+        cs, cb = claims.get(req.tenant, (0, 0))
+        if pol.slots > 0 and \
+                self.tenant_slots.get(req.tenant, 0) + cs + 1 > pol.slots:
+            return True
+        if self.paged:
+            limit = pol.block_limit(self.engine.num_blocks - 1)
+            if limit > 0 and self.tenant_blocks.get(req.tenant, 0) + cb \
+                    + self.admission_claim(req) > limit:
+                return True
+        return False
 
     # ------------------------------------------------------------- state
     @property
@@ -464,21 +538,26 @@ class SlotScheduler:
                 return False
 
     def _preempt_one(self, exclude: int) -> bool:
-        """Swap out the lowest-priority occupied row (the youngest
-        admit — it has done the least work and re-queues behind the
-        least history), never ``exclude``. Decoding and still-
+        """Swap out the lowest-priority occupied row, never
+        ``exclude``: victims order by (priority class, age) — every
+        best-effort row goes before any standard row before any
+        guaranteed row, youngest admit first within a class (it has
+        done the least work and re-queues behind the least history).
+        Untenanted, every row ranks equal and the order degenerates to
+        the original youngest-admit rule. Decoding and still-
         prefilling rows are both fair game; returns False when no
         victim exists."""
-        victim, t_adm = None, -1.0
+        victim, key = None, (-1, -1.0)
         for slot, req in enumerate(self._req):
             if req is not None and slot != exclude \
-                    and req.admit_t > t_adm:
-                victim, t_adm = slot, req.admit_t
+                    and (self._rank(req), req.admit_t) > key:
+                victim, key = slot, (self._rank(req), req.admit_t)
         for slot in self._prefill_q:
             st = self._pending[slot]
             if st is not None and slot != exclude \
-                    and st["req"].admit_t > t_adm:
-                victim, t_adm = slot, st["req"].admit_t
+                    and (self._rank(st["req"]), st["req"].admit_t) > key:
+                victim, key = slot, (self._rank(st["req"]),
+                                     st["req"].admit_t)
         if victim is None:
             return False
         self._preempt(victim)
@@ -505,6 +584,12 @@ class SlotScheduler:
             self._req[slot] = None
         rec["spec"] = (int(self._spec_try[slot]),
                        int(self._spec_hit[slot]), self._spec_off[slot])
+        # tenancy: a preempted row's slot/block charge is RETURNED (its
+        # blocks leave the device pool for the host buffer); the charge
+        # rides the record so the resume re-applies exactly what was
+        # credited here
+        rec["charge"] = self._slot_charge[slot]
+        self._tenant_credit(req, slot)
         swap = self.engine.swap_out_row(slot)
         rec.update(swap)
         req.status = "swapped"
@@ -529,7 +614,13 @@ class SlotScheduler:
         n = 0
         while self._swapped and self._free:
             self._check_live()
-            rec = min(self._swapped, key=lambda r: r["req"].admit_t)
+            # (priority class, age): a preempted guaranteed row resumes
+            # before any standard row before any best-effort row,
+            # oldest admit first within a class (untenanted: the
+            # original oldest-admit order, ranks all equal)
+            rec = min(self._swapped,
+                      key=lambda r: (self._rank(r["req"]),
+                                     r["req"].admit_t))
             need = rec["n"]
             m = self.engine.manager
             if need > m.free_count:
@@ -564,6 +655,7 @@ class SlotScheduler:
             self.swap_host_bytes -= rec["nbytes"]
             req = rec["req"]
             req.slot = slot
+            self._tenant_charge(req, rec["charge"])
             for d in self.drafters.values():
                 d.reset(slot)
             self._spec_try[slot], self._spec_hit[slot], \
@@ -601,6 +693,10 @@ class SlotScheduler:
         p = req.params
         req.slot = slot
         req.admit_t = time.perf_counter()
+        # tenancy: charge the tenant its admission claim (slots always,
+        # blocks in paged mode) — credited back wherever the row leaves
+        # its slot (retire, abort, preempt)
+        self._tenant_charge(req, self.admission_claim(req))
         for d in self.drafters.values():
             d.reset(slot)               # new occupant: drop mirror state
         self._spec_try[slot] = self._spec_hit[slot] = 0
@@ -787,6 +883,7 @@ class SlotScheduler:
             # drop the row's block refs; blocks donated to the trie (or
             # shared with other live rows) survive through their refs
             self.engine.release_row(slot)
+        self._tenant_credit(req, slot)
         self._req[slot] = None
         self._temp[slot] = 0.0
         self._topk[slot] = 0

@@ -57,12 +57,13 @@ from ..utils import profiler
 from .engine import DecodeEngine
 from .resilience import (STATE_CODES, STATE_DEGRADED, STATE_DRAINING,
                          STATE_FAILED, STATE_SERVING, DegradationLadder,
-                         EngineFailedError, FaultInjector, ReplayJournal,
-                         SupersededError, reset_for_replay)
+                         EngineFailedError, FaultInjector, InjectedFault,
+                         ReplayJournal, SupersededError, reset_for_replay)
 from .scheduler import Request, SamplingParams, SlotScheduler
+from .tenancy import DEFAULT_TENANT, TenantRegistry
 
 __all__ = ["InferenceServer", "ServeResult", "AdmissionError",
-           "QueueFullError", "EngineFailedError"]
+           "QueueFullError", "QuotaExceededError", "EngineFailedError"]
 
 # monotonic scheduler counters that survive an engine rebuild: recovery
 # replaces the SlotScheduler, but the obs registry's callback counters
@@ -105,6 +106,23 @@ class QueueFullError(AdmissionError):
         self.retry_after_ms = float(retry_after_ms)
 
 
+class QuotaExceededError(QueueFullError):
+    """A tenant-quota rejection (serve/tenancy.py): the request's
+    TENANT is over its rate limit or queue quota — the server itself
+    has capacity. Distinct from plain :class:`QueueFullError` so the
+    router spills the request to a peer replica (per-replica quota
+    state) instead of treating the whole fleet as saturated, and so
+    callers can back off ONE tenant's traffic without throttling the
+    rest. ``tenant`` is the resolved policy name, ``kind`` the quota
+    that fired (``rate`` | ``queue`` | ``blocks``)."""
+
+    def __init__(self, reason: str, retry_after_ms: float = 0.0,
+                 tenant: str = "", kind: str = ""):
+        super().__init__(reason, retry_after_ms)
+        self.tenant = tenant
+        self.kind = kind
+
+
 @dataclass
 class ServeResult:
     """Terminal state of one request. ``tokens`` is the FULL sequence
@@ -144,7 +162,7 @@ class InferenceServer:
                  kv_mb: float = 0.0, fused_attn: bool = True,
                  chaos: str = "", max_restarts: int = 3,
                  watchdog_ms: float = 0.0, degrade: bool = True,
-                 tp: int = 0, mesh=None):
+                 tp: int = 0, mesh=None, tenants: str = ""):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -225,6 +243,23 @@ class InferenceServer:
         ``cxn_serve_state`` gauge surface SERVING / DEGRADED /
         DRAINING / FAILED.
 
+        Multi-tenant SLOs (serve/tenancy.py, doc/serving.md
+        "Multi-tenant SLOs"): ``tenants`` is the ``serve_tenants``
+        policy spec (or a pre-built TenantRegistry) — per-tenant
+        priority classes, queue/slot/KV-block quotas, token-bucket
+        rate limits with honest ``retry_after_ms`` refill hints, and
+        default deadlines. Armed, requests carry a ``tenant=`` label
+        through submit; admission enforces rate + queue quotas with
+        typed :class:`QuotaExceededError`; the scheduler admits by
+        (priority class, arrival), skips at-quota tenants without
+        blocking peers, and preempts best-effort rows first; the
+        degradation ladder sheds classes inverse-priority and gains an
+        emergency rung 4 (guaranteed sheddable) reachable only under
+        protected-class pressure; request counters/histograms gain a
+        ``tenant=`` label. Unset (the default) is a pinned no-op —
+        the whole layer is skipped and every surface is bit-identical
+        to the untenanted server.
+
         Tensor-parallel serving (doc/serving.md "Sharded & replicated
         serving"): ``tp`` > 1 builds a ``model``-axis mesh over the
         first ``tp`` local devices and shards the decode engine across
@@ -276,7 +311,15 @@ class InferenceServer:
         self._max_restarts = int(max_restarts)
         self._watchdog_ms = float(watchdog_ms)
         self._journal = ReplayJournal()
-        self._ladder = DegradationLadder(enabled=bool(degrade))
+        # multi-tenant SLOs (serve/tenancy.py): None when serve_tenants
+        # is unset — the pinned no-op; armed, the ladder gains the
+        # emergency rung (guaranteed sheddable only under
+        # protected-class pressure)
+        self._tenancy = TenantRegistry.from_spec(tenants)
+        self._ladder = DegradationLadder(
+            enabled=bool(degrade),
+            max_rung=(DegradationLadder.EMERGENCY_RUNG
+                      if self._tenancy is not None else 0))
         self._restarts = 0
         self._replayed = 0
         self._reserve_stalls = 0
@@ -355,6 +398,15 @@ class InferenceServer:
         self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
                         "timeout": 0, "cancelled": 0, "expired": 0,
                         "shed": 0, "error": 0}
+        if self._tenancy is not None:
+            # quota rejections only exist under tenancy; the key is
+            # ADDED rather than unconditional so the untenanted
+            # metrics() surface stays bit-identical
+            self._counts["quota"] = 0
+            self._tcounts = {t: dict.fromkeys(self._counts, 0)
+                             for t in self._tenancy.label_names()}
+        else:
+            self._tcounts = None
         self._ttft_s: collections.deque = collections.deque(maxlen=4096)
         self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
         self._queue_depth_max = 0
@@ -430,8 +482,59 @@ class InferenceServer:
                                     spec_len=self._engine.spec_len,
                                     tracer=self._tracer,
                                     injector=self._inj,
-                                    on_swap_corrupt=self._replay_one)
+                                    on_swap_corrupt=self._replay_one,
+                                    tenancy=self._tenancy)
         self._sched.prefix_admission = self._ladder.prefix_admission
+
+    # ----------------------------------------------------------- tenancy
+    def _class_of(self, req: Request) -> str:
+        """The request's priority class; untenanted requests are
+        ``standard``, which keeps every class-gated path (door shed,
+        queue shed) bit-identical to the pre-tenancy server."""
+        if self._tenancy is None:
+            return "standard"
+        return self._tenancy.class_of(req.tenant)
+
+    def _bump(self, key: str, req: Optional[Request] = None,
+              tenant: str = "") -> None:
+        """Increment one request counter, mirrored into the tenant's
+        row when tenancy is armed (caller holds the lock or runs on
+        the scheduler thread, like every _counts mutation)."""
+        self._counts[key] += 1
+        if self._tcounts is not None:
+            t = req.tenant if req is not None else \
+                self._tenancy.resolve(tenant)
+            self._tcounts.get(t, self._tcounts[DEFAULT_TENANT])[key] += 1
+
+    def _hist(self, fam, req: Request):
+        """The (tenant-labeled when armed) histogram child to observe
+        a request's latency into."""
+        return fam.labels(req.tenant) if self._tenancy is not None \
+            else fam
+
+    def _inc_shed(self, tenant: str) -> None:
+        """Count one shed into the (rung[, tenant]) family."""
+        if self._tenancy is None:
+            self._shed_c.labels(str(self._ladder.rung)).inc()
+        else:
+            self._shed_c.labels(str(self._ladder.rung), tenant).inc()
+
+    def _tenant_queued(self, tenant: str) -> int:
+        """Queued (unadmitted) requests for one tenant — the queue-
+        quota denominator and the per-tenant depth gauge."""
+        with self._cond:
+            return sum(1 for r in self._queue if r.tenant == tenant)
+
+    def _class_queue_frac(self):
+        """Per-class queue fractions for the tenant-aware ladder
+        (None when untenanted)."""
+        if self._tenancy is None:
+            return None
+        per = {c: 0 for c in ("guaranteed", "standard", "best_effort")}
+        with self._cond:
+            for r in self._queue:
+                per[self._tenancy.class_of(r.tenant)] += 1
+        return {c: n / float(self._queue_cap) for c, n in per.items()}
 
     # --------------------------------------------------------------- obs
     def _register_obs(self) -> None:
@@ -472,8 +575,50 @@ class InferenceServer:
                 ("error", "requests failed typed (replay divergence, "
                           "swap corruption, engine permanently "
                           "failed)")):
-            cb_counter("cxn_serve_%s_total" % key, help_,
-                       lambda k=key: self._counts[k])
+            if self._tenancy is None:
+                cb_counter("cxn_serve_%s_total" % key, help_,
+                           lambda k=key: self._counts[k])
+            else:
+                # tenancy armed: the same names, one child per tenant
+                # (the cross-tenant total is a PromQL `sum by` away);
+                # pre-touched for every policy so the catalog is
+                # stable before the first request
+                name = "cxn_serve_%s_total" % key
+                cb.append(name)
+                fam = r.counter(name, help_, labelnames=("tenant",))
+                for t in self._tenancy.label_names():
+                    fam.labels(t, fn=(lambda k=key, t=t:
+                                      self._tcounts[t][k]))
+        if self._tenancy is not None:
+            # the tenancy-only catalog: quota rejections by kind, live
+            # per-tenant queue/slot/block gauges (doc/observability.md)
+            self._quota_c = r.counter(
+                "cxn_serve_quota_rejections_total",
+                "submits rejected on a tenant quota (typed "
+                "QuotaExceededError with a retry_after_ms hint)",
+                labelnames=("tenant", "kind"))
+            cb.extend(("cxn_serve_tenant_queue_depth",
+                       "cxn_serve_tenant_slots",
+                       "cxn_serve_tenant_blocks"))
+            qd = r.gauge("cxn_serve_tenant_queue_depth",
+                         "queued (unadmitted) requests by tenant",
+                         labelnames=("tenant",))
+            ts = r.gauge("cxn_serve_tenant_slots",
+                         "scheduler slots occupied by tenant",
+                         labelnames=("tenant",))
+            tb = r.gauge("cxn_serve_tenant_blocks",
+                         "KV blocks charged to tenant admissions",
+                         labelnames=("tenant",))
+            for t in self._tenancy.label_names():
+                for kind in ("rate", "queue", "blocks"):
+                    self._quota_c.labels(t, kind)
+                qd.labels(t, fn=lambda t=t: self._tenant_queued(t))
+                ts.labels(t,
+                          fn=lambda t=t: self._sched.tenant_usage(t)[0])
+                tb.labels(t,
+                          fn=lambda t=t: self._sched.tenant_usage(t)[1])
+        else:
+            self._quota_c = None
         for attr, help_ in (
                 ("ticks", "batched decode steps run"),
                 ("tokens_generated", "tokens emitted across all "
@@ -529,11 +674,23 @@ class InferenceServer:
             # when an injector is armed
             fam.labels(point, fn=(lambda p=point: inj.counts[p])
                        if inj is not None else None)
-        self._shed_c = r.counter(
-            "cxn_shed_requests_total",
-            "queued requests shed by the degradation ladder",
-            labelnames=("rung",))
-        self._shed_c.labels("3")        # shedding is the rung-3 effect
+        if self._tenancy is None:
+            self._shed_c = r.counter(
+                "cxn_shed_requests_total",
+                "queued requests shed by the degradation ladder",
+                labelnames=("rung",))
+            self._shed_c.labels("3")    # shedding is the rung-3 effect
+        else:
+            # tenancy armed: sheds are attributed to the tenant too —
+            # the isolation headline ("zero guaranteed sheds under a
+            # best-effort flood") is a direct PromQL query
+            self._shed_c = r.counter(
+                "cxn_shed_requests_total",
+                "queued requests shed by the degradation ladder",
+                labelnames=("rung", "tenant"))
+            for rung in ("3", "4"):
+                for t in self._tenancy.label_names():
+                    self._shed_c.labels(rung, t)
         cb_gauge("cxn_serve_queue_depth", "requests waiting in the "
                  "admission queue", lambda: len(self._queue))
         cb_gauge("cxn_serve_queue_depth_max", "high-water queue depth "
@@ -626,12 +783,29 @@ class InferenceServer:
         # latency histograms (fixed log-spaced buckets -> mergeable
         # across replicas); cxn_serve_phase_seconds was registered with
         # the StepStats observer in __init__
-        self._ttft_h = r.histogram(
-            "cxn_serve_ttft_seconds",
-            "submit -> first token (queue wait included)")
-        self._gap_h = r.histogram(
-            "cxn_serve_token_gap_seconds",
-            "mean inter-token gap per completed request")
+        if self._tenancy is None:
+            self._ttft_h = r.histogram(
+                "cxn_serve_ttft_seconds",
+                "submit -> first token (queue wait included)")
+            self._gap_h = r.histogram(
+                "cxn_serve_token_gap_seconds",
+                "mean inter-token gap per completed request")
+        else:
+            # per-tenant latency series (same names + tenant label,
+            # fixed mergeable buckets): the per-class SLO gauges —
+            # guaranteed p95 TTFT under overload is read straight off
+            # cxn_serve_ttft_seconds{tenant="gold"}
+            self._ttft_h = r.histogram(
+                "cxn_serve_ttft_seconds",
+                "submit -> first token (queue wait included)",
+                labelnames=("tenant",))
+            self._gap_h = r.histogram(
+                "cxn_serve_token_gap_seconds",
+                "mean inter-token gap per completed request",
+                labelnames=("tenant",))
+            for t in self._tenancy.label_names():
+                self._ttft_h.labels(t)
+                self._gap_h.labels(t)
         # the recompile-trip family always exists (pre-touched at 0) so
         # the exported catalog is stable whether or not a guard is armed
         from ..analysis.recompile import trip_counter
@@ -660,6 +834,12 @@ class InferenceServer:
     def ladder(self):
         """The degradation ladder (serve/resilience.py)."""
         return self._ladder
+
+    @property
+    def tenancy(self):
+        """The tenant-policy registry (serve/tenancy.py; None when
+        ``serve_tenants`` is unset — the pinned no-op)."""
+        return self._tenancy
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the full serving catalog
@@ -697,13 +877,19 @@ class InferenceServer:
         like PR 9's single-node replay. Migrations bypass the queue cap
         (the request already held — and lost — capacity on another
         replica) and count into ``cxn_replayed_requests_total``."""
+        if self._tenancy is not None:
+            # re-resolve against THIS server's registry (the dead peer
+            # may have been untenanted or carried labels this fleet
+            # does not know); migrations bypass quotas — the request
+            # already held, and lost, capacity elsewhere
+            req.tenant = self._tenancy.resolve(req.tenant)
         with self._cond:
             if self._failed is not None:
                 raise EngineFailedError(str(self._failed))
             if self._closing:
                 raise AdmissionError("server is shutting down")
             self._queue.append(req)
-            self._counts["submitted"] += 1
+            self._bump("submitted", req)
             self._replayed += 1
             self._queue_depth_max = max(self._queue_depth_max,
                                         len(self._queue))
@@ -718,17 +904,23 @@ class InferenceServer:
         queue-FULL shed path in submit() records the zero-wait sample —
         that one really was turned away at the door by load)."""
         with self._cond:
-            self._counts["rejected"] += 1
+            self._bump("rejected")
         raise AdmissionError(reason)
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
-               block: bool = False, **overrides) -> Request:
+               block: bool = False, tenant: str = "",
+               **overrides) -> Request:
         """Enqueue one generation request; returns an opaque handle for
         :meth:`result`. ``params``/keyword overrides fill a
-        SamplingParams on top of the server defaults. Raises
+        SamplingParams on top of the server defaults. ``tenant`` is the
+        request's tenant label (serve/tenancy.py) — resolved against
+        the ``serve_tenants`` registry when armed (unknown names get
+        the ``default`` policy), ignored otherwise. Raises
         :class:`QueueFullError` when the admission queue is at capacity
-        (``block=True`` waits for space instead) and
-        :class:`AdmissionError` for unservable prompts."""
+        (``block=True`` waits for space instead),
+        :class:`QuotaExceededError` when the tenant is over its rate or
+        queue quota (quotas are hard — they apply to blocking submits
+        too), and :class:`AdmissionError` for unservable prompts."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         seq_len = self._engine.cfg.seq_len
         if prompt.size < 1:
@@ -752,26 +944,83 @@ class InferenceServer:
                          "(server spec drafters: %s)"
                          % (p.spec_mode,
                             ", ".join(sorted(self._drafters)) or "none"))
+        pol = None
+        if self._tenancy is not None:
+            pol = self._tenancy.policy_for(tenant)
+            tenant = pol.name
+            if pol.timeout_ms > 0 and p.timeout_ms <= 0:
+                # the tenant's default deadline; the request's own
+                # timeout always wins
+                p = replace(p, timeout_ms=pol.timeout_ms)
+            if self._paged:
+                limit = pol.block_limit(self._engine.num_blocks - 1)
+                if limit > 0 and \
+                        self._engine.blocks_for(prompt.size + 1) > limit:
+                    # a prompt no amount of waiting fits under the
+                    # tenant's block quota would park in the queue
+                    # forever — reject it NOW, typed, hint 0 (permanent)
+                    with self._cond:
+                        self._bump("rejected", tenant=tenant)
+                        self._bump("quota", tenant=tenant)
+                    self._quota_c.labels(tenant, "blocks").inc()
+                    raise QuotaExceededError(
+                        "tenant %r: prompt needs %d KV blocks, over the "
+                        "tenant block quota of %d"
+                        % (tenant, self._engine.blocks_for(
+                            prompt.size + 1), limit),
+                        tenant=tenant, kind="blocks")
+        if self._inj is not None and self._inj.fire("admit"):
+            # chaos point 'admit': the admission/quota path itself
+            # faults — contained to THIS submit (typed rejection), the
+            # server and every other request are untouched
+            with self._cond:
+                self._bump("rejected", tenant=tenant)
+            raise AdmissionError(
+                str(InjectedFault("chaos point 'admit' fired inside "
+                                  "the admission path")))
+        cls = pol.priority if pol is not None else "standard"
+
+        def _queue_quota_locked():
+            # re-checked after every blocking wait below: N submits of
+            # one tenant parked at the global cap must not ALL append
+            # past the tenant's queue quota as capacity frees
+            if pol is not None and pol.queue > 0 and sum(
+                    1 for r in self._queue
+                    if r.tenant == tenant) >= pol.queue:
+                self._bump("rejected", tenant=tenant)
+                self._bump("quota", tenant=tenant)
+                self._quota_c.labels(tenant, "queue").inc()
+                raise QuotaExceededError(
+                    "tenant %r at its queue quota (%d queued)"
+                    % (tenant, pol.queue),
+                    retry_after_ms=self._retry_after_ms(),
+                    tenant=tenant, kind="queue")
+
         with self._cond:
             if self._failed is not None:
-                self._counts["rejected"] += 1
+                self._bump("rejected", tenant=tenant)
                 raise EngineFailedError(str(self._failed))
             if self._closing:
-                raise AdmissionError("server is shutting down")
+                raise self._draining_error()
+            _queue_quota_locked()
             if self._ladder.shedding and not block and p.timeout_ms > 0 \
-                    and self._ema_req_s > 0:
+                    and self._ema_req_s > 0 \
+                    and cls in self._ladder.shed_classes():
                 # non-blocking submits only: a block=True caller (the
                 # CLI stdin loop) asked to WAIT, and the queue-resident
                 # shed still protects it if its deadline turns hopeless
                 # rung-3 door check: a deadline the current backlog
                 # cannot possibly meet is shed NOW with a back-off
-                # hint, not queued to expire after wasting queue space
+                # hint, not queued to expire after wasting queue space.
+                # Tenant-aware: the door walks classes with the ladder
+                # — guaranteed requests pass until the emergency rung.
                 eta_ms = ((len(self._queue) + 1) * self._ema_req_s
                           / max(1, self._engine.slots)) * 1e3
                 if eta_ms > p.timeout_ms:
-                    self._counts["rejected"] += 1
-                    self._counts["shed"] += 1
-                    self._shed_c.labels(str(self._ladder.rung)).inc()
+                    self._bump("rejected", tenant=tenant)
+                    self._bump("shed", tenant=tenant)
+                    self._inc_shed(tenant if pol is not None
+                                   else DEFAULT_TENANT)
                     self._ladder.sheds += 1
                     self._phase_h.labels(profiler.QUEUE_WAIT).observe(0.0)
                     raise QueueFullError(
@@ -781,7 +1030,7 @@ class InferenceServer:
                         retry_after_ms=self._retry_after_ms())
             while len(self._queue) >= self._queue_cap:
                 if not block:
-                    self._counts["rejected"] += 1
+                    self._bump("rejected", tenant=tenant)
                     self._phase_h.labels(profiler.QUEUE_WAIT).observe(0.0)
                     raise QueueFullError(
                         "admission queue full (%d queued, %d/%d slots "
@@ -793,14 +1042,42 @@ class InferenceServer:
                 if self._failed is not None:
                     raise EngineFailedError(str(self._failed))
                 if self._closing:
-                    raise AdmissionError("server is shutting down")
-            req = Request(next(self._rid), prompt, p, time.perf_counter())
+                    raise self._draining_error()
+                _queue_quota_locked()
+            if pol is not None:
+                # rate limit LAST, once nothing structural can reject:
+                # one token per ADMITTED request (TokenBucket's
+                # contract) — queue-full / quota / shed rejections must
+                # not silently drain the tenant's bucket
+                ok, retry = self._tenancy.take(tenant,
+                                              time.perf_counter())
+                if not ok:
+                    self._bump("rejected", tenant=tenant)
+                    self._bump("quota", tenant=tenant)
+                    self._quota_c.labels(tenant, "rate").inc()
+                    raise QuotaExceededError(
+                        "tenant %r over its rate limit (%g qps)"
+                        % (tenant, pol.qps), retry_after_ms=retry,
+                        tenant=tenant, kind="rate")
+            req = Request(next(self._rid), prompt, p,
+                          time.perf_counter(), tenant=tenant)
             self._queue.append(req)
-            self._counts["submitted"] += 1
+            self._bump("submitted", req)
             self._queue_depth_max = max(self._queue_depth_max,
                                         len(self._queue))
             self._cond.notify_all()
         return req
+
+    def _draining_error(self):
+        """The admission rejection while shutting down: a DRAINING
+        server (graceful preemption — SIGTERM, drain_replica) answers
+        with a back-off hint so clients retry elsewhere or later; an
+        aborting one answers plain (nothing to wait for)."""
+        if self._drain and not self._stopped.is_set():
+            return QueueFullError(
+                "server is draining (graceful shutdown); retry "
+                "elsewhere", retry_after_ms=self._retry_after_ms())
+        return AdmissionError("server is shutting down")
 
     def result(self, handle: Request,
                timeout: Optional[float] = None) -> ServeResult:
@@ -840,8 +1117,8 @@ class InferenceServer:
         for req in self._queue:
             if req.deadline is not None and now > req.deadline:
                 expired.append(req)
-                self._counts["timeout"] += 1
-                self._counts["expired"] += 1
+                self._bump("timeout", req)
+                self._bump("expired", req)
                 # an expired request DID wait — record its full queue
                 # time, or overload reads as low queue-wait percentiles
                 # (only the admitted survivors would contribute). Runs
@@ -927,20 +1204,55 @@ class InferenceServer:
                 # requests popped EARLIER IN THIS PASS (their
                 # allocations run later, outside this lock), so a burst
                 # can't over-admit against a free_count that hasn't
-                # moved yet.
+                # moved yet. Tenancy (serve/tenancy.py): candidates are
+                # walked in (priority class, arrival) order — per-tenant
+                # sub-queues under the FIFO — and a tenant at its
+                # slot/block quota is SKIPPED without blocking other
+                # tenants queued behind it (`t_claims` mirrors `claimed`
+                # per tenant); untenanted, every rank ties and the walk
+                # IS the original FIFO pop.
                 claimed = 0
-                while n_free > 0 and self._queue \
-                        and not sched.swapped_pending \
-                        and sched.admissible(self._queue[0], claimed):
-                    req = self._queue.popleft()
-                    # journal BEFORE any device work: from this moment
-                    # until its terminal state, the request is replayed
-                    # after an engine-fatal fault (serve/resilience.py)
-                    self._journal.add(req)
-                    claimed += sched.admission_claim(req)
-                    admitted.append(req)
-                    n_free -= 1
-                    self._cond.notify_all()   # space for blocked submits
+                t_claims: Dict[str, tuple] = {}
+                if not sched.swapped_pending and n_free > 0 \
+                        and self._queue:
+                    q = list(self._queue)
+                    if self._tenancy is None:
+                        order = range(len(q))
+                    else:
+                        order = sorted(
+                            range(len(q)),
+                            key=lambda i: (sched._rank(q[i]), i))
+                    taken = set()
+                    for i in order:
+                        if n_free <= 0:
+                            break
+                        req = q[i]
+                        if not sched.admissible(req, claimed):
+                            # the first globally-inadmissible candidate
+                            # ends the walk: admission stays orderly
+                            # waiting, never a search for smaller work
+                            break
+                        if sched.tenant_blocked(req, t_claims):
+                            continue        # THIS tenant waits; peers
+                            #                 behind it do not
+                        # journal BEFORE any device work: from this
+                        # moment until its terminal state, the request
+                        # is replayed after an engine-fatal fault
+                        # (serve/resilience.py)
+                        self._journal.add(req)
+                        claimed += sched.admission_claim(req)
+                        if self._tenancy is not None:
+                            cs, cb = t_claims.get(req.tenant, (0, 0))
+                            t_claims[req.tenant] = (
+                                cs + 1, cb + sched.admission_claim(req))
+                        taken.add(i)
+                        admitted.append(req)
+                        n_free -= 1
+                    if taken:
+                        self._queue = collections.deque(
+                            r for i, r in enumerate(q) if i not in taken)
+                        self._cond.notify_all()  # space for blocked
+                        #                          submits
                 if not admitted and sched.active == 0 \
                         and not sched.swapped_pending:
                     if self._closing and not self._queue:
@@ -1032,7 +1344,7 @@ class InferenceServer:
         with self._cond:
             self._closing = True
             for req in self._queue:
-                self._counts[status] += 1
+                self._bump(status, req)
                 req.finish(status, msg)
             self._queue.clear()
             self._cond.notify_all()
@@ -1045,7 +1357,7 @@ class InferenceServer:
         self._sched.cancel_active(status, msg)
         for req in self._journal.requests():
             if not req.done.is_set():
-                self._counts[status] += 1
+                self._bump(status, req)
                 req.finish(status, msg)
         self._journal.clear()
         if self._prefix is not None:
@@ -1249,7 +1561,8 @@ class InferenceServer:
             if self._prefix is not None:
                 free += self._prefix.reclaimable_blocks()
             headroom = free / float(usable)
-        lad.evaluate(qf, headroom)
+        lad.evaluate(qf, headroom,
+                     class_queue_frac=self._class_queue_frac())
         if lad.rung != before:
             self._sched.prefix_admission = lad.prefix_admission
             profiler.warn(
@@ -1260,6 +1573,8 @@ class InferenceServer:
                    if headroom is not None else "n/a",
                    "speculation off" if lad.rung == 1 else
                    "prefix admission off" if lad.rung == 2 else
+                   "EMERGENCY (guaranteed sheddable)"
+                   if lad.rung >= lad.EMERGENCY_RUNG else
                    "shedding" if lad.rung >= 3 else "recovered"
                    if lad.rung == 0 else "degraded"))
             if self._tracer.enabled:
@@ -1289,38 +1604,55 @@ class InferenceServer:
         expiry — the queue space goes to requests that can still make
         it, which is what keeps admitted-request TTFT bounded under
         overload. Requests without deadlines are never shed (they wait
-        by contract)."""
+        by contract).
+
+        Tenant-aware (serve/tenancy.py): classes are walked in inverse
+        priority — ALL doomed best-effort requests are shed (and their
+        queue positions vacated) before any standard request's ETA is
+        even re-evaluated, and guaranteed requests are only sheddable
+        on the emergency rung 4. Untenanted, every request is class
+        ``standard`` and the walk reduces to the original single
+        pass."""
         ema = self._ema_req_s
         if ema <= 0 or not any(r.deadline is not None
                                for r in self._queue):
             return []
-        keep = collections.deque()
         shed: List[Request] = []
         slots = max(1, self._engine.slots)
-        pos = 0
-        for req in self._queue:
-            eta = now + (pos + 1) * ema / slots
-            if req.deadline is not None and eta > req.deadline:
-                retry = self._retry_after_ms()
-                req.retry_after_ms = retry
-                self._counts["shed"] += 1
-                self._ladder.sheds += 1
-                self._shed_c.labels(str(self._ladder.rung)).inc()
-                self._stats.record(profiler.QUEUE_WAIT,
-                                   now - req.submit_t)
-                self._stats.end_step()
-                req.finish("shed",
-                           "load shed at degradation rung %d: estimated "
-                           "admission %.0f ms past deadline; retry "
-                           "after %.0f ms"
-                           % (self._ladder.rung,
-                              (eta - req.deadline) * 1e3, retry))
-                shed.append(req)
-            else:
-                keep.append(req)
-                pos += 1
+        queue = self._queue
+        for cls in self._ladder.shed_classes():
+            if not any(r.deadline is not None
+                       and self._class_of(r) == cls for r in queue):
+                continue
+            keep = collections.deque()
+            pos = 0
+            for req in queue:
+                eta = now + (pos + 1) * ema / slots
+                if req.deadline is not None and eta > req.deadline \
+                        and self._class_of(req) == cls:
+                    retry = self._retry_after_ms()
+                    req.retry_after_ms = retry
+                    self._bump("shed", req)
+                    self._ladder.sheds += 1
+                    self._inc_shed(req.tenant if self._tenancy
+                                   is not None else DEFAULT_TENANT)
+                    self._stats.record(profiler.QUEUE_WAIT,
+                                       now - req.submit_t)
+                    self._stats.end_step()
+                    req.finish(
+                        "shed",
+                        "load shed at degradation rung %d: estimated "
+                        "admission %.0f ms past deadline; retry "
+                        "after %.0f ms"
+                        % (self._ladder.rung,
+                           (eta - req.deadline) * 1e3, retry))
+                    shed.append(req)
+                else:
+                    keep.append(req)
+                    pos += 1
+            queue = keep
         if shed:
-            self._queue = keep
+            self._queue = queue
             self._cond.notify_all()
             if self._tracer.enabled:
                 self._tracer.instant("shed", TID_CONTROL,
@@ -1356,17 +1688,22 @@ class InferenceServer:
                                if self._ladder.shedding else 0.0),
             "watchdog_ms": self._watchdog_ms,
             "chaos": self._inj.spec if self._inj is not None else "",
+            # tenancy (serve/tenancy.py): which classes the current
+            # rung may shed, and per-class queue fractions (None /
+            # empty when serve_tenants is unset)
+            "shed_classes": list(self._ladder.shed_classes()),
+            "class_queue_frac": self._class_queue_frac(),
         }
 
     def _record_done(self, req: Request) -> None:
         """Scheduler on_finish hook (scheduler-thread only)."""
         self._journal.remove(req)       # terminal: nothing to replay
         if req.status != "ok":
-            self._counts["cancelled" if req.status == "cancelled"
-                         else req.status] += 1
+            self._bump("cancelled" if req.status == "cancelled"
+                       else req.status, req)
             self._maybe_slow(req)
             return
-        self._counts["completed"] += 1
+        self._bump("completed", req)
         if req.admit_t is not None:
             # EMA of admit->done feeds the shed / retry_after estimates
             dur = req.done_t - req.admit_t
@@ -1374,12 +1711,12 @@ class InferenceServer:
                 else 0.2 * dur + 0.8 * self._ema_req_s
         ttft = req.first_token_t - req.submit_t
         self._ttft_s.append(ttft)
-        self._ttft_h.observe(ttft)
+        self._hist(self._ttft_h, req).observe(ttft)
         if len(req.tokens) > 1:
             gap = ((req.done_t - req.first_token_t)
                    / (len(req.tokens) - 1))
             self._tok_gap_s.append(gap)
-            self._gap_h.observe(gap)
+            self._hist(self._gap_h, req).observe(gap)
         self._maybe_slow(req)
 
     def _maybe_slow(self, req: Request) -> None:
@@ -1526,6 +1863,16 @@ class InferenceServer:
                                    / max(1, sc.spec_forwards)),
             "spec_forwards": sc.spec_forwards,
             "spec_backoffs": sc.spec_backoffs,
+            # multi-tenant SLOs (serve/tenancy.py): per-tenant request
+            # counters + live usage, None when serve_tenants is unset
+            "tenants": ({t: {
+                "priority": self._tenancy.policy_for(t).priority,
+                "requests": dict(self._tcounts[t]),
+                "queue_depth": self._tenant_queued(t),
+                "slots": sc.tenant_usage(t)[0],
+                "blocks": sc.tenant_usage(t)[1],
+            } for t in self._tenancy.label_names()}
+                if self._tenancy is not None else None),
             "prefix_cache_bytes": pc.nbytes if pc is not None else 0,
             "prefix_cache": ({
                 "budget_bytes": pc.budget, "bytes": pc.nbytes,
@@ -1545,6 +1892,9 @@ class InferenceServer:
             self._tok_gap_s.clear()
             self._queue_depth_max = 0
             self._counts = {k: 0 for k in self._counts}
+            if self._tcounts is not None:
+                self._tcounts = {t: dict.fromkeys(row, 0)
+                                 for t, row in self._tcounts.items()}
         self._stats.clear()
         self._sched.ticks = 0
         self._sched.active_row_ticks = 0
@@ -1576,8 +1926,14 @@ class InferenceServer:
         # ttft_seconds_count > completed_total (the callback counters
         # read the zeroed dicts, the histograms would still carry the
         # warm pass)
-        self._ttft_h.reset()
-        self._gap_h.reset()
+        if self._tenancy is None:
+            self._ttft_h.reset()
+            self._gap_h.reset()
+        else:
+            for fam_name in ("cxn_serve_ttft_seconds",
+                             "cxn_serve_token_gap_seconds"):
+                for _, child in self._registry.get(fam_name).children():
+                    child.reset()
         for _, child in self._registry.get(
                 "cxn_serve_phase_seconds").children():
             child.reset()

@@ -1,0 +1,252 @@
+"""Multi-tenant SLO policy: per-tenant quotas, priority classes, and
+rate limits for the serving stack (doc/serving.md "Multi-tenant SLOs").
+
+One server multiplexes many products over one block pool and one
+admission queue; before this module every overload decision was
+*global* — one FIFO, one headroom gate, one degradation ladder — so a
+burst of best-effort traffic degraded paying tenants first-come-first-
+served. This module makes tenancy a first-class scheduler dimension:
+
+* :class:`TenantPolicy` — one tenant's contract: a **priority class**
+  (``guaranteed`` > ``standard`` > ``best_effort``) that orders
+  admission, preemption, and shedding; a **queue quota** (max requests
+  resident in the admission queue); a **slot quota** (max concurrently
+  admitted scheduler slots); a **KV-block quota** (absolute blocks or a
+  ``%`` of the usable pool, charged at admission); a **token-bucket
+  rate limit** (``qps`` + ``burst``) whose rejections carry a
+  ``retry_after_ms`` computed from the bucket's refill time; and a
+  **default deadline** applied to requests that submit without one.
+
+* :class:`TenantRegistry` — the parsed ``serve_tenants`` spec plus an
+  untenanted ``default`` policy (standard priority, no quotas), so a
+  request with no — or an unknown — tenant label is still governed.
+  **An empty spec yields no registry at all**: ``serve_tenants`` unset
+  is a pinned no-op (the scheduler and server skip every tenancy
+  branch; existing suites are bit-identical).
+
+Spec grammar (tenants separated by ``;``, fields by ``,``)::
+
+    serve_tenants = gold:prio=G,blocks=40%,qps=50;free:prio=B,queue=4
+
+    name:field=value,...      one tenant's policy
+    prio=G|S|B                guaranteed | standard | best_effort
+                              (full names accepted)
+    blocks=N | blocks=P%      KV-block quota: absolute, or percent of
+                              the usable pool
+    qps=R [,burst=N]          token-bucket rate limit (burst defaults
+                              to max(1, ceil(R)))
+    queue=N                   max queued (not yet admitted) requests
+    slots=N                   max concurrently admitted slots
+    timeout_ms=X              default queue deadline for the tenant's
+                              requests (a request's own timeout wins)
+
+A tenant literally named ``default`` REPLACES the untenanted policy —
+how an operator assigns a class/quota to unlabeled traffic.
+
+Enforcement sites: rate + queue quotas at ``InferenceServer.submit``
+(typed ``QuotaExceededError``); slot + block quotas inside the
+scheduler pass (a tenant at quota is *skipped*, never blocking peers
+behind it in the queue); the preemption victim order and rung-3/4
+shedding walk classes inverse-priority (serve/scheduler.py,
+serve/server.py, serve/resilience.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["TenantPolicy", "TenantRegistry", "TokenBucket",
+           "PRIORITIES", "PRIORITY_RANK", "DEFAULT_TENANT"]
+
+# priority classes, best first; rank orders preemption/shedding — a
+# HIGHER rank is sacrificed first (best_effort before standard before
+# guaranteed)
+PRIORITIES = ("guaranteed", "standard", "best_effort")
+PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+DEFAULT_TENANT = "default"
+
+_PRIO_ALIASES = {
+    "g": "guaranteed", "guaranteed": "guaranteed",
+    "s": "standard", "standard": "standard",
+    "b": "best_effort", "be": "best_effort",
+    "best_effort": "best_effort", "besteffort": "best_effort",
+}
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``rate`` tokens/sec refill up to
+    ``burst`` capacity; one token per admitted request. The caller
+    supplies ``now`` (seconds, any monotonic clock), which makes the
+    bucket exactly reproducible on a fake clock — the property the
+    rate-limit tests pin. ``rate <= 0`` admits everything."""
+
+    def __init__(self, rate: float, burst: float = 0.0):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0,
+                                                        math.ceil(rate))
+        self.tokens = self.burst
+        self._t: Optional[float] = None
+
+    def take(self, now: float) -> Tuple[bool, float]:
+        """Try to take one token at time ``now``. Returns
+        ``(admitted, retry_after_ms)``: on rejection the hint is the
+        exact refill time until one whole token is available — the
+        honest back-off, not a guess."""
+        if self.rate <= 0:
+            return True, 0.0
+        if self._t is not None and now > self._t:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True, 0.0
+        return False, (1.0 - self.tokens) / self.rate * 1e3
+
+
+@dataclasses.dataclass
+class TenantPolicy:
+    """One tenant's SLO contract (module docstring). Zero means
+    'unlimited' for every quota field."""
+    name: str
+    priority: str = "standard"
+    queue: int = 0              # max queued (unadmitted) requests
+    slots: int = 0              # max concurrently admitted slots
+    blocks: float = 0.0         # KV-block quota (absolute count)
+    blocks_frac: float = 0.0    # ...or a fraction of the usable pool
+    qps: float = 0.0            # token-bucket rate (requests/sec)
+    burst: float = 0.0          # bucket capacity (0 = auto)
+    timeout_ms: float = 0.0     # default queue deadline
+
+    def __post_init__(self):
+        if self.priority not in PRIORITIES:
+            raise ValueError("tenant %r: priority must be one of %s, "
+                             "got %r" % (self.name, "/".join(PRIORITIES),
+                                         self.priority))
+
+    @property
+    def rank(self) -> int:
+        """Sacrifice order: higher rank is preempted/shed first."""
+        return PRIORITY_RANK[self.priority]
+
+    def block_limit(self, usable: int) -> int:
+        """The tenant's block quota against a pool of ``usable``
+        allocatable blocks (0 = unlimited)."""
+        if self.blocks_frac > 0:
+            return max(1, int(self.blocks_frac * usable))
+        return int(self.blocks)
+
+
+def _parse_policy(item: str) -> TenantPolicy:
+    name, sep, body = item.partition(":")
+    name = name.strip()
+    if not sep or not name:
+        raise ValueError("serve_tenants: malformed tenant %r (want "
+                         "name:field=value,...)" % item)
+    kw: Dict[str, object] = {}
+    for field in body.split(","):
+        field = field.strip()
+        if not field:
+            continue
+        key, fsep, val = field.partition("=")
+        key = key.strip().lower()
+        val = val.strip()
+        if not fsep:
+            raise ValueError("serve_tenants: tenant %r: malformed "
+                             "field %r (want key=value)" % (name, field))
+        if key in ("prio", "priority"):
+            prio = _PRIO_ALIASES.get(val.lower())
+            if prio is None:
+                raise ValueError(
+                    "serve_tenants: tenant %r: unknown priority %r "
+                    "(want G/S/B or %s)" % (name, val,
+                                            "/".join(PRIORITIES)))
+            kw["priority"] = prio
+        elif key == "blocks":
+            if val.endswith("%"):
+                frac = float(val[:-1]) / 100.0
+                if not 0.0 < frac <= 1.0:
+                    raise ValueError("serve_tenants: tenant %r: blocks "
+                                     "percent must be in (0, 100], got "
+                                     "%r" % (name, val))
+                kw["blocks_frac"] = frac
+            else:
+                kw["blocks"] = float(val)
+        elif key == "qps":
+            kw["qps"] = float(val)
+        elif key == "burst":
+            kw["burst"] = float(val)
+        elif key == "queue":
+            kw["queue"] = int(val)
+        elif key == "slots":
+            kw["slots"] = int(val)
+        elif key == "timeout_ms":
+            kw["timeout_ms"] = float(val)
+        else:
+            raise ValueError("serve_tenants: tenant %r: unknown field "
+                             "%r (fields: prio, blocks, qps, burst, "
+                             "queue, slots, timeout_ms)" % (name, key))
+    return TenantPolicy(name=name, **kw)
+
+
+class TenantRegistry:
+    """The parsed tenant catalog + per-tenant token buckets. Requests
+    whose tenant label matches no policy resolve to the ``default``
+    policy (standard priority, no quotas, unless the spec overrides the
+    ``default`` tenant explicitly). Bucket state is guarded by the
+    server's admission lock — the registry itself adds none."""
+
+    def __init__(self, policies: List[TenantPolicy]):
+        self.policies: Dict[str, TenantPolicy] = {}
+        for pol in policies:
+            if pol.name in self.policies:
+                raise ValueError("serve_tenants: duplicate tenant %r"
+                                 % pol.name)
+            self.policies[pol.name] = pol
+        if DEFAULT_TENANT not in self.policies:
+            self.policies[DEFAULT_TENANT] = TenantPolicy(DEFAULT_TENANT)
+        self._buckets = {name: TokenBucket(p.qps, p.burst)
+                         for name, p in self.policies.items()}
+
+    @classmethod
+    def from_spec(cls, spec) -> Optional["TenantRegistry"]:
+        """Parse a ``serve_tenants`` spec; empty -> None (tenancy fully
+        off costs nothing — no object, no checks beyond ``is not
+        None``). A TenantRegistry instance passes through."""
+        if isinstance(spec, TenantRegistry):
+            return spec
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        return cls([_parse_policy(item) for item in spec.split(";")
+                    if item.strip()])
+
+    # ------------------------------------------------------------ lookup
+    def policy_for(self, name: str) -> TenantPolicy:
+        return self.policies.get(name or DEFAULT_TENANT,
+                                 self.policies[DEFAULT_TENANT])
+
+    def resolve(self, name: str) -> str:
+        """The label value a request carries: its own tenant name when
+        registered, else ``default`` — so metric labels and scheduler
+        accounting never key on unknown strings."""
+        return self.policy_for(name).name
+
+    def rank_of(self, name: str) -> int:
+        return self.policy_for(name).rank
+
+    def class_of(self, name: str) -> str:
+        return self.policy_for(name).priority
+
+    def take(self, name: str, now: float) -> Tuple[bool, float]:
+        """One token-bucket roll for ``name``'s resolved policy (caller
+        holds the admission lock)."""
+        return self._buckets[self.resolve(name)].take(now)
+
+    def label_names(self) -> List[str]:
+        """Every label value this registry can emit, sorted — the
+        stable metric catalog (pre-touched at registration)."""
+        return sorted(self.policies)

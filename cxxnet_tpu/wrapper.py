@@ -199,7 +199,7 @@ class Net:
                     max_restarts: int = 3, watchdog_ms: float = 0.0,
                     degrade: bool = True, tp: int = 0,
                     replicas: int = 1, router_policy: str = "prefix",
-                    **defaults) -> None:
+                    tenants: str = "", **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -263,7 +263,16 @@ class Net:
         (serve/router.py; ``router_policy`` ∈ prefix | rr) — submit /
         result / metrics / health keep working, failover replays live
         requests on survivors, and :meth:`metrics_text` becomes the
-        merged per-replica scrape payload."""
+        merged per-replica scrape payload.
+
+        Multi-tenant SLOs (serve/tenancy.py, doc/serving.md
+        "Multi-tenant SLOs"): ``tenants`` is the ``serve_tenants``
+        policy spec — per-tenant priority classes (guaranteed /
+        standard / best_effort), queue/slot/KV-block quotas,
+        token-bucket rate limits with ``retry_after_ms`` refill hints,
+        and default deadlines; requests opt in via
+        ``serve_submit(tenant=...)``. Empty (the default) is a pinned
+        no-op — the untenanted server is bit-identical."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams, ServeRouter
         if getattr(self, "_server", None) is not None:
@@ -282,7 +291,7 @@ class Net:
             paged=paged, block_size=block_size, num_blocks=num_blocks,
             kv_mb=kv_mb, fused_attn=fused_attn, chaos=chaos,
             max_restarts=max_restarts, watchdog_ms=watchdog_ms,
-            degrade=degrade, tp=tp,
+            degrade=degrade, tp=tp, tenants=tenants,
             defaults=SamplingParams(**defaults))
         if replicas > 1:
             # each replica owns its registry; the merged payload is
@@ -307,12 +316,16 @@ class Net:
         return srv
 
     def serve_submit(self, prompt: Array, block: bool = False,
-                     **params):
+                     tenant: str = "", **params):
         """Enqueue one request -> handle (per-request ``params`` override
-        the serve_start defaults). Raises serve.QueueFullError when the
-        bounded admission queue is full, unless ``block=True``."""
+        the serve_start defaults; ``tenant`` labels the request when
+        ``serve_start(tenants=...)`` armed the policy registry).
+        Raises serve.QueueFullError when the bounded admission queue is
+        full (unless ``block=True``) and serve.QuotaExceededError when
+        the tenant is over its rate or queue quota."""
         return self._serving().submit(np.asarray(prompt, np.int64),
-                                      block=block, **params)
+                                      block=block, tenant=tenant,
+                                      **params)
 
     def serve_result(self, handle, timeout=None):
         """Block for a handle's ServeResult (status / full token

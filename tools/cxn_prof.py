@@ -72,6 +72,18 @@ _DEFAULT_CELL_TOL = {
     #                                         this regresses DOWN from
     #                                         ~1.0 only when failover
     #                                         breaks
+    "serve_goodput_guaranteed_overload": 0.05,  # the guaranteed
+    #                                         tenant's completion
+    #                                         fraction under 3x
+    #                                         overload: pinned ~1.0 —
+    #                                         any drop means the SLO
+    #                                         isolation broke
+    "serve_p95_ttft_ms_guaranteed_overload": 0.30,  # open-loop
+    #                                         overload trace on a
+    #                                         shared-core rig:
+    #                                         scheduler-timing noise
+    #                                         dominates (the ms unit
+    #                                         regresses UP)
     "gpt_decode_spec_ms_per_token": 0.20,
     "obs_overhead_pct": 1.0,        # a percentage-point-scale cell:
     #                                 gate it on the <= 2% budget in
