@@ -40,6 +40,9 @@ RULES = {
     "CXN208": ("error", "explicit index clip materialized as a "
                         "standalone entry-computation clamp instead of "
                         "folding into its gather/scatter fusion"),
+    "CXN209": ("error", "int8 operand silently promoted to f32 inside a "
+                        "bf16 quantized step (dequant must target the "
+                        "compute dtype)"),
 }
 
 

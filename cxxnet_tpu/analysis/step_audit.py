@@ -132,6 +132,22 @@ def entry_clamp_count(hlo_text: str) -> int:
     return n
 
 
+_INT8_PROMOTE_RE = re.compile(
+    r"convert\s+[^:\n]*:\s*\(tensor<[^>]*xi8>\)\s*->\s*tensor<[^>]*xf32>")
+
+
+def int8_promotions(stable: str) -> int:
+    """StableHLO converts of an i8 tensor STRAIGHT to f32. Inside a
+    bf16 quantized serve program (serve_int8_weights /
+    serve_kv_dtype=int8) every int8 operand must dequantize to the
+    COMPUTE dtype — int8 values are exact in bf16's 8 mantissa bits, so
+    an i8->f32 convert means some op silently widened the quantized
+    stream (doubling the very bytes quantization halved) instead of
+    computing in bf16; CXN209 names it. f32-compute configs are exempt:
+    there f32 IS the dequant target."""
+    return len(_INT8_PROMOTE_RE.findall(stable))
+
+
 def format_step_info(info: Dict) -> str:
     """One human line per audited step's info dict (the single renderer —
     task=lint, the CXN_LINT hook, and tools/cxn_lint.py all print this)."""
@@ -146,6 +162,12 @@ def format_step_info(info: Dict) -> str:
         line += " clip=%s" % ("folded" if info["entry_clamps"] == 0
                               else "%d materialized"
                               % info["entry_clamps"])
+    if "int8_promotions" in info:
+        # the quantized-serve audit's dequant-dtype assertion (CXN209):
+        # "clean" means no int8 operand widened to f32 in a bf16 step
+        line += " int8=%s" % ("clean" if info["int8_promotions"] == 0
+                              else "%d promoted"
+                              % info["int8_promotions"])
     if info.get("shardings"):
         # a sharded audit names its input placements, so the step table
         # shows the executable was partitioned (not a 1-device lookalike)
@@ -158,13 +180,17 @@ def audit_jit(fn, args: tuple, label: str,
               static_argnums: Sequence[int] = (),
               collective_budget: Optional[int] = None,
               compile_budget_s: Optional[float] = None,
-              check_clip: bool = False) -> Tuple[List[Finding], Dict]:
+              check_clip: bool = False,
+              check_int8: bool = False) -> Tuple[List[Finding], Dict]:
     """Audit one jitted function AOT. Returns (findings, info) where info
     carries the raw counts ({"collectives", "donated", "aliased"}) plus
     the step's measured AOT lower+compile seconds ("compile_s") — the
     compile-time baseline the AOT-executable-cache roadmap item needs,
     gated in CI by ``compile_budget_s`` (CXN207) the same way
-    collective counts are by ``lint_collective_budget``."""
+    collective counts are by ``lint_collective_budget``.
+    ``check_int8`` (bf16 quantized serve programs) additionally asserts
+    no int8 operand is silently promoted to f32 (CXN209,
+    :func:`int8_promotions`)."""
     import time
     import warnings
     findings: List[Finding] = []
@@ -276,6 +302,16 @@ def audit_jit(fn, args: tuple, label: str,
                 "NOT fold into its gather/scatter fusion, so every "
                 "step pays an extra HLO pass the engine documents as "
                 "free" % (label, info["entry_clamps"])))
+    if check_int8:
+        info["int8_promotions"] = int8_promotions(stable)
+        if info["int8_promotions"] > 0:
+            findings.append(Finding(
+                "CXN209", "%s: %d int8 operand(s) converted straight "
+                "to f32 inside a bf16 quantized step — the dequant "
+                "must target the compute dtype (int8 is exact in "
+                "bf16), or the step silently re-widens the very "
+                "stream quantization halved"
+                % (label, info["int8_promotions"])))
     return findings, info
 
 
@@ -381,13 +417,23 @@ def audit_serve_engine(engine, n_prompt: int = 8,
     report = LintReport()
     infos = []
     paged = bool(getattr(engine, "paged", False))
+    # quantized engines (serve_int8_weights / serve_kv_dtype=int8) with
+    # bf16 compute additionally assert no int8 operand is silently
+    # promoted to f32 (CXN209, the `int8=clean` column) — the audited
+    # rows ARE the int8 variants: lint_specs hands over the engine's
+    # own quantized blocks and (values, scales) pool structs
+    quant = bool(getattr(engine, "int8_weights", False)
+                 or getattr(engine, "kv_int8", False))
+    check_int8 = quant and getattr(engine, "cfg", None) is not None \
+        and engine.cfg.dtype == "bfloat16"
     for label, fn, args, donate_nums in engine.lint_specs(
             n_prompt=n_prompt, donate=donate):
         findings, info = audit_jit(fn, args, label,
                                    donate_argnums=donate_nums,
                                    collective_budget=collective_budget,
                                    compile_budget_s=compile_budget_s,
-                                   check_clip=paged)
+                                   check_clip=paged,
+                                   check_int8=check_int8)
         report.extend(findings)
         infos.append(info)
     return report, infos

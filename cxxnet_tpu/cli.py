@@ -103,6 +103,20 @@ class LearnTask:
         #                             gather formulation, the
         #                             bit-reference; CXN_FUSED_ATTN=0
         #                             env force-disables too)
+        self.serve_int8_weights = 0     # stream the serve programs'
+        #                                 block matmul weights int8-
+        #                                 quantized (per-out-column,
+        #                                 quantized once at engine
+        #                                 build; speculative verify
+        #                                 included; 0 = full-precision
+        #                                 weights, a pinned no-op)
+        self.serve_kv_dtype = ""  # KV block-pool stored dtype: "" =
+        #                           the compute dtype; "int8" = per-
+        #                           block-scaled int8 (values, scales)
+        #                           pairs — ~2x tokens per serve_kv_mb,
+        #                           halved swap bandwidth; paged only
+        #                           (doc/serving.md "Quantized
+        #                           serving")
         self.serve_chaos = ""     # fault-injection spec (chaos harness;
         #                           grammar in serve/resilience.py, e.g.
         #                           "tick_raise:0.01,seed:7"; the
@@ -271,6 +285,10 @@ class LearnTask:
             self.serve_kv_mb = float(val)
         elif name == "serve_fused_attn":
             self.serve_fused_attn = int(val)
+        elif name == "serve_int8_weights":
+            self.serve_int8_weights = int(val)
+        elif name == "serve_kv_dtype":
+            self.serve_kv_dtype = val
         elif name == "serve_chaos":
             self.serve_chaos = val
         elif name == "serve_max_restarts":
@@ -940,13 +958,16 @@ class LearnTask:
                 nb = (self.serve_num_blocks or auto_num_blocks(
                     gcfg, 2, self.serve_prefill_chunk,
                     block_size=self.serve_block_size,
-                    kv_mb=self.serve_kv_mb))
+                    kv_mb=self.serve_kv_mb,
+                    kv_dtype=self.serve_kv_dtype))
             eng = DecodeEngine(gcfg, gparams, slots=2,
                                prefill_chunk=self.serve_prefill_chunk,
                                spec_len=max(1, self.spec_len),
                                num_blocks=nb,
                                block_size=self.serve_block_size,
-                               fused_attn=bool(self.serve_fused_attn))
+                               fused_attn=bool(self.serve_fused_attn),
+                               int8_weights=bool(self.serve_int8_weights),
+                               kv_dtype=self.serve_kv_dtype)
             table.merge(devprof.profile_engine(
                 eng, registry=reg, time_reps=self.prof_reps))
             eng.close()
@@ -1008,6 +1029,8 @@ class LearnTask:
                          num_blocks=self.serve_num_blocks,
                          kv_mb=self.serve_kv_mb,
                          fused_attn=bool(self.serve_fused_attn),
+                         int8_weights=bool(self.serve_int8_weights),
+                         kv_dtype=self.serve_kv_dtype,
                          recompile_limit=self.net.lint_recompile_limit,
                          recompile_strict=bool(
                              self.net.lint_recompile_strict),
@@ -1042,15 +1065,18 @@ class LearnTask:
                 if self.serve_paged:
                     eng = (srv.servers[0] if routed else srv)._engine
                     mode += (", paged KV (%d blocks x %d tokens, "
-                             "%.1f MiB, %s attention)"
+                             "%.1f MiB %s, %s attention)"
                              % (eng.num_blocks, eng.block_size,
                                 eng.cache_bytes() / 2.0 ** 20,
+                                eng.kv_dtype,
                                 "fused" if eng.fused_attn
                                 else "gather"))
             else:
                 mode = "whole-prompt prefill, prefix cache off"
             if self.serve_tp > 1:
                 mode += ", tp=%d (KV head-sharded)" % self.serve_tp
+            if self.serve_int8_weights:
+                mode += ", int8 weights"
             if routed:
                 mode += ", %d replicas (%s router)" % (
                     self.serve_replicas, self.serve_router)

@@ -187,13 +187,31 @@ def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     return h + o + p["b_proj"].astype(x.dtype)
 
 
+def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str):
+    """``x @ p[wk]`` with the int8 weight-streaming dequant applied when
+    ``p`` carries the matching per-out-column scale ``sk`` (the
+    _quantize_decode_blocks scheme: dequant commutes with the
+    contraction, so ONE row-scale lands after the matmul). Without the
+    scale key this is exactly the pre-existing cast-and-matmul — the
+    scale check is a static (trace-time) dict lookup, so unquantized
+    programs are byte-for-byte unchanged. The int8 weight converts to
+    the COMPUTE dtype (never silently to f32 — the CXN209 audit
+    contract; int8 values are exactly representable in bf16's 8
+    mantissa bits)."""
+    y = x @ p[wk].astype(x.dtype)
+    if sk in p:
+        y = y * p[sk].astype(x.dtype)
+    return y
+
+
 def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce,
               pre=lambda x: x):
     """MLP half of the pre-LN block (LN2 -> up -> relu -> down ->
     residual)."""
     x = pre(_layernorm(h, p["ln2_g"], p["ln2_b"]))
-    m = jax.nn.relu(x @ p["w_mlp1"].astype(x.dtype) + p["b_mlp1"].astype(x.dtype))
-    m = reduce(m @ p["w_mlp2"].astype(x.dtype))
+    m = jax.nn.relu(_qmat(x, p, "w_mlp1", "s_mlp1")
+                    + p["b_mlp1"].astype(x.dtype))
+    m = reduce(_qmat(m, p, "w_mlp2", "s_mlp2"))
     return h + m + p["b_mlp2"].astype(x.dtype)
 
 
@@ -691,12 +709,12 @@ def _block_core_fusedqkv(p: Dict[str, jnp.ndarray], h: jnp.ndarray,
     concat re-runs inside scan/remat and measured 7% SLOWER (round 2)."""
     b, n, _ = h.shape
     x = _layernorm(h, p["ln1_g"], p["ln1_b"])
-    qkv = x @ p["w_qkv"].astype(x.dtype) + p["b_qkv"].astype(x.dtype)
+    qkv = _qmat(x, p, "w_qkv", "s_qkv") + p["b_qkv"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     d = q.shape[-1] // n_head
     att, aux = attn(q.reshape(b, n, n_head, d), k.reshape(b, n, n_head, d),
                     v.reshape(b, n, n_head, d))
-    o = reduce(att.reshape(b, n, -1) @ p["w_proj"].astype(x.dtype))
+    o = reduce(_qmat(att.reshape(b, n, -1), p, "w_proj", "s_proj"))
     return _mlp_core(p, h + o + p["b_proj"].astype(x.dtype), reduce), aux
 
 
@@ -970,9 +988,13 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     draft_params), "stats": {}}`` (``stats`` is filled with
     accept_rate / forwards / drafted on return). Greedy output is
     bit-identical to the non-speculative scan; sampled output is
-    identical in distribution. The speculative path runs on the XLA
-    decode formulation (it shares the serving engine's programs), so
-    ``int8_weights`` does not compose with it and is rejected."""
+    identical in distribution. ``int8_weights`` COMPOSES with it since
+    the quantized-serving round: the verify/tick programs stream the
+    per-out-column int8 weights through the XLA formulation
+    (serve/engine.py), so greedy speculative-int8 output is
+    bit-identical to the engine's own non-speculative int8 stream —
+    int8 is a weight-fidelity choice, speculation a scheduling choice,
+    and the two no longer exclude each other."""
     n_prompt = int(prompt.shape[1])
     if max_new < 1:
         raise ValueError("max_new must be >= 1, got %d" % max_new)
@@ -986,10 +1008,6 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     if not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1], got %g" % top_p)
     if speculative:
-        if int8_weights:
-            raise ValueError("speculative decoding runs the XLA decode "
-                             "path; int8_weights needs the fused kernel "
-                             "— pick one")
         # lazy import: serve imports models.gpt at module load, so the
         # reverse edge must stay inside this branch
         import numpy as np
@@ -1000,7 +1018,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         return jnp.asarray(speculative_decode(
             params, np.asarray(prompt, np.int32), max_new, cfg,
             temperature=float(temperature), rng=rng, top_k=int(top_k),
-            top_p=float(top_p), spec=spec))
+            top_p=float(top_p), spec=spec,
+            int8_weights=bool(int8_weights)))
     if temperature <= 0:
         # the filters are inert on the greedy path; normalizing them out
         # of the _decode_fn cache key avoids compiling duplicate

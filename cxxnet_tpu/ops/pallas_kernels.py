@@ -1506,7 +1506,13 @@ def paged_attention_geometry_ok(n_head: int, bpr: int, block_size: int,
     — auditing a fused program production would never run pins the
     wrong executable."""
     s = bpr * block_size
-    if 2 * n_head * s * head_dim * itemsize > 12 * 1024 * 1024:
+    vmem = 2 * n_head * s * head_dim * itemsize
+    if itemsize == 1:
+        # per-block-scaled int8 pool (serve_kv_dtype=int8): the row
+        # image also holds the two scale planes — budget them at f32,
+        # the widest compute dtype they can carry
+        vmem += 2 * n_head * s * 4
+    if vmem > 12 * 1024 * 1024:
         return False
     return head_dim % 128 in (0, 64) and block_size % 8 == 0
 
@@ -1530,29 +1536,49 @@ def paged_attention_supported(n_head: int, bpr: int, block_size: int,
                                        head_dim, itemsize)
 
 
-def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                       k_scr, v_scr, *, bs: int, bpr: int, n_head: int,
-                       rows: int):
+def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                       bs: int, bpr: int, n_head: int, rows: int,
+                       quant: bool = False):
     """One grid step = one (slot row, logical block): copy the DMA'd
     physical block into the row image scratch; the LAST block of each
     row runs the attention over the completed image. Scalar-prefetched
     ``table`` drives the block DMAs (the index_map reads it), so the
-    gather IS the block pipeline — no HBM intermediate ever exists."""
+    gather IS the block pipeline — no HBM intermediate ever exists.
+
+    ``quant`` (serve_kv_dtype=int8): two extra operands/scratches carry
+    the per-(head, token) scale planes; the block copy moves the stored
+    int8 payload (half the DMA bytes — the point), and the finalize
+    step dequantizes the completed row image IN VMEM exactly as the
+    gather formulation's ``engine._kv_dequant`` does (int8 -> the scale
+    dtype, times the scale, THEN the attention's f32 cast), so
+    interpret mode stays bit-exact against the gather reference."""
+    if quant:
+        sk_ref, sv_ref, o_ref, k_scr, v_scr, sk_scr, sv_scr = rest
+    else:
+        o_ref, k_scr, v_scr = rest
     i = pl.program_id(0)
     j = pl.program_id(1)
     k_scr[:, pl.dslice(j * bs, bs), :] = k_ref[0, 0]
     v_scr[:, pl.dslice(j * bs, bs), :] = v_ref[0, 0]
+    if quant:
+        sk_scr[:, pl.dslice(j * bs, bs)] = sk_ref[0, 0]
+        sv_scr[:, pl.dslice(j * bs, bs)] = sv_ref[0, 0]
 
     @pl.when(j == bpr - 1)
     def _finalize():
         s_len = bpr * bs
         d = q_ref.shape[-1]
+        if quant:
+            kk = k_scr[:].astype(sk_scr.dtype) * sk_scr[:][..., None]
+            vv = v_scr[:].astype(sv_scr.dtype) * sv_scr[:][..., None]
+        else:
+            kk, vv = k_scr[:], v_scr[:]
         # EXACT mirror of _attn_cached_rows/_attn_verify (serve/engine
         # .py): head-major f32 q, ONE head-batched dot (batch dim 0 =
         # heads — the einsum's own contraction), then / sqrt(d)
         qh = jnp.swapaxes(q_ref[0], 0, 1).astype(jnp.float32)  # (H, R, d)
         sc = jax.lax.dot_general(
-            qh, k_scr[:].astype(jnp.float32),
+            qh, kk.astype(jnp.float32),
             (((2,), (2,)), ((0,), (0,)))) / (d ** 0.5)         # (H, R, S)
         kpos = jax.lax.broadcasted_iota(jnp.int32,
                                         (n_head, rows, s_len), 2)
@@ -1561,13 +1587,13 @@ def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         w = jax.nn.softmax(jnp.where(kpos <= qpos, sc, _NEG_INF),
                            axis=-1)
         o = jax.lax.dot_general(
-            w, v_scr[:].astype(jnp.float32),
+            w, vv.astype(jnp.float32),
             (((2,), (1,)), ((0,), (0,))))                      # (H, R, d)
         o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
 
 
 def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
-                    block_size: int):
+                    block_size: int, scale_k=None, scale_v=None):
     """Fused block-table gather + cached attention for the paged decode
     programs. ``q`` (b, R, H, d) — R = 1 for the batched tick, K+1 for
     the draft-and-verify step; ``pool_k``/``pool_v`` the WHOLE
@@ -1575,37 +1601,59 @@ def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
     ``layer`` are ever DMA'd); ``table`` (b, bpr) int32 physical block
     ids; ``pos`` (b,) int32 — query r of row i is masked at absolute
     position ``pos[i] + r``, the union of the tick's (R=1) and the
-    verify's masking semantics. Returns (b, R, H, d) in q's dtype."""
+    verify's masking semantics. Returns (b, R, H, d) in q's dtype.
+
+    ``scale_k``/``scale_v`` (both or neither): the (L, num_blocks, H,
+    bs) scale planes of a per-block-scaled int8 pool
+    (serve_kv_dtype=int8) — the kernel then DMAs int8 payload blocks
+    plus their scales and dequantizes the row image in VMEM
+    (_paged_attn_kernel ``quant`` path)."""
     b, rows, n_head, d = q.shape
     bpr = table.shape[1]
     bs = int(block_size)
+    quant = scale_k is not None
     kern = functools.partial(_paged_attn_kernel, bs=bs, bpr=bpr,
-                             n_head=n_head, rows=rows)
+                             n_head=n_head, rows=rows, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, rows, n_head, d),
+                     lambda i, j, tab, pp: (i, 0, 0, 0)),
+        pl.BlockSpec((1, 1, n_head, bs, d),
+                     lambda i, j, tab, pp: (layer, tab[i, j],
+                                            0, 0, 0)),
+        pl.BlockSpec((1, 1, n_head, bs, d),
+                     lambda i, j, tab, pp: (layer, tab[i, j],
+                                            0, 0, 0)),
+    ]
+    scratch = [
+        pltpu.VMEM((n_head, bpr * bs, d), pool_k.dtype),
+        pltpu.VMEM((n_head, bpr * bs, d), pool_v.dtype),
+    ]
+    operands = (table, pos, q, pool_k, pool_v)
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, n_head, bs),
+                         lambda i, j, tab, pp: (layer, tab[i, j], 0, 0)),
+            pl.BlockSpec((1, 1, n_head, bs),
+                         lambda i, j, tab, pp: (layer, tab[i, j], 0, 0)),
+        ]
+        scratch += [
+            pltpu.VMEM((n_head, bpr * bs), scale_k.dtype),
+            pltpu.VMEM((n_head, bpr * bs), scale_v.dtype),
+        ]
+        operands += (scale_k, scale_v)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, bpr),
-        in_specs=[
-            pl.BlockSpec((1, rows, n_head, d),
-                         lambda i, j, tab, pp: (i, 0, 0, 0)),
-            pl.BlockSpec((1, 1, n_head, bs, d),
-                         lambda i, j, tab, pp: (layer, tab[i, j],
-                                                0, 0, 0)),
-            pl.BlockSpec((1, 1, n_head, bs, d),
-                         lambda i, j, tab, pp: (layer, tab[i, j],
-                                                0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rows, n_head, d),
                                lambda i, j, tab, pp: (i, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((n_head, bpr * bs, d), pool_k.dtype),
-            pltpu.VMEM((n_head, bpr * bs, d), pool_v.dtype),
-        ],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kern, grid_spec=grid_spec,
         out_shape=_out_struct((b, rows, n_head, d), q.dtype, q),
         interpret=_INTERPRET,
-    )(table, pos, q, pool_k, pool_v)
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,7 @@ submit API with replay-based failover and merged metrics.
 """
 
 from .engine import (DecodeEngine, assert_fused_allclose, auto_num_blocks,
-                     fused_attn_tolerance)
+                     fused_attn_tolerance, kv_int8_tolerance)
 from .paged import BlockManager, BlockPoolExhausted
 from .prefix_cache import PagedPrefixCache, PrefixCache
 from .resilience import (DegradationLadder, EngineFailedError,
@@ -34,7 +34,8 @@ __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SlotScheduler", "DecodeEngine", "PrefixCache",
            "PagedPrefixCache", "BlockManager", "BlockPoolExhausted",
            "auto_num_blocks", "fused_attn_tolerance",
-           "assert_fused_allclose", "AdmissionError", "QueueFullError",
+           "assert_fused_allclose", "kv_int8_tolerance",
+           "AdmissionError", "QueueFullError",
            "QuotaExceededError", "NgramDrafter", "ModelDrafter",
            "SpeculativeDecoder", "FaultInjector", "DegradationLadder",
            "InjectedFault", "SwapCorruptionError", "EngineFailedError",

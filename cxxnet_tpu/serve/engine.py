@@ -87,6 +87,22 @@ The K/V scatter (and with it every cache byte) is shared with the
 gather path; garbage block 0 and parked rows mask to an exact 0.0
 inside the kernel exactly as they do outside it.
 
+**Quantized serving** (``serve_int8_weights`` / ``serve_kv_dtype=int8``,
+doc/serving.md "Quantized serving"; both OFF by default and pinned
+no-ops there): weights quantize ONCE at engine build — per-out-column
+symmetric int8 with f32 scales, the offline fused decode's exact scheme
+(models/gpt.py:_quantize_decode_blocks) — and stream through all three
+programs via the scale-aware matmul in ``_block_core_fusedqkv``/
+``_qmat``; the paged KV pool can independently store per-block-scaled
+int8 as a ``(values, scales)`` pair (one symmetric scale per (layer,
+block, head, token)), quantized on scatter and dequantized on gather in
+BOTH the gather and the fused attention formulations, so every pool
+byte — ``kv_blocks``, the trie's shared blocks, ``swap_host`` — is the
+stored int8 representation (~2x tokens per MiB, halved swap bandwidth,
+crc-verified bit-exact round trips). Accuracy lives under the ONE
+:func:`kv_int8_tolerance` contract; the dequant targets the COMPUTE
+dtype, never silently f32 (the CXN209 audit).
+
 Recycled-slot safety: every attention mask admits only positions <= the
 querying row's own position, and every admitted position was written by
 THIS request — a prefix-cache copy, one of its own prefill chunks, or
@@ -151,7 +167,7 @@ import numpy as np
 from jax import lax
 
 from ..models.gpt import (GPTConfig, _block_core_fusedqkv, _fuse_qkv_blocks,
-                          _layernorm)
+                          _layernorm, _quantize_decode_blocks)
 from ..obs.devprof import compile_attribution
 from ..ops.attention import local_attention
 from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
@@ -160,8 +176,8 @@ from .paged import BlockPoolExhausted
 from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
 __all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
-           "assert_fused_allclose", "serve_param_shardings",
-           "serve_kv_sharding", "serve_tp_size"]
+           "assert_fused_allclose", "kv_int8_tolerance",
+           "serve_param_shardings", "serve_kv_sharding", "serve_tp_size"]
 
 
 def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
@@ -206,14 +222,58 @@ def assert_fused_allclose(actual, desired, err_msg: str = "") -> None:
         err_msg=err_msg, **tol)
 
 
-def _paged_geometry(cfg, prefill_chunk: int, block_size: int):
+def kv_int8_tolerance() -> Dict[str, float]:
+    """The ONE numeric contract of per-block-scaled int8 KV (the
+    ``serve_kv_dtype=int8`` pool), the quantized analogue of
+    :func:`fused_attn_tolerance` — every int8-KV differential test
+    pins through THESE numbers instead of ad-hoc settings:
+
+    * ``rtol`` / ``atol`` — per-op band for a dequantized attention
+      read against the full-precision reference. Symmetric per-(head,
+      token) scaling bounds the element error by ``scale / 2`` =
+      ``max|v| / 254`` per stored value; softmax averaging keeps the
+      attention output inside ~1% of the reference on O(1) values.
+    * ``greedy_flip`` — the bounded greedy-divergence budget: the max
+      fraction of LOCKSTEP decode steps (both engines fed the same
+      context) whose argmax may differ between the int8-KV engine and
+      the full-precision engine. Tiny random-init test models sit near
+      the uniform-logits worst case, so the budget is deliberately
+      loose; a plumbing bug (wrong scale axis, swapped K/V) flips far
+      more than this.
+    * ``chi2_sig`` — significance level for the sampled-mode
+      chi-squared pin (int8-engine sample distribution vs the
+      full-precision engine's at matched sample sizes).
+
+    With quantization OFF (``serve_kv_dtype`` unset) nothing here
+    applies: the pools hold the compute dtype and every bit-identity
+    suite pins the no-op."""
+    return {"rtol": 2e-2, "atol": 2e-2, "greedy_flip": 0.35,
+            "chi2_sig": 1e-3}
+
+
+def _kv_itemsizes(cfg, kv_int8: bool):
+    """(value itemsize, per-token-per-head scale overhead bytes) of one
+    stored KV position — the dtype-aware half of the paged-geometry
+    formula. int8 pools store 1-byte values plus one compute-dtype
+    scale per (layer, block, head, token); full-precision pools store
+    compute-dtype values and no scales."""
+    citem = 2 if cfg.dtype == "bfloat16" else 4
+    return (1, citem) if kv_int8 else (citem, 0)
+
+
+def _paged_geometry(cfg, prefill_chunk: int, block_size: int,
+                    kv_dtype: str = ""):
     """The ONE source of paged-cache geometry — ``(chunk, block_size,
     row_len, blocks_per_row, block_bytes)`` — shared by
     :func:`auto_num_blocks`, the :class:`DecodeEngine` ctor, and
     :meth:`DecodeEngine.block_bytes`, so a sizing budget can never
     desynchronize from the engine's actual block layout. Validates the
     paged preconditions (chunked prefill on, block size divides the
-    seq_len-clamped chunk)."""
+    seq_len-clamped chunk). ``kv_dtype`` makes ``block_bytes``
+    dtype-aware: ``"int8"`` prices the per-block-scaled int8 layout
+    (1-byte values + one compute-dtype scale per head per token), so a
+    ``serve_kv_mb`` budget buys ~2x the blocks and the DeviceLedger's
+    ``kv_blocks`` prediction still reconciles bit-for-bit."""
     chunk = min(int(prefill_chunk), cfg.seq_len)
     if chunk <= 0:
         raise ValueError(
@@ -229,15 +289,16 @@ def _paged_geometry(cfg, prefill_chunk: int, block_size: int):
             "min(serve_prefill_chunk, seq_len))"
             % (int(block_size), chunk, cfg.seq_len))
     row_len = (cfg.seq_len + chunk - 1) // chunk * chunk
-    itemsize = 2 if cfg.dtype == "bfloat16" else 4
+    itemsize, scale_bytes = _kv_itemsizes(
+        cfg, str(kv_dtype).lower() == "int8")
     block_bytes = (2 * cfg.n_layer * cfg.n_head * bs
-                   * (cfg.feat // cfg.n_head) * itemsize)
+                   * ((cfg.feat // cfg.n_head) * itemsize + scale_bytes))
     return chunk, bs, row_len, row_len // bs, block_bytes
 
 
 def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
                     block_size: int = 0, prefix_mb: float = 0.0,
-                    kv_mb: float = 0.0) -> int:
+                    kv_mb: float = 0.0, kv_dtype: str = "") -> int:
     """Block-pool sizing for the paged engine — the ONE formula the
     server, the CLI, and the lint tool share (geometry from
     :func:`_paged_geometry`, the same helper the engine ctor uses). An
@@ -249,9 +310,12 @@ def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
     ``slots`` rows so a huge trie budget cannot balloon the pool) plus
     the reserved garbage block — a strict superset of what the dense
     pool could ever hold, so the default upgrade never loses capacity
-    (doc/serving.md memory formula)."""
+    (doc/serving.md memory formula). ``kv_dtype="int8"`` sizes by the
+    QUANTIZED block itemsize: the same ``serve_kv_mb`` budget yields
+    ~2x the blocks (doc/serving.md "Quantized serving")."""
     _, _, _, bpr, block_bytes = _paged_geometry(cfg, prefill_chunk,
-                                                block_size)
+                                                block_size,
+                                                kv_dtype=kv_dtype)
     if kv_mb > 0:
         return int(kv_mb * (1 << 20) // block_bytes)
     prefix_blocks = int(prefix_mb * (1 << 20) // block_bytes)
@@ -291,7 +355,12 @@ def serve_param_shardings(mesh):
     blocks = {"w_qkv": col, "b_qkv": vec, "w_proj": col,
               "w_mlp1": col, "b_mlp1": vec, "w_mlp2": col,
               "ln1_g": rep, "ln1_b": rep, "ln2_g": rep, "ln2_b": rep,
-              "b_proj": rep, "b_mlp2": rep}
+              "b_proj": rep, "b_mlp2": rep,
+              # int8 weight streaming (serve_int8_weights): the (L, out)
+              # per-out-column dequant scales shard with their matmul's
+              # OUTPUT dim — the scale multiply is elementwise on the
+              # sharded dim, applied BEFORE the gather re-replication
+              "s_qkv": vec, "s_proj": vec, "s_mlp1": vec, "s_mlp2": vec}
     outer = {k: rep for k in ("emb", "pos", "lnf_g", "lnf_b", "head")}
     return blocks, outer
 
@@ -716,23 +785,100 @@ def _insert_prefix_fn(cfg_key: tuple, n_tokens: int, donate: bool):
 # placement (the RecompileGuard pins it).
 
 
+# int8 KV codec (serve_kv_dtype=int8): a quantized pool is the pytree
+# (values int8, scales compute-dtype) instead of one compute-dtype
+# array — scales shaped like the values minus the head_dim axis, one
+# symmetric scale per (layer, block, head, token). Tuple-ness is part
+# of jit's abstract signature, so the SAME program builders serve both
+# layouts (a quantized engine is a different compiled program, counted
+# as such — the RecompileGuard signature carries /kv=int8). Quantize
+# happens ON SCATTER (the one place a position's K/V is produced),
+# dequantize ON GATHER (the one place it is consumed), so the stored
+# representation IS the int8 payload — which is what lets the swap
+# crc32 checksums of PR 9 verify a quantized round trip bit-exactly.
+
+
+def _kv_quant(val, sdtype):
+    """Per-(…, head, token) symmetric int8 quantization of a K/V write:
+    ``scale = max|v| / 127`` over head_dim, rounded to the STORED scale
+    dtype first so dequant uses exactly the scale quantization used
+    (values clipped to ±127 — a scale that rounded down must not wrap
+    the int8 payload)."""
+    a = val.astype(jnp.float32)
+    s = (jnp.max(jnp.abs(a), axis=-1) / 127.0).astype(sdtype)
+    sf = jnp.maximum(s.astype(jnp.float32), 1e-12)
+    q = jnp.clip(jnp.round(a / sf[..., None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, s
+
+
+def _kv_dequant(q, s):
+    """Inverse of :func:`_kv_quant` in the COMPUTE dtype (``s.dtype``):
+    int8 values are exact in bf16's 8 mantissa bits, so the product is
+    one rounding step — never a silent f32 promotion (CXN209)."""
+    return q.astype(s.dtype) * s[..., None]
+
+
+def _layer_pool(pool, l):
+    """Layer ``l``'s slice of a pool in either layout (array or the
+    int8 (values, scales) pair)."""
+    if isinstance(pool, tuple):
+        return pool[0][l], pool[1][l]
+    return pool[l]
+
+
+def _scatter_kv(pool, l, blk, off, val):
+    """Scatter one K/V write — ``val`` (…, H, d) at (layer ``l``, block
+    ``blk``, offset ``off``) — into either pool layout, quantizing on
+    the way in for an int8 pool."""
+    if isinstance(pool, tuple):
+        qp, sp = pool
+        q, s = _kv_quant(val, sp.dtype)
+        return (qp.at[l, blk, :, off, :].set(q),
+                sp.at[l, blk, :, off].set(s))
+    return pool.at[l, blk, :, off, :].set(val)
+
+
 def _gather_row(pool, table, n_head, bs):
     """One row's logical K or V cache (1, H, row_len, d) gathered from
-    the pool through its (bpr,) block table."""
-    blk = pool[table]                               # (bpr, H, bs, d)
-    hd = pool.shape[-1]
+    the (layer-sliced) pool through its (bpr,) block table,
+    dequantized on the way out for an int8 pool."""
+    if isinstance(pool, tuple):
+        qp, sp = pool
+        blk = _kv_dequant(qp[table], sp[table])     # (bpr, H, bs, d)
+    else:
+        blk = pool[table]
+    hd = blk.shape[-1]
     return jnp.transpose(blk, (1, 0, 2, 3)).reshape(
         n_head, table.shape[0] * bs, hd)[None]
 
 
 def _gather_rows(pool, table, n_head, bs):
     """All slot rows' logical caches (slots, H, row_len, d) gathered
-    from the pool through the (slots, bpr) block table."""
-    blk = pool[table]                               # (b, bpr, H, bs, d)
+    from the (layer-sliced) pool through the (slots, bpr) block table,
+    dequantized on the way out for an int8 pool."""
+    if isinstance(pool, tuple):
+        qp, sp = pool
+        blk = _kv_dequant(qp[table], sp[table])     # (b, bpr, H, bs, d)
+    else:
+        blk = pool[table]
     b, bpr = table.shape
-    hd = pool.shape[-1]
+    hd = blk.shape[-1]
     return jnp.transpose(blk, (0, 2, 1, 3, 4)).reshape(
         b, n_head, bpr * bs, hd)
+
+
+def _paged_attn(q, pool_k, pool_v, table, pos, l, bs):
+    """Route the fused Pallas block-table-walk attention over either
+    pool layout: an int8 pool hands the kernel its scale planes too, so
+    the in-VMEM dequant mirrors :func:`_kv_dequant` op for op (the
+    interpret-mode differential pins it bit-exact against the gather
+    formulation)."""
+    from ..ops.pallas_kernels import paged_attention
+    if isinstance(pool_k, tuple):
+        return paged_attention(q, pool_k[0], pool_v[0], table, pos, l,
+                               bs, scale_k=pool_k[1], scale_v=pool_v[1])
+    return paged_attention(q, pool_k, pool_v, table, pos, l, bs)
 
 
 @functools.lru_cache(maxsize=16)
@@ -775,17 +921,19 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
             p = {k: w[l] for k, w in blocks.items()}
 
             def attn(q, k, v, l=l):
-                # scatter each row's (H, d) K/V into its own block, then
-                # attend: fused = the Pallas block-table walk; gather =
+                # scatter each row's (H, d) K/V into its own block
+                # (quantize-on-scatter for an int8 pool), then attend:
+                # fused = the Pallas block-table walk; gather =
                 # materialize the logical rows and reuse the dense math
-                pk = pin_kv(pool_k.at[l, blk, :, off, :].set(k[:, 0]))
-                pv = pin_kv(pool_v.at[l, blk, :, off, :].set(v[:, 0]))
+                pk = pin_kv(_scatter_kv(pool_k, l, blk, off, k[:, 0]))
+                pv = pin_kv(_scatter_kv(pool_v, l, blk, off, v[:, 0]))
                 if fused:
-                    from ..ops.pallas_kernels import paged_attention
-                    return paged_attention(q, pk, pv, table, pos, l,
-                                           bs), (pk, pv)
-                ck = _gather_rows(pk[l], table, cfg.n_head, bs)
-                cv = _gather_rows(pv[l], table, cfg.n_head, bs)
+                    return _paged_attn(q, pk, pv, table, pos, l,
+                                       bs), (pk, pv)
+                ck = _gather_rows(_layer_pool(pk, l), table, cfg.n_head,
+                                  bs)
+                cv = _gather_rows(_layer_pool(pv, l), table, cfg.n_head,
+                                  bs)
                 return gather(_attn_cached_rows(q, ck, cv, pos)), (pk, pv)
 
             h, (pool_k, pool_v) = _block_core_fusedqkv(
@@ -829,10 +977,12 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
             p = {k: w[l] for k, w in blocks.items()}
 
             def attn(q, k, v, l=l):
-                pk = pin_kv(pool_k.at[l, blkw, :, offw, :].set(k[0]))
-                pv = pin_kv(pool_v.at[l, blkw, :, offw, :].set(v[0]))
-                row_k = _gather_row(pk[l], table, cfg.n_head, bs)
-                row_v = _gather_row(pv[l], table, cfg.n_head, bs)
+                pk = pin_kv(_scatter_kv(pool_k, l, blkw, offw, k[0]))
+                pv = pin_kv(_scatter_kv(pool_v, l, blkw, offw, v[0]))
+                row_k = _gather_row(_layer_pool(pk, l), table,
+                                    cfg.n_head, bs)
+                row_v = _gather_row(_layer_pool(pv, l), table,
+                                    cfg.n_head, bs)
                 return gather(_attn_chunk(q, row_k, row_v, start)), \
                     (pk, pv)
 
@@ -879,15 +1029,16 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
             p = {k: w[l] for k, w in blocks.items()}
 
             def attn(q, k, v, l=l):
-                pk = pin_kv(pool_k.at[l, blkw, :, offw, :].set(k[0]))
-                pv = pin_kv(pool_v.at[l, blkw, :, offw, :].set(v[0]))
+                pk = pin_kv(_scatter_kv(pool_k, l, blkw, offw, k[0]))
+                pv = pin_kv(_scatter_kv(pool_v, l, blkw, offw, v[0]))
                 if fused:
-                    from ..ops.pallas_kernels import paged_attention
-                    return paged_attention(
+                    return _paged_attn(
                         q, pk, pv, table[None],
                         jnp.reshape(pos, (1,)), l, bs), (pk, pv)
-                row_k = _gather_row(pk[l], table, cfg.n_head, bs)
-                row_v = _gather_row(pv[l], table, cfg.n_head, bs)
+                row_k = _gather_row(_layer_pool(pk, l), table,
+                                    cfg.n_head, bs)
+                row_v = _gather_row(_layer_pool(pv, l), table,
+                                    cfg.n_head, bs)
                 return gather(_attn_verify(q, row_k, row_v, pos)), \
                     (pk, pv)
 
@@ -931,11 +1082,20 @@ def _copy_block_fn(cfg_key: tuple, bs: int, donate: bool):
     size = (cfg.n_layer, 1, cfg.n_head, bs, hd)
 
     def impl(pool_k, pool_v, src, dst):
-        bk = lax.dynamic_slice(pool_k, (0, src, 0, 0, 0), size)
-        bv = lax.dynamic_slice(pool_v, (0, src, 0, 0, 0), size)
-        pk = lax.dynamic_update_slice(pool_k, bk, (0, dst, 0, 0, 0))
-        pv = lax.dynamic_update_slice(pool_v, bv, (0, dst, 0, 0, 0))
-        return pk, pv
+        def cp(pool):
+            if isinstance(pool, tuple):
+                # int8 pool: the COW copy moves the STORED
+                # representation — payload and scales — so the private
+                # copy is bit-identical to the shared original
+                q, s = pool
+                bq = lax.dynamic_slice(q, (0, src, 0, 0, 0), size)
+                bsc = lax.dynamic_slice(s, (0, src, 0, 0), size[:-1])
+                return (lax.dynamic_update_slice(q, bq, (0, dst, 0, 0, 0)),
+                        lax.dynamic_update_slice(s, bsc, (0, dst, 0, 0)))
+            b = lax.dynamic_slice(pool, (0, src, 0, 0, 0), size)
+            return lax.dynamic_update_slice(pool, b, (0, dst, 0, 0, 0))
+
+        return cp(pool_k), cp(pool_v)
 
     return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
@@ -948,7 +1108,15 @@ def _gather_blocks_fn(cfg_key: tuple, bs: int, bpr: int):
     signature for every row size; pools NOT donated (the pool keeps
     serving)."""
     def impl(pool_k, pool_v, ids):
-        return pool_k[:, ids], pool_v[:, ids]   # (L, bpr, H, bs, d)
+        def g(pool):                            # (L, bpr, H, bs, d)
+            if isinstance(pool, tuple):
+                # int8 pool: the swap buffer carries the stored
+                # representation (payload + scales), so the round trip
+                # — and PR 9's crc32 over it — is bit-exact
+                return pool[0][:, ids], pool[1][:, ids]
+            return pool[:, ids]
+
+        return g(pool_k), g(pool_v)
 
     return jax.jit(impl)
 
@@ -961,7 +1129,13 @@ def _scatter_blocks_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
     garbage block (id 0), which exists to absorb exactly this kind of
     write."""
     def impl(pool_k, pool_v, bufk, bufv, ids):
-        return pool_k.at[:, ids].set(bufk), pool_v.at[:, ids].set(bufv)
+        def sc(pool, buf):
+            if isinstance(pool, tuple):
+                return (pool[0].at[:, ids].set(buf[0]),
+                        pool[1].at[:, ids].set(buf[1]))
+            return pool.at[:, ids].set(buf)
+
+        return sc(pool_k, bufk), sc(pool_v, bufv)
 
     return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
@@ -980,7 +1154,8 @@ class DecodeEngine:
                  recompile_strict: bool = True, abstract: bool = False,
                  spec_len: int = 0, obs_registry=None,
                  num_blocks: int = 0, block_size: int = 0,
-                 injector=None, fused_attn: bool = True, mesh=None):
+                 injector=None, fused_attn: bool = True, mesh=None,
+                 int8_weights: bool = False, kv_dtype: str = ""):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -1007,14 +1182,65 @@ class DecodeEngine:
         single-device programs run untouched, but the engine's params
         and caches are committed to that mesh's device — how the
         router places replica i on its own device block instead of
-        every replica defaulting onto device 0."""
+        every replica defaulting onto device 0.
+
+        Quantized serving (doc/serving.md "Quantized serving"):
+        ``int8_weights`` quantizes the fused block matmul weights ONCE
+        at engine build (per-out-column symmetric int8,
+        models/gpt.py:_quantize_decode_blocks) and streams them through
+        every program — chunk prefill, tick, AND the speculative
+        verify — halving the per-token weight traffic the decode step
+        is bound by. ``kv_dtype="int8"`` (paged engines only) stores
+        the block pool per-block-scaled int8: each pool becomes a
+        ``(values int8, scales)`` pair with one symmetric scale per
+        (layer, block, head, token), quantized on scatter and
+        dequantized on gather inside the same fused/gather attention
+        formulations — ~2x tokens per MiB in ``kv_blocks``, the trie's
+        shared blocks, and ``swap_host`` (the swap record carries the
+        stored int8 representation, so PR 9's crc32 checksums verify
+        the quantized round trip bit-exactly). Accuracy is pinned by
+        :func:`kv_int8_tolerance`; both knobs default OFF and are
+        pinned no-ops there (every bit-identity suite runs against
+        the unquantized programs)."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
             raise ValueError("feat %d not divisible by n_head %d"
                              % (cfg.feat, cfg.n_head))
+        kv = str(kv_dtype or "").lower()
+        if kv in ("", "auto", "bf16", "bfloat16", "f32", "float32"):
+            if kv in ("bf16", "bfloat16") and cfg.dtype != "bfloat16":
+                raise ValueError(
+                    "serve_kv_dtype=bf16 under an f32 model config: the "
+                    "full-precision pool always stores the COMPUTE "
+                    "dtype (leave serve_kv_dtype unset, or set "
+                    "dtype=bfloat16)")
+            if kv in ("f32", "float32") and cfg.dtype == "bfloat16":
+                raise ValueError(
+                    "serve_kv_dtype=f32 under a bfloat16 model config: "
+                    "the full-precision pool always stores the COMPUTE "
+                    "dtype (leave serve_kv_dtype unset)")
+            self.kv_int8 = False
+        elif kv == "int8":
+            if int(num_blocks) <= 0:
+                raise ValueError(
+                    "serve_kv_dtype=int8 requires the paged KV cache "
+                    "(serve_paged=1 with chunked prefill): the dense "
+                    "slot pool keeps the compute dtype")
+            self.kv_int8 = True
+        else:
+            raise ValueError(
+                "serve_kv_dtype must be one of '', 'auto', 'bf16', "
+                "'f32', 'int8', got %r" % (kv_dtype,))
+        self.int8_weights = bool(int8_weights)
         self.tp = serve_tp_size(mesh)
         self.mesh = mesh if self.tp > 1 else None
+        if self.kv_int8 and self.tp > 1:
+            raise ValueError(
+                "serve_kv_dtype=int8 does not compose with serve_tp>1 "
+                "yet: the (values, scales) pool pair needs per-leaf "
+                "head-axis shardings the TP constraint hooks don't "
+                "carry — shard OR quantize the KV pool, not both")
         if self.tp > 1:
             if cfg.n_head % self.tp:
                 raise ValueError(
@@ -1067,7 +1293,8 @@ class DecodeEngine:
         self.num_blocks = int(num_blocks) if self.paged else 0
         if self.paged:
             _, self.block_size, row_len_g, _, self._block_bytes = \
-                _paged_geometry(cfg, prefill_chunk, block_size)
+                _paged_geometry(cfg, prefill_chunk, block_size,
+                                kv_dtype="int8" if self.kv_int8 else "")
             assert row_len_g == self.row_len
         else:
             self.block_size = 0
@@ -1078,6 +1305,17 @@ class DecodeEngine:
         # abstract engine fuses shapes only — no device concat
         self._blocks = (jax.eval_shape(_fuse_qkv_blocks, params["blocks"])
                         if abstract else _fuse_qkv_blocks(params["blocks"]))
+        if self.int8_weights:
+            # quantize ONCE at engine build (per-out-column symmetric
+            # int8 + f32 scales, the offline decode's exact scheme) —
+            # the engine then holds ONLY the int8 weights, so resident
+            # weight memory halves along with the per-token stream; the
+            # programs pick the scale keys up statically in
+            # _block_core_fusedqkv/_qmat (models/gpt.py)
+            self._blocks = (jax.eval_shape(_quantize_decode_blocks,
+                                           self._blocks)
+                            if abstract
+                            else _quantize_decode_blocks(self._blocks))
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
                                               "lnf_b", "head")}
         if self.tp > 1:
@@ -1110,12 +1348,20 @@ class DecodeEngine:
             rep = NamedSharding(mesh, PartitionSpec())
             self._blocks = jax.device_put(self._blocks, rep)
             self._outer = jax.device_put(self._outer, rep)
-        # RecompileGuard signatures carry the mesh shape: the same
-        # program traced over two mesh shapes is two compiled
-        # executables, and the guard must count it as such
+        # RecompileGuard signatures carry the mesh shape AND the
+        # quantization dtypes: the same program traced over two mesh
+        # shapes — or over int8 vs full-precision operands — is two
+        # compiled executables, and the guard must count it as such (an
+        # int8 and a bf16 engine in one process are distinct single
+        # signatures; unlike the fused/gather flag, dtype changes the
+        # abstract signature for real, so it belongs in the string)
         self._sig_suffix = ("/mesh=%s" % "x".join(
             str(s) for s in self.mesh.devices.shape)) if self.tp > 1 \
             else ""
+        if self.int8_weights:
+            self._sig_suffix += "/w=int8"
+        if self.kv_int8:
+            self._sig_suffix += "/kv=int8"
         hd = cfg.feat // cfg.n_head
         if self.paged:
             self.bpr = self.row_len // self.block_size
@@ -1134,7 +1380,9 @@ class DecodeEngine:
             self.fused_attn = bool(fused_attn) and \
                 paged_attention_supported(
                     cfg.n_head // self.tp, self.bpr, self.block_size, hd,
-                    2 if cfg.dtype == "bfloat16" else 4) and self.tp == 1
+                    1 if self.kv_int8
+                    else (2 if cfg.dtype == "bfloat16" else 4)) \
+                and self.tp == 1
             shape = (cfg.n_layer, self.num_blocks, cfg.n_head,
                      self.block_size, hd)
             # host-side bookkeeping (free list, refcounts, tables);
@@ -1158,18 +1406,46 @@ class DecodeEngine:
             # leaves are ShapeDtypeStructs, so lint_specs can AOT-lower
             # every program without allocating a single device byte;
             # prefill/tick calls on such an engine are a usage error
-            self.cache_k = jax.ShapeDtypeStruct(shape, self.dtype,
-                                                sharding=kv_sh)
-            self.cache_v = jax.ShapeDtypeStruct(shape, self.dtype,
-                                                sharding=kv_sh)
+            if self.kv_int8:
+                sshape = shape[:-1]
+                self.cache_k = (jax.ShapeDtypeStruct(shape, jnp.int8),
+                                jax.ShapeDtypeStruct(sshape, self.dtype))
+                self.cache_v = (jax.ShapeDtypeStruct(shape, jnp.int8),
+                                jax.ShapeDtypeStruct(sshape, self.dtype))
+            else:
+                self.cache_k = jax.ShapeDtypeStruct(shape, self.dtype,
+                                                    sharding=kv_sh)
+                self.cache_v = jax.ShapeDtypeStruct(shape, self.dtype,
+                                                    sharding=kv_sh)
         elif kv_sh is not None:
             # head-sharded pool: each shard holds n_head / tp whole
             # heads of every block/row — 1/tp of the KV bytes per chip,
-            # the serving-memory lever TP exists for
-            self.cache_k = jax.device_put(jnp.zeros(shape, self.dtype),
-                                          kv_sh)
-            self.cache_v = jax.device_put(jnp.zeros(shape, self.dtype),
-                                          kv_sh)
+            # the serving-memory lever TP exists for (int8 pools are
+            # rejected with tp > 1 above; a placement-only mesh commits
+            # the pair wholesale, P() fits any rank)
+            if self.kv_int8:
+                sshape = shape[:-1]
+                self.cache_k = jax.device_put(
+                    (jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, self.dtype)), kv_sh)
+                self.cache_v = jax.device_put(
+                    (jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, self.dtype)), kv_sh)
+            else:
+                self.cache_k = jax.device_put(jnp.zeros(shape, self.dtype),
+                                              kv_sh)
+                self.cache_v = jax.device_put(jnp.zeros(shape, self.dtype),
+                                              kv_sh)
+        elif self.kv_int8:
+            # per-block-scaled int8 pool: (values, scales) pair — one
+            # symmetric scale per (layer, block, head, token) in the
+            # compute dtype, quantize-on-scatter / dequantize-on-gather
+            # (_scatter_kv / _gather_row[s])
+            sshape = shape[:-1]
+            self.cache_k = (jnp.zeros(shape, jnp.int8),
+                            jnp.zeros(sshape, self.dtype))
+            self.cache_v = (jnp.zeros(shape, jnp.int8),
+                            jnp.zeros(sshape, self.dtype))
         else:
             self.cache_k = jnp.zeros(shape, self.dtype)
             self.cache_v = jnp.zeros(shape, self.dtype)
@@ -1366,6 +1642,14 @@ class DecodeEngine:
              tick_args, nums))
         return specs
 
+    @property
+    def kv_dtype(self) -> str:
+        """The pool's STORED dtype name — ``"int8"`` for the quantized
+        (values, scales) layout, else the compute dtype."""
+        if self.kv_int8:
+            return "int8"
+        return "bf16" if self.cfg.dtype == "bfloat16" else "f32"
+
     def cache_bytes(self) -> int:
         """KV-cache device bytes. Dense: 2 * layers * slots * heads *
         row_len * head_dim * itemsize (row_len is chunk-padded seq_len),
@@ -1373,10 +1657,17 @@ class DecodeEngine:
         Paged: 2 * layers * num_blocks * heads * block_size * head_dim *
         itemsize — the WHOLE pool, prefix-cache-resident blocks
         included, since the trie's shared blocks live inside it
-        (doc/serving.md memory formula)."""
+        (doc/serving.md memory formula). An int8 pool sums its stored
+        leaves — 1-byte values plus the compute-dtype scale planes — so
+        the DeviceLedger's ``kv_blocks`` prediction reconciles against
+        ``jax.live_arrays()`` under quantization too."""
         if self.cache_k is None:        # closed (metrics after shutdown)
             return 0
-        return 2 * self.cache_k.size * self.cache_k.dtype.itemsize
+        total = 0
+        for cache in (self.cache_k, self.cache_v):
+            for leaf in (cache if isinstance(cache, tuple) else (cache,)):
+                total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        return total
 
     def close(self) -> None:
         """Drop the cache buffers (the server calls this at shutdown)."""
@@ -1692,6 +1983,20 @@ class DecodeEngine:
         ids[:n] = m.table[slot, :n]
         fn = _gather_blocks_fn(self._cfg_key, self.block_size, self.bpr)
         bk, bv = fn(self.cache_k, self.cache_v, jnp.asarray(ids))
+        if self.kv_int8:
+            # the swap record carries the STORED representation — the
+            # int8 payload plus its scale planes — so the host round
+            # trip moves half the bytes and the crc covers exactly the
+            # bits swap-in scatters back (bit-exact by construction)
+            qk = np.asarray(bk[0])[:, :n].copy()
+            sk = np.asarray(bk[1])[:, :n].copy()
+            qv = np.asarray(bv[0])[:, :n].copy()
+            sv = np.asarray(bv[1])[:, :n].copy()
+            m.release_row(slot)
+            return {"k": qk, "ks": sk, "v": qv, "vs": sv, "n": n,
+                    "nbytes": (qk.nbytes + sk.nbytes + qv.nbytes
+                               + sv.nbytes),
+                    "crc": swap_checksum(qk, sk, qv, sv)}
         bk = np.asarray(bk)[:, :n].copy()
         bv = np.asarray(bv)[:, :n].copy()
         m.release_row(slot)
@@ -1717,7 +2022,9 @@ class DecodeEngine:
             # below must catch it (the injected flip, not the raise,
             # is the fault: it exercises the detection path)
             rec["k"].view(np.uint8).flat[0] ^= 0xFF
-        if "crc" in rec and swap_checksum(rec["k"], rec["v"]) != rec["crc"]:
+        if "crc" in rec and swap_checksum(
+                rec["k"], rec.get("ks"), rec["v"],
+                rec.get("vs")) != rec["crc"]:
             raise SwapCorruptionError(
                 "swap-in checksum mismatch for a %d-block row (host "
                 "buffer corrupted in transit); resuming would replay a "
@@ -1734,12 +2041,30 @@ class DecodeEngine:
         cfg = self.cfg
         hd = cfg.feat // cfg.n_head
         shape = (cfg.n_layer, self.bpr, cfg.n_head, self.block_size, hd)
+        fn = _scatter_blocks_fn(self._cfg_key, self.block_size, self.bpr,
+                                self._donate)
+        if self.kv_int8:
+            # rebuild the padded (values, scales) pair from the stored
+            # representation — no requantization, so resume is bit-exact
+            sshape = shape[:-1]
+            bq_k = np.zeros(shape, np.int8)
+            bs_k = np.zeros(sshape, np.dtype(self.dtype))
+            bq_v = np.zeros(shape, np.int8)
+            bs_v = np.zeros(sshape, np.dtype(self.dtype))
+            bq_k[:, :n] = rec["k"]
+            bs_k[:, :n] = rec["ks"]
+            bq_v[:, :n] = rec["v"]
+            bs_v[:, :n] = rec["vs"]
+            self.cache_k, self.cache_v = fn(
+                self.cache_k, self.cache_v,
+                (jnp.asarray(bq_k), jnp.asarray(bs_k)),
+                (jnp.asarray(bq_v), jnp.asarray(bs_v)),
+                jnp.asarray(ids))
+            return
         bufk = np.zeros(shape, np.dtype(self.dtype))
         bufv = np.zeros(shape, np.dtype(self.dtype))
         bufk[:, :n] = rec["k"]
         bufv[:, :n] = rec["v"]
-        fn = _scatter_blocks_fn(self._cfg_key, self.block_size, self.bpr,
-                                self._donate)
         self.cache_k, self.cache_v = fn(
             self.cache_k, self.cache_v, jnp.asarray(bufk),
             jnp.asarray(bufv), jnp.asarray(ids))

@@ -24,6 +24,13 @@ block indices, the COW block copy, swap in/out) lives in
 serve/engine.py, and the *policy* (when to evict the trie, whom to
 preempt) in serve/scheduler.py.
 
+The manager is deliberately DTYPE-AGNOSTIC: under quantized serving
+(``serve_kv_dtype=int8``, doc/serving.md "Quantized serving") the
+device pool a block id points into becomes a ``(values int8, scales)``
+pair instead of one compute-dtype array, and every id here simply
+indexes both leaves — refcounts, COW, and swap semantics are unchanged
+while each block holds ~2x the tokens per MiB.
+
 Invariants the rest of the serving stack leans on:
 
 * **Block 0 is the garbage block.** It is never handed out by

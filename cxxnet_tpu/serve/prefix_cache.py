@@ -320,7 +320,12 @@ class _PagedNode:
 class PagedPrefixCache:
     """Zero-copy shared-prefix reuse over the paged block pool: the same
     chunk-granular token-trie as :class:`PrefixCache`, but each node
-    holds BLOCK IDS instead of host K/V copies.
+    holds BLOCK IDS instead of host K/V copies. The trie is therefore
+    dtype-agnostic: under ``serve_kv_dtype=int8`` its ids point into
+    the quantized (values, scales) pool, node byte accounting follows
+    ``engine.block_bytes()``'s stored-dtype formula, and the same
+    ``serve_prefix_mb`` budget holds ~2x the cached prefix tokens
+    (doc/serving.md "Quantized serving").
 
     * **Hit** (``copy_into``): the matched chain's block ids are
       appended to the admitting row's block table with one refcount bump

@@ -322,13 +322,19 @@ def reset_for_replay(req) -> None:
     req.deadline = None
 
 
-def swap_checksum(bk, bv) -> int:
-    """Cheap host-buffer checksum for the swap round trip (crc32 over
-    both contiguous K/V buffers) — a corrupted buffer fails loudly at
-    resume instead of resuming a garbage bit-stream."""
+def swap_checksum(*bufs) -> int:
+    """Cheap host-buffer checksum for the swap round trip (crc32 chained
+    over every contiguous buffer, Nones skipped) — a corrupted buffer
+    fails loudly at resume instead of resuming a garbage bit-stream.
+    Quantized (int8) swap records pass four buffers — K/V payloads plus
+    their scale planes — so the crc covers exactly the stored
+    representation the scatter restores."""
     import numpy as np
-    return zlib.crc32(np.ascontiguousarray(bv),
-                      zlib.crc32(np.ascontiguousarray(bk)))
+    crc = 0
+    for b in bufs:
+        if b is not None:
+            crc = zlib.crc32(np.ascontiguousarray(b), crc)
+    return crc
 
 
 # ----------------------------------------------------------------- ladder

@@ -590,6 +590,10 @@ class SlotScheduler:
         # credited here
         rec["charge"] = self._slot_charge[slot]
         self._tenant_credit(req, slot)
+        # the engine's swap record is carried OPAQUELY: under
+        # serve_kv_dtype=int8 it holds the stored int8 payloads plus
+        # scale planes ("ks"/"vs") at roughly half the bytes — the
+        # nbytes/crc bookkeeping below is layout-agnostic
         swap = self.engine.swap_out_row(slot)
         rec.update(swap)
         req.status = "swapped"

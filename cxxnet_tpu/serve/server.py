@@ -162,7 +162,8 @@ class InferenceServer:
                  kv_mb: float = 0.0, fused_attn: bool = True,
                  chaos: str = "", max_restarts: int = 3,
                  watchdog_ms: float = 0.0, degrade: bool = True,
-                 tp: int = 0, mesh=None, tenants: str = ""):
+                 tp: int = 0, mesh=None, tenants: str = "",
+                 int8_weights: bool = False, kv_dtype: str = ""):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -260,6 +261,20 @@ class InferenceServer:
         the whole layer is skipped and every surface is bit-identical
         to the untenanted server.
 
+        Quantized serving (doc/serving.md "Quantized serving"):
+        ``int8_weights`` quantizes the block matmul weights once at
+        engine build (per-out-column symmetric int8, the offline
+        decode's exact scheme) and streams them through chunk prefill,
+        tick AND the speculative verify — halving the weight traffic
+        the decode step is bound by. ``kv_dtype="int8"`` (paged only)
+        stores the KV block pool per-block-scaled int8 — ``(values,
+        scales)`` pairs, quantize-on-scatter / dequantize-on-gather —
+        so ``kv_blocks``, the prefix trie's shared blocks, and
+        ``swap_host`` all hold ~2x tokens per MiB and swap bandwidth
+        halves (checksums verify the quantized round trip bit-exactly).
+        Accuracy is pinned by ``serve.engine.kv_int8_tolerance``; both
+        default OFF and are pinned no-ops there.
+
         Tensor-parallel serving (doc/serving.md "Sharded & replicated
         serving"): ``tp`` > 1 builds a ``model``-axis mesh over the
         first ``tp`` local devices and shards the decode engine across
@@ -348,9 +363,13 @@ class InferenceServer:
         nb = 0
         if self._paged:
             from .engine import auto_num_blocks
+            # auto-sizing is dtype-aware: the same serve_kv_mb budget
+            # buys ~2x the blocks under serve_kv_dtype=int8 (the
+            # quantized block itemsize — doc/serving.md "Quantized
+            # serving")
             nb = int(num_blocks) if num_blocks > 0 else auto_num_blocks(
                 cfg, slots, prefill_chunk, block_size=block_size,
-                prefix_mb=prefix_mb, kv_mb=kv_mb)
+                prefix_mb=prefix_mb, kv_mb=kv_mb, kv_dtype=kv_dtype)
         # everything the recovery supervisor needs to rebuild the
         # device-facing stack from scratch (engine, prefix cache,
         # drafters, scheduler) — _build_stack() reads only this
@@ -360,7 +379,8 @@ class InferenceServer:
             recompile_strict=recompile_strict, spec_mode=spec_mode,
             spec_len=spec_len, spec_model=spec_model, prefix_mb=prefix_mb,
             nb=nb, block_size=block_size, prof_every=prof_every,
-            fused_attn=bool(fused_attn), mesh=mesh)
+            fused_attn=bool(fused_attn), mesh=mesh,
+            int8_weights=bool(int8_weights), kv_dtype=kv_dtype)
         self._prefill_budget = int(prefill_budget)
         # device/compiler observatory (obs/devprof.py): compile-time
         # accounting always (this registry becomes a CompileWatch sink,
@@ -447,7 +467,8 @@ class InferenceServer:
             num_blocks=b["nb"],
             block_size=b["block_size"] if self._paged else 0,
             injector=self._inj, fused_attn=b["fused_attn"],
-            mesh=b["mesh"])
+            mesh=b["mesh"], int8_weights=b["int8_weights"],
+            kv_dtype=b["kv_dtype"])
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             if self._paged:
@@ -1814,6 +1835,7 @@ class InferenceServer:
                 "num_blocks": self._engine.num_blocks,
                 "block_size": self._engine.block_size,
                 "fused_attn": self._engine.fused_attn,
+                "kv_dtype": self._engine.kv_dtype,
                 "blocks": self._engine.manager.counts(),
                 "cow_faults": self._engine.manager.cow_faults,
                 "swaps_out": sc.swaps_out, "swaps_in": sc.swaps_in,
@@ -1840,6 +1862,7 @@ class InferenceServer:
             "tokens_generated": sc.tokens_generated,
             "slots": self._engine.slots,
             "tp": self._tp,
+            "int8_weights": self._engine.int8_weights,
             "kv_cache_bytes": self._engine.cache_bytes(),
             # device-memory ledger snapshot (obs/devprof.py): predicted
             # bytes per pool vs the measured jax.live_arrays() total

@@ -290,12 +290,22 @@ class SpeculativeDecoder:
     stream costs."""
 
     def __init__(self, cfg, params: Dict, batch: int, spec_len: int = 4,
-                 mode: str = "ngram", model=None, tracer=None):
+                 mode: str = "ngram", model=None, tracer=None,
+                 int8_weights: bool = False):
         """``tracer``: obs span recorder for the offline decode loop
         (doc/observability.md) — None uses the process-global tracer,
         so ``gpt_decode(speculative=...)`` runs show up on the same
         TID_ENGINE track as serving ticks; pass one with
-        ``enabled=False`` to opt out."""
+        ``enabled=False`` to opt out.
+
+        ``int8_weights`` streams the target engine's block matmul
+        weights int8-quantized (per-out-column, models/gpt.py
+        _quantize_decode_blocks) through the verify/tick programs —
+        the previously-impossible speculative-plus-int8 combination.
+        Greedy output is then bit-identical to the engine's OWN
+        non-speculative int8 stream (the verify logits are the int8
+        tick's logits); the drafter keeps full-precision weights — it
+        only affects accept_rate, never which tokens are emitted."""
         from .engine import DecodeEngine
         if mode not in ("ngram", "model"):
             raise ValueError("speculative mode must be 'ngram' or "
@@ -305,7 +315,8 @@ class SpeculativeDecoder:
         self.cfg = cfg
         self.spec_len = min(int(spec_len), max(cfg.seq_len - 1, 1))
         self.engine = DecodeEngine(cfg, params, slots=batch,
-                                   prefill_chunk=0, spec_len=self.spec_len)
+                                   prefill_chunk=0, spec_len=self.spec_len,
+                                   int8_weights=int8_weights)
         if mode == "model":
             if model is None:
                 raise ValueError("speculative mode 'model' needs "
@@ -437,20 +448,24 @@ class SpeculativeDecoder:
 def speculative_decode(params: Dict, prompt, max_new: int, cfg,
                        temperature: float = 0.0, rng=None,
                        top_k: int = 0, top_p: float = 1.0,
-                       spec: Optional[dict] = None):
+                       spec: Optional[dict] = None,
+                       int8_weights: bool = False):
     """``gpt_decode(speculative=...)``'s implementation: build a
     one-shot :class:`SpeculativeDecoder`, run it, fill ``spec['stats']``
     (if the caller passed a dict to receive accept_rate & friends), and
     return the (b, n_prompt + max_new) ids. ``spec`` keys: ``mode``
     ('ngram' | 'model'), ``spec_len``, ``model`` ((draft_cfg,
-    draft_params) for mode 'model'), ``stats`` (optional out-dict)."""
+    draft_params) for mode 'model'), ``stats`` (optional out-dict).
+    ``int8_weights`` streams the target weights int8-quantized through
+    the verify/tick programs (SpeculativeDecoder docstring)."""
     spec = dict(spec or {})
     stats_out = spec.get("stats")
     prompt = np.asarray(prompt, np.int32)
     dec = SpeculativeDecoder(cfg, params, batch=prompt.shape[0],
                              spec_len=int(spec.get("spec_len", 4)),
                              mode=spec.get("mode", "ngram"),
-                             model=spec.get("model"))
+                             model=spec.get("model"),
+                             int8_weights=int8_weights)
     try:
         out = dec.decode(prompt, max_new, temperature=temperature,
                          rng=rng, top_k=top_k, top_p=top_p)

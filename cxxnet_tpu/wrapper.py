@@ -199,7 +199,8 @@ class Net:
                     max_restarts: int = 3, watchdog_ms: float = 0.0,
                     degrade: bool = True, tp: int = 0,
                     replicas: int = 1, router_policy: str = "prefix",
-                    tenants: str = "", **defaults) -> None:
+                    tenants: str = "", int8_weights: bool = False,
+                    kv_dtype: str = "", **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -272,7 +273,16 @@ class Net:
         token-bucket rate limits with ``retry_after_ms`` refill hints,
         and default deadlines; requests opt in via
         ``serve_submit(tenant=...)``. Empty (the default) is a pinned
-        no-op — the untenanted server is bit-identical."""
+        no-op — the untenanted server is bit-identical.
+
+        Quantized serving (doc/serving.md "Quantized serving"):
+        ``int8_weights`` streams the engine's block matmul weights
+        int8-quantized (per-out-column, quantized once at build;
+        speculative verify included); ``kv_dtype="int8"`` stores the
+        paged KV pool per-block-scaled int8 — ~2x tokens per ``kv_mb``
+        and halved swap bandwidth, accuracy pinned by
+        ``serve.engine.kv_int8_tolerance``. Both default off (pinned
+        no-ops)."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams, ServeRouter
         if getattr(self, "_server", None) is not None:
@@ -292,6 +302,7 @@ class Net:
             kv_mb=kv_mb, fused_attn=fused_attn, chaos=chaos,
             max_restarts=max_restarts, watchdog_ms=watchdog_ms,
             degrade=degrade, tp=tp, tenants=tenants,
+            int8_weights=int8_weights, kv_dtype=kv_dtype,
             defaults=SamplingParams(**defaults))
         if replicas > 1:
             # each replica owns its registry; the merged payload is

@@ -1,0 +1,508 @@
+"""Quantized serving end-to-end (doc/serving.md "Quantized serving"):
+int8 weight streaming through the serve programs + per-block-scaled
+int8 KV pools.
+
+The load-bearing invariants:
+
+1. **pinned no-op when off** — the default engine/server holds plain
+   compute-dtype pools and full-precision weights, byte-for-byte the
+   pre-quantization programs (the whole bit-identity corpus of
+   test_serve*/test_resilience/test_router keeps pinning that; here we
+   pin the structural facts directly);
+2. **the stored representation IS the int8 payload** — swap-out /
+   checksum / swap-in round-trips bit-exactly, a COW fault copies the
+   payload + scales without touching the donor, preempt->swap->resume
+   is stream-identical to an undisturbed int8 run;
+3. **accuracy under ONE contract** — ``kv_int8_tolerance()`` bounds the
+   lockstep greedy divergence and the sampled-mode chi-squared, and
+   nothing in this file invents its own ad-hoc tolerance;
+4. **fused == gather under quantization** — the Pallas block-table-walk
+   kernel's in-VMEM dequant is bit-exact against the XLA gather
+   formulation in interpret mode, speculative verify included;
+5. **hygiene** — int8 vs bf16 engines count DISTINCT single
+   RecompileGuard signatures (the dtype is in the signature string,
+   unlike the deliberately flag-free fused/gather bit), the quantized
+   step audit keeps full donation aliasing with no silent f32
+   promotion of int8 operands (CXN209), and ledger pool predictions
+   stay exact under the quantized itemsize.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import DecodeEngine, InferenceServer, auto_num_blocks
+from cxxnet_tpu.serve.engine import kv_int8_tolerance
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+NB = auto_num_blocks(CFG, 2, 4)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _admit(eng, slot, prompt, key, temp=0.0):
+    """Drive a paged engine's chunk prefill by hand (reserve + chunk
+    windows); returns the first sampled token."""
+    tok = None
+    for start in range(0, len(prompt), eng.chunk):
+        end = min(start + eng.chunk, len(prompt))
+        eng.reserve_window(slot, start, start + eng.chunk)
+        buf = np.zeros(eng.chunk, np.int32)
+        buf[:end - start] = prompt[start:end]
+        tok = eng.prefill_chunk(slot, buf, start, end - start, key, temp,
+                                0, 1.0)
+    return int(tok)
+
+
+def _tick_one(eng, slot, tok, pos, fold, key=None, temp=0.0):
+    """One batched tick advancing only ``slot`` (other rows parked)."""
+    b = eng.slots
+    t = np.zeros(b, np.int32)
+    t[slot] = tok
+    p = np.full(b, eng.row_len - 1, np.int32)
+    p[slot] = pos
+    keys = np.zeros((b, 2), np.uint32)
+    if key is not None:
+        keys[slot] = key
+    f = np.zeros(b, np.int32)
+    f[slot] = fold
+    nxt = eng.tick(t, p, keys, f, np.full(b, temp, np.float32),
+                   np.zeros(b, np.int32), np.ones(b, np.float32))
+    return int(nxt[slot])
+
+
+def _stream(eng, prompt, n, key=None, temp=0.0):
+    """Greedy (or sampled) single-request stream through a paged
+    engine: chunked admit + ticks, reserving every window."""
+    key = np.zeros((2,), np.uint32) if key is None else key
+    toks = [_admit(eng, 0, prompt, key, temp)]
+    pos = len(prompt)
+    for i in range(1, n):
+        eng.reserve_window(0, pos, pos + 1)
+        toks.append(_tick_one(eng, 0, toks[-1], pos, i, key, temp))
+        pos += 1
+    return toks
+
+
+# --------------------------------------------------- pinned no-op (off)
+def test_defaults_are_pinned_noop():
+    """With the knobs unset the engine holds PLAIN compute-dtype pools
+    (no (values, scales) pairs), full-precision weights, and the same
+    block geometry as before the quantized round — the structural half
+    of the no-op pin (the token-identity half is every pre-existing
+    serve suite, which runs against exactly these defaults)."""
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB)
+    assert not isinstance(eng.cache_k, tuple)
+    assert not eng.kv_int8 and not eng.int8_weights
+    assert eng.kv_dtype == "f32"
+    assert "s_qkv" not in eng._blocks
+    from cxxnet_tpu.serve.engine import _paged_geometry
+    assert eng.block_bytes() == _paged_geometry(CFG, 4, 0)[4]
+    assert eng._sig_suffix == ""
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                         prefill_chunk=4) as srv:
+        m = srv.metrics()
+    assert m["paged"]["kv_dtype"] == "f32"
+    assert m["int8_weights"] is False
+
+
+def test_kv_dtype_validation():
+    with pytest.raises(ValueError, match="serve_kv_dtype"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     kv_dtype="int4")
+    # int8 KV is paged-only: the dense slot pool keeps the compute dtype
+    with pytest.raises(ValueError, match="paged"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, kv_dtype="int8")
+    # an explicit full-precision name must MATCH the compute dtype
+    with pytest.raises(ValueError, match="COMPUTE"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     kv_dtype="bf16")     # CFG is f32
+    # matching spellings are accepted as the no-op they are
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                       kv_dtype="f32")
+    assert not eng.kv_int8
+
+
+def test_kv_int8_rejects_tp():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 local devices for a model-axis mesh")
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:2], model_parallel=2)
+    with pytest.raises(ValueError, match="serve_tp"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     kv_dtype="int8", mesh=mesh)
+
+
+# ------------------------------------------------- accuracy contract
+def test_kv_int8_greedy_divergence_bounded():
+    """Lockstep teacher-forced divergence: both engines fed the SAME
+    context each step (the full-precision engine's greedy token), the
+    fraction of steps where the int8-KV engine's argmax differs is
+    bounded by the ONE contract, kv_int8_tolerance()['greedy_flip'].
+    A plumbing bug (wrong scale axis, swapped K/V, garbage block read)
+    flips essentially every step on this near-uniform tiny model."""
+    rs = np.random.RandomState(1)
+    prompt = _prompt(rs, 10)
+    ref = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4, num_blocks=NB)
+    q = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4, num_blocks=NB,
+                     kv_dtype="int8")
+    key = np.zeros((2,), np.uint32)
+    t_ref = _admit(ref, 0, prompt, key)
+    t_q = _admit(q, 0, prompt, key)
+    steps = 24
+    flips = int(t_ref != t_q)
+    tok, pos = t_ref, len(prompt)
+    for i in range(1, steps):
+        ref.reserve_window(0, pos, pos + 1)
+        q.reserve_window(0, pos, pos + 1)
+        nxt_ref = _tick_one(ref, 0, tok, pos, i)
+        nxt_q = _tick_one(q, 0, tok, pos, i)      # SAME forced context
+        flips += int(nxt_ref != nxt_q)
+        tok, pos = nxt_ref, pos + 1
+    budget = kv_int8_tolerance()["greedy_flip"]
+    assert flips / steps <= budget, (flips, steps, budget)
+
+
+def _chi2_crit(df, z=3.09):
+    """Wilson-Hilferty upper-tail chi-squared quantile (z=3.09 ~ the
+    contract's chi2_sig=1e-3)."""
+    return df * (1 - 2 / (9 * df) + z * (2 / (9 * df)) ** 0.5) ** 3
+
+
+def test_kv_int8_sampled_chi_squared():
+    """Sampled mode under int8 KV follows (statistically) the same
+    first-token distribution as the full-precision engine at this
+    sample size — the quantization perturbs logits by ~1%, far inside
+    the two-sample chi-squared resolution, while a broken key schedule
+    or scale application shifts whole modes and fails hard. Draws are
+    repeated TICKS at a fixed position with varied request keys (each
+    tick rewrites the same K/V deterministically, so only the sampling
+    key varies)."""
+    rs = np.random.RandomState(2)
+    prompt = _prompt(rs, 9)
+    n = 600
+    counts = {}
+    for kv in ("", "int8"):
+        eng = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4,
+                           num_blocks=NB, kv_dtype=kv)
+        _admit(eng, 0, prompt, np.zeros((2,), np.uint32))
+        pos = len(prompt)
+        eng.reserve_window(0, pos, pos + 1)
+        c = np.zeros(CFG.vocab_size)
+        for s in range(n):
+            key = np.asarray(jax.random.PRNGKey(s), np.uint32)
+            c[_tick_one(eng, 0, int(prompt[-1]), pos, 1, key,
+                        temp=1.0)] += 1
+        counts[kv] = c
+    a, b = counts[""], counts["int8"]
+    keep = (a + b) > 0
+    stat = float((((a - b) ** 2)[keep] / (a + b)[keep]).sum())
+    df = int(keep.sum()) - 1
+    assert df >= 2
+    assert stat < _chi2_crit(df), (stat, df, a, b)
+
+
+# ------------------------------------------- stored-representation bits
+def test_swap_roundtrip_bit_exact_and_checksummed():
+    """Swap-out -> crc32 -> swap-in of an int8 row is bit-exact: the
+    record carries the STORED representation (payload + scale planes),
+    so re-swapping the resumed row reproduces the identical buffers and
+    checksum; flipping one payload byte trips the typed corruption
+    error BEFORE any allocation."""
+    from cxxnet_tpu.serve.resilience import SwapCorruptionError
+    rs = np.random.RandomState(3)
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                       kv_dtype="int8")
+    _admit(eng, 0, _prompt(rs, 11), np.zeros((2,), np.uint32))
+    rec = eng.swap_out_row(0)
+    assert {"k", "ks", "v", "vs", "n", "nbytes", "crc"} <= set(rec)
+    assert rec["k"].dtype == np.int8 and rec["v"].dtype == np.int8
+    eng.swap_in_row(0, rec)
+    rec2 = eng.swap_out_row(0)
+    np.testing.assert_array_equal(rec["k"], rec2["k"])
+    np.testing.assert_array_equal(rec["ks"], rec2["ks"])
+    np.testing.assert_array_equal(rec["v"], rec2["v"])
+    np.testing.assert_array_equal(rec["vs"], rec2["vs"])
+    assert rec["crc"] == rec2["crc"]
+    rec2["k"].view(np.uint8).flat[3] ^= 0xFF
+    free_before = eng.manager.free_count
+    with pytest.raises(SwapCorruptionError):
+        eng.swap_in_row(0, rec2)
+    assert eng.manager.free_count == free_before
+
+
+def test_cow_fault_leaves_int8_donor_bit_unchanged():
+    """A write into a shared int8 block faults a private copy; the
+    donor block's stored payload AND scale plane are bit-untouched
+    (the COW copy moves the stored representation, engine
+    _copy_block_fn)."""
+    rs = np.random.RandomState(4)
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                       kv_dtype="int8")
+    prompt = _prompt(rs, 8)     # exactly 2 blocks at bs=4
+    _admit(eng, 0, prompt, np.zeros((2,), np.uint32))
+    donor_ids = eng.row_block_ids(0, 0, 2)
+    # slot 1 shares both blocks (a prefix hit), then writes into them
+    eng.attach_shared(1, donor_ids)
+    kq, ks = eng.cache_k
+    before_q = np.asarray(kq[:, donor_ids])
+    before_s = np.asarray(ks[:, donor_ids])
+    eng.reserve_window(1, 4, 12)        # COW-faults block 1, grows
+    buf = np.zeros(eng.chunk, np.int32)
+    buf[:] = _prompt(rs, 4)
+    eng.prefill_chunk(1, buf, 4, 4, np.zeros((2,), np.uint32), 0.0,
+                      0, 1.0)
+    assert eng.manager.cow_faults >= 1
+    kq2, ks2 = eng.cache_k
+    np.testing.assert_array_equal(np.asarray(kq2[:, donor_ids]), before_q)
+    np.testing.assert_array_equal(np.asarray(ks2[:, donor_ids]), before_s)
+
+
+def test_preempt_swap_resume_identity_int8():
+    """A pool several times smaller than the working set (forcing
+    preempt -> swap -> resume) serves the same int8 token streams as a
+    roomy pool — resume restores the stored int8 representation, never
+    requantizes."""
+    rs = np.random.RandomState(6)
+    cases = [(_prompt(rs, 21), 8, 0.0, 0),
+             (_prompt(rs, 19), 8, 0.9, 7),
+             (_prompt(rs, 17), 8, 0.0, 0)]
+    outs = {}
+    # 13 blocks = one full row (bpr 12) + the garbage block: two live
+    # rows' working sets (8 blocks each) cannot coexist, forcing
+    # preempt -> swap -> resume in the tiny arm
+    for nb in (NB, 13):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=8,
+                             prefill_chunk=4, num_blocks=nb,
+                             prefix_mb=0.0, kv_dtype="int8") as srv:
+            hs = [srv.submit(p, max_tokens=m, temperature=t, seed=s)
+                  for p, m, t, s in cases]
+            outs[nb] = [srv.result(h, timeout=300) for h in hs]
+            m_ = srv.metrics()
+        assert all(r.status == "ok" for r in outs[nb])
+    assert m_["paged"]["swaps_out"] >= 1       # the tiny pool really swapped
+    for a, b in zip(outs[NB], outs[13]):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+# ------------------------------------------------ int8 weights + spec
+def test_speculative_int8_weights_composes_offline():
+    """gpt_decode(speculative=..., int8_weights=True) — the explicit
+    rejection is gone — and its greedy stream is bit-identical to the
+    SAME engine configuration decoded tick-by-tick (the verify logits
+    ARE the int8 tick's logits, quantized weights included)."""
+    rs = np.random.RandomState(3)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base, base])     # n-gram bait
+    spec = {"mode": "ngram", "spec_len": 3, "stats": {}}
+    out = np.asarray(gpt_decode(
+        PARAMS, jnp.asarray(prompt)[None], 8, CFG, speculative=spec,
+        int8_weights=True))[0]
+    assert spec["stats"]["forwards"] >= 1
+    eng = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=0,
+                       int8_weights=True)
+    key = np.zeros((2,), np.uint32)
+    toks = [eng.prefill(0, prompt, key, 0.0, 0, 1.0)]
+    pos = len(prompt)
+    for i in range(1, 8):
+        toks.append(_tick_one(eng, 0, toks[-1], pos, i))
+        pos += 1
+    assert list(out[len(prompt):]) == toks
+
+
+def test_int8_weights_serving_identity_vs_own_oracle():
+    """An int8-weights SERVER (paged, chunked, prefix cache on) is
+    stream-identical to the offline speculative-int8 decode of the same
+    request — the weight quantization is one engine-build-time
+    transform, not a per-program reinterpretation."""
+    rs = np.random.RandomState(8)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base])
+    ref = np.asarray(gpt_decode(
+        PARAMS, jnp.asarray(prompt)[None], 6, CFG, speculative=2,
+        int8_weights=True))[0]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=2,
+                         int8_weights=True) as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=6), timeout=300)
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, ref)
+
+
+# ---------------------------------------------------- fused == gather
+def test_fused_interpret_bit_identity_int8():
+    """The Pallas block-table-walk kernel with scale operands is
+    bit-exact against the XLA gather formulation in interpret mode —
+    tick AND speculative verify — under the shared fused contract
+    (exact on CPU/interpret; assert_fused_allclose's accelerator band
+    would apply on a real TPU)."""
+    from cxxnet_tpu.ops import pallas_kernels as pk
+    rs = np.random.RandomState(9)
+    prompt = _prompt(rs, 10)
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        streams = {}
+        for fused in (True, False):
+            eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                               num_blocks=NB, kv_dtype="int8",
+                               spec_len=3, fused_attn=fused)
+            assert eng.fused_attn == fused
+            toks = _stream(eng, prompt, 6)
+            # one verify step rides along: draft the last token thrice
+            pos = len(prompt) + 5
+            eng.reserve_window(0, pos, pos + 4)
+            buf = np.full(4, toks[-1], np.int32)
+            n_acc, emit = eng.verify_chunk(
+                0, buf, pos, 3, np.zeros((2,), np.uint32), 6, 0.0, 0,
+                1.0)
+            streams[fused] = (toks, n_acc, emit)
+    finally:
+        pk._INTERPRET = old
+    assert streams[True] == streams[False]
+
+
+# -------------------------------------------------------- hygiene pins
+def test_recompile_signatures_distinct_per_dtype():
+    """An int8 and a bf16 engine in one process are DISTINCT single
+    signatures: the quantization dtypes ride in the signature string
+    (/w=int8, /kv=int8) — unlike the fused/gather flag, which PR 10
+    pinned flag-free, a dtype change IS a different abstract signature
+    and must count as such. Each engine still holds exactly ONE
+    signature across its own traffic."""
+    rs = np.random.RandomState(10)
+    engines = {
+        "plain": DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                              num_blocks=NB, recompile_limit=1),
+        "quant": DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                              num_blocks=NB, recompile_limit=1,
+                              int8_weights=True, kv_dtype="int8"),
+    }
+    sigs = {}
+    for name, eng in engines.items():
+        for n in (5, 9):        # mixed lengths: still one signature
+            slot = 0
+            eng.release_row(slot)
+            _admit(eng, slot, _prompt(rs, n), np.zeros((2,), np.uint32))
+        assert len(eng.prefill_signatures) == 1
+        sigs[name] = str(eng.prefill_signatures[0])
+    assert sigs["plain"] != sigs["quant"]
+    assert "/w=int8" in sigs["quant"] and "/kv=int8" in sigs["quant"]
+    assert "int8" not in sigs["plain"]
+
+
+def test_quantized_audit_clean_and_cxn209_detects():
+    """The quantized serve programs (bf16 compute) audit with FULL
+    donation aliasing and the int8=clean column — no silent f32
+    promotion of int8 operands — while a deliberate i8->f32 convert
+    trips CXN209."""
+    from cxxnet_tpu.analysis import audit_serve_engine
+    from cxxnet_tpu.analysis.step_audit import audit_jit
+    bcfg = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2,
+                     feat=16, n_microbatch=1, dtype="bfloat16")
+    bparams = gpt_init(jax.random.PRNGKey(5), bcfg)
+    eng = DecodeEngine(bcfg, bparams, 2, prefill_chunk=4, abstract=True,
+                       num_blocks=auto_num_blocks(bcfg, 2, 4,
+                                                  kv_dtype="int8"),
+                       kv_dtype="int8", int8_weights=True, spec_len=3,
+                       fused_attn=False)
+    report, infos = audit_serve_engine(eng, donate=True)
+    assert report.ok(), report.format()
+    for info in infos:
+        assert info["donated"] == info["aliased"] > 0
+        assert info["int8_promotions"] == 0
+    # negative control: int8 straight to f32 must be named
+    bad = jax.jit(lambda a: a.astype(jnp.float32).sum())
+    findings, info = audit_jit(
+        bad, (jax.ShapeDtypeStruct((4,), jnp.int8),), "bad",
+        check_int8=True)
+    assert [f.rule for f in findings] == ["CXN209"]
+    assert info["int8_promotions"] == 1
+
+
+def test_auto_num_blocks_int8_sizes_by_quantized_itemsize():
+    """The same serve_kv_mb budget buys ~2x the blocks under int8 (the
+    dtype-aware geometry), and the ledger's kv_blocks prediction equals
+    the pool's actual stored bytes — payload plus scale planes."""
+    # realistic head_dim (64): value bytes dominate the scale overhead,
+    # so the same MiB buys ~1.94x the blocks
+    wide = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2,
+                     feat=128, n_microbatch=1, dtype="bfloat16")
+    nb_bf = auto_num_blocks(wide, 2, 4, kv_mb=1.0)
+    nb_i8 = auto_num_blocks(wide, 2, 4, kv_mb=1.0, kv_dtype="int8")
+    assert nb_i8 >= 1.8 * nb_bf
+    # the exact stored-bytes formula, pinned against the live pool
+    bcfg = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2,
+                     feat=16, n_microbatch=1, dtype="bfloat16")
+    bparams = gpt_init(jax.random.PRNGKey(5), bcfg)
+    eng = DecodeEngine(bcfg, bparams, 2, prefill_chunk=4,
+                       num_blocks=64, kv_dtype="int8")
+    hd = bcfg.feat // bcfg.n_head
+    expect = 2 * (bcfg.n_layer * 64 * bcfg.n_head * 4 * hd * 1
+                  + bcfg.n_layer * 64 * bcfg.n_head * 4 * 2)
+    assert eng.cache_bytes() == expect
+    assert eng.block_bytes() * 64 == expect
+
+
+def test_ledger_reconciles_under_int8():
+    """cxn_device_bytes{pool=kv_blocks} prediction == the live pool's
+    measured bytes under int8 (the formula follows the stored dtype),
+    and the int8 pool at equal blocks is under ~60% of the bf16 pool."""
+    from cxxnet_tpu.obs.metrics import Registry
+    sizes = {}
+    for kv in ("", "int8"):
+        reg = Registry()
+        with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                             prefill_chunk=4, num_blocks=NB,
+                             kv_dtype=kv, registry=reg) as srv:
+            res = srv.result(srv.submit(np.arange(6, dtype=np.int32),
+                                        max_tokens=3), timeout=300)
+            assert res.status == "ok"
+            led = srv.metrics()["device_bytes"]
+            eng = srv._engine
+            assert led["pools"]["kv_blocks"] == eng.cache_bytes()
+            leaves = []
+            for c in (eng.cache_k, eng.cache_v):
+                leaves += list(c) if isinstance(c, tuple) else [c]
+            measured = sum(x.size * x.dtype.itemsize for x in leaves)
+            assert led["pools"]["kv_blocks"] == measured
+            sizes[kv] = measured
+    assert sizes["int8"] < 0.6 * sizes[""]
+
+
+# ----------------------------------------------------------- chaos soak
+@pytest.mark.slow
+def test_chaos_soak_with_quantization_armed():
+    """The resilience chaos soak rides with quantization armed: every
+    injection point firing at low probability over a mixed int8
+    workload, every request completes, the streams stay bit-identical
+    to an undisturbed int8 server (greedy replay pins the emitted
+    prefix; int8 pools make the regeneration deterministic exactly
+    like bf16 ones), and the block refcount audit stays clean."""
+    rs = np.random.RandomState(11)
+    cases = [dict(p=_prompt(rs, rs.randint(5, 14)),
+                  max_tokens=int(rs.randint(4, 8)))
+             for _ in range(12)]
+    outs = {}
+    for chaos in ("", "all:0.02,seed:3,hang_ms:50"):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                             prefill_chunk=4, num_blocks=NB,
+                             kv_dtype="int8", int8_weights=True,
+                             spec_mode="ngram", spec_len=2,
+                             chaos=chaos, max_restarts=50) as srv:
+            hs = [srv.submit(c["p"], max_tokens=c["max_tokens"])
+                  for c in cases]
+            outs[chaos] = [srv.result(h, timeout=600) for h in hs]
+            eng = srv._engine
+            eng.manager.check_consistency(
+                srv._prefix.trie_refs() if srv._prefix is not None else 0)
+    for a, b in zip(outs[""], outs["all:0.02,seed:3,hang_ms:50"]):
+        assert a.status == "ok" and b.status == "ok"
+        np.testing.assert_array_equal(a.tokens, b.tokens)

@@ -182,11 +182,17 @@ def test_tp_audit_shardings_collectives_donation():
 def test_tp_param_shardings_cover_fused_blocks():
     """serve_param_shardings names a placement for every leaf the fused
     block dict actually holds — a renamed weight would KeyError at
-    engine construction, not silently replicate."""
-    from cxxnet_tpu.models.gpt import _fuse_qkv_blocks
+    engine construction, not silently replicate. Since the quantized
+    round the table also covers the int8 dequant scales
+    (_quantize_decode_blocks), i.e. exactly the QUANTIZED dict's key
+    set — both weight layouts look their placements up in one table."""
+    from cxxnet_tpu.models.gpt import (_fuse_qkv_blocks,
+                                       _quantize_decode_blocks)
     blocks = jax.eval_shape(_fuse_qkv_blocks, PARAMS["blocks"])
+    qblocks = jax.eval_shape(_quantize_decode_blocks, blocks)
     bsh, osh = serve_param_shardings(_mesh())
-    assert set(bsh) == set(blocks)
+    assert set(blocks) <= set(bsh)
+    assert set(bsh) == set(qblocks)
     assert set(osh) == {"emb", "pos", "lnf_g", "lnf_b", "head"}
 
 

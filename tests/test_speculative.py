@@ -412,11 +412,17 @@ def test_gpt_decode_speculative_greedy_identity():
     np.testing.assert_array_equal(ref, out_i)
 
 
-def test_gpt_decode_speculative_rejects_int8():
+def test_gpt_decode_speculative_accepts_int8():
+    """The speculative + int8_weights combination is COMPOSABLE since
+    the quantized-serving round (it used to raise): the verify/tick
+    programs stream the per-out-column int8 weights, and the call
+    returns the right shape (the identity-vs-own-int8-stream pin lives
+    in tests/test_serve_int8.py)."""
     rs = np.random.RandomState(13)
     p = _prompt(rs, 4)[None]
-    with pytest.raises(ValueError, match="int8"):
-        gpt_decode(PARAMS, p, 4, CFG, int8_weights=True, speculative=4)
+    out = np.asarray(gpt_decode(PARAMS, p, 4, CFG, int8_weights=True,
+                                speculative=4))
+    assert out.shape == (1, 8)
 
 
 def test_wrapper_generate_speculative():

@@ -16,7 +16,10 @@ serve engine's executables — the PAGED chunk-prefill / tick (and
 ``serve_verify_chunk`` when ``spec_mode`` != off) programs with
 abstract block-table inputs by default, or the dense prefill / chunk /
 tick set under ``serve_paged=0`` — the programs ``task=serve`` runs,
-with the block pool's donation aliasing pinned. Every
+with the block pool's donation aliasing pinned. Quantized configs
+(``serve_int8_weights=1`` / ``serve_kv_dtype=int8``) audit the int8
+variants themselves: aliasing on every (values, scales) leaf, plus the
+CXN209 no-silent-f32-promotion check on bf16 compute. Every
 audited step's line now reports its AOT lower+compile seconds, and
 ``lint_compile_budget_s=<s>`` turns that into a CI gate: any step
 compiling over the budget fails the lint with CXN207, so compile-time
@@ -86,7 +89,8 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     gcfg, task.serve_slots, task.serve_prefill_chunk,
                     block_size=task.serve_block_size,
                     prefix_mb=task.serve_prefix_mb,
-                    kv_mb=task.serve_kv_mb))
+                    kv_mb=task.serve_kv_mb,
+                    kv_dtype=task.serve_kv_dtype))
             # fused-attention audit off-TPU: the production default is
             # the fused Pallas tick/verify, but the kernel only
             # compiles on TPU backends — arm interpret mode for the
@@ -143,6 +147,12 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             try:
                 if arm:
                     _pk._INTERPRET = True
+                # quantized serve audit (serve_int8_weights /
+                # serve_kv_dtype=int8): the abstract engine carries the
+                # int8 block dict and the (values, scales) pool structs,
+                # so the audited executables ARE the quantized programs
+                # — donation aliasing pinned, and CXN209 asserts no
+                # silent f32 promotion of the int8 operands (bf16)
                 eng = DecodeEngine(gcfg, gparams, slots=2,
                                    prefill_chunk=task.serve_prefill_chunk,
                                    abstract=True,
@@ -152,7 +162,10 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                              if task.spec_mode != "off"
                                              else 0),
                                    fused_attn=bool(task.serve_fused_attn),
-                                   mesh=mesh)
+                                   mesh=mesh,
+                                   int8_weights=bool(
+                                       task.serve_int8_weights),
+                                   kv_dtype=task.serve_kv_dtype)
                 # the serve executables ride under the same compile-time
                 # budget as the trainer steps (CXN207): pass
                 # lint_compile_budget_s=<s> to gate compile regressions

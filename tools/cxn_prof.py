@@ -62,6 +62,15 @@ _DEFAULT_CELL_TOL = {
     #                                         DOWN), band matches the
     #                                         other serve trace cells
     "serve_tokens_per_mib": 0.20,
+    "serve_tokens_per_mib_int8": 0.30,      # preempt/swap-regime trace
+    #                                         (the bf16 arm thrashes by
+    #                                         design) — swap timing
+    #                                         noise on top of the usual
+    #                                         open-loop spread
+    "gpt_decode_spec_int8_ms_per_token": 0.30,  # spec accept-rate +
+    #                                         dequant dispatch jitter
+    #                                         (CPU pins machinery, not
+    #                                         bandwidth — serving.md)
     "serve_tokens_per_sec_tp2": 0.30,       # tiny-geometry trace cells:
     #                                         dispatch-bound on CPU, so
     "serve_tokens_per_sec_replicated": 0.30,  # scheduler-thread timing
