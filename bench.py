@@ -1346,6 +1346,93 @@ def bench_lint():
         path, os.path.dirname(__file__)))
 
 
+def bench_serve_cold_start():
+    """AOT executable cache cold-start cell (round 18,
+    doc/performance.md "AOT executable cache"): the flagship serve
+    geometry built from scratch with the in-process compiled-program
+    caches cleared before each arm — a fresh-process stand-in (jax's
+    glue-op caches stay warm in BOTH arms, so the delta isolates the
+    serve programs, which dominate startup).
+
+    * ``engine_cold_start_ms``: InferenceServer() construction ->
+      first probe token, warm AOT cache arm; vs_baseline = the no-cache
+      arm / warm arm (>1 = the cache wins cold start).
+    * ``engine_recovery_ms``: the same two arms through PR 9's actual
+      recovery path — a chaos-killed tick mid-request forces
+      ``_do_recover`` (teardown + rebuild + replay), with the program
+      caches cleared after build so the rebuild must RE-ACQUIRE every
+      program: from disk (warm arm) or by recompiling at the next
+      fetch (no-cache arm). Reported value = submit -> replayed-ok
+      wall of the faulted request.
+    """
+    import tempfile
+
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.serve import InferenceServer
+    from cxxnet_tpu.serve.engine import clear_program_caches
+
+    c = SERVE_CELL
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(1)
+    probe = rs.randint(0, c["vocab"], (17,)).astype(np.int32)
+    # aot_cache="" falls back to CXN_AOT_CACHE — a rig that exports it
+    # would silently warm the no-cache baseline arms; isolate the cell
+    env_cache = os.environ.pop("CXN_AOT_CACHE", None)
+
+    def cold_start(aot_dir):
+        clear_program_caches()
+        t0 = time.perf_counter()
+        srv = InferenceServer(cfg, params, slots=4, queue=8,
+                              aot_cache=aot_dir)
+        res = srv.result(srv.submit(probe, max_tokens=2), timeout=600)
+        ms = (time.perf_counter() - t0) * 1e3
+        assert res.status == "ok", res.status
+        return srv, ms
+
+    def recovery(aot_dir):
+        clear_program_caches()
+        srv = InferenceServer(cfg, params, slots=4, queue=8,
+                              aot_cache=aot_dir, chaos="tick_raise@4",
+                              max_restarts=2)
+        # drop the build-time programs: the recovery rebuild (and the
+        # no-cache arm's next tick) must re-acquire every executable,
+        # exactly like a supervisor-restarted fresh process
+        clear_program_caches()
+        t0 = time.perf_counter()
+        res = srv.result(srv.submit(probe, max_tokens=8), timeout=600)
+        ms = (time.perf_counter() - t0) * 1e3
+        m = srv.metrics()
+        srv.shutdown(drain=False)
+        assert res.status == "ok", res.status
+        assert m["resilience"]["restarts"] >= 1, "fault did not fire"
+        return ms, m["resilience"]["last_recover_ms"]
+
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            srv, _ = cold_start(d)          # populate the cache
+            srv.shutdown(drain=False)
+            srv, ms_nocache = cold_start("")
+            srv.shutdown(drain=False)
+            srv, ms_warm = cold_start(d)
+            hits = srv.metrics()["aot_cache"]["hits"]
+            srv.shutdown(drain=False)
+            assert hits >= 2, "warm arm must load from the cache"
+            emit("engine_cold_start_ms", ms_warm, "ms",
+                 ms_nocache / ms_warm, nocache_ms=round(ms_nocache, 1))
+            rec_nocache, _ = recovery("")
+            rec_warm, rebuild_ms = recovery(d)
+            emit("engine_recovery_ms", rec_warm, "ms",
+                 rec_nocache / rec_warm, nocache_ms=round(rec_nocache, 1),
+                 rebuild_ms=round(rebuild_ms, 1))
+    finally:
+        if env_cache is not None:
+            os.environ["CXN_AOT_CACHE"] = env_cache
+
+
 def main() -> int:
     rc = 0
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
@@ -1353,7 +1440,8 @@ def main() -> int:
                bench_serve_prefill_heavy, bench_serve_paged,
                bench_serve_fused, bench_serve_int8, bench_serve_sharded,
                bench_serve_replicated, bench_serve_tenanted,
-               bench_serve_spec, bench_obs_overhead, bench_lint):
+               bench_serve_spec, bench_serve_cold_start,
+               bench_obs_overhead, bench_lint):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

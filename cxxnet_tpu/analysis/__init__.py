@@ -17,19 +17,22 @@ startup, findings through the profiler log), and ``tools/cxn_lint.py``
 for CI. Rule catalog and exit codes: doc/lint.md.
 """
 
+from .aot_cache import AotCache, CachedProgram, get_cache
 from .findings import (Finding, LintError, LintReport, RULES,
                        parse_suppressions)
 from .graph_lint import (GraphLintResult, lint_config_file,
                          lint_config_text, lint_pairs)
 from .recompile import RecompileGuard, abstract_signature
-from .step_audit import (audit_jit, audit_net, audit_serve_engine,
-                         collective_counts, format_step_info,
-                         net_step_specs)
+from .step_audit import (audit_aot_artifacts, audit_executable, audit_jit,
+                         audit_net, audit_serve_engine, collective_counts,
+                         format_step_info, net_step_specs)
 
 __all__ = [
+    "AotCache", "CachedProgram", "get_cache",
     "Finding", "LintError", "LintReport", "RULES", "parse_suppressions",
     "GraphLintResult", "lint_config_file", "lint_config_text", "lint_pairs",
     "RecompileGuard", "abstract_signature",
-    "audit_jit", "audit_net", "audit_serve_engine", "collective_counts",
-    "format_step_info", "net_step_specs",
+    "audit_aot_artifacts", "audit_executable", "audit_jit", "audit_net",
+    "audit_serve_engine", "collective_counts", "format_step_info",
+    "net_step_specs",
 ]

@@ -1,0 +1,637 @@
+"""AOT executable cache: persist compiled serve/train programs on disk.
+
+Every engine build, trainer startup, and watchdog restart pays full XLA
+compilation for the same small set of shape-specialized programs — the
+exact cost :class:`~cxxnet_tpu.obs.devprof.CompileWatch` measures
+(``cxn_compile_seconds{fn=}``) and CXN207 budgets. This repo's
+one-signature-per-program discipline (RecompileGuard) means the artifact
+set is tiny and stable, so the compiled executables are serialized once
+(``jax.experimental.serialize_executable``) and reloaded on every later
+startup: a warm cold start performs ZERO ``/jax/core/compile/*`` work
+for the cached programs, and PR 9's ``_build_stack()`` recovery path and
+the router's replica spin-up stop paying compile at all.
+
+**Key anatomy** — one artifact per full key; any component drifting is a
+different key (the stale entry stays until pruned; CXN210 names the
+drifted component):
+
+``program``
+    program name (``serve_tick``, ``net_update``, ``gpt_decode``, ...).
+``signature``
+    abstract call signature: pytree structure + per-leaf
+    ``dtype[shape]`` (weak types marked, non-trivial NamedSharding
+    specs included) + the donated/static argnums.
+``extra``
+    builder constants that select a different program WITHOUT changing
+    the abstract signature (prefill chunk, spec_len, block geometry,
+    fused/gather resolution, the ``/mesh=``/``/w=int8``/``/kv=int8``
+    guard suffixes, Pallas interpret mode).
+``config``
+    hash of the owning config (``GPTConfig`` tuple / the Net's raw
+    config pairs) — python-level constants baked into the trace
+    (learning rates, layer wiring) never alias across configs.
+``mesh`` / ``devices``
+    mesh axis names x sizes, and the device ids + device kind the
+    executable was compiled against (a serialized executable embeds its
+    device assignment — replica i's artifact must not load onto
+    replica j's device block).
+``backend`` / ``jax`` / ``jaxlib``
+    ``jax.default_backend()`` and the jax/jaxlib versions — an XLA
+    upgrade invalidates every artifact it might lower differently.
+
+**Layout** (content-addressed, ``aot_cache=DIR`` config key or the
+``CXN_AOT_CACHE`` env var)::
+
+    DIR/<program>/<sha256-of-key>.bin    # pickle: key + payload + trees
+    DIR/<program>/<sha256-of-key>.json   # key components (the validator
+                                         # scans these without unpickling)
+
+Writes are atomic (tempfile + ``os.replace`` in the target dir), loads
+are corruption-safe: a torn/corrupt/stale/unreadable entry logs one
+``profiler.warn`` and falls through to a normal compile — the cache can
+NEVER fail a startup, only speed one up. An unwritable cache directory
+degrades the same way: one warn, every lookup a miss, the engine builds
+by compiling.
+
+**Observability**: ``cxn_aot_cache_{hits,misses,stale,bytes}_total{fn=}``
+counters on every attached sink registry (:meth:`AotCache.add_sink`,
+the CompileWatch idiom), and each hit emits an ``aot_load`` span on the
+sink tracer's engine track — where the ``compile`` span would have been.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["AotCache", "CachedProgram", "ResolvedProgram", "get_cache",
+           "active", "configure", "config_hash", "signature_string",
+           "devices_string", "mesh_tag", "METRIC_NAMES"]
+
+METRIC_NAMES = (
+    ("cxn_aot_cache_hits_total",
+     "AOT executable cache hits (program loaded instead of compiled)"),
+    ("cxn_aot_cache_misses_total",
+     "AOT executable cache misses (program compiled, then persisted)"),
+    ("cxn_aot_cache_stale_total",
+     "corrupt or key-mismatched cache entries skipped (fell through "
+     "to compile)"),
+    ("cxn_aot_cache_bytes_total",
+     "artifact bytes moved through the cache (read on hit, written "
+     "on store)"),
+)
+
+_KIND_TO_NAME = {"hit": "cxn_aot_cache_hits_total",
+                 "miss": "cxn_aot_cache_misses_total",
+                 "stale": "cxn_aot_cache_stale_total",
+                 "bytes": "cxn_aot_cache_bytes_total"}
+
+
+def _versions() -> Tuple[str, str]:
+    """(jax, jaxlib) versions — a module-level seam so tests can fake a
+    jax upgrade and pin the key invalidation it must cause."""
+    import jax
+    import jaxlib
+    return jax.__version__, jaxlib.__version__
+
+
+def _interpret_flag() -> bool:
+    """Pallas interpret mode changes every kernel-bearing executable
+    (tools/cxn_lint.py arms it off-TPU); it must live in the key."""
+    try:
+        from ..ops import pallas_kernels
+        return bool(pallas_kernels._INTERPRET)
+    except Exception:
+        return False
+
+
+def config_hash(obj) -> str:
+    """Short stable hash of a config object (``repr``-based: GPTConfig
+    tuples and Net's (key, value) pair lists are both repr-stable)."""
+    return hashlib.sha256(repr(obj).encode()).hexdigest()[:16]
+
+
+def mesh_tag(mesh) -> str:
+    if mesh is None:
+        return "none"
+    return ",".join("%s=%d" % (n, s)
+                    for n, s in zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _leaf_sig(leaf) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return repr(leaf)
+    s = "%s[%s]" % (dtype, ",".join(str(d) for d in shape))
+    if getattr(leaf, "weak_type", False):
+        s += "~w"
+    sh = getattr(leaf, "sharding", None)
+    if sh is not None and type(sh).__name__ == "NamedSharding":
+        s += "{%s}" % (sh.spec,)
+    return s
+
+
+def signature_string(args: tuple, donate_argnums: Sequence[int] = (),
+                     static_argnums: Sequence[int] = ()) -> str:
+    """Abstract-signature component of the key: pytree structure +
+    per-leaf dtype/shape/weak-type/sharding, plus the donation/static
+    contract. Computed WITHOUT tracing — a cache hit must not emit a
+    single ``/jax/core/compile/*`` event."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return "%s|%s|donate=%s|static=%s" % (
+        treedef, ";".join(_leaf_sig(x) for x in leaves),
+        tuple(sorted(donate_argnums)), tuple(sorted(static_argnums)))
+
+
+def devices_string(args: tuple = (), mesh=None) -> str:
+    """Device ids + device kind the program binds to: the mesh's devices
+    when given, else the union of the args' committed placements, else
+    the default device. Serialized executables embed their device
+    assignment, so two placements are two artifacts."""
+    import jax
+    ids, kind = set(), ""
+    devs = []
+    if mesh is not None:
+        devs = list(mesh.devices.flat)
+    else:
+        for leaf in jax.tree_util.tree_leaves(args):
+            ds = getattr(getattr(leaf, "sharding", None), "device_set",
+                         None)
+            if ds:
+                devs.extend(ds)
+    if not devs:
+        devs = [jax.devices()[0]]
+    for d in devs:
+        ids.add(int(d.id))
+        kind = getattr(d, "device_kind", kind) or kind
+    return "%s:%s" % (",".join(str(i) for i in sorted(ids)), kind)
+
+
+class AotCache:
+    """One on-disk executable cache rooted at ``path`` (use
+    :func:`get_cache` — instances are shared per real path so the
+    hit/miss counters aggregate per process)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._sinks: List[tuple] = []       # (registry, tracer or None)
+        self._warned: set = set()           # warn-once keys (per category)
+        # in-memory executables by digest, populated on LOAD success: an
+        # in-process rebuild (PR 9's watchdog recovery) re-resolves
+        # WITHOUT re-reading and re-deserializing the artifact — same
+        # lifetime semantics as the engine's module-level lru'd jit
+        # programs. Deliberately NOT populated on a SUCCESSFUL store, so
+        # the first warm start of a populating process still proves the
+        # disk round trip — but a FAILED store memoizes (see store):
+        # recovery must not recompile just because the disk half is
+        # degraded. clear_memory_caches() restores fresh-process
+        # semantics for tests/bench.
+        self._mem: Dict[str, object] = {}
+        self.hits = 0
+        self.misses = 0
+        self.stale = 0
+        self.bytes = 0
+
+    # ------------------------------------------------------------ key
+    def components(self, program: str, args: tuple,
+                   donate_argnums: Sequence[int] = (),
+                   static_argnums: Sequence[int] = (),
+                   extra: str = "", config: str = "",
+                   mesh=None) -> Dict[str, str]:
+        jx, jlib = _versions()
+        import jax
+        return {
+            "program": str(program),
+            "signature": signature_string(args, donate_argnums,
+                                          static_argnums),
+            "extra": "%s|interpret=%d" % (extra, _interpret_flag()),
+            "config": str(config),
+            "mesh": mesh_tag(mesh),
+            "devices": devices_string(args, mesh),
+            "backend": jax.default_backend(),
+            "jax": jx,
+            "jaxlib": jlib,
+        }
+
+    @staticmethod
+    def digest(components: Dict[str, str]) -> str:
+        return hashlib.sha256(
+            json.dumps(components, sort_keys=True).encode()).hexdigest()
+
+    def _paths(self, components: Dict[str, str]) -> Tuple[str, str, str]:
+        d = self.digest(components)
+        base = os.path.join(self.path, components["program"])
+        return d, os.path.join(base, d + ".bin"), \
+            os.path.join(base, d + ".json")
+
+    # ---------------------------------------------------------- load
+    def load(self, components: Dict[str, str], tracer=None):
+        """Deserialize-and-load the artifact for this exact key, or
+        ``None`` (miss / stale / corrupt — never raises). A hit emits an
+        ``aot_load`` span where the compile span would have been."""
+        from ..utils import profiler
+        label = components["program"]
+        digest, bin_path, _ = self._paths(components)
+        with self._lock:
+            cached = self._mem.get(digest)
+        if cached is not None:
+            self._emit("hit", label)
+            self._span(tracer, label, time.perf_counter(), 0.0, 0)
+            return cached
+        try:
+            with open(bin_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            self._emit("miss", label)
+            return None
+        t0 = time.perf_counter()
+        try:
+            rec = pickle.loads(blob)
+            if rec["meta"] != components:
+                raise ValueError("stored key != requested key")
+            if hashlib.sha256(rec["payload"]).hexdigest() != rec["sha256"]:
+                raise ValueError("payload checksum mismatch")
+            from jax.experimental import serialize_executable as se
+            compiled = se.deserialize_and_load(
+                rec["payload"], rec["in_tree"], rec["out_tree"])
+        except Exception as e:                          # noqa: BLE001
+            # corrupt / truncated / version-skewed pickle: log once per
+            # entry, count stale, fall through to a normal compile —
+            # a bad cache entry must never fail a startup
+            profiler.warn(
+                "aot_cache: dropping unusable entry for %r (%s: %s) — "
+                "recompiling" % (label, type(e).__name__, e))
+            self._emit("stale", label)
+            self._emit("miss", label)
+            return None
+        dur = time.perf_counter() - t0
+        with self._lock:
+            self._mem[digest] = compiled
+        self._emit("hit", label)
+        self._emit("bytes", label, float(len(blob)))
+        self._span(tracer, label, t0, dur, len(blob))
+        return compiled
+
+    def _span(self, tracer, label: str, t0: float, dur: float,
+              nbytes: int) -> None:
+        with self._lock:
+            tracers = [t for _, t in self._sinks if t is not None]
+        if tracer is not None and all(t is not tracer for t in tracers):
+            tracers.append(tracer)
+        for t in tracers:
+            try:
+                from ..obs.trace import TID_ENGINE
+                t.add("aot_load", t0, dur, TID_ENGINE, cat="compile",
+                      args={"fn": label, "bytes": nbytes})
+            except Exception:       # a dead sink must not break loads
+                pass
+
+    # --------------------------------------------------------- store
+    def store(self, components: Dict[str, str], compiled) -> bool:
+        """Serialize + atomically persist one compiled executable.
+        Returns False (after ONE warn per cache) when the backend cannot
+        serialize or the directory is unwritable — the caller keeps its
+        freshly compiled executable either way, and the executable is
+        MEMOIZED in-process so a watchdog/chaos recovery rebuild does
+        not pay XLA again for a disk-degraded cache (a cache-off rebuild
+        reuses the lru'd jit programs for free; armed-but-unwritable
+        must never be slower than off)."""
+        from ..utils import profiler
+        label = components["program"]
+        try:
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+        except Exception as e:                          # noqa: BLE001
+            self._warn_once(
+                "serialize",
+                "aot_cache: backend cannot serialize %r (%s: %s) — "
+                "cache stays cold" % (label, type(e).__name__, e))
+            self._memoize(components, compiled)
+            return False
+        rec = {"meta": components, "payload": payload,
+               "sha256": hashlib.sha256(payload).hexdigest(),
+               "in_tree": in_tree, "out_tree": out_tree}
+        try:
+            blob = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:                          # noqa: BLE001
+            self._warn_once(
+                "pickle", "aot_cache: cannot pickle artifact for %r "
+                "(%s: %s)" % (label, type(e).__name__, e))
+            self._memoize(components, compiled)
+            return False
+        digest, bin_path, meta_path = self._paths(components)
+        try:
+            os.makedirs(os.path.dirname(bin_path), exist_ok=True)
+            self._atomic_write(bin_path, blob)
+            self._atomic_write(
+                meta_path,
+                json.dumps(components, sort_keys=True, indent=1).encode())
+        except OSError as e:
+            # unwritable/readonly cache dir: ONE warn, metrics keep
+            # showing misses, the engine serves from the compiled
+            # executable it already holds
+            self._warn_once(
+                "unwritable",
+                "aot_cache: cache dir %r unwritable (%s) — compiled "
+                "programs will not persist" % (self.path, e))
+            self._memoize(components, compiled)
+            return False
+        self._emit("bytes", label, float(len(blob)))
+        return True
+
+    def _memoize(self, components: Dict[str, str], compiled) -> None:
+        """In-process fallback for a failed persist (see store)."""
+        with self._lock:
+            self._mem[self.digest(components)] = compiled
+
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".aot-tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _warn_once(self, category: str, msg: str) -> None:
+        """One warning per failure CATEGORY (serialize / pickle /
+        unwritable): an early backend-serialize warn must not swallow a
+        later unwritable-directory warn."""
+        from ..utils import profiler
+        with self._lock:
+            if category in self._warned:
+                return
+            self._warned.add(category)
+        profiler.warn(msg)
+
+    # ------------------------------------------------- staleness scan
+    def stale_entries(self, components: Dict[str, str]
+                      ) -> List[Tuple[str, Dict[str, Tuple[str, str]]]]:
+        """Same-program entries whose key differs from ``components``:
+        ``[(digest, {component: (stored, current), ...}), ...]`` — the
+        CXN210 validator names exactly the drifting component(s)."""
+        cur_digest, _, _ = self._paths(components)
+        base = os.path.join(self.path, components["program"])
+        out = []
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            return out
+        # union of sidecar and payload names: an orphaned .bin (crash /
+        # disk-full between the pair of writes) must still surface as
+        # CXN210 — a cold start would silently miss it and recompile
+        digests = sorted({n[:-5] for n in names if n.endswith(".json")}
+                         | {n[:-4] for n in names if n.endswith(".bin")})
+        for digest in digests:
+            if digest == cur_digest:
+                continue
+            try:
+                with open(os.path.join(base, digest + ".json")) as f:
+                    stored = json.load(f)
+            except Exception:                           # noqa: BLE001
+                out.append((digest, {"entry": ("unreadable meta", "")}))
+                continue
+            drift = {k: (str(stored.get(k, "<absent>")), str(v))
+                     for k, v in components.items()
+                     if stored.get(k) != v}
+            out.append((digest, drift or
+                        {"entry": ("meta/digest mismatch", "")}))
+        return out
+
+    def has(self, components: Dict[str, str]) -> bool:
+        return os.path.exists(self._paths(components)[1])
+
+    # ------------------------------------------------------- metrics
+    def add_sink(self, registry, tracer=None) -> None:
+        """Attach a metrics registry (and optional tracer): the four
+        ``cxn_aot_cache_*_total{fn=}`` families are pre-created so the
+        series exist before the first event. Idempotent per registry."""
+        for name, help_ in METRIC_NAMES:
+            registry.counter(name, help_, labelnames=("fn",))
+        with self._lock:
+            if not any(r is registry for r, _ in self._sinks):
+                self._sinks.append((registry, tracer))
+
+    def remove_sink(self, registry) -> None:
+        with self._lock:
+            self._sinks = [(r, t) for r, t in self._sinks
+                           if r is not registry]
+
+    def _emit(self, kind: str, label: str, n: float = 1.0) -> None:
+        with self._lock:
+            if kind == "hit":
+                self.hits += 1
+            elif kind == "miss":
+                self.misses += 1
+            elif kind == "stale":
+                self.stale += 1
+            elif kind == "bytes":
+                self.bytes += int(n)
+            sinks = list(self._sinks)
+        for registry, _ in sinks:
+            try:
+                registry.counter(_KIND_TO_NAME[kind],
+                                 labelnames=("fn",)).labels(label).inc(n)
+            except Exception:   # a dead sink must not break the cache
+                pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "stale": self.stale, "bytes": self.bytes}
+
+
+# ---------------------------------------------------- process-wide state
+_caches: Dict[str, AotCache] = {}
+_caches_lock = threading.Lock()
+_UNSET = object()
+_override = _UNSET
+
+
+def get_cache(path: str) -> AotCache:
+    """The shared :class:`AotCache` for ``path`` (one instance per real
+    path, so every owner's hits land in the same counters)."""
+    key = os.path.realpath(str(path))
+    with _caches_lock:
+        c = _caches.get(key)
+        if c is None:
+            c = _caches[key] = AotCache(str(path))
+        return c
+
+
+def clear_memory_caches() -> None:
+    """Drop every cache's in-memory executable memo (disk artifacts are
+    untouched) — the fresh-process stand-in for tests and the
+    cold-start bench; ``serve.engine.clear_program_caches`` calls this
+    so one helper resets the whole compiled-program surface."""
+    with _caches_lock:
+        caches = list(_caches.values())
+    for c in caches:
+        with c._lock:
+            c._mem.clear()
+
+
+def configure(path: Optional[str]) -> None:
+    """Set (or, with ``None``, disable) the process-default cache that
+    lazily-resolved programs consult — overrides ``CXN_AOT_CACHE``.
+    Call :func:`reset_configured` to restore env-driven behavior."""
+    global _override
+    _override = get_cache(path) if path else None
+
+
+def reset_configured() -> None:
+    global _override
+    _override = _UNSET
+
+
+def active() -> Optional[AotCache]:
+    """The process-default cache: an explicit :func:`configure` wins,
+    else the ``CXN_AOT_CACHE`` env var, else None (cache off — the
+    pinned no-op)."""
+    if _override is not _UNSET:
+        return _override
+    path = os.environ.get("CXN_AOT_CACHE", "")
+    return get_cache(path) if path else None
+
+
+# ------------------------------------------------------- program wrappers
+class ResolvedProgram:
+    """A loaded/AOT-compiled executable standing in for a jitted
+    program fetch. Calls go to the executable; a signature-mismatch
+    ``TypeError`` (the one-signature discipline was violated) logs once,
+    permanently falls back to the lazy jit builder, and never corrupts
+    state (the pytree/aval check fires before any buffer is donated)."""
+
+    __slots__ = ("exec", "label", "source", "_fallback", "_dead")
+
+    def __init__(self, compiled, label: str, source: str, fallback):
+        self.exec = compiled
+        self.label = label
+        self.source = source            # "aot_load" | "compiled"
+        self._fallback = fallback       # () -> jitted fn
+        self._dead = False
+
+    def __call__(self, *args):
+        if not self._dead:
+            try:
+                return self.exec(*args)
+            except TypeError as e:
+                from ..utils import profiler
+                profiler.warn(
+                    "aot_cache: resolved %r rejected a call signature "
+                    "(%s) — falling back to the jit path" %
+                    (self.label, e))
+                self._dead = True
+        return self._fallback()(*args)
+
+
+class CachedProgram:
+    """Attribute-transparent wrapper (the RecompileGuard idiom: .lower
+    and friends delegate to the wrapped jit) that resolves its ONE
+    compiled executable through an :class:`AotCache` on first call —
+    load on hit, AOT-compile-then-persist on miss. Calls whose abstract
+    signature differs from the resolved one (a second eval batch shape,
+    a different static node set) drop to the plain jit path, which
+    compiles them lazily exactly as before."""
+
+    def __init__(self, fn, name: str, config: str = "", extra: str = "",
+                 donate_argnums: Sequence[int] = (),
+                 static_argnums: Sequence[int] = (), cache=None,
+                 mesh=None):
+        self._fn = fn
+        self._name = name
+        self._config = config
+        self._extra = extra
+        self._donate = tuple(donate_argnums)
+        self._static = tuple(static_argnums)
+        self._static_set = frozenset(self._static)
+        self._cache = cache
+        self._mesh = mesh
+        self._exec = None
+        self._static_vals = None
+        self._resolve_failed = False
+        self.source = ""                # "" | "aot_load" | "compiled"
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:                      # call sites are positional-only
+            return self._fn(*args, **kwargs)
+        if self._exec is not None:
+            if not self._static_set:
+                # hot path (Net's per-step calls): hand the args straight
+                # to the executable — its own pytree/aval validation
+                # rejects an off-signature call BEFORE any buffer is
+                # donated, so the TypeError fallback is state-safe and
+                # the steady state pays zero signature recomputation
+                try:
+                    return self._exec(*args)
+                except TypeError:
+                    return self._fn(*args)
+            # static args are EXCLUDED from the executable's inputs, so
+            # a drifted static (a new forward node set) would not trip
+            # the aval check — compare the static VALUES captured at
+            # resolve (a cheap tuple ==, not a full abstract-signature
+            # recomputation over the args pytree) and leave dynamic-arg
+            # drift to the executable's validation, exactly as above
+            if tuple(args[i] for i in self._static) == self._static_vals:
+                try:
+                    return self._exec(*(a for i, a in enumerate(args)
+                                        if i not in self._static_set))
+                except TypeError:
+                    return self._fn(*args)
+            return self._fn(*args)
+        if self._resolve_failed:
+            return self._fn(*args)
+        cache = self._cache if self._cache is not None else active()
+        if cache is None:
+            return self._fn(*args)
+        self.resolve(cache, args)
+        return self(*args)
+
+    def resolve(self, cache: AotCache, args: tuple, tracer=None) -> str:
+        """Load-or-compile the executable for this exact call signature.
+        Returns the source ("aot_load" / "compiled" / "" on failure)."""
+        comp = cache.components(self._name, args,
+                                donate_argnums=self._donate,
+                                static_argnums=self._static,
+                                extra=self._extra, config=self._config,
+                                mesh=self._mesh)
+        compiled = cache.load(comp, tracer=tracer)
+        if compiled is None:
+            from ..obs.devprof import compile_attribution
+            with compile_attribution(self._name):
+                try:
+                    lowered = self._fn.lower(*args)
+                except Exception:       # noqa: BLE001
+                    # an arg mix .lower cannot abstract (exotic
+                    # static): permanently defer to plain jit dispatch
+                    self._resolve_failed = True
+                    return ""
+                # a genuine compile failure propagates — the jit path
+                # would only repeat the identical (expensive) compile
+                # for the same exception, so no fallback here
+                compiled = lowered.compile()
+            cache.store(comp, compiled)
+            self.source = "compiled"
+        else:
+            self.source = "aot_load"
+        self._exec = compiled
+        self._static_vals = tuple(args[i] for i in self._static)
+        return self.source
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)

@@ -43,6 +43,10 @@ RULES = {
     "CXN209": ("error", "int8 operand silently promoted to f32 inside a "
                         "bf16 quantized step (dequant must target the "
                         "compute dtype)"),
+    "CXN210": ("error", "stale AOT executable-cache artifact: a cached "
+                        "program's key no longer matches the current "
+                        "config/mesh/backend/jax version (the drifting "
+                        "component is named)"),
 }
 
 

@@ -38,6 +38,23 @@ from .findings import Finding, LintReport
 _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
                    "all-to-all", "collective-permute")
 _ALIAS_RE = re.compile(r"\{\s*\d+\s*\}\s*:\s*\((\d+),")
+
+
+def _alias_body(hlo: str) -> str:
+    """The ``input_output_alias={...}`` body from an HLO module header
+    (brace-matched — the map nests braces), or "" when absent. Shared by
+    :func:`audit_jit` and :func:`audit_executable` so the two CXN201
+    checks can never drift apart on header parsing."""
+    header = hlo.splitlines()[0] if hlo else ""
+    if "input_output_alias={" not in header:
+        return ""
+    start = header.index("input_output_alias={") + len(
+        "input_output_alias={")
+    depth, end = 1, start
+    while end < len(header) and depth:
+        depth += {"{": 1, "}": -1}.get(header[end], 0)
+        end += 1
+    return header[start:end]
 _HOST_MARKERS = ("callback", "infeed", "outfeed", "SendToHost",
                  "RecvFromHost")
 # donation markers on @main arguments: jax emits tf.aliasing_output when
@@ -225,17 +242,7 @@ def audit_jit(fn, args: tuple, label: str,
                 "CXN201", "%s: donation dropped at lowering — %s (no "
                 "unaliased output of matching shape/dtype; the buffer "
                 "cannot be reused in place)" % (label, msg.split("\n")[0])))
-    header = hlo.splitlines()[0] if hlo else ""
-    alias_body = ""
-    if "input_output_alias={" in header:
-        start = header.index("input_output_alias={") + len(
-            "input_output_alias={")
-        depth, end = 1, start
-        while end < len(header) and depth:
-            depth += {"{": 1, "}": -1}.get(header[end], 0)
-            end += 1
-        alias_body = header[start:end]
-    compiled_aliased = {int(m) for m in _ALIAS_RE.findall(alias_body)}
+    compiled_aliased = {int(m) for m in _ALIAS_RE.findall(_alias_body(hlo))}
     for p in sorted(donors - compiled_aliased):
         findings.append(Finding(
             "CXN201", "%s: donated buffer (entry param %d, tensor<%s>) "
@@ -313,6 +320,146 @@ def audit_jit(fn, args: tuple, label: str,
                 "stream quantization halved"
                 % (label, info["int8_promotions"])))
     return findings, info
+
+
+_HLO_INT8_PROMOTE_RE = re.compile(
+    r"=\s*f32\[[^\]]*\]\S*\s+convert\(\s*s8\[")
+
+
+def int8_promotions_hlo(hlo_text: str) -> int:
+    """The optimized-HLO twin of :func:`int8_promotions` — ``s8 -> f32``
+    converts in the compiled executable's text. The artifact validator
+    only holds the deserialized executable (no StableHLO render
+    exists for a loaded program), so CXN209 checks the same contract
+    at the HLO level there."""
+    return len(_HLO_INT8_PROMOTE_RE.findall(hlo_text))
+
+
+def audit_executable(compiled, label: str, requested_donations: int = 0,
+                     collective_budget: Optional[int] = None,
+                     check_clip: bool = False,
+                     check_int8: bool = False) -> Tuple[List[Finding],
+                                                        Dict]:
+    """Audit one ALREADY-COMPILED (typically cache-loaded) executable —
+    the artifact-validator half of :func:`audit_jit`, for programs with
+    no lowering to inspect: donation aliasing (CXN201, via the
+    executable's ``input_output_alias`` header against the requested
+    donation count), collective counts (CXN204), paged clip-folding
+    (CXN208), and quantized-dequant hygiene (CXN209, HLO-level)."""
+    findings: List[Finding] = []
+    hlo = compiled.as_text()
+    aliased = len(set(_ALIAS_RE.findall(_alias_body(hlo))))
+    if requested_donations and aliased < requested_donations:
+        findings.append(Finding(
+            "CXN201", "%s: cached executable aliases %d of %d donated "
+            "buffer(s) — the persisted program lost donation aliasing "
+            "the engine relies on for in-place cache updates"
+            % (label, aliased, requested_donations)))
+    counts = collective_counts(hlo)
+    total = sum(counts.values())
+    if collective_budget is not None and collective_budget >= 0 \
+            and total > collective_budget:
+        findings.append(Finding(
+            "CXN204", "%s: cached executable runs %d collectives per "
+            "step (%s), over the pinned budget %d"
+            % (label, total,
+               ", ".join("%s=%d" % (k, v) for k, v in counts.items()
+                         if v), collective_budget)))
+    info = {"label": label, "collectives": counts,
+            "donated": requested_donations, "aliased": aliased,
+            "compile_s": 0.0, "shardings": []}
+    if check_clip:
+        info["entry_clamps"] = entry_clamp_count(hlo)
+        if info["entry_clamps"] > 0:
+            findings.append(Finding(
+                "CXN208", "%s: cached executable materializes %d "
+                "standalone entry-computation clamp(s) — the explicit "
+                "index clip did not fold into its gather/scatter "
+                "fusion" % (label, info["entry_clamps"])))
+    if check_int8:
+        info["int8_promotions"] = int8_promotions_hlo(hlo)
+        if info["int8_promotions"] > 0:
+            findings.append(Finding(
+                "CXN209", "%s: cached executable converts %d int8 "
+                "operand(s) straight to f32 inside a bf16 quantized "
+                "step" % (label, info["int8_promotions"])))
+    return findings, info
+
+
+def audit_aot_artifacts(engine, cache,
+                        collective_budget: Optional[int] = None,
+                        donate: Optional[bool] = None
+                        ) -> Tuple[LintReport, List[Dict]]:
+    """Artifact-validator mode of the compiled-step audit
+    (``tools/cxn_lint.py --compile`` with ``aot_cache=DIR``): for each
+    serve program of ``engine`` (abstract engines audit free — nothing
+    is allocated), compute the CURRENT cache key, then
+
+    * an exact-key artifact is deserialized and audited in place
+      (:func:`audit_executable` — the CI gate sees the program a warm
+      production startup would actually LOAD, not a fresh lookalike);
+    * every same-program entry under a DIFFERENT key is a CXN210
+      "stale AOT artifact" naming the drifting key component(s) —
+      a config edit, mesh change, or jax upgrade that was not followed
+      by re-warming the cache fails CI instead of silently compiling
+      at the next cold start;
+    * a program with no entry at all is reported in the info rows
+      (``aot=absent``) without a finding — an empty cache is cold, not
+      wrong."""
+    from .aot_cache import config_hash, get_cache
+    report = LintReport()
+    infos: List[Dict] = []
+    if isinstance(cache, str):
+        cache = get_cache(cache)
+    paged = bool(getattr(engine, "paged", False))
+    quant = bool(getattr(engine, "int8_weights", False)
+                 or getattr(engine, "kv_int8", False))
+    check_int8 = quant and getattr(engine, "cfg", None) is not None \
+        and engine.cfg.dtype == "bfloat16"
+    cfg_hash = config_hash(engine._cfg_key)
+    for label, fn, args, donate_nums in engine.lint_specs(donate=donate):
+        if label == "serve_prefill":    # per-length legacy admit: uncached
+            continue
+        comp = cache.components(label, args, donate_argnums=donate_nums,
+                                extra=engine.aot_extra(label),
+                                config=cfg_hash, mesh=engine.mesh)
+        for digest, drift in cache.stale_entries(comp):
+            if set(drift) <= {"devices"}:
+                # a sibling artifact for the SAME program on a
+                # different device block — the router's per-replica
+                # placement story, not staleness (each replica warms
+                # its own devices; the validator engine keys to the
+                # default block)
+                continue
+            elide = lambda s: s if len(s) <= 60 else \
+                "%s…%s" % (s[:40], s[-16:])
+            report.add(Finding(
+                "CXN210", "%s: stale AOT artifact %s… — key drifted on "
+                "%s (re-warm the cache, or prune the entry)"
+                % (label, digest[:12],
+                   "; ".join("%s: %r -> %r" % (k, elide(old), elide(new))
+                             for k, (old, new) in sorted(drift.items())))))
+        if not cache.has(comp):
+            infos.append({"label": label, "collectives": {},
+                          "donated": 0, "aliased": 0, "compile_s": 0.0,
+                          "shardings": [], "aot": "absent"})
+            continue
+        compiled = cache.load(comp)
+        if compiled is None:            # corrupt on disk: load() warned
+            infos.append({"label": label, "collectives": {},
+                          "donated": 0, "aliased": 0, "compile_s": 0.0,
+                          "shardings": [], "aot": "corrupt"})
+            continue
+        findings, info = audit_executable(
+            compiled, label,
+            requested_donations=_requested_donations(args, donate_nums,
+                                                     ()),
+            collective_budget=collective_budget,
+            check_clip=paged, check_int8=check_int8)
+        info["aot"] = "ok"
+        report.extend(findings)
+        infos.append(info)
+    return report, infos
 
 
 def net_step_specs(net) -> List[Tuple[str, object, tuple, tuple, tuple]]:

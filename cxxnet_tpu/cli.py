@@ -169,6 +169,14 @@ class LearnTask:
         #                           (empty = random init — testing only)
         self.lint_compile = 0     # task=lint: also lower/compile-audit the
         #                           jitted steps (pass 2; needs init_model)
+        self.aot_cache = ""       # AOT executable cache dir (analysis/
+        #                           aot_cache.py; CXN_AOT_CACHE env is
+        #                           the fallback): serve/train/decode
+        #                           programs load their persisted
+        #                           executables instead of compiling on
+        #                           a warm start; cxn-lint --compile
+        #                           validates the artifacts (CXN210).
+        #                           Empty = off (a pinned no-op).
         self.obs_trace = 1        # span tracing (obs/trace.py): cheap
         #                           enough to stay on; 0 disables
         self.obs_trace_buffer = 65536   # span ring capacity (old spans
@@ -320,6 +328,8 @@ class LearnTask:
             self.name_pred = val
         elif name == "lint_compile":
             self.lint_compile = int(val)
+        elif name == "aot_cache":
+            self.aot_cache = val
         elif name == "obs_trace":
             self.obs_trace = int(val)
         elif name == "obs_trace_buffer":
@@ -967,9 +977,24 @@ class LearnTask:
                                block_size=self.serve_block_size,
                                fused_attn=bool(self.serve_fused_attn),
                                int8_weights=bool(self.serve_int8_weights),
-                               kv_dtype=self.serve_kv_dtype)
+                               kv_dtype=self.serve_kv_dtype,
+                               aot=self.aot_cache or None)
             table.merge(devprof.profile_engine(
                 eng, registry=reg, time_reps=self.prof_reps))
+            if self.aot_cache:
+                # cached-vs-compiled per program: which executables a
+                # production startup over this config would LOAD vs pay
+                # XLA for (doc/performance.md "AOT executable cache")
+                from .analysis.aot_cache import get_cache
+                st = eng.aot_status()
+                stats = get_cache(self.aot_cache).stats()
+                print("aot cache (%s): %s | hits %d, misses %d, stale "
+                      "%d, %.1f KiB moved"
+                      % (self.aot_cache,
+                         ", ".join("%s=%s" % kv for kv in sorted(
+                             st.items())) or "no programs",
+                         stats["hits"], stats["misses"], stats["stale"],
+                         stats["bytes"] / 1024.0))
             eng.close()
         print(table.format_roofline())
         ledger = devprof.register_net_pools(self.net)
@@ -1044,7 +1069,8 @@ class LearnTask:
                          watchdog_ms=self.serve_watchdog_ms,
                          degrade=bool(self.serve_degrade),
                          tp=self.serve_tp,
-                         tenants=self.serve_tenants)
+                         tenants=self.serve_tenants,
+                         aot_cache=self.aot_cache)
         routed = self.serve_replicas > 1
         if routed:
             # replicated serving: N engines behind the prefix- and
@@ -1088,6 +1114,12 @@ class LearnTask:
                 mode += ", tenants [%s]" % ", ".join(
                     "%s=%s" % (t, ten.policy_for(t).priority[0].upper())
                     for t in ten.label_names())
+            if self.aot_cache:
+                st = (srv.servers[0] if routed else srv)._engine \
+                    .aot_status()
+                loaded = sum(1 for v in st.values() if v == "aot_load")
+                mode += ", aot cache %s (%d/%d programs loaded)" % (
+                    self.aot_cache, loaded, len(st))
             inj = (srv.servers[0] if routed else srv).fault_injector
             if inj is not None:
                 mode += ", CHAOS armed (%s)" % inj.spec

@@ -952,7 +952,19 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                                       jnp.arange(max_new - 1))
         return ids
 
-    return jax.jit(run)
+    # AOT executable cache (analysis/aot_cache.py): when a cache is
+    # active (aot_cache config key / CXN_AOT_CACHE env), the first call
+    # of each decode signature loads its persisted executable instead of
+    # compiling — the per-signature compile storm CompileWatch measures
+    # under fn="gpt_decode" disappears on a warm start. Inactive (the
+    # default), the wrapper is one ``active() is None`` check per call.
+    # Every lru-key constant selects a different program, so all of them
+    # ride in the cache key's `extra` component.
+    from ..analysis.aot_cache import CachedProgram, config_hash
+    return CachedProgram(
+        jax.jit(run), "gpt_decode", config=config_hash(cfg_key),
+        extra=repr((n_prompt, max_new, temperature, fused, int8,
+                    fold_head, top_k, top_p)))
 
 
 def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,

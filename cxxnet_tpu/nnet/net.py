@@ -22,6 +22,7 @@ fused by XLA into the first conv.
 from __future__ import annotations
 
 import json
+import os
 import struct
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -99,6 +100,13 @@ class Net:
         # (CXN207; 0 = unbudgeted) — the compile-time regression gate
         # tools/cxn_lint.py --compile enforces in CI
         self.lint_compile_budget_s = 0.0
+        # AOT executable cache dir (analysis/aot_cache.py; the
+        # CXN_AOT_CACHE env var is the fallback): the four hot jitted
+        # steps resolve through it on first call — deserialize-and-load
+        # on a key hit instead of compiling, persist-after-compile on a
+        # miss — so trainer startup over an unchanged config skips XLA
+        # entirely. "" (default) is a pinned no-op.
+        self.aot_cache = ""
         # device/compiler observatory (obs/devprof.py): one BLOCKING
         # device-time sample per prof_every train steps publishing
         # cxn_program_seconds / cxn_mfu gauges; 0 (default) keeps the
@@ -175,6 +183,8 @@ class Net:
                 self.lint_compile_budget_s = float(v)
             elif k == "prof_every":
                 self.prof_every = int(v)
+            elif k == "aot_cache":
+                self.aot_cache = v
             elif k.startswith("metric"):
                 self.train_metrics.configure(k, v)
                 self.eval_metrics.configure(k, v)
@@ -340,6 +350,37 @@ class Net:
         # node_ids is static: each distinct request set compiles a forward
         # that materializes only those nodes (XLA fuses the rest away)
         self._jit_forward = jax.jit(self._forward_eval, static_argnums=(4,))
+        # AOT executable cache (analysis/aot_cache.py): wrap each hot
+        # step so its ONE training signature resolves from disk on
+        # first call — load instead of compile on a warm startup,
+        # compile-then-persist otherwise. Off-signature calls (a second
+        # eval batch shape, a new forward node set) keep the lazy jit
+        # path untouched. The config hash covers every (key, value)
+        # pair: python constants baked into the trace (eta, wiring)
+        # can never alias across configs.
+        aot_path = self.aot_cache or os.environ.get("CXN_AOT_CACHE", "")
+        if aot_path:
+            from ..analysis.aot_cache import (CachedProgram, config_hash,
+                                              get_cache)
+            from ..obs.metrics import default_registry as _dreg
+            aot = get_cache(aot_path)
+            aot.add_sink(_dreg())
+            chash = config_hash(sorted(
+                p for p in self.cfg if p[0] != "aot_cache"))
+
+            def wrap(fn, name, donate, static=()):
+                return CachedProgram(fn, name, config=chash,
+                                     donate_argnums=donate,
+                                     static_argnums=static, cache=aot,
+                                     mesh=self.mesh)
+
+            self._jit_update = wrap(self._jit_update, "net_update",
+                                    (0, 1, 2, 3))
+            self._jit_accum = wrap(self._jit_accum, "net_accum", (0, 3))
+            self._jit_apply = wrap(self._jit_apply, "net_apply",
+                                   (0, 1, 2))
+            self._jit_forward = wrap(self._jit_forward, "net_forward",
+                                     (), (4,))
         # process-level train-step counter in the obs registry (shared
         # across Nets, like any Prometheus process counter)
         from ..obs.metrics import default_registry

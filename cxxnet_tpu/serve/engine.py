@@ -177,7 +177,8 @@ from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
 __all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
            "assert_fused_allclose", "kv_int8_tolerance",
-           "serve_param_shardings", "serve_kv_sharding", "serve_tp_size"]
+           "serve_param_shardings", "serve_kv_sharding", "serve_tp_size",
+           "clear_program_caches"]
 
 
 def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
@@ -1140,6 +1141,21 @@ def _scatter_blocks_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool):
     return jax.jit(impl, donate_argnums=(0, 1) if donate else ())
 
 
+def clear_program_caches() -> None:
+    """Drop every module-level compiled-program cache AND the AOT
+    cache's in-memory executable memos. Tests and the cold-start bench
+    use this to simulate a fresh process: the next program fetch
+    re-resolves — from the AOT executable cache's DISK artifacts when
+    one is armed (analysis/aot_cache.py), else by tracing + compiling."""
+    for f in (_tick_fn, _prefill_fn, _prefill_chunk_fn, _verify_fn,
+              _extract_chunks_fn, _insert_prefix_fn, _tick_paged_fn,
+              _prefill_chunk_paged_fn, _verify_paged_fn, _copy_block_fn,
+              _gather_blocks_fn, _scatter_blocks_fn):
+        f.cache_clear()
+    from ..analysis.aot_cache import clear_memory_caches
+    clear_memory_caches()
+
+
 class DecodeEngine:
     """Owns the KV cache — the dense slot pool, or the paged block pool
     plus block tables (``num_blocks > 0``) — and drives the jitted
@@ -1155,7 +1171,8 @@ class DecodeEngine:
                  spec_len: int = 0, obs_registry=None,
                  num_blocks: int = 0, block_size: int = 0,
                  injector=None, fused_attn: bool = True, mesh=None,
-                 int8_weights: bool = False, kv_dtype: str = ""):
+                 int8_weights: bool = False, kv_dtype: str = "",
+                 aot=None, tracer=None):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -1501,6 +1518,19 @@ class DecodeEngine:
                     lambda sig: None, "serve_tick", recompile_limit,
                     strict=bool(recompile_strict), log=profiler.warn,
                     on_trip=on_trip)
+        # AOT executable cache (analysis/aot_cache.py, doc/performance.md
+        # "AOT executable cache"): ``aot`` is an AotCache (or a dir
+        # path); the serve programs resolve through it at build —
+        # deserialize-and-load on a key hit (ZERO XLA compilation),
+        # AOT-compile-then-persist on a miss — so every later engine
+        # build, _build_stack() recovery, and replica spin-up over the
+        # same key starts in milliseconds. None (the default) is a
+        # pinned no-op: the lazy module-level jit path runs untouched.
+        self._aot = None
+        self._aot_progs: Dict[str, object] = {}
+        self._aot_src: Dict[str, str] = {}
+        if aot is not None and not abstract:
+            self.warm_aot(aot, tracer=tracer)
 
     def set_profiler(self, prof) -> None:
         """Arm live per-program device timing (an
@@ -1541,6 +1571,62 @@ class DecodeEngine:
         (slots x bpr) block-table shape = one signature across every
         occupancy mix — pinned by tests/test_serve_paged.py."""
         return self._tguard.signatures if self._tguard is not None else ()
+
+    def aot_extra(self, label: str) -> str:
+        """The AOT-cache key's ``extra`` component for one program:
+        every builder constant that selects a different executable
+        WITHOUT changing the abstract signature (the fused/gather
+        resolution, geometry constants, the guard-suffix flags). The
+        artifact validator (analysis/step_audit.py:audit_aot_artifacts)
+        must derive the same string, so it lives here, next to the
+        builders it describes."""
+        return "%s/chunk=%d/bs=%d/bpr=%d/spec=%d/fused=%d%s" % (
+            label, self.chunk, self.block_size, self.bpr, self.spec_len,
+            int(self.fused_attn), self._sig_suffix)
+
+    def warm_aot(self, cache=None, tracer=None) -> Dict[str, str]:
+        """Resolve the serve programs through the AOT executable cache:
+        for each program the engine will run (the same abstract specs
+        the compiled-step audit lowers), deserialize-and-load the
+        artifact for its exact key, or AOT-compile once and persist it.
+        Returns ``{label: "aot_load" | "compiled"}`` (also kept as
+        :meth:`aot_status`). The legacy whole-prompt prefill is skipped
+        — one program per prompt length has no single spec to warm; its
+        signatures stay on the lazy jit path."""
+        from ..analysis import aot_cache as aot_mod
+        cache = cache if cache is not None else self._aot
+        if cache is None:
+            return {}
+        if isinstance(cache, str):
+            cache = aot_mod.get_cache(cache)
+        self._aot = cache
+        cfg_hash = aot_mod.config_hash(self._cfg_key)
+        for label, fn, args, donate_nums in self.lint_specs(donate=None):
+            if label == "serve_prefill":
+                continue
+            comp = cache.components(label, args,
+                                    donate_argnums=donate_nums,
+                                    extra=self.aot_extra(label),
+                                    config=cfg_hash, mesh=self.mesh)
+            compiled = cache.load(comp, tracer=tracer)
+            if compiled is None:
+                with compile_attribution(label):
+                    compiled = fn.lower(*args).compile()
+                cache.store(comp, compiled)
+                src = "compiled"
+            else:
+                src = "aot_load"
+            self._aot_progs[label] = aot_mod.ResolvedProgram(
+                compiled, label, src, (lambda f=fn: f))
+            self._aot_src[label] = src
+        return dict(self._aot_src)
+
+    def aot_status(self) -> Dict[str, str]:
+        """How each serve program was resolved at the last
+        :meth:`warm_aot` — ``"aot_load"`` (deserialized from the cache)
+        or ``"compiled"`` (compiled, then persisted); empty when the
+        cache is off (``task=prof`` reports this table)."""
+        return dict(self._aot_src)
 
     def lint_specs(self, n_prompt: int = 8, donate: Optional[bool] = None):
         """(label, jitted fn, abstract args, donate_argnums) rows for the
@@ -1739,6 +1825,9 @@ class DecodeEngine:
             fn = _prefill_chunk_fn(self._cfg_key, self.chunk,
                                    self._donate, mesh=self.mesh)
             args = ()
+        # AOT-cache-resolved executable (load-instead-of-compile) when
+        # the engine was warmed; the lazy jit above is its fallback
+        fn = self._aot_progs.get("serve_prefill_chunk", fn)
         t0 = self._prof.begin("serve_prefill_chunk") \
             if self._prof is not None else None
         with compile_attribution("serve_prefill_chunk"):
@@ -1804,6 +1893,10 @@ class DecodeEngine:
             fn = _verify_fn(self._cfg_key, k, self._donate,
                             mesh=self.mesh)
             args = ()
+        if k == self.spec_len:
+            # the one full-window signature the cache holds; a narrower
+            # ad-hoc window keeps the lazy jit path
+            fn = self._aot_progs.get("serve_verify_chunk", fn)
         t0 = self._prof.begin("serve_verify_chunk") \
             if self._prof is not None else None
         with compile_attribution("serve_verify_chunk"):
@@ -1882,6 +1975,7 @@ class DecodeEngine:
         else:
             fn = _tick_fn(self._cfg_key, self._donate, mesh=self.mesh)
             args = ()
+        fn = self._aot_progs.get("serve_tick", fn)
         t0 = self._prof.begin("serve_tick") \
             if self._prof is not None else None
         with compile_attribution("serve_tick"):

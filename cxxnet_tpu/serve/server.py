@@ -163,7 +163,8 @@ class InferenceServer:
                  chaos: str = "", max_restarts: int = 3,
                  watchdog_ms: float = 0.0, degrade: bool = True,
                  tp: int = 0, mesh=None, tenants: str = "",
-                 int8_weights: bool = False, kv_dtype: str = ""):
+                 int8_weights: bool = False, kv_dtype: str = "",
+                 aot_cache: str = ""):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -284,7 +285,20 @@ class InferenceServer:
         chunked prefill and ``n_head`` divisible by ``tp``; the fused
         paged-attention kernel resolves to the gather fallback under
         TP. Pass ``mesh`` to serve over an explicit pre-built mesh
-        instead (``tp`` is then ignored)."""
+        instead (``tp`` is then ignored).
+
+        AOT executable cache (doc/performance.md "AOT executable
+        cache"): ``aot_cache`` is a directory (or the ``CXN_AOT_CACHE``
+        env var; the explicit parameter wins) holding serialized
+        compiled serve programs. At build — and on every
+        watchdog/fault ``_build_stack()`` rebuild — the engine's
+        prefill-chunk / verify / tick executables are LOADED from it
+        when their full key matches (zero XLA compilation, sub-second
+        cold start; the ``cxn_aot_cache_*`` counters and ``aot_load``
+        spans witness it) and compiled-then-persisted otherwise. A
+        corrupt entry or an unwritable directory degrades to compiling
+        with one logged warning. Unset (the default) is a pinned
+        no-op."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -390,6 +404,19 @@ class InferenceServer:
         # extraction AOT-compiles every engine program once, which is
         # startup cost a prof_every=0 server must not pay
         devprof.compile_watch().add_sink(self._registry, self._tracer)
+        # AOT executable cache (analysis/aot_cache.py): armed by the
+        # aot_cache param or CXN_AOT_CACHE; every _build_stack() — the
+        # first one AND every recovery rebuild — resolves the serve
+        # programs through it (load on key hit, compile-and-persist on
+        # miss), with hits/misses/stale/bytes counted in this server's
+        # registry and aot_load spans on the engine trace track
+        self._aot = None
+        aot_path = str(aot_cache or "") or os.environ.get(
+            "CXN_AOT_CACHE", "")
+        if aot_path:
+            from ..analysis.aot_cache import get_cache
+            self._aot = get_cache(aot_path)
+            self._aot.add_sink(self._registry, self._tracer)
         # StepStats feeds the registry (utils/profiler.py observer):
         # every phase sample lands in the mergeable per-phase histogram
         # as well as the StepStats percentile window
@@ -454,7 +481,10 @@ class InferenceServer:
         replayed traffic itself. The jitted programs are module-level
         lru caches keyed by config, so a rebuild reuses every compiled
         executable — teardown + rebuild is host bookkeeping plus one
-        pool allocation, not a recompile."""
+        pool allocation, not a recompile. With the AOT executable cache
+        armed the same holds ACROSS processes: a supervisor-restarted
+        server (cold lru caches) re-resolves every program from disk
+        instead of compiling (analysis/aot_cache.py)."""
         b = self._build
         cfg, slots, spec_mode = b["cfg"], b["slots"], b["spec_mode"]
         prefill_chunk, prefix_mb = b["prefill_chunk"], b["prefix_mb"]
@@ -468,7 +498,8 @@ class InferenceServer:
             block_size=b["block_size"] if self._paged else 0,
             injector=self._inj, fused_attn=b["fused_attn"],
             mesh=b["mesh"], int8_weights=b["int8_weights"],
-            kv_dtype=b["kv_dtype"])
+            kv_dtype=b["kv_dtype"],
+            aot=self._aot, tracer=self._tracer)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
             if self._paged:
@@ -1497,6 +1528,10 @@ class InferenceServer:
             tr.add("recovery", t0, t1 - t0, TID_ENGINE, cat="resilience",
                    args={"reason": reason, "restart": self._restarts,
                          "replayed": len(reqs)})
+        # teardown -> rebuild -> requeue wall of THIS recovery (the
+        # bench.py cold-start cell and metrics() read it; with a warm
+        # AOT cache the rebuild loads executables instead of compiling)
+        self._last_recover_ms = (t1 - t0) * 1e3
         profiler.warn("serve: engine rebuilt cold in %.0f ms (restart "
                       "%d/%d), replaying %d in-flight request(s)"
                       % ((t1 - t0) * 1e3, self._restarts,
@@ -1789,6 +1824,8 @@ class InferenceServer:
         # and stop routing process compile events into a dead server's
         # registry (the CompileWatch sink holds a reference to it)
         devprof.compile_watch().remove_sink(self._registry)
+        if self._aot is not None:
+            self._aot.remove_sink(self._registry)
 
     def close(self) -> None:
         self.shutdown(drain=False)
@@ -1811,6 +1848,12 @@ class InferenceServer:
         sc = self._sched
         pc = self._prefix
         return {
+            # AOT executable cache: resolution source per program +
+            # process-wide cache traffic; the key is ADDED only when
+            # armed so the uncached metrics() surface stays identical
+            **({"aot_cache": dict(self._aot.stats(),
+                                  programs=self._engine.aot_status())}
+               if self._aot is not None else {}),
             "requests": dict(self._counts),
             "ttft_ms": ms(self._ttft_s),
             "token_ms": ms(self._tok_gap_s),
@@ -1848,6 +1891,7 @@ class InferenceServer:
                 "state": self.health()["state"],
                 "rung": self._ladder.rung,
                 "restarts": self._restarts,
+                "last_recover_ms": getattr(self, "_last_recover_ms", 0.0),
                 "replayed": self._replayed,
                 "shed": self._ladder.sheds,
                 "reserve_stalls": self._reserve_stalls,

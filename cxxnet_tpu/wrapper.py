@@ -200,7 +200,8 @@ class Net:
                     degrade: bool = True, tp: int = 0,
                     replicas: int = 1, router_policy: str = "prefix",
                     tenants: str = "", int8_weights: bool = False,
-                    kv_dtype: str = "", **defaults) -> None:
+                    kv_dtype: str = "", aot_cache: str = "",
+                    **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
         serve``). ``prefill_chunk``/``prefill_budget`` shape the chunked
@@ -282,7 +283,15 @@ class Net:
         paged KV pool per-block-scaled int8 — ~2x tokens per ``kv_mb``
         and halved swap bandwidth, accuracy pinned by
         ``serve.engine.kv_int8_tolerance``. Both default off (pinned
-        no-ops)."""
+        no-ops).
+
+        AOT executable cache (doc/performance.md "AOT executable
+        cache"): ``aot_cache`` is a directory of serialized compiled
+        serve programs (``CXN_AOT_CACHE`` env is the fallback) — a
+        warm start LOADS the engine's chunk-prefill/verify/tick
+        executables instead of compiling them, and every recovery
+        rebuild / replica spin-up over the same key does the same.
+        Empty (the default) is a pinned no-op."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams, ServeRouter
         if getattr(self, "_server", None) is not None:
@@ -303,6 +312,7 @@ class Net:
             max_restarts=max_restarts, watchdog_ms=watchdog_ms,
             degrade=degrade, tp=tp, tenants=tenants,
             int8_weights=int8_weights, kv_dtype=kv_dtype,
+            aot_cache=aot_cache,
             defaults=SamplingParams(**defaults))
         if replicas > 1:
             # each replica owns its registry; the merged payload is

@@ -1,0 +1,422 @@
+"""AOT executable cache (analysis/aot_cache.py + the engine/Net/
+gpt_decode fetch points).
+
+The load-bearing invariants:
+
+1. **bit identity** — a cache-hit engine's served tokens equal a
+   freshly-compiled engine's AND the solo ``gpt_decode`` oracle, greedy
+   and sampled, paged and speculative;
+2. **zero compile on warm start** — with a warm cache and the in-process
+   program caches cleared (a fresh-process stand-in), building and
+   serving performs NO ``/jax/core/compile/*`` work for the cached
+   programs (CompileWatch per-label attribution is the witness);
+3. **key invalidation** — every key component (config hash, signature,
+   extra flags, mesh, devices, backend, jax/jaxlib version) drifting is
+   a miss, and the CXN210 validator names the drifting component;
+4. **corruption safety** — a truncated/garbage entry logs one warning,
+   counts stale, and falls through to a normal compile — never a crash;
+5. **recovery** — ``_build_stack()`` after an injected engine fault
+   re-resolves every program from the cache (zero new compile seconds);
+6. **aot_cache unset is a no-op** — no cache object, no resolved
+   programs, the lazy jit path untouched (the rest of the serve suite
+   is the real pin).
+"""
+
+import glob
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis import aot_cache as aot_mod
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.obs import devprof
+from cxxnet_tpu.serve import InferenceServer
+from cxxnet_tpu.serve import engine as engine_mod
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+SERVE_LABELS = ("serve_prefill_chunk", "serve_verify_chunk", "serve_tick")
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _cases(rs):
+    """Greedy + sampled + shared-prefix mixed workload."""
+    shared = _prompt(rs, 8)
+    return [
+        dict(p=_prompt(rs, 5), max_tokens=5),
+        dict(p=np.concatenate([shared, _prompt(rs, 3)]), max_tokens=5),
+        dict(p=np.concatenate([shared, _prompt(rs, 2)]), max_tokens=4,
+             temperature=0.8, top_k=5, seed=7),
+        dict(p=_prompt(rs, 9), max_tokens=5, temperature=1.1, seed=3),
+    ]
+
+
+def _serve(srv, cases):
+    hs = [srv.submit(c["p"], **{k: v for k, v in c.items() if k != "p"})
+          for c in cases]
+    res = [srv.result(h, timeout=300) for h in hs]
+    assert all(r.status == "ok" for r in res), [r.status for r in res]
+    return [tuple(int(t) for t in r.tokens) for r in res]
+
+
+def _serve_compile_seconds():
+    """Per-label compile seconds for the serve programs (CompileWatch)."""
+    totals = devprof.compile_watch().totals
+    return {k: totals.get(k, 0.0) for k in SERVE_LABELS}
+
+
+# ------------------------------------------------- unit: cache + wrapper
+def test_cached_program_roundtrip(tmp_path):
+    cache = aot_mod.get_cache(str(tmp_path))
+    jit = lambda: jax.jit(lambda x, n: x * 2 + n, static_argnums=(1,))
+    x = jax.numpy.ones((4,), np.float32)
+    cp = aot_mod.CachedProgram(jit(), "toy", config="c1", extra="e1",
+                               static_argnums=(1,), cache=cache)
+    np.testing.assert_array_equal(np.asarray(cp(x, 3)), np.full(4, 5.0))
+    assert cp.source == "compiled"
+    assert cache.stats()["misses"] >= 1
+    # a fresh wrapper (fresh-process stand-in) loads instead of compiling
+    cp2 = aot_mod.CachedProgram(jit(), "toy", config="c1", extra="e1",
+                                static_argnums=(1,), cache=cache)
+    h0 = cache.stats()["hits"]
+    np.testing.assert_array_equal(np.asarray(cp2(x, 3)), np.full(4, 5.0))
+    assert cp2.source == "aot_load" and cache.stats()["hits"] == h0 + 1
+    # a drifted static value drops to the plain jit path (and still works)
+    np.testing.assert_array_equal(np.asarray(cp2(x, 5)), np.full(4, 7.0))
+    # attribute transparency: .lower reaches the wrapped jit
+    assert hasattr(cp2, "lower")
+
+
+def test_key_invalidation_names_each_component(tmp_path):
+    """Every key component drifting is (a) a different digest — a miss —
+    and (b) named by stale_entries (the CXN210 source)."""
+    cache = aot_mod.get_cache(str(tmp_path))
+    x = jax.numpy.ones((3,), np.float32)
+    comp = cache.components("p", (x,), extra="A", config="c1")
+    compiled = jax.jit(lambda x: x + 1).lower(x).compile()
+    assert cache.store(comp, compiled)
+    assert cache.load(dict(comp)) is not None
+    for field, val in [("config", "c2"), ("extra", "B|interpret=0"),
+                       ("mesh", "model=2"), ("devices", "7:TPU v99"),
+                       ("backend", "tpu"), ("jax", "9.9.9"),
+                       ("jaxlib", "9.9.8"),
+                       ("signature", comp["signature"] + "x")]:
+        drifted = dict(comp, **{field: val})
+        assert cache.digest(drifted) != cache.digest(comp)
+        assert cache.load(drifted) is None          # miss, not a crash
+        stale = cache.stale_entries(drifted)
+        assert stale and any(field in d for _, d in stale), \
+            (field, stale)
+    # an orphaned payload (crash between the .bin and .json writes of
+    # the pair) must still surface in the scan, as "unreadable meta"
+    orphan = tmp_path / "p" / ("0" * 64 + ".bin")
+    orphan.write_bytes(b"payload without a sidecar")
+    stale = cache.stale_entries(dict(comp, config="c3"))
+    assert any(d.get("entry", ("",))[0] == "unreadable meta"
+               for _, d in stale), stale
+    orphan.unlink()
+
+
+def test_faked_jax_version_invalidates(tmp_path, monkeypatch):
+    cache = aot_mod.get_cache(str(tmp_path))
+    x = jax.numpy.ones((3,), np.float32)
+    comp = cache.components("p", (x,), config="c1")
+    cache.store(comp, jax.jit(lambda x: x + 1).lower(x).compile())
+    monkeypatch.setattr(aot_mod, "_versions", lambda: ("99.0.0", "99.0.0"))
+    comp2 = cache.components("p", (x,), config="c1")
+    assert cache.load(comp2) is None
+    stale = cache.stale_entries(comp2)
+    assert stale and all("jax" in drift for _, drift in stale)
+    assert stale[0][1]["jax"] == (jax.__version__, "99.0.0")
+
+
+def test_corrupted_entry_falls_through(tmp_path, capfd):
+    cache = aot_mod.get_cache(str(tmp_path))
+    x = jax.numpy.ones((3,), np.float32)
+    comp = cache.components("p", (x,), config="c1")
+    cache.store(comp, jax.jit(lambda x: x + 1).lower(x).compile())
+    for b in glob.glob(str(tmp_path / "p" / "*.bin")):
+        with open(b, "wb") as f:
+            f.write(b"garbage")
+    s0 = cache.stats()["stale"]
+    assert cache.load(comp) is None
+    assert cache.stats()["stale"] == s0 + 1
+    assert "recompiling" in capfd.readouterr().err
+
+
+# --------------------------------------------- serve engine: warm start
+def _populate(tmp_path, **kw):
+    """One throwaway server build that compiles + persists everything."""
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         aot_cache=str(tmp_path), **kw) as srv:
+        assert set(srv._engine.aot_status()) >= {"serve_prefill_chunk",
+                                                 "serve_tick"}
+        return srv._engine.aot_status()
+
+
+def test_warm_start_bit_identical_and_zero_compile(tmp_path):
+    """The acceptance pin: warm-cache startup loads every serve program
+    (zero /jax/core/compile/* seconds for the cached labels) and serves
+    bit-identical tokens — greedy AND sampled, paged + prefix sharing."""
+    rs = np.random.RandomState(0)
+    cases = _cases(rs)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                         prefill_chunk=4) as srv:
+        ref = _serve(srv, cases)
+    status = _populate(tmp_path)
+    assert all(v == "compiled" for v in status.values())
+    # fresh-process stand-in: drop every in-process compiled program
+    engine_mod.clear_program_caches()
+    before = _serve_compile_seconds()
+    from cxxnet_tpu.obs.trace import TID_ENGINE, Tracer
+    tr = Tracer()
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         aot_cache=str(tmp_path), tracer=tr) as srv:
+        status = srv._engine.aot_status()
+        got = _serve(srv, cases)
+        m = srv.metrics()
+    assert all(v == "aot_load" for v in status.values()), status
+    assert got == ref
+    assert _serve_compile_seconds() == before, \
+        "warm start must not compile any cached serve program"
+    assert m["aot_cache"]["hits"] >= 2
+    # the compile spans of a cold start are REPLACED by aot_load spans
+    # on the engine trace track (one per loaded program); the small
+    # uncached copy programs (COW faults) may still compile — only the
+    # CACHED labels must show zero compile spans
+    spans = tr.spans(TID_ENGINE)
+    assert sum(1 for s in spans if s.name == "aot_load") >= 2
+    compiled_fns = {(s.args or {}).get("fn") for s in spans
+                    if s.name == "compile"}
+    assert not (compiled_fns & set(SERVE_LABELS)), compiled_fns
+
+
+def test_warm_start_speculative_identity(tmp_path):
+    rs = np.random.RandomState(3)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base, base])     # n-gram bait
+    kw = dict(slots=2, queue=8, prefill_chunk=4, spec_mode="ngram",
+              spec_len=3)
+    with InferenceServer(CFG, PARAMS, **kw) as srv:
+        ref = srv.result(srv.submit(prompt, max_tokens=8), timeout=300)
+    _populate(tmp_path, spec_mode="ngram", spec_len=3)
+    engine_mod.clear_program_caches()
+    before = _serve_compile_seconds()
+    with InferenceServer(CFG, PARAMS, aot_cache=str(tmp_path),
+                         **kw) as srv:
+        assert srv._engine.aot_status().get("serve_verify_chunk") \
+            == "aot_load"
+        res = srv.result(srv.submit(prompt, max_tokens=8), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok" and m["spec_forwards"] >= 1
+    np.testing.assert_array_equal(res.tokens, ref.tokens)
+    assert _serve_compile_seconds() == before
+
+
+def test_corrupt_cache_serves_by_compiling(tmp_path, capfd):
+    rs = np.random.RandomState(1)
+    cases = _cases(rs)[:2]
+    _populate(tmp_path)
+    for b in glob.glob(str(tmp_path / "*" / "*.bin")):
+        with open(b, "wb") as f:
+            f.write(b"\x00garbage")
+    engine_mod.clear_program_caches()
+    cache = aot_mod.get_cache(str(tmp_path))
+    s0 = cache.stats()["stale"]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         aot_cache=str(tmp_path)) as srv:
+        assert all(v == "compiled"
+                   for v in srv._engine.aot_status().values())
+        got = _serve(srv, cases)
+    assert cache.stats()["stale"] > s0
+    assert "recompiling" in capfd.readouterr().err
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                         prefill_chunk=4) as srv:
+        assert got == _serve(srv, cases)
+
+
+def test_recovery_rebuilds_from_cache(tmp_path):
+    """PR 9's _build_stack() restart path: with a warm cache (and the
+    in-process program caches cleared — a supervisor-restart stand-in),
+    an injected engine fault recovers by LOADING every program; the
+    replayed stream is bit-identical and no cached label compiles."""
+    rs = np.random.RandomState(4)
+    cases = [dict(p=_prompt(rs, 7), max_tokens=8),
+             dict(p=_prompt(rs, 5), max_tokens=6)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                         prefill_chunk=4) as srv:
+        ref = _serve(srv, cases)
+    _populate(tmp_path)
+    engine_mod.clear_program_caches()
+    before = _serve_compile_seconds()
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16, prefill_chunk=4,
+                         aot_cache=str(tmp_path), chaos="tick_raise@2",
+                         max_restarts=2) as srv:
+        got = _serve(srv, cases)
+        m = srv.metrics()
+    assert m["resilience"]["restarts"] >= 1, \
+        "the injected fault must trigger recovery"
+    assert got == ref
+    assert _serve_compile_seconds() == before, \
+        "recovery must re-resolve programs from the cache, not compile"
+
+
+def test_unwritable_cache_dir_degrades_gracefully(tmp_path, capfd):
+    """aot_cache pointing at an unusable path: ONE warn, metrics show
+    misses and zero hits, the engine builds by compiling and serves."""
+    rs = np.random.RandomState(2)
+    notadir = tmp_path / "occupied"
+    notadir.write_text("not a directory")
+    cache = aot_mod.get_cache(str(notadir))
+    m0 = cache.stats()
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         aot_cache=str(notadir)) as srv:
+        assert all(v == "compiled"
+                   for v in srv._engine.aot_status().values())
+        res = srv.result(srv.submit(_prompt(rs, 6), max_tokens=5),
+                         timeout=300)
+    assert res.status == "ok"
+    m1 = cache.stats()
+    assert m1["misses"] > m0["misses"] and m1["hits"] == m0["hits"]
+    err = capfd.readouterr().err
+    # exactly ONE warn, not one per program (the tmp path itself
+    # contains "unwritable" — count the message tail instead)
+    assert err.count("compiled programs will not persist") == 1, err
+    # the failed store MEMOIZED the executables: an in-process rebuild
+    # (what a watchdog recovery does) re-resolves without paying XLA
+    # again — armed-but-unwritable must never be slower than cache-off
+    t0 = _serve_compile_seconds()
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         aot_cache=str(notadir)) as srv2:
+        assert all(v == "aot_load"
+                   for v in srv2._engine.aot_status().values())
+    assert _serve_compile_seconds() == t0
+
+
+def test_unset_is_a_noop():
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8,
+                         prefill_chunk=4) as srv:
+        assert srv._aot is None
+        assert srv._engine.aot_status() == {}
+        assert "aot_cache" not in srv.metrics()
+
+
+# ------------------------------------------------------- CXN210 validator
+def test_artifact_validator_flags_stale(tmp_path, monkeypatch):
+    from cxxnet_tpu.analysis.step_audit import audit_aot_artifacts
+    _populate(tmp_path)
+    # an abstract validator engine sized EXACTLY like the server's
+    # (same auto_num_blocks inputs) — its keys must match the artifacts
+    veng = engine_mod.DecodeEngine(
+        CFG, PARAMS, slots=2, prefill_chunk=4, abstract=True,
+        num_blocks=engine_mod.auto_num_blocks(CFG, 2, 4, prefix_mb=32.0),
+        spec_len=0)
+    report, infos = audit_aot_artifacts(veng, str(tmp_path))
+    # the matching chunk/tick artifacts audit clean (donation is off on
+    # the CPU mesh, so no aliasing is expected — no CXN201 either way)
+    assert not any(f.rule == "CXN210" for f in report.findings), \
+        report.format()
+    assert {i["label"] for i in infos} >= {"serve_prefill_chunk",
+                                           "serve_tick"}
+    # a sibling artifact for ANOTHER replica's device block (same key,
+    # devices component only) is NOT stale — the router placement story
+    cache = aot_mod.get_cache(str(tmp_path))
+    row = [s for s in veng.lint_specs(donate=None)
+           if s[0] == "serve_tick"][0]
+    comp = cache.components("serve_tick", row[2], donate_argnums=row[3],
+                            extra=veng.aot_extra("serve_tick"),
+                            config=aot_mod.config_hash(veng._cfg_key))
+    x = jax.numpy.ones((2,), np.float32)
+    cache.store(dict(comp, devices="7:cpu"),
+                jax.jit(lambda x: x + 1).lower(x).compile())
+    report, _ = audit_aot_artifacts(veng, str(tmp_path))
+    assert not any(f.rule == "CXN210" for f in report.findings), \
+        report.format()
+    # fake a jax upgrade: every entry is now stale, CXN210 names "jax"
+    monkeypatch.setattr(aot_mod, "_versions", lambda: ("99.0.0", "99.0.0"))
+    report, _ = audit_aot_artifacts(veng, str(tmp_path))
+    stale = [f for f in report.findings if f.rule == "CXN210"]
+    assert stale and all("jax" in f.message for f in stale), \
+        report.format()
+    assert report.exit_code() != 0          # fails CI in validator mode
+
+
+# ------------------------------------------------------ Net + gpt_decode
+NET_CONF = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 8
+  init_sigma = 0.1
+layer[+1] = relu
+layer[+1] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,6
+batch_size = 8
+eta = 0.1
+seed = 5
+"""
+
+
+def _net_run(tmp_path=None, steps=3):
+    from cxxnet_tpu import Net
+    from cxxnet_tpu.utils.config import tokenize
+    net = Net(tokenize(NET_CONF))
+    if tmp_path is not None:
+        net.set_param("aot_cache", str(tmp_path))
+    net.init_model()
+    rs = np.random.RandomState(7)
+    for _ in range(steps):
+        class B:
+            data = rs.rand(8, 1, 1, 6).astype(np.float32)
+            label = rs.randint(0, 4, (8, 1)).astype(np.float32)
+            extra_data = []
+            num_batch_padd = 0
+        net.update(B)
+    return net
+
+
+def test_net_train_warm_start(tmp_path):
+    ref = _net_run()
+    a = _net_run(tmp_path)
+    assert a._jit_update.source == "compiled"
+    before = dict(devprof.compile_watch().totals).get("net_update", 0.0)
+    b = _net_run(tmp_path)                  # fresh Net = fresh jit objects
+    assert b._jit_update.source == "aot_load"
+    after = dict(devprof.compile_watch().totals).get("net_update", 0.0)
+    assert after == before, "warm trainer startup must not recompile " \
+        "net_update"
+    for lk, tags in ref.params.items():
+        for tag, w in tags.items():
+            np.testing.assert_array_equal(np.asarray(b.params[lk][tag]),
+                                          np.asarray(w),
+                                          err_msg="%s/%s" % (lk, tag))
+
+
+def test_gpt_decode_warm(tmp_path):
+    from cxxnet_tpu.models import gpt as gpt_m
+    rs = np.random.RandomState(9)
+    prompt = _prompt(rs, 6)[None]
+    ref = np.asarray(gpt_decode(PARAMS, prompt, 5, CFG))
+    aot_mod.configure(str(tmp_path))
+    try:
+        gpt_m._decode_fn.cache_clear()
+        out1 = np.asarray(gpt_decode(PARAMS, prompt, 5, CFG))
+        gpt_m._decode_fn.cache_clear()      # fresh-process stand-in
+        out2 = np.asarray(gpt_decode(PARAMS, prompt, 5, CFG))
+        cache = aot_mod.get_cache(str(tmp_path))
+        assert cache.stats()["hits"] >= 1
+    finally:
+        aot_mod.reset_configured()
+        gpt_m._decode_fn.cache_clear()
+    np.testing.assert_array_equal(out1, ref)
+    np.testing.assert_array_equal(out2, ref)

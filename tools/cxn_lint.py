@@ -183,6 +183,40 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                 _pk._INTERPRET = old_interp
             report.extend(serve_report.findings)
             infos += serve_infos
+            # AOT-artifact validator (aot_cache=DIR / CXN_AOT_CACHE):
+            # audit the CACHED serve executables — the programs a warm
+            # production startup actually loads — and fail on CXN210
+            # staleness (a config/mesh/jax-version drift that was not
+            # followed by re-warming the cache). The validator engine
+            # mirrors PRODUCTION sizing (serve_slots, the same
+            # auto-sized pool) and production fused/gather resolution
+            # (no interpret arming: the artifacts were written by the
+            # real backend's resolution), so its keys are the server's.
+            aot_dir = getattr(task, "aot_cache", "") \
+                or os.environ.get("CXN_AOT_CACHE", "")
+            if aot_dir:
+                from cxxnet_tpu.analysis.step_audit import \
+                    audit_aot_artifacts
+                veng = DecodeEngine(
+                    gcfg, gparams, slots=task.serve_slots,
+                    prefill_chunk=task.serve_prefill_chunk,
+                    abstract=True, num_blocks=nb,
+                    block_size=task.serve_block_size,
+                    spec_len=(task.spec_len if task.spec_mode != "off"
+                              else 0),
+                    fused_attn=bool(task.serve_fused_attn), mesh=mesh,
+                    int8_weights=bool(task.serve_int8_weights),
+                    kv_dtype=task.serve_kv_dtype)
+                aot_report, aot_infos = audit_aot_artifacts(
+                    veng, aot_dir,
+                    collective_budget=(colbudget if colbudget >= 0
+                                       else None))
+                report.extend(aot_report.findings)
+                if verbose:
+                    for info in aot_infos:
+                        print("  aot[%s]: %s" % (info.get("aot", "?"),
+                                                 info["label"]))
+                infos += [i for i in aot_infos if i.get("aot") == "ok"]
         if verbose:
             from cxxnet_tpu.analysis import format_step_info
             for info in infos:

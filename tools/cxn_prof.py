@@ -94,6 +94,13 @@ _DEFAULT_CELL_TOL = {
     #                                         dominates (the ms unit
     #                                         regresses UP)
     "gpt_decode_spec_ms_per_token": 0.20,
+    "engine_cold_start_ms": 0.35,           # wall-clock startup cells on
+    #                                         a shared CI core: compile/
+    #                                         deserialize timing noise
+    "engine_recovery_ms": 0.40,             # (the ms unit regresses UP;
+    #                                         doc/performance.md "AOT
+    #                                         executable cache" records
+    #                                         the arms)
     "obs_overhead_pct": 1.0,        # a percentage-point-scale cell:
     #                                 gate it on the <= 2% budget in
     #                                 bench.py, not on relative drift
