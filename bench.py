@@ -64,6 +64,24 @@ Prints one JSON line per metric, in this order:
                                      check; cxn_mfu{fn=serve_tick}
                                      rides along as an attribute,
                                      round 16)
+ 12a''l. serve_tokens_per_sec_longctx (long-prompt paged trace with the
+                                     rows pushed past the resident
+                                     VMEM gate: streaming-fused vs
+                                     gather arms; ~1.0 where the
+                                     kernel is unsupported and both
+                                     arms resolve gather)
+ 12a''t. autotune_wall_ms           (the task=autotune sweep's wall
+                                     cost: every serve_block_size
+                                     divisor of the chunk built and
+                                     its AOT tick timed; paid once
+                                     per fleet — the executables and
+                                     the winner persist via the AOT
+                                     cache)
+ 12a''u. serve_tokens_per_sec_tuned (the same trace served at the
+                                     default geometry vs
+                                     serve_block_size=auto loading
+                                     the persisted winner; ~1.0 when
+                                     the default already won)
  12a3. serve_tokens_per_sec_tp2     (tensor-parallel serving: the
                                      REPL_CELL trace served by the tp=2
                                      gather-form TP engine — KV pool
@@ -882,6 +900,138 @@ def bench_serve_fused():
          mfu_serve_tick=(round(mfu, 6) if mfu is not None else None))
 
 
+# the long-context streaming cell: prompts deep enough that a row's
+# whole KV image is a real VMEM liability. head_dim 64 keeps the
+# geometry one a real TPU would fuse; the cell CLAMPS the resident
+# VMEM gate so its rows cross into the streaming formulation — the
+# arm under test is the online-softmax accumulation path, exactly
+# what a production-sized long-context row (past the real 12 MiB
+# gate) resolves to.
+LONGCTX_CELL = dict(layers=2, heads=4, feat=256, seq=512, vocab=256,
+                    slots=4, n_requests=12, mean_gap_ms=5.0, seed=3,
+                    prefix_len=384, suffix=(8, 16), max_new=(8, 16),
+                    chunk=64, budget=4)
+
+
+def bench_serve_longctx():
+    """Long-context streaming-attention cell (doc/serving.md
+    "Streaming fused attention"): a long-prompt shared-prefix Poisson
+    trace whose rows are pushed past the resident VMEM gate (the cell
+    clamps ``_PAGED_RESIDENT_VMEM`` to an eighth of a row image, the
+    CI-priced stand-in for a production row blowing the real 12 MiB
+    budget), served ``serve_fused_attn=1`` vs ``0``. Wherever the
+    Pallas kernel arms, the fused arm resolves the STREAMING
+    formulation — rows that round 16's resident kernel would have
+    dropped back to gather stay fused — and
+    ``serve_tokens_per_sec_longctx`` records streaming / gather. On
+    backends without the kernel both arms resolve gather and the
+    ratio pins the off-switch no-op (~1.0), same contract as the
+    resident fused cell."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.ops import pallas_kernels as pk
+
+    c = dict(LONGCTX_CELL)
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_prefix_trace(c)
+    kw = dict(queue=c["n_requests"], prefill_chunk=c["chunk"],
+              prefill_budget=c["budget"], prefix_mb=8.0,
+              slots=c["slots"])
+    hd = c["feat"] // c["heads"]
+    row_vmem = pk._paged_row_vmem(c["heads"], c["seq"] // c["chunk"],
+                                  c["chunk"], hd, 2)
+    old_gate = pk._PAGED_RESIDENT_VMEM
+    pk._PAGED_RESIDENT_VMEM = row_vmem // 8
+    try:
+        wall_s, ms_ = run_serve_trace(cfg, params, trace,
+                                      fused_attn=True, **kw)
+    finally:
+        pk._PAGED_RESIDENT_VMEM = old_gate
+    wall_g, mg = run_serve_trace(cfg, params, trace, fused_attn=False,
+                                 **kw)
+    tps_s = ms_["tokens_generated"] / wall_s
+    tps_g = mg["tokens_generated"] / wall_g
+    emit("serve_tokens_per_sec_longctx", tps_s, "tokens/sec",
+         tps_s / max(tps_g, 1e-9),
+         formulation=ms_["paged"]["fused_formulation"] or "gather",
+         gather_tokens_per_sec=round(tps_g, 1),
+         prompt_len=c["prefix_len"] + max(c["suffix"]))
+
+
+def bench_serve_autotune():
+    """Geometry-autotune cell (doc/performance.md "Geometry
+    autotuning"): the ``task=autotune`` sweep run in-process on the
+    replication cell's geometry — every ``serve_block_size`` divisor
+    of the prefill chunk built as a real engine and its AOT decode
+    tick timed on zero-filled inputs — then the SAME trace served at
+    the default geometry vs ``serve_block_size=auto`` loading the
+    persisted winner. Emits ``autotune_wall_ms`` (the once-per-fleet
+    tuning cost; the executables it compiled persist through the AOT
+    cache, so replicas pay none of it) and
+    ``serve_tokens_per_sec_tuned`` with vs_baseline = tuned / default
+    — >= 1.0 when the sweep finds a better block size, ~1.0 when the
+    default was already the winner (the honest no-win case)."""
+    import dataclasses
+    import tempfile
+
+    from cxxnet_tpu.analysis import aot_cache as aot_mod
+    from cxxnet_tpu.obs import devprof
+    from cxxnet_tpu.serve.engine import DecodeEngine, auto_num_blocks
+
+    c, cfg, params = _repl_model()
+    trace = _repl_trace(c)
+    chunk = min(c["chunk"], cfg.seq_len)
+    # a rig that exports CXN_AOT_CACHE would warm the default arm from
+    # a previous run's executables; isolate the cell like the
+    # cold-start one does
+    env_cache = os.environ.pop("CXN_AOT_CACHE", None)
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            cache = aot_mod.get_cache(d)
+            t0 = time.perf_counter()
+            rows = []
+            for bs in [x for x in range(1, chunk + 1) if chunk % x == 0]:
+                nb = auto_num_blocks(cfg, c["slots"], chunk,
+                                     block_size=bs)
+                eng = DecodeEngine(cfg, params, slots=c["slots"],
+                                   prefill_chunk=chunk, num_blocks=nb,
+                                   block_size=bs, aot=cache)
+                table = devprof.profile_engine(eng, time_reps=3)
+                rows.append((table.get("serve_tick").measured_s, bs,
+                             eng.fused_formulation or "gather"))
+                eng.close()
+            tick_s, win_bs, form = min(rows)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            comp = aot_mod.tuned_components(
+                aot_mod.config_hash(dataclasses.astuple(cfg)), chunk,
+                "", 1)
+            cache.store_tuned(comp, {"block_size": win_bs,
+                                     "formulation": form,
+                                     "tick_ms": tick_s * 1e3})
+            emit("autotune_wall_ms", wall_ms, "ms",
+                 candidates=len(rows), winner_block_size=win_bs,
+                 winner_tick_ms=round(tick_s * 1e3, 3))
+            kw = dict(slots=c["slots"], queue=c["n_requests"],
+                      prefill_chunk=chunk)
+            wall_d, md = run_serve_trace(cfg, params, trace, **kw)
+            wall_t, mt = run_serve_trace(cfg, params, trace,
+                                         block_size=-1, aot_cache=d,
+                                         **kw)
+            tps_d = md["tokens_generated"] / wall_d
+            tps_t = mt["tokens_generated"] / wall_t
+            emit("serve_tokens_per_sec_tuned", tps_t, "tokens/sec",
+                 tps_t / max(tps_d, 1e-9),
+                 tuned_block_size=mt["paged"]["block_size"],
+                 default_block_size=md["paged"]["block_size"],
+                 default_tokens_per_sec=round(tps_d, 1))
+    finally:
+        if env_cache is not None:
+            os.environ["CXN_AOT_CACHE"] = env_cache
+
+
 # the quantized-serving cell's geometry + trace: a shared-prefix
 # prefill-heavy mix like PREFIX_CELL but small enough that the
 # deliberately memory-starved bf16 arm's preempt/swap churn stays
@@ -1438,7 +1588,8 @@ def main() -> int:
     for fn in (bench_alexnet, bench_resnet50, bench_feed_overlap, bench_gpt,
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
                bench_serve_prefill_heavy, bench_serve_paged,
-               bench_serve_fused, bench_serve_int8, bench_serve_sharded,
+               bench_serve_fused, bench_serve_longctx,
+               bench_serve_autotune, bench_serve_int8, bench_serve_sharded,
                bench_serve_replicated, bench_serve_tenanted,
                bench_serve_spec, bench_serve_cold_start,
                bench_obs_overhead, bench_lint):

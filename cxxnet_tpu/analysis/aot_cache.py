@@ -45,6 +45,11 @@ drifted component):
     DIR/<program>/<sha256-of-key>.bin    # pickle: key + payload + trees
     DIR/<program>/<sha256-of-key>.json   # key components (the validator
                                          # scans these without unpickling)
+    DIR/serve_tuned_geometry/<key>.json  # geometry-autotune winner
+                                         # (task=autotune, loaded by
+                                         # serve_block_size=auto — no
+                                         # .bin: the winner's programs
+                                         # persist under their own keys)
 
 Writes are atomic (tempfile + ``os.replace`` in the target dir), loads
 are corruption-safe: a torn/corrupt/stale/unreadable entry logs one
@@ -72,7 +77,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["AotCache", "CachedProgram", "ResolvedProgram", "get_cache",
            "active", "configure", "config_hash", "signature_string",
-           "devices_string", "mesh_tag", "METRIC_NAMES"]
+           "devices_string", "mesh_tag", "tuned_components",
+           "METRIC_NAMES"]
 
 METRIC_NAMES = (
     ("cxn_aot_cache_hits_total",
@@ -173,6 +179,31 @@ def devices_string(args: tuple = (), mesh=None) -> str:
         ids.add(int(d.id))
         kind = getattr(d, "device_kind", kind) or kind
     return "%s:%s" % (",".join(str(i) for i in sorted(ids)), kind)
+
+
+def tuned_components(config: str, chunk: int, kv_dtype: str = "",
+                     tp: int = 1) -> Dict[str, str]:
+    """The key of one persisted geometry-autotune winner
+    (``task=autotune`` → ``serve_block_size=auto``): device kind +
+    backend + model geometry (the config hash) + prefill chunk +
+    KV dtype + TP degree — everything that changes which
+    ``serve_block_size`` wins. Deliberately NOT keyed on jax/jaxlib
+    versions (a timing winner survives an upgrade; the executables it
+    points at re-warm under their own versioned keys) but keyed on the
+    interpret flag: interpret-mode timings say nothing about a real
+    backend."""
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "program": "serve_tuned_geometry",
+        "config": str(config),
+        "chunk": str(int(chunk)),
+        "kv": str(kv_dtype or "").lower() or "none",
+        "tp": str(int(tp)),
+        "backend": jax.default_backend(),
+        "device_kind": str(getattr(dev, "device_kind", "")),
+        "interpret": str(int(_interpret_flag())),
+    }
 
 
 class AotCache:
@@ -352,6 +383,57 @@ class AotCache:
         """In-process fallback for a failed persist (see store)."""
         with self._lock:
             self._mem[self.digest(components)] = compiled
+
+    # -------------------------------------------- tuned geometry winners
+    def store_tuned(self, components: Dict[str, str], record: Dict
+                    ) -> bool:
+        """Atomically persist one geometry-autotune winner (a small
+        JSON sidecar — no executable payload; the winner's programs
+        persist under their own keys when the tuning sweep warms them).
+        The sidecar carries the full key at the top level, so
+        :meth:`stale_entries` names a drifted winner's components the
+        same way it names a drifted executable's (CXN210)."""
+        _, _, meta_path = self._paths(components)
+        doc = dict(components)
+        doc["winner"] = dict(record)
+        try:
+            os.makedirs(os.path.dirname(meta_path), exist_ok=True)
+            self._atomic_write(
+                meta_path,
+                json.dumps(doc, sort_keys=True, indent=1).encode())
+        except (OSError, TypeError) as e:
+            self._warn_once(
+                "unwritable",
+                "aot_cache: cache dir %r unwritable (%s) — autotune "
+                "winner will not persist" % (self.path, e))
+            return False
+        return True
+
+    def load_tuned(self, components: Dict[str, str]) -> Optional[Dict]:
+        """The persisted winner record for this exact key, or ``None``
+        (miss / key drift / corrupt — never raises; drift and
+        corruption count as stale, the CXN210 idiom: a winner tuned
+        for a different geometry must not silently steer this one)."""
+        label = components["program"]
+        _, _, meta_path = self._paths(components)
+        try:
+            with open(meta_path) as f:
+                doc = json.load(f)
+        except OSError:
+            self._emit("miss", label)
+            return None
+        except Exception:                               # noqa: BLE001
+            self._emit("stale", label)
+            self._emit("miss", label)
+            return None
+        rec = doc.get("winner")
+        if ({k: doc.get(k) for k in components} != dict(components)
+                or not isinstance(rec, dict) or "block_size" not in rec):
+            self._emit("stale", label)
+            self._emit("miss", label)
+            return None
+        self._emit("hit", label)
+        return rec
 
     @staticmethod
     def _atomic_write(path: str, data: bytes) -> None:

@@ -21,6 +21,7 @@ without touching the net.
 from __future__ import annotations
 
 import contextlib
+import glob
 import os
 import re
 import sys
@@ -91,7 +92,10 @@ class LearnTask:
         #                           (0 = dense slot rows; forced dense
         #                           when serve_prefill_chunk = 0)
         self.serve_block_size = 0   # KV block width in tokens (0 = the
-        #                             prefill chunk; must divide it)
+        #                             prefill chunk; must divide it;
+        #                             "auto"/-1 = load the persisted
+        #                             task=autotune winner from the AOT
+        #                             cache, chunk default when none)
         self.serve_num_blocks = 0   # block-pool size (0 = auto: dense-
         #                             equivalent rows + trie headroom,
         #                             or serve_kv_mb when set)
@@ -286,7 +290,10 @@ class LearnTask:
         elif name == "serve_paged":
             self.serve_paged = int(val)
         elif name == "serve_block_size":
-            self.serve_block_size = int(val)
+            # "auto" is the -1 sentinel: the engine build resolves it
+            # through the persisted geometry-autotune winner
+            self.serve_block_size = (-1 if str(val).strip().lower()
+                                     == "auto" else int(val))
         elif name == "serve_num_blocks":
             self.serve_num_blocks = int(val)
         elif name == "serve_kv_mb":
@@ -421,6 +428,8 @@ class LearnTask:
                 self.task_generate()
             elif self.task == "prof":
                 self.task_prof()
+            elif self.task == "autotune":
+                self.task_autotune()
             else:
                 raise ValueError("unknown task %r" % self.task)
         return 0
@@ -539,9 +548,10 @@ class LearnTask:
                 return
             self.continue_training = 0
         if self.model_in == "NULL":
-            # prof runs fine on random init: cost/memory/compile time
-            # are properties of the program, not the weights
-            assert self.task in ("train", "prof"), \
+            # prof/autotune run fine on random init: cost/memory/
+            # compile/tick time are properties of the program geometry,
+            # not the weights
+            assert self.task in ("train", "prof", "autotune"), \
                 "must specify model_in if not training"
             self.net = Net(self._trainer_cfg())
             self.net.init_model()
@@ -1008,6 +1018,142 @@ class LearnTask:
         if totals:
             print("compile seconds: " + ", ".join(
                 "%s %.2fs" % (k, v) for k, v in sorted(totals.items())))
+
+    def task_autotune(self) -> None:
+        """``task=autotune``: geometry search for the paged serve
+        engine (doc/performance.md "Geometry autotuning"). Sweeps
+        ``serve_block_size`` over the divisors of the (seq_len-clamped)
+        prefill chunk — each candidate is a different blocks-per-row x
+        per-block VMEM footprint, and with it a different
+        resident-vs-streaming crossover for the fused kernel — builds
+        the real engine per candidate (production ``serve_slots``,
+        the same auto-sized pool a server would build), times the AOT
+        executables on zero-filled inputs (the ``task=prof`` harness,
+        ``prof_reps`` best-of reps), and picks the winner by decode
+        tick time (the steady-state cost serving is bound by; prefill
+        time is reported for the record). With an ``aot_cache`` armed
+        the winner persists under the device-kind + model-geometry key
+        (analysis/aot_cache.py:tuned_components) and the WINNER's
+        executables stay warm in the cache (losing candidates' files
+        are pruned after the pick, so a later ``cxn-lint --compile
+        aot_cache=`` CXN210 scan stays clean) — tuning runs ONCE per
+        fleet, and a later ``serve_block_size=auto`` build loads the
+        winner AND its compiled programs with zero XLA work."""
+        import dataclasses
+        from .analysis import aot_cache as aot_mod
+        from .nnet.lm import net_gpt_export
+        from .obs import devprof
+        from .obs.metrics import default_registry
+        from .serve.engine import DecodeEngine, auto_num_blocks
+        if not (self.serve_paged and self.serve_prefill_chunk > 0):
+            raise ConfigError(
+                "task=autotune tunes the PAGED serve engine: set "
+                "serve_paged=1 and serve_prefill_chunk > 0")
+        t0 = time.perf_counter()
+        gcfg, gparams = net_gpt_export(self.net)
+        cache = None
+        cache_path = str(self.aot_cache or "") or os.environ.get(
+            "CXN_AOT_CACHE", "")
+        if cache_path:
+            cache = aot_mod.get_cache(cache_path)
+        mesh = None
+        if self.serve_tp > 1:
+            import jax as _jax
+            from .parallel.mesh import make_mesh
+            devs = _jax.devices()
+            if len(devs) < self.serve_tp:
+                raise ConfigError(
+                    "serve_tp=%d needs %d devices, found %d"
+                    % (self.serve_tp, self.serve_tp, len(devs)))
+            mesh = make_mesh(devices=devs[:self.serve_tp],
+                             model_parallel=self.serve_tp)
+        reg = default_registry()
+        chunk = min(self.serve_prefill_chunk, gcfg.seq_len)
+        cands = [d for d in range(1, chunk + 1) if chunk % d == 0]
+        spec = self.spec_len if self.spec_mode != "off" else 0
+        reps = max(1, self.prof_reps)
+
+        def _cache_files():
+            if not cache_path:
+                return set()
+            return set(glob.glob(os.path.join(cache_path, "*", "*")))
+
+        rows = []
+        created = {}                # bs -> artifact files this sweep wrote
+        seen = _cache_files()
+        for bs in cands:
+            nb = self.serve_num_blocks or auto_num_blocks(
+                gcfg, self.serve_slots, self.serve_prefill_chunk,
+                block_size=bs, prefix_mb=self.serve_prefix_mb,
+                kv_mb=self.serve_kv_mb, kv_dtype=self.serve_kv_dtype)
+            eng = DecodeEngine(
+                gcfg, gparams, slots=self.serve_slots,
+                prefill_chunk=self.serve_prefill_chunk,
+                num_blocks=nb, block_size=bs, spec_len=spec,
+                fused_attn=bool(self.serve_fused_attn), mesh=mesh,
+                int8_weights=bool(self.serve_int8_weights),
+                kv_dtype=self.serve_kv_dtype, aot=cache)
+            table = devprof.profile_engine(eng, registry=reg,
+                                           time_reps=reps)
+            tick = table.get("serve_tick")
+            pre = table.get("serve_prefill_chunk")
+            rows.append({
+                "block_size": bs, "bpr": eng.bpr,
+                "num_blocks": eng.num_blocks,
+                "formulation": eng.fused_formulation or "gather",
+                "tick_ms": tick.measured_s * 1e3,
+                "prefill_chunk_ms":
+                    pre.measured_s * 1e3 if pre is not None else 0.0,
+            })
+            eng.close()
+            now = _cache_files()
+            created[bs] = now - seen
+            seen = now
+            if not self.silent:
+                r = rows[-1]
+                print("autotune: bs=%-4d bpr=%-4d %-9s tick %8.3f ms, "
+                      "prefill_chunk %8.3f ms"
+                      % (r["block_size"], r["bpr"], r["formulation"],
+                         r["tick_ms"], r["prefill_chunk_ms"]))
+        winner = min(rows, key=lambda r: r["tick_ms"])
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        record = dict(winner)
+        record["candidates"] = rows
+        record["wall_ms"] = wall_ms
+        print("autotune: winner serve_block_size=%d (%s, %.3f ms/tick; "
+              "%d candidates in %.0f ms)"
+              % (winner["block_size"], winner["formulation"],
+                 winner["tick_ms"], len(rows), wall_ms))
+        if cache is not None:
+            comp = aot_mod.tuned_components(
+                aot_mod.config_hash(dataclasses.astuple(gcfg)), chunk,
+                self.serve_kv_dtype, self.serve_tp if mesh else 1)
+            if cache.store_tuned(comp, record):
+                print("autotune: winner persisted to %s (load it with "
+                      "serve_block_size=auto)" % cache_path)
+            # losing candidates' executables are dead weight a CXN210
+            # scan (cxn-lint --compile aot_cache=) would flag as stale
+            # against the winner geometry: prune ONLY the files this
+            # sweep created for non-winner block sizes — pre-existing
+            # artifacts (other configs sharing the cache) untouched
+            pruned = 0
+            for bs, files in created.items():
+                if bs == winner["block_size"]:
+                    continue
+                for f in files:
+                    try:
+                        os.remove(f)
+                        pruned += 1
+                    except OSError:
+                        pass
+            if pruned:
+                print("autotune: pruned %d losing-candidate artifact "
+                      "file(s) — the cache holds the winner's "
+                      "executables only" % pruned)
+        else:
+            print("autotune: no aot_cache armed — winner NOT persisted "
+                  "(set aot_cache=DIR or CXN_AOT_CACHE to let "
+                  "serve_block_size=auto load it)")
 
     def task_serve(self) -> None:
         """Online serving: keep the model hot behind a request queue (the

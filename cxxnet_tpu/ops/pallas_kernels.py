@@ -1495,16 +1495,17 @@ def cached_attention(q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray,
 # and parked rows only ever contribute score columns strictly above the
 # row's position, which the -1e30 mask softmaxes to an exact 0.0.
 
-def paged_attention_geometry_ok(n_head: int, bpr: int, block_size: int,
-                                head_dim: int,
-                                itemsize: int = 2) -> bool:
-    """The TPU-geometry half of the fused-attention gate: lane-friendly
-    head_dim / sublane-aligned block size, and the two (H, row_len, d)
-    VMEM row images within budget. Split out so surfaces that audit
-    off-TPU (tools/cxn_lint.py arming interpret mode) can still decide
-    whether a REAL TPU would resolve fused or gather for this geometry
-    — auditing a fused program production would never run pins the
-    wrong executable."""
+# VMEM budget of the RESIDENT formulation's two (H, row_len, d) row
+# images. Module-level (not inlined in the gate) so differential tests
+# can shrink it and drive a small geometry across the resident ->
+# streaming crossover the way they flip _INTERPRET.
+_PAGED_RESIDENT_VMEM = 12 * 1024 * 1024
+
+
+def _paged_row_vmem(n_head: int, bpr: int, block_size: int,
+                    head_dim: int, itemsize: int) -> int:
+    """Bytes of one row's TWO (H, row_len, d) VMEM images — what the
+    resident formulation must hold at once."""
     s = bpr * block_size
     vmem = 2 * n_head * s * head_dim * itemsize
     if itemsize == 1:
@@ -1512,28 +1513,111 @@ def paged_attention_geometry_ok(n_head: int, bpr: int, block_size: int,
         # image also holds the two scale planes — budget them at f32,
         # the widest compute dtype they can carry
         vmem += 2 * n_head * s * 4
-    if vmem > 12 * 1024 * 1024:
-        return False
+    return vmem
+
+
+def _paged_alignment_ok(block_size: int, head_dim: int) -> bool:
+    """Lane-friendly head_dim / sublane-aligned block size — the Mosaic
+    tiling constraints BOTH fused formulations share."""
     return head_dim % 128 in (0, 64) and block_size % 8 == 0
+
+
+def paged_attention_geometry_ok(n_head: int, bpr: int, block_size: int,
+                                head_dim: int,
+                                itemsize: int = 2) -> bool:
+    """The TPU-geometry half of the RESIDENT fused-attention gate:
+    lane-friendly head_dim / sublane-aligned block size, and the two
+    (H, row_len, d) VMEM row images within budget. Split out so
+    surfaces that audit off-TPU (tools/cxn_lint.py arming interpret
+    mode) can still decide whether a REAL TPU would resolve fused or
+    gather for this geometry — auditing a fused program production
+    would never run pins the wrong executable. Row images past the
+    budget are no longer a fused fallback: they stream
+    (:func:`paged_attention_streaming_ok`)."""
+    if _paged_row_vmem(n_head, bpr, block_size, head_dim,
+                       itemsize) > _PAGED_RESIDENT_VMEM:
+        return False
+    return _paged_alignment_ok(block_size, head_dim)
+
+
+def paged_attention_streaming_ok(n_head: int, bpr: int, block_size: int,
+                                 head_dim: int,
+                                 itemsize: int = 2) -> bool:
+    """The STREAMING formulation's gate: same alignment constraints as
+    the resident form, but VMEM holds only one (H, bs, d) block pair
+    plus the f32 running accumulators — O(block), independent of
+    row_len — so any row length the pool can hold qualifies. The one
+    remaining footprint check keeps a pathological single BLOCK inside
+    the resident budget (a block that large would already have failed
+    upstream sizing)."""
+    if not _paged_alignment_ok(block_size, head_dim):
+        return False
+    return _paged_row_vmem(n_head, 1, block_size, head_dim,
+                           itemsize) <= _PAGED_RESIDENT_VMEM
+
+
+def paged_attention_formulation(n_head: int, bpr: int, block_size: int,
+                                head_dim: int,
+                                itemsize: int = 2) -> str:
+    """Which fused formulation serves this geometry: ``"resident"``
+    (whole row image in VMEM, bit-exact against the gather reference in
+    interpret mode), ``"streaming"`` (online-softmax accumulation
+    across the blocks-per-row grid dimension — rows past the resident
+    VMEM budget stay fused; numerics under the ``streaming`` branch of
+    serve/engine.py:fused_attn_tolerance), or ``""`` (unsupported —
+    the engine keeps the XLA gather formulation).
+
+    Interpret mode waives the ALIGNMENT limits (tiny differential-test
+    models run), but the VMEM crossover still decides resident vs
+    streaming, so tests — and a shrunken ``_PAGED_RESIDENT_VMEM`` —
+    exercise the same formulation a real TPU would pick."""
+    if os.environ.get("CXN_FUSED_ATTN", "1") == "0":
+        return ""
+    if not use_pallas():
+        return ""
+    resident_fits = _paged_row_vmem(
+        n_head, bpr, block_size, head_dim,
+        itemsize) <= _PAGED_RESIDENT_VMEM
+    if _INTERPRET:
+        return "resident" if resident_fits else "streaming"
+    if resident_fits and _paged_alignment_ok(block_size, head_dim):
+        return "resident"
+    if paged_attention_streaming_ok(n_head, bpr, block_size, head_dim,
+                                    itemsize):
+        return "streaming"
+    return ""
 
 
 def paged_attention_supported(n_head: int, bpr: int, block_size: int,
                               head_dim: int, itemsize: int = 2) -> bool:
-    """True when :func:`paged_attention` may serve this geometry:
-    TPU backend (or interpret mode under test — there the geometry
-    limits are waived, so tiny differential-test models run), the
-    off-switch ``CXN_FUSED_ATTN=0`` not thrown, and
-    :func:`paged_attention_geometry_ok`. Beyond any of these the
-    engine keeps the XLA gather formulation (doc/serving.md \"Fused
-    paged attention\" records when and why)."""
+    """True when :func:`paged_attention` may serve this geometry under
+    EITHER formulation: TPU backend (or interpret mode under test —
+    there the alignment limits are waived, so tiny differential-test
+    models run), the off-switch ``CXN_FUSED_ATTN=0`` not thrown, and
+    a formulation whose gate holds. Beyond any of these the engine
+    keeps the XLA gather formulation (doc/serving.md \"Fused paged
+    attention\" records when and why)."""
+    return paged_attention_formulation(n_head, bpr, block_size,
+                                       head_dim, itemsize) != ""
+
+
+def paged_attention_fallback_reason(n_head: int, bpr: int,
+                                    block_size: int, head_dim: int,
+                                    itemsize: int = 2) -> str:
+    """Why the support gate rejected this geometry — ``"env_off"``
+    (``CXN_FUSED_ATTN=0``), ``"backend"`` (no TPU and no interpret
+    mode), or ``"geometry"`` (alignment fails both formulations) —
+    or ``""`` when fused is supported. The engine logs this once and
+    counts it in ``cxn_fused_fallback_total{reason=}`` so a fleet
+    silently serving the slow gather path shows up on a dashboard."""
     if os.environ.get("CXN_FUSED_ATTN", "1") == "0":
-        return False
+        return "env_off"
     if not use_pallas():
-        return False
-    if _INTERPRET:
-        return True         # differential testing: no alignment limits
-    return paged_attention_geometry_ok(n_head, bpr, block_size,
-                                       head_dim, itemsize)
+        return "backend"
+    if paged_attention_formulation(n_head, bpr, block_size, head_dim,
+                                   itemsize) == "":
+        return "geometry"
+    return ""
 
 
 def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
@@ -1592,8 +1676,81 @@ def _paged_attn_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
 
 
+def _paged_attn_stream_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref,
+                              *rest, bs: int, bpr: int, n_head: int,
+                              rows: int, quant: bool = False):
+    """STREAMING formulation: one grid step = one (slot row, logical
+    block), but instead of building a whole-row VMEM image it folds the
+    block straight into flash-style running accumulators (the
+    ``_flash_kernel`` machinery re-cut over the block-table grid):
+    per-(head, query) running max ``m``, softmax denominator ``l`` and
+    un-normalized output ``acc`` persist in scratch across the
+    blocks-per-row grid dimension, and the LAST block normalizes into
+    the output. VMEM is O(block) — one (H, bs, d) K/V pair plus the
+    f32 accumulators — so row images past the resident budget stay
+    fused (the long-context gate, ``paged_attention_streaming_ok``).
+
+    Numerics: the per-block masked scores are the same f32 arithmetic
+    as the resident kernel's, but the softmax sum and the ·V product
+    accumulate block-by-block with rescaling — a reassociation of the
+    reference's single-softmax reduction that is NOT bit-identical in
+    floating point even in interpret mode. The band lives in the ONE
+    contract (serve/engine.py:fused_attn_tolerance, ``streaming``
+    formulation); the masking argument is unchanged — a fully-masked
+    garbage block contributes an exact 0.0 to ``l`` and ``acc``
+    (``exp(-1e30 - m)`` underflows to 0, and the correction factor is
+    exp(0) = 1 because ``m`` never decreases)."""
+    if quant:
+        sk_ref, sv_ref, o_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        o_ref, acc_scr, m_scr, l_scr = rest
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    d = q_ref.shape[-1]
+    if quant:
+        # in-VMEM dequant of ONE block, mirroring engine._kv_dequant
+        # (int8 -> scale dtype, times the scale, THEN the f32 cast)
+        kk = k_ref[0, 0].astype(sk_ref.dtype) * sk_ref[0, 0][..., None]
+        vv = v_ref[0, 0].astype(sv_ref.dtype) * sv_ref[0, 0][..., None]
+    else:
+        kk, vv = k_ref[0, 0], v_ref[0, 0]                  # (H, bs, d)
+    qh = jnp.swapaxes(q_ref[0], 0, 1).astype(jnp.float32)  # (H, R, d)
+    sc = jax.lax.dot_general(
+        qh, kk.astype(jnp.float32),
+        (((2,), (2,)), ((0,), (0,)))) / (d ** 0.5)         # (H, R, bs)
+    kpos = j * bs + jax.lax.broadcasted_iota(
+        jnp.int32, (n_head, rows, bs), 2)
+    qpos = pos_ref[i] + jax.lax.broadcasted_iota(
+        jnp.int32, (n_head, rows, bs), 1)
+    sc = jnp.where(kpos <= qpos, sc, _NEG_INF)
+    m_prev = m_scr[:, :, 0]                                # (H, R)
+    m_new = jnp.maximum(m_prev, sc.max(-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(sc - m_new[:, :, None])
+    l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(-1)
+    acc_scr[:] = acc_scr[:] * corr[:, :, None] + jax.lax.dot_general(
+        p, vv.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))))
+    m_scr[:, :, 0] = m_new
+
+    @pl.when(j == bpr - 1)
+    def _finalize():
+        # pos >= 0 guarantees block 0's first column is unmasked, so l
+        # is never 0 in practice; the clamp matches _flash_kernel's
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)
+        o_ref[0] = jnp.swapaxes(acc_scr[:] / l[:, :, None],
+                                0, 1).astype(o_ref.dtype)
+
+
 def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
-                    block_size: int, scale_k=None, scale_v=None):
+                    block_size: int, scale_k=None, scale_v=None,
+                    streaming: bool = False):
     """Fused block-table gather + cached attention for the paged decode
     programs. ``q`` (b, R, H, d) — R = 1 for the batched tick, K+1 for
     the draft-and-verify step; ``pool_k``/``pool_v`` the WHOLE
@@ -1607,13 +1764,22 @@ def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
     bs) scale planes of a per-block-scaled int8 pool
     (serve_kv_dtype=int8) — the kernel then DMAs int8 payload blocks
     plus their scales and dequantizes the row image in VMEM
-    (_paged_attn_kernel ``quant`` path)."""
+    (_paged_attn_kernel ``quant`` path).
+
+    ``streaming`` selects the online-softmax formulation
+    (_paged_attn_stream_kernel): same grid, same operands, same
+    output, but VMEM O(block) instead of O(row) — the long-context
+    form, selected by the engine when
+    :func:`paged_attention_formulation` says so. Both formulations
+    share one abstract signature per geometry; the flag is a builder
+    constant, never a traced value."""
     b, rows, n_head, d = q.shape
     bpr = table.shape[1]
     bs = int(block_size)
     quant = scale_k is not None
-    kern = functools.partial(_paged_attn_kernel, bs=bs, bpr=bpr,
-                             n_head=n_head, rows=rows, quant=quant)
+    kern = functools.partial(
+        _paged_attn_stream_kernel if streaming else _paged_attn_kernel,
+        bs=bs, bpr=bpr, n_head=n_head, rows=rows, quant=quant)
     in_specs = [
         pl.BlockSpec((1, rows, n_head, d),
                      lambda i, j, tab, pp: (i, 0, 0, 0)),
@@ -1624,10 +1790,19 @@ def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
                      lambda i, j, tab, pp: (layer, tab[i, j],
                                             0, 0, 0)),
     ]
-    scratch = [
-        pltpu.VMEM((n_head, bpr * bs, d), pool_k.dtype),
-        pltpu.VMEM((n_head, bpr * bs, d), pool_v.dtype),
-    ]
+    if streaming:
+        # O(block) VMEM: the flash-style running accumulators persist
+        # across the blocks-per-row grid dim; no row image exists
+        scratch = [
+            pltpu.VMEM((n_head, rows, d), jnp.float32),     # acc
+            pltpu.VMEM((n_head, rows, 1), jnp.float32),     # m
+            pltpu.VMEM((n_head, rows, 1), jnp.float32),     # l
+        ]
+    else:
+        scratch = [
+            pltpu.VMEM((n_head, bpr * bs, d), pool_k.dtype),
+            pltpu.VMEM((n_head, bpr * bs, d), pool_v.dtype),
+        ]
     operands = (table, pos, q, pool_k, pool_v)
     if quant:
         in_specs += [
@@ -1636,10 +1811,13 @@ def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
             pl.BlockSpec((1, 1, n_head, bs),
                          lambda i, j, tab, pp: (layer, tab[i, j], 0, 0)),
         ]
-        scratch += [
-            pltpu.VMEM((n_head, bpr * bs), scale_k.dtype),
-            pltpu.VMEM((n_head, bpr * bs), scale_v.dtype),
-        ]
+        if not streaming:
+            # the streaming kernel dequantizes each block inline; only
+            # the resident row image carries whole-row scale planes
+            scratch += [
+                pltpu.VMEM((n_head, bpr * bs), scale_k.dtype),
+                pltpu.VMEM((n_head, bpr * bs), scale_v.dtype),
+            ]
         operands += (scale_k, scale_v)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -1654,6 +1832,46 @@ def paged_attention(q, pool_k, pool_v, table, pos, layer: int,
         out_shape=_out_struct((b, rows, n_head, d), q.dtype, q),
         interpret=_INTERPRET,
     )(*operands)
+
+
+def paged_attention_sharded(q, pool_k, pool_v, table, pos, layer: int,
+                            block_size: int, mesh, scale_k=None,
+                            scale_v=None, streaming: bool = False):
+    """:func:`paged_attention` shard_mapped over ``mesh``'s model axis:
+    each shard runs the SAME kernel on its LOCAL head slice — q and
+    the pools arrive head-sharded from the engine's gather-form TP
+    placement (serve/engine.py: w_qkv output-sharded, the KV pool on
+    axis 2), the block table and positions replicated — so a Mosaic
+    custom call GSPMD cannot partition becomes N independent per-shard
+    calls with ZERO collectives inside the wrap. Heads are independent
+    in attention, so each shard's output rows are exactly the
+    single-device kernel's rows for those heads: TP-fused decode stays
+    under the same single-device tolerance contract. The engine
+    re-replicates the output at the block boundary exactly as the
+    gather formulation does (the one all-gather either path pays)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import MODEL_AXIS
+    hsp = P(None, None, MODEL_AXIS, None)          # q / scales / out
+    psp = P(None, None, MODEL_AXIS, None, None)    # pools (head axis 2)
+    rep = P()
+    quant = scale_k is not None
+
+    def local(qs, pk, pv, tab, pp, sk, sv):
+        return paged_attention(qs, pk, pv, tab, pp, layer, block_size,
+                               scale_k=sk, scale_v=sv,
+                               streaming=streaming)
+
+    if quant:
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(hsp, psp, psp, rep, rep, hsp, hsp),
+                       out_specs=hsp, check_rep=False)
+        return fn(q, pool_k, pool_v, table, pos, scale_k, scale_v)
+    fn = shard_map(lambda qs, pk, pv, tab, pp: local(qs, pk, pv, tab,
+                                                     pp, None, None),
+                   mesh=mesh, in_specs=(hsp, psp, psp, rep, rep),
+                   out_specs=hsp, check_rep=False)
+    return fn(q, pool_k, pool_v, table, pos)
 
 
 # ---------------------------------------------------------------------------

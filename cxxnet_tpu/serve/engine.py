@@ -146,13 +146,23 @@ but never re-associate arithmetic — TP-sharded decode is BIT-IDENTICAL
 to the single-device engine (greedy and sampled), pinned by
 tests/test_serve_tp.py on the forced multi-device CPU mesh. Cost: one
 all-gather per matmul boundary (~4 per layer, plus the qkv-split
-reshards) and the embedding/LM head replicated; the fused paged-
-attention kernel is a Mosaic custom call GSPMD cannot partition, so
-tp > 1 pins the XLA gather-attention fallback (the support gate
-already evaluates the LOCAL head count ``n_head // tp``, so a future
-shard_map wrap only has to drop the pin). RecompileGuard signatures
-carry the mesh shape — the same program traced over two mesh shapes is
-two compiled executables and must count as such.
+reshards) and the embedding/LM head replicated. The fused paged-
+attention kernel is a Mosaic custom call GSPMD cannot partition on its
+own, so it rides inside a ``shard_map`` wrap
+(ops/pallas_kernels.py:paged_attention_sharded): each shard runs the
+kernel on its LOCAL head slice (q / pools head-sharded, block tables
+replicated), and the head-sharded output is re-replicated by the SAME
+``gather`` hook the gather formulation pays — no extra collective, and
+still zero all-reduces on the decode hot path. The support gate
+evaluates the local head count ``n_head // tp``, so fused resolves ON
+under TP wherever the per-shard geometry fits. Per-shard outputs are
+bit-identical to the corresponding head slice of the single-device
+kernel whenever the local head count is >= 2 (XLA lowers a batch-1
+head contraction through a different codepath whose low-order f32
+bits can differ — a one-head shard is numerically fine but not
+bitwise-pinned). RecompileGuard signatures carry the mesh shape — the
+same program traced over two mesh shapes is two compiled executables
+and must count as such.
 """
 
 from __future__ import annotations
@@ -178,20 +188,29 @@ from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 __all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
            "assert_fused_allclose", "kv_int8_tolerance",
            "serve_param_shardings", "serve_kv_sharding", "serve_tp_size",
-           "clear_program_caches"]
+           "resolve_block_size", "clear_program_caches"]
 
 
-def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
+def fused_attn_tolerance(dtype=None,
+                         formulation: str = "resident") -> Dict[str, float]:
     """The ONE fused-vs-gather numeric contract (every differential test
     pins through :func:`assert_fused_allclose`; nothing defines its own
     ad-hoc ``allclose`` settings).
 
     * **Interpret mode / CPU** (``pallas_kernels._INTERPRET``, or any
-      non-TPU backend): EXACT — ``rtol = atol = 0``, any dtype. The
-      fused kernel's compute step reproduces the gather reference's
-      arithmetic op for op (head-batched f32 dots, the same mask
-      constant, the same ``jax.nn.softmax``), so the interpret-mode
-      lowering is bit-identical by construction.
+      non-TPU backend), RESIDENT formulation: EXACT — ``rtol = atol =
+      0``, any dtype. The fused kernel's compute step reproduces the
+      gather reference's arithmetic op for op (head-batched f32 dots,
+      the same mask constant, the same ``jax.nn.softmax``), so the
+      interpret-mode lowering is bit-identical by construction.
+    * **STREAMING formulation** (``formulation="streaming"``): bounded
+      even in interpret mode. Online-softmax accumulates per KV block
+      with running max/sum rescaling, so its f32 reductions are
+      RE-ASSOCIATED relative to the single-pass softmax of the gather
+      reference (and of the resident kernel) — mathematically equal,
+      bitwise a few f32 ULP apart. The band covers that reassociation
+      (measured ~1e-7 on O(1) values; bf16 outputs still round both
+      arms to 8 mantissa bits, so the bf16 band already covers it).
     * **TPU**: bounded ULP in the COMPARED dtype — the Mosaic lowering
       of the same ops may round differently in the last bits (dot
       tiling, transcendental tables). For f32 outputs that is a few
@@ -204,19 +223,29 @@ def fused_attn_tolerance(dtype=None) -> Dict[str, float]:
     carry: the contract is now executable, in one place."""
     import jax as _jax
     from ..ops import pallas_kernels as _pk
-    if _pk._INTERPRET or _jax.default_backend() != "tpu":
-        return {"rtol": 0.0, "atol": 0.0}
     if dtype is not None and jnp.dtype(dtype) == jnp.bfloat16:
-        # two bf16 ULP relative (2^-8 each), atol for near-zero values
-        return {"rtol": 2.0 / 256, "atol": 2.0 / 256}
+        if formulation == "streaming" \
+                or not (_pk._INTERPRET
+                        or _jax.default_backend() != "tpu"):
+            # two bf16 ULP relative (2^-8 each), atol for near-zero
+            return {"rtol": 2.0 / 256, "atol": 2.0 / 256}
+        return {"rtol": 0.0, "atol": 0.0}
+    if _pk._INTERPRET or _jax.default_backend() != "tpu":
+        if formulation == "streaming":
+            # f32 online-softmax reassociation band (see above)
+            return {"rtol": 1e-5, "atol": 1e-6}
+        return {"rtol": 0.0, "atol": 0.0}
     return {"rtol": 2e-6, "atol": 2e-6}
 
 
-def assert_fused_allclose(actual, desired, err_msg: str = "") -> None:
+def assert_fused_allclose(actual, desired, err_msg: str = "",
+                          formulation: str = "resident") -> None:
     """Assert fused-vs-gather agreement under the shared tolerance
-    contract (exact in interpret mode / on CPU, bounded ULP — in the
-    compared dtype — on TPU)."""
-    tol = fused_attn_tolerance(getattr(desired, "dtype", None))
+    contract (exact in interpret mode / on CPU for the resident
+    formulation, bounded ULP — in the compared dtype — on TPU and for
+    the streaming online-softmax formulation)."""
+    tol = fused_attn_tolerance(getattr(desired, "dtype", None),
+                               formulation=formulation)
     np.testing.assert_allclose(
         np.asarray(actual, np.float64 if tol["rtol"] else None),
         np.asarray(desired, np.float64 if tol["rtol"] else None),
@@ -250,6 +279,36 @@ def kv_int8_tolerance() -> Dict[str, float]:
     suite pins the no-op."""
     return {"rtol": 2e-2, "atol": 2e-2, "greedy_flip": 0.35,
             "chi2_sig": 1e-3}
+
+
+# fused-fallback observability (one line per distinct reason per
+# process — engine rebuilds and replica spin-ups over the same config
+# must not spam the log; the counter still ticks every resolution)
+_FALLBACK_LOGGED = set()
+
+
+def _note_fused_fallback(reason: str, registry=None) -> None:
+    """Record one fused-attention fallback resolution: the support gate
+    rejected the Pallas kernel (``reason`` from
+    ``paged_attention_fallback_reason`` — "backend", "geometry",
+    "env_off") and the engine is keeping the XLA gather formulation.
+    Logs the reason ONCE per process via the profiler and counts every
+    occurrence in ``cxn_fused_fallback_total{reason=}`` when a registry
+    is armed — the resolution used to be silent, which made "why is
+    this replica slow" a source-diving exercise."""
+    if not reason:
+        return
+    if reason not in _FALLBACK_LOGGED:
+        _FALLBACK_LOGGED.add(reason)
+        from ..utils import profiler
+        profiler.log("serve: fused paged attention unavailable "
+                     "(reason=%s) — decoding on the XLA gather "
+                     "formulation" % reason)
+    if registry is not None:
+        registry.counter(
+            "cxn_fused_fallback_total",
+            "fused paged-attention fallback resolutions by reason",
+            labelnames=("reason",)).labels(reason).inc()
 
 
 def _kv_itemsizes(cfg, kv_int8: bool):
@@ -321,6 +380,49 @@ def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
         return int(kv_mb * (1 << 20) // block_bytes)
     prefix_blocks = int(prefix_mb * (1 << 20) // block_bytes)
     return slots * bpr + min(prefix_blocks, slots * bpr) + 1
+
+
+def resolve_block_size(cfg, prefill_chunk: int, block_size: int,
+                       kv_dtype: str = "", tp: int = 1,
+                       aot=None) -> int:
+    """Resolve ``serve_block_size=auto`` (the ``-1`` sentinel) through
+    the persisted geometry-autotune winner — the ONE lookup the
+    server, the CLI, and the lint tool share. A non-negative
+    ``block_size`` passes through untouched (0 keeps the
+    block-size-defaults-to-chunk behavior). ``-1`` consults the AOT
+    cache (``aot``: an AotCache, a path, or None for the
+    process-default :func:`~cxxnet_tpu.analysis.aot_cache.active`
+    cache) under the :func:`~cxxnet_tpu.analysis.aot_cache
+    .tuned_components` key — device kind + model geometry + chunk +
+    KV dtype + TP. A hit returns the tuned winner (tuning ran once per
+    fleet; every replica loads it here); a miss logs once and falls
+    back to 0 = the chunk default, so ``auto`` without a tuning run
+    is never an error."""
+    bs = int(block_size)
+    if bs >= 0:
+        return bs
+    from ..analysis import aot_cache as aot_mod
+    from ..utils import profiler
+    cache = aot_mod.get_cache(aot) if isinstance(aot, str) \
+        else (aot if aot is not None else aot_mod.active())
+    chunk = min(int(prefill_chunk), cfg.seq_len)
+    if cache is not None:
+        comp = aot_mod.tuned_components(
+            aot_mod.config_hash(dataclasses.astuple(cfg)), chunk,
+            kv_dtype, tp)
+        rec = cache.load_tuned(comp)
+        if rec is not None:
+            profiler.log(
+                "serve: serve_block_size=auto -> %d (tuned winner, "
+                "formulation=%s, %.3f ms/tick when tuned)"
+                % (int(rec["block_size"]), rec.get("formulation", "?"),
+                   float(rec.get("tick_ms", 0.0))))
+            return int(rec["block_size"])
+    profiler.log("serve: serve_block_size=auto found no tuned winner "
+                 "for this geometry%s — using the chunk default "
+                 "(run task=autotune with an aot_cache to persist one)"
+                 % ("" if cache is not None else " (no aot cache armed)"))
+    return 0
 
 
 # ------------------------------------------------------------------ TP
@@ -869,22 +971,35 @@ def _gather_rows(pool, table, n_head, bs):
         b, n_head, bpr * bs, hd)
 
 
-def _paged_attn(q, pool_k, pool_v, table, pos, l, bs):
+def _paged_attn(q, pool_k, pool_v, table, pos, l, bs, mesh=None,
+                streaming=False):
     """Route the fused Pallas block-table-walk attention over either
     pool layout: an int8 pool hands the kernel its scale planes too, so
     the in-VMEM dequant mirrors :func:`_kv_dequant` op for op (the
     interpret-mode differential pins it bit-exact against the gather
-    formulation)."""
-    from ..ops.pallas_kernels import paged_attention
+    formulation). A TP ``mesh`` (model axis > 1) routes through the
+    shard_map wrap — each shard runs the kernel on its local head
+    slice of q and the pools, tables replicated; the returned output
+    is still HEAD-SHARDED and the caller re-replicates it with the
+    same ``gather`` hook the gather formulation uses. ``streaming``
+    selects the online-softmax grid formulation (row images past the
+    resident VMEM gate)."""
+    from ..ops.pallas_kernels import (paged_attention,
+                                      paged_attention_sharded)
+    sk = sv = None
     if isinstance(pool_k, tuple):
-        return paged_attention(q, pool_k[0], pool_v[0], table, pos, l,
-                               bs, scale_k=pool_k[1], scale_v=pool_v[1])
-    return paged_attention(q, pool_k, pool_v, table, pos, l, bs)
+        (pool_k, sk), (pool_v, sv) = pool_k, pool_v
+    if mesh is not None:
+        return paged_attention_sharded(q, pool_k, pool_v, table, pos,
+                                       l, bs, mesh, scale_k=sk,
+                                       scale_v=sv, streaming=streaming)
+    return paged_attention(q, pool_k, pool_v, table, pos, l, bs,
+                           scale_k=sk, scale_v=sv, streaming=streaming)
 
 
 @functools.lru_cache(maxsize=16)
 def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
-                   fused: bool = False, mesh=None):
+                   fused="", mesh=None):
     """Paged batched decode tick: same math as ``_tick_fn`` with the
     per-row dus replaced by a block scatter and the cache row reads by a
     table gather. Parked rows scatter into whatever their table's last
@@ -893,19 +1008,28 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
     position before attending to it (write-before-attend, the invariant
     every reuse argument leans on).
 
-    ``fused`` replaces the XLA gather + attention by ONE Pallas pass
-    per layer (ops/pallas_kernels.py:paged_attention): the kernel walks
-    the block table directly, so the gathered logical rows are never
-    materialized in HBM. The scatter (and with it the cache bytes) is
-    IDENTICAL either way; only the attention read path changes, under
-    the fused_attn_tolerance contract. The flag is part of this lru
+    ``fused`` (the formulation string — ``"resident"`` /
+    ``"streaming"``, falsy = gather; a legacy ``True`` means resident)
+    replaces the XLA gather + attention by ONE Pallas pass per layer
+    (ops/pallas_kernels.py:paged_attention): the kernel walks the
+    block table directly, so the gathered logical rows are never
+    materialized in HBM — streaming additionally carries online-
+    softmax scratch across the block walk so row images past the
+    resident VMEM gate stay fused. Under a TP mesh the kernel rides
+    the shard_map wrap per head shard and its output is re-replicated
+    by the same ``gather`` hook the gather formulation pays. The
+    scatter (and with it the cache bytes) is IDENTICAL either way;
+    only the attention read path changes, under the
+    fused_attn_tolerance contract. The formulation is part of this lru
     key — a fused and a gather engine over one config are different
     compiled programs — but deliberately NOT part of any RecompileGuard
     signature string (the guard counts traffic-driven drift, and the
-    flag is fixed at engine construction)."""
+    formulation is fixed at engine construction)."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     gather, pin_kv = _tp_ops(mesh)
+    tp_mesh = mesh if serve_tp_size(mesh) > 1 else None
+    streaming = (fused == "streaming")
 
     def impl(blocks, outer, pool_k, pool_v, table, tok, pos, keys, fold,
              temp, top_k, top_p):
@@ -929,8 +1053,9 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
                 pk = pin_kv(_scatter_kv(pool_k, l, blk, off, k[:, 0]))
                 pv = pin_kv(_scatter_kv(pool_v, l, blk, off, v[:, 0]))
                 if fused:
-                    return _paged_attn(q, pk, pv, table, pos, l,
-                                       bs), (pk, pv)
+                    return gather(_paged_attn(
+                        q, pk, pv, table, pos, l, bs, mesh=tp_mesh,
+                        streaming=streaming)), (pk, pv)
                 ck = _gather_rows(_layer_pool(pk, l), table, cfg.n_head,
                                   bs)
                 cv = _gather_rows(_layer_pool(pv, l), table, cfg.n_head,
@@ -1002,7 +1127,7 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
 
 @functools.lru_cache(maxsize=16)
 def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
-                     donate: bool, fused: bool = False, mesh=None):
+                     donate: bool, fused="", mesh=None):
     """Paged draft-and-verify step: ``_verify_fn``'s math over block
     scatter/gather. All K+1 candidate positions were reserved (and
     COW-privatized) before dispatch, which is exactly why a rejected
@@ -1010,13 +1135,17 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
     privately-owned blocks beyond the row's accepted position,
     unreachable by the position mask until overwritten.
 
-    ``fused`` routes the attention read through the same Pallas
-    block-table kernel as the tick, widened to K+1 query rows (query r
-    masked at ``pos + r`` — exactly ``_attn_verify``'s semantics); the
-    scatter and the accept/emit logic are untouched."""
+    ``fused`` (the formulation string, as in :func:`_tick_paged_fn`)
+    routes the attention read through the same Pallas block-table
+    kernel as the tick, widened to K+1 query rows (query r masked at
+    ``pos + r`` — exactly ``_attn_verify``'s semantics), sharded per
+    head under a TP mesh; the scatter and the accept/emit logic are
+    untouched."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     gather, pin_kv = _tp_ops(mesh)
+    tp_mesh = mesh if serve_tp_size(mesh) > 1 else None
+    streaming = (fused == "streaming")
     rows = spec_len + 1
 
     def impl(blocks, outer, pool_k, pool_v, table, toks, pos, n_draft,
@@ -1033,9 +1162,10 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
                 pk = pin_kv(_scatter_kv(pool_k, l, blkw, offw, k[0]))
                 pv = pin_kv(_scatter_kv(pool_v, l, blkw, offw, v[0]))
                 if fused:
-                    return _paged_attn(
-                        q, pk, pv, table[None],
-                        jnp.reshape(pos, (1,)), l, bs), (pk, pv)
+                    return gather(_paged_attn(
+                        q, pk, pv, table[None], jnp.reshape(pos, (1,)),
+                        l, bs, mesh=tp_mesh,
+                        streaming=streaming)), (pk, pv)
                 row_k = _gather_row(_layer_pool(pk, l), table,
                                     cfg.n_head, bs)
                 row_v = _gather_row(_layer_pool(pv, l), table,
@@ -1184,11 +1314,18 @@ class DecodeEngine:
 
         ``fused_attn`` (paged only): arm the fused Pallas
         block-table-walk attention for the tick and verify programs
-        wherever ``paged_attention_supported`` holds — it auto-resolves
-        OFF on unsupported backends/geometries (the XLA gather
-        formulation then runs, bit-reference semantics), and
+        wherever ``paged_attention_formulation`` resolves one — the
+        RESIDENT whole-row-image formulation when it fits the VMEM
+        gate, the STREAMING online-softmax formulation (one KV block
+        resident at a time) for longer rows, so long-context serving
+        stays fused. It auto-resolves OFF on unsupported
+        backends/geometries (the XLA gather formulation then runs,
+        bit-reference semantics — the reason is logged once and counted
+        in ``cxn_fused_fallback_total{reason=}``), and
         ``CXN_FUSED_ATTN=0`` force-disables it process-wide. The
-        resolved state is ``self.fused_attn``.
+        resolved state is ``self.fused_attn`` /
+        ``self.fused_formulation``; under TP the kernel runs per head
+        shard through the shard_map wrap (module docstring).
 
         ``mesh`` (a ``jax.sharding.Mesh`` whose ``model`` axis is > 1)
         arms gather-form tensor-parallel serving (module docstring):
@@ -1385,21 +1522,33 @@ class DecodeEngine:
             # fused paged attention: requested AND the backend/geometry
             # supports the kernel (TPU, or interpret mode under test) —
             # anything else keeps the gather formulation, so a CPU test
-            # mesh and an odd geometry degrade silently to the
-            # bit-reference path instead of failing to compile. Under
-            # TP the gate sees the LOCAL head count (each shard holds
-            # n_head / tp whole heads), but tp > 1 currently PINS the
-            # gather fallback regardless: the kernel is a Mosaic custom
-            # call GSPMD cannot partition — the shard_map wrap that
-            # would run it per-shard is the noted follow-up, and only
-            # has to drop the tp == 1 term below
-            from ..ops.pallas_kernels import paged_attention_supported
-            self.fused_attn = bool(fused_attn) and \
-                paged_attention_supported(
-                    cfg.n_head // self.tp, self.bpr, self.block_size, hd,
-                    1 if self.kv_int8
-                    else (2 if cfg.dtype == "bfloat16" else 4)) \
-                and self.tp == 1
+            # mesh and an odd geometry degrade to the bit-reference
+            # path instead of failing to compile, and the resolution is
+            # no longer silent: the reason is logged once and counted
+            # in cxn_fused_fallback_total{reason=}. The gate sees the
+            # LOCAL head count (each shard holds n_head / tp whole
+            # heads, the shard_map wrap runs the kernel per shard), and
+            # picks the FORMULATION: "resident" when the whole row
+            # image fits the VMEM gate, "streaming" (online-softmax
+            # scratch across the block walk) when only a single block
+            # does — long rows stay fused instead of degrading to
+            # gather.
+            from ..ops.pallas_kernels import (
+                paged_attention_fallback_reason,
+                paged_attention_formulation)
+            itemsize = 1 if self.kv_int8 \
+                else (2 if cfg.dtype == "bfloat16" else 4)
+            form = paged_attention_formulation(
+                cfg.n_head // self.tp, self.bpr, self.block_size, hd,
+                itemsize)
+            self.fused_formulation = form if bool(fused_attn) else ""
+            self.fused_attn = bool(self.fused_formulation)
+            if bool(fused_attn) and not self.fused_attn:
+                _note_fused_fallback(
+                    paged_attention_fallback_reason(
+                        cfg.n_head // self.tp, self.bpr,
+                        self.block_size, hd, itemsize),
+                    obs_registry)
             shape = (cfg.n_layer, self.num_blocks, cfg.n_head,
                      self.block_size, hd)
             # host-side bookkeeping (free list, refcounts, tables);
@@ -1412,6 +1561,7 @@ class DecodeEngine:
             self.bpr = 0
             self.manager = None
             self.fused_attn = False
+            self.fused_formulation = ""
             shape = (cfg.n_layer, slots, cfg.n_head, self.row_len, hd)
         kv_sh = serve_kv_sharding(self.mesh) if self.tp > 1 else None
         if kv_sh is None and mesh is not None and not abstract:
@@ -1579,10 +1729,16 @@ class DecodeEngine:
         resolution, geometry constants, the guard-suffix flags). The
         artifact validator (analysis/step_audit.py:audit_aot_artifacts)
         must derive the same string, so it lives here, next to the
-        builders it describes."""
-        return "%s/chunk=%d/bs=%d/bpr=%d/spec=%d/fused=%d%s" % (
+        builders it describes. The streaming formulation is a distinct
+        executable and gets its own ``/form=streaming`` component;
+        resident keeps the historical key shape, so every artifact
+        written before the streaming formulation existed still
+        resolves."""
+        return "%s/chunk=%d/bs=%d/bpr=%d/spec=%d/fused=%d%s%s" % (
             label, self.chunk, self.block_size, self.bpr, self.spec_len,
-            int(self.fused_attn), self._sig_suffix)
+            int(self.fused_attn),
+            "/form=streaming" if self.fused_formulation == "streaming"
+            else "", self._sig_suffix)
 
     def warm_aot(self, cache=None, tracer=None) -> Dict[str, str]:
         """Resolve the serve programs through the AOT executable cache:
@@ -1672,7 +1828,8 @@ class DecodeEngine:
                     ("serve_verify_chunk",
                      _verify_paged_fn(self._cfg_key, self.spec_len,
                                       self.block_size, self.bpr, don,
-                                      self.fused_attn, mesh=self.mesh),
+                                      self.fused_formulation,
+                                      mesh=self.mesh),
                      verify_args, nums))
             tick_args = (self._blocks, self._outer, self.cache_k,
                          self.cache_v, SDS((b, self.bpr), i32),
@@ -1682,7 +1839,8 @@ class DecodeEngine:
             specs.append(
                 ("serve_tick",
                  _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                don, self.fused_attn, mesh=self.mesh),
+                                don, self.fused_formulation,
+                                mesh=self.mesh),
                  tick_args, nums))
             return specs
         tick_args = (self._blocks, self._outer, self.cache_k, self.cache_v,
@@ -1885,7 +2043,8 @@ class DecodeEngine:
                              % (k, self.bpr, self._sig_suffix))
             fn = _verify_paged_fn(self._cfg_key, k, self.block_size,
                                   self.bpr, self._donate,
-                                  self.fused_attn, mesh=self.mesh)
+                                  self.fused_formulation,
+                                  mesh=self.mesh)
             args = (jnp.asarray(m.table[slot]),)
         else:
             if self._vguard is not None:
@@ -1969,7 +2128,7 @@ class DecodeEngine:
                 self._tguard("slots=%d/table=%d%s"
                              % (self.slots, self.bpr, self._sig_suffix))
             fn = _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
-                                self._donate, self.fused_attn,
+                                self._donate, self.fused_formulation,
                                 mesh=self.mesh)
             args = (jnp.asarray(self.manager.table),)
         else:

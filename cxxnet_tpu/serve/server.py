@@ -201,10 +201,13 @@ class InferenceServer:
         (copy-on-write protected), and under pool pressure the
         scheduler preempts rows to a host swap buffer and resumes them
         bit-identically. ``block_size`` is the block's token width
-        (0 = the prefill chunk; must divide it), ``num_blocks`` the
-        pool size (0 = auto: dense-equivalent ``slots`` rows plus trie
-        headroom, or ``kv_mb`` MiB when given — the explicit budget
-        wins over the formula). ``paged=False`` or
+        (0 = the prefill chunk; must divide it; -1 = ``auto``: load
+        the persisted ``task=autotune`` winner for this device kind +
+        model geometry from the AOT cache, falling back to the chunk
+        default when none exists — engine.resolve_block_size),
+        ``num_blocks`` the pool size (0 = auto: dense-equivalent
+        ``slots`` rows plus trie headroom, or ``kv_mb`` MiB when given
+        — the explicit budget wins over the formula). ``paged=False`` or
         ``prefill_chunk=0`` keeps the dense pool (one row per slot —
         still the better layout when every request runs near seq_len).
         ``fused_attn`` (paged only, default on): route the tick/verify
@@ -375,6 +378,17 @@ class InferenceServer:
         from .engine import serve_tp_size
         self._tp = serve_tp_size(mesh)
         nb = 0
+        if self._paged and int(block_size) < 0:
+            # serve_block_size=auto (-1): resolve through the persisted
+            # geometry-autotune winner BEFORE the pool is sized — the
+            # tuned block width changes block_bytes and with it every
+            # auto_num_blocks budget below
+            from .engine import resolve_block_size
+            block_size = resolve_block_size(
+                cfg, prefill_chunk, block_size, kv_dtype=kv_dtype,
+                tp=self._tp,
+                aot=(str(aot_cache or "")
+                     or os.environ.get("CXN_AOT_CACHE", "") or None))
         if self._paged:
             from .engine import auto_num_blocks
             # auto-sizing is dtype-aware: the same serve_kv_mb budget
@@ -1878,6 +1892,7 @@ class InferenceServer:
                 "num_blocks": self._engine.num_blocks,
                 "block_size": self._engine.block_size,
                 "fused_attn": self._engine.fused_attn,
+                "fused_formulation": self._engine.fused_formulation,
                 "kv_dtype": self._engine.kv_dtype,
                 "blocks": self._engine.manager.counts(),
                 "cow_faults": self._engine.manager.cow_faults,

@@ -1,0 +1,207 @@
+"""Geometry autotuner (``task=autotune`` + ``serve_block_size=auto``).
+
+The load-bearing invariants:
+
+1. **winner persistence** — the sweep times real AOT executables and
+   persists one winner record per (device kind, model geometry, chunk,
+   kv dtype, tp) key under the AOT cache's standard program-dir layout
+   (``serve_tuned_geometry/<key>.json``), so tuning runs ONCE per
+   fleet and every replica loads the result;
+2. **auto resolution** — ``serve_block_size=auto`` (-1) consults the
+   tuned winner at engine build, BEFORE the pool is sized; a miss
+   falls back to the chunk default (0) with a log line, never an
+   error;
+3. **zero compile on the tuned path** — the sweep warms the AOT cache
+   with every candidate's executables, so a fresh
+   ``serve_block_size=auto`` build loads the winner AND its compiled
+   programs with no new ``/jax/core/compile/*`` work (CompileWatch is
+   the witness);
+4. **stale-winner invalidation** — geometry drift (a different config
+   hash / chunk / kv dtype / tp) is a miss, and the CXN210
+   ``stale_entries`` scan names the drifting component, exactly like
+   executable entries.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from cxxnet_tpu.analysis import aot_cache as aot_mod
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+from cxxnet_tpu.obs import devprof
+from cxxnet_tpu.serve import InferenceServer
+from cxxnet_tpu.serve import engine as engine_mod
+from cxxnet_tpu.serve.engine import resolve_block_size
+
+CFG = GPTConfig(vocab_size=32, seq_len=16, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+SERVE_LABELS = ("serve_prefill_chunk", "serve_verify_chunk", "serve_tick")
+
+
+def _cfg_hash(cfg=CFG):
+    return aot_mod.config_hash(dataclasses.astuple(cfg))
+
+
+def _serve_compile_seconds():
+    totals = devprof.compile_watch().totals
+    return {k: totals.get(k, 0.0) for k in SERVE_LABELS}
+
+
+# ---------------------------------------------------- unit: persistence
+def test_tuned_roundtrip_unit(tmp_path):
+    cache = aot_mod.get_cache(str(tmp_path))
+    comp = aot_mod.tuned_components(_cfg_hash(), 4, "", 1)
+    rec = {"block_size": 2, "formulation": "gather", "tick_ms": 0.5}
+    assert cache.store_tuned(comp, rec)
+    got = cache.load_tuned(dict(comp))
+    assert got is not None and got["block_size"] == 2
+    assert got["formulation"] == "gather"
+    # the sidecar lives in the standard program-dir layout, components
+    # at top level, so the CXN210 machinery reads it like any entry
+    files = glob.glob(str(tmp_path / "serve_tuned_geometry" / "*.json"))
+    assert len(files) == 1
+    doc = json.load(open(files[0]))
+    assert doc["program"] == "serve_tuned_geometry"
+    assert doc["winner"]["block_size"] == 2
+
+
+def test_tuned_key_excludes_jax_versions():
+    """A jax upgrade must NOT invalidate a tuned geometry — the winner
+    depends on device kind and model shape, not the compiler build
+    (the executables it points at carry their own version keys)."""
+    comp = aot_mod.tuned_components(_cfg_hash(), 4, "", 1)
+    assert "jax" not in comp and "jaxlib" not in comp
+    for k in ("program", "config", "chunk", "kv", "tp", "backend",
+              "device_kind", "interpret"):
+        assert k in comp, comp
+
+
+def test_stale_winner_invalidated_on_geometry_drift(tmp_path):
+    """Geometry drift = miss + CXN210: a winner tuned for one config
+    hash / chunk / kv dtype / tp never serves another, and the stale
+    scan names the drifting component."""
+    cache = aot_mod.get_cache(str(tmp_path))
+    comp = aot_mod.tuned_components(_cfg_hash(), 4, "", 1)
+    cache.store_tuned(comp, {"block_size": 2})
+    other = dataclasses.replace(CFG, n_head=4, feat=32)
+    for drifted in (
+            aot_mod.tuned_components(_cfg_hash(other), 4, "", 1),
+            aot_mod.tuned_components(_cfg_hash(), 8, "", 1),
+            aot_mod.tuned_components(_cfg_hash(), 4, "int8", 1),
+            aot_mod.tuned_components(_cfg_hash(), 4, "", 2)):
+        assert cache.load_tuned(drifted) is None
+        stale = cache.stale_entries(drifted)
+        assert stale, drifted
+        drift_keys = set().union(*[set(d) for _, d in stale])
+        assert drift_keys & {"config", "chunk", "kv", "tp"}, stale
+    # a winner record missing its payload counts stale, not a crash
+    bad = aot_mod.tuned_components(_cfg_hash(), 2, "", 1)
+    _, _, meta = cache._paths(bad)
+    os.makedirs(os.path.dirname(meta), exist_ok=True)
+    with open(meta, "w") as f:
+        json.dump(dict(bad), f)                     # no "winner" dict
+    s0 = cache.stats()["stale"]
+    assert cache.load_tuned(bad) is None
+    assert cache.stats()["stale"] == s0 + 1
+
+
+# ------------------------------------------------------ auto resolution
+def test_resolve_block_size_paths(tmp_path, capfd):
+    cache = aot_mod.get_cache(str(tmp_path))
+    # explicit sizes pass through untouched, no cache consulted
+    assert resolve_block_size(CFG, 4, 8) == 8
+    assert resolve_block_size(CFG, 4, 0) == 0
+    # auto + miss: chunk default, logged, never an error
+    assert resolve_block_size(CFG, 4, -1, aot=cache) == 0
+    # auto + winner: the tuned size
+    comp = aot_mod.tuned_components(_cfg_hash(), 4, "", 1)
+    cache.store_tuned(comp, {"block_size": 2, "formulation": "gather",
+                             "tick_ms": 0.4})
+    assert resolve_block_size(CFG, 4, -1, aot=cache) == 2
+    # the string path (CXN_AOT_CACHE-style) resolves the same cache
+    assert resolve_block_size(CFG, 4, -1, aot=str(tmp_path)) == 2
+    # kv-dtype drift within the same cache is a miss
+    assert resolve_block_size(CFG, 4, -1, kv_dtype="int8",
+                              aot=cache) == 0
+
+
+def test_cli_parses_auto(monkeypatch):
+    from cxxnet_tpu.cli import LearnTask
+    task = LearnTask()
+    task.set_param("serve_block_size", "auto")
+    assert task.serve_block_size == -1
+    task.set_param("serve_block_size", "4")
+    assert task.serve_block_size == 4
+
+
+# ----------------------------------------- e2e: sweep -> persist -> load
+def test_task_autotune_persists_and_auto_build_loads(tmp_path, capfd):
+    """The acceptance pin: ``task=autotune`` sweeps the chunk's
+    divisors, persists a winner, and a fresh ``serve_block_size=auto``
+    server build loads the winner's geometry AND its executables with
+    zero new compile events for the serve programs."""
+    from cxxnet_tpu.cli import main as cli_main
+    from cxxnet_tpu.models import gpt_lm_config
+    from cxxnet_tpu.nnet.lm import net_gpt_export
+    from cxxnet_tpu.nnet.net import Net
+    from cxxnet_tpu.utils.config import tokenize
+    conf_txt = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                             nblock=2, batch_size=8, precision="float32",
+                             updater="sgd", eta=0.1)
+    conf = tmp_path / "tune.conf"
+    conf.write_text(conf_txt)
+    cache_dir = tmp_path / "aot"
+    rc = cli_main([str(conf), "task=autotune", "prof_reps=1",
+                   "serve_prefill_chunk=2", "silent=1",
+                   "aot_cache=%s" % cache_dir])
+    out = capfd.readouterr().out
+    assert rc == 0
+    assert "winner serve_block_size=" in out and "persisted" in out
+    files = glob.glob(str(cache_dir / "serve_tuned_geometry" / "*.json"))
+    assert len(files) == 1
+    doc = json.load(open(files[0]))
+    winner_bs = doc["winner"]["block_size"]
+    assert 2 % winner_bs == 0 and len(doc["winner"]["candidates"]) == 2
+    # losing candidates' executables are pruned after the pick, so a
+    # CXN210 scan of the tuned cache stays clean: one entry per serve
+    # program dir (the winner's), nothing stale
+    for prog in ("serve_prefill_chunk", "serve_tick"):
+        metas = glob.glob(str(cache_dir / prog / "*.json"))
+        assert len(metas) == 1, (prog, metas)
+    # a fresh build (fresh-process stand-in: in-process program caches
+    # dropped) resolves auto -> winner and loads every serve program
+    net = Net(tokenize(conf_txt))
+    net.init_model()
+    gcfg, gparams = net_gpt_export(net)
+    engine_mod.clear_program_caches()
+    before = _serve_compile_seconds()
+    with InferenceServer(gcfg, gparams, prefill_chunk=2, block_size=-1,
+                         aot_cache=str(cache_dir)) as srv:
+        m = srv.metrics()
+        status = srv._engine.aot_status()
+        assert m["paged"]["block_size"] == winner_bs
+    assert all(v == "aot_load" for v in status.values()), status
+    assert _serve_compile_seconds() == before, \
+        "the tuned build must not compile any serve program"
+    assert m["aot_cache"]["hits"] >= 2
+
+
+def test_auto_without_winner_serves_on_chunk_default(tmp_path):
+    """auto + an empty cache is the safe path: chunk-default geometry,
+    a served request, no error."""
+    rs = np.random.RandomState(0)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         block_size=-1,
+                         aot_cache=str(tmp_path)) as srv:
+        assert srv.metrics()["paged"]["block_size"] == 4
+        res = srv.result(srv.submit(
+            rs.randint(0, 32, (5,)).astype(np.int32), max_tokens=4),
+            timeout=300)
+    assert res.status == "ok"

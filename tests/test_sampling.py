@@ -158,28 +158,26 @@ def test_speculative_rejection_matches_direct_distribution():
     probs = _filtered_probs(logits, temperature, top_k, top_p)
     draft = int(np.argsort(probs)[-2])      # a plausible (2nd best) draft
     n = 4000
-    lrow = jnp.asarray(logits)[None]
-    t_row = jnp.asarray([temperature], jnp.float32)
-    k_row = jnp.asarray([top_k], jnp.int32)
-    p_row = jnp.asarray([top_p], jnp.float32)
-    spec_counts = np.zeros(8)
-    direct_counts = np.zeros(8)
-    for s in range(n):
-        key = jax.random.PRNGKey(s)
-        acc = bool(np.asarray(accept_draft_rows(
-            lrow, jnp.asarray([draft]), jax.random.fold_in(key, 1)[None],
-            t_row, k_row, p_row))[0])
-        if acc:
-            tok = draft
-        else:
-            tok = int(np.asarray(residual_sample_rows(
-                lrow, jnp.asarray([draft]),
-                jax.random.fold_in(key, 2)[None], t_row, k_row,
-                p_row))[0])
-        spec_counts[tok] += 1
-        direct_counts[int(np.asarray(sample_rows(
-            lrow, jax.random.fold_in(key, 3)[None], t_row, k_row,
-            p_row))[0])] += 1
+    # the rows APIs batch over independent requests, so the n trials
+    # run as one n-row call each instead of an n-iteration host loop
+    lrows = jnp.tile(jnp.asarray(logits)[None], (n, 1))
+    t_rows = jnp.full((n,), temperature, jnp.float32)
+    k_rows = jnp.full((n,), top_k, jnp.int32)
+    p_rows = jnp.full((n,), top_p, jnp.float32)
+    drafts = jnp.full((n,), draft, jnp.int32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(n, dtype=jnp.uint32))
+    k1 = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+    k2 = jax.vmap(lambda k: jax.random.fold_in(k, 2))(keys)
+    k3 = jax.vmap(lambda k: jax.random.fold_in(k, 3))(keys)
+    acc = np.asarray(accept_draft_rows(lrows, drafts, k1, t_rows, k_rows,
+                                       p_rows))
+    resid = np.asarray(residual_sample_rows(lrows, drafts, k2, t_rows,
+                                            k_rows, p_rows))
+    spec_toks = np.where(acc, draft, resid)
+    direct_toks = np.asarray(sample_rows(lrows, k3, t_rows, k_rows,
+                                         p_rows))
+    spec_counts = np.bincount(spec_toks, minlength=8).astype(float)
+    direct_counts = np.bincount(direct_toks, minlength=8).astype(float)
     # the filters must actually bite in this setup (df > 1, < vocab)
     kept = int((probs > 0).sum())
     assert 2 <= kept < 8

@@ -1,0 +1,344 @@
+"""Streaming online-softmax fused paged attention.
+
+The load-bearing invariants of the STREAMING formulation
+(ops/pallas_kernels.py:_paged_attn_stream_kernel, the
+``paged_attention_formulation`` gate, the engine's formulation
+threading):
+
+1. **gate selection** — geometry inside the resident VMEM budget keeps
+   the round-16 resident kernel; a row image past the budget (here: a
+   clamped ``_PAGED_RESIDENT_VMEM``, the CI stand-in for a
+   production-length row blowing the real 12 MiB gate) resolves
+   ``"streaming"`` instead of falling back to gather. The off-switches
+   (param / env) still win.
+2. **numerics under the shared contract** — streaming-vs-gather
+   agreement asserts through ``assert_fused_allclose(...,
+   formulation="streaming")``: the online-softmax reassociation band
+   for f32, the bf16 band on bf16 pools — never ad-hoc tolerances.
+   Garbage (id 0) table entries stay masked. Served TOKENS are pinned
+   bit-identical to the gather path and the solo oracle (the band is
+   orders of magnitude below any argmax margin).
+3. **int8-KV composes** — the scale-plane operands ride through the
+   streaming grid exactly as through the resident one; a streaming
+   int8 engine is token-identical to the gather int8 engine.
+4. **compiled-program hygiene** — the streaming/resident choice is
+   engine-construction state, NOT signature state (PR 10 idiom): one
+   compiled tick signature across mixed row lengths, and a streaming
+   engine's RecompileGuard signatures equal a resident engine's.
+5. **fallback observability** — a fused request the backend cannot
+   serve logs its reason once and counts it in
+   ``cxn_fused_fallback_total{reason=}``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import cxxnet_tpu.ops.pallas_kernels as pk
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (DecodeEngine, InferenceServer,
+                              assert_fused_allclose, fused_attn_tolerance)
+from cxxnet_tpu.serve.engine import (_attn_cached_rows, _attn_verify,
+                                     _gather_row, _gather_rows)
+
+CFG = GPTConfig(vocab_size=32, seq_len=32, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+HD = CFG.feat // CFG.n_head
+
+
+@pytest.fixture(autouse=True)
+def interpret(monkeypatch):
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+
+
+def _force_streaming(monkeypatch, block_size=4):
+    """Clamp the resident VMEM budget to exactly one f32 block image:
+    every full row here overflows it (streaming selected), while a
+    single block of any served dtype still fits (the streaming gate
+    passes)."""
+    gate = pk._paged_row_vmem(CFG.n_head, 1, block_size, HD, 4)
+    monkeypatch.setattr(pk, "_PAGED_RESIDENT_VMEM", gate)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, **kw):
+    seed = kw.pop("seed", 0)
+    t = kw.get("temperature", 0.0)
+    rng = jax.random.PRNGKey(seed) if t > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 rng=rng, **kw))[0]
+
+
+# ------------------------------------------------------ gate selection
+def test_formulation_crossover(monkeypatch):
+    """Same geometry, two budgets: the stock gate resolves resident,
+    the clamped gate resolves streaming — and both count as fused."""
+    bpr = CFG.seq_len // 4
+    assert pk.paged_attention_formulation(CFG.n_head, bpr, 4, HD,
+                                          4) == "resident"
+    _force_streaming(monkeypatch)
+    assert pk.paged_attention_formulation(CFG.n_head, bpr, 4, HD,
+                                          4) == "streaming"
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, fused_attn=True)
+    assert eng.fused_attn and eng.fused_formulation == "streaming"
+    eng.close()
+
+
+def test_streaming_respects_off_switches(monkeypatch):
+    _force_streaming(monkeypatch)
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, fused_attn=False)
+    assert eng.fused_attn is False and eng.fused_formulation == ""
+    eng.close()
+    monkeypatch.setenv("CXN_FUSED_ATTN", "0")
+    assert pk.paged_attention_formulation(CFG.n_head, 12, 4, HD, 4) == ""
+
+
+def test_streaming_tolerance_band_is_the_contract():
+    """The streaming branch of the shared contract is a band, not
+    exact (online-softmax reassociation), and the default resident
+    branch stays exact here — the contract test_serve_fused pins."""
+    tol = fused_attn_tolerance(formulation="streaming")
+    assert tol["rtol"] > 0.0 and tol["atol"] > 0.0
+    assert fused_attn_tolerance() == {"rtol": 0.0, "atol": 0.0}
+
+
+# ----------------------------------------------------- kernel numerics
+def test_kernel_streaming_vs_gather_reference():
+    """paged_attention(streaming=True) against the gather reference,
+    tick AND verify shapes, f32 and bf16, garbage (id 0) table entries
+    — inside the streaming band of the shared contract."""
+    rs = np.random.RandomState(0)
+    L, NB, H, bs = 2, 20, CFG.n_head, 4
+    b, bpr = 3, 6
+    for dtype in (jnp.float32, jnp.bfloat16):
+        pool_k = jnp.asarray(rs.randn(L, NB, H, bs, HD), dtype)
+        pool_v = jnp.asarray(rs.randn(L, NB, H, bs, HD), dtype)
+        table = np.zeros((b, bpr), np.int32)
+        table[0, :3] = [5, 9, 2]            # rest: garbage block 0
+        table[1, :5] = [7, 11, 1, 3, 8]
+        table[2, :2] = [4, 6]
+        table = jnp.asarray(table)
+        pos = jnp.asarray([9, 17, 6], jnp.int32)
+        q = jnp.asarray(rs.randn(b, 1, H, HD), dtype)
+
+        @jax.jit
+        def gather_tick(q, pk_, pv_, table, pos):
+            ck = _gather_rows(pk_[1], table, H, bs)
+            cv = _gather_rows(pv_[1], table, H, bs)
+            return _attn_cached_rows(q, ck, cv, pos)
+
+        @jax.jit
+        def stream_tick(q, pk_, pv_, table, pos):
+            return pk.paged_attention(q, pk_, pv_, table, pos, 1, bs,
+                                      streaming=True)
+
+        assert_fused_allclose(
+            stream_tick(q, pool_k, pool_v, table, pos),
+            gather_tick(q, pool_k, pool_v, table, pos),
+            "tick %s" % dtype.__name__, formulation="streaming")
+
+        R = 4
+        qv = jnp.asarray(rs.randn(1, R, H, HD), dtype)
+        vpos = jnp.asarray(9, jnp.int32)
+
+        @jax.jit
+        def gather_verify(q, pk_, pv_, table, pos):
+            ck = _gather_row(pk_[0], table[0], H, bs)
+            cv = _gather_row(pv_[0], table[0], H, bs)
+            return _attn_verify(q, ck, cv, pos)
+
+        @jax.jit
+        def stream_verify(q, pk_, pv_, table, pos):
+            return pk.paged_attention(q, pk_, pv_, table[:1],
+                                      jnp.reshape(pos, (1,)), 0, bs,
+                                      streaming=True)
+
+        assert_fused_allclose(
+            stream_verify(qv, pool_k, pool_v, table, vpos),
+            gather_verify(qv, pool_k, pool_v, table, vpos),
+            "verify %s" % dtype.__name__, formulation="streaming")
+
+
+# ------------------------------------------------- served-token identity
+def test_streaming_vs_gather_vs_oracle_mixed_workload(monkeypatch):
+    """The tentpole differential, streaming edition: mixed lengths,
+    sampling, shared prefixes served with the STREAMING kernel produce
+    tokens identical to the solo oracle. (gather == the same oracle
+    over mixed traffic is test_serve.py's pin, so streaming == gather
+    follows.)"""
+    _force_streaming(monkeypatch)
+    rs = np.random.RandomState(0)
+    shared = _prompt(rs, 12)
+    cases = [
+        dict(p=_prompt(rs, 3), max_tokens=5),
+        dict(p=_prompt(rs, 9), max_tokens=5, temperature=0.8, top_k=5,
+             top_p=0.9, seed=7),
+        dict(p=np.concatenate([shared, _prompt(rs, 5)]), max_tokens=5),
+    ]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                         prefill_chunk=4, fused_attn=True) as srv:
+        m = srv.metrics()["paged"]
+        assert m["fused_attn"] is True
+        assert m["fused_formulation"] == "streaming"
+        hs = [srv.submit(c["p"], **{k: v for k, v in c.items()
+                                    if k != "p"}) for c in cases]
+        outs = [srv.result(h, timeout=300) for h in hs]
+    assert all(r.status == "ok" for r in outs)
+    for c, rf in zip(cases, outs):
+        kw = {k: v for k, v in c.items() if k not in ("p", "max_tokens")}
+        ref = _ref(c["p"], c["max_tokens"], **kw)
+        np.testing.assert_array_equal(rf.tokens, ref)
+
+
+def test_streaming_speculative_identity(monkeypatch):
+    """The streaming VERIFY program (R > 1 rows through the online-
+    softmax grid) stays token-identical to the solo oracle."""
+    _force_streaming(monkeypatch)
+    rs = np.random.RandomState(3)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base, base])
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=3,
+                         fused_attn=True) as srv:
+        assert srv.metrics()["paged"]["fused_formulation"] == "streaming"
+        res = srv.result(srv.submit(prompt, max_tokens=8), timeout=300)
+        m = srv.metrics()
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, _ref(prompt, 8))
+    assert m["spec_forwards"] >= 1
+
+
+def test_streaming_int8_kv_identity(monkeypatch):
+    """int8-KV through the streaming grid: the scale planes ride the
+    same block walk, and the streaming int8 server is token-identical
+    to the gather int8 server (same quantized pool, so the only delta
+    is the attention read — inside the streaming band, below any
+    greedy margin)."""
+    _force_streaming(monkeypatch)
+    rs = np.random.RandomState(9)
+    prompts = [_prompt(rs, n) for n in (5, 11)]
+    outs = {}
+    for fused in (True, False):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=8,
+                             prefill_chunk=4, kv_dtype="int8",
+                             fused_attn=fused) as srv:
+            m = srv.metrics()["paged"]
+            assert m["kv_dtype"] == "int8"
+            assert m["fused_formulation"] == ("streaming" if fused
+                                              else "")
+            hs = [srv.submit(p, max_tokens=5) for p in prompts]
+            outs[fused] = [srv.result(h, timeout=300).tokens for h in hs]
+    for tf, tg in zip(outs[True], outs[False]):
+        np.testing.assert_array_equal(tf, tg)
+
+
+# ------------------------------------------- compiled-program hygiene
+def test_one_signature_streaming_across_mixed_lengths(monkeypatch):
+    """Mixed-length traffic through a strict RecompileGuard with the
+    STREAMING kernel armed: one compiled signature per program — row
+    length is masked data, never a recompile trigger, exactly as on
+    the resident and gather paths."""
+    _force_streaming(monkeypatch)
+    rs = np.random.RandomState(9)
+    with InferenceServer(CFG, PARAMS, slots=3, queue=64, prefill_chunk=4,
+                         recompile_limit=1, recompile_strict=True,
+                         spec_mode="ngram", spec_len=2,
+                         fused_attn=True) as srv:
+        hs = [srv.submit(_prompt(rs, 1 + (i * 7) % 20), max_tokens=3)
+              for i in range(8)]
+        assert all(srv.result(h, timeout=300).status == "ok"
+                   for h in hs)
+        eng = srv._engine
+        assert eng.fused_formulation == "streaming"
+        assert len(eng.prefill_signatures) == 1, eng.prefill_signatures
+        assert len(eng.tick_signatures) == 1, eng.tick_signatures
+        assert len(eng.verify_signatures) <= 1
+
+
+def test_guard_signatures_do_not_carry_formulation(monkeypatch):
+    """The resident/streaming choice is construction state: a
+    streaming engine and a resident engine over the same traffic count
+    IDENTICAL RecompileGuard signatures, and no signature string
+    carries the formulation (PR 10's flag-free idiom, extended)."""
+    rs = np.random.RandomState(2)
+    prompt = _prompt(rs, 6)
+    sigs = {}
+    for streaming in (True, False):
+        if streaming:
+            monkeypatch.setattr(
+                pk, "_PAGED_RESIDENT_VMEM",
+                pk._paged_row_vmem(CFG.n_head, 1, 4, HD, 4))
+        else:
+            monkeypatch.setattr(pk, "_PAGED_RESIDENT_VMEM",
+                                12 * 1024 * 1024)
+        with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                             prefill_chunk=4, recompile_limit=2,
+                             spec_mode="ngram", spec_len=2,
+                             fused_attn=True) as srv:
+            assert srv.metrics()["paged"]["fused_formulation"] == \
+                ("streaming" if streaming else "resident")
+            srv.result(srv.submit(np.concatenate([prompt, prompt]),
+                                  max_tokens=4), timeout=300)
+            eng = srv._engine
+            sigs[streaming] = (eng.prefill_signatures,
+                               eng.tick_signatures,
+                               eng.verify_signatures)
+    assert sigs[True] == sigs[False], sigs
+    for group in sigs[True]:
+        for s in group:
+            assert "stream" not in s and "resident" not in s, s
+
+
+def test_streaming_audit_fully_aliased_and_clip_folded(monkeypatch):
+    """cxn-lint pass 2 on the STREAMING engine: pool donation aliasing
+    end to end and every index clip folded (CXN208), exactly like the
+    resident programs."""
+    from cxxnet_tpu.analysis import audit_serve_engine
+    _force_streaming(monkeypatch)
+    eng = DecodeEngine(CFG, PARAMS, slots=2, prefill_chunk=4,
+                       num_blocks=30, spec_len=2, abstract=True,
+                       fused_attn=True)
+    assert eng.fused_formulation == "streaming"
+    report, infos = audit_serve_engine(eng, donate=True)
+    assert report.ok(), report.format()
+    for info in infos:
+        if info["label"] in ("serve_verify_chunk", "serve_tick"):
+            assert info["donated"] == 2 and info["aliased"] == 2, info
+            assert info["entry_clamps"] == 0, info
+
+
+# ------------------------------------------------ fallback observability
+def test_fallback_reason_counted_once(monkeypatch):
+    """An unsupported fused request resolves gather, logs its reason
+    through the profiler ONCE per process, and counts every resolution
+    in cxn_fused_fallback_total{reason=}."""
+    import cxxnet_tpu.serve.engine as eng_mod
+    monkeypatch.setattr(pk, "_INTERPRET", False)    # CPU: backend gate
+    monkeypatch.setattr(eng_mod, "_FALLBACK_LOGGED", set())
+    logged = []
+    from cxxnet_tpu.utils import profiler
+    monkeypatch.setattr(profiler, "log",
+                        lambda msg, *a, **k: logged.append(msg))
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                         prefill_chunk=4, fused_attn=True) as srv:
+        assert srv.metrics()["paged"]["fused_attn"] is False
+        snap = srv.registry.snapshot()
+    key = 'cxn_fused_fallback_total{reason="backend"}'
+    assert snap.get(key) == 1, snap
+    hits = [m for m in logged if "fused paged attention unavailable" in m]
+    assert len(hits) == 1 and "reason=backend" in hits[0]
+    # second engine, same process: counted again, logged never again
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                         prefill_chunk=4, fused_attn=True) as srv:
+        snap = srv.registry.snapshot()
+    assert snap.get(key) == 1         # per-server registry: one build
+    hits = [m for m in logged if "fused paged attention unavailable" in m]
+    assert len(hits) == 1

@@ -19,7 +19,16 @@ tick set under ``serve_paged=0`` — the programs ``task=serve`` runs,
 with the block pool's donation aliasing pinned. Quantized configs
 (``serve_int8_weights=1`` / ``serve_kv_dtype=int8``) audit the int8
 variants themselves: aliasing on every (values, scales) leaf, plus the
-CXN209 no-silent-f32-promotion check on bf16 compute. Every
+CXN209 no-silent-f32-promotion check on bf16 compute. Under
+``serve_tp=N`` the audit builds the model-axis mesh and audits the
+PARTITIONED executables — including the shard_map-wrapped fused
+paged-attention programs (armed in Pallas interpret mode off-TPU when
+the LOCAL head slice's geometry would resolve fused on a real TPU),
+so donation aliasing, the zero-all-reduce decode contract, and the
+CXN208 clip-fold are pinned for the programs a sharded ``task=serve``
+actually runs. A ``serve_block_size=auto`` config resolves through
+the tuned-geometry winner (``aot_cache=DIR`` / ``CXN_AOT_CACHE``)
+exactly as the production server would before sizing the pool. Every
 audited step's line now reports its AOT lower+compile seconds, and
 ``lint_compile_budget_s=<s>`` turns that into a CI gate: any step
 compiling over the budget fails the lint with CXN207, so compile-time
@@ -83,35 +92,6 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             # mirrors the config's serving mode — paged by default, so
             # the audited programs (block-table gather/scatter, pool
             # donation aliasing) are the ones task=serve actually runs.
-            nb = 0
-            if task.serve_paged and task.serve_prefill_chunk > 0:
-                nb = (task.serve_num_blocks or auto_num_blocks(
-                    gcfg, task.serve_slots, task.serve_prefill_chunk,
-                    block_size=task.serve_block_size,
-                    prefix_mb=task.serve_prefix_mb,
-                    kv_mb=task.serve_kv_mb,
-                    kv_dtype=task.serve_kv_dtype))
-            # fused-attention audit off-TPU: the production default is
-            # the fused Pallas tick/verify, but the kernel only
-            # compiles on TPU backends — arm interpret mode for the
-            # audit so CI (the CPU mesh) still AOT-lowers and pins THE
-            # FUSED programs' donation aliasing, not a gather stand-in.
-            # Only for geometries a real TPU would resolve FUSED,
-            # though: interpret mode waives the kernel's geometry
-            # limits, and auditing a fused program production would
-            # fall back from pins the wrong executable.
-            import jax as _jax
-            from cxxnet_tpu.ops import pallas_kernels as _pk
-            geom_ok = False
-            if nb > 0:
-                from cxxnet_tpu.serve.engine import _paged_geometry
-                _, bs_, _, bpr_, _ = _paged_geometry(
-                    gcfg, task.serve_prefill_chunk,
-                    task.serve_block_size)
-                geom_ok = _pk.paged_attention_geometry_ok(
-                    gcfg.n_head, bpr_, bs_,
-                    gcfg.feat // gcfg.n_head,
-                    2 if gcfg.dtype == "bfloat16" else 4)
             # TP-sharded serve audit (serve_tp > 1): build the model-
             # axis mesh over the local devices and audit the PARTITIONED
             # executables — real mesh shardings on the abstract inputs,
@@ -120,6 +100,7 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             # XLA_FLAGS=--xla_force_host_platform_device_count=<N>
             # before invoking this tool (tests/conftest.py does the
             # same for the suite).
+            import jax as _jax
             tp = int(getattr(task, "serve_tp", 0) or 0)
             mesh = None
             if tp > 1:
@@ -133,10 +114,55 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     return 2
                 from cxxnet_tpu.parallel.mesh import make_mesh
                 mesh = make_mesh(devices=devs[:tp], model_parallel=tp)
-            # fused attention cannot be audited under TP (the Pallas
-            # kernel is a custom call GSPMD cannot partition; the
-            # engine pins the gather fallback there — serve/engine.py)
-            arm = bool(geom_ok and task.serve_fused_attn and tp <= 1
+            # serve_block_size=auto (-1): resolve through the tuned-
+            # geometry winner exactly as the production server would,
+            # so the audited executables carry the geometry a warm
+            # startup actually builds (miss -> chunk default, 0)
+            aot_dir = getattr(task, "aot_cache", "") \
+                or os.environ.get("CXN_AOT_CACHE", "")
+            serve_bs = int(task.serve_block_size)
+            if serve_bs < 0 and task.serve_paged \
+                    and task.serve_prefill_chunk > 0:
+                from cxxnet_tpu.serve.engine import resolve_block_size
+                serve_bs = resolve_block_size(
+                    gcfg, task.serve_prefill_chunk, serve_bs,
+                    kv_dtype=task.serve_kv_dtype, tp=max(1, tp),
+                    aot=aot_dir or None)
+            nb = 0
+            if task.serve_paged and task.serve_prefill_chunk > 0:
+                nb = (task.serve_num_blocks or auto_num_blocks(
+                    gcfg, task.serve_slots, task.serve_prefill_chunk,
+                    block_size=serve_bs,
+                    prefix_mb=task.serve_prefix_mb,
+                    kv_mb=task.serve_kv_mb,
+                    kv_dtype=task.serve_kv_dtype))
+            # fused-attention audit off-TPU: the production default is
+            # the fused Pallas tick/verify, but the kernel only
+            # compiles on TPU backends — arm interpret mode for the
+            # audit so CI (the CPU mesh) still AOT-lowers and pins THE
+            # FUSED programs' donation aliasing, not a gather stand-in.
+            # Only for geometries a real TPU would resolve fused
+            # (resident OR streaming), though: interpret mode waives
+            # the kernel's geometry limits, and auditing a fused
+            # program production would fall back from pins the wrong
+            # executable. Under TP the gate reads the LOCAL head slice
+            # (n_head // tp) — the shard_map-wrapped kernel audits the
+            # same way the sharded engine resolves it.
+            from cxxnet_tpu.ops import pallas_kernels as _pk
+            geom_ok = False
+            if nb > 0:
+                from cxxnet_tpu.serve.engine import _paged_geometry
+                _, bs_, _, bpr_, _ = _paged_geometry(
+                    gcfg, task.serve_prefill_chunk, serve_bs)
+                itemsize = 1 if task.serve_kv_dtype == "int8" \
+                    else (2 if gcfg.dtype == "bfloat16" else 4)
+                lheads = gcfg.n_head // max(1, tp)
+                hd = gcfg.feat // gcfg.n_head
+                geom_ok = (_pk.paged_attention_geometry_ok(
+                               lheads, bpr_, bs_, hd, itemsize)
+                           or _pk.paged_attention_streaming_ok(
+                               lheads, bpr_, bs_, hd, itemsize))
+            arm = bool(geom_ok and task.serve_fused_attn
                        and os.environ.get("CXN_FUSED_ATTN", "1") != "0"
                        and _jax.default_backend() != "tpu"
                        and not _pk._INTERPRET)
@@ -157,7 +183,7 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                    prefill_chunk=task.serve_prefill_chunk,
                                    abstract=True,
                                    num_blocks=nb,
-                                   block_size=task.serve_block_size,
+                                   block_size=serve_bs,
                                    spec_len=(task.spec_len
                                              if task.spec_mode != "off"
                                              else 0),
@@ -192,8 +218,6 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             # auto-sized pool) and production fused/gather resolution
             # (no interpret arming: the artifacts were written by the
             # real backend's resolution), so its keys are the server's.
-            aot_dir = getattr(task, "aot_cache", "") \
-                or os.environ.get("CXN_AOT_CACHE", "")
             if aot_dir:
                 from cxxnet_tpu.analysis.step_audit import \
                     audit_aot_artifacts
@@ -201,7 +225,7 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     gcfg, gparams, slots=task.serve_slots,
                     prefill_chunk=task.serve_prefill_chunk,
                     abstract=True, num_blocks=nb,
-                    block_size=task.serve_block_size,
+                    block_size=serve_bs,
                     spec_len=(task.spec_len if task.spec_mode != "off"
                               else 0),
                     fused_attn=bool(task.serve_fused_attn), mesh=mesh,

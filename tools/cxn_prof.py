@@ -61,6 +61,17 @@ _DEFAULT_CELL_TOL = {
     #                                         tokens/sec unit (regresses
     #                                         DOWN), band matches the
     #                                         other serve trace cells
+    "serve_tokens_per_sec_longctx": 0.25,   # same open-loop trace
+    #                                         spread as the fused cell
+    #                                         (streaming vs gather arms)
+    "autotune_wall_ms": 0.50,               # a compile-and-time sweep
+    #                                         on a shared CI core: wall
+    #                                         noise like lint_wall_ms
+    #                                         (the ms unit regresses UP)
+    "serve_tokens_per_sec_tuned": 0.30,     # tiny-geometry trace cell
+    #                                         like the tp2/replicated
+    #                                         ones: dispatch-bound on
+    #                                         CPU
     "serve_tokens_per_mib": 0.20,
     "serve_tokens_per_mib_int8": 0.30,      # preempt/swap-regime trace
     #                                         (the bf16 arm thrashes by
