@@ -105,6 +105,20 @@ Prints one JSON line per metric, in this order:
                                      vs_baseline = router / single
                                      completed fraction — the
                                      availability headline, round 17)
+ 12a5f. serve_tokens_per_sec_fleet  (cross-process fleet: 1 prefill +
+                                     2 decode worker processes behind
+                                     the RPC router, KV records
+                                     migrating over sockets;
+                                     vs_baseline = fleet / in-process
+                                     2-replica router — the wire tax
+                                     on shared cores, round 18)
+ 12a5g. serve_goodput_fleet_kill    (completed-request fraction with a
+                                     decode worker SIGKILLed
+                                     mid-trace: the fleet router
+                                     replays the dead worker's journal
+                                     on the survivor; vs_baseline =
+                                     fleet / single chaos-killed
+                                     engine, round 18)
  12a6. serve_goodput_guaranteed_overload (multi-tenant SLO cell: a
                                      3x-overload Poisson trace with a
                                      G/S/B tenant mix — the guaranteed
@@ -1261,6 +1275,125 @@ def bench_serve_replicated():
          single_goodput=round(g_single, 3))
 
 
+def bench_serve_fleet():
+    """Cross-process fleet cell (doc/serving.md "Disaggregated
+    fleet"): the REPL_CELL trace served by the in-process 2-replica
+    router vs a 1-prefill + 2-decode worker-process fleet behind the
+    RPC router — every request chunk-prefills on the prefill tier and
+    its checksummed KV record migrates over a socket to a decode
+    worker. Emits ``serve_tokens_per_sec_fleet`` (vs_baseline = fleet
+    / in-process router — the socket+pickle tax on shared cores; the
+    disaggregation win needs separate hosts) and
+    ``serve_goodput_fleet_kill``: completed-request fraction with a
+    decode worker SIGKILLed mid-trace — the router replays the dead
+    worker's requests from its journal on the survivor (vs_baseline =
+    fleet / single engine chaos-killed with restart budget 0, the
+    same outage the replicated cell baselines against)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        emit("serve_tokens_per_sec_fleet", 0.0, "tokens/sec",
+             skipped="fleet cell is CPU-host only (worker processes "
+                     "cannot share one accelerator)")
+        return
+    from cxxnet_tpu.serve import (EngineFailedError, FleetRouter,
+                                  InferenceServer, QueueFullError)
+
+    c, cfg, params = _repl_model()
+    trace = _repl_trace(c)
+    kw = dict(slots=c["slots"], queue=c["n_requests"],
+              prefill_chunk=c["chunk"])
+    wenv = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    aot = tempfile.mkdtemp(prefix="cxn-fleet-bench-aot")
+    try:
+        wall_r, mr = run_serve_trace(cfg, params, trace, replicas=2,
+                                     **kw)
+        tps_r = mr["tokens_generated"] / wall_r
+
+        def fleet_pass(r):
+            # warm pass fills every worker's caches and compiles (or
+            # AOT-loads) every program; the timed pass is steady state
+            for h in [r.submit(p, max_tokens=m) for _, p, m in trace]:
+                r.result(h, timeout=600)
+            t0 = time.perf_counter()
+            handles = []
+            for gap, p, m in trace:             # open loop
+                time.sleep(gap)
+                handles.append(r.submit(p, max_tokens=m))
+            toks = 0
+            for (_, p, m), h in zip(trace, handles):
+                res = r.result(h, timeout=600)
+                if res.status == "ok":          # tokens = full seq
+                    toks += len(res.tokens) - len(p)
+            return time.perf_counter() - t0, toks
+
+        with FleetRouter(cfg, params, prefill=1, decode=2,
+                         worker_env=wenv, aot_cache=aot, **kw) as r:
+            wall_f, toks_f = fleet_pass(r)
+            mig = r.metrics()["fleet"]
+        tps_f = toks_f / wall_f
+        emit("serve_tokens_per_sec_fleet", tps_f, "tokens/sec",
+             tps_f / max(tps_r, 1e-9),
+             router_tokens_per_sec=round(tps_r, 1),
+             migrations=mig["migrations"],
+             kv_wire_bytes=mig["kv_wire_bytes"])
+
+        # availability: SIGKILL a decode worker after ~40% of the
+        # trace is in; the journal replays its requests on the
+        # survivor while a replacement respawns
+        def goodput_single():
+            srv = InferenceServer(cfg, params, chaos="tick_raise@40",
+                                  max_restarts=0, **kw)
+            ok, handles = 0, []
+            try:
+                for gap, p, m in trace:
+                    time.sleep(gap)
+                    try:
+                        handles.append(srv.submit(p, max_tokens=m))
+                    except (EngineFailedError, QueueFullError):
+                        pass
+                for h in handles:
+                    if srv.result(h, timeout=600).status == "ok":
+                        ok += 1
+            finally:
+                srv.shutdown(drain=False)
+            return ok / float(len(trace))
+
+        g_single = goodput_single()
+        ok = 0
+        with FleetRouter(cfg, params, prefill=1, decode=2,
+                         worker_env=wenv, aot_cache=aot,
+                         heartbeat_s=0.5, **kw) as r:
+            handles = []
+            for gap, p, m in trace:
+                time.sleep(gap)
+                handles.append(r.submit(p, max_tokens=m))
+            # kill once ~40% of the results are in: the victim is
+            # mid-decode on live streams, not idling through the
+            # submission burst
+            killed = False
+            for i, h in enumerate(handles):
+                if r.result(h, timeout=600).status == "ok":
+                    ok += 1
+                if not killed and i >= int(0.4 * len(handles)):
+                    victims = r._live("decode")
+                    if victims:
+                        victims[0].proc.kill()
+                    killed = True
+            mk = r.metrics()["fleet"]
+        g_fleet = ok / float(len(trace))
+        emit("serve_goodput_fleet_kill", g_fleet, "fraction",
+             g_fleet / max(g_single, 1e-9),
+             single_goodput=round(g_single, 3),
+             replays=mk["replays"], restarts=mk["restarts"])
+    finally:
+        shutil.rmtree(aot, ignore_errors=True)
+
+
 def bench_serve_tenanted():
     """Multi-tenant SLO cell (doc/serving.md "Multi-tenant SLOs"): a
     3x-overload Poisson trace with a guaranteed / standard /
@@ -1590,7 +1723,8 @@ def main() -> int:
                bench_serve_prefill_heavy, bench_serve_paged,
                bench_serve_fused, bench_serve_longctx,
                bench_serve_autotune, bench_serve_int8, bench_serve_sharded,
-               bench_serve_replicated, bench_serve_tenanted,
+               bench_serve_replicated, bench_serve_fleet,
+               bench_serve_tenanted,
                bench_serve_spec, bench_serve_cold_start,
                bench_obs_overhead, bench_lint):
         try:

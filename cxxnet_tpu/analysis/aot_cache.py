@@ -78,7 +78,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = ["AotCache", "CachedProgram", "ResolvedProgram", "get_cache",
            "active", "configure", "config_hash", "signature_string",
            "devices_string", "mesh_tag", "tuned_components",
-           "METRIC_NAMES"]
+           "configure_relabel", "relabel_active", "METRIC_NAMES"]
 
 METRIC_NAMES = (
     ("cxn_aot_cache_hits_total",
@@ -161,7 +161,15 @@ def devices_string(args: tuple = (), mesh=None) -> str:
     """Device ids + device kind the program binds to: the mesh's devices
     when given, else the union of the args' committed placements, else
     the default device. Serialized executables embed their device
-    assignment, so two placements are two artifacts."""
+    assignment, so two placements are two artifacts — UNLESS device
+    relabeling is armed (:func:`configure_relabel` / CXN_AOT_RELABEL):
+    then the ids are rewritten positionally (0..n-1, count and kind
+    preserved), so every identically-shaped replica device block of a
+    fleet tier shares ONE persisted artifact instead of compiling and
+    storing per block. Only safe when the blocks really are
+    interchangeable — the serving fleet's replica workers, each seeing
+    its own local devices — which is why it is opt-in, never the
+    default."""
     import jax
     ids, kind = set(), ""
     devs = []
@@ -178,7 +186,28 @@ def devices_string(args: tuple = (), mesh=None) -> str:
     for d in devs:
         ids.add(int(d.id))
         kind = getattr(d, "device_kind", kind) or kind
+    if relabel_active():
+        ids = range(len(ids))
     return "%s:%s" % (",".join(str(i) for i in sorted(ids)), kind)
+
+
+# device-relabeling module flag: None = follow the CXN_AOT_RELABEL env
+# (how fleet worker processes arm it); configure_relabel() overrides
+# in-process (tests, embedders). Off by default — the pinned no-op.
+_relabel: Optional[bool] = None
+
+
+def configure_relabel(on: Optional[bool]) -> None:
+    """Force device relabeling on/off for this process; ``None``
+    returns control to the ``CXN_AOT_RELABEL`` environment switch."""
+    global _relabel
+    _relabel = None if on is None else bool(on)
+
+
+def relabel_active() -> bool:
+    if _relabel is not None:
+        return _relabel
+    return os.environ.get("CXN_AOT_RELABEL", "") not in ("", "0")
 
 
 def tuned_components(config: str, chunk: int, kv_dtype: str = "",

@@ -150,6 +150,22 @@ class LearnTask:
         #                           (longest prefix-affinity match,
         #                           load breaks ties) or "rr"
         #                           (round-robin)
+        self.serve_fleet = ""     # task=serve: CROSS-PROCESS fleet tier
+        #                           spec, "prefill=N,decode=M" (or a bare
+        #                           worker count = decode-only replica
+        #                           pool); "" = in-process serving.
+        #                           Spawns worker processes behind the
+        #                           RPC router (serve/fleet.py)
+        self.aot_relabel = -1     # AOT executable device relabeling:
+        #                           1 = key executables on positional
+        #                           device ids so one persisted artifact
+        #                           serves every replica worker of a
+        #                           tier; 0 = off; -1 = auto (on for
+        #                           fleet workers when aot_cache is set)
+        self.fleet_spec = ""      # task=fleet-worker: path of the
+        #                           pickled worker spec the router wrote
+        self.fleet_tier = ""      # task=fleet-worker: tier name whose
+        #                           per-tier kwargs overlay server_kw
         self.serve_degrade = 1    # graceful-degradation ladder: under
         #                           sustained overload disable spec ->
         #                           stop prefix admission -> shed
@@ -320,6 +336,14 @@ class LearnTask:
             self.serve_replicas = int(val)
         elif name == "serve_router":
             self.serve_router = val
+        elif name == "serve_fleet":
+            self.serve_fleet = val
+        elif name == "aot_relabel":
+            self.aot_relabel = int(val)
+        elif name == "fleet_spec":
+            self.fleet_spec = val
+        elif name == "fleet_tier":
+            self.fleet_tier = val
         elif name == "spec_mode":
             self.spec_mode = val
         elif name == "spec_len":
@@ -386,6 +410,14 @@ class LearnTask:
             # `lint_compile = 1` additionally builds the net and audits
             # the compiled steps (pass 2)
             return self.task_lint(argv[0], cli_overrides)
+        if self.task == "fleet-worker":
+            # serving-fleet worker process (serve/fleet.py): the pickled
+            # spec carries config + host params + server kwargs, so no
+            # netconfig / data plumbing is built here
+            if not self.fleet_spec:
+                raise ValueError("task=fleet-worker needs fleet_spec=")
+            from .serve.fleet import worker_main
+            return worker_main(self.fleet_spec, self.fleet_tier)
         lint_level = int(os.environ.get("CXN_LINT", "0") or 0)
         if lint_level:
             # runtime hook: graph/config lint before anything is built,
@@ -1217,8 +1249,21 @@ class LearnTask:
                          tp=self.serve_tp,
                          tenants=self.serve_tenants,
                          aot_cache=self.aot_cache)
-        routed = self.serve_replicas > 1
-        if routed:
+        fleet = bool(self.serve_fleet.strip())
+        routed = self.serve_replicas > 1 and not fleet
+        if fleet:
+            # cross-process fleet: disaggregated prefill/decode worker
+            # processes behind the out-of-process RPC router — same
+            # stdin/stdout contract; KV rows migrate between tiers over
+            # checksummed sockets (serve/fleet.py)
+            from .serve import FleetRouter, parse_tiers
+            tiers = parse_tiers(self.serve_fleet)
+            srv = FleetRouter(cfg, params, prefill=tiers["prefill"],
+                              decode=tiers["decode"],
+                              aot_relabel=(None if self.aot_relabel < 0
+                                           else bool(self.aot_relabel)),
+                              **server_kw)
+        elif routed:
             # replicated serving: N engines behind the prefix- and
             # health-aware router — same stdin/stdout contract, requests
             # spread (and failed over) across replicas (serve/router.py)
@@ -1228,7 +1273,16 @@ class LearnTask:
                               policy=self.serve_router, **server_kw)
         else:
             srv = InferenceServer(cfg, params, **server_kw)
-        if not self.silent:
+        if fleet and not self.silent:
+            profiler.log(
+                "serving: cross-process fleet, %d prefill + %d decode "
+                "workers, %d slots/worker, queue %d%s (one prompt per "
+                "line; EOF drains and exits)"
+                % (tiers["prefill"], tiers["decode"], self.serve_slots,
+                   self.serve_queue,
+                   ", aot cache " + self.aot_cache
+                   if self.aot_cache else ""))
+        if not self.silent and not fleet:
             if self.serve_prefill_chunk > 0:
                 mode = "prefill chunk %d, prefix cache %s" % (
                     self.serve_prefill_chunk,
@@ -1390,6 +1444,19 @@ class LearnTask:
                 feed.notify()
             out_thread.join()
             m = srv.metrics()
+            if fleet and not self.silent:
+                fl = m["fleet"]
+                profiler.log(
+                    "serve: %d ok / %d timeout / %d rejected over %d "
+                    "worker(s) (%d prefill + %d decode); %d "
+                    "migration(s), %d KV wire bytes, %d replay(s), %d "
+                    "restart(s); %d tokens"
+                    % (m["requests"]["completed"],
+                       m["requests"]["timeout"],
+                       m["requests"]["rejected"], fl["live"],
+                       fl["prefill"], fl["decode"], fl["migrations"],
+                       fl["kv_wire_bytes"], fl["replays"],
+                       fl["restarts"], m["tokens_generated"]))
             if routed and not self.silent:
                 # aggregate summary: the per-replica detail lives in the
                 # merged scrape payload (metrics_text)
@@ -1405,7 +1472,7 @@ class LearnTask:
                                 self.serve_replicas, m["routed"],
                                 m["affinity_hits"], m["failovers"],
                                 p95s, m["tokens_generated"]))
-            if not routed and not self.silent:
+            if not routed and not fleet and not self.silent:
                 # gauge text follows the serving mode, so a legacy run
                 # reads "prefix cache off" instead of a misleading
                 # "prefix hit 0%" (disabled, not ineffective)

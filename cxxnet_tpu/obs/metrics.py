@@ -41,7 +41,8 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
-           "BYTES_BUCKETS", "default_registry", "merged_prometheus"]
+           "BYTES_BUCKETS", "default_registry", "merged_prometheus",
+           "registry_state", "registry_from_state"]
 
 
 def _log_spaced(lo: float, hi: float, per_decade: int) -> Tuple[float, ...]:
@@ -470,6 +471,66 @@ class Registry:
                                 "p95": child.percentile(0.95),
                                 "p99": child.percentile(0.99)}
         return out
+
+
+def registry_state(reg: Registry) -> Dict:
+    """One registry as a plain picklable dict — the fleet's scrape
+    transport (serve/fleet.py): a worker process serializes its live
+    registry here, ships it over RPC, and the router rebuilds a real
+    Registry with :func:`registry_from_state` so ONE
+    :func:`merged_prometheus` call aggregates the whole fleet exactly
+    like it aggregates in-process replicas. Callback-backed children
+    are evaluated NOW (the provider lives in the worker; only its
+    current value can travel)."""
+    fams = []
+    with reg._lock:
+        objs = [reg._families[n] for n in sorted(reg._families)]
+    for fam in objs:
+        children = []
+        for values, child in fam.children():
+            if fam.kind == "histogram":
+                counts, s, c = child._snapshot()
+                children.append((values, {"counts": counts, "sum": s,
+                                          "count": c}))
+            else:
+                children.append((values, float(child.value)))
+        fams.append({"name": fam.name, "help": fam.help,
+                     "kind": fam.kind, "labelnames": fam.labelnames,
+                     "buckets": (child.buckets
+                                 if fam.kind == "histogram" else None),
+                     "children": children})
+    return {"families": fams}
+
+
+def registry_from_state(state: Dict) -> Registry:
+    """Rebuild a Registry from :func:`registry_state` output. The
+    result is a plain value snapshot (no callbacks) with the same
+    names, kinds, labels, and bucket geometry — exactly what
+    :func:`merged_prometheus` needs from each fleet worker."""
+    reg = Registry()
+    for f in state.get("families", []):
+        kind, lnames = f["kind"], tuple(f["labelnames"])
+        if kind == "histogram":
+            fam = reg._register(f["name"], f["help"], kind, lnames,
+                                lambda b=tuple(f["buckets"]):
+                                Histogram(b),
+                                buckets=tuple(f["buckets"]))
+        else:
+            make = Counter if kind == "counter" else Gauge
+            fam = reg._register(f["name"], f["help"], kind, lnames,
+                                make)
+        for values, v in f["children"]:
+            child = fam.labels(*values) if lnames else fam.default
+            if kind == "histogram":
+                child._counts = list(v["counts"])
+                child._sum = float(v["sum"])
+                child._count = int(v["count"])
+            else:
+                # direct assignment, not inc()/set(): a dead worker
+                # callback can have produced NaN, and a counter's
+                # guard rails should not reject an honest snapshot
+                child._value = float(v)
+    return reg
 
 
 def merged_prometheus(registries: Dict[str, Registry],

@@ -13,7 +13,10 @@ Surfaces: ``InferenceServer`` (programmatic), ``wrapper.Net.serve_*``
 ``serve_tp`` shards one engine over a model-axis mesh (gather-form TP,
 bit-identical tokens — engine.py module docstring), and ``ServeRouter``
 (router.py) runs N engine replicas behind one prefix- and health-aware
-submit API with replay-based failover and merged metrics.
+submit API with replay-based failover and merged metrics. Cross-process:
+``FleetRouter`` (fleet.py) spawns disaggregated prefill/decode worker
+processes behind the binary RPC of rpc.py, migrating KV rows between
+tiers over checksummed sockets with journal-replay failover.
 """
 
 from .engine import (DecodeEngine, assert_fused_allclose, auto_num_blocks,
@@ -23,7 +26,9 @@ from .prefix_cache import PagedPrefixCache, PrefixCache
 from .resilience import (DegradationLadder, EngineFailedError,
                          FaultInjector, InjectedFault,
                          SwapCorruptionError)
+from .fleet import FleetRouter, parse_tiers
 from .router import RouterHandle, ServeRouter
+from .rpc import FrameError, RpcError, WorkerLostError
 from .scheduler import Request, SamplingParams, SlotScheduler
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      QuotaExceededError, ServeResult)
@@ -40,4 +45,5 @@ __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "SpeculativeDecoder", "FaultInjector", "DegradationLadder",
            "InjectedFault", "SwapCorruptionError", "EngineFailedError",
            "ServeRouter", "RouterHandle", "TenantPolicy",
-           "TenantRegistry", "TokenBucket"]
+           "TenantRegistry", "TokenBucket", "FleetRouter",
+           "parse_tiers", "FrameError", "RpcError", "WorkerLostError"]

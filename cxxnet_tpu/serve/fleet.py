@@ -1,0 +1,947 @@
+"""Cross-process serving fleet: disaggregated prefill/decode tiers
+behind an out-of-process RPC router.
+
+``serve_replicas=M`` (serve/router.py) is M engines as *threads* in one
+process — one GIL, one failure domain, one host. This module is the
+same serving contract over *processes*: a :class:`FleetRouter` spawns N
+worker processes (each hosting one :class:`InferenceServer` over its
+own device block), talks to them over the length-prefixed binary RPC of
+serve/rpc.py, and splits them into two tiers:
+
+* **prefill tier** — runs chunked prefill (prefix cache included);
+  every request is submitted with ``migrate=True``, so the scheduler
+  parks the just-prefilled row as a swap record (``_migrate_out``)
+  instead of decoding it;
+* **decode tier** — adopts the migrated rows: the router moves the
+  crc32-checksummed engine swap record (serve/paged.py
+  ``swap_out_row``/``swap_in_row`` — int8 KV stored representation
+  included) over the socket, and the decode worker's scheduler resumes
+  it through the exact host-RAM preemption path. The checksum verifies
+  the wire round trip bit-exactly; a corrupted payload fails typed
+  (``SwapCorruptionError``) and replays only that request.
+
+Failure domains are real here: the ROUTER owns the ``ReplayJournal``
+(serve/resilience.py), so a SIGKILL'd worker's in-flight requests are
+rewound (``rewind_request`` — the same contract the in-process router
+uses) and re-adopted on a survivor, bit-identically for greedy streams
+and distribution-identically for sampled ones. A replacement worker is
+spawned in the background; with a shared AOT executable cache and
+device relabeling armed (analysis/aot_cache.py, ``CXN_AOT_RELABEL``)
+it loads every serve program instead of compiling — near-free spin-up.
+
+The in-process ``ServeRouter`` remains the single-host fast path and
+the oracle the fleet is pinned against (tests/test_fleet.py). With
+``serve_fleet`` unset nothing in this module runs: no process, no
+thread, no socket.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from .resilience import (EngineFailedError, ReplayJournal,
+                         reset_for_replay)
+from .router import rewind_request
+from .rpc import RpcClient, RpcError, RpcServer, WorkerLostError
+from .scheduler import Request, SamplingParams
+from .server import (AdmissionError, QueueFullError, QuotaExceededError,
+                     ServeResult)
+
+__all__ = ["FleetRouter", "FleetWorker", "WorkerLostError",
+           "worker_main", "parse_tiers", "request_to_wire",
+           "request_from_wire", "record_to_wire", "record_from_wire"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+READY_SENTINEL = "CXN_FLEET_READY"
+
+
+def parse_tiers(spec: str) -> Dict[str, int]:
+    """Parse a ``serve_fleet`` tier spec — ``"prefill=1,decode=2"`` —
+    into ``{"prefill": n, "decode": m}``. A bare integer means that
+    many decode workers with no prefill tier (no migration: a plain
+    cross-process replica fleet)."""
+    spec = (spec or "").strip()
+    out = {"prefill": 0, "decode": 0}
+    if not spec:
+        return out
+    if spec.isdigit():
+        out["decode"] = int(spec)
+        return out
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        tier, sep, n = item.partition("=")
+        tier = tier.strip()
+        if not sep or tier not in out:
+            raise ValueError(
+                "serve_fleet: malformed tier spec %r (want e.g. "
+                "'prefill=1,decode=2')" % (item,))
+        out[tier] = int(n)
+    return out
+
+
+# ----------------------------------------------------------- wire forms
+def request_to_wire(req: Request) -> dict:
+    return {"rid": req.rid,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "params": dataclasses.asdict(req.params),
+            "tenant": req.tenant,
+            "tokens": list(req.tokens),
+            "replay_expect": (None if req.replay_expect is None
+                              else list(req.replay_expect))}
+
+
+def request_from_wire(d: dict) -> Request:
+    req = Request(int(d["rid"]), np.asarray(d["prompt"], np.int32),
+                  SamplingParams(**d["params"]), time.perf_counter(),
+                  tenant=d.get("tenant", ""))
+    req.tokens = list(d.get("tokens", ()))
+    exp = d.get("replay_expect")
+    req.replay_expect = None if exp is None else list(exp)
+    return req
+
+
+_REC_KEYS = ("key", "phase", "tok", "pos", "fold", "spec", "charge",
+             "k", "v", "ks", "vs", "n", "nbytes", "crc")
+
+
+def record_to_wire(rec: dict) -> dict:
+    d = {k: rec[k] for k in _REC_KEYS if k in rec}
+    d["req"] = request_to_wire(rec["req"])
+    return d
+
+
+def record_from_wire(d: dict):
+    rec = dict(d)
+    req = request_from_wire(rec.pop("req"))
+    # rebase the lifecycle clock: perf_counter values don't compare
+    # across processes, and the resume path orders by admit_t
+    now = time.perf_counter()
+    req.submit_t = req.admit_t = req.first_token_t = now
+    req.deadline = None         # already admitted once (replay contract)
+    return req, rec
+
+
+def result_to_wire(res: ServeResult) -> dict:
+    return {"status": res.status,
+            "tokens": np.asarray(res.tokens, np.int32),
+            "error": res.error, "ttft_ms": res.ttft_ms,
+            "ms_per_token": res.ms_per_token, "queue_ms": res.queue_ms,
+            "retry_after_ms": res.retry_after_ms}
+
+
+def result_from_wire(d: dict) -> ServeResult:
+    return ServeResult(d["status"], np.asarray(d["tokens"], np.int32),
+                       error=d.get("error", ""),
+                       ttft_ms=d.get("ttft_ms", 0.0),
+                       ms_per_token=d.get("ms_per_token", 0.0),
+                       queue_ms=d.get("queue_ms", 0.0),
+                       retry_after_ms=d.get("retry_after_ms", 0.0))
+
+
+# typed remote exceptions revived locally: the fleet keeps the single
+# server's admission contract — a queue-full worker raises
+# QueueFullError (back-off hint included) through the socket
+def _revive(e: RpcError) -> BaseException:
+    p = e.payload
+    msg = p.get("msg", str(e))
+    t = e.remote_type
+    if t == "QueueFullError":
+        return QueueFullError(msg,
+                              retry_after_ms=p.get("retry_after_ms", 0.0))
+    if t == "QuotaExceededError":
+        return QuotaExceededError(msg,
+                                  retry_after_ms=p.get("retry_after_ms",
+                                                       0.0),
+                                  tenant=p.get("tenant", ""),
+                                  kind=p.get("kind", ""))
+    if t == "AdmissionError":
+        return AdmissionError(msg)
+    if t == "EngineFailedError":
+        return EngineFailedError(msg)
+    if t == "TimeoutError":
+        return TimeoutError(msg)
+    return e
+
+
+# ------------------------------------------------------- worker process
+class FleetWorker:
+    """The worker-process side: one InferenceServer behind the RPC verb
+    surface. ``handle(verb, payload)`` is the RpcServer handler;
+    requests are tracked by the ROUTER's rid (the ``rid=`` submit hook),
+    so the cross-process journal and failover accounting share one key
+    space."""
+
+    def __init__(self, server):
+        self.server = server
+        self._handles: Dict[int, Request] = {}
+        self._lock = threading.Lock()
+        self.shutdown_event = threading.Event()
+        self.spinup_info: dict = {}
+
+    # every verb below runs on its own RpcServer dispatch thread
+    def handle(self, verb: str, p: dict):
+        fn = getattr(self, "verb_" + verb, None)
+        if fn is None:
+            raise AdmissionError("unknown fleet verb %r" % verb)
+        return fn(**p)
+
+    def _req(self, rid: int) -> Request:
+        with self._lock:
+            req = self._handles.get(rid)
+        if req is None:
+            raise AdmissionError("unknown request id %d on this worker"
+                                 % rid)
+        return req
+
+    def verb_ping(self):
+        return True
+
+    def verb_health(self):
+        h = dict(self.server.health())
+        h["pid"] = os.getpid()
+        return h
+
+    def verb_spinup(self):
+        """Spin-up accounting recorded at READY time: compile seconds
+        by program label (obs/devprof.py CompileWatch) and the AOT
+        cache traffic — the zero-compile replacement-worker pin."""
+        return dict(self.spinup_info)
+
+    def verb_metrics(self):
+        return self.server.metrics()
+
+    def verb_metrics_state(self):
+        return obs_metrics.registry_state(self.server.registry)
+
+    def verb_metrics_text(self):
+        return self.server.metrics_text()
+
+    def verb_submit(self, rid: int, prompt, params: dict,
+                    tenant: str = "", migrate: bool = False,
+                    block: bool = False):
+        req = self.server.submit(np.asarray(prompt, np.int32),
+                                 params=SamplingParams(**params),
+                                 block=block, tenant=tenant, rid=rid,
+                                 migrate=migrate)
+        with self._lock:
+            self._handles[rid] = req
+        return True
+
+    def verb_result(self, rid: int, wait: Optional[float] = None):
+        res = self.server.result(self._req(rid), timeout=wait)
+        if res.status == "migrated":
+            # the router raced the migration pump; it retries once the
+            # decode-tier owner is known
+            return {"status": "__migrated__", "tokens": ()}
+        return result_to_wire(res)
+
+    def verb_fetch_migrated(self, rid: int,
+                            wait: Optional[float] = None):
+        req = self._req(rid)
+        if not req.done.wait(wait):
+            raise TimeoutError("request %d still prefilling" % rid)
+        rec = self.server.export_migrated(req, timeout=0)
+        if rec is not None:
+            return {"kind": "record", "record": record_to_wire(rec)}
+        if req.status == "migrated":
+            # parked record lost to an engine recovery between park and
+            # export — the router replays from its journal
+            return {"kind": "lost"}
+        return {"kind": "result",
+                "result": result_to_wire(self.server.result(req, 0))}
+
+    def verb_adopt_migrated(self, record: dict):
+        req, rec = record_from_wire(record)
+        self.server.adopt_swapped(req, rec)
+        with self._lock:
+            self._handles[req.rid] = req
+        return True
+
+    def verb_adopt(self, request: dict):
+        req = request_from_wire(request)
+        now = time.perf_counter()
+        req.submit_t = now
+        reset_for_replay(req)
+        self.server.adopt(req)
+        with self._lock:
+            self._handles[req.rid] = req
+        return True
+
+    def verb_drain(self, wait: Optional[float] = None):
+        self.server.drain(timeout=wait)
+        return True
+
+    def verb_shutdown(self):
+        self.shutdown_event.set()
+        return True
+
+
+def worker_main(spec_path: str, tier: str = "") -> int:
+    """Process entry (``python -m cxxnet_tpu.serve.fleet <spec> [tier]``
+    / CLI ``task=fleet-worker``): build the InferenceServer from the
+    pickled spec, bind the RPC port, print the READY sentinel + port on
+    stdout (the router's spawn handshake), and serve until the shutdown
+    verb."""
+    with open(spec_path, "rb") as f:
+        spec = pickle.load(f)
+    kw = dict(spec.get("server_kw") or {})
+    kw.update((spec.get("tier_kw") or {}).get(tier, {}))
+    from .server import InferenceServer
+    srv = InferenceServer(spec["cfg"], spec["params"], **kw)
+    worker = FleetWorker(srv)
+    # spin-up accounting BEFORE serving traffic: compile totals by
+    # attributed program label + the AOT cache counters — what the
+    # zero-compile replacement-worker test pins
+    try:
+        from ..obs import devprof
+        worker.spinup_info["compile_totals"] = dict(
+            devprof.compile_watch().totals)
+    except Exception:
+        worker.spinup_info["compile_totals"] = {}
+    worker.spinup_info["aot"] = srv.metrics().get("aot_cache")
+    worker.spinup_info["tier"] = tier
+    rpc = RpcServer(worker.handle, port=int(spec.get("port", 0)),
+                    name="worker")
+    rpc.start()
+    print("%s %d" % (READY_SENTINEL, rpc.port), flush=True)
+    worker.shutdown_event.wait()
+    time.sleep(0.25)            # let the shutdown reply flush
+    rpc.close()
+    try:
+        srv.shutdown(drain=False, timeout=10)
+    except Exception:
+        pass
+    return 0
+
+
+# ------------------------------------------------------- router process
+class _Worker:
+    """Router-side handle on one worker process: tier, subprocess,
+    stdout drain, RPC client, and liveness."""
+
+    def __init__(self, tier: str, idx: int):
+        self.tier = tier
+        self.idx = idx
+        self.name = "%s%d" % (tier, idx)
+        self.proc: Optional[subprocess.Popen] = None
+        self.client: Optional[RpcClient] = None
+        self.port: Optional[int] = None
+        self.ready = threading.Event()
+        self.dead = False
+        self.lines: collections.deque = collections.deque(maxlen=400)
+        self.reader: Optional[threading.Thread] = None
+
+    def call(self, verb: str, timeout: Optional[float] = None,
+             **payload):
+        if self.dead or self.client is None:
+            raise WorkerLostError("worker %s is gone" % self.name)
+        try:
+            return self.client.call(verb, timeout=timeout, **payload)
+        except RpcError as e:
+            raise _revive(e)
+
+    def tail(self, n: int = 40) -> str:
+        return "\n".join(list(self.lines)[-n:])
+
+
+class FleetRouter:
+    """Spawn and front a cross-process serving fleet. The submit /
+    result / drain / metrics surface mirrors ``ServeRouter``; handles
+    are plain :class:`Request` mirrors (tokens live worker-side until
+    the terminal result crosses back).
+
+    ``prefill``/``decode`` are the tier sizes; with ``prefill == 0``
+    the fleet is a plain cross-process replica pool (no migration).
+    ``tier_kw`` overlays per-tier server kwargs on ``server_kw`` (e.g.
+    chaos on the decode tier only). ``worker_env`` overlays the worker
+    process environment — device placement rides it (the CPU CI passes
+    a one-device XLA_FLAGS; a TPU rig passes per-tier visible-device
+    variables). ``aot_relabel`` (default on when ``aot_cache`` is set)
+    arms device relabeling in the workers so one persisted artifact
+    serves every worker of a tier."""
+
+    def __init__(self, cfg, params, *, prefill: int = 1,
+                 decode: int = 2, worker_env: Optional[dict] = None,
+                 tier_kw: Optional[dict] = None,
+                 aot_relabel: Optional[bool] = None,
+                 restart_workers: bool = True, heartbeat_s: float = 2.0,
+                 spawn_timeout: float = 600.0, registry=None,
+                 defaults: Optional[SamplingParams] = None,
+                 **server_kw):
+        if decode < 1:
+            raise ValueError("fleet needs decode >= 1 worker, got %d"
+                             % decode)
+        if prefill < 0:
+            raise ValueError("fleet prefill tier size must be >= 0")
+        self._heartbeat_s = float(heartbeat_s)
+        self._spawn_timeout = float(spawn_timeout)
+        self._restart_workers = bool(restart_workers)
+        self._worker_env = dict(worker_env or {})
+        if aot_relabel is None:
+            aot_relabel = bool(server_kw.get("aot_cache"))
+        self._aot_relabel = bool(aot_relabel)
+        self._defaults = (defaults if defaults is not None
+                          else SamplingParams())
+        if server_kw.get("timeout_ms") and not self._defaults.timeout_ms:
+            self._defaults = dataclasses.replace(
+                self._defaults, timeout_ms=server_kw["timeout_ms"])
+        self._lock = threading.Lock()
+        self._fail_lock = threading.Lock()
+        self._closing = False
+        self._rid = itertools.count()
+        self._journal = ReplayJournal()
+        self._reqs: Dict[int, Request] = {}      # rid -> local mirror
+        self._owner: Dict[int, _Worker] = {}
+        self._results: Dict[int, dict] = {}      # rid -> wire result
+        self._mig_done: Dict[int, threading.Event] = {}
+        self.migrations = 0
+        self.kv_wire_bytes = 0
+        self.replays = 0
+        self.restarts = 0
+        self._final_metrics: Optional[Dict] = None  # drain() snapshot
+        # router-owned fleet metrics; worker registries merge with this
+        # one (worker="router") in metrics_text()
+        self._registry = (registry if registry is not None
+                          else obs_metrics.Registry())
+        self._registry.gauge(
+            "cxn_fleet_workers", "live fleet worker processes",
+            fn=lambda: float(len(self._live())))
+        self._mig_c = self._registry.counter(
+            "cxn_fleet_migrations_total",
+            "prefill->decode KV-row migrations completed over the wire")
+        self._wire_c = self._registry.counter(
+            "cxn_kv_wire_bytes_total",
+            "KV swap-record payload bytes moved over fleet sockets")
+        self._restart_c = self._registry.counter(
+            "cxn_worker_restarts_total",
+            "replacement fleet workers spawned after a worker loss")
+        self._replay_c = self._registry.counter(
+            "cxn_fleet_replays_total",
+            "requests replayed on a survivor after a worker loss")
+        # one spec file feeds every worker of the fleet (replacements
+        # included): config + host-resident params + server kwargs
+        self._spec_dir = tempfile.mkdtemp(prefix="cxn-fleet-")
+        self._spec_path = os.path.join(self._spec_dir, "spec.pkl")
+        import jax
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+        with open(self._spec_path, "wb") as f:
+            pickle.dump({"cfg": cfg, "params": host_params,
+                         "server_kw": dict(server_kw),
+                         "tier_kw": dict(tier_kw or {})},
+                        f, protocol=pickle.HIGHEST_PROTOCOL)
+        self.workers: List[_Worker] = []
+        self._widx = {"prefill": itertools.count(),
+                      "decode": itertools.count()}
+        try:
+            # sequential spawn: the first worker warms the shared AOT
+            # cache, every later worker (relabeling armed) loads its
+            # executables instead of compiling
+            for _ in range(prefill):
+                self._spawn("prefill")
+            for _ in range(decode):
+                self._spawn("decode")
+        except Exception:
+            self._teardown(kill=True)
+            raise
+        self._stop = threading.Event()
+        self._monitor_t = threading.Thread(
+            target=self._monitor, name="cxn-fleet-monitor", daemon=True)
+        self._monitor_t.start()
+
+    # ------------------------------------------------------------ spawn
+    def _spawn(self, tier: str) -> _Worker:
+        w = _Worker(tier, next(self._widx[tier]))
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env["PYTHONPATH"] = _REPO_ROOT
+        env["PYTHONUNBUFFERED"] = "1"
+        if self._aot_relabel:
+            env["CXN_AOT_RELABEL"] = "1"
+        env.update(self._worker_env)
+        w.proc = subprocess.Popen(
+            [sys.executable, "-m", "cxxnet_tpu.serve.fleet",
+             self._spec_path, tier],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, cwd=_REPO_ROOT, text=True)
+        w.reader = threading.Thread(target=self._drain_stdout,
+                                    args=(w,),
+                                    name="cxn-fleet-stdout-%s" % w.name,
+                                    daemon=True)
+        w.reader.start()
+        if not w.ready.wait(self._spawn_timeout) or w.port is None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+            raise RuntimeError(
+                "fleet worker %s did not come up within %.0fs; last "
+                "output:\n%s" % (w.name, self._spawn_timeout, w.tail()))
+        w.client = RpcClient("127.0.0.1", w.port, name=w.name)
+        with self._lock:
+            self.workers.append(w)
+        return w
+
+    def _drain_stdout(self, w: _Worker) -> None:
+        for line in w.proc.stdout:
+            line = line.rstrip("\n")
+            w.lines.append(line)
+            if line.startswith(READY_SENTINEL):
+                try:
+                    w.port = int(line.split()[1])
+                except (IndexError, ValueError):
+                    w.port = None
+                w.ready.set()
+        w.ready.set()           # EOF: unblock a waiting spawn either way
+
+    def _live(self, tier: Optional[str] = None) -> List[_Worker]:
+        with self._lock:
+            return [w for w in self.workers
+                    if not w.dead and (tier is None or w.tier == tier)]
+
+    def _outstanding(self, w: _Worker) -> int:
+        with self._lock:
+            return sum(1 for rid, o in self._owner.items()
+                       if o is w and rid not in self._results)
+
+    def _pick(self, tier: str, exclude: Optional[_Worker] = None
+              ) -> Optional[_Worker]:
+        cands = [w for w in self._live(tier) if w is not exclude]
+        if not cands and exclude is not None:
+            cands = [w for w in self._live(tier)]
+        if not cands:
+            return None
+        return min(cands, key=self._outstanding)
+
+    # ----------------------------------------------------------- submit
+    def submit(self, prompt, params: Optional[SamplingParams] = None,
+               block: bool = False, tenant: str = "",
+               **overrides) -> Request:
+        if self._closing:
+            raise AdmissionError("fleet is shutting down")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = params if params is not None else self._defaults
+        if overrides:
+            p = dataclasses.replace(p, **overrides)
+        rid = next(self._rid)
+        req = Request(rid, prompt, p, time.perf_counter(), tenant=tenant)
+        prefill_tier = self._live("prefill")
+        migrate = bool(prefill_tier) and bool(self._live("decode"))
+        tier = "prefill" if prefill_tier else "decode"
+        last_err: Optional[BaseException] = None
+        tried: List[_Worker] = []
+        while True:
+            w = self._pick(tier)
+            w = w if w not in tried else next(
+                (c for c in self._live(tier) if c not in tried), None)
+            if w is None:
+                if tier == "prefill":
+                    # whole prefill tier gone: the decode tier serves
+                    # end-to-end (no migration) until a replacement is up
+                    tier, migrate, tried = "decode", False, []
+                    continue
+                raise last_err or EngineFailedError(
+                    "no live fleet worker to accept the request")
+            tried.append(w)
+            try:
+                w.call("submit", rid=rid, prompt=prompt,
+                       params=dataclasses.asdict(p), tenant=tenant,
+                       migrate=migrate, block=block)
+                break
+            except WorkerLostError as e:
+                last_err = e
+                self._note_lost(w)
+        with self._lock:
+            self._journal.add(req)
+            self._reqs[rid] = req
+            self._owner[rid] = w
+            if migrate:
+                self._mig_done[rid] = threading.Event()
+        if migrate:
+            threading.Thread(target=self._pump, args=(rid,),
+                             name="cxn-fleet-pump-%d" % rid,
+                             daemon=True).start()
+        return req
+
+    # -------------------------------------------------------- migration
+    def _pump(self, rid: int) -> None:
+        """Drive one request's prefill->decode hop: block on the
+        prefill worker until the row is exportable, move the swap
+        record, and adopt it on the least-loaded decode worker. Runs on
+        its own thread so N in-flight requests migrate concurrently
+        (a result() caller never serializes the tier hop)."""
+        ev = self._mig_done.get(rid)
+        w = self._owner.get(rid)
+        try:
+            try:
+                out = w.call("fetch_migrated", rid=rid, timeout=None)
+            except WorkerLostError:
+                self._note_lost(w)      # failover replays rid for us
+                return
+            except Exception:
+                return                  # result() surfaces the state
+            if out["kind"] == "result":
+                with self._lock:
+                    self._results[rid] = out["result"]
+                return
+            if out["kind"] == "lost":
+                self._replay([rid], why="migration record lost")
+                return
+            record = out["record"]
+            nbytes = int(record.get("nbytes", 0))
+            while True:
+                d = self._pick("decode", exclude=w)
+                if d is None:
+                    self._replay([rid], why="no decode worker")
+                    return
+                try:
+                    d.call("adopt_migrated", record=record)
+                    break
+                except WorkerLostError:
+                    self._note_lost(d)
+            with self._lock:
+                self._owner[rid] = d
+                self.migrations += 1
+                self.kv_wire_bytes += nbytes
+            self._mig_c.inc()
+            self._wire_c.inc(nbytes)
+        finally:
+            if ev is not None:
+                ev.set()
+
+    # ----------------------------------------------------------- result
+    @staticmethod
+    def _remaining(deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise TimeoutError("request still in flight at the fleet "
+                               "deadline")
+        return rem
+
+    def result(self, handle: Request,
+               timeout: Optional[float] = None) -> ServeResult:
+        rid = handle.rid
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            with self._lock:
+                wire = self._results.get(rid)
+                w = self._owner.get(rid)
+                ev = self._mig_done.get(rid)
+            if wire is not None:
+                return self._finish_local(rid, wire)
+            if ev is not None and not ev.is_set():
+                if not ev.wait(self._remaining(deadline)):
+                    raise TimeoutError(
+                        "request %d still migrating between tiers"
+                        % rid)
+                continue
+            if w is None or w.dead:
+                # a failover replay is (re)assigning the owner
+                time.sleep(0.05)
+                self._remaining(deadline)
+                continue
+            rem = self._remaining(deadline)
+            try:
+                # worker-side wait carries the user deadline; the RPC
+                # wait is padded so the remote TimeoutError wins the race
+                wire = w.call("result", rid=rid, wait=rem,
+                              timeout=(None if rem is None
+                                       else rem + 30.0))
+            except WorkerLostError:
+                self._note_lost(w)
+                continue
+            except TimeoutError:
+                raise
+            if wire.get("status") == "__migrated__":
+                continue        # raced the pump; loop to the new owner
+            with self._lock:
+                self._results[rid] = wire
+            return self._finish_local(rid, wire)
+
+    def _finish_local(self, rid: int, wire: dict) -> ServeResult:
+        res = result_from_wire(wire)
+        with self._lock:
+            req = self._reqs.get(rid)
+            if req is not None:
+                self._journal.remove(req)
+        if req is not None and not req.done.is_set():
+            if res.status == "ok" and len(res.tokens):
+                req.tokens = list(
+                    np.asarray(res.tokens)[len(req.prompt):])
+            req.finish(res.status, res.error)
+        return res
+
+    # --------------------------------------------------------- failover
+    def _note_lost(self, w: Optional[_Worker]) -> None:
+        """Mark a worker dead exactly once, replay its in-flight
+        requests on survivors, and (optionally) spawn a replacement."""
+        if w is None:
+            return
+        with self._fail_lock:
+            if w.dead:
+                return
+            w.dead = True
+        if w.client is not None:
+            w.client.close()
+        try:
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.kill()
+        except OSError:
+            pass
+        with self._lock:
+            victims = [rid for rid, o in self._owner.items()
+                       if o is w and rid not in self._results
+                       and rid in self._reqs]
+        if victims and not self._closing:
+            self._replay(victims, why="worker %s lost" % w.name)
+        if self._restart_workers and not self._closing:
+            self.restarts += 1
+            self._restart_c.inc()
+            threading.Thread(target=self._respawn, args=(w.tier,),
+                             name="cxn-fleet-respawn",
+                             daemon=True).start()
+
+    def _respawn(self, tier: str) -> None:
+        try:
+            self._spawn(tier)
+        except Exception:
+            pass                # monitor keeps serving on survivors
+
+    def _replay(self, rids: List[int], why: str = "") -> None:
+        """Re-adopt journaled requests on surviving workers: the rewind
+        (router.py ``rewind_request``) + deterministic re-execution make
+        greedy streams bit-identical and sampled streams distribution-
+        identical — PR 9's replay contract, across a process boundary."""
+        for rid in rids:
+            with self._lock:
+                req = self._reqs.get(rid)
+                ev = self._mig_done.get(rid)
+                if req is None or rid in self._results:
+                    continue
+            new = rewind_request(req)
+            placed = False
+            while not placed:
+                # prefer the decode tier (end-to-end serve, no second
+                # hop), fall back to any live worker
+                d = self._pick("decode") or self._pick("prefill")
+                if d is None:
+                    new.finish("error",
+                               "no surviving fleet worker to replay "
+                               "request %d (%s)" % (rid, why))
+                    with self._lock:
+                        self._results[rid] = result_to_wire(
+                            ServeResult("error", np.zeros((0,), np.int32),
+                                        error=new.error))
+                    break
+                try:
+                    d.call("adopt", request=request_to_wire(new))
+                    placed = True
+                except WorkerLostError:
+                    self._note_lost(d)
+            if not placed:
+                continue
+            with self._lock:
+                self._journal.remove(req)
+                self._journal.add(new)
+                self._reqs[rid] = new
+                self._owner[rid] = d
+                self.replays += 1
+            self._replay_c.inc()
+            if ev is not None:
+                ev.set()        # the tier hop is moot after a replay
+
+    # ---------------------------------------------------------- monitor
+    def _monitor(self) -> None:
+        """Heartbeat loop: a worker whose process exited, whose
+        connection died, or whose health verb goes silent past the
+        timeout is declared lost (typed WorkerLostError for its
+        waiters) and its requests replay on survivors."""
+        hb_timeout = max(10.0, 5 * self._heartbeat_s)
+        while not self._stop.wait(self._heartbeat_s):
+            for w in self._live():
+                if self._stop.is_set():
+                    return
+                if w.proc is not None and w.proc.poll() is not None:
+                    self._note_lost(w)
+                    continue
+                try:
+                    w.call("ping", timeout=hb_timeout)
+                except (WorkerLostError, TimeoutError):
+                    self._note_lost(w)
+
+    # ---------------------------------------------------------- metrics
+    def metrics(self) -> Dict:
+        if self._final_metrics is not None:
+            return self._final_metrics
+        per = {}
+        for w in self._live():
+            try:
+                per[w.name] = w.call("metrics", timeout=30)
+            except (WorkerLostError, TimeoutError):
+                pass
+        counts: Dict[str, int] = {}
+        for m in per.values():
+            for k, v in m.get("requests", {}).items():
+                counts[k] = counts.get(k, 0) + v
+        return {
+            "requests": counts,
+            "tokens_generated": sum(m.get("tokens_generated", 0)
+                                    for m in per.values()),
+            "workers": per,
+            "fleet": {"live": len(self._live()),
+                      "prefill": len(self._live("prefill")),
+                      "decode": len(self._live("decode")),
+                      "migrations": self.migrations,
+                      "kv_wire_bytes": self.kv_wire_bytes,
+                      "replays": self.replays,
+                      "restarts": self.restarts},
+        }
+
+    def metrics_text(self) -> str:
+        """ONE merged Prometheus scrape for the whole fleet: every
+        worker's registry crosses the wire as a value snapshot
+        (obs/metrics.py registry_state), is rebuilt router-side, and
+        merges with the router's own fleet counters under ``worker=``
+        labels — histograms additionally aggregate, exactly like the
+        in-process router's ``replica=`` payload."""
+        regs: Dict[str, obs_metrics.Registry] = {}
+        for w in self._live():
+            try:
+                regs[w.name] = obs_metrics.registry_from_state(
+                    w.call("metrics_state", timeout=30))
+            except (WorkerLostError, TimeoutError):
+                pass
+        regs["router"] = self._registry
+        return obs_metrics.merged_prometheus(regs, label="worker")
+
+    @property
+    def registry(self):
+        return self._registry
+
+    def health(self) -> Dict:
+        per = {}
+        for w in self._live():
+            try:
+                per[w.name] = w.call("health", timeout=30)
+            except (WorkerLostError, TimeoutError):
+                per[w.name] = {"state": "LOST"}
+        live = len(self._live())
+        return {"state": ("SERVING" if live else "FAILED"),
+                "workers": per, "live": live,
+                "replays": self.replays, "restarts": self.restarts}
+
+    # --------------------------------------------------------- shutdown
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Zero-lost graceful stop: wait for every tier hop to settle,
+        drain every worker (their queues finish), pull every
+        outstanding result into the router cache, then tear the
+        processes down — ``result()`` keeps answering from the cache
+        afterwards."""
+        with self._lock:
+            events = list(self._mig_done.values())
+        for ev in events:
+            ev.wait(timeout)
+        for w in self._live():
+            try:
+                w.call("drain", wait=timeout,
+                       timeout=(None if timeout is None
+                                else timeout + 30.0))
+            except (WorkerLostError, TimeoutError):
+                pass
+        with self._lock:
+            pending = [rid for rid in self._reqs
+                       if rid not in self._results]
+        for rid in pending:
+            w = self._owner.get(rid)
+            if w is None or w.dead:
+                continue
+            try:
+                wire = w.call("result", rid=rid, wait=30, timeout=60)
+                if wire.get("status") != "__migrated__":
+                    with self._lock:
+                        self._results[rid] = wire
+            except (WorkerLostError, TimeoutError):
+                pass
+        # snapshot the aggregate before the processes go away so the
+        # post-drain summary (cli.py task_serve) still has numbers —
+        # mirrors result() answering from the cache after teardown
+        self._final_metrics = self.metrics()
+        self.shutdown(drain=False)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        if self._closing:
+            return
+        if drain:
+            self.drain(timeout)
+            return
+        self._closing = True
+        self._stop.set()
+        self._monitor_t.join(timeout=10)
+        self._teardown(kill=False)
+        with self._lock:
+            for rid, req in self._reqs.items():
+                if rid not in self._results and not req.done.is_set():
+                    req.finish("cancelled", "fleet shutdown")
+            self._journal.clear()
+            for ev in self._mig_done.values():
+                ev.set()
+
+    def _teardown(self, kill: bool) -> None:
+        with self._lock:
+            workers = list(self.workers)
+        for w in workers:
+            if not kill and not w.dead and w.client is not None:
+                try:
+                    w.call("shutdown", timeout=10)
+                except (WorkerLostError, TimeoutError):
+                    pass
+        for w in workers:
+            if w.client is not None:
+                w.client.close()
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    try:
+                        w.proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+                except OSError:
+                    pass
+            if w.reader is not None:
+                w.reader.join(timeout=5)
+            w.dead = True
+        shutil.rmtree(self._spec_dir, ignore_errors=True)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc[0] is None)
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1],
+                         sys.argv[2] if len(sys.argv) > 2 else ""))

@@ -73,7 +73,22 @@ from .scheduler import Request, SamplingParams
 from .server import (AdmissionError, InferenceServer, QueueFullError,
                      QuotaExceededError)
 
-__all__ = ["ServeRouter", "RouterHandle"]
+__all__ = ["ServeRouter", "RouterHandle", "rewind_request"]
+
+
+def rewind_request(req: Request) -> Request:
+    """A fresh Request carrying everything a bit-exact replay needs
+    (serve/resilience.py): prompt, params (seed included), tenant
+    label, and the emitted-token prefix as the ``replay_expect`` pin.
+    Shared by the in-process router's failover/drain migration and the
+    cross-process fleet's worker-loss replay (serve/fleet.py) — one
+    rewind contract, not two."""
+    new = Request(req.rid, req.prompt, req.params, req.submit_t,
+                  tenant=req.tenant)
+    new.tokens = list(req.tokens)
+    new.replay_expect = req.replay_expect
+    reset_for_replay(new)
+    return new
 
 
 class RouterHandle:
@@ -385,16 +400,9 @@ class ServeRouter:
 
     # ----------------------------------------------------------- failover
     def _rewind(self, req: Request) -> Request:
-        """A fresh Request carrying everything a bit-exact replay needs
-        (serve/resilience.py): prompt, params (seed included), tenant
-        label, and the emitted-token prefix as the ``replay_expect``
-        pin."""
-        new = Request(req.rid, req.prompt, req.params, req.submit_t,
-                      tenant=req.tenant)
-        new.tokens = list(req.tokens)
-        new.replay_expect = req.replay_expect
-        reset_for_replay(new)
-        return new
+        """Module-level :func:`rewind_request` — kept as a method for
+        the pinned tests and subclass hooks."""
+        return rewind_request(req)
 
     def _failover(self, handle: RouterHandle, from_idx: int) -> bool:
         """Migrate one live request off ``from_idx`` (failed or

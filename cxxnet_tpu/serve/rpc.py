@@ -1,0 +1,368 @@
+"""Length-prefixed binary RPC for the serving fleet (serve/fleet.py).
+
+One small, dependency-free wire protocol carries every fleet verb —
+submit / result / adopt / drain / health / metrics — plus the KV
+migration payloads (the crc32-checksummed engine swap records, moved
+verbatim: the checksum that guards host-RAM preemption round trips
+guards the socket round trip for free).
+
+Frame layout (all integers network byte order)::
+
+    +------+---------+------+-------+----------+---------------+
+    | CXRP | version | kind | seq   | length   | payload bytes |
+    | 4 B  | 1 B     | 1 B  | 4 B   | 8 B      | `length` B    |
+    +------+---------+------+-------+----------+---------------+
+
+``kind`` is REQUEST (0) / REPLY (1) / ERROR (2); ``seq`` matches a
+reply to its request so one connection multiplexes concurrent calls
+(the server dispatches every request on its own handler thread — a
+blocking ``result`` verb never serializes the connection). Payloads
+are pickled dicts: the fleet runs the SAME code tree on both ends of
+every socket (the router spawns its own workers), which is the one
+situation pickle's schema-free numpy transport is the right tool —
+this port must never be exposed beyond the fleet's loopback/rack.
+
+Malformed frames get a TYPED death, never a hang: bad magic, an
+unsupported version, an oversized length, or a mid-frame EOF raise
+:class:`FrameError` (the server best-effort replies with an ERROR
+frame, then closes that connection — the worker itself survives).
+A handler exception crosses back as :class:`RpcError` carrying the
+remote type name; a dead peer — heartbeat timeout or connection loss —
+fails every pending and future call with :class:`WorkerLostError`, the
+signal the fleet router's journal replay triggers on.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+__all__ = ["FrameError", "RpcError", "WorkerLostError", "RpcServer",
+           "RpcClient", "MAGIC", "VERSION", "MAX_FRAME"]
+
+MAGIC = b"CXRP"
+VERSION = 1
+KIND_REQUEST = 0
+KIND_REPLY = 1
+KIND_ERROR = 2
+_HEADER = struct.Struct("!4sBBIQ")      # magic, version, kind, seq, len
+# KV swap records for a long row run to a few MB; 1 GiB is far above
+# any real frame while still rejecting a garbage length field instantly
+MAX_FRAME = 1 << 30
+
+
+class FrameError(RuntimeError):
+    """A malformed wire frame (bad magic / bad version / oversized /
+    truncated); ``reason`` is the short machine-readable kind."""
+
+    def __init__(self, msg: str, reason: str = ""):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class RpcError(RuntimeError):
+    """The remote handler raised; ``remote_type`` is the exception's
+    type name and ``payload`` the full error record (back-off hints
+    and tenancy fields included), so the caller can re-raise typed."""
+
+    def __init__(self, msg: str, remote_type: str = "",
+                 payload: Optional[dict] = None):
+        super().__init__(msg)
+        self.remote_type = remote_type
+        self.payload = payload or {}
+
+
+class WorkerLostError(RuntimeError):
+    """The peer is gone — connection closed/reset, or no heartbeat
+    within the timeout. Every call pending on the connection fails
+    with this, which is the fleet router's replay trigger."""
+
+
+def _recv_exact(sock: socket.socket, n: int, what: str) -> bytes:
+    """Read exactly ``n`` bytes; EOF raises ConnectionError when
+    nothing was read yet (a clean close between frames) and FrameError
+    when a frame was cut mid-flight."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            if not buf:
+                raise ConnectionError("connection closed")
+            raise FrameError("truncated %s: got %d of %d bytes"
+                             % (what, len(buf), n), reason="truncated")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket, max_frame: int = MAX_FRAME):
+    """Read one frame -> (kind, seq, payload object). Raises
+    ConnectionError on a clean close, FrameError on garbage."""
+    hdr = _recv_exact(sock, _HEADER.size, "header")
+    magic, ver, kind, seq, length = _HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameError("bad frame magic %r (want %r)" % (magic, MAGIC),
+                         reason="bad-magic")
+    if ver != VERSION:
+        raise FrameError("unsupported frame version %d (speak %d)"
+                         % (ver, VERSION), reason="bad-version")
+    if length > max_frame:
+        raise FrameError("frame length %d exceeds the %d-byte cap"
+                         % (length, max_frame), reason="oversized")
+    body = _recv_exact(sock, length, "payload") if length else b""
+    try:
+        payload = pickle.loads(body) if body else None
+    except Exception as e:
+        raise FrameError("undecodable frame payload: %s" % e,
+                         reason="bad-payload")
+    return kind, seq, payload
+
+
+def write_frame(sock: socket.socket, lock: threading.Lock, kind: int,
+                seq: int, payload) -> None:
+    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    hdr = _HEADER.pack(MAGIC, VERSION, kind, seq, len(body))
+    with lock:
+        sock.sendall(hdr + body)
+
+
+class RpcServer:
+    """Accept loop + per-connection reader threads over one handler:
+    ``handler(verb, payload_dict) -> result``. Every REQUEST frame is
+    dispatched on its own thread so blocking verbs (``result``,
+    ``fetch_migrated``) never stall other calls multiplexed on the same
+    connection; replies are serialized by a per-connection write lock.
+
+    A FrameError on a connection answers with one best-effort ERROR
+    frame (seq 0) and closes THAT connection; the listener and every
+    other connection stay up — a fuzzing client cannot take a worker
+    down."""
+
+    def __init__(self, handler: Callable[[str, dict], object],
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_frame: int = MAX_FRAME, name: str = "rpc"):
+        self._handler = handler
+        self._max_frame = max_frame
+        self._name = name
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._closing = False
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._accept_t: Optional[threading.Thread] = None
+
+    def start(self) -> "RpcServer":
+        self._accept_t = threading.Thread(
+            target=self._accept_loop,
+            name="cxn-fleet-%s-accept" % self._name, daemon=True)
+        self._accept_t.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return                  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="cxn-fleet-%s-conn" % self._name,
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while True:
+                try:
+                    kind, seq, payload = read_frame(conn,
+                                                    self._max_frame)
+                except ConnectionError:
+                    return
+                except FrameError as e:
+                    # typed rejection, then hang up THIS connection —
+                    # the frame boundary is untrustworthy now, so
+                    # resynchronization is not attempted
+                    try:
+                        write_frame(conn, wlock, KIND_ERROR, 0,
+                                    {"type": "FrameError",
+                                     "msg": str(e),
+                                     "reason": e.reason})
+                    except OSError:
+                        pass
+                    return
+                if kind != KIND_REQUEST or not isinstance(payload, dict):
+                    try:
+                        write_frame(conn, wlock, KIND_ERROR, seq,
+                                    {"type": "FrameError",
+                                     "msg": "expected a request frame",
+                                     "reason": "bad-kind"})
+                    except OSError:
+                        pass
+                    return
+                threading.Thread(
+                    target=self._dispatch, args=(conn, wlock, seq,
+                                                 payload),
+                    name="cxn-fleet-%s-h" % self._name,
+                    daemon=True).start()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, wlock, seq: int, payload: dict) -> None:
+        verb = payload.pop("verb", "")
+        try:
+            result = self._handler(verb, payload)
+            frame = (KIND_REPLY, {"ok": result})
+        except Exception as e:         # crosses back typed, not fatal
+            err = {"type": type(e).__name__, "msg": str(e)}
+            for attr in ("retry_after_ms", "tenant", "kind", "reason"):
+                v = getattr(e, attr, None)
+                if v is not None and not isinstance(v, type):
+                    err[attr] = v
+            frame = (KIND_ERROR, err)
+        try:
+            write_frame(conn, wlock, frame[0], seq, frame[1])
+        except OSError:
+            pass                        # caller hung up; nothing to do
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            conns, self._conns = self._conns, []
+        # a thread parked in accept() does not reliably wake when the
+        # listener fd closes under it — nudge it with a self-connect
+        try:
+            socket.create_connection((self.host, self.port),
+                                     timeout=1).close()
+        except OSError:
+            pass
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for c in conns:
+            # shutdown BEFORE close: close() alone neither wakes this
+            # process's blocked readers nor (until they exit recv)
+            # sends the FIN a peer's waiters are released by
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_t is not None:
+            self._accept_t.join(timeout=5)
+
+
+class RpcClient:
+    """One connection to a worker, shared by any number of caller
+    threads: calls are seq-matched by a reader thread, writes serialize
+    on a lock. ``call`` raises the typed remote error (re-raised by the
+    fleet layer), TimeoutError past ``timeout``, and WorkerLostError
+    the moment the connection dies — which also fails every call still
+    pending, so a SIGKILL'd worker releases its waiters immediately
+    instead of leaking them into their timeouts."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 30.0,
+                 max_frame: int = MAX_FRAME, name: str = "rpc"):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pending: Dict[int, dict] = {}
+        self._lost: Optional[str] = None
+        self._reader = threading.Thread(
+            target=self._read_loop, name="cxn-fleet-%s-reader" % name,
+            daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                kind, seq, payload = read_frame(self._sock)
+                with self._lock:
+                    slot = self._pending.pop(seq, None)
+                if slot is None:
+                    continue            # caller timed out and left
+                slot["kind"] = kind
+                slot["payload"] = payload
+                slot["event"].set()
+        except (ConnectionError, FrameError, OSError) as e:
+            self._fail_all("worker connection lost: %s" % e)
+
+    def _fail_all(self, why: str) -> None:
+        with self._lock:
+            if self._lost is None:
+                self._lost = why
+            pending, self._pending = self._pending, {}
+        for slot in pending.values():
+            slot["kind"] = None
+            slot["event"].set()
+
+    @property
+    def lost(self) -> Optional[str]:
+        return self._lost
+
+    def call(self, verb: str, timeout: Optional[float] = None,
+             **payload):
+        if self._lost is not None:
+            raise WorkerLostError(self._lost)
+        slot = {"event": threading.Event(), "kind": None,
+                "payload": None}
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = slot
+        payload["verb"] = verb
+        try:
+            write_frame(self._sock, self._wlock, KIND_REQUEST, seq,
+                        payload)
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(seq, None)
+            self._fail_all("worker connection lost: %s" % e)
+            raise WorkerLostError(self._lost)
+        if not slot["event"].wait(timeout):
+            with self._lock:
+                self._pending.pop(seq, None)
+            raise TimeoutError("rpc %r: no reply within %.1fs"
+                               % (verb, timeout))
+        if slot["kind"] is None:
+            raise WorkerLostError(self._lost or "worker connection lost")
+        if slot["kind"] == KIND_ERROR:
+            err = slot["payload"] or {}
+            raise RpcError("%s: %s" % (err.get("type", "RemoteError"),
+                                       err.get("msg", "")),
+                           remote_type=err.get("type", ""),
+                           payload=err)
+        return (slot["payload"] or {}).get("ok")
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._fail_all("client closed")

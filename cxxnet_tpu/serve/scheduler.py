@@ -132,7 +132,7 @@ class Request:
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
                  "error", "done", "slot", "traced", "replay_expect",
-                 "retry_after_ms", "tenant")
+                 "retry_after_ms", "tenant", "migrate")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  params: SamplingParams, submit_t: float,
@@ -164,6 +164,12 @@ class Request:
         # rejected request carries out through its ServeResult
         self.replay_expect: Optional[List[int]] = None
         self.retry_after_ms = 0.0
+        # disaggregated serving (serve/fleet.py): True = this request's
+        # KV row leaves for a decode-tier worker the moment prefill
+        # completes (_migrate_out), instead of decoding here. Default
+        # False keeps every non-fleet submit on the exact pre-fleet
+        # path.
+        self.migrate = False
 
     def finish(self, status: str, error: str = "") -> None:
         """First terminal state wins: a request failed by the recovery
@@ -269,6 +275,16 @@ class SlotScheduler:
         self.swaps_out = 0
         self.swaps_in = 0
         self.swap_host_bytes = 0
+        # disaggregated serving (serve/fleet.py): completed-prefill rows
+        # parked for export to a decode-tier worker (rid -> swap record
+        # in exactly the resume_swapped format, host numpy only), plus
+        # the tier traffic counters. The dict is written here on the
+        # scheduler thread and popped by the server's export hook on an
+        # RPC thread — single get/pop operations only, never iterated
+        # cross-thread.
+        self.migrated: dict = {}
+        self.migrations_out = 0
+        self.migrations_in = 0
         # resilience (serve/resilience.py): the chaos injector (None =
         # off), the server's swap-corruption replay hook, the
         # degradation ladder's prefix-admission switch (rung 2), the
@@ -848,6 +864,15 @@ class SlotScheduler:
         if self._finished(req, tok):
             self._retire(req, "ok")
             return
+        if req.migrate and self.paged:
+            # disaggregated fleet (serve/fleet.py): this worker only
+            # prefills — the row's blocks leave for a decode worker.
+            # Runs AFTER the prefix donation above, so the trie keeps
+            # serving this prompt's prefix to later same-prefix traffic
+            # (swap-out copies content; the trie's refs survive the
+            # row release).
+            self._migrate_out(req, key, tok)
+            return
         n = len(req.prompt)
         self._tok[slot] = tok
         self._pos[slot] = n            # position the NEXT tick processes
@@ -857,6 +882,64 @@ class SlotScheduler:
         self._topk[slot] = p.top_k
         self._topp[slot] = p.top_p
         self._req[slot] = req
+
+    def _migrate_out(self, req: Request, key: np.ndarray,
+                     tok: int) -> None:
+        """Park a just-prefilled row for adoption by a decode-tier
+        worker (serve/fleet.py): the record is exactly what
+        :meth:`resume_swapped` restores — decode cursor armed at the
+        first token, PRNG key, and the row's block contents via the
+        crc-checksummed engine swap record — so the adopting worker's
+        ``inject_swapped`` + resume path replays the existing bit-exact
+        preemption contract over the wire. The request finishes here
+        with the non-terminal-looking ``migrated`` status WITHOUT the
+        ``on_finish`` hook: it did not complete on this worker, so the
+        completion counters (and the journal, which the export hook
+        clears) must not see it as done."""
+        slot = req.slot
+        rec = {"req": req, "key": np.array(key, np.uint32, copy=True),
+               "phase": "decode", "tok": int(tok),
+               "pos": len(req.prompt), "fold": 1,
+               "spec": (int(self._spec_try[slot]),
+                        int(self._spec_hit[slot]),
+                        self._spec_off[slot]),
+               "charge": self._slot_charge[slot]}
+        self._tenant_credit(req, slot)
+        swap = self.engine.swap_out_row(slot)
+        rec.update(swap)
+        req.slot = None
+        self._req[slot] = None
+        self._tok[slot] = 0
+        self._pos[slot] = self._park
+        self._fold[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._free.append(slot)
+        self.migrations_out += 1
+        self.migrated[req.rid] = rec
+        req.finish("migrated")
+
+    def pop_migrated(self, rid: int) -> Optional[dict]:
+        """Claim (and remove) one parked migration record; None when
+        the record is gone — an engine recovery between park and export
+        dropped it, and the fleet router then replays the request from
+        its own journal instead."""
+        return self.migrated.pop(rid, None)
+
+    def inject_swapped(self, rec: dict) -> None:
+        """Adopt a migrated row from another worker: the wire record
+        joins the resume list exactly like a locally-preempted row, so
+        ``resume_swapped`` restores it (crc verified first — a
+        corrupted wire payload routes to the swap-corruption replay
+        hook, never into the pool). Scheduler-thread only: the server
+        drains its adoption queue into here at the top of each pass."""
+        req = rec["req"]
+        req.status = "swapped"
+        req.slot = None
+        self._swapped.append(rec)
+        self.swap_host_bytes += rec["nbytes"]
+        self.migrations_in += 1
 
     def _finished(self, req: Request, tok: int) -> bool:
         p = req.params
@@ -1180,4 +1263,9 @@ class SlotScheduler:
             n += 1
         self._swapped = []
         self.swap_host_bytes = 0
+        # un-exported migration records: the requests already finished
+        # ("migrated") and the buffers are host-only — just drop them
+        # (the fleet router replays from its own journal if it still
+        # wants them)
+        self.migrated.clear()
         return n

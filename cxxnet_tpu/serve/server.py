@@ -73,7 +73,8 @@ _SCHED_CARRY = ("ticks", "active_row_ticks", "tokens_generated",
                 "spec_drafted", "spec_accepted", "spec_emitted",
                 "spec_rollbacks", "spec_backoffs", "swaps_out",
                 "swaps_in", "swap_corruptions", "drafter_faults",
-                "prefix_restore_faults", "replay_mismatches")
+                "prefix_restore_faults", "replay_mismatches",
+                "migrations_out", "migrations_in")
 
 _server_seq = itertools.count()
 # rids are PROCESS-unique, not per-server: the span tracer keys request
@@ -447,6 +448,12 @@ class InferenceServer:
             observer=lambda name, s: self._phase_h.labels(name).observe(s))
         self._queue: collections.deque = collections.deque()
         self._queue_cap = queue
+        # disaggregated fleet (serve/fleet.py): migration records
+        # adopted from a prefill-tier worker, parked here by the RPC
+        # thread (adopt_swapped) and drained onto the scheduler's
+        # resume list at the top of each pass — the scheduler thread is
+        # the only mutator of its own swap state
+        self._adopted: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._rid = _rid_seq
         self._closing = False           # no new submits
@@ -961,6 +968,45 @@ class InferenceServer:
                                         len(self._queue))
             self._cond.notify_all()
 
+    def export_migrated(self, handle: Request,
+                        timeout: Optional[float] = None):
+        """Fleet prefill-tier hook (serve/fleet.py): wait for ``handle``
+        to leave this worker, then hand its parked migration record to
+        the caller for wire transport. Returns the record when the
+        request migrated, ``None`` when it is terminal here (finished
+        during prefill — the normal :meth:`result` has the answer — or
+        the record was lost to an engine recovery and the router must
+        replay instead). The journal entry leaves WITH the record: from
+        this moment the request is the adopting worker's (and the fleet
+        router's) to replay."""
+        if not handle.done.wait(timeout):
+            raise TimeoutError("request %d still in flight"
+                               % handle.rid)
+        if handle.status != "migrated":
+            return None
+        rec = self._sched.pop_migrated(handle.rid)
+        self._journal.remove(handle)
+        return rec
+
+    def adopt_swapped(self, req: Request, rec: dict) -> None:
+        """Fleet decode-tier hook (serve/fleet.py): adopt a migrated
+        row — ``rec`` is the wire-transported swap record (crc still
+        unverified; the scheduler's resume path checks it) and ``req``
+        the rebuilt Request it belongs to. Parked on the adoption queue
+        for the scheduler thread to inject; journaled first, so a fault
+        between adoption and resume replays the request here from
+        scratch, bit-identically."""
+        rec["req"] = req
+        with self._cond:
+            if self._failed is not None:
+                raise EngineFailedError(str(self._failed))
+            if self._closing:
+                raise AdmissionError("server is shutting down")
+            self._journal.add(req)
+            self._bump("submitted", req)
+            self._adopted.append(rec)
+            self._cond.notify_all()
+
     def _reject(self, reason: str) -> None:
         """Count + raise an unservable-request rejection, so the
         'rejected' metric agrees with the ERR lines callers emit. No
@@ -975,6 +1021,7 @@ class InferenceServer:
 
     def submit(self, prompt, params: Optional[SamplingParams] = None,
                block: bool = False, tenant: str = "",
+               rid: Optional[int] = None, migrate: bool = False,
                **overrides) -> Request:
         """Enqueue one generation request; returns an opaque handle for
         :meth:`result`. ``params``/keyword overrides fill a
@@ -1125,8 +1172,14 @@ class InferenceServer:
                         "tenant %r over its rate limit (%g qps)"
                         % (tenant, pol.qps), retry_after_ms=retry,
                         tenant=tenant, kind="rate")
-            req = Request(next(self._rid), prompt, p,
-                          time.perf_counter(), tenant=tenant)
+            # rid/migrate are the fleet hooks (serve/fleet.py): a fleet
+            # worker serves requests under the ROUTER's request id (the
+            # cross-process journal and failover accounting key on it),
+            # and migrate=True sends the row to a decode-tier worker at
+            # prefill completion. Both default to the pre-fleet path.
+            req = Request(next(self._rid) if rid is None else rid,
+                          prompt, p, time.perf_counter(), tenant=tenant)
+            req.migrate = migrate
             self._queue.append(req)
             self._bump("submitted", req)
             self._queue_depth_max = max(self._queue_depth_max,
@@ -1254,6 +1307,13 @@ class InferenceServer:
         try:
             with self._cond:
                 now = time.perf_counter()
+                # fleet adoptions first (serve/fleet.py): migrated rows
+                # parked by the RPC thread join the scheduler's resume
+                # list here, on the scheduler thread — swapped_pending
+                # then both skips the idle park below and gives them
+                # resume priority over fresh admissions
+                while self._adopted:
+                    sched.inject_swapped(self._adopted.popleft())
                 expired = self._expire_queued_locked(now)
                 if self._closing and not self._drain:
                     return False
@@ -1413,6 +1473,10 @@ class InferenceServer:
                 self._bump(status, req)
                 req.finish(status, msg)
             self._queue.clear()
+            # adopted-but-never-injected migration records: the
+            # requests are journaled (swept below); the host buffers
+            # just drop
+            self._adopted.clear()
             self._cond.notify_all()
         # retire every scheduler-tracked request FIRST (counted via
         # _record_done, which also drops them from the journal), so the
@@ -1515,12 +1579,20 @@ class InferenceServer:
         self._register_obs()            # rebind callbacks to the new
         #                                 engine/scheduler (latest wins)
         t_replay = time.perf_counter()
+        # parked migration records are host-only numpy — they survive
+        # the engine rebuild verbatim, so an export racing a recovery
+        # still gets its record instead of forcing a router-side replay
+        self._sched.migrated.update(old.migrated)
         reqs = [r for r in self._journal.requests()
                 if not r.done.is_set()]
         self._journal.clear()
         for req in reqs:
             reset_for_replay(req)
         with self._cond:
+            # adopted-but-not-injected records: their requests are in
+            # `reqs` (journaled at adoption) and will replay from
+            # scratch — draining the records too would admit them twice
+            self._adopted.clear()
             # replayed requests go to the FRONT in admission order —
             # they were admitted once and must not requeue behind
             # traffic that arrived after them (cap overflow is fine:

@@ -201,6 +201,7 @@ class Net:
                     replicas: int = 1, router_policy: str = "prefix",
                     tenants: str = "", int8_weights: bool = False,
                     kv_dtype: str = "", aot_cache: str = "",
+                    fleet: str = "", aot_relabel=None, worker_env=None,
                     **defaults) -> None:
         """Start the continuous-batching inference server over this net's
         decode path (serve/InferenceServer; the CLI twin is ``task =
@@ -291,7 +292,22 @@ class Net:
         warm start LOADS the engine's chunk-prefill/verify/tick
         executables instead of compiling them, and every recovery
         rebuild / replica spin-up over the same key does the same.
-        Empty (the default) is a pinned no-op."""
+        Empty (the default) is a pinned no-op.
+
+        Cross-process fleet (serve/fleet.py, doc/serving.md
+        "Disaggregated fleet"): ``fleet`` is a tier spec —
+        ``"prefill=1,decode=2"`` (or a bare worker count for a
+        decode-only pool) — that serves from that many separate OS
+        processes behind the out-of-process RPC router instead of
+        in-process engines: prefill workers chunk-prefill and the
+        checksummed KV record migrates over a socket to a decode
+        worker; a SIGKILL'd worker's requests replay bit-identically
+        on survivors from the router's journal. ``aot_relabel``
+        (default: on when ``aot_cache`` is set) lets replacement
+        workers reuse AOT artifacts across device relabeling for
+        zero-compile spin-up. Empty (the default) is a pinned no-op —
+        no sockets, no processes, the in-process paths above are
+        untouched."""
         from .nnet.lm import net_gpt_export
         from .serve import InferenceServer, SamplingParams, ServeRouter
         if getattr(self, "_server", None) is not None:
@@ -314,7 +330,31 @@ class Net:
             int8_weights=int8_weights, kv_dtype=kv_dtype,
             aot_cache=aot_cache,
             defaults=SamplingParams(**defaults))
-        if replicas > 1:
+        if fleet.strip():
+            # worker processes own their registries and tracers (the
+            # spec crosses a process boundary); the merged scrape is
+            # metrics_text() — reject what cannot ride along instead
+            # of silently dropping it
+            if registry is not None or tracer is not None:
+                raise ValueError(
+                    "serve_start(fleet=%r, registry=.../tracer=...): "
+                    "fleet workers own their registries and tracers; "
+                    "scrape the merged payload via metrics_text()"
+                    % fleet)
+            if replicas > 1:
+                raise ValueError(
+                    "serve_start(fleet=%r, replicas=%d): the fleet "
+                    "spec already sizes the worker pool" % (fleet,
+                                                            replicas))
+            from .serve import FleetRouter, parse_tiers
+            tiers = parse_tiers(fleet)
+            kw.pop("tracer")
+            self._server = FleetRouter(cfg, params,
+                                       prefill=tiers["prefill"],
+                                       decode=tiers["decode"],
+                                       aot_relabel=aot_relabel,
+                                       worker_env=worker_env, **kw)
+        elif replicas > 1:
             # each replica owns its registry; the merged payload is
             # metrics_text() (a caller-supplied registry would make the
             # replicas' gauges fight over one name set) — surface the

@@ -51,7 +51,11 @@ def _no_leaked_background_threads():
     # JSONL snapshots to a closed test's tmp file forever)
     # (the "cxn-serve" prefix also covers the resilience layer's
     # watchdog threads, cxn-serve-watchdog-* — serve/server.py)
-    prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec", "cxn-obs")
+    # cxn-fleet-* covers the cross-process router (serve/fleet.py):
+    # monitor/pump/respawn threads, RPC reader + dispatch threads, and
+    # the worker-stdout drains — all must be gone after shutdown()
+    prefixes = ("cxn-device-prefetch", "cxn-serve", "cxn-spec", "cxn-obs",
+                "cxn-fleet")
     deadline = time.time() + 5.0
     while True:
         leaked = [t.name for t in threading.enumerate()

@@ -1,0 +1,447 @@
+"""Cross-process serving fleet (serve/fleet.py + serve/rpc.py):
+disaggregated prefill/decode tiers behind the out-of-process RPC
+router. Pins the ISSUE-17 contracts: prefill->decode KV migration over
+the checksummed wire is bit-identical to the single-process engine
+oracle (greedy, sampled, prefix-hit, int8 KV); a corrupted wire payload
+fails typed and replays only that row; a SIGKILL'd decode worker's
+requests replay bit-identically on a survivor; drain loses nothing;
+malformed RPC frames get typed rejection, not a hang; and a
+second/replacement worker spins up with zero labeled XLA compiles via
+the shared relabeled AOT cache.
+
+Worker processes ride the shared spawn plumbing of
+tests/fleet_harness.py (free ports; FleetRouter itself carries the
+pipe-drain reader discipline the harness pioneered)."""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+from cxxnet_tpu.serve import (FleetRouter, FrameError, InferenceServer,
+                              RpcError, WorkerLostError, parse_tiers)
+from cxxnet_tpu.serve.rpc import (KIND_ERROR, KIND_REQUEST, MAGIC,
+                                  RpcClient, RpcServer, read_frame,
+                                  write_frame)
+from fleet_harness import free_port
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+
+# fleet workers are single-device processes: the parent's 8-virtual-CPU
+# XLA_FLAGS (conftest) must not leak in (8x the host arena per worker)
+WENV = {"JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+KW = dict(slots=2, queue=16, prefill_chunk=4, spawn_timeout=600,
+          worker_env=WENV)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(prompt, max_new, temperature=0.0, seed=0):
+    rng = jax.random.PRNGKey(seed) if temperature > 0 else None
+    return np.asarray(gpt_decode(PARAMS, prompt[None], max_new, CFG,
+                                 temperature=temperature, rng=rng))[0]
+
+
+@pytest.fixture(scope="module")
+def aot_dir(tmp_path_factory):
+    """ONE AOT executable cache shared by every fleet in this module:
+    the first worker compiles and persists the serve programs, every
+    later spawn (relabeling armed by default) loads them — which both
+    keeps this module's wall clock sane and is itself the spin-up
+    contract under test."""
+    return str(tmp_path_factory.mktemp("fleet-aot"))
+
+
+# --------------------------------------------------------------- units
+def test_parse_tiers():
+    assert parse_tiers("prefill=1,decode=2") == {"prefill": 1,
+                                                "decode": 2}
+    assert parse_tiers("3") == {"prefill": 0, "decode": 3}
+    assert parse_tiers("") == {"prefill": 0, "decode": 0}
+    assert parse_tiers("decode=4") == {"prefill": 0, "decode": 4}
+    with pytest.raises(ValueError, match="tier"):
+        parse_tiers("draft=2")
+
+
+def test_fleet_validation():
+    with pytest.raises(ValueError, match="decode"):
+        FleetRouter(CFG, PARAMS, prefill=1, decode=0, **KW)
+    with pytest.raises(ValueError, match="prefill"):
+        FleetRouter(CFG, PARAMS, prefill=-1, decode=1, **KW)
+    with pytest.raises(ValueError):
+        parse_tiers("prefill=x")
+
+
+def test_aot_relabel_rewrites_device_ids():
+    """Relabeling rewrites the device-id key component positionally
+    (count + kind preserved): an array committed to device 3 keys like
+    one on device 0, so identical replica blocks share one artifact.
+    Off by default — placements key separately."""
+    from cxxnet_tpu.analysis import aot_cache as ac
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-virtual-device conftest topology")
+    x0 = jax.device_put(np.ones((4,), np.float32), jax.devices()[0])
+    x3 = jax.device_put(np.ones((4,), np.float32), jax.devices()[3])
+    try:
+        ac.configure_relabel(False)
+        assert ac.devices_string((x3,)) != ac.devices_string((x0,))
+        ac.configure_relabel(True)
+        assert ac.relabel_active()
+        assert ac.devices_string((x3,)) == ac.devices_string((x0,))
+        # count preserved: a 2-device placement never aliases 1-device
+        x03 = (x0, x3)
+        assert ac.devices_string(x03) != ac.devices_string((x0,))
+    finally:
+        ac.configure_relabel(None)
+    assert not ac.relabel_active()      # env switch unset -> off
+
+
+# ----------------------------------------------------------- RPC layer
+def _frame_echo_server():
+    srv = RpcServer(lambda verb, p: {"verb": verb, **p}, name="fuzz")
+    srv.start()
+    return srv
+
+
+def test_rpc_frame_fuzz_typed_rejection():
+    """Malformed frames get a typed KIND_ERROR reply (or a clean
+    connection close for a torn stream) in bounded time — never a hang,
+    never a crashed server: a healthy client keeps working after every
+    abuse below."""
+    srv = _frame_echo_server()
+    try:
+        def raw():
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=10)
+            s.settimeout(10)
+            return s
+
+        hdr = struct.Struct("!4sBBIQ")
+        # bad magic
+        s = raw()
+        s.sendall(hdr.pack(b"XXXX", 1, KIND_REQUEST, 1, 0))
+        _, _, err = read_frame(s)
+        assert err["reason"] == "bad-magic", err
+        s.close()
+        # bad version
+        s = raw()
+        s.sendall(hdr.pack(MAGIC, 9, KIND_REQUEST, 1, 0))
+        _, _, err = read_frame(s)
+        assert err["reason"] == "bad-version", err
+        s.close()
+        # oversized declared length
+        s = raw()
+        s.sendall(hdr.pack(MAGIC, 1, KIND_REQUEST, 1, 1 << 40))
+        _, _, err = read_frame(s)
+        assert err["reason"] == "oversized", err
+        s.close()
+        # undecodable payload
+        s = raw()
+        s.sendall(hdr.pack(MAGIC, 1, KIND_REQUEST, 1, 4) + b"\x00junk")
+        _, _, err = read_frame(s)
+        assert err["reason"] == "bad-payload", err
+        s.close()
+        # non-request kind
+        s = raw()
+        write_frame(s, threading.Lock(), KIND_ERROR, 7,
+                    {"verb": "ping", "payload": {}})
+        kind, seq, err = read_frame(s)
+        assert kind == KIND_ERROR and err["reason"] == "bad-kind", err
+        s.close()
+        # truncated mid-frame: torn header, then torn body
+        for blob in (hdr.pack(MAGIC, 1, KIND_REQUEST, 1, 64)[:9],
+                     hdr.pack(MAGIC, 1, KIND_REQUEST, 1, 64) + b"xy"):
+            s = raw()
+            s.sendall(blob)
+            s.close()
+        # the server survived it all: a real client round-trips
+        cli = RpcClient("127.0.0.1", srv.port, name="fuzz")
+        try:
+            out = cli.call("echo", x=3, timeout=30)
+            assert out == {"verb": "echo", "x": 3}
+        finally:
+            cli.close()
+    finally:
+        srv.close()
+
+
+def test_rpc_typed_remote_errors_and_loss():
+    """A handler exception crosses the wire with its type + attributes;
+    a server that dies mid-call releases every waiter with
+    WorkerLostError immediately (the SIGKILL contract), not a hang."""
+    from cxxnet_tpu.serve.server import QueueFullError
+
+    release = threading.Event()
+
+    def handler(verb, p):
+        if verb == "full":
+            raise QueueFullError("queue is full", retry_after_ms=125.0)
+        if verb == "hang":
+            release.wait(60)    # parked until teardown lets it go
+        return True
+
+    srv = RpcServer(handler, name="err")
+    srv.start()
+    cli = RpcClient("127.0.0.1", srv.port, name="err")
+    try:
+        with pytest.raises(RpcError) as ei:
+            cli.call("full", timeout=30)
+        assert ei.value.remote_type == "QueueFullError"
+        assert ei.value.payload["retry_after_ms"] == 125.0
+        done = {}
+
+        def waiter():
+            try:
+                cli.call("hang", timeout=120)
+            except WorkerLostError:
+                done["lost"] = time.monotonic()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        srv.close()
+        t.join(timeout=20)
+        assert not t.is_alive() and done["lost"] - t0 < 15.0
+        assert cli.lost
+    finally:
+        release.set()
+        cli.close()
+        srv.close()
+
+
+def test_rpc_client_rejects_bad_port():
+    with pytest.raises((ConnectionError, OSError, FrameError)):
+        RpcClient("127.0.0.1", free_port(), connect_timeout=5,
+                  name="nope")
+
+
+# ----------------------------------------------- migration bit-identity
+def test_fleet_migration_bit_identical(aot_dir):
+    """The acceptance fleet — 1 prefill + 2 decode on CPU — serves
+    greedy, sampled, and prefix-sharing traffic bit-identically to the
+    solo ``gpt_decode`` oracle: chunked prefill on the prefill tier,
+    the crc-checksummed KV row over the socket, decode resumed on the
+    decode tier. Also pins the merged ``worker=``-labeled scrape and
+    zero-lost drain."""
+    rs = np.random.RandomState(0)
+    shared = _prompt(rs, 8)
+    cases = [
+        (_prompt(rs, 6), dict(max_tokens=6)),
+        (_prompt(rs, 9), dict(max_tokens=5)),
+        (np.concatenate([shared, _prompt(rs, 3)]), dict(max_tokens=5)),
+        (np.concatenate([shared, _prompt(rs, 4)]), dict(max_tokens=5)),
+        (_prompt(rs, 7), dict(max_tokens=6, temperature=0.8, seed=3)),
+        (_prompt(rs, 5), dict(max_tokens=6, temperature=1.1, top_k=8,
+                              seed=9)),
+    ]
+    refs = [_ref(p, kw["max_tokens"], kw.get("temperature", 0.0),
+                 kw.get("seed", 0))
+            for p, kw in cases if "top_k" not in kw]
+    with FleetRouter(CFG, PARAMS, prefill=1, decode=2,
+                     aot_cache=aot_dir, **KW) as r:
+        hs, done = [], {}
+        for i, (p, kw) in enumerate(cases):
+            hs.append(r.submit(p, **kw))
+            if i == 2:
+                # let the prefix donor retire so its chunks are in the
+                # prefill tier's cache before the sharer prefills
+                done[2] = r.result(hs[2], timeout=600)
+        outs = [done.get(i) or r.result(h, timeout=600)
+                for i, h in enumerate(hs)]
+        for res in outs:
+            assert res.status == "ok", (res.status, res.error)
+        full = [np.asarray(res.tokens) for res in outs]
+        for got, ref in zip(full[:5], refs):        # topk has no oracle
+            np.testing.assert_array_equal(got, ref)
+        m = r.metrics()
+        assert m["fleet"]["migrations"] == len(cases)
+        assert m["fleet"]["kv_wire_bytes"] > 0
+        assert m["requests"]["completed"] == len(cases)
+        # prefix reuse happened on the prefill tier
+        pw = next(v for k, v in m["workers"].items()
+                  if k.startswith("prefill"))
+        assert pw["prefix_cache"]["hits"] >= 1
+        # ONE merged scrape: router fleet counters + per-worker
+        # families under worker= labels
+        text = r.metrics_text()
+        assert 'cxn_fleet_workers{worker="router"} 3' in text
+        assert 'cxn_fleet_migrations_total{worker="router"} %d' \
+            % len(cases) in text
+        assert 'worker="prefill0"' in text
+        assert 'worker="decode0"' in text and 'worker="decode1"' in text
+        # sampled determinism across the process hop: resubmitting the
+        # same seed reproduces the same stream
+        p, kw = cases[4]
+        res2 = r.result(r.submit(p, **kw), timeout=600)
+        np.testing.assert_array_equal(res2.tokens, full[4])
+        # drain = zero lost: in-flight work finishes, results answer
+        # from the router cache after the processes are gone
+        tail = [(_prompt(rs, 6), _ref_kw) for _ref_kw in
+                (dict(max_tokens=4), dict(max_tokens=4))]
+        tail_refs = [_ref(p, 4) for p, _ in tail]
+        tail_h = [r.submit(p, **kw) for p, kw in tail]
+        r.drain(timeout=600)
+        for h, ref in zip(tail_h, tail_refs):
+            res = r.result(h, timeout=10)
+            assert res.status == "ok", (res.status, res.error)
+            np.testing.assert_array_equal(res.tokens, ref)
+
+
+def test_fleet_int8_kv_migrates_bit_exact(aot_dir):
+    """int8 KV crosses the wire in stored representation (quantized
+    blocks + per-block scales, one crc over both): the fleet's stream
+    equals the single-process int8 server's stream exactly."""
+    rs = np.random.RandomState(7)
+    prompts = [_prompt(rs, 6), _prompt(rs, 9)]
+    kw = dict(slots=2, queue=16, prefill_chunk=4, kv_dtype="int8")
+    refs = []
+    with InferenceServer(CFG, PARAMS, **kw) as solo:
+        for p in prompts:
+            res = solo.result(solo.submit(p, max_tokens=6), timeout=600)
+            assert res.status == "ok"
+            refs.append(np.asarray(res.tokens))
+    with FleetRouter(CFG, PARAMS, prefill=1, decode=1,
+                     kv_dtype="int8", worker_env=WENV,
+                     spawn_timeout=600, slots=2, queue=16,
+                     prefill_chunk=4) as r:
+        hs = [r.submit(p, max_tokens=6) for p in prompts]
+        for h, p, ref in zip(hs, prompts, refs):
+            res = r.result(h, timeout=600)
+            assert res.status == "ok", (res.status, res.error)
+            np.testing.assert_array_equal(res.tokens, ref)
+        assert r.metrics()["fleet"]["migrations"] == len(prompts)
+
+
+# -------------------------------------------------------------- chaos
+def test_fleet_wire_corruption_single_row_replay(aot_dir):
+    """A corrupted KV payload on the wire fails the crc check BEFORE
+    touching the decode worker's block pool (SwapCorruptionError), and
+    only that row replays — locally, bit-identically (the first token
+    crossed as the replay pin); the neighbor request never notices."""
+    rs = np.random.RandomState(2)
+    p1, p2 = _prompt(rs, 6), _prompt(rs, 8)
+    r1, r2 = _ref(p1, 8), _ref(p2, 8)
+    with FleetRouter(CFG, PARAMS, prefill=1, decode=1,
+                     tier_kw={"decode": {"chaos": "swap_in@1"}},
+                     aot_cache=aot_dir, **KW) as r:
+        res1 = r.result(r.submit(p1, max_tokens=8), timeout=600)
+        res2 = r.result(r.submit(p2, max_tokens=8), timeout=600)
+        assert res1.status == "ok", (res1.status, res1.error)
+        assert res2.status == "ok", (res2.status, res2.error)
+        np.testing.assert_array_equal(res1.tokens, r1)
+        np.testing.assert_array_equal(res2.tokens, r2)
+        dec = next(v for k, v in r.metrics()["workers"].items()
+                   if k.startswith("decode"))
+        assert dec["resilience"]["swap_corruptions"] == 1
+        assert dec["resilience"]["replayed"] == 1
+        assert dec["resilience"]["faults_injected"]["swap_in"] == 1
+
+
+def test_fleet_sigkill_decode_worker_replays_on_survivor(aot_dir):
+    """SIGKILL one decode worker mid-decode: the router's journal
+    replays its in-flight requests on the surviving decode worker —
+    every stream still bit-identical to the oracle — a replacement is
+    spawned, and the survivor's counters stay monotone."""
+    rs = np.random.RandomState(4)
+    prompts = [_prompt(rs, n) for n in (6, 9, 5, 7)]
+    refs = [_ref(p, 16) for p in prompts]
+    with FleetRouter(CFG, PARAMS, prefill=1, decode=2,
+                     aot_cache=aot_dir, heartbeat_s=0.5, **KW) as r:
+        hs = [r.submit(p, max_tokens=16) for p in prompts]
+        deadline = time.time() + 300
+        while r.migrations < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert r.migrations >= 1, "no migration before the kill"
+        victim = next(w for w in r.workers if w.tier == "decode"
+                      and any(o is w for o in r._owner.values()))
+        survivor = next(w for w in r.workers
+                        if w.tier == "decode" and w is not victim)
+        before = survivor.call("metrics", timeout=60)["requests"]
+        victim.proc.kill()              # SIGKILL, no goodbye
+        for h, ref in zip(hs, refs):
+            res = r.result(h, timeout=600)
+            assert res.status == "ok", (res.status, res.error)
+            np.testing.assert_array_equal(res.tokens, ref)
+        m = r.metrics()["fleet"]
+        assert m["replays"] >= 1, m
+        assert m["restarts"] >= 1, m
+        after = survivor.call("metrics", timeout=60)["requests"]
+        for k, v in before.items():     # monotone across the failover
+            assert after[k] >= v, (k, before, after)
+        assert after["submitted"] > before["submitted"]
+        text = r.metrics_text()
+        assert 'cxn_worker_restarts_total{worker="router"}' in text
+        deadline = time.time() + 300
+        while len(r._live("decode")) < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert len(r._live("decode")) == 2, "replacement never came up"
+
+
+def test_fleet_replacement_spinup_zero_compile(aot_dir):
+    """The last worker to spin up against the warm shared AOT cache
+    (device relabeling armed by the router) loads every serve program:
+    zero AOT misses, zero labeled compile seconds (CompileWatch) — the
+    near-free replacement-worker contract. One request proves the
+    loaded executables actually serve."""
+    rs = np.random.RandomState(9)
+    p = _prompt(rs, 6)
+    ref = _ref(p, 5)
+    with FleetRouter(CFG, PARAMS, prefill=0, decode=2,
+                     aot_cache=aot_dir, **KW) as r:
+        info = r.workers[-1].call("spinup", timeout=60)
+        aot = info["aot"]
+        assert aot is not None and aot["misses"] == 0, aot
+        assert aot["hits"] >= 2, aot
+        labeled = {k: v for k, v in info["compile_totals"].items()
+                   if k != "unattributed"}
+        assert not labeled, labeled
+        res = r.result(r.submit(p, max_tokens=5), timeout=600)
+        assert res.status == "ok", (res.status, res.error)
+        np.testing.assert_array_equal(res.tokens, ref)
+
+
+def test_wrapper_fleet_api():
+    """Net.serve_start(fleet=...): the reference-style surface serves
+    from worker processes, token-identical to Net.generate; fleet=""
+    keeps the in-process server (pinned no-op); registry/tracer and
+    replicas conflicts are rejected up front."""
+    from cxxnet_tpu import wrapper
+    from cxxnet_tpu.models import gpt_lm_config
+    from cxxnet_tpu.obs.metrics import Registry
+
+    cfg = gpt_lm_config(seq_len=16, vocab_size=32, feat=16, nhead=2,
+                        nblock=2, batch_size=4, dev="cpu:0")
+    net = wrapper.Net(cfg=cfg)
+    net.init_model()
+    prompt = np.arange(4, dtype=np.int32) % 32
+    want = net.generate(prompt[None], max_new=5, temperature=0.9, seed=3)
+    net.serve_start(slots=2, queue=4, max_tokens=5,
+                    fleet="prefill=1,decode=1", worker_env=WENV)
+    try:
+        res = net.serve_result(
+            net.serve_submit(prompt, temperature=0.9, seed=3),
+            timeout=600)
+        assert res.status == "ok", (res.status, res.error)
+        np.testing.assert_array_equal(np.asarray(res.tokens), want[0])
+        assert net.serve_metrics()["fleet"]["migrations"] == 1
+        assert 'cxn_fleet_workers{worker="router"}' in net.metrics_text()
+        assert net.serve_health()["state"] == "SERVING"
+    finally:
+        net.serve_stop()
+    with pytest.raises(ValueError, match="sizes the worker pool"):
+        net.serve_start(fleet="prefill=1,decode=1", replicas=2)
+    with pytest.raises(ValueError, match="own their registries"):
+        net.serve_start(fleet="1", registry=Registry())

@@ -92,6 +92,17 @@ _DEFAULT_CELL_TOL = {
     #                                         this regresses DOWN from
     #                                         ~1.0 only when failover
     #                                         breaks
+    "serve_tokens_per_sec_fleet": 0.35,     # cross-process worker pool
+    #                                         on shared cores: socket +
+    #                                         pickle + process-scheduler
+    #                                         noise on top of the tiny-
+    #                                         geometry trace (round 18)
+    "serve_goodput_fleet_kill": 0.10,       # fraction in [0, 1]: the
+    #                                         fleet router replays a
+    #                                         SIGKILLed decode worker's
+    #                                         journal on the survivor —
+    #                                         drops below ~1.0 only
+    #                                         when failover breaks
     "serve_goodput_guaranteed_overload": 0.05,  # the guaranteed
     #                                         tenant's completion
     #                                         fraction under 3x
