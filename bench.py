@@ -145,6 +145,10 @@ Prints one JSON line per metric, in this order:
  13. lint_wall_ms                   (cxn-lint pass 1 on the largest
                                      example config — the CXN_LINT
                                      startup/CI cost, round 8)
+ 13b. lint_threads_wall_ms          (cxn-lint pass 3 — the CXN3xx
+                                     concurrency lint over the whole
+                                     package source, the new tier-1
+                                     CI gate's cost, round 19)
 
 Round 3's bench emitted only the AlexNet line, which had plateaued at the
 chip's proven streaming ceiling — the driver-recorded BENCH_r*.json could no
@@ -1627,6 +1631,18 @@ def bench_lint():
     ms = (time.perf_counter() - t0) * 1e3
     emit("lint_wall_ms", ms, "ms", config=os.path.relpath(
         path, os.path.dirname(__file__)))
+    # pass 3 (the CXN3xx concurrency lint) walks every package source
+    # file per run — a pure-AST cost, but one tier-1 CI now pays on
+    # every gate, so it gets its own trajectory line
+    from cxxnet_tpu.analysis import lint_threads
+    from cxxnet_tpu.analysis.findings import LintReport
+    rep = LintReport()
+    lint_threads(report=rep)                 # cold: bytecode/AST warmup
+    assert rep.ok(), "package must pass the concurrency lint"
+    t0 = time.perf_counter()
+    lint_threads(report=LintReport())
+    ms = (time.perf_counter() - t0) * 1e3
+    emit("lint_threads_wall_ms", ms, "ms")
 
 
 def bench_serve_cold_start():

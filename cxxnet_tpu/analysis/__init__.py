@@ -12,12 +12,22 @@ prefill/tick through the AOT API: donation aliasing, f64 promotion,
 host transfers, weak-typed inputs, collective counts vs a pinned
 budget. :mod:`.recompile` adds the runtime recompilation guard.
 
+**Pass 3** (:mod:`.concurrency`) audits the Python host runtime itself:
+an AST pass over the package enforcing the ``# guarded_by:`` lock
+annotation convention (CXN301-CXN305 — unguarded writes, acquisition-
+order cycles, blocking-under-lock, untracked threads, waits without a
+predicate loop) plus the ``CXN_LOCK_WATCH=1`` runtime lock-order
+watchdog that validates the static graph during the fleet suites.
+
 Surfaces: ``task=lint`` (CLI), the ``CXN_LINT`` runtime hook (both at
 startup, findings through the profiler log), and ``tools/cxn_lint.py``
 for CI. Rule catalog and exit codes: doc/lint.md.
 """
 
 from .aot_cache import AotCache, CachedProgram, get_cache
+from .concurrency import (LockOrderError, analyze_package, analyze_source,
+                          lint_threads, make_condition, make_lock,
+                          make_rlock, watch_enabled)
 from .findings import (Finding, LintError, LintReport, RULES,
                        parse_suppressions)
 from .graph_lint import (GraphLintResult, lint_config_file,
@@ -29,6 +39,8 @@ from .step_audit import (audit_aot_artifacts, audit_executable, audit_jit,
 
 __all__ = [
     "AotCache", "CachedProgram", "get_cache",
+    "LockOrderError", "analyze_package", "analyze_source", "lint_threads",
+    "make_condition", "make_lock", "make_rlock", "watch_enabled",
     "Finding", "LintError", "LintReport", "RULES", "parse_suppressions",
     "GraphLintResult", "lint_config_file", "lint_config_text", "lint_pairs",
     "RecompileGuard", "abstract_signature",

@@ -1,0 +1,866 @@
+"""cxn-lint pass 3: host-side concurrency discipline (CXN3xx).
+
+Passes 1-2 (graph_lint.py, step_audit.py) audit what the *compiler*
+sees — configs and HLO. This pass audits what the compiler cannot see:
+the Python host runtime that PRs 16-17 turned into a multi-threaded,
+multi-process serving fleet (router threads, RPC reader/writer threads,
+scheduler queues, merged metrics registries). Two halves share this
+module:
+
+**Static half** — an AST pass over the package driven by a lightweight
+annotation convention::
+
+    self._tries = {}        # guarded_by: self._lock
+
+marks ``_tries`` as shared mutable state protected by ``self._lock``.
+The analyzer then reports:
+
+- **CXN301** write to a guarded attribute outside any ``with <guard>:``
+  block in a thread-reachable method. Exempt: ``__init__``/``__new__``/
+  ``__del__`` (happens-before publication), methods whose name ends in
+  ``_locked``, and methods whose docstring says "caller holds" — both
+  existing repo conventions for lock-is-already-held helpers.
+- **CXN302** lock-acquisition-order cycle in the static acquisition
+  graph (deadlock potential across router <-> fleet <-> metrics). Edges
+  come from lexically nested ``with`` blocks plus one level of
+  same-class / same-module call resolution.
+- **CXN303** blocking call while holding a lock: socket ``recv``/
+  ``accept``, ``queue.get()`` with no timeout, ``subprocess`` ``wait``,
+  ``time.sleep``, ``jax.block_until_ready``, thread ``join``. Waiting
+  on a *held* ``Condition`` is NOT flagged — ``Condition.wait``
+  releases its lock while parked (that is CXN305's business).
+- **CXN304** ``threading.Thread`` created without ``daemon=`` and
+  without a visible join/daemon-flag path — the pattern the test
+  suite's leaked-thread fixture exists to catch after the fact.
+- **CXN305** untimed ``Condition.wait()`` outside a predicate ``while``
+  loop (lost-wakeup / spurious-wakeup hazard). Timed waits are polls by
+  construction and stay quiet.
+
+Per-line suppression: ``# cxn-lint: disable=CXN301`` on (or directly
+above) the offending line, for annotated-intentional patterns; config
+``lint_ignore`` works through LintReport exactly as for passes 1-2.
+
+**Runtime half** — a debug lock-order watchdog, armed by
+``CXN_LOCK_WATCH=1``. :func:`make_lock` / :func:`make_rlock` /
+:func:`make_condition` return plain ``threading`` primitives normally;
+armed, they return wrapped primitives that maintain per-thread held
+stacks and a global acquisition-order graph keyed by creation-site
+name. Acquiring B while holding A records the edge A->B; an acquire
+that would close an observed inversion (B->A exists) raises
+:class:`LockOrderError` at the acquire site — the dynamic oracle that
+validates CXN302's static graph during the fleet/router suites
+(tests/fleet_harness.py arms it in every worker). An optional hold-time
+budget (``CXN_LOCK_HOLD_MS``, float, 0/unset = off) records — but does
+not raise on — sections that held a lock past the budget; tests drain
+them via :func:`violations` / :func:`check`.
+
+This module is stdlib-only on purpose: the swept modules (serve/, obs/,
+io/) import it at module scope, and it must never drag jax into a
+process that only wanted a metrics counter.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, LintReport
+
+__all__ = [
+    "analyze_package", "analyze_source", "lint_threads",
+    "make_lock", "make_rlock", "make_condition",
+    "watch_enabled", "violations", "reset_watch", "check",
+    "LockOrderError",
+]
+
+_LAYER = "threads"
+
+_GUARD_RE = re.compile(r"#\s*guarded_by:\s*([^#\r\n]+?)\s*$")
+_DISABLE_RE = re.compile(r"#\s*cxn-lint:\s*disable=([A-Za-z0-9,\s]+)")
+
+# container mutations that count as writes for CXN301 (reads stay quiet
+# by design: the convention is deliberately lightweight, and benign
+# racy stat reads are annotated-intentional)
+_MUTATORS = frozenset((
+    "append", "appendleft", "add", "extend", "extendleft", "insert",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate", "move_to_end",
+))
+
+# attribute calls that block on the network while a lock is held
+_BLOCKING_SOCK = frozenset(("recv", "recv_into", "recvfrom", "accept"))
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:               # pragma: no cover - malformed node
+        return ""
+
+
+def _norm_expr(text: str) -> str:
+    """Canonicalize a guard expression ('self. _lock' -> 'self._lock')
+    so annotation text and ``with`` context expressions compare equal."""
+    try:
+        return ast.unparse(ast.parse(text.strip(), mode="eval"))
+    except SyntaxError:
+        return text.strip()
+
+
+def _self_attr_root(node: ast.AST) -> Optional[str]:
+    """The attribute name a write ultimately lands on, for targets
+    rooted at ``self``: ``self.x``, ``self.x[k]``, ``self.x[k].y`` all
+    resolve to ``x``. None for anything not rooted at ``self``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+def _name_root(node: ast.AST) -> Optional[str]:
+    """Like :func:`_self_attr_root` for module-level names: ``x``,
+    ``x[k]`` resolve to ``x``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_condition_ctor(call: ast.Call) -> bool:
+    return isinstance(call, ast.Call) and (
+        _unparse(call.func).endswith("Condition")
+        or _unparse(call.func).endswith("make_condition"))
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    fn = _unparse(call.func)
+    return fn == "Thread" or fn.endswith("threading.Thread")
+
+
+class _Edges:
+    """The static lock-acquisition graph (CXN302). Nodes are
+    class-qualified guard names (``ServeRouter._lock``); edges carry one
+    witness site each for the report."""
+
+    def __init__(self) -> None:
+        self.out: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    def add(self, a: str, b: str, path: str, line: int) -> None:
+        if a == b:      # reentrant same-guard nesting (RLock) is fine
+            return
+        self.out.setdefault(a, {}).setdefault(b, (path, line))
+
+    def cycles(self) -> List[Tuple[List[str], Tuple[str, int]]]:
+        """Every distinct acquisition-order cycle, each with the witness
+        site of its first edge. Deduped on the node set, so A->B->A and
+        B->A->B report once."""
+        found: List[Tuple[List[str], Tuple[str, int]]] = []
+        seen: Set[frozenset] = set()
+        for start in sorted(self.out):
+            stack = [(start, [start])]
+            while stack:
+                node, trail = stack.pop()
+                for nxt in sorted(self.out.get(node, ())):
+                    if nxt == start:
+                        key = frozenset(trail)
+                        if key not in seen:
+                            seen.add(key)
+                            found.append((trail + [start],
+                                          self.out[start][trail[1]]
+                                          if len(trail) > 1
+                                          else self.out[node][nxt]))
+                    elif nxt not in trail:
+                        stack.append((nxt, trail + [nxt]))
+        return found
+
+
+class _ModuleLint(ast.NodeVisitor):
+    """One file's static pass. Collects findings for CXN301/303/304/305
+    directly and acquisition edges (CXN302) into a shared graph."""
+
+    def __init__(self, tree: ast.Module, src: str, path: str,
+                 modname: str, edges: _Edges) -> None:
+        self.tree = tree
+        self.path = path
+        self.modname = modname
+        self.edges = edges
+        self.findings: List[Finding] = []
+        lines = src.splitlines()
+        self.guards_at: Dict[int, str] = {}     # line -> guard expr
+        self.comment_only: Set[int] = set()     # whole-line comments
+        self.disables: Dict[int, Set[str]] = {}  # line -> {"CXN301",...}
+        for i, ln in enumerate(lines, 1):
+            if ln.lstrip().startswith("#"):
+                self.comment_only.add(i)
+            m = _GUARD_RE.search(ln)
+            if m:
+                self.guards_at[i] = _norm_expr(m.group(1))
+            m = _DISABLE_RE.search(ln)
+            if m:
+                self.disables[i] = {r.strip().upper()
+                                    for r in m.group(1).split(",")
+                                    if r.strip()}
+        # join/daemon escape hatch for CXN304: any name that is ever
+        # .join()ed or has .daemon assigned counts as tracked
+        self.joined: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and isinstance(node.func.value,
+                                   (ast.Name, ast.Attribute)):
+                leaf = (node.func.value.attr
+                        if isinstance(node.func.value, ast.Attribute)
+                        else node.func.value.id)
+                self.joined.add(leaf)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        base = t.value
+                        leaf = (base.attr if isinstance(base, ast.Attribute)
+                                else base.id if isinstance(base, ast.Name)
+                                else None)
+                        if leaf:
+                            self.joined.add(leaf)
+        # module-scope guarded names and conditions
+        self.mod_guarded: Dict[str, str] = {}
+        self.mod_conds: Set[str] = set()
+        self._scan_scope(tree.body, None)
+        # class name -> {attr: guard} / {condition attr exprs}
+        self.cls_guarded: Dict[str, Dict[str, str]] = {}
+        self.cls_conds: Dict[str, Set[str]] = {}
+        self.cls_method_guards: Dict[str, Dict[str, List[str]]] = {}
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            self._scan_class(cls)
+        self.mod_fn_guards: Dict[str, List[str]] = {
+            fn.name: self._guards_in(fn) for fn in tree.body
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    # ---------------------------------------------------- collection
+    def _guard_for_line(self, line: int) -> Optional[str]:
+        """The guarded_by annotation covering ``line``: same line, or a
+        comment-ONLY line directly above (a trailing annotation on the
+        previous statement must not bleed onto this one)."""
+        g = self.guards_at.get(line)
+        if g is None and line - 1 in self.comment_only:
+            g = self.guards_at.get(line - 1)
+        return g
+
+    def _scan_scope(self, body: Sequence[ast.stmt], cls: None) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                g = self._guard_for_line(stmt.lineno)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        if g:
+                            self.mod_guarded[t.id] = g
+                        if isinstance(stmt.value, ast.Call) \
+                                and _is_condition_ctor(stmt.value):
+                            self.mod_conds.add(t.id)
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        guarded: Dict[str, str] = {}
+        conds: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                g = self._guard_for_line(node.lineno)
+                for t in node.targets:
+                    attr = t.attr if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" else None
+                    if attr and g:
+                        guarded[attr] = g
+                    if attr and isinstance(node.value, ast.Call) \
+                            and _is_condition_ctor(node.value):
+                        conds.add("self." + attr)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Attribute) \
+                    and isinstance(node.target.value, ast.Name) \
+                    and node.target.value.id == "self":
+                g = self._guard_for_line(node.lineno)
+                if g:
+                    guarded[node.target.attr] = g
+                if isinstance(node.value, ast.Call) \
+                        and _is_condition_ctor(node.value):
+                    conds.add("self." + node.target.attr)
+        self.cls_guarded[cls.name] = guarded
+        self.cls_conds[cls.name] = conds
+        self.cls_method_guards[cls.name] = {
+            fn.name: self._guards_in(fn) for fn in cls.body
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _guards_in(self, fn: ast.AST) -> List[str]:
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    out.append(_norm_expr(_unparse(item.context_expr)))
+        return out
+
+    # ------------------------------------------------------ reporting
+    def _suppressed(self, rule: str, line: int) -> bool:
+        dis = self.disables.get(line)
+        if (dis is None or not dis) and line - 1 in self.comment_only:
+            dis = self.disables.get(line - 1)
+        return bool(dis) and (rule in dis or "CXN3XX" in dis)
+
+    def _emit(self, rule: str, line: int, msg: str) -> None:
+        if not self._suppressed(rule, line):
+            self.findings.append(Finding(rule, msg, path=self.path,
+                                         line=line, layer=_LAYER))
+
+    def _node_name(self, guard: str, cls: Optional[str]) -> str:
+        """Class-qualify a guard for the acquisition graph:
+        ``self._lock`` inside ServeRouter -> ``ServeRouter._lock``;
+        module-level guards get the module name."""
+        if cls and guard.startswith("self."):
+            return "%s.%s" % (cls, guard[5:])
+        if guard.startswith("self."):
+            return "%s.%s" % (self.modname, guard[5:])
+        return "%s:%s" % (self.modname, guard)
+
+    # ------------------------------------------------------- the walk
+    def run(self) -> List[Finding]:
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._walk_fn(item, stmt.name)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(stmt, None)
+        return self.findings
+
+    @staticmethod
+    def _caller_holds(fn: ast.AST) -> bool:
+        if fn.name.endswith("_locked"):
+            return True
+        doc = ast.get_docstring(fn) or ""
+        return "caller holds" in doc.lower()
+
+    def _walk_fn(self, fn: ast.AST, cls: Optional[str]) -> None:
+        exempt301 = (fn.name in ("__init__", "__new__", "__del__")
+                     or self._caller_holds(fn))
+        guarded = dict(self.mod_guarded)
+        conds = set(self.mod_conds)
+        attr_guards = self.cls_guarded.get(cls, {}) if cls else {}
+        if cls:
+            conds |= self.cls_conds.get(cls, set())
+        # local conditions (cli.py's `feed = threading.Condition()`)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_condition_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        conds.add(t.id)
+        self._visit(fn.body, cls, fn, exempt301, attr_guards, guarded,
+                    conds, held=[], in_while=False)
+
+    def _visit(self, body: Sequence[ast.stmt], cls, fn, exempt301,
+               attr_guards, name_guards, conds,
+               held: List[str], in_while: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(stmt, cls)    # fresh held stack: runs later
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                entered = []
+                for item in stmt.items:
+                    g = _norm_expr(_unparse(item.context_expr))
+                    for h in held + entered:
+                        self.edges.add(self._node_name(h, cls),
+                                       self._node_name(g, cls),
+                                       self.path, stmt.lineno)
+                    entered.append(g)
+                self._visit(stmt.body, cls, fn, exempt301, attr_guards,
+                            name_guards, conds, held + entered, in_while)
+                continue
+            if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                self._check_stmt(stmt, cls, fn, exempt301, attr_guards,
+                                 name_guards, conds, held, in_while,
+                                 header_only=True)
+                self._visit(stmt.body, cls, fn, exempt301, attr_guards,
+                            name_guards, conds, held,
+                            in_while or isinstance(stmt, ast.While))
+                self._visit(stmt.orelse, cls, fn, exempt301, attr_guards,
+                            name_guards, conds, held, in_while)
+                continue
+            if isinstance(stmt, ast.If):
+                self._check_expr(stmt.test, cls, fn, exempt301,
+                                 attr_guards, name_guards, conds, held,
+                                 in_while)
+                self._visit(stmt.body, cls, fn, exempt301, attr_guards,
+                            name_guards, conds, held, in_while)
+                self._visit(stmt.orelse, cls, fn, exempt301, attr_guards,
+                            name_guards, conds, held, in_while)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._visit(blk, cls, fn, exempt301, attr_guards,
+                                name_guards, conds, held, in_while)
+                for h in stmt.handlers:
+                    self._visit(h.body, cls, fn, exempt301, attr_guards,
+                                name_guards, conds, held, in_while)
+                continue
+            self._check_stmt(stmt, cls, fn, exempt301, attr_guards,
+                             name_guards, conds, held, in_while)
+
+    # ------------------------------------------------- per-node rules
+    def _check_stmt(self, stmt, cls, fn, exempt301, attr_guards,
+                    name_guards, conds, held, in_while,
+                    header_only=False) -> None:
+        # CXN301: writes to guarded state
+        if not exempt301 and not header_only:
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, ast.Delete):
+                targets = list(stmt.targets)
+            for t in targets:
+                self._check_write(t, cls, attr_guards, name_guards, held)
+        nodes = ast.walk(stmt.test if header_only and
+                         hasattr(stmt, "test") else stmt) \
+            if not header_only or hasattr(stmt, "test") else ()
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                self._check_call(node, cls, fn, exempt301, attr_guards,
+                                 name_guards, conds, held, in_while)
+
+    def _check_expr(self, expr, cls, fn, exempt301, attr_guards,
+                    name_guards, conds, held, in_while) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(node, cls, fn, exempt301, attr_guards,
+                                 name_guards, conds, held, in_while)
+
+    def _check_write(self, target, cls, attr_guards, name_guards,
+                     held) -> None:
+        attr = _self_attr_root(target)
+        guard = attr_guards.get(attr) if attr else None
+        label = "self.%s" % attr if attr else None
+        if guard is None:
+            name = _name_root(target)
+            guard = name_guards.get(name) if name else None
+            label = name
+        if guard and guard not in held:
+            self._emit("CXN301", target.lineno,
+                       "write to %s outside its guard `with %s:`"
+                       % (label, guard))
+
+    def _check_call(self, call: ast.Call, cls, fn, exempt301,
+                    attr_guards, name_guards, conds, held,
+                    in_while) -> None:
+        fn_text = _unparse(call.func)
+        recv = None
+        attr = None
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv = _norm_expr(_unparse(call.func.value))
+        # CXN301: mutating container calls on guarded state
+        if attr in _MUTATORS and not exempt301:
+            owner = _self_attr_root(call.func.value)
+            guard = attr_guards.get(owner) if owner else None
+            label = "self.%s" % owner if owner else None
+            if guard is None:
+                name = _name_root(call.func.value)
+                guard = name_guards.get(name) if name else None
+                label = name
+            if guard and guard not in held:
+                self._emit("CXN301", call.lineno,
+                           "%s.%s() mutates guarded state outside "
+                           "`with %s:`" % (label, attr, guard))
+        # CXN302: one-level call resolution into the acquisition graph
+        if held and recv == "self" and cls:
+            for g in self.cls_method_guards.get(cls, {}).get(attr, ()):
+                for h in held:
+                    self.edges.add(self._node_name(h, cls),
+                                   self._node_name(g, cls),
+                                   self.path, call.lineno)
+        elif held and recv is None and isinstance(call.func, ast.Name):
+            for g in self.mod_fn_guards.get(call.func.id, ()):
+                for h in held:
+                    self.edges.add(self._node_name(h, cls),
+                                   self._node_name(g, None),
+                                   self.path, call.lineno)
+        # CXN303: blocking while holding a lock
+        if held:
+            blocked = None
+            if fn_text.endswith("time.sleep") or fn_text == "sleep":
+                blocked = "time.sleep()"
+            elif attr == "block_until_ready" \
+                    or fn_text.endswith("block_until_ready"):
+                blocked = "jax.block_until_ready()"
+            elif attr in _BLOCKING_SOCK:
+                blocked = "socket .%s()" % attr
+            elif attr == "get" and not call.args and not call.keywords:
+                blocked = "queue .get() with no timeout"
+            elif attr == "get" and not any(
+                    kw.arg == "timeout" for kw in call.keywords) \
+                    and call.args \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value is True \
+                    and len(call.args) < 2:
+                blocked = "queue .get(block=True) with no timeout"
+            elif attr == "wait" and recv not in held \
+                    and recv is not None \
+                    and re.search(r"proc|popen", recv, re.I):
+                blocked = "subprocess .wait()"
+            elif attr == "join" and recv is not None \
+                    and re.search(r"thread|_t\b", recv, re.I):
+                blocked = "thread .join()"
+            if blocked:
+                self._emit("CXN303", call.lineno,
+                           "blocking %s while holding %s"
+                           % (blocked, ", ".join(sorted(set(held)))))
+        # CXN304: untracked threads
+        if _is_thread_ctor(call):
+            if not any(kw.arg == "daemon" for kw in call.keywords):
+                if not (self.joined & self._target_leaves(call)):
+                    self._emit("CXN304", call.lineno,
+                               "threading.Thread without daemon= and no "
+                               "tracked join/daemon path")
+        # CXN305: condition wait outside a predicate while loop
+        if attr == "wait" and recv in conds and not call.args \
+                and not call.keywords and not in_while:
+            self._emit("CXN305", call.lineno,
+                       "untimed %s.wait() outside a predicate `while` "
+                       "loop (lost/spurious wakeup hazard)" % recv)
+
+    def _target_leaves(self, call: ast.Call) -> Set[str]:
+        """Names the Thread object could be reachable by, to match
+        against the module's joined/daemon-assigned set. Walks the whole
+        tree for `x = threading.Thread(...)` statements owning this
+        exact call node."""
+        leaves: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        leaves.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        leaves.add(t.attr)
+        return leaves
+
+
+# ------------------------------------------------------------- drivers
+def _analyze_module(src: str, path: str, modname: str,
+                    edges: _Edges) -> List[Finding]:
+    tree = ast.parse(src, filename=path)
+    return _ModuleLint(tree, src, path, modname, edges).run()
+
+
+def _emit_cycles(edges: _Edges, report: LintReport) -> None:
+    for trail, (path, line) in edges.cycles():
+        report.add(Finding(
+            "CXN302",
+            "lock-acquisition-order cycle: %s" % " -> ".join(trail),
+            path=path, line=line, layer=_LAYER))
+
+
+def analyze_source(src: str, path: str = "<source>",
+                   report: Optional[LintReport] = None,
+                   modname: Optional[str] = None) -> LintReport:
+    """Static pass over one module's source (the test-fixture entry
+    point). Runs all five rules including a module-local CXN302 cycle
+    check."""
+    report = report if report is not None else LintReport()
+    edges = _Edges()
+    for f in _analyze_module(src, path, modname or
+                             os.path.splitext(os.path.basename(path))[0],
+                             edges):
+        report.add(f)
+    _emit_cycles(edges, report)
+    return report
+
+
+def analyze_package(root: Optional[str] = None,
+                    report: Optional[LintReport] = None) -> LintReport:
+    """Static pass over every ``*.py`` under ``root`` (default: the
+    installed ``cxxnet_tpu`` package), with the acquisition graph —
+    and so CXN302 — built package-wide."""
+    report = report if report is not None else LintReport()
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    edges = _Edges()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            modname = rel[:-3].replace(os.sep, ".")
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                for f in _analyze_module(src, rel, modname, edges):
+                    report.add(f)
+            except SyntaxError as e:
+                report.add(Finding("CXN302", "unparsable module: %s" % e,
+                                   path=rel, line=e.lineno or 0,
+                                   layer=_LAYER))
+    _emit_cycles(edges, report)
+    return report
+
+
+def lint_threads(root: Optional[str] = None,
+                 report: Optional[LintReport] = None) -> LintReport:
+    """The ``task=lint`` / ``tools/cxn_lint.py --threads`` entry point:
+    :func:`analyze_package` under the standard report plumbing."""
+    return analyze_package(root=root, report=report)
+
+
+# =====================================================================
+# Runtime half: the lock-order watchdog
+# =====================================================================
+class LockOrderError(RuntimeError):
+    """An acquire that closes an observed lock-order inversion, raised
+    in the acquiring thread the moment the cycle becomes possible —
+    BEFORE it can deadlock, not after."""
+
+
+def watch_enabled() -> bool:
+    return os.environ.get("CXN_LOCK_WATCH", "") not in ("", "0")
+
+
+def _hold_budget_ms() -> float:
+    try:
+        return float(os.environ.get("CXN_LOCK_HOLD_MS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+class _Held:
+    __slots__ = ("name", "depth", "t0")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.depth = 1
+        self.t0 = time.monotonic()
+
+
+class _WatchState:
+    """Global watchdog state: the observed acquisition graph (keyed by
+    creation-site lock NAME, so the check survives respawned instances)
+    plus per-thread held stacks and the violation journal."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()      # raw on purpose: never watched
+        self.edges: Dict[str, Set[str]] = {}
+        self.violations: List[str] = []
+        self.tls = threading.local()
+
+    def held(self) -> List[_Held]:
+        try:
+            return self.tls.held
+        except AttributeError:
+            self.tls.held = []
+            return self.tls.held
+
+    def before_acquire(self, name: str) -> None:
+        held = self.held()
+        for h in held:
+            if h.name == name:          # reentrant (RLock) — no edge
+                return
+        if not held:
+            return
+        with self.mu:
+            back = self.edges.get(name, ())
+            for h in held:
+                if h.name in back:
+                    msg = ("lock-order inversion: acquiring %r while "
+                           "holding %r, but %r -> %r was already "
+                           "observed" % (name, h.name, name, h.name))
+                    self.violations.append(msg)
+                    raise LockOrderError(msg)
+
+    def after_acquire(self, name: str) -> None:
+        held = self.held()
+        for h in held:
+            if h.name == name:
+                h.depth += 1
+                return
+        with self.mu:
+            for h in held:
+                if h.name != name:
+                    self.edges.setdefault(h.name, set()).add(name)
+        held.append(_Held(name))
+
+    def before_release(self, name: str, budget_ms: float) -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].name == name:
+                held[i].depth -= 1
+                if held[i].depth == 0:
+                    if budget_ms > 0:
+                        ms = (time.monotonic() - held[i].t0) * 1e3
+                        if ms > budget_ms:
+                            with self.mu:
+                                self.violations.append(
+                                    "hold-time budget breach: %r held "
+                                    "%.1f ms (budget %.1f ms)"
+                                    % (name, ms, budget_ms))
+                    del held[i]
+                return
+
+    def suspend(self, name: str) -> Optional[_Held]:
+        """Condition.wait releases its lock while parked: pop the held
+        record so waiting threads do not pin stale edges/hold-times."""
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].name == name:
+                h = held[i]
+                del held[i]
+                return h
+        return None
+
+    def resume(self, h: Optional[_Held]) -> None:
+        if h is not None:
+            h.t0 = time.monotonic()
+            self.held().append(h)
+
+
+_STATE = _WatchState()
+
+
+class _WatchedLock:
+    """threading.Lock/RLock with lockdep-style order tracking."""
+
+    __slots__ = ("name", "_lk", "_budget")
+
+    def __init__(self, name: str, lk) -> None:
+        self.name = name
+        self._lk = lk
+        self._budget = _hold_budget_ms()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        _STATE.before_acquire(self.name)
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            _STATE.after_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        _STATE.before_release(self.name, self._budget)
+        self._lk.release()
+
+    def locked(self) -> bool:
+        return self._lk.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _WatchedCondition:
+    """threading.Condition over a watched lock. ``wait`` suspends the
+    held record (the underlying lock really is released while parked)
+    and resumes it — with a fresh hold-clock — on wakeup."""
+
+    __slots__ = ("name", "_cv", "_budget")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._cv = threading.Condition()
+        self._budget = _hold_budget_ms()
+
+    def acquire(self, *a):
+        _STATE.before_acquire(self.name)
+        got = self._cv.acquire(*a)
+        if got:
+            _STATE.after_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        _STATE.before_release(self.name, self._budget)
+        self._cv.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        h = _STATE.suspend(self.name)
+        try:
+            return self._cv.wait(timeout)
+        finally:
+            _STATE.resume(h)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        h = _STATE.suspend(self.name)
+        try:
+            return self._cv.wait_for(predicate, timeout)
+        finally:
+            _STATE.resume(h)
+
+    def notify(self, n: int = 1) -> None:
+        self._cv.notify(n)
+
+    def notify_all(self) -> None:
+        self._cv.notify_all()
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — watched when ``CXN_LOCK_WATCH`` is armed.
+    ``name`` is the creation-site identity the acquisition graph keys
+    on (convention: ``ClassName._attr``)."""
+    if watch_enabled():
+        return _WatchedLock(name, threading.Lock())
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — watched when armed; reentrant acquires
+    are depth-counted, never self-edges."""
+    if watch_enabled():
+        return _WatchedLock(name, threading.RLock())
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` — watched when armed. The wait() hole
+    in the held stack is handled (Condition.wait releases its lock)."""
+    if watch_enabled():
+        return _WatchedCondition(name)
+    return threading.Condition()
+
+
+def violations() -> List[str]:
+    """The watchdog's journal: inversions (also raised) and hold-time
+    budget breaches (recorded only — CI jitter must not flake)."""
+    with _STATE.mu:
+        return list(_STATE.violations)
+
+
+def reset_watch() -> None:
+    """Clear the acquisition graph and journal (test isolation)."""
+    with _STATE.mu:
+        _STATE.edges.clear()
+        _STATE.violations.clear()
+
+
+def check() -> None:
+    """Raise :class:`LockOrderError` if the journal is non-empty — the
+    end-of-test gate for suites that run with the watchdog armed."""
+    v = violations()
+    if v:
+        raise LockOrderError("; ".join(v))

@@ -1,7 +1,7 @@
 """Lint findings: the shared result type of both analysis passes.
 
 Every rule has a stable id (``CXN1xx`` = graph/config lint, ``CXN2xx`` =
-compiled-step audit) so findings can be suppressed per-config with
+compiled-step audit, ``CXN3xx`` = host-concurrency lint) so findings can be suppressed per-config with
 ``lint_ignore = <rule_id>`` (comma-separated ids accepted, repeatable) and
 golden-tested by exact formatted output. The catalog below is the single
 source of truth doc/lint.md renders from.
@@ -47,6 +47,20 @@ RULES = {
                         "program's key no longer matches the current "
                         "config/mesh/backend/jax version (the drifting "
                         "component is named)"),
+    # ---- pass 3: host-concurrency lint (AST, no devices) ----
+    "CXN301": ("error", "write to a `# guarded_by:` attribute outside "
+                        "any `with <guard>:` block in a thread-reachable "
+                        "method"),
+    "CXN302": ("error", "lock-acquisition-order cycle in the static "
+                        "acquisition graph (deadlock potential)"),
+    "CXN303": ("error", "blocking call (socket recv/accept, untimed "
+                        "queue.get, subprocess wait, time.sleep, "
+                        "jax.block_until_ready, thread join) while "
+                        "holding a lock"),
+    "CXN304": ("error", "threading.Thread created without daemon= and "
+                        "without a tracked join/daemon path"),
+    "CXN305": ("error", "untimed Condition.wait() outside a predicate "
+                        "`while` loop (lost/spurious wakeup hazard)"),
 }
 
 

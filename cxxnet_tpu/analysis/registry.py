@@ -53,7 +53,7 @@ _LR_SUBKEYS = frozenset(("schedule", "gamma", "alpha", "step", "factor",
 _TAG_PREFIXES = ("wmat:", "bias:")
 
 # keys introduced by the analysis subsystem itself
-_LINT_KEYS = frozenset(("lint_ignore",))
+_LINT_KEYS = frozenset(("lint_ignore", "lint_threads"))
 
 
 def _keys_of_callable(fn) -> Tuple[Set[str], Set[str]]:

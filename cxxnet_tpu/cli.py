@@ -189,6 +189,9 @@ class LearnTask:
         #                           (empty = random init — testing only)
         self.lint_compile = 0     # task=lint: also lower/compile-audit the
         #                           jitted steps (pass 2; needs init_model)
+        self.lint_threads = 0     # task=lint: also run the CXN3xx
+        #                           concurrency pass over the package
+        #                           source (pass 3; pure AST, no devices)
         self.aot_cache = ""       # AOT executable cache dir (analysis/
         #                           aot_cache.py; CXN_AOT_CACHE env is
         #                           the fallback): serve/train/decode
@@ -359,6 +362,8 @@ class LearnTask:
             self.name_pred = val
         elif name == "lint_compile":
             self.lint_compile = int(val)
+        elif name == "lint_threads":
+            self.lint_threads = int(val)
         elif name == "aot_cache":
             self.aot_cache = val
         elif name == "obs_trace":
@@ -502,7 +507,8 @@ class LearnTask:
         """``task=lint``: run the static analyzer on the config and exit
         nonzero on errors (doc/lint.md). Pass 1 (graph/config) always;
         ``lint_compile = 1`` also builds the net and audits the compiled
-        steps (pass 2)."""
+        steps (pass 2); ``lint_threads = 1`` also runs the CXN3xx
+        concurrency pass over the package source (pass 3)."""
         from .analysis import audit_net, format_step_info, lint_config_file
         t0 = profiler.get_time()
         result = lint_config_file(config_path, extra_pairs=overrides)
@@ -514,6 +520,9 @@ class LearnTask:
             report.extend(audit_report.findings)
             for info in infos:
                 print("lint: %s" % format_step_info(info))
+        if self.lint_threads:
+            from .analysis import lint_threads
+            lint_threads(report=report)
         print(report.format())
         print("lint: %s in %.0f ms" % (
             "clean" if report.ok() else "FAILED",

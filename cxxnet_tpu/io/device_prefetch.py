@@ -39,11 +39,11 @@ reference's two-slot ThreadBuffer.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..analysis.concurrency import make_lock
 from ..parallel.distributed import is_multi_host, multihost_assert_equal
 from .data import PrefetchProducerMixin
 
@@ -77,9 +77,9 @@ class DeviceBatch:
 # prefetchers in this process, and the lock serializing placements so two
 # prefetchers in a SINGLE-host run (where they are allowed) cannot
 # interleave inside one placement either
-_live_prefetchers: set = set()
-_live_lock = threading.Lock()
-_place_lock = threading.Lock()
+_live_prefetchers: set = set()      # guarded_by: _live_lock
+_live_lock = make_lock("device_prefetch._live_lock")
+_place_lock = make_lock("device_prefetch._place_lock")
 
 
 class DevicePrefetcher(PrefetchProducerMixin):

@@ -62,6 +62,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.concurrency import make_lock
+
 __all__ = ["HWPeaks", "hw_peaks", "ProgramCost", "CostTable",
            "profile_net", "profile_engine", "LiveSampler", "DeviceLedger",
            "CompileWatch", "compile_watch", "compile_attribution",
@@ -356,8 +358,9 @@ class CostTable:
 # stable object per config, which is exactly the restart case the
 # cache exists for; a rebuilt Net gets fresh jit objects and honestly
 # re-extracts.
-_COST_CACHE: Dict[tuple, tuple] = {}        # key -> (weakref(fn), row)
-_COST_CACHE_LOCK = threading.Lock()
+# key -> (weakref(fn), row)
+_COST_CACHE: Dict[tuple, tuple] = {}        # guarded_by: _COST_CACHE_LOCK
+_COST_CACHE_LOCK = make_lock("devprof._COST_CACHE_LOCK")
 
 
 def _signature_of(args) -> tuple:
@@ -755,11 +758,13 @@ class CompileWatch:
     once per process and costs nothing between compiles."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._installed = False
+        self._lock = make_lock("CompileWatch._lock")
+        self._installed = False             # guarded_by: self._lock
         self._tls = threading.local()
-        self._sinks: List[tuple] = []       # (registry, tracer or None)
-        self.totals: Dict[str, float] = {}  # label -> seconds (all events)
+        # (registry, tracer or None)
+        self._sinks: List[tuple] = []       # guarded_by: self._lock
+        # label -> seconds (all events)
+        self.totals: Dict[str, float] = {}  # guarded_by: self._lock
 
     # ------------------------------------------------------------ plumbing
     def _install(self) -> None:

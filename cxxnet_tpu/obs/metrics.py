@@ -40,6 +40,8 @@ import math
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.concurrency import make_lock
+
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "TIME_BUCKETS",
            "BYTES_BUCKETS", "default_registry", "merged_prometheus",
            "registry_state", "registry_from_state"]
@@ -106,8 +108,8 @@ class Counter:
     kind = "counter"
 
     def __init__(self, fn: Optional[Callable[[], float]] = None):
-        self._lock = threading.Lock()
-        self._value = 0.0
+        self._lock = make_lock("Counter._lock")
+        self._value = 0.0               # guarded_by: self._lock
         self._fn = fn
 
     def inc(self, amount: float = 1.0) -> None:
@@ -137,8 +139,8 @@ class Gauge:
     kind = "gauge"
 
     def __init__(self, fn: Optional[Callable[[], float]] = None):
-        self._lock = threading.Lock()
-        self._value = 0.0
+        self._lock = make_lock("Gauge._lock")
+        self._value = 0.0               # guarded_by: self._lock
         self._fn = fn
 
     def set(self, value: float) -> None:
@@ -178,10 +180,11 @@ class Histogram:
             raise ValueError("histogram buckets must be strictly "
                              "increasing")
         self.buckets = tuple(float(b) for b in buckets)
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(self.buckets) + 1)    # [+Inf] last
-        self._sum = 0.0
-        self._count = 0
+        self._lock = make_lock("Histogram._lock")
+        # bucket counts, [+Inf] bucket last
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded_by: self._lock
+        self._sum = 0.0                 # guarded_by: self._lock
+        self._count = 0                 # guarded_by: self._lock
 
     def reset(self) -> None:
         """Zero the observations (bench warm-up isolation — an owner
@@ -270,9 +273,10 @@ class _Family:
         self.help = help_
         self.kind = kind
         self.labelnames = labelnames
-        self._make = make
+        self._make = make               # guarded_by: self._lock
         self._buckets: Optional[Tuple[float, ...]] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("_Family._lock")
+        # guarded_by: self._lock
         self._children: Dict[Tuple[str, ...], object] = {}
         if not labelnames:
             self._children[()] = make()
@@ -352,7 +356,8 @@ class Registry:
     counter without coordination); a kind mismatch is an error."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Registry._lock")
+        # guarded_by: self._lock
         self._families: Dict[str, _Family] = {}
 
     # ---------------------------------------------------------- creation
@@ -597,7 +602,7 @@ def merged_prometheus(registries: Dict[str, Registry],
 
 
 _default = Registry()
-_default_lock = threading.Lock()
+_default_lock = make_lock("metrics._default_lock")
 
 
 def default_registry() -> Registry:

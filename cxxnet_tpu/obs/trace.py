@@ -56,6 +56,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.concurrency import make_lock
+
 __all__ = ["Span", "Tracer", "get_tracer", "configure", "request_tid",
            "spans_to_chrome", "TID_ENGINE", "TID_TRAIN", "TID_CONTROL",
            "REQ_TID_BASE"]
@@ -121,7 +123,8 @@ class Tracer:
 
     def __init__(self, capacity: int = 65536, enabled: bool = True,
                  sample: int = 1, slow_dir: str = ""):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
+        # guarded_by: self._lock
         self._ring: collections.deque = collections.deque(
             maxlen=max(1, int(capacity)))
         self.enabled = bool(enabled)
@@ -133,7 +136,8 @@ class Tracer:
         self._epoch = time.perf_counter()
         self._epoch_wall = time.time()
         self.exemplars: collections.deque = collections.deque(maxlen=8)
-        self.dropped = 0        # spans pushed out of the ring (approx.)
+        # spans pushed out of the ring (approx.; read lockless at export)
+        self.dropped = 0        # guarded_by: self._lock
         # slow-dump throttle: under saturation EVERY request can cross
         # obs_slow_ms, and note_slow runs on the scheduler thread — an
         # unthrottled makedirs+json.dump per retire would amplify the

@@ -52,6 +52,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.concurrency import make_lock
 from ..obs import metrics as obs_metrics
 from .resilience import (EngineFailedError, ReplayJournal,
                          reset_for_replay)
@@ -191,8 +192,8 @@ class FleetWorker:
 
     def __init__(self, server):
         self.server = server
-        self._handles: Dict[int, Request] = {}
-        self._lock = threading.Lock()
+        self._handles: Dict[int, Request] = {}  # guarded_by: self._lock
+        self._lock = make_lock("FleetWorker._lock")
         self.shutdown_event = threading.Event()
         self.spinup_info: dict = {}
 
@@ -403,19 +404,25 @@ class FleetRouter:
         if server_kw.get("timeout_ms") and not self._defaults.timeout_ms:
             self._defaults = dataclasses.replace(
                 self._defaults, timeout_ms=server_kw["timeout_ms"])
-        self._lock = threading.Lock()
-        self._fail_lock = threading.Lock()
-        self._closing = False
+        # _lock guards the request tables + counters below; _fail_lock
+        # serializes ONLY the worker-death latch (_note_lost), so a
+        # failover never has to wait on the request tables and the two
+        # are never nested — the lint acquisition graph (CXN302) and
+        # the CXN_LOCK_WATCH watchdog both check that stays true
+        self._lock = make_lock("FleetRouter._lock")
+        self._fail_lock = make_lock("FleetRouter._fail_lock")
+        self._closing = False               # guarded_by: self._lock
         self._rid = itertools.count()
-        self._journal = ReplayJournal()
-        self._reqs: Dict[int, Request] = {}      # rid -> local mirror
-        self._owner: Dict[int, _Worker] = {}
-        self._results: Dict[int, dict] = {}      # rid -> wire result
-        self._mig_done: Dict[int, threading.Event] = {}
-        self.migrations = 0
-        self.kv_wire_bytes = 0
-        self.replays = 0
-        self.restarts = 0
+        self._journal = ReplayJournal()     # guarded_by: self._lock
+        # rid -> local mirror / owning worker / wire result
+        self._reqs: Dict[int, Request] = {}      # guarded_by: self._lock
+        self._owner: Dict[int, _Worker] = {}     # guarded_by: self._lock
+        self._results: Dict[int, dict] = {}      # guarded_by: self._lock
+        self._mig_done: Dict[int, threading.Event] = {}  # guarded_by: self._lock
+        self.migrations = 0                 # guarded_by: self._lock
+        self.kv_wire_bytes = 0              # guarded_by: self._lock
+        self.replays = 0                    # guarded_by: self._lock
+        self.restarts = 0                   # guarded_by: self._lock
         self._final_metrics: Optional[Dict] = None  # drain() snapshot
         # router-owned fleet metrics; worker registries merge with this
         # one (worker="router") in metrics_text()
@@ -715,7 +722,11 @@ class FleetRouter:
         if victims and not self._closing:
             self._replay(victims, why="worker %s lost" % w.name)
         if self._restart_workers and not self._closing:
-            self.restarts += 1
+            # under _lock: _note_lost runs on monitor AND caller
+            # threads, and two concurrent worker deaths must not lose
+            # a restart count to a torn read-modify-write
+            with self._lock:
+                self.restarts += 1
             self._restart_c.inc()
             threading.Thread(target=self._respawn, args=(w.tier,),
                              name="cxn-fleet-respawn",
@@ -895,7 +906,8 @@ class FleetRouter:
         if drain:
             self.drain(timeout)
             return
-        self._closing = True
+        with self._lock:
+            self._closing = True
         self._stop.set()
         self._monitor_t.join(timeout=10)
         self._teardown(kill=False)

@@ -72,11 +72,12 @@ trace's ``control`` track (doc/serving.md "Resilience").
 
 from __future__ import annotations
 
-import threading
 import time
 import weakref
 import zlib
 from typing import Dict, List, Optional
+
+from ..analysis.concurrency import make_condition
 
 __all__ = ["FaultInjector", "ReplayJournal", "DegradationLadder",
            "InjectedFault", "SwapCorruptionError", "EngineFailedError",
@@ -143,8 +144,8 @@ class FaultInjector:
         # injected hangs wait on this condition so a recovery (or
         # shutdown) can interrupt them instead of sleeping out the
         # full hang_ms on an abandoned thread
-        self._cv = threading.Condition()
-        self._release_gen = 0
+        self._cv = make_condition("FaultInjector._cv")
+        self._release_gen = 0   # guarded_by: self._cv
 
     @classmethod
     def from_spec(cls, spec: str) -> Optional["FaultInjector"]:

@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..analysis.concurrency import make_rlock
 from ..obs import metrics as obs_metrics
 from .resilience import (STATE_DRAINING, STATE_FAILED, EngineFailedError,
                          ReplayJournal, reset_for_replay)
@@ -217,24 +218,29 @@ class ServeRouter:
             for s in self._servers:
                 s.shutdown(drain=False)
             raise
-        self._lock = threading.RLock()
-        self._tries = [_AffinityTrie(chunk, affinity_cap)
+        # one lock guards ALL router state: routing tables, journal,
+        # handles, and the counters below — submit/result/failover run
+        # on arbitrary caller threads (cxn-lint CXN3xx, doc/lint.md)
+        self._lock = make_rlock("ServeRouter._lock")
+        self._tries = [_AffinityTrie(chunk, affinity_cap)  # guarded_by: self._lock
                        for _ in range(replicas)]
-        self._routable = [True] * replicas
-        self._swept = [False] * replicas
+        self._routable = [True] * replicas  # guarded_by: self._lock
+        self._swept = [False] * replicas    # guarded_by: self._lock
         # rid -> current Request / RouterHandle: the router's OWN
         # replay journal (PR 9's class — the conftest leak check sees
         # it, so a router that abandons admitted requests fails tests
         # the same way a server would)
-        self._journal = ReplayJournal()
-        self._handles: Dict[int, RouterHandle] = {}
-        self._rr = itertools.count()
-        self.routed = [0] * replicas        # submits sent to replica i
-        self.affinity_hits = 0              # routed by a prefix match
-        self.failovers = 0                  # failed-replica migrations
-        self.drain_migrations = 0           # drain-initiated migrations
-        self.quota_spills = 0               # tenant-quota rejections
-        #                                     spilled to a peer replica
+        self._journal = ReplayJournal()     # guarded_by: self._lock
+        self._handles: Dict[int, RouterHandle] = {}  # guarded_by: self._lock
+        self._rr = itertools.count()        # guarded_by: self._lock
+        # counters: submits sent to replica i / routed by a prefix
+        # match / failed-replica migrations / drain-initiated
+        # migrations / tenant-quota rejections spilled to a peer
+        self.routed = [0] * replicas        # guarded_by: self._lock
+        self.affinity_hits = 0              # guarded_by: self._lock
+        self.failovers = 0                  # guarded_by: self._lock
+        self.drain_migrations = 0           # guarded_by: self._lock
+        self.quota_spills = 0               # guarded_by: self._lock
 
     # ------------------------------------------------------------ routing
     @property
@@ -269,7 +275,7 @@ class ServeRouter:
         """Pick a replica for ``prompt`` (None = nobody healthy).
         Policy "prefix": longest affinity match wins, load breaks ties
         (and decides for cold prompts); "rr": round-robin over the
-        healthy set."""
+        healthy set. Caller holds ``_lock``."""
         cands = self._candidates(exclude)
         if not cands:
             return None

@@ -38,6 +38,8 @@ import pickle
 import socket
 import struct
 import threading
+
+from ..analysis.concurrency import make_lock
 from typing import Callable, Dict, Optional
 
 __all__ = ["FrameError", "RpcError", "WorkerLostError", "RpcServer",
@@ -151,9 +153,9 @@ class RpcServer:
         self._lsock.bind((host, port))
         self._lsock.listen(64)
         self.host, self.port = self._lsock.getsockname()[:2]
-        self._closing = False
-        self._conns: list = []
-        self._lock = threading.Lock()
+        self._closing = False           # guarded_by: self._lock
+        self._conns: list = []          # guarded_by: self._lock
+        self._lock = make_lock("RpcServer._lock")
         self._accept_t: Optional[threading.Thread] = None
 
     def start(self) -> "RpcServer":
@@ -180,7 +182,7 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        wlock = threading.Lock()
+        wlock = make_lock("RpcServer.conn_wlock")
         try:
             while True:
                 try:
@@ -285,11 +287,13 @@ class RpcClient:
                                               timeout=connect_timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._wlock = threading.Lock()
-        self._lock = threading.Lock()
-        self._seq = 0
-        self._pending: Dict[int, dict] = {}
-        self._lost: Optional[str] = None
+        self._wlock = make_lock("RpcClient._wlock")
+        self._lock = make_lock("RpcClient._lock")
+        self._seq = 0                   # guarded_by: self._lock
+        self._pending: Dict[int, dict] = {}  # guarded_by: self._lock
+        # why the connection died (read lockless on the fast path —
+        # a stale None only costs one extra write_frame OSError)
+        self._lost: Optional[str] = None  # guarded_by: self._lock
         self._reader = threading.Thread(
             target=self._read_loop, name="cxn-fleet-%s-reader" % name,
             daemon=True)

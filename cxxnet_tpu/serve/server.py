@@ -49,6 +49,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.concurrency import make_condition, make_rlock
 from ..obs import devprof
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
@@ -354,15 +355,16 @@ class InferenceServer:
             max_rung=(DegradationLadder.EMERGENCY_RUNG
                       if self._tenancy is not None else 0))
         self._restarts = 0
-        self._replayed = 0
+        self._replayed = 0              # guarded_by: self._cond
         self._reserve_stalls = 0
         self._failed: Optional[EngineFailedError] = None
         self._ema_req_s = 0.0           # EMA of admit->done, feeds the
         #                                 retry_after_ms / shed estimates
         self._gen = 0
-        self._recover_lock = threading.RLock()
+        self._recover_lock = make_rlock("InferenceServer._recover_lock")
         self._heartbeat = time.perf_counter()
-        self._parked = False            # loop idle-parked (watchdog skips)
+        # loop idle-parked (watchdog skips)
+        self._parked = False            # guarded_by: self._cond
         if mesh is None and tp and int(tp) > 1:
             import jax as _jax
 
@@ -446,26 +448,27 @@ class InferenceServer:
         self._phase_h.labels(profiler.QUEUE_WAIT)
         self._stats = profiler.StepStats(
             observer=lambda name, s: self._phase_h.labels(name).observe(s))
-        self._queue: collections.deque = collections.deque()
+        self._queue: collections.deque = collections.deque()  # guarded_by: self._cond
         self._queue_cap = queue
         # disaggregated fleet (serve/fleet.py): migration records
         # adopted from a prefill-tier worker, parked here by the RPC
         # thread (adopt_swapped) and drained onto the scheduler's
         # resume list at the top of each pass — the scheduler thread is
         # the only mutator of its own swap state
-        self._adopted: collections.deque = collections.deque()
-        self._cond = threading.Condition()
+        self._adopted: collections.deque = collections.deque()  # guarded_by: self._cond
+        self._cond = make_condition("InferenceServer._cond")
         self._rid = _rid_seq
-        self._closing = False           # no new submits
+        # no new submits
+        self._closing = False           # guarded_by: self._cond
         self._drain = True              # finish queued work on shutdown?
         self._stopped = threading.Event()
         # counters + per-request latency samples for metrics(); the
         # sample reservoirs are bounded so a long-lived server's memory
         # does not grow with requests served (percentiles then describe
         # the most recent window)
-        self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
-                        "timeout": 0, "cancelled": 0, "expired": 0,
-                        "shed": 0, "error": 0}
+        self._counts = {"submitted": 0, "completed": 0,  # guarded_by: self._cond
+                        "rejected": 0, "timeout": 0, "cancelled": 0,
+                        "expired": 0, "shed": 0, "error": 0}
         if self._tenancy is not None:
             # quota rejections only exist under tenancy; the key is
             # ADDED rather than unconditional so the untenanted
@@ -477,7 +480,7 @@ class InferenceServer:
             self._tcounts = None
         self._ttft_s: collections.deque = collections.deque(maxlen=4096)
         self._tok_gap_s: collections.deque = collections.deque(maxlen=4096)
-        self._queue_depth_max = 0
+        self._queue_depth_max = 0       # guarded_by: self._cond
         self._build_stack()
         self._register_obs()
         self._idx = next(_server_seq)
@@ -1406,7 +1409,11 @@ class InferenceServer:
                         self._evaluate_ladder()
                         self._parked = True
                         try:
-                            self._cond.wait()
+                            # not a predicate loop BY DESIGN: the caller
+                            # re-enters _pass, which re-derives all
+                            # state — a spurious wakeup just costs one
+                            # scan (see the park rationale above)
+                            self._cond.wait()   # cxn-lint: disable=CXN305
                         finally:
                             # beat BEFORE unparking: the watchdog must
                             # never observe parked=False with a stale
@@ -1599,8 +1606,8 @@ class InferenceServer:
             # they already held their queue slot)
             for req in reversed(reqs):
                 self._queue.appendleft(req)
+            self._replayed += len(reqs)
             self._cond.notify_all()
-        self._replayed += len(reqs)
         t1 = time.perf_counter()
         if tr.enabled:
             # the recovery span tree on the ENGINE track: a restart is
@@ -1631,13 +1638,13 @@ class InferenceServer:
         key schedule regenerates its verified tokens bit-identically."""
         self._journal.remove(req)
         reset_for_replay(req)
-        self._replayed += 1
         if self._tracer.enabled:
             self._tracer.instant("replay_request", TID_CONTROL,
                                  cat="resilience",
                                  args={"rid": req.rid,
                                        "why": "swap corruption"})
         with self._cond:
+            self._replayed += 1
             self._queue.appendleft(req)
             self._cond.notify_all()
 

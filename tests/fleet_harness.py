@@ -88,6 +88,11 @@ def run_workers(script, ranks, tmp_path, extra=None, timeout=240,
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    # arm the lock-order watchdog (analysis/concurrency.py) in every
+    # spawned worker: both fleet suites then exercise the runtime
+    # inversion detector for free — a real inversion in the serving
+    # stack fails the worker loudly instead of deadlocking at timeout
+    env.setdefault("CXN_LOCK_WATCH", "1")
     if env_extra:
         env.update(env_extra)
     for attempt in range(attempts):

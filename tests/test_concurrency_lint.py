@@ -1,0 +1,441 @@
+"""cxn-lint pass 3: the CXN3xx host-concurrency rules (static AST
+half) and the CXN_LOCK_WATCH runtime lock-order watchdog
+(analysis/concurrency.py, doc/lint.md "Concurrency discipline").
+
+Every rule CXN301-CXN305 gets one positive fixture the analyzer must
+flag and one negative twin it must not; the watchdog tests seed a real
+two-lock inversion and assert it raises BEFORE a deadlock is possible.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cxxnet_tpu.analysis import analyze_source
+from cxxnet_tpu.analysis.concurrency import (LockOrderError, check,
+                                             make_condition, make_lock,
+                                             make_rlock, reset_watch,
+                                             violations, watch_enabled)
+from cxxnet_tpu.analysis.findings import LintReport
+
+
+def rules(src, **kw):
+    """The set of rule ids analyze_source raises on ``src``."""
+    report = analyze_source(src, path="fix.py", **kw)
+    return {f.rule for f in report.findings}
+
+
+# ===================================================================
+# CXN301: write to a guarded attribute outside `with <guard>:`
+# ===================================================================
+CXN301_POS = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0             # guarded_by: self._lock
+        # guarded_by: self._lock
+        self._items = []
+
+    def bump(self):
+        self._n += 1            # unguarded RMW
+
+    def push(self, x):
+        self._items.append(x)   # unguarded mutator
+"""
+
+CXN301_NEG = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0             # guarded_by: self._lock
+        # guarded_by: self._lock
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def _drain_locked(self):
+        self._items.clear()     # caller-holds convention: _locked suffix
+
+    def replay(self):
+        \"\"\"Caller holds ``_lock`` around the whole replay pass.\"\"\"
+        self._n += 1
+"""
+
+
+def test_cxn301_flags_unguarded_writes():
+    report = analyze_source(CXN301_POS, path="fix.py")
+    hits = [f for f in report.findings if f.rule == "CXN301"]
+    assert len(hits) == 2
+    assert {f.line for f in hits} == {12, 15}
+    assert all(f.path == "fix.py" for f in hits)
+
+
+def test_cxn301_quiet_under_lock_and_caller_holds():
+    assert "CXN301" not in rules(CXN301_NEG)
+
+
+def test_cxn301_annotation_does_not_bleed_to_next_line():
+    # a trailing guarded_by on line N must not annotate line N+1's
+    # attribute (regression: a real sweep briefly flagged the neighbor
+    # of an annotated field) — only a comment-ONLY line above carries
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a = 0             # guarded_by: self._lock
+        self._b = 0
+
+    def bump(self):
+        self._b += 1
+"""
+    assert "CXN301" not in rules(src)
+
+
+# ===================================================================
+# CXN302: lock-acquisition-order cycle
+# ===================================================================
+CXN302_POS = """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+def f():
+    with _a:
+        with _b:
+            pass
+
+def g():
+    with _b:
+        with _a:
+            pass
+"""
+
+CXN302_NEG = """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+def f():
+    with _a:
+        with _b:
+            pass
+
+def g():
+    with _a:
+        with _b:
+            pass
+"""
+
+
+def test_cxn302_flags_inverted_nesting():
+    assert "CXN302" in rules(CXN302_POS)
+
+
+def test_cxn302_quiet_on_consistent_order():
+    assert "CXN302" not in rules(CXN302_NEG)
+
+
+# ===================================================================
+# CXN303: blocking call while holding a lock
+# ===================================================================
+CXN303_POS = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = None
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def drain(self):
+        with self._lock:
+            item = self._q.get()
+        return item
+"""
+
+CXN303_NEG = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = None
+
+    def slow(self):
+        time.sleep(0.5)
+        with self._lock:
+            pass
+
+    def drain(self):
+        with self._lock:
+            item = self._q.get(timeout=1.0)
+        return item
+"""
+
+
+def test_cxn303_flags_blocking_under_lock():
+    report = analyze_source(CXN303_POS, path="fix.py")
+    hits = [f for f in report.findings if f.rule == "CXN303"]
+    assert len(hits) == 2           # the sleep and the untimed get
+
+
+def test_cxn303_quiet_outside_lock_or_timed():
+    assert "CXN303" not in rules(CXN303_NEG)
+
+
+def test_cxn303_condition_wait_on_held_lock_is_exempt():
+    # Condition.wait RELEASES its own lock while parked — waiting on
+    # the condition you hold is the one "blocking" call that is fine
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def park(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+"""
+    assert "CXN303" not in rules(src)
+
+
+# ===================================================================
+# CXN304: threading.Thread without daemon= or a tracked join
+# ===================================================================
+CXN304_POS = """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+"""
+
+CXN304_NEG = """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+class Pool:
+    def start(self, fn):
+        self._t = threading.Thread(target=fn)
+        self._t.start()
+
+    def close(self):
+        self._t.join()
+"""
+
+
+def test_cxn304_flags_untracked_thread():
+    assert "CXN304" in rules(CXN304_POS)
+
+
+def test_cxn304_quiet_with_daemon_or_join():
+    assert "CXN304" not in rules(CXN304_NEG)
+
+
+# ===================================================================
+# CXN305: untimed Condition.wait outside a predicate while loop
+# ===================================================================
+CXN305_POS = """
+import threading
+
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def park(self):
+        with self._cv:
+            self._cv.wait()
+"""
+
+CXN305_NEG = """
+import threading
+
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def park(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def poll(self):
+        with self._cv:
+            self._cv.wait(0.1)      # timed: a poll by construction
+"""
+
+
+def test_cxn305_flags_bare_wait():
+    assert "CXN305" in rules(CXN305_POS)
+
+
+def test_cxn305_quiet_in_while_or_timed():
+    assert "CXN305" not in rules(CXN305_NEG)
+
+
+# ===================================================================
+# Suppression: per-line disable + lint_ignore plumbing
+# ===================================================================
+def test_inline_disable_suppresses_one_line():
+    src = CXN305_POS.replace("self._cv.wait()",
+                             "self._cv.wait()  # cxn-lint: disable=CXN305")
+    assert "CXN305" not in rules(src)
+
+
+def test_inline_disable_is_rule_scoped():
+    # disabling a DIFFERENT rule on the line must not silence CXN305
+    src = CXN305_POS.replace("self._cv.wait()",
+                             "self._cv.wait()  # cxn-lint: disable=CXN301")
+    assert "CXN305" in rules(src)
+
+
+def test_lint_ignore_suppresses_family_rule():
+    report = LintReport(suppress=frozenset({"CXN301"}))
+    analyze_source(CXN301_POS, path="fix.py", report=report)
+    assert not [f for f in report.findings if f.rule == "CXN301"]
+    assert report.n_suppressed >= 2
+
+
+# ===================================================================
+# Runtime half: the lock-order watchdog
+# ===================================================================
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("CXN_LOCK_WATCH", "1")
+    reset_watch()
+    yield
+    reset_watch()
+
+
+def test_factories_plain_when_unarmed(monkeypatch):
+    monkeypatch.delenv("CXN_LOCK_WATCH", raising=False)
+    assert not watch_enabled()
+    # the unwatched path hands back raw primitives: zero serving-path
+    # overhead unless the env var arms the watchdog
+    assert type(make_lock("x")) is type(threading.Lock())
+    assert isinstance(make_condition("x"), threading.Condition)
+
+
+def test_watchdog_detects_seeded_inversion(armed):
+    a = make_lock("fix.A")
+    b = make_lock("fix.B")
+    with a:
+        with b:                     # observe A -> B
+            pass
+    with pytest.raises(LockOrderError):
+        with b:
+            with a:                 # the inversion: B then A
+                pass
+    assert any("inversion" in v for v in violations())
+    with pytest.raises(LockOrderError):
+        check()
+
+
+def test_watchdog_consistent_order_stays_silent(armed):
+    a = make_lock("fix.C")
+    b = make_lock("fix.D")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert violations() == []
+    check()                         # must not raise
+
+
+def test_watchdog_rlock_reentrance_is_not_a_cycle(armed):
+    r = make_rlock("fix.R")
+    with r:
+        with r:                     # depth bump, never a self-edge
+            pass
+    assert violations() == []
+
+
+def test_watchdog_condition_wait_releases_held_record(armed):
+    # while parked in cv.wait() the thread does NOT hold the lock —
+    # another thread taking an "inverted" lock order against the
+    # parked thread's condition must stay silent
+    cv = make_condition("fix.CV")
+    lk = make_lock("fix.L")
+    with cv:
+        with lk:                    # observe CV -> L
+            pass
+    woke = []
+
+    def waker():
+        time.sleep(0.05)
+        with lk:                    # L with CV *parked*: no inversion
+            pass
+        with cv:
+            woke.append(True)
+            cv.notify_all()
+
+    t = threading.Thread(target=waker, daemon=True)
+    t.start()
+    with cv:
+        while not woke:
+            cv.wait(timeout=2.0)
+    t.join(timeout=5)
+    assert woke and violations() == []
+
+
+def test_watchdog_hold_budget_records_without_raising(monkeypatch):
+    monkeypatch.setenv("CXN_LOCK_WATCH", "1")
+    monkeypatch.setenv("CXN_LOCK_HOLD_MS", "1")
+    reset_watch()
+    try:
+        lk = make_lock("fix.H")     # budget read at creation
+        with lk:
+            time.sleep(0.02)        # breach the 1 ms budget, no raise
+        assert any("budget" in v for v in violations())
+    finally:
+        reset_watch()
+
+
+def test_watchdog_survives_respawned_instances(armed):
+    # the graph keys on the creation-site NAME: a respawned worker's
+    # fresh lock objects inherit the fleet's observed ordering
+    with make_lock("fix.S1"):
+        with make_lock("fix.S2"):
+            pass
+    with pytest.raises(LockOrderError):
+        with make_lock("fix.S2"):
+            with make_lock("fix.S1"):
+                pass
+
+
+# ===================================================================
+# The swept tree itself
+# ===================================================================
+def test_package_is_clean():
+    from cxxnet_tpu.analysis import lint_threads
+    report = lint_threads(report=LintReport())
+    assert report.ok(), report.format()

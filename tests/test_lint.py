@@ -328,6 +328,18 @@ def test_tools_cxn_lint_all_examples():
     assert mod.main(["--all-examples", "--quiet"]) == 0
 
 
+def test_tools_cxn_lint_threads():
+    """Tier-1 gate: the CXN3xx concurrency lint (pass 3) must stay
+    clean over the whole package — a guarded write drifting out from
+    under its lock fails CI here, not in a fleet-suite deadlock."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cxn_lint", os.path.join(_REPO, "tools", "cxn_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--threads", "--quiet"]) == 0
+
+
 def test_wrapper_lint_surface():
     from cxxnet_tpu import wrapper
     net = wrapper.Net(cfg=NET_CFG + "bacth_size = 1\n")

@@ -5,6 +5,7 @@ steps) from the command line.
     python tools/cxn_lint.py <config> [<config> ...] [k=v ...]
     python tools/cxn_lint.py --all-examples
     python tools/cxn_lint.py --compile <config>
+    python tools/cxn_lint.py --threads
 
 ``--all-examples`` lints every ``example/**/*.conf`` (pass 1 only — no
 data files or devices are needed, so this is the fast tier-1 CI check;
@@ -34,6 +35,15 @@ audited step's line now reports its AOT lower+compile seconds, and
 compiling over the budget fails the lint with CXN207, so compile-time
 regressions are caught the same way collective-count regressions are.
 ``k=v`` args are CLI-style overrides linted as line-less pairs.
+
+``--threads`` runs pass 3 — the CXN3xx concurrency lint — over the
+installed ``cxxnet_tpu`` package source: ``# guarded_by:`` write
+discipline (CXN301), lock-acquisition-order cycles (CXN302), blocking
+calls under a lock (CXN303), unjoinable non-daemon threads (CXN304),
+and untimed ``Condition.wait`` outside a predicate loop (CXN305). Like
+``--all-examples`` it needs no data files or devices (pure AST), so
+tests/test_lint.py wires it into the tier-1 gate. It composes with
+config paths (both passes run) or stands alone.
 
 Exit codes: 0 clean (warnings allowed), 1 lint errors, 2 usage error.
 """
@@ -251,13 +261,27 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
     return report.exit_code()
 
 
+def lint_threads_pass(verbose=True) -> int:
+    """Pass 3 over the package tree (no config needed — pure AST)."""
+    from cxxnet_tpu.analysis import lint_threads
+    from cxxnet_tpu.analysis.findings import LintReport
+    report = LintReport()
+    lint_threads(report=report)
+    if verbose or not report.ok():
+        print("== cxxnet_tpu (threads)")
+        print(report.format())
+    return report.exit_code()
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     do_compile = "--compile" in argv
     all_examples = "--all-examples" in argv
+    do_threads = "--threads" in argv
     quiet = "--quiet" in argv
     argv = [a for a in argv
-            if a not in ("--compile", "--all-examples", "--quiet")]
+            if a not in ("--compile", "--all-examples", "--threads",
+                         "--quiet")]
     overrides = []
     paths = []
     for a in argv:
@@ -269,10 +293,12 @@ def main(argv=None) -> int:
     if all_examples:
         paths += sorted(glob.glob(os.path.join(_REPO, "example", "*",
                                                "*.conf")))
-    if not paths:
+    if not paths and not do_threads:
         print(__doc__.strip(), file=sys.stderr)
         return 2
     rc = 0
+    if do_threads:
+        rc |= lint_threads_pass(verbose=not quiet)
     for p in paths:
         if not os.path.exists(p):
             print("cannot open config %r" % p, file=sys.stderr)
@@ -280,9 +306,11 @@ def main(argv=None) -> int:
         rc |= lint_one(p, overrides, do_compile=do_compile,
                        verbose=not quiet)
     if not quiet:
-        print("cxn-lint: %d config(s), %s" % (len(paths),
-                                              "clean" if rc == 0
-                                              else "FAILED"))
+        what = "%d config(s)" % len(paths) if paths else "threads pass"
+        if paths and do_threads:
+            what += " + threads pass"
+        print("cxn-lint: %s, %s" % (what, "clean" if rc == 0
+                                    else "FAILED"))
     return rc
 
 

@@ -128,6 +128,7 @@ _DEFAULT_CELL_TOL = {
     #                                 bench.py, not on relative drift
     "train_feed_overlap": 0.15,
     "lint_wall_ms": 0.50,
+    "lint_threads_wall_ms": 0.50,   # same shared-core wall noise band
 }
 
 
