@@ -1153,6 +1153,109 @@ def bench_serve_int8():
          spec_bf16_ms_per_token=round(bf_ms, 4))
 
 
+# the int4 weight-streaming cell's geometry: weight-heavy on purpose —
+# feat 640 puts ~20 MiB of int8 (~10 MiB int4-packed) block weights
+# against a ~3 MiB KV budget, so the device working set (KV pool +
+# resident weight pool) is weight-dominated and the packed-nibble
+# pool's 2x-under-int8 / 4x-under-bf16 shrink shows up in the
+# denominator the way HBM sees it. Short prompts keep the live KV
+# working set INSIDE the budget (no preempt/swap storms): unlike the
+# int8 cell this one prices the weight stream, not KV capacity, and
+# the swap regime's wall-clock noise would drown a weight-pool ratio.
+# All three arms share the bf16 KV pool at the SAME serve_kv_mb so the
+# block-capacity schedule is identical and ONLY the weight stream
+# differs between arms.
+INT4_CELL = dict(layers=4, heads=4, feat=640, seq=128, vocab=64,
+                 slots=4, n_requests=12, mean_gap_ms=2.0, seed=1,
+                 prefix_len=32, suffix=(4, 8, 12), max_new=(8, 16),
+                 chunk=32, budget=4)
+
+
+def bench_serve_int4():
+    """Int4 weight-streaming cell (doc/serving.md "Int4 weights"): the
+    shared-prefix Poisson trace served three times at the SAME
+    ``serve_kv_mb`` budget — bf16 weights, int8 weights, and packed
+    int4 weights (per-out-column scales, ``serve_int4_group=0``) — with
+    the metric pricing the whole device working set: steady-state
+    tokens/s per MiB of (KV pool + resident weight pool), the weight
+    pool read from the device-memory ledger so the int4 arm is priced
+    at its PACKED bytes. Emits ``serve_tokens_per_mib_int4``
+    (vs_baseline = int4 / int8 at equal KV MiB; acceptance gate >= 1.5
+    — the packed pool halves the int8 arm's weight bytes while the
+    fused dequant-matmul keeps the unpack off HBM) and
+    ``gpt_decode_int4_ms_per_token`` — the offline DECODE_CELL decode
+    with int4 weight streaming (vs_baseline = the same run at full
+    precision; on the CPU rig this pins the dequant machinery's
+    overhead, the HBM-bandwidth win being a TPU rig's to record)."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_decode, gpt_init
+
+    c = dict(INT4_CELL)
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1, dtype="bfloat16")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    trace = serve_prefix_trace(c)
+    # the shared budget: exactly the live working set — one shared
+    # prefix block plus two private blocks per slot (suffix + generated
+    # tokens span at most two block windows). Every arm fits, nothing
+    # swaps, and the tokens/s numerator stays in the low-noise regime;
+    # the denominator does the discriminating.
+    hd = c["feat"] // c["heads"]
+    block_mib = (2 * c["layers"] * c["heads"] * c["chunk"] * hd * 2) \
+        / 2.0 ** 20
+    mib = (1 + 2 * c["slots"]) * block_mib
+    kw = dict(queue=c["n_requests"], prefill_chunk=c["chunk"],
+              prefill_budget=c["budget"], prefix_mb=16.0,
+              slots=c["slots"], kv_mb=mib)
+
+    def arm(**qkw):
+        wall, m = run_serve_trace(cfg, params, trace, **kw, **qkw)
+        wmib = m["device_bytes"]["pools"]["params"] / 2.0 ** 20
+        return m["tokens_generated"] / wall / (mib + wmib), wmib, m
+
+    tpm_b, wmib_b, _ = arm()
+    tpm_8, wmib_8, _ = arm(int8_weights=True)
+    tpm_4, wmib_4, m4 = arm(int4_weights=True, int4_group=0)
+    emit("serve_tokens_per_mib_int4", tpm_4, "tokens/sec/MiB",
+         tpm_4 / max(tpm_8, 1e-9),
+         int8_tokens_per_mib=round(tpm_8, 4),
+         bf16_tokens_per_mib=round(tpm_b, 4), kv_mib=round(mib, 1),
+         weight_mib_bf16=round(wmib_b, 2),
+         weight_mib_int8=round(wmib_8, 2),
+         weight_mib_int4=round(wmib_4, 2),
+         int4_formulation=m4["int4_formulation"] or "xla_ref")
+
+    # offline int4 decode: the decode cell's exact prompt, both arms in
+    # this run; per-column scales keep the CPU reference dequant a
+    # single unpack + dot per weight (the grouped kernel path is the
+    # TPU rig's measurement)
+    d = DECODE_CELL
+    dcfg = GPTConfig(vocab_size=256, seq_len=d["seq"],
+                     n_layer=d["layers"], n_head=d["heads"],
+                     feat=d["feat"], n_microbatch=1, dtype="bfloat16")
+    dparams = gpt_init(jax.random.PRNGKey(0), dcfg)
+    rs = np.random.RandomState(0)
+    prompt = jax.numpy.asarray(
+        rs.randint(0, 256, (1, d["prompt_len"])).astype(np.int32))
+    max_new = 64
+
+    def run(int4):
+        qkw = dict(int4_weights=int4, int4_group=0) if int4 else {}
+        np.asarray(gpt_decode(dparams, prompt, max_new, dcfg, **qkw))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(gpt_decode(dparams, prompt, max_new, dcfg, **qkw))
+            best = min(best, time.perf_counter() - t0)
+        return best / max_new * 1e3
+
+    bf_ms = run(False)
+    i4_ms = run(True)
+    emit("gpt_decode_int4_ms_per_token", i4_ms, "ms/token",
+         bf_ms / i4_ms, bf16_ms_per_token=round(bf_ms, 4))
+
+
 # the sharded/replicated serving cell (round 17, doc/serving.md
 # "Sharded & replicated serving"): small geometry — the POINT on a CPU
 # rig is exercising the real partitioned programs / router machinery
@@ -1738,7 +1841,8 @@ def main() -> int:
                bench_moe, bench_decode, bench_decode_spec, bench_serve,
                bench_serve_prefill_heavy, bench_serve_paged,
                bench_serve_fused, bench_serve_longctx,
-               bench_serve_autotune, bench_serve_int8, bench_serve_sharded,
+               bench_serve_autotune, bench_serve_int8, bench_serve_int4,
+               bench_serve_sharded,
                bench_serve_replicated, bench_serve_fleet,
                bench_serve_tenanted,
                bench_serve_spec, bench_serve_cold_start,

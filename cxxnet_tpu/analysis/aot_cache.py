@@ -211,16 +211,19 @@ def relabel_active() -> bool:
 
 
 def tuned_components(config: str, chunk: int, kv_dtype: str = "",
-                     tp: int = 1) -> Dict[str, str]:
+                     tp: int = 1, weights: str = "") -> Dict[str, str]:
     """The key of one persisted geometry-autotune winner
     (``task=autotune`` → ``serve_block_size=auto``): device kind +
     backend + model geometry (the config hash) + prefill chunk +
-    KV dtype + TP degree — everything that changes which
-    ``serve_block_size`` wins. Deliberately NOT keyed on jax/jaxlib
-    versions (a timing winner survives an upgrade; the executables it
-    points at re-warm under their own versioned keys) but keyed on the
-    interpret flag: interpret-mode timings say nothing about a real
-    backend."""
+    KV dtype + TP degree + weight stream (``weights``: the
+    ``serve.engine.weight_stream_tag`` spelling — "int8" / "int4:gN" /
+    "" for full precision; int4 swaps the hot matmul formulation, so
+    its winner must never leak to a bf16 engine) — everything that
+    changes which ``serve_block_size`` wins. Deliberately NOT keyed on
+    jax/jaxlib versions (a timing winner survives an upgrade; the
+    executables it points at re-warm under their own versioned keys)
+    but keyed on the interpret flag: interpret-mode timings say nothing
+    about a real backend."""
     import jax
     dev = jax.devices()[0]
     return {
@@ -229,6 +232,7 @@ def tuned_components(config: str, chunk: int, kv_dtype: str = "",
         "chunk": str(int(chunk)),
         "kv": str(kv_dtype or "").lower() or "none",
         "tp": str(int(tp)),
+        "w": str(weights or "") or "none",
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "")),
         "interpret": str(int(_interpret_flag())),

@@ -47,6 +47,10 @@ RULES = {
                         "program's key no longer matches the current "
                         "config/mesh/backend/jax version (the drifting "
                         "component is named)"),
+    "CXN211": ("error", "unpacked int4 weight tensor materialized in "
+                        "HBM where the fused dequant-matmul should be "
+                        "active (the nibble unpack belongs inside the "
+                        "kernel tile's VMEM)"),
     # ---- pass 3: host-concurrency lint (AST, no devices) ----
     "CXN301": ("error", "write to a `# guarded_by:` attribute outside "
                         "any `with <guard>:` block in a thread-reachable "

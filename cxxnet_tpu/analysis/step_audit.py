@@ -150,19 +150,69 @@ def entry_clamp_count(hlo_text: str) -> int:
 
 
 _INT8_PROMOTE_RE = re.compile(
-    r"convert\s+[^:\n]*:\s*\(tensor<[^>]*xi8>\)\s*->\s*tensor<[^>]*xf32>")
+    r"convert\s+[^:\n]*:\s*\(tensor<[^>]*x(?:u?i8|u?i4)>\)"
+    r"\s*->\s*tensor<[^>]*xf32>")
 
 
 def int8_promotions(stable: str) -> int:
-    """StableHLO converts of an i8 tensor STRAIGHT to f32. Inside a
-    bf16 quantized serve program (serve_int8_weights /
-    serve_kv_dtype=int8) every int8 operand must dequantize to the
-    COMPUTE dtype — int8 values are exact in bf16's 8 mantissa bits, so
-    an i8->f32 convert means some op silently widened the quantized
-    stream (doubling the very bytes quantization halved) instead of
-    computing in bf16; CXN209 names it. f32-compute configs are exempt:
-    there f32 IS the dequant target."""
+    """StableHLO converts of a narrow-integer tensor STRAIGHT to f32.
+    Inside a bf16 quantized serve program (serve_int8_weights /
+    serve_int4_weights / serve_kv_dtype=int8) every quantized operand
+    must dequantize to the COMPUTE dtype — int8 values and int4 nibble
+    codes are exact in bf16's 8 mantissa bits, so an i8/ui8/i4/ui4 ->
+    f32 convert means some op silently widened the quantized stream
+    (doubling or quadrupling the very bytes quantization shrank)
+    instead of computing in bf16; CXN209 names it. f32-compute configs
+    are exempt: there f32 IS the dequant target."""
     return len(_INT8_PROMOTE_RE.findall(stable))
+
+
+# a convert out of the packed-int4 unpack chain (i8 codes, or a ui8
+# byte that skipped the signed hop) into EITHER float dtype — CXN211
+# flags these only when the tensor's trailing dims equal an unpacked
+# quantized-weight image (k, n), i.e. the full-width dequant buffer the
+# fused dequant-matmul exists to keep out of HBM
+_INT4_DEQUANT_RE = re.compile(
+    r"convert\s+[^:\n]*:\s*\(tensor<([0-9x]*)x(?:u?i8|u?i4)>\)"
+    r"\s*->\s*tensor<[0-9x]*x(?:f32|bf16)>")
+_HLO_INT4_DEQUANT_RE = re.compile(
+    r"=\s*(?:f32|bf16)\[([\d,]*)\]\S*\s+convert\(\s*[su]8\[")
+
+
+def _trailing2(dims_txt: str, sep: str):
+    parts = [p for p in dims_txt.split(sep) if p]
+    if len(parts) < 2:
+        return None
+    return int(parts[-2]), int(parts[-1])
+
+
+def int4_dequant_buffers(stable: str, weight_shapes) -> int:
+    """Count StableHLO converts that materialize a FULL-WIDTH unpacked
+    int4 weight: an i8/ui8 (or i4/ui4) tensor whose trailing two dims
+    equal one of ``weight_shapes`` — the set of unpacked (k, n) images
+    of the engine's quantized matmul weights — converting to f32/bf16.
+    When the fused dequant-matmul should be active, the unpack lives in
+    VMEM inside the kernel tile; a match here means the program built
+    the dequantized weight in HBM anyway (the exact traffic int4
+    packing exists to remove). CXN211 names it."""
+    shapes = {tuple(s) for s in weight_shapes}
+    n = 0
+    for m in _INT4_DEQUANT_RE.finditer(stable):
+        if _trailing2(m.group(1), "x") in shapes:
+            n += 1
+    return n
+
+
+def int4_dequant_buffers_hlo(hlo_text: str, weight_shapes) -> int:
+    """Optimized-HLO twin of :func:`int4_dequant_buffers` for the
+    artifact validator (cache-loaded executables render no
+    StableHLO)."""
+    shapes = {tuple(s) for s in weight_shapes}
+    n = 0
+    for m in _HLO_INT4_DEQUANT_RE.finditer(hlo_text):
+        if _trailing2(m.group(1), ",") in shapes:
+            n += 1
+    return n
 
 
 def format_step_info(info: Dict) -> str:
@@ -185,6 +235,13 @@ def format_step_info(info: Dict) -> str:
         line += " int8=%s" % ("clean" if info["int8_promotions"] == 0
                               else "%d promoted"
                               % info["int8_promotions"])
+    if "int4_dequants" in info:
+        # the int4-streaming audit's in-VMEM-unpack assertion (CXN211):
+        # "clean" means no full-width dequantized weight image was
+        # materialized where the fused dequant-matmul should be active
+        line += " int4=%s" % ("clean" if info["int4_dequants"] == 0
+                              else "%d materialized"
+                              % info["int4_dequants"])
     if info.get("shardings"):
         # a sharded audit names its input placements, so the step table
         # shows the executable was partitioned (not a 1-device lookalike)
@@ -198,7 +255,8 @@ def audit_jit(fn, args: tuple, label: str,
               collective_budget: Optional[int] = None,
               compile_budget_s: Optional[float] = None,
               check_clip: bool = False,
-              check_int8: bool = False) -> Tuple[List[Finding], Dict]:
+              check_int8: bool = False,
+              check_int4=None) -> Tuple[List[Finding], Dict]:
     """Audit one jitted function AOT. Returns (findings, info) where info
     carries the raw counts ({"collectives", "donated", "aliased"}) plus
     the step's measured AOT lower+compile seconds ("compile_s") — the
@@ -207,7 +265,10 @@ def audit_jit(fn, args: tuple, label: str,
     collective counts are by ``lint_collective_budget``.
     ``check_int8`` (bf16 quantized serve programs) additionally asserts
     no int8 operand is silently promoted to f32 (CXN209,
-    :func:`int8_promotions`)."""
+    :func:`int8_promotions`). ``check_int4`` (a set of unpacked (k, n)
+    weight shapes, or None) asserts no full-width dequantized int4
+    weight is materialized where the fused dequant-matmul should be
+    active (CXN211, :func:`int4_dequant_buffers`)."""
     import time
     import warnings
     findings: List[Finding] = []
@@ -319,16 +380,26 @@ def audit_jit(fn, args: tuple, label: str,
                 "bf16), or the step silently re-widens the very "
                 "stream quantization halved"
                 % (label, info["int8_promotions"])))
+    if check_int4:
+        info["int4_dequants"] = int4_dequant_buffers(stable, check_int4)
+        if info["int4_dequants"] > 0:
+            findings.append(Finding(
+                "CXN211", "%s: %d full-width unpacked int4 weight "
+                "tensor(s) materialized in HBM — the fused dequant-"
+                "matmul is active for this geometry, so the nibble "
+                "unpack must stay inside the kernel tile's VMEM; a "
+                "materialized dequant buffer re-streams the very bytes "
+                "packing removed" % (label, info["int4_dequants"])))
     return findings, info
 
 
 _HLO_INT8_PROMOTE_RE = re.compile(
-    r"=\s*f32\[[^\]]*\]\S*\s+convert\(\s*s8\[")
+    r"=\s*f32\[[^\]]*\]\S*\s+convert\(\s*[su][48]\[")
 
 
 def int8_promotions_hlo(hlo_text: str) -> int:
-    """The optimized-HLO twin of :func:`int8_promotions` — ``s8 -> f32``
-    converts in the compiled executable's text. The artifact validator
+    """The optimized-HLO twin of :func:`int8_promotions` — ``s8/u8/s4/
+    u4 -> f32`` converts in the compiled executable's text. The artifact validator
     only holds the deserialized executable (no StableHLO render
     exists for a loaded program), so CXN209 checks the same contract
     at the HLO level there."""
@@ -338,8 +409,9 @@ def int8_promotions_hlo(hlo_text: str) -> int:
 def audit_executable(compiled, label: str, requested_donations: int = 0,
                      collective_budget: Optional[int] = None,
                      check_clip: bool = False,
-                     check_int8: bool = False) -> Tuple[List[Finding],
-                                                        Dict]:
+                     check_int8: bool = False,
+                     check_int4=None) -> Tuple[List[Finding],
+                                               Dict]:
     """Audit one ALREADY-COMPILED (typically cache-loaded) executable —
     the artifact-validator half of :func:`audit_jit`, for programs with
     no lowering to inspect: donation aliasing (CXN201, via the
@@ -383,7 +455,54 @@ def audit_executable(compiled, label: str, requested_donations: int = 0,
                 "CXN209", "%s: cached executable converts %d int8 "
                 "operand(s) straight to f32 inside a bf16 quantized "
                 "step" % (label, info["int8_promotions"])))
+    if check_int4:
+        info["int4_dequants"] = int4_dequant_buffers_hlo(hlo, check_int4)
+        if info["int4_dequants"] > 0:
+            findings.append(Finding(
+                "CXN211", "%s: cached executable materializes %d "
+                "full-width unpacked int4 weight tensor(s) — the "
+                "nibble unpack must stay inside the fused dequant-"
+                "matmul's VMEM tile for this geometry"
+                % (label, info["int4_dequants"])))
     return findings, info
+
+
+def _int4_check_shapes(engine, label: str):
+    """The CXN211 arming decision for ONE serve program: the set of
+    unpacked (k, n) weight images to scan for, or None when the check
+    does not apply. Armed only when the engine streams int4 AND every
+    one of the program's four hot matmuls passes the fused dequant-
+    matmul's geometry gate at the program's own row count — programs
+    the gate routes to the XLA reference unpack full-width BY DESIGN
+    (that IS the reference formulation), so flagging them would make
+    the lint cry wolf on every CPU rig."""
+    if not getattr(engine, "int4_weights", False) \
+            or getattr(engine, "int4_formulation", "") != "fused":
+        return None
+    if "verify" in label:
+        m = engine.slots * (engine.spec_len + 1)
+    elif "tick" in label:
+        m = engine.slots
+    elif "chunk" in label:
+        m = engine.chunk
+    else:
+        return None
+    from ..models.gpt import QUANT_DECODE_PAIRS
+    from ..ops.pallas_kernels import int4_matmul_supported
+    citem = 2 if engine.cfg.dtype == "bfloat16" else 4
+    shapes = set()
+    for wk, sk in QUANT_DECODE_PAIRS:
+        w = engine._blocks.get(wk)
+        s = engine._blocks.get(sk)
+        if w is None or s is None:
+            return None
+        k, n = int(w.shape[-2]), int(s.shape[-1])
+        g = int(s.shape[-2])
+        if 2 * int(w.shape[-1]) != n or k % g \
+                or not int4_matmul_supported(m, k, n, g, itemsize=citem):
+            return None
+        shapes.add((k, n))
+    return shapes
 
 
 def audit_aot_artifacts(engine, cache,
@@ -413,7 +532,8 @@ def audit_aot_artifacts(engine, cache,
         cache = get_cache(cache)
     paged = bool(getattr(engine, "paged", False))
     quant = bool(getattr(engine, "int8_weights", False)
-                 or getattr(engine, "kv_int8", False))
+                 or getattr(engine, "kv_int8", False)
+                 or getattr(engine, "int4_weights", False))
     check_int8 = quant and getattr(engine, "cfg", None) is not None \
         and engine.cfg.dtype == "bfloat16"
     cfg_hash = config_hash(engine._cfg_key)
@@ -455,7 +575,8 @@ def audit_aot_artifacts(engine, cache,
             requested_donations=_requested_donations(args, donate_nums,
                                                      ()),
             collective_budget=collective_budget,
-            check_clip=paged, check_int8=check_int8)
+            check_clip=paged, check_int8=check_int8,
+            check_int4=_int4_check_shapes(engine, label))
         info["aot"] = "ok"
         report.extend(findings)
         infos.append(info)
@@ -564,13 +685,18 @@ def audit_serve_engine(engine, n_prompt: int = 8,
     report = LintReport()
     infos = []
     paged = bool(getattr(engine, "paged", False))
-    # quantized engines (serve_int8_weights / serve_kv_dtype=int8) with
-    # bf16 compute additionally assert no int8 operand is silently
-    # promoted to f32 (CXN209, the `int8=clean` column) — the audited
-    # rows ARE the int8 variants: lint_specs hands over the engine's
-    # own quantized blocks and (values, scales) pool structs
+    # quantized engines (serve_int8_weights / serve_int4_weights /
+    # serve_kv_dtype=int8) with bf16 compute additionally assert no
+    # quantized operand is silently promoted to f32 (CXN209, the
+    # `int8=clean` column) — the audited rows ARE the quantized
+    # variants: lint_specs hands over the engine's own quantized blocks
+    # and (values, scales) pool structs. Int4 engines whose fused
+    # dequant-matmul resolved ON additionally assert no full-width
+    # unpacked weight is materialized (CXN211, the `int4=clean` column;
+    # armed per program by _int4_check_shapes).
     quant = bool(getattr(engine, "int8_weights", False)
-                 or getattr(engine, "kv_int8", False))
+                 or getattr(engine, "kv_int8", False)
+                 or getattr(engine, "int4_weights", False))
     check_int8 = quant and getattr(engine, "cfg", None) is not None \
         and engine.cfg.dtype == "bfloat16"
     for label, fn, args, donate_nums in engine.lint_specs(
@@ -580,7 +706,9 @@ def audit_serve_engine(engine, n_prompt: int = 8,
                                    collective_budget=collective_budget,
                                    compile_budget_s=compile_budget_s,
                                    check_clip=paged,
-                                   check_int8=check_int8)
+                                   check_int8=check_int8,
+                                   check_int4=_int4_check_shapes(
+                                       engine, label))
         report.extend(findings)
         infos.append(info)
     return report, infos

@@ -114,6 +114,19 @@ class LearnTask:
         #                                 build; speculative verify
         #                                 included; 0 = full-precision
         #                                 weights, a pinned no-op)
+        self.serve_int4_weights = 0     # stream them PACKED int4
+        #                                 instead: two nibbles per byte,
+        #                                 group-wise symmetric scales,
+        #                                 fused Pallas dequant-matmul
+        #                                 where the geometry gate
+        #                                 passes (doc/serving.md "Int4
+        #                                 weights"; exclusive with
+        #                                 serve_int8_weights; 0 = a
+        #                                 pinned no-op)
+        self.serve_int4_group = 64      # scale-group size in in-rows
+        #                                 for serve_int4_weights (0 =
+        #                                 one group = per-out-column
+        #                                 scales)
         self.serve_kv_dtype = ""  # KV block-pool stored dtype: "" =
         #                           the compute dtype; "int8" = per-
         #                           block-scaled int8 (values, scales)
@@ -321,6 +334,10 @@ class LearnTask:
             self.serve_fused_attn = int(val)
         elif name == "serve_int8_weights":
             self.serve_int8_weights = int(val)
+        elif name == "serve_int4_weights":
+            self.serve_int4_weights = int(val)
+        elif name == "serve_int4_group":
+            self.serve_int4_group = int(val)
         elif name == "serve_kv_dtype":
             self.serve_kv_dtype = val
         elif name == "serve_chaos":
@@ -1028,8 +1045,25 @@ class LearnTask:
                                block_size=self.serve_block_size,
                                fused_attn=bool(self.serve_fused_attn),
                                int8_weights=bool(self.serve_int8_weights),
+                               int4_weights=bool(self.serve_int4_weights),
+                               int4_group=int(self.serve_int4_group),
                                kv_dtype=self.serve_kv_dtype,
                                aot=self.aot_cache or None)
+            # the weight pool the serve programs actually stream — the
+            # PACKED byte count under int8/int4 (nibbles + scale
+            # planes), exactly what cxn_device_bytes{pool=params}
+            # prices, so a quantization knob that silently failed to
+            # shrink the pool is visible on the first prof line
+            wtag = ("int4(group=%d)" % eng.int4_group
+                    if eng.int4_weights else
+                    "int8" if eng.int8_weights else
+                    ("bf16" if gcfg.dtype == "bfloat16" else "f32"))
+            wb = devprof.tree_nbytes((eng._blocks, eng._outer))
+            print("serve weight pool: dtype=%s, %.2f MiB resident "
+                  "(formulation=%s)"
+                  % (wtag, wb / (1 << 20),
+                     (eng.int4_formulation or "reference")
+                     if eng.int4_weights else "n/a"))
             table.merge(devprof.profile_engine(
                 eng, registry=reg, time_reps=self.prof_reps))
             if self.aot_cache:
@@ -1133,6 +1167,8 @@ class LearnTask:
                 num_blocks=nb, block_size=bs, spec_len=spec,
                 fused_attn=bool(self.serve_fused_attn), mesh=mesh,
                 int8_weights=bool(self.serve_int8_weights),
+                int4_weights=bool(self.serve_int4_weights),
+                int4_group=int(self.serve_int4_group),
                 kv_dtype=self.serve_kv_dtype, aot=cache)
             table = devprof.profile_engine(eng, registry=reg,
                                            time_reps=reps)
@@ -1166,9 +1202,13 @@ class LearnTask:
               % (winner["block_size"], winner["formulation"],
                  winner["tick_ms"], len(rows), wall_ms))
         if cache is not None:
+            from .serve.engine import weight_stream_tag
             comp = aot_mod.tuned_components(
                 aot_mod.config_hash(dataclasses.astuple(gcfg)), chunk,
-                self.serve_kv_dtype, self.serve_tp if mesh else 1)
+                self.serve_kv_dtype, self.serve_tp if mesh else 1,
+                weight_stream_tag(bool(self.serve_int8_weights),
+                                  bool(self.serve_int4_weights),
+                                  int(self.serve_int4_group)))
             if cache.store_tuned(comp, record):
                 print("autotune: winner persisted to %s (load it with "
                       "serve_block_size=auto)" % cache_path)
@@ -1242,6 +1282,8 @@ class LearnTask:
                          kv_mb=self.serve_kv_mb,
                          fused_attn=bool(self.serve_fused_attn),
                          int8_weights=bool(self.serve_int8_weights),
+                         int4_weights=bool(self.serve_int4_weights),
+                         int4_group=int(self.serve_int4_group),
                          kv_dtype=self.serve_kv_dtype,
                          recompile_limit=self.net.lint_recompile_limit,
                          recompile_strict=bool(
@@ -1312,6 +1354,8 @@ class LearnTask:
                 mode += ", tp=%d (KV head-sharded)" % self.serve_tp
             if self.serve_int8_weights:
                 mode += ", int8 weights"
+            if self.serve_int4_weights:
+                mode += ", int4 weights (group %d)" % self.serve_int4_group
             if routed:
                 mode += ", %d replicas (%s router)" % (
                     self.serve_replicas, self.serve_router)

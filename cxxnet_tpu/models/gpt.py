@@ -197,8 +197,17 @@ def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str):
     programs are byte-for-byte unchanged. The int8 weight converts to
     the COMPUTE dtype (never silently to f32 — the CXN209 audit
     contract; int8 values are exactly representable in bf16's 8
-    mantissa bits)."""
-    y = x @ p[wk].astype(x.dtype)
+    mantissa bits).
+
+    A uint8 weight means PACKED int4 nibbles (_quantize_decode_blocks
+    _int4): group-wise scales on the CONTRACTION dim do not commute
+    with the matmul, so the whole product routes to _qmat4 (per-group
+    partials scaled before the cross-group sum). The dtype check is
+    static too — bf16/f32 and int8 programs keep their exact jaxpr."""
+    w = p[wk]
+    if w.dtype == jnp.uint8:
+        return _qmat4(x, w, p[sk])
+    y = x @ w.astype(x.dtype)
     if sk in p:
         y = y * p[sk].astype(x.dtype)
     return y
@@ -791,11 +800,144 @@ def _dequantize_decode_blocks(qblocks: Dict, dtype=jnp.float32) -> Dict:
     return bl
 
 
+# ---------------------------------------------------------------------------
+# int4 weight streaming (round 19): two nibbles per byte along the
+# out-column dim, group-wise symmetric scales over in-rows. The group
+# scales sit on the CONTRACTION dim, so (unlike int8's per-out-column
+# scheme) dequant does NOT commute with the matmul — _qmat4 scales each
+# group's partial product before the cross-group sum, and the Pallas
+# kernel (ops/pallas_kernels.int4_matmul) does the same accumulation
+# with the unpack in VMEM so the unpacked weight never touches HBM.
+
+INT4_GROUP_DEFAULT = 64
+
+
+def _int4_groups(k: int, group: int) -> int:
+    """Number of scale groups for k in-rows: ceil(k / group), or ONE
+    group (= per-out-column scaling) when group <= 0."""
+    return 1 if group <= 0 else -(-k // group)
+
+
+def _pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """int8 codes in [-7, 7] (..., k, n) -> packed uint8 (..., k, n/2).
+    Halves layout: byte column j holds out-column j in the LOW nibble
+    and out-column j + n/2 in the HIGH nibble (offset-8 codes), so the
+    unpack is one lane-dim concatenate — no interleave reshape, which
+    Mosaic would materialize. n must be even (the quantizer pads)."""
+    half = q.shape[-1] // 2
+    u = (q + jnp.int8(8)).astype(jnp.uint8)
+    return u[..., :half] | (u[..., half:] << jnp.uint8(4))
+
+
+def _unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """packed uint8 (..., k, n/2) -> int8 codes (..., k, n); exact
+    inverse of :func:`_pack_int4`. The uint8 -> int8 hop happens BEFORE
+    any float convert (the CXN209/CXN211 audit contract: nibble codes
+    are exact in bf16's 8 mantissa bits, so no silent f32 promotion)."""
+    lo = (packed & jnp.uint8(0xF)).astype(jnp.int8) - jnp.int8(8)
+    hi = (packed >> jnp.uint8(4)).astype(jnp.int8) - jnp.int8(8)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def _quantize_decode_blocks_int4(blocks: Dict,
+                                 group: int = INT4_GROUP_DEFAULT) -> Dict:
+    """Group-wise symmetric int4 quantization of the four matmul weights
+    in the fused-QKV block dict: scale[l, g, j] = max over the g-th
+    in-row group of |w[l, :, j]| / 7, codes clipped to [-7, 7] and
+    packed two-per-byte (_pack_int4). Groups are BALANCED — G =
+    ceil(k / group) groups of g0 = ceil(k / G) rows, last group ragged
+    — so G and g0 re-derive from the scale plane's shape alone and the
+    fast kernel's equal-block grid applies whenever G divides k.
+    Biases/LN stay exact; odd out-widths pad one zero column (packed
+    only — the scale plane keeps the true n)."""
+    bl = dict(blocks)
+    for wk, sk in QUANT_DECODE_PAIRS:
+        w = bl[wk].astype(jnp.float32)                 # (L, k, n)
+        L, k, n = w.shape
+        G = _int4_groups(k, group)
+        g0 = -(-k // G)
+        rows = jnp.minimum(jnp.arange(k) // g0, G - 1)
+        wg = jnp.pad(w, ((0, 0), (0, G * g0 - k), (0, 0)))
+        wg = wg.reshape(L, G, g0, n)
+        s = jnp.maximum(jnp.max(jnp.abs(wg), axis=2) / 7.0, 1e-8)
+        q = jnp.clip(jnp.round(w / s[:, rows, :]), -7, 7).astype(jnp.int8)
+        if n % 2:
+            q = jnp.pad(q, ((0, 0), (0, 0), (0, 1)))
+        bl[wk] = _pack_int4(q)                         # (L, k, ~n/2) u8
+        bl[sk] = s                                     # (L, G, n) f32
+    return bl
+
+
+def _dequantize_decode_blocks_int4(qblocks: Dict,
+                                   dtype=jnp.float32) -> Dict:
+    """Inverse of :func:`_quantize_decode_blocks_int4` up to the int4
+    rounding (tests compare programs on packed inputs against programs
+    on these)."""
+    bl = dict(qblocks)
+    for wk, sk in QUANT_DECODE_PAIRS:
+        s = bl.pop(sk)                                 # (L, G, n)
+        q = _unpack_int4(bl[wk])                       # (L, k, n_pad)
+        k = q.shape[1]
+        G, n = int(s.shape[1]), int(s.shape[2])
+        g0 = -(-k // G)
+        rows = jnp.minimum(jnp.arange(k) // g0, G - 1)
+        bl[wk] = (q[..., :n].astype(jnp.float32)
+                  * s[:, rows, :]).astype(dtype)
+    return bl
+
+
+def _qmat4_ref(x, packed, scales):
+    """XLA reference for the packed-int4 matmul — mirrors the Pallas
+    kernel OP FOR OP (zeros-init f32 accumulator; per group: unpack,
+    cast to the compute dtype, dot_general with f32 accumulation, scale
+    the partial, add) so interpret-mode bit-identity is a structural
+    property, not a tolerance. Handles the ragged last group and odd-n
+    pad column the kernel's geometry gate excludes."""
+    G, n = int(scales.shape[0]), int(scales.shape[1])
+    k = int(x.shape[-1])
+    g0 = -(-k // G)
+    qq = _unpack_int4(packed)[:, :n]
+    acc = jnp.zeros((x.shape[0], n), jnp.float32)
+    for g in range(G):
+        lo, hi = g * g0, min((g + 1) * g0, k)
+        wq = qq[lo:hi].astype(x.dtype)
+        part = jax.lax.dot_general(x[:, lo:hi], wq,
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        acc = acc + part * scales[g][None]
+    return acc.astype(x.dtype)
+
+
+def _qmat4(x, packed, scales):
+    """``x @ dequant(packed, scales)`` without materializing the
+    dequantized weight: the Pallas kernel when the geometry qualifies
+    (ops/pallas_kernels.int4_matmul — unpack + dequant inside the
+    matmul tile in VMEM), else :func:`_qmat4_ref`. The route is a
+    trace-time decision, so each compiled program contains exactly one
+    formulation."""
+    lead, k = x.shape[:-1], int(x.shape[-1])
+    G, n = int(scales.shape[0]), int(scales.shape[1])
+    m = 1
+    for d in lead:
+        m *= int(d)
+    x2 = x.reshape(m, k)
+    from ..ops import pallas_kernels as _pk
+    if (k % G == 0 and 2 * int(packed.shape[-1]) == n
+            and _pk.int4_matmul_supported(m, k, n, G,
+                                          itemsize=x.dtype.itemsize)):
+        y = _pk.int4_matmul(x2, packed, scales)
+    else:
+        y = _qmat4_ref(x2, packed, scales)
+    return y.reshape(lead + (n,))
+
+
 @functools.lru_cache(maxsize=64)
 def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                temperature: float, fused: bool = False,
                int8: bool = False, fold_head: bool = False,
-               top_k: int = 0, top_p: float = 1.0):
+               top_k: int = 0, top_p: float = 1.0,
+               int4: bool = False,
+               int4_group: int = INT4_GROUP_DEFAULT):
     """Build (and cache) the jitted prefill+decode program for one
     (config, prompt length, generation length, sampling) signature —
     repeated gpt_decode calls hit jit's cache instead of retracing.
@@ -803,7 +945,11 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
     kernel per batch row (ops/pallas_kernels.fused_decode_step) with
     bf16 weights double-buffered through VMEM. ``int8``: additionally
     stream the matmul weights int8-quantized (half the bytes of the
-    weight-bandwidth-bound step; fused path only). ``top_k``/``top_p``
+    weight-bandwidth-bound step; fused path only). ``int4``: stream
+    them PACKED int4 with ``int4_group``-row scale groups through the
+    XLA scan's _qmat dispatch instead (the fused whole-step kernel
+    stays int8/bf16 — the caller forces ``fused=False``); prefill keeps
+    the full-precision blocks either way. ``top_k``/``top_p``
     restrict the sampling candidate set (ops/sampling.py — the SAME
     filter the serving tick applies per slot row, so serve-vs-generate
     token identity holds under any sampling params); both are inert on
@@ -852,6 +998,13 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                 # weight-bandwidth-bound — and its math must match the
                 # training forward that produced the caches)
                 dec_blocks = _quantize_decode_blocks(blocks)
+        elif int4:
+            # packed nibbles + group scales for the DECODE scan only
+            # (same prefill reasoning as int8 above); quantized once per
+            # decode call, outside the token scan. dec_blocks is the
+            # SAME object as blocks when int4 is off, so the unquantized
+            # scan's jaxpr is byte-for-byte unchanged.
+            dec_blocks = _quantize_decode_blocks_int4(blocks, int4_group)
 
         # ---- prefill: full forward over the prompt, emitting k/v caches
         h = (params["emb"][prompt]
@@ -939,7 +1092,7 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
                     return out, (ck, cv)
 
                 h, (cache_k, cache_v) = lax.scan(
-                    layer, h, (blocks, cache_k, cache_v))
+                    layer, h, (dec_blocks, cache_k, cache_v))
             hl = _layernorm(h, params["lnf_g"], params["lnf_b"])
             logits = hl[:, 0] @ params["head"].astype(hl.dtype)
             nxt = pick(logits, jax.random.fold_in(rng, i + 1))
@@ -964,7 +1117,7 @@ def _decode_fn(cfg_key: tuple, n_prompt: int, max_new: int,
     return CachedProgram(
         jax.jit(run), "gpt_decode", config=config_hash(cfg_key),
         extra=repr((n_prompt, max_new, temperature, fused, int8,
-                    fold_head, top_k, top_p)))
+                    fold_head, top_k, top_p, int4, int4_group)))
 
 
 def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
@@ -973,7 +1126,9 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                rng: Optional[jax.Array] = None,
                int8_weights: bool = False,
                top_k: int = 0, top_p: float = 1.0,
-               speculative=None) -> jnp.ndarray:
+               speculative=None,
+               int4_weights: bool = False,
+               int4_group: int = INT4_GROUP_DEFAULT) -> jnp.ndarray:
     """Generate ``max_new`` (>= 1) tokens after ``prompt`` (b, n_prompt)
     int32. temperature 0 = greedy; else categorical sampling with ``rng``,
     optionally restricted by ``top_k`` (keep the k most likely tokens;
@@ -1006,7 +1161,19 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     (serve/engine.py), so greedy speculative-int8 output is
     bit-identical to the engine's own non-speculative int8 stream —
     int8 is a weight-fidelity choice, speculation a scheduling choice,
-    and the two no longer exclude each other."""
+    and the two no longer exclude each other.
+
+    ``int4_weights`` (opt-in, round 19): stream the block matmul
+    weights PACKED int4 — two nibbles per byte, group-wise symmetric
+    scales over ``int4_group`` in-rows (0 = one group = per-out-column)
+    — through the XLA decode scan's _qmat4 route (Pallas dequant-matmul
+    where the geometry qualifies, the op-for-op XLA reference
+    elsewhere). Quarter the weight bytes of bf16, ~half of int8, on the
+    weight-bandwidth-bound decode step. Mutually exclusive with
+    ``int8_weights``; the fused whole-step kernel is bypassed (it
+    streams int8/bf16 only). Accuracy rides the serve engine's
+    ``w_int4_tolerance()`` contract; composes with ``speculative`` the
+    same way int8 does."""
     n_prompt = int(prompt.shape[1])
     if max_new < 1:
         raise ValueError("max_new must be >= 1, got %d" % max_new)
@@ -1019,6 +1186,12 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         raise ValueError("top_k must be >= 0 (0 disables), got %d" % top_k)
     if not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1], got %g" % top_p)
+    if int4_weights and int8_weights:
+        raise ValueError("int4_weights and int8_weights are mutually "
+                         "exclusive — pick one weight stream")
+    if int4_group < 0:
+        raise ValueError("int4_group must be >= 0 (0 = per-out-column),"
+                         " got %d" % int4_group)
     if speculative:
         # lazy import: serve imports models.gpt at module load, so the
         # reverse edge must stay inside this branch
@@ -1031,7 +1204,9 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
             params, np.asarray(prompt, np.int32), max_new, cfg,
             temperature=float(temperature), rng=rng, top_k=int(top_k),
             top_p=float(top_p), spec=spec,
-            int8_weights=bool(int8_weights)))
+            int8_weights=bool(int8_weights),
+            int4_weights=bool(int4_weights),
+            int4_group=int(int4_group)))
     if temperature <= 0:
         # the filters are inert on the greedy path; normalizing them out
         # of the _decode_fn cache key avoids compiling duplicate
@@ -1090,7 +1265,10 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
             "(falling back to the XLA scan); re-place the params with "
             "a jax.sharding.Mesh to re-enable fusion")
     itemsize = 2 if cfg.dtype == "bfloat16" else 4
-    fused = bool(single_shard and fused_decode_supported(
+    # the fused whole-step kernel streams bf16/int8 weights only — int4
+    # decode runs the XLA scan, whose _qmat dispatch routes the hot
+    # matmuls to the int4 dequant-matmul kernel per-op instead
+    fused = bool(single_shard and not int4_weights and fused_decode_supported(
         (int(prompt.shape[0]), cfg.n_head, n_prompt + max_new, hd),
         cfg.n_head, cfg.feat, itemsize=itemsize,
         weight_itemsize=1 if int8_weights else None))
@@ -1120,7 +1298,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
     fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature), fused,
                     int8=bool(int8_weights and fused),
                     fold_head=fold_head, top_k=int(top_k),
-                    top_p=float(top_p))
+                    top_p=float(top_p), int4=bool(int4_weights),
+                    int4_group=int(int4_group))
 
     # compile-time accounting (obs/devprof.py): a first-call compile of
     # any decode signature lands in cxn_compile_seconds{fn="gpt_decode"}
@@ -1159,7 +1338,8 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
                             float(temperature), fused,
                             int8=bool(int8_weights and fused),
                             fold_head=False, top_k=int(top_k),
-                            top_p=float(top_p))
+                            top_p=float(top_p), int4=bool(int4_weights),
+                            int4_group=int(int4_group))
             try:
                 return _run(fn)
             except Exception as e2:                     # noqa: BLE001
@@ -1178,7 +1358,9 @@ def gpt_decode(params: Dict, prompt: jnp.ndarray, max_new: int,
         # mismatch would trace+compile it twice)
         fn = _decode_fn(cfg_key, n_prompt, max_new, float(temperature),
                         False, int8=False, fold_head=False,
-                        top_k=int(top_k), top_p=float(top_p))
+                        top_k=int(top_k), top_p=float(top_p),
+                        int4=bool(int4_weights),
+                        int4_group=int(int4_group))
         return _run(fn)
 
 

@@ -1255,7 +1255,9 @@ __all__ = ["use_pallas", "lrn_fused", "flash_attention",
            "flash_attention_bhnd", "flash_fwd_with_lse",
            "flash_bwd_blocks",
            "fused_relu_lrn_maxpool", "fused_relu_lrn_maxpool_supported",
-           "layernorm_fused", "layernorm_fused_supported"]
+           "layernorm_fused", "layernorm_fused_supported",
+           "int4_matmul", "int4_matmul_supported",
+           "int4_matmul_geometry_ok", "int4_matmul_fallback_reason"]
 
 
 # ---------------------------------------------------------------------------
@@ -2152,3 +2154,146 @@ def fused_decode_step(blocks, h, ck, cv, pos, n_head: int, head=None):
     if head is not None:
         return out, ck2, cv2                   # (b, 1) int32 next tokens
     return out.reshape(b, 1, f), ck2, cv2
+
+
+# ---------------------------------------------------------------------------
+# int4 weight streaming: fused dequant-matmul (packed nibbles, group scales)
+# ---------------------------------------------------------------------------
+#
+# y = x @ dequant(packed) for the serve programs' block matmuls under
+# serve_int4_weights=1 (models/gpt.py:_qmat4 routes here; its XLA
+# reference _qmat4_ref mirrors this kernel op for op, so interpret-mode
+# output is bit-identical). The weight arrives PACKED: a (k, n/2) uint8
+# plane whose byte j carries out-columns j (low nibble) and j + n/2
+# (high nibble), each stored as code + 8 with code in [-7, 7], plus an
+# f32 (G, n) scale plane — one symmetric scale per (group of k rows,
+# out column). The grid streams the G row groups through VMEM in the
+# PR 16 K-tile idiom: nibble unpack + scale dequant happen INSIDE the
+# tile, partial products accumulate in an f32 scratch across the
+# sequential grid dim, and the unpacked bf16/f32 weight never exists
+# in HBM — the whole point of packing (the decode stream is weight-
+# bandwidth-bound; nibbles halve the int8 byte count again).
+
+# per-tile VMEM budget of the dequant-matmul (x tile + packed tile +
+# unpack temporaries + f32 accumulator + out tile); module-level so
+# tests can shrink it and drive geometries across the fused -> XLA
+# reference crossover the way they flip _INTERPRET
+_INT4_TILE_VMEM = 12 * 1024 * 1024
+
+
+def _int4_tile_vmem(m: int, k: int, n: int, groups: int,
+                    itemsize: int = 2) -> int:
+    """Bytes one (m, k-group, n) grid step holds at once."""
+    g0 = k // max(1, groups)
+    return (m * g0 * itemsize               # x tile
+            + g0 * (n // 2)                 # packed nibble tile
+            + g0 * n * (1 + itemsize)       # unpacked i8 + compute cast
+            + n * 4                         # scale row (f32)
+            + m * n * (4 + itemsize))       # f32 accumulator + out tile
+
+
+def int4_matmul_geometry_ok(m: int, k: int, n: int, groups: int,
+                            itemsize: int = 2) -> bool:
+    """The geometry half of the int4 dequant-matmul gate: the scale
+    groups must tile the contraction dim exactly (ragged groups keep
+    the XLA reference — BlockSpec grids are rectangular), the packed
+    column count must be whole bytes, the tile must fit the VMEM
+    budget, and on a real TPU the operand dims must be lane/sublane
+    friendly (n spanning full 128-lane registers for BOTH the packed
+    and unpacked views, the k-group a sublane multiple, m at least one
+    sublane). Interpret mode waives the alignment limits (tiny
+    differential-test models run) but keeps the structural and VMEM
+    checks, so tests exercise the same crossover a real TPU would."""
+    if groups < 1 or k % groups or n % 2:
+        return False
+    if _int4_tile_vmem(m, k, n, groups, itemsize) > _INT4_TILE_VMEM:
+        return False
+    if _INTERPRET:
+        return True
+    g0 = k // groups
+    return m >= 8 and n % 256 == 0 and g0 % 8 == 0
+
+
+def int4_matmul_supported(m: int, k: int, n: int, groups: int,
+                          itemsize: int = 2) -> bool:
+    """True when :func:`int4_matmul` may serve this matmul shape: TPU
+    backend (or interpret mode under test), the ``CXN_INT4_MATMUL=0``
+    off-switch not thrown, and the geometry gate holds. Anything else
+    keeps models/gpt.py's XLA reference ``_qmat4_ref`` — the
+    bit-reference the kernel is pinned against."""
+    if os.environ.get("CXN_INT4_MATMUL", "1") == "0":
+        return False
+    return use_pallas() and int4_matmul_geometry_ok(m, k, n, groups,
+                                                    itemsize)
+
+
+def int4_matmul_fallback_reason(m: int, k: int, n: int, groups: int,
+                                itemsize: int = 2) -> str:
+    """Why the support gate rejected this shape — ``"env_off"``
+    (``CXN_INT4_MATMUL=0``), ``"backend"`` (no TPU and no interpret
+    mode), ``"geometry"`` — or ``""`` when the kernel serves it. The
+    engine logs this once and counts it in
+    ``cxn_int4_fallback_total{reason=}`` (serve/engine.py)."""
+    if os.environ.get("CXN_INT4_MATMUL", "1") == "0":
+        return "env_off"
+    if not use_pallas():
+        return "backend"
+    if not int4_matmul_geometry_ok(m, k, n, groups, itemsize):
+        return "geometry"
+    return ""
+
+
+def _int4_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref):
+    """One grid step = one scale group of k rows: unpack the nibble
+    tile to i8, cast to the compute dtype (int4 codes are exact in
+    bf16's 8 mantissa bits — never a silent f32 widen, the CXN209
+    contract), run the MXU partial product with f32 accumulation, and
+    scale-dequant the PARTIAL — group scales live on the contraction
+    dim, so unlike int8's per-out-column scheme the multiply must land
+    before the cross-group sum. The f32 scratch persists across the
+    sequential grid dim; the last group casts it into the output."""
+    gi = pl.program_id(0)
+    ng = pl.num_programs(0)
+
+    @pl.when(gi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    packed = w_ref[...]                             # (g0, n // 2) uint8
+    lo = (packed & jnp.uint8(0xF)).astype(jnp.int8) - 8
+    hi = (packed >> jnp.uint8(4)).astype(jnp.int8) - 8
+    # byte j holds columns (j, j + n/2): the unpack is a lane concat,
+    # never an interleaving relayout
+    wq = jnp.concatenate([lo, hi], axis=-1).astype(x_ref.dtype)
+    acc_ref[...] += _mm(x_ref[...], wq) * s_ref[...]
+
+    @pl.when(gi == ng - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def int4_matmul(x, packed, scales):
+    """``x (m, k) @ dequant(packed (k, n/2) uint8, scales (G, n) f32)``
+    -> (m, n) in x's dtype. Callers gate on
+    :func:`int4_matmul_supported` — k must split into G equal row
+    groups and n into whole bytes (models/gpt.py pads the out dim to
+    even at quantize time and the gate rejects ragged groups)."""
+    m, k = x.shape
+    g = int(scales.shape[0])
+    n = int(scales.shape[1])
+    assert n == 2 * int(packed.shape[1]), \
+        "scale plane n=%d vs packed n/2=%d" % (n, int(packed.shape[1]))
+    g0 = k // g
+    return pl.pallas_call(
+        _int4_matmul_kernel,
+        grid=(g,),
+        in_specs=[pl.BlockSpec((m, g0), lambda i: (0, i)),
+                  pl.BlockSpec((g0, n // 2), lambda i: (i, 0)),
+                  pl.BlockSpec((1, n), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((m, n), lambda i: (0, 0)),
+        out_shape=_out_struct((m, n), x.dtype, x),
+        scratch_shapes=[pltpu.VMEM((m, n), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(x, packed, scales)

@@ -176,8 +176,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..models.gpt import (GPTConfig, _block_core_fusedqkv, _fuse_qkv_blocks,
-                          _layernorm, _quantize_decode_blocks)
+from ..models.gpt import (GPTConfig, INT4_GROUP_DEFAULT, _block_core_fusedqkv,
+                          _fuse_qkv_blocks, _int4_groups, _layernorm,
+                          _quantize_decode_blocks,
+                          _quantize_decode_blocks_int4)
 from ..obs.devprof import compile_attribution
 from ..ops.attention import local_attention
 from ..ops.sampling import (accept_draft_rows, residual_sample_rows,
@@ -187,6 +189,7 @@ from .resilience import InjectedFault, SwapCorruptionError, swap_checksum
 
 __all__ = ["DecodeEngine", "auto_num_blocks", "fused_attn_tolerance",
            "assert_fused_allclose", "kv_int8_tolerance",
+           "w_int4_tolerance", "weight_stream_tag",
            "serve_param_shardings", "serve_kv_sharding", "serve_tp_size",
            "resolve_block_size", "clear_program_caches"]
 
@@ -281,6 +284,45 @@ def kv_int8_tolerance() -> Dict[str, float]:
             "chi2_sig": 1e-3}
 
 
+def w_int4_tolerance() -> Dict[str, float]:
+    """The ONE numeric contract of packed-int4 weight streaming
+    (``serve_int4_weights=1``), the weight-side sibling of
+    :func:`kv_int8_tolerance` — every int4-weight differential test
+    pins through THESE numbers:
+
+    * ``rtol`` / ``atol`` — per-op band for an int4-dequantized matmul
+      against the full-precision reference. A symmetric group scale
+      bounds each weight's error by ``scale / 2`` = ``max|w| / 14``
+      over its group, ~9x the int8 bound — residual streams and LN keep
+      activations O(1), so logits land within a few percent.
+    * ``greedy_flip`` — max fraction of LOCKSTEP decode steps whose
+      argmax may differ from the full-precision engine's. 3-bit
+      mantissas on a tiny random-init model (near-uniform logits) flip
+      often and harmlessly; a plumbing bug (nibble order, group axis,
+      scale placement) flips essentially every step.
+    * ``chi2_sig`` — significance level for the sampled-mode
+      chi-squared pin at matched sample sizes.
+
+    The band is deliberately wider than int8's: int4 halves the bits,
+    it does not halve the error. With ``serve_int4_weights`` unset
+    nothing here applies — the unquantized programs stay pinned
+    byte-for-byte."""
+    return {"rtol": 8e-2, "atol": 8e-2, "greedy_flip": 0.5,
+            "chi2_sig": 1e-3}
+
+
+def weight_stream_tag(int8: bool, int4: bool,
+                      int4_group: int = INT4_GROUP_DEFAULT) -> str:
+    """Canonical weight-stream component for autotune/AOT keys:
+    ``"int8"``, ``"int4:g<group>"``, or ``""`` for full precision —
+    the ONE spelling shared by resolve_block_size, the autotune task,
+    and the bench cells, so a winner tuned under one weight dtype can
+    never be served to another."""
+    if int4:
+        return "int4:g%d" % int(int4_group)
+    return "int8" if int8 else ""
+
+
 # fused-fallback observability (one line per distinct reason per
 # process — engine rebuilds and replica spin-ups over the same config
 # must not spam the log; the counter still ticks every resolution)
@@ -308,6 +350,30 @@ def _note_fused_fallback(reason: str, registry=None) -> None:
         registry.counter(
             "cxn_fused_fallback_total",
             "fused paged-attention fallback resolutions by reason",
+            labelnames=("reason",)).labels(reason).inc()
+
+
+def _note_int4_fallback(reason: str, registry=None) -> None:
+    """Record one int4 dequant-matmul fallback resolution: the support
+    gate (``int4_matmul_fallback_reason`` — "backend", "geometry",
+    "env_off") rejected the Pallas kernel for the tick's hot matmul
+    geometry and the engine's programs stream packed weights through
+    the XLA reference instead. Same once-per-process logging /
+    always-counting contract as :func:`_note_fused_fallback`, under
+    ``cxn_int4_fallback_total{reason=}``."""
+    if not reason:
+        return
+    key = "int4:" + reason
+    if key not in _FALLBACK_LOGGED:
+        _FALLBACK_LOGGED.add(key)
+        from ..utils import profiler
+        profiler.log("serve: int4 dequant-matmul kernel unavailable "
+                     "(reason=%s) — streaming packed weights through "
+                     "the XLA reference formulation" % reason)
+    if registry is not None:
+        registry.counter(
+            "cxn_int4_fallback_total",
+            "int4 dequant-matmul fallback resolutions by reason",
             labelnames=("reason",)).labels(reason).inc()
 
 
@@ -384,7 +450,7 @@ def auto_num_blocks(cfg, slots: int, prefill_chunk: int,
 
 def resolve_block_size(cfg, prefill_chunk: int, block_size: int,
                        kv_dtype: str = "", tp: int = 1,
-                       aot=None) -> int:
+                       aot=None, weights: str = "") -> int:
     """Resolve ``serve_block_size=auto`` (the ``-1`` sentinel) through
     the persisted geometry-autotune winner — the ONE lookup the
     server, the CLI, and the lint tool share. A non-negative
@@ -394,7 +460,9 @@ def resolve_block_size(cfg, prefill_chunk: int, block_size: int,
     process-default :func:`~cxxnet_tpu.analysis.aot_cache.active`
     cache) under the :func:`~cxxnet_tpu.analysis.aot_cache
     .tuned_components` key — device kind + model geometry + chunk +
-    KV dtype + TP. A hit returns the tuned winner (tuning ran once per
+    KV dtype + TP + weight stream (``weights``: the
+    :func:`weight_stream_tag` spelling — int4's matmul route changes
+    which block size wins). A hit returns the tuned winner (tuning ran once per
     fleet; every replica loads it here); a miss logs once and falls
     back to 0 = the chunk default, so ``auto`` without a tuning run
     is never an error."""
@@ -409,7 +477,7 @@ def resolve_block_size(cfg, prefill_chunk: int, block_size: int,
     if cache is not None:
         comp = aot_mod.tuned_components(
             aot_mod.config_hash(dataclasses.astuple(cfg)), chunk,
-            kv_dtype, tp)
+            kv_dtype, tp, weights)
         rec = cache.load_tuned(comp)
         if rec is not None:
             profiler.log(
@@ -1302,6 +1370,8 @@ class DecodeEngine:
                  num_blocks: int = 0, block_size: int = 0,
                  injector=None, fused_attn: bool = True, mesh=None,
                  int8_weights: bool = False, kv_dtype: str = "",
+                 int4_weights: bool = False,
+                 int4_group: int = INT4_GROUP_DEFAULT,
                  aot=None, tracer=None):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
@@ -1355,7 +1425,22 @@ class DecodeEngine:
         the quantized round trip bit-exactly). Accuracy is pinned by
         :func:`kv_int8_tolerance`; both knobs default OFF and are
         pinned no-ops there (every bit-identity suite runs against
-        the unquantized programs)."""
+        the unquantized programs).
+
+        ``int4_weights`` (round 19) quantizes the same fused block
+        dict to PACKED int4 instead — two nibbles per byte along the
+        out-column dim, group-wise symmetric scales over
+        ``int4_group`` in-rows (0 = one group = per-out-column;
+        models/gpt.py:_quantize_decode_blocks_int4) — quartering the
+        resident weight pool and the per-token stream. Every program
+        routes its hot matmuls through _qmat's uint8 dispatch: the
+        fused Pallas dequant-matmul (``int4_matmul`` — unpack + scale
+        inside the tile, the unpacked weight never in HBM) where the
+        geometry gate passes, the op-for-op XLA reference elsewhere
+        (resolution in ``self.int4_formulation``, fallbacks counted in
+        ``cxn_int4_fallback_total{reason=}``). Mutually exclusive with
+        ``int8_weights``; accuracy pinned by :func:`w_int4_tolerance`;
+        OFF is the same byte-for-byte no-op contract."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -1387,8 +1472,26 @@ class DecodeEngine:
                 "serve_kv_dtype must be one of '', 'auto', 'bf16', "
                 "'f32', 'int8', got %r" % (kv_dtype,))
         self.int8_weights = bool(int8_weights)
+        self.int4_weights = bool(int4_weights)
+        self.int4_group = int(int4_group)
+        if self.int4_weights and self.int8_weights:
+            raise ValueError(
+                "serve_int4_weights and serve_int8_weights are mutually "
+                "exclusive — pick one weight stream")
+        if self.int4_group < 0:
+            raise ValueError(
+                "serve_int4_group must be >= 0 (0 = per-out-column), "
+                "got %d" % int4_group)
         self.tp = serve_tp_size(mesh)
         self.mesh = mesh if self.tp > 1 else None
+        if self.int4_weights and self.tp > 1:
+            raise ValueError(
+                "serve_int4_weights does not compose with serve_tp>1 "
+                "yet: the (packed, group-scales) weight pair needs "
+                "per-leaf output-dim shardings (the scale plane shards "
+                "on its LAST axis, the packed nibbles on a HALVED one) "
+                "the TP constraint hooks don't carry — shard OR "
+                "int4-quantize the weights, not both")
         if self.kv_int8 and self.tp > 1:
             raise ValueError(
                 "serve_kv_dtype=int8 does not compose with serve_tp>1 "
@@ -1470,6 +1573,16 @@ class DecodeEngine:
                                            self._blocks)
                             if abstract
                             else _quantize_decode_blocks(self._blocks))
+        elif self.int4_weights:
+            # same build-once contract, packed nibbles + group scales:
+            # the engine holds ONLY the packed representation (a
+            # quarter of bf16's weight bytes), and _qmat's uint8
+            # dispatch routes every program's hot matmuls through
+            # _qmat4 (kernel or XLA reference, resolved below)
+            _q4 = functools.partial(_quantize_decode_blocks_int4,
+                                    group=self.int4_group)
+            self._blocks = (jax.eval_shape(_q4, self._blocks)
+                            if abstract else _q4(self._blocks))
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
                                               "lnf_b", "head")}
         if self.tp > 1:
@@ -1514,9 +1627,33 @@ class DecodeEngine:
             else ""
         if self.int8_weights:
             self._sig_suffix += "/w=int8"
+        if self.int4_weights:
+            self._sig_suffix += "/w=int4/g=%d" % self.int4_group
         if self.kv_int8:
             self._sig_suffix += "/kv=int8"
         hd = cfg.feat // cfg.n_head
+        # int4 matmul route, resolved ONCE on the tick's hot QKV
+        # geometry (m = slots decode rows, k = feat, n = 3*feat, the
+        # largest per-token matmul): "fused" when the Pallas dequant-
+        # matmul's gate passes there, "" when programs stream packed
+        # weights through the XLA reference. Chunk-prefill matmuls
+        # re-gate per shape inside _qmat4 — this field is the
+        # observability/audit verdict for the steady-state decode path.
+        self.int4_formulation = ""
+        if self.int4_weights:
+            from ..ops.pallas_kernels import (int4_matmul_fallback_reason,
+                                              int4_matmul_supported)
+            citem = 2 if cfg.dtype == "bfloat16" else 4
+            g_qkv = _int4_groups(cfg.feat, self.int4_group)
+            if int4_matmul_supported(slots, cfg.feat, 3 * cfg.feat,
+                                     g_qkv, itemsize=citem):
+                self.int4_formulation = "fused"
+            else:
+                _note_int4_fallback(
+                    int4_matmul_fallback_reason(
+                        slots, cfg.feat, 3 * cfg.feat, g_qkv,
+                        itemsize=citem),
+                    obs_registry)
         if self.paged:
             self.bpr = self.row_len // self.block_size
             # fused paged attention: requested AND the backend/geometry

@@ -165,7 +165,8 @@ class InferenceServer:
                  chaos: str = "", max_restarts: int = 3,
                  watchdog_ms: float = 0.0, degrade: bool = True,
                  tp: int = 0, mesh=None, tenants: str = "",
-                 int8_weights: bool = False, kv_dtype: str = "",
+                 int8_weights: bool = False, int4_weights: bool = False,
+                 int4_group: int = 64, kv_dtype: str = "",
                  aot_cache: str = ""):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
@@ -279,7 +280,14 @@ class InferenceServer:
         ``swap_host`` all hold ~2x tokens per MiB and swap bandwidth
         halves (checksums verify the quantized round trip bit-exactly).
         Accuracy is pinned by ``serve.engine.kv_int8_tolerance``; both
-        default OFF and are pinned no-ops there.
+        default OFF and are pinned no-ops there. ``int4_weights``
+        (doc/serving.md "Int4 weights") packs the fused block weights
+        to two nibbles per byte with group-wise symmetric scales
+        (``int4_group`` in-rows per scale group, 0 = one scale per out
+        column) and streams them through every serve program via the
+        fused Pallas dequant-matmul where supported — ~4x weight bytes
+        vs bf16, accuracy pinned by ``serve.engine.w_int4_tolerance``;
+        mutually exclusive with ``int8_weights``.
 
         Tensor-parallel serving (doc/serving.md "Sharded & replicated
         serving"): ``tp`` > 1 builds a ``model``-axis mesh over the
@@ -386,12 +394,15 @@ class InferenceServer:
             # geometry-autotune winner BEFORE the pool is sized — the
             # tuned block width changes block_bytes and with it every
             # auto_num_blocks budget below
-            from .engine import resolve_block_size
+            from .engine import resolve_block_size, weight_stream_tag
             block_size = resolve_block_size(
                 cfg, prefill_chunk, block_size, kv_dtype=kv_dtype,
                 tp=self._tp,
                 aot=(str(aot_cache or "")
-                     or os.environ.get("CXN_AOT_CACHE", "") or None))
+                     or os.environ.get("CXN_AOT_CACHE", "") or None),
+                weights=weight_stream_tag(bool(int8_weights),
+                                          bool(int4_weights),
+                                          int(int4_group)))
         if self._paged:
             from .engine import auto_num_blocks
             # auto-sizing is dtype-aware: the same serve_kv_mb budget
@@ -411,7 +422,9 @@ class InferenceServer:
             spec_len=spec_len, spec_model=spec_model, prefix_mb=prefix_mb,
             nb=nb, block_size=block_size, prof_every=prof_every,
             fused_attn=bool(fused_attn), mesh=mesh,
-            int8_weights=bool(int8_weights), kv_dtype=kv_dtype)
+            int8_weights=bool(int8_weights),
+            int4_weights=bool(int4_weights), int4_group=int(int4_group),
+            kv_dtype=kv_dtype)
         self._prefill_budget = int(prefill_budget)
         # device/compiler observatory (obs/devprof.py): compile-time
         # accounting always (this registry becomes a CompileWatch sink,
@@ -522,6 +535,7 @@ class InferenceServer:
             block_size=b["block_size"] if self._paged else 0,
             injector=self._inj, fused_attn=b["fused_attn"],
             mesh=b["mesh"], int8_weights=b["int8_weights"],
+            int4_weights=b["int4_weights"], int4_group=b["int4_group"],
             kv_dtype=b["kv_dtype"],
             aot=self._aot, tracer=self._tracer)
         self._prefix = None
@@ -2001,6 +2015,9 @@ class InferenceServer:
             "slots": self._engine.slots,
             "tp": self._tp,
             "int8_weights": self._engine.int8_weights,
+            "int4_weights": self._engine.int4_weights,
+            "int4_group": self._engine.int4_group,
+            "int4_formulation": self._engine.int4_formulation,
             "kv_cache_bytes": self._engine.cache_bytes(),
             # device-memory ledger snapshot (obs/devprof.py): predicted
             # bytes per pool vs the measured jax.live_arrays() total

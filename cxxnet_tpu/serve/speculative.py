@@ -291,7 +291,8 @@ class SpeculativeDecoder:
 
     def __init__(self, cfg, params: Dict, batch: int, spec_len: int = 4,
                  mode: str = "ngram", model=None, tracer=None,
-                 int8_weights: bool = False):
+                 int8_weights: bool = False, int4_weights: bool = False,
+                 int4_group: int = 64):
         """``tracer``: obs span recorder for the offline decode loop
         (doc/observability.md) — None uses the process-global tracer,
         so ``gpt_decode(speculative=...)`` runs show up on the same
@@ -305,7 +306,14 @@ class SpeculativeDecoder:
         Greedy output is then bit-identical to the engine's OWN
         non-speculative int8 stream (the verify logits are the int8
         tick's logits); the drafter keeps full-precision weights — it
-        only affects accept_rate, never which tokens are emitted."""
+        only affects accept_rate, never which tokens are emitted.
+
+        ``int4_weights`` / ``int4_group`` do the same with PACKED int4
+        weights (group-wise scales, models/gpt.py
+        _quantize_decode_blocks_int4): greedy spec-int4 output is
+        bit-identical to the engine's own non-speculative int4 stream.
+        Mutually exclusive with ``int8_weights`` (the engine ctor
+        rejects the pair)."""
         from .engine import DecodeEngine
         if mode not in ("ngram", "model"):
             raise ValueError("speculative mode must be 'ngram' or "
@@ -316,7 +324,9 @@ class SpeculativeDecoder:
         self.spec_len = min(int(spec_len), max(cfg.seq_len - 1, 1))
         self.engine = DecodeEngine(cfg, params, slots=batch,
                                    prefill_chunk=0, spec_len=self.spec_len,
-                                   int8_weights=int8_weights)
+                                   int8_weights=int8_weights,
+                                   int4_weights=int4_weights,
+                                   int4_group=int4_group)
         if mode == "model":
             if model is None:
                 raise ValueError("speculative mode 'model' needs "
@@ -449,7 +459,9 @@ def speculative_decode(params: Dict, prompt, max_new: int, cfg,
                        temperature: float = 0.0, rng=None,
                        top_k: int = 0, top_p: float = 1.0,
                        spec: Optional[dict] = None,
-                       int8_weights: bool = False):
+                       int8_weights: bool = False,
+                       int4_weights: bool = False,
+                       int4_group: int = 64):
     """``gpt_decode(speculative=...)``'s implementation: build a
     one-shot :class:`SpeculativeDecoder`, run it, fill ``spec['stats']``
     (if the caller passed a dict to receive accept_rate & friends), and
@@ -457,7 +469,8 @@ def speculative_decode(params: Dict, prompt, max_new: int, cfg,
     ('ngram' | 'model'), ``spec_len``, ``model`` ((draft_cfg,
     draft_params) for mode 'model'), ``stats`` (optional out-dict).
     ``int8_weights`` streams the target weights int8-quantized through
-    the verify/tick programs (SpeculativeDecoder docstring)."""
+    the verify/tick programs; ``int4_weights`` / ``int4_group`` stream
+    them packed int4 instead (SpeculativeDecoder docstring)."""
     spec = dict(spec or {})
     stats_out = spec.get("stats")
     prompt = np.asarray(prompt, np.int32)
@@ -465,7 +478,9 @@ def speculative_decode(params: Dict, prompt, max_new: int, cfg,
                              spec_len=int(spec.get("spec_len", 4)),
                              mode=spec.get("mode", "ngram"),
                              model=spec.get("model"),
-                             int8_weights=int8_weights)
+                             int8_weights=int8_weights,
+                             int4_weights=int4_weights,
+                             int4_group=int4_group)
     try:
         out = dec.decode(prompt, max_new, temperature=temperature,
                          rng=rng, top_k=top_k, top_p=top_p)

@@ -200,6 +200,7 @@ class Net:
                     degrade: bool = True, tp: int = 0,
                     replicas: int = 1, router_policy: str = "prefix",
                     tenants: str = "", int8_weights: bool = False,
+                    int4_weights: bool = False, int4_group: int = 64,
                     kv_dtype: str = "", aot_cache: str = "",
                     fleet: str = "", aot_relabel=None, worker_env=None,
                     **defaults) -> None:
@@ -283,8 +284,13 @@ class Net:
         speculative verify included); ``kv_dtype="int8"`` stores the
         paged KV pool per-block-scaled int8 — ~2x tokens per ``kv_mb``
         and halved swap bandwidth, accuracy pinned by
-        ``serve.engine.kv_int8_tolerance``. Both default off (pinned
-        no-ops).
+        ``serve.engine.kv_int8_tolerance``. ``int4_weights`` streams
+        the block weights as packed nibbles with group-wise symmetric
+        scales (``int4_group`` in-rows per group, 0 = per-out-column)
+        through the fused Pallas dequant-matmul where supported —
+        doc/serving.md "Int4 weights", accuracy pinned by
+        ``serve.engine.w_int4_tolerance``; exclusive with
+        ``int8_weights``. All default off (pinned no-ops).
 
         AOT executable cache (doc/performance.md "AOT executable
         cache"): ``aot_cache`` is a directory of serialized compiled
@@ -327,7 +333,8 @@ class Net:
             kv_mb=kv_mb, fused_attn=fused_attn, chaos=chaos,
             max_restarts=max_restarts, watchdog_ms=watchdog_ms,
             degrade=degrade, tp=tp, tenants=tenants,
-            int8_weights=int8_weights, kv_dtype=kv_dtype,
+            int8_weights=int8_weights, int4_weights=int4_weights,
+            int4_group=int4_group, kv_dtype=kv_dtype,
             aot_cache=aot_cache,
             defaults=SamplingParams(**defaults))
         if fleet.strip():

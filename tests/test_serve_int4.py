@@ -1,0 +1,545 @@
+"""Int4 weight streaming end-to-end (doc/serving.md "Int4 weights"):
+packed nibbles, group-wise scales, and the fused Pallas dequant-matmul
+through the serve programs.
+
+The load-bearing invariants:
+
+1. **pinned no-op when off** — the default engine/server holds
+   full-precision weights (no uint8 planes, no scale groups, empty
+   signature suffix); the whole pre-existing bit-identity corpus runs
+   against exactly these defaults;
+2. **the packing is exact** — pack -> unpack is the identity on int4
+   codes, and quantize -> dequantize lands within the one contract;
+3. **kernel == reference, bitwise** — ``int4_matmul`` in interpret mode
+   is bit-identical to the XLA reference ``_qmat4_ref`` under an
+   exactness-by-construction regime (integer activations, power-of-two
+   scales: every op is exact in f32, so any divergence is structural,
+   not rounding), grouped AND per-column, f32 AND bf16;
+4. **accuracy under ONE contract** — ``w_int4_tolerance()`` bounds the
+   lockstep greedy divergence and the sampled-mode chi-squared, and
+   nothing in this file invents its own ad-hoc tolerance;
+5. **hygiene** — int4 vs int8 vs full-precision engines count DISTINCT
+   single RecompileGuard signatures (``/w=int4/g=<group>`` rides in the
+   signature string), the step audit's CXN211 names any full-width
+   unpacked int4 weight materialized where the fused kernel should be
+   active (``int4=clean`` column), the device-memory ledger prices the
+   weight pool at its PACKED bytes, and the autotune geometry key keyes
+   on the weight stream.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cxxnet_tpu.models.gpt import (GPTConfig, INT4_GROUP_DEFAULT,
+                                   QUANT_DECODE_PAIRS,
+                                   _dequantize_decode_blocks_int4,
+                                   _fuse_qkv_blocks, _int4_groups,
+                                   _pack_int4, _qmat4_ref,
+                                   _quantize_decode_blocks_int4,
+                                   _unpack_int4, gpt_decode, gpt_init)
+from cxxnet_tpu.ops import pallas_kernels as pk
+from cxxnet_tpu.serve import DecodeEngine, InferenceServer, auto_num_blocks
+from cxxnet_tpu.serve.engine import w_int4_tolerance, weight_stream_tag
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+NB = auto_num_blocks(CFG, 2, 4)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _admit(eng, slot, prompt, key, temp=0.0):
+    """Drive a paged engine's chunk prefill by hand (reserve + chunk
+    windows); returns the first sampled token."""
+    tok = None
+    for start in range(0, len(prompt), eng.chunk):
+        end = min(start + eng.chunk, len(prompt))
+        eng.reserve_window(slot, start, start + eng.chunk)
+        buf = np.zeros(eng.chunk, np.int32)
+        buf[:end - start] = prompt[start:end]
+        tok = eng.prefill_chunk(slot, buf, start, end - start, key, temp,
+                                0, 1.0)
+    return int(tok)
+
+
+def _tick_one(eng, slot, tok, pos, fold, key=None, temp=0.0):
+    """One batched tick advancing only ``slot`` (other rows parked)."""
+    b = eng.slots
+    t = np.zeros(b, np.int32)
+    t[slot] = tok
+    p = np.full(b, eng.row_len - 1, np.int32)
+    p[slot] = pos
+    keys = np.zeros((b, 2), np.uint32)
+    if key is not None:
+        keys[slot] = key
+    f = np.zeros(b, np.int32)
+    f[slot] = fold
+    nxt = eng.tick(t, p, keys, f, np.full(b, temp, np.float32),
+                   np.zeros(b, np.int32), np.ones(b, np.float32))
+    return int(nxt[slot])
+
+
+# --------------------------------------------------- pinned no-op (off)
+def test_defaults_are_pinned_noop():
+    """With serve_int4_weights unset the engine holds full-precision
+    weight planes (no uint8, no group-scale planes), an empty signature
+    suffix, and the server reports the flag off — the structural half
+    of the no-op pin (the token-identity half is every pre-existing
+    serve suite, which runs against exactly these defaults)."""
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB)
+    assert not eng.int4_weights
+    assert eng.int4_group == INT4_GROUP_DEFAULT
+    assert eng.int4_formulation == ""
+    assert eng._sig_suffix == ""
+    for wk, sk in QUANT_DECODE_PAIRS:
+        assert eng._blocks[wk].dtype != jnp.uint8
+        assert sk not in eng._blocks
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                         prefill_chunk=4) as srv:
+        m = srv.metrics()
+    assert m["int4_weights"] is False
+    assert m["int4_formulation"] == ""
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="mutually"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     int4_weights=True, int8_weights=True)
+    with pytest.raises(ValueError, match="serve_int4_group"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     int4_weights=True, int4_group=-1)
+    with pytest.raises(ValueError, match="mutually"):
+        gpt_decode(PARAMS, jnp.zeros((1, 4), jnp.int32), 2, CFG,
+                   int4_weights=True, int8_weights=True)
+    with pytest.raises(ValueError, match="int4_group"):
+        gpt_decode(PARAMS, jnp.zeros((1, 4), jnp.int32), 2, CFG,
+                   int4_weights=True, int4_group=-2)
+
+
+def test_int4_rejects_tp():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 local devices for a model-axis mesh")
+    from cxxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(devices=jax.devices()[:2], model_parallel=2)
+    with pytest.raises(ValueError, match="serve_tp"):
+        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                     int4_weights=True, mesh=mesh)
+
+
+# ------------------------------------------------------- packing is exact
+def test_pack_unpack_roundtrip_identity():
+    """pack -> unpack is the identity on every int4 code, including the
+    extremes (the offset-8 storage covers [-8, 7]; the quantizer emits
+    [-7, 7])."""
+    rs = np.random.RandomState(0)
+    q = rs.randint(-7, 8, (3, 10, 12)).astype(np.int8)
+    q[0, 0, :2] = (-7, 7)
+    out = np.asarray(_unpack_int4(_pack_int4(jnp.asarray(q))))
+    np.testing.assert_array_equal(out, q)
+
+
+def test_quantize_dequantize_within_contract():
+    """quantize -> dequantize of the fused block dict stays within the
+    ONE tolerance contract, grouped and per-column, balanced ragged
+    groups included; packed planes store TRUE k rows (no row padding),
+    uint8, with the (L, G, n) f32 scale plane alongside."""
+    tol = w_int4_tolerance()
+    blocks = _fuse_qkv_blocks(PARAMS["blocks"])
+    for group in (INT4_GROUP_DEFAULT, 0, 5):     # 5: ragged last group
+        qb = _quantize_decode_blocks_int4(blocks, group)
+        deq = _dequantize_decode_blocks_int4(qb)
+        for wk, sk in QUANT_DECODE_PAIRS:
+            w = np.asarray(blocks[wk], np.float32)
+            L, k, n = w.shape
+            assert qb[wk].dtype == jnp.uint8
+            assert qb[wk].shape == (L, k, (n + 1) // 2)
+            assert qb[sk].shape == (L, _int4_groups(k, group), n)
+            err = np.abs(np.asarray(deq[wk]) - w).max()
+            assert err <= tol["atol"] * np.abs(w).max(), (wk, group, err)
+
+
+# ------------------------------------------- kernel == reference, bitwise
+def _exact_case(rs, m, k, n, g, dtype):
+    """Exactness-by-construction operands: integer-valued activations
+    and power-of-two scales make every op in both formulations exact
+    (codes and partials fit f32/bf16 mantissas, scaling is a pure
+    exponent shift), so kernel-vs-reference equality is BITWISE — any
+    difference is a structural divergence, not accumulated rounding."""
+    x = jnp.asarray(rs.randint(-4, 5, (m, k)), dtype)
+    q = jnp.asarray(rs.randint(-7, 8, (k, n)).astype(np.int8))
+    packed = _pack_int4(q)
+    scales = jnp.asarray(
+        2.0 ** rs.randint(-3, 4, (g, n)).astype(np.float32))
+    return x, packed, scales
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_bit_identical_to_reference(dtype):
+    rs = np.random.RandomState(7)
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        for m, k, n, g in ((8, 128, 256, 2), (8, 128, 256, 1),
+                           (16, 64, 512, 4)):
+            assert pk.int4_matmul_supported(m, k, n, g,
+                                            itemsize=dtype(0).itemsize)
+            x, packed, scales = _exact_case(rs, m, k, n, g, dtype)
+            ker = np.asarray(pk.int4_matmul(x, packed, scales))
+            ref = np.asarray(_qmat4_ref(x, packed, scales))
+            np.testing.assert_array_equal(ker, ref, err_msg=str((m, k,
+                                                                 n, g)))
+    finally:
+        pk._INTERPRET = old
+
+
+def test_reference_matches_dequantized_matmul():
+    """On random data the grouped reference agrees with the plain
+    dequantize-then-matmul formulation to float rounding (the two sum
+    the same products in a different order), ragged groups included —
+    this ties ``_qmat4_ref`` to the dequantizer the accuracy contract
+    is stated against."""
+    rs = np.random.RandomState(8)
+    for k, n, g in ((12, 10, 3), (10, 6, 4)):    # 10/4: ragged last group
+        x = jnp.asarray(rs.randn(4, k).astype(np.float32))
+        q = jnp.asarray(rs.randint(-7, 8, (k, n + n % 2)).astype(np.int8))
+        g0 = -(-k // g)
+        rows = np.minimum(np.arange(k) // g0, g - 1)
+        scales = jnp.asarray(
+            (0.01 + rs.rand(g, n)).astype(np.float32))
+        deq = (np.asarray(q)[:, :n].astype(np.float32)
+               * np.asarray(scales)[rows])
+        ref = np.asarray(_qmat4_ref(x, _pack_int4(q), scales))
+        np.testing.assert_allclose(ref, np.asarray(x) @ deq, rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_geometry_gate_and_fallback_reasons():
+    """The support gate rejects ragged groups, odd packed widths, and
+    over-VMEM tiles; the fallback reason names the rejecting half."""
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        assert pk.int4_matmul_geometry_ok(8, 128, 256, 2)
+        assert not pk.int4_matmul_geometry_ok(8, 130, 256, 4)  # ragged
+        assert not pk.int4_matmul_geometry_ok(8, 128, 255, 1)  # odd n
+        old_budget = pk._INT4_TILE_VMEM
+        pk._INT4_TILE_VMEM = 1024
+        try:
+            assert not pk.int4_matmul_geometry_ok(8, 128, 256, 2)
+            assert pk.int4_matmul_fallback_reason(8, 128, 256,
+                                                  2) == "geometry"
+        finally:
+            pk._INT4_TILE_VMEM = old_budget
+        assert pk.int4_matmul_fallback_reason(8, 128, 256, 2) == ""
+    finally:
+        pk._INTERPRET = old
+    if jax.default_backend() != "tpu":
+        assert pk.int4_matmul_fallback_reason(8, 128, 256,
+                                              2) == "backend"
+
+
+# ------------------------------------------------- accuracy contract
+def test_int4_greedy_divergence_bounded():
+    """Lockstep teacher-forced divergence: both engines fed the SAME
+    context each step (the full-precision engine's greedy token), the
+    fraction of steps where the int4 engine's argmax differs is bounded
+    by the ONE contract, w_int4_tolerance()['greedy_flip']. A plumbing
+    bug (wrong scale axis, swapped nibbles, garbage group map) flips
+    essentially every step on this near-uniform tiny model."""
+    rs = np.random.RandomState(1)
+    prompt = _prompt(rs, 10)
+    ref = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4, num_blocks=NB)
+    q = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4, num_blocks=NB,
+                     int4_weights=True)
+    key = np.zeros((2,), np.uint32)
+    t_ref = _admit(ref, 0, prompt, key)
+    t_q = _admit(q, 0, prompt, key)
+    steps = 24
+    flips = int(t_ref != t_q)
+    tok, pos = t_ref, len(prompt)
+    for i in range(1, steps):
+        ref.reserve_window(0, pos, pos + 1)
+        q.reserve_window(0, pos, pos + 1)
+        nxt_ref = _tick_one(ref, 0, tok, pos, i)
+        nxt_q = _tick_one(q, 0, tok, pos, i)      # SAME forced context
+        flips += int(nxt_ref != nxt_q)
+        tok, pos = nxt_ref, pos + 1
+    budget = w_int4_tolerance()["greedy_flip"]
+    assert flips / steps <= budget, (flips, steps, budget)
+
+
+def _chi2_crit(df, z=3.09):
+    """Wilson-Hilferty upper-tail chi-squared quantile (z=3.09 ~ the
+    contract's chi2_sig=1e-3)."""
+    return df * (1 - 2 / (9 * df) + z * (2 / (9 * df)) ** 0.5) ** 3
+
+
+def test_int4_sampled_chi_squared():
+    """Sampled mode under int4 weights follows (statistically) the same
+    first-token distribution as the full-precision engine at this
+    sample size — int4 perturbs logits by a few percent, inside the
+    two-sample chi-squared resolution, while a broken scale application
+    shifts whole modes and fails hard."""
+    rs = np.random.RandomState(2)
+    prompt = _prompt(rs, 9)
+    n = 600
+    counts = {}
+    for int4 in (False, True):
+        eng = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4,
+                           num_blocks=NB, int4_weights=int4)
+        _admit(eng, 0, prompt, np.zeros((2,), np.uint32))
+        pos = len(prompt)
+        eng.reserve_window(0, pos, pos + 1)
+        c = np.zeros(CFG.vocab_size)
+        for s in range(n):
+            key = np.asarray(jax.random.PRNGKey(s), np.uint32)
+            c[_tick_one(eng, 0, int(prompt[-1]), pos, 1, key,
+                        temp=1.0)] += 1
+        counts[int4] = c
+    a, b = counts[False], counts[True]
+    keep = (a + b) > 0
+    stat = float((((a - b) ** 2)[keep] / (a + b)[keep]).sum())
+    df = int(keep.sum()) - 1
+    assert df >= 2
+    assert stat < _chi2_crit(df), (stat, df, a, b)
+
+
+# --------------------------------------------------- int4 + speculative
+def test_speculative_int4_composes_and_is_identity():
+    """gpt_decode(speculative=..., int4_weights=True) composes, drafts
+    fire, and the greedy speculative stream is bit-identical to the
+    non-speculative int4 decode of the same prompt — the verify logits
+    ARE the int4 tick's logits, packed weights included."""
+    rs = np.random.RandomState(3)
+    base = _prompt(rs, 6)
+    prompt = jnp.asarray(np.concatenate([base, base, base]))[None]
+    plain = np.asarray(gpt_decode(PARAMS, prompt, 8, CFG,
+                                  int4_weights=True))
+    spec = {"mode": "ngram", "spec_len": 3, "stats": {}}
+    out = np.asarray(gpt_decode(PARAMS, prompt, 8, CFG, speculative=spec,
+                                int4_weights=True))
+    assert spec["stats"]["forwards"] >= 1
+    np.testing.assert_array_equal(out, plain)
+
+
+def test_int4_serving_identity_vs_own_oracle():
+    """An int4-weights SERVER (paged, chunked, speculative) is
+    stream-identical to the offline int4 decode of the same request —
+    the weight quantization is one engine-build-time transform, not a
+    per-program reinterpretation."""
+    rs = np.random.RandomState(8)
+    base = _prompt(rs, 6)
+    prompt = np.concatenate([base, base])
+    ref = np.asarray(gpt_decode(
+        PARAMS, jnp.asarray(prompt)[None], 6, CFG, speculative=2,
+        int4_weights=True))[0]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         spec_mode="ngram", spec_len=2,
+                         int4_weights=True) as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=6), timeout=300)
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens, ref)
+
+
+def test_per_column_group_serving_matches_hand_driven_engine():
+    """serve_int4_group=0 (one scale group = per-out-column) through the
+    full server is stream-identical to a hand-driven engine with the
+    same grouping — the degenerate G=1 plumbing (scale plane (L, 1, n))
+    serves end to end, deterministically."""
+    rs = np.random.RandomState(12)
+    prompt = _prompt(rs, 9)
+    eng = DecodeEngine(CFG, PARAMS, 1, prefill_chunk=4, num_blocks=NB,
+                       int4_weights=True, int4_group=0)
+    assert eng._sig_suffix == "/w=int4/g=0"
+    key = np.zeros((2,), np.uint32)
+    toks = [_admit(eng, 0, prompt, key)]
+    pos = len(prompt)
+    for i in range(1, 5):
+        eng.reserve_window(0, pos, pos + 1)
+        toks.append(_tick_one(eng, 0, toks[-1], pos, i))
+        pos += 1
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         prefix_mb=0.0, int4_weights=True,
+                         int4_group=0) as srv:
+        res = srv.result(srv.submit(prompt, max_tokens=5), timeout=300)
+    assert res.status == "ok"
+    np.testing.assert_array_equal(res.tokens[len(prompt):], toks)
+
+
+# -------------------------------------------------------- hygiene pins
+def test_recompile_signatures_distinct_per_weight_stream():
+    """int4, int8 and full-precision engines in one process are three
+    DISTINCT single signatures: the weight stream rides in the
+    signature string (/w=int4/g=<group> carries the group width too —
+    different groupings are different programs)."""
+    rs = np.random.RandomState(10)
+    engines = {
+        "plain": DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                              num_blocks=NB, recompile_limit=1),
+        "int8": DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                             num_blocks=NB, recompile_limit=1,
+                             int8_weights=True),
+        "int4": DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4,
+                             num_blocks=NB, recompile_limit=1,
+                             int4_weights=True),
+    }
+    assert engines["int4"]._sig_suffix == "/w=int4/g=%d" \
+        % INT4_GROUP_DEFAULT
+    sigs = {}
+    for name, eng in engines.items():
+        for n in (5, 9):        # mixed lengths: still one signature
+            eng.release_row(0)
+            _admit(eng, 0, _prompt(rs, n), np.zeros((2,), np.uint32))
+        assert len(eng.prefill_signatures) == 1
+        sigs[name] = str(eng.prefill_signatures[0])
+    assert len(set(sigs.values())) == 3
+    assert "/w=int4/g=%d" % INT4_GROUP_DEFAULT in sigs["int4"]
+    assert "int4" not in sigs["plain"] and "int4" not in sigs["int8"]
+
+
+def test_weight_stream_tag_and_tuned_components():
+    """The autotune geometry key carries the weight stream: an int4
+    engine's tuned block width never shadows an int8/bf16 one's."""
+    from cxxnet_tpu.analysis.aot_cache import tuned_components
+    assert weight_stream_tag(False, False) == ""
+    assert weight_stream_tag(True, False) == "int8"
+    assert weight_stream_tag(False, True, 32) == "int4:g32"
+    tags = ["", "int8", "int4:g64", "int4:g0"]
+    comps = [tuned_components("h", 4, weights=t) for t in tags]
+    assert comps[0]["w"] == "none"
+    assert comps[2]["w"] == "int4:g64"
+    assert len({tuple(sorted(c.items())) for c in comps}) == len(tags)
+
+
+def test_int4_audit_clean_and_cxn211_detects():
+    """With the kernel route armed (interpret mode stands in for the
+    TPU backend) the int4 serve programs audit ``int4=clean`` — no
+    full-width unpacked weight in HBM, no silent promotion — while a
+    deliberate unpack-then-matmul trips CXN211 and a u8->f32 convert
+    trips the widened CXN209."""
+    from cxxnet_tpu.analysis import audit_serve_engine, format_step_info
+    from cxxnet_tpu.analysis.step_audit import audit_jit
+    bcfg = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2,
+                     feat=16, n_microbatch=1, dtype="bfloat16")
+    bparams = gpt_init(jax.random.PRNGKey(5), bcfg)
+    old = pk._INTERPRET
+    pk._INTERPRET = True
+    try:
+        eng = DecodeEngine(bcfg, bparams, 2, prefill_chunk=4,
+                           abstract=True,
+                           num_blocks=auto_num_blocks(bcfg, 2, 4),
+                           int4_weights=True, int4_group=8, spec_len=3,
+                           fused_attn=False)
+        assert eng.int4_formulation == "fused"
+        report, infos = audit_serve_engine(eng, donate=True)
+    finally:
+        pk._INTERPRET = old
+    assert report.ok(), report.format()
+    armed = [i for i in infos if "int4_dequants" in i]
+    assert armed, "no program armed the CXN211 check"
+    for info in armed:
+        assert info["int4_dequants"] == 0
+        assert info["int8_promotions"] == 0
+        assert " int4=clean" in format_step_info(info)
+    # positive control: a full-width dequant in front of the matmul is
+    # exactly the HBM traffic the packing exists to remove
+    k, n, g = 16, 48, 2
+    rows = jnp.minimum(jnp.arange(k) // (k // g), g - 1)
+
+    def bad(x, packed, scales):
+        w = (_unpack_int4(packed).astype(jnp.float32)
+             * scales[rows]).astype(x.dtype)
+        return x @ w
+
+    findings, info = audit_jit(
+        jax.jit(bad),
+        (jax.ShapeDtypeStruct((2, k), jnp.bfloat16),
+         jax.ShapeDtypeStruct((k, n // 2), jnp.uint8),
+         jax.ShapeDtypeStruct((g, n), jnp.float32)),
+        "bad", check_int4={(k, n)})
+    assert "CXN211" in [f.rule for f in findings]
+    assert info["int4_dequants"] >= 1
+    assert "materialized" in format_step_info(info)
+    # the widened CXN209: a packed-nibble (u8) operand converted
+    # straight to f32 inside a quantized step is a silent promotion
+    findings, info = audit_jit(
+        jax.jit(lambda a: a.astype(jnp.float32).sum()),
+        (jax.ShapeDtypeStruct((4,), jnp.uint8),), "bad209",
+        check_int8=True)
+    assert [f.rule for f in findings] == ["CXN209"]
+    assert info["int8_promotions"] == 1
+
+
+def test_ledger_prices_packed_weight_pool():
+    """cxn_device_bytes{pool=params} under int4 prices the PACKED
+    representation: the weight pool shrinks by ~8x against the f32
+    engine (4 bits vs 32 per block-weight element; the unquantized
+    outer dict, biases and scale planes damp the pool-level ratio on
+    this tiny config, where they are a large fraction of the bytes),
+    and the engine's block dict really holds uint8 planes with
+    (L, G, n) scales."""
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                       int4_weights=True, int4_group=8)
+    for wk, sk in QUANT_DECODE_PAIRS:
+        assert eng._blocks[wk].dtype == jnp.uint8
+        k = eng._blocks[wk].shape[1]
+        assert eng._blocks[sk].shape[1] == _int4_groups(k, 8)
+    sizes = {}
+    for int4 in (False, True):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=4,
+                             prefill_chunk=4, num_blocks=NB,
+                             int4_weights=int4) as srv:
+            res = srv.result(srv.submit(np.arange(6, dtype=np.int32),
+                                        max_tokens=3), timeout=300)
+            assert res.status == "ok"
+            m = srv.metrics()
+            sizes[int4] = m["device_bytes"]["pools"]["params"]
+            assert m["int4_weights"] is int4
+    assert sizes[True] < 0.45 * sizes[False], sizes
+    # the matmul planes themselves (the part int4 packs) shrink ~8x
+    q4 = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
+                      int4_weights=True)
+    full = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB)
+    packed = sum(int(np.prod(q4._blocks[wk].shape))
+                 for wk, _ in QUANT_DECODE_PAIRS)
+    plain = sum(int(np.prod(full._blocks[wk].shape))
+                * full._blocks[wk].dtype.itemsize
+                for wk, _ in QUANT_DECODE_PAIRS)
+    assert packed * 8 == plain
+
+
+# ----------------------------------------------------------- chaos soak
+@pytest.mark.slow
+def test_chaos_soak_with_int4_armed():
+    """The resilience chaos soak rides with int4 weights armed: every
+    injection point firing at low probability over a mixed workload,
+    every request completes, the streams stay bit-identical to an
+    undisturbed int4 server (the packed pool makes regeneration
+    deterministic exactly like full precision), and the block refcount
+    audit stays clean."""
+    rs = np.random.RandomState(11)
+    cases = [dict(p=_prompt(rs, rs.randint(5, 14)),
+                  max_tokens=int(rs.randint(4, 8)))
+             for _ in range(12)]
+    outs = {}
+    for chaos in ("", "all:0.02,seed:3,hang_ms:50"):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=16,
+                             prefill_chunk=4, num_blocks=NB,
+                             int4_weights=True, spec_mode="ngram",
+                             spec_len=2, chaos=chaos,
+                             max_restarts=50) as srv:
+            hs = [srv.submit(c["p"], max_tokens=c["max_tokens"])
+                  for c in cases]
+            outs[chaos] = [srv.result(h, timeout=600) for h in hs]
+            eng = srv._engine
+            eng.manager.check_consistency(
+                srv._prefix.trie_refs() if srv._prefix is not None else 0)
+    for a, b in zip(outs[""], outs["all:0.02,seed:3,hang_ms:50"]):
+        assert a.status == "ok" and b.status == "ok"
+        np.testing.assert_array_equal(a.tokens, b.tokens)

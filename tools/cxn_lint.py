@@ -21,6 +21,12 @@ with the block pool's donation aliasing pinned. Quantized configs
 (``serve_int8_weights=1`` / ``serve_kv_dtype=int8``) audit the int8
 variants themselves: aliasing on every (values, scales) leaf, plus the
 CXN209 no-silent-f32-promotion check on bf16 compute. Under
+``serve_int4_weights=1`` additionally audits the packed-nibble
+programs: the engine streams the uint8-packed weight planes, the
+``int4=`` column reports whether any executable materializes an
+unpacked int4 weight image in HBM (CXN211 where the fused
+dequant-matmul should be active), and CXN209 covers the i4/u8 ->
+f32 promotion variant. Under
 ``serve_tp=N`` the audit builds the model-axis mesh and audits the
 PARTITIONED executables — including the shard_map-wrapped fused
 paged-attention programs (armed in Pallas interpret mode off-TPU when
@@ -133,11 +139,16 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
             serve_bs = int(task.serve_block_size)
             if serve_bs < 0 and task.serve_paged \
                     and task.serve_prefill_chunk > 0:
-                from cxxnet_tpu.serve.engine import resolve_block_size
+                from cxxnet_tpu.serve.engine import (resolve_block_size,
+                                                     weight_stream_tag)
                 serve_bs = resolve_block_size(
                     gcfg, task.serve_prefill_chunk, serve_bs,
                     kv_dtype=task.serve_kv_dtype, tp=max(1, tp),
-                    aot=aot_dir or None)
+                    aot=aot_dir or None,
+                    weights=weight_stream_tag(
+                        bool(task.serve_int8_weights),
+                        bool(task.serve_int4_weights),
+                        int(task.serve_int4_group)))
             nb = 0
             if task.serve_paged and task.serve_prefill_chunk > 0:
                 nb = (task.serve_num_blocks or auto_num_blocks(
@@ -201,6 +212,10 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                    mesh=mesh,
                                    int8_weights=bool(
                                        task.serve_int8_weights),
+                                   int4_weights=bool(
+                                       task.serve_int4_weights),
+                                   int4_group=int(
+                                       task.serve_int4_group),
                                    kv_dtype=task.serve_kv_dtype)
                 # the serve executables ride under the same compile-time
                 # budget as the trainer steps (CXN207): pass
@@ -240,6 +255,8 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                               else 0),
                     fused_attn=bool(task.serve_fused_attn), mesh=mesh,
                     int8_weights=bool(task.serve_int8_weights),
+                    int4_weights=bool(task.serve_int4_weights),
+                    int4_group=int(task.serve_int4_group),
                     kv_dtype=task.serve_kv_dtype)
                 aot_report, aot_infos = audit_aot_artifacts(
                     veng, aot_dir,

@@ -82,6 +82,17 @@ _DEFAULT_CELL_TOL = {
     #                                         dequant dispatch jitter
     #                                         (CPU pins machinery, not
     #                                         bandwidth — serving.md)
+    "serve_tokens_per_mib_int4": 0.30,      # open-loop trace on shared
+    #                                         cores; the metric prices
+    #                                         tokens/s per MiB of device
+    #                                         working set (KV + packed
+    #                                         weight pool), so wall
+    #                                         noise lands in the
+    #                                         numerator
+    "gpt_decode_int4_ms_per_token": 0.30,   # CPU pins the dequant
+    #                                         machinery, not HBM
+    #                                         bandwidth — dispatch
+    #                                         jitter dominates
     "serve_tokens_per_sec_tp2": 0.30,       # tiny-geometry trace cells:
     #                                         dispatch-bound on CPU, so
     "serve_tokens_per_sec_replicated": 0.30,  # scheduler-thread timing
