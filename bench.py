@@ -1256,6 +1256,100 @@ def bench_serve_int4():
          bf_ms / i4_ms, bf16_ms_per_token=round(bf_ms, 4))
 
 
+LORA_CELL = dict(layers=2, heads=4, feat=64, seq=160, vocab=256,
+                 slots=8, n_requests=16, n_adapters=16, rank=4,
+                 mean_gap_ms=1.0, seed=23, chunk=16, max_new=(16, 24))
+
+
+def bench_serve_lora():
+    """Batched multi-LoRA cell (doc/serving.md "Batched multi-LoRA"): a
+    mixed 16-adapter Poisson trace served two ways through the SAME
+    armed stack. The batched arm holds every adapter resident in the
+    paged pool and serves the whole mixed population in one decode tick
+    per step (one traced program, per-row adapter ids, ragged grouped
+    delta). The swap baseline models the classic one-adapter-at-a-time
+    engine: a 2-slot pool (base + one adapter) served group-by-group —
+    drain the batch, swap the next adapter in, re-admit — which is what
+    serving N adapters costs without per-row dispatch. Emits
+    ``serve_tokens_per_sec_lora_mixed`` (vs_baseline = batched/swap;
+    acceptance gate >= 2 — every request names its OWN adapter, so the
+    swap arm's ticks run one row each while the batched arm keeps all
+    8 slots full) and ``serve_lora_vs_swap`` (the ratio itself), with
+    the batched arm's pool counters as extras."""
+    import jax
+    from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+    from cxxnet_tpu.serve import InferenceServer
+    from cxxnet_tpu.serve.lora import make_adapter
+
+    c = dict(LORA_CELL)
+    cfg = GPTConfig(vocab_size=c["vocab"], seq_len=c["seq"],
+                    n_layer=c["layers"], n_head=c["heads"], feat=c["feat"],
+                    n_microbatch=1)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    names = ["a%02d" % i for i in range(c["n_adapters"])]
+    adapters = {n: make_adapter(cfg, c["rank"], seed=i)
+                for i, n in enumerate(names)}
+    spec = ";".join("%s:%s.npz" % (n, n) for n in names)
+
+    rs = np.random.RandomState(c["seed"])
+    gaps = rs.exponential(c["mean_gap_ms"] / 1e3, c["n_requests"])
+    maxt = rs.choice(list(c["max_new"]), c["n_requests"])
+    trace = [(float(g),
+              rs.randint(0, c["vocab"], (rs.randint(8, 24),))
+              .astype(np.int32),
+              int(m), names[i % c["n_adapters"]])
+             for i, (g, m) in enumerate(zip(gaps, maxt))]
+
+    def arm(batched):
+        # pool_mb tiny-but-set clamps the swap arm to the 2-slot floor
+        # (base + one adapter): every group change is a host swap-in,
+        # exactly the engine the batched pool replaces
+        srv = InferenceServer(
+            cfg, params, slots=c["slots"], queue=c["n_requests"],
+            prefill_chunk=c["chunk"], prefix_mb=4.0, paged=True,
+            lora=spec, lora_rank=c["rank"], lora_adapters=adapters,
+            lora_pool_mb=(0.0 if batched else 1e-9))
+        def one_pass():
+            t0 = time.perf_counter()
+            if batched:                      # open loop, mixed population
+                handles = []
+                for gap, p, m, a in trace:
+                    time.sleep(gap)
+                    handles.append(srv.submit(p, max_tokens=m, adapter=a))
+                for h in handles:
+                    srv.result(h)
+            else:                            # drain between adapter groups
+                for name in names:
+                    group = [srv.submit(p, max_tokens=m, adapter=a)
+                             for _, p, m, a in trace if a == name]
+                    for h in group:
+                        srv.result(h)
+            return time.perf_counter() - t0
+
+        try:
+            one_pass()                       # compile + populate the pool
+            best = float("inf")
+            for _ in range(2):
+                srv.reset_metrics()
+                wall = one_pass()
+                m = srv.metrics()
+                best = min(best, wall)
+        finally:
+            srv.shutdown()
+        return m["tokens_generated"] / best, m
+
+    tps_seq, _ = arm(batched=False)
+    tps_mix, mm = arm(batched=True)
+    ratio = tps_mix / max(tps_seq, 1e-9)
+    lp = mm["lora"]
+    emit("serve_tokens_per_sec_lora_mixed", tps_mix, "tokens/sec",
+         ratio, swap_tokens_per_sec=round(tps_seq, 2),
+         pool_hits=lp["hits"], pool_swap_ins=lp["swap_ins"],
+         pool_evictions=lp["evictions"], pool_slots=lp["size"],
+         adapters=c["n_adapters"], rank=lp["rank"])
+    emit("serve_lora_vs_swap", ratio, "x", ratio)
+
+
 # the sharded/replicated serving cell (round 17, doc/serving.md
 # "Sharded & replicated serving"): small geometry — the POINT on a CPU
 # rig is exercising the real partitioned programs / router machinery
@@ -1842,7 +1936,7 @@ def main() -> int:
                bench_serve_prefill_heavy, bench_serve_paged,
                bench_serve_fused, bench_serve_longctx,
                bench_serve_autotune, bench_serve_int8, bench_serve_int4,
-               bench_serve_sharded,
+               bench_serve_lora, bench_serve_sharded,
                bench_serve_replicated, bench_serve_fleet,
                bench_serve_tenanted,
                bench_serve_spec, bench_serve_cold_start,

@@ -134,6 +134,22 @@ class LearnTask:
         #                           halved swap bandwidth; paged only
         #                           (doc/serving.md "Quantized
         #                           serving")
+        self.serve_lora = ""      # batched multi-LoRA adapter registry:
+        #                           "name:path.npz;name2:path2.npz" —
+        #                           per-request adapters served in ONE
+        #                           batched tick through a paged device
+        #                           pool of factor pages (serve/lora.py,
+        #                           doc/serving.md "Batched multi-LoRA");
+        #                           paged engine only; "" = a pinned
+        #                           STRUCTURAL no-op (no adapter operand
+        #                           in the serve programs)
+        self.serve_lora_rank = 8  # adapter rank r (must match the
+        #                           registered adapter files)
+        self.serve_lora_pool_mb = 0.0   # device budget for the adapter
+        #                                 pool in MiB (0 = size the pool
+        #                                 for the whole registry; smaller
+        #                                 budgets page adapters LRU like
+        #                                 KV blocks)
         self.serve_chaos = ""     # fault-injection spec (chaos harness;
         #                           grammar in serve/resilience.py, e.g.
         #                           "tick_raise:0.01,seed:7"; the
@@ -340,6 +356,12 @@ class LearnTask:
             self.serve_int4_group = int(val)
         elif name == "serve_kv_dtype":
             self.serve_kv_dtype = val
+        elif name == "serve_lora":
+            self.serve_lora = val
+        elif name == "serve_lora_rank":
+            self.serve_lora_rank = int(val)
+        elif name == "serve_lora_pool_mb":
+            self.serve_lora_pool_mb = float(val)
         elif name == "serve_chaos":
             self.serve_chaos = val
         elif name == "serve_max_restarts":
@@ -1285,6 +1307,9 @@ class LearnTask:
                          int4_weights=bool(self.serve_int4_weights),
                          int4_group=int(self.serve_int4_group),
                          kv_dtype=self.serve_kv_dtype,
+                         lora=self.serve_lora,
+                         lora_rank=int(self.serve_lora_rank),
+                         lora_pool_mb=float(self.serve_lora_pool_mb),
                          recompile_limit=self.net.lint_recompile_limit,
                          recompile_strict=bool(
                              self.net.lint_recompile_strict),
@@ -1356,6 +1381,10 @@ class LearnTask:
                 mode += ", int8 weights"
             if self.serve_int4_weights:
                 mode += ", int4 weights (group %d)" % self.serve_int4_group
+            if self.serve_lora:
+                lp = (srv.servers[0] if routed else srv).lora_pool
+                mode += (", lora r%d (%d adapters, %d pool slots)"
+                         % (lp.rank, len(lp.registry), lp.size))
             if routed:
                 mode += ", %d replicas (%s router)" % (
                     self.serve_replicas, self.serve_router)

@@ -187,7 +187,8 @@ def _attn_core_bhnd(p: Dict[str, jnp.ndarray], h: jnp.ndarray, n_head: int,
     return h + o + p["b_proj"].astype(x.dtype)
 
 
-def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str):
+def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str,
+          shards: int = 1):
     """``x @ p[wk]`` with the int8 weight-streaming dequant applied when
     ``p`` carries the matching per-out-column scale ``sk`` (the
     _quantize_decode_blocks scheme: dequant commutes with the
@@ -203,10 +204,12 @@ def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str):
     _int4): group-wise scales on the CONTRACTION dim do not commute
     with the matmul, so the whole product routes to _qmat4 (per-group
     partials scaled before the cross-group sum). The dtype check is
-    static too — bf16/f32 and int8 programs keep their exact jaxpr."""
+    static too — bf16/f32 and int8 programs keep their exact jaxpr.
+    ``shards``: how many independent out-dim segments the packed plane
+    holds (the shard-aware TP packing — see _pack_int4)."""
     w = p[wk]
     if w.dtype == jnp.uint8:
-        return _qmat4(x, w, p[sk])
+        return _qmat4(x, w, p[sk], shards=shards)
     y = x @ w.astype(x.dtype)
     if sk in p:
         y = y * p[sk].astype(x.dtype)
@@ -214,13 +217,20 @@ def _qmat(x, p: Dict[str, jnp.ndarray], wk: str, sk: str):
 
 
 def _mlp_core(p: Dict[str, jnp.ndarray], h: jnp.ndarray, reduce,
-              pre=lambda x: x):
+              pre=lambda x: x, lora=None, int4_shards: int = 1):
     """MLP half of the pre-LN block (LN2 -> up -> relu -> down ->
-    residual)."""
+    residual). ``lora``, when set, is the serve-time per-row low-rank
+    delta hook ``lora(site, x, y) -> y'`` (serve/lora.py) — a static
+    (trace-time) check, so lora-less programs keep their exact jaxpr."""
     x = pre(_layernorm(h, p["ln2_g"], p["ln2_b"]))
-    m = jax.nn.relu(_qmat(x, p, "w_mlp1", "s_mlp1")
-                    + p["b_mlp1"].astype(x.dtype))
-    m = reduce(_qmat(m, p, "w_mlp2", "s_mlp2"))
+    m = _qmat(x, p, "w_mlp1", "s_mlp1", int4_shards)
+    if lora is not None:
+        m = lora("mlp1", x, m)
+    m = jax.nn.relu(m + p["b_mlp1"].astype(x.dtype))
+    m2 = _qmat(m, p, "w_mlp2", "s_mlp2", int4_shards)
+    if lora is not None:
+        m2 = lora("mlp2", m, m2)
+    m = reduce(m2)
     return h + m + p["b_mlp2"].astype(x.dtype)
 
 
@@ -709,22 +719,37 @@ def gpt_place(params: Dict, mesh: Mesh, zero: int = 0) -> Dict:
 
 
 def _block_core_fusedqkv(p: Dict[str, jnp.ndarray], h: jnp.ndarray,
-                         n_head: int, attn, reduce):
+                         n_head: int, attn, reduce, lora=None,
+                         int4_shards: int = 1):
     """Decode-path block body on pre-fused QKV weights ("w_qkv" (f, 3f),
     "b_qkv" (3f)): batch-1 decode is bound by per-layer op count, not
     bandwidth (doc/performance.md round 3), so one projection matmul
     instead of three measured +12% tok/s with bit-identical outputs. The
     training path keeps separate projections — there the fused weight
-    concat re-runs inside scan/remat and measured 7% SLOWER (round 2)."""
+    concat re-runs inside scan/remat and measured 7% SLOWER (round 2).
+
+    ``lora`` (serve/lora.py): per-row low-rank delta hook
+    ``lora(site, x, y) -> y'`` applied to all four matmul sites; a
+    static trace-time check, so lora-less programs keep their exact
+    jaxpr. ``int4_shards``: shard count of a shard-aware int4 packing
+    (serve_tp x serve_int4_weights — see _pack_int4)."""
     b, n, _ = h.shape
     x = _layernorm(h, p["ln1_g"], p["ln1_b"])
-    qkv = _qmat(x, p, "w_qkv", "s_qkv") + p["b_qkv"].astype(x.dtype)
+    qkv = _qmat(x, p, "w_qkv", "s_qkv", int4_shards)
+    if lora is not None:
+        qkv = lora("qkv", x, qkv)
+    qkv = qkv + p["b_qkv"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     d = q.shape[-1] // n_head
     att, aux = attn(q.reshape(b, n, n_head, d), k.reshape(b, n, n_head, d),
                     v.reshape(b, n, n_head, d))
-    o = reduce(_qmat(att.reshape(b, n, -1), p, "w_proj", "s_proj"))
-    return _mlp_core(p, h + o + p["b_proj"].astype(x.dtype), reduce), aux
+    af = att.reshape(b, n, -1)
+    o = _qmat(af, p, "w_proj", "s_proj", int4_shards)
+    if lora is not None:
+        o = lora("proj", af, o)
+    o = reduce(o)
+    return _mlp_core(p, h + o + p["b_proj"].astype(x.dtype), reduce,
+                     lora=lora, int4_shards=int4_shards), aux
 
 
 def _fuse_qkv_blocks(blocks: Dict[str, jnp.ndarray]) -> Dict:
@@ -818,29 +843,49 @@ def _int4_groups(k: int, group: int) -> int:
     return 1 if group <= 0 else -(-k // group)
 
 
-def _pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+def _pack_int4(q: jnp.ndarray, shards: int = 1) -> jnp.ndarray:
     """int8 codes in [-7, 7] (..., k, n) -> packed uint8 (..., k, n/2).
     Halves layout: byte column j holds out-column j in the LOW nibble
     and out-column j + n/2 in the HIGH nibble (offset-8 codes), so the
     unpack is one lane-dim concatenate — no interleave reshape, which
-    Mosaic would materialize. n must be even (the quantizer pads)."""
+    Mosaic would materialize. n must be even (the quantizer pads).
+
+    ``shards`` > 1 (serve_tp x serve_int4_weights): each of the
+    ``shards`` equal out-dim segments packs INDEPENDENTLY — nibble
+    pairs never straddle a shard boundary, so sharding the packed
+    plane's byte dim over the model axis hands every device exactly
+    its own shard's self-contained bytes. The codes themselves are
+    packing-independent, which is what keeps TP-int4 bit-identical to
+    the single-device packing."""
+    if shards > 1:
+        w = q.shape[-1] // shards
+        return jnp.concatenate(
+            [_pack_int4(q[..., s * w:(s + 1) * w])
+             for s in range(shards)], axis=-1)
     half = q.shape[-1] // 2
     u = (q + jnp.int8(8)).astype(jnp.uint8)
     return u[..., :half] | (u[..., half:] << jnp.uint8(4))
 
 
-def _unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+def _unpack_int4(packed: jnp.ndarray, shards: int = 1) -> jnp.ndarray:
     """packed uint8 (..., k, n/2) -> int8 codes (..., k, n); exact
-    inverse of :func:`_pack_int4`. The uint8 -> int8 hop happens BEFORE
-    any float convert (the CXN209/CXN211 audit contract: nibble codes
-    are exact in bf16's 8 mantissa bits, so no silent f32 promotion)."""
+    inverse of :func:`_pack_int4` (``shards`` must match the packing).
+    The uint8 -> int8 hop happens BEFORE any float convert (the
+    CXN209/CXN211 audit contract: nibble codes are exact in bf16's 8
+    mantissa bits, so no silent f32 promotion)."""
+    if shards > 1:
+        w = packed.shape[-1] // shards
+        return jnp.concatenate(
+            [_unpack_int4(packed[..., s * w:(s + 1) * w])
+             for s in range(shards)], axis=-1)
     lo = (packed & jnp.uint8(0xF)).astype(jnp.int8) - jnp.int8(8)
     hi = (packed >> jnp.uint8(4)).astype(jnp.int8) - jnp.int8(8)
     return jnp.concatenate([lo, hi], axis=-1)
 
 
 def _quantize_decode_blocks_int4(blocks: Dict,
-                                 group: int = INT4_GROUP_DEFAULT) -> Dict:
+                                 group: int = INT4_GROUP_DEFAULT,
+                                 shards: int = 1) -> Dict:
     """Group-wise symmetric int4 quantization of the four matmul weights
     in the fused-QKV block dict: scale[l, g, j] = max over the g-th
     in-row group of |w[l, :, j]| / 7, codes clipped to [-7, 7] and
@@ -849,7 +894,9 @@ def _quantize_decode_blocks_int4(blocks: Dict,
     — so G and g0 re-derive from the scale plane's shape alone and the
     fast kernel's equal-block grid applies whenever G divides k.
     Biases/LN stay exact; odd out-widths pad one zero column (packed
-    only — the scale plane keeps the true n)."""
+    only — the scale plane keeps the true n). ``shards`` > 1 selects
+    the shard-aware TP packing (see _pack_int4); codes and scales are
+    packing-independent, only the byte layout changes."""
     bl = dict(blocks)
     for wk, sk in QUANT_DECODE_PAIRS:
         w = bl[wk].astype(jnp.float32)                 # (L, k, n)
@@ -861,22 +908,27 @@ def _quantize_decode_blocks_int4(blocks: Dict,
         wg = wg.reshape(L, G, g0, n)
         s = jnp.maximum(jnp.max(jnp.abs(wg), axis=2) / 7.0, 1e-8)
         q = jnp.clip(jnp.round(w / s[:, rows, :]), -7, 7).astype(jnp.int8)
-        if n % 2:
+        if shards > 1:
+            if n % (2 * shards):
+                raise ValueError(
+                    "int4 TP packing needs the out dim to split into "
+                    "%d even shards, got n=%d (%s)" % (shards, n, wk))
+        elif n % 2:
             q = jnp.pad(q, ((0, 0), (0, 0), (0, 1)))
-        bl[wk] = _pack_int4(q)                         # (L, k, ~n/2) u8
+        bl[wk] = _pack_int4(q, shards)                 # (L, k, ~n/2) u8
         bl[sk] = s                                     # (L, G, n) f32
     return bl
 
 
-def _dequantize_decode_blocks_int4(qblocks: Dict,
-                                   dtype=jnp.float32) -> Dict:
+def _dequantize_decode_blocks_int4(qblocks: Dict, dtype=jnp.float32,
+                                   shards: int = 1) -> Dict:
     """Inverse of :func:`_quantize_decode_blocks_int4` up to the int4
     rounding (tests compare programs on packed inputs against programs
     on these)."""
     bl = dict(qblocks)
     for wk, sk in QUANT_DECODE_PAIRS:
         s = bl.pop(sk)                                 # (L, G, n)
-        q = _unpack_int4(bl[wk])                       # (L, k, n_pad)
+        q = _unpack_int4(bl[wk], shards)               # (L, k, n_pad)
         k = q.shape[1]
         G, n = int(s.shape[1]), int(s.shape[2])
         g0 = -(-k // G)
@@ -886,17 +938,20 @@ def _dequantize_decode_blocks_int4(qblocks: Dict,
     return bl
 
 
-def _qmat4_ref(x, packed, scales):
+def _qmat4_ref(x, packed, scales, shards: int = 1):
     """XLA reference for the packed-int4 matmul — mirrors the Pallas
     kernel OP FOR OP (zeros-init f32 accumulator; per group: unpack,
     cast to the compute dtype, dot_general with f32 accumulation, scale
     the partial, add) so interpret-mode bit-identity is a structural
-    property, not a tolerance. Handles the ragged last group and odd-n
-    pad column the kernel's geometry gate excludes."""
+    property, not a tolerance. Handles the ragged last group, the odd-n
+    pad column the kernel's geometry gate excludes, and the shard-aware
+    TP packing (``shards`` > 1): the unpack keeps each shard's columns
+    device-local, and every out column is still one full-k contraction,
+    so the result is bit-identical to the single-device packing's."""
     G, n = int(scales.shape[0]), int(scales.shape[1])
     k = int(x.shape[-1])
     g0 = -(-k // G)
-    qq = _unpack_int4(packed)[:, :n]
+    qq = _unpack_int4(packed, shards)[:, :n]
     acc = jnp.zeros((x.shape[0], n), jnp.float32)
     for g in range(G):
         lo, hi = g * g0, min((g + 1) * g0, k)
@@ -908,13 +963,17 @@ def _qmat4_ref(x, packed, scales):
     return acc.astype(x.dtype)
 
 
-def _qmat4(x, packed, scales):
+def _qmat4(x, packed, scales, shards: int = 1):
     """``x @ dequant(packed, scales)`` without materializing the
     dequantized weight: the Pallas kernel when the geometry qualifies
     (ops/pallas_kernels.int4_matmul — unpack + dequant inside the
     matmul tile in VMEM), else :func:`_qmat4_ref`. The route is a
     trace-time decision, so each compiled program contains exactly one
-    formulation."""
+    formulation. A shard-aware packing (``shards`` > 1, the TP path)
+    always keeps the XLA reference: the kernel's in-tile unpack
+    assumes the single-segment halves layout, and GSPMD cannot
+    partition the pallas_call anyway — the reference's per-shard
+    unpack is what partitions cleanly."""
     lead, k = x.shape[:-1], int(x.shape[-1])
     G, n = int(scales.shape[0]), int(scales.shape[1])
     m = 1
@@ -922,12 +981,12 @@ def _qmat4(x, packed, scales):
         m *= int(d)
     x2 = x.reshape(m, k)
     from ..ops import pallas_kernels as _pk
-    if (k % G == 0 and 2 * int(packed.shape[-1]) == n
+    if (shards == 1 and k % G == 0 and 2 * int(packed.shape[-1]) == n
             and _pk.int4_matmul_supported(m, k, n, G,
                                           itemsize=x.dtype.itemsize)):
         y = _pk.int4_matmul(x2, packed, scales)
     else:
-        y = _qmat4_ref(x2, packed, scales)
+        y = _qmat4_ref(x2, packed, scales, shards)
     return y.reshape(lead + (n,))
 
 

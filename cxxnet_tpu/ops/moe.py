@@ -153,6 +153,22 @@ def switch_moe(x: jnp.ndarray, w_gate: jnp.ndarray, w_up: jnp.ndarray,
     return out.astype(x.dtype), aux
 
 
+def grouped_order(ids: jnp.ndarray, n_groups: int):
+    """Segment-sort plan for a ragged grouped GEMM: stable argsort of
+    the per-row group ids plus the per-group segment sizes
+    ``lax.ragged_dot`` consumes. Shared by the dropless MoE dispatch
+    below and the serve-time multi-LoRA delta (serve/lora.py) — both
+    are the same "sort rows by matrix id, run one grouped GEMM over the
+    ragged segments, unsort" move. The stable sort keeps same-group
+    rows in submission order, so every row's dot is a full contraction
+    regardless of which neighbours share its group (per-row results are
+    bit-identical across batch compositions — the property the LoRA
+    solo-oracle identity pins lean on)."""
+    order = jnp.argsort(ids, stable=True)
+    group_sizes = jnp.bincount(ids, length=n_groups).astype(jnp.int32)
+    return order, group_sizes
+
+
 def _switch_moe_ragged(x, w_gate, w_up, w_down, top_k):
     """Dropless (Megablocks-style) dispatch: no capacity, no dropped
     tokens. Tokens are sorted by expert and the per-expert FFN runs as a
@@ -170,10 +186,9 @@ def _switch_moe_ragged(x, w_gate, w_up, w_down, top_k):
     gate = top_p.reshape(-1)
     expert_idx = top_i.astype(jnp.int32).reshape(-1)            # (S*k,)
 
-    order = jnp.argsort(expert_idx, stable=True)                # (S*k,)
+    order, group_sizes = grouped_order(expert_idx, e)           # (S*k,)
     x_flat = x if top_k == 1 else jnp.repeat(x, top_k, axis=0)
     x_sorted = x_flat[order]
-    group_sizes = jnp.bincount(expert_idx, length=e).astype(jnp.int32)
     h = jax.nn.relu(lax.ragged_dot(x_sorted, w_up.astype(x.dtype),
                                    group_sizes))
     y = lax.ragged_dot(h, w_down.astype(x.dtype), group_sizes)
@@ -272,4 +287,4 @@ def switch_moe_alltoall(x: jnp.ndarray, w_gate: jnp.ndarray,
     return out.astype(x.dtype), aux
 
 
-__all__ = ["switch_moe", "switch_moe_alltoall"]
+__all__ = ["switch_moe", "switch_moe_alltoall", "grouped_order"]

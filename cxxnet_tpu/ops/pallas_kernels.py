@@ -1257,7 +1257,9 @@ __all__ = ["use_pallas", "lrn_fused", "flash_attention",
            "fused_relu_lrn_maxpool", "fused_relu_lrn_maxpool_supported",
            "layernorm_fused", "layernorm_fused_supported",
            "int4_matmul", "int4_matmul_supported",
-           "int4_matmul_geometry_ok", "int4_matmul_fallback_reason"]
+           "int4_matmul_geometry_ok", "int4_matmul_fallback_reason",
+           "lora_bgmv", "lora_bgmv_supported",
+           "lora_bgmv_geometry_ok", "lora_bgmv_fallback_reason"]
 
 
 # ---------------------------------------------------------------------------
@@ -2297,3 +2299,131 @@ def int4_matmul(x, packed, scales):
             dimension_semantics=("arbitrary",)),
         interpret=_INTERPRET,
     )(x, packed, scales)
+
+
+# ---------------------------------------------------------------------------
+# batched grouped low-rank matmul (multi-LoRA serving, round 20): every
+# slot row of one decode tick may carry a DIFFERENT rank-r adapter, so
+# the delta matmul is a batch of tiny (n, in) x (in, r) x (r, out)
+# products indexed by a per-row adapter id. The kernel rides the paged-
+# attention scalar-prefetch idiom: the adapter-id vector is prefetched,
+# the index_map gathers row i's A/B factor tiles straight from the
+# device adapter pool into VMEM (rows arrive segment-sorted by id, so
+# consecutive rows hit the SAME block index and Mosaic skips the
+# re-fetch — the sort IS the batching), and the two dots accumulate in
+# f32 before folding into the base projection. The XLA reference is the
+# ragged grouped dispatch in serve/lora.py (ops/moe.py grouped_order +
+# lax.ragged_dot) — op-for-op the same per-row contraction, pinned
+# bit-exact in interpret mode.
+
+# per-row VMEM budget of the bgmv tile (x/base tiles + A/B factor pair
+# + f32 accumulators); module-level so tests can shrink it and drive
+# geometries across the fused -> XLA reference crossover
+_LORA_TILE_VMEM = 8 * 1024 * 1024
+
+
+def _lora_tile_vmem(n: int, d_in: int, r: int, d_out: int,
+                    itemsize: int = 2) -> int:
+    """Bytes one (row) grid step holds at once."""
+    return (n * d_in * itemsize             # x tile
+            + d_in * r * itemsize           # A factor tile
+            + r * d_out * itemsize          # B factor tile
+            + n * r * 4                     # f32 intermediate
+            + n * d_out * (4 + 2 * itemsize))   # f32 acc + base + out
+
+
+def lora_bgmv_geometry_ok(n: int, d_in: int, r: int, d_out: int,
+                          itemsize: int = 2) -> bool:
+    """The geometry half of the bgmv gate: the factor pair and the f32
+    intermediates must fit the per-row VMEM budget, and on a real TPU
+    the operand dims must be lane/sublane friendly (in/out spanning
+    full 128-lane registers, the rank a sublane multiple — rank 8 is
+    the floor). Interpret mode waives the alignment limits (tiny
+    differential-test models run) but keeps the VMEM check."""
+    if r < 1 or n < 1:
+        return False
+    if _lora_tile_vmem(n, d_in, r, d_out, itemsize) > _LORA_TILE_VMEM:
+        return False
+    if _INTERPRET:
+        return True
+    return r % 8 == 0 and d_in % 128 == 0 and d_out % 128 == 0
+
+
+def lora_bgmv_supported(n: int, d_in: int, r: int, d_out: int,
+                        itemsize: int = 2) -> bool:
+    """True when :func:`lora_bgmv` may serve this delta shape: TPU
+    backend (or interpret mode under test), the ``CXN_LORA_BGMV=0``
+    off-switch not thrown, and the geometry gate holds. Anything else
+    keeps serve/lora.py's ragged XLA reference — the bit-reference the
+    kernel is pinned against."""
+    if os.environ.get("CXN_LORA_BGMV", "1") == "0":
+        return False
+    return use_pallas() and lora_bgmv_geometry_ok(n, d_in, r, d_out,
+                                                  itemsize)
+
+
+def lora_bgmv_fallback_reason(n: int, d_in: int, r: int, d_out: int,
+                              itemsize: int = 2) -> str:
+    """Why the support gate rejected this shape — ``"env_off"``
+    (``CXN_LORA_BGMV=0``), ``"backend"`` (no TPU and no interpret
+    mode), ``"geometry"`` — or ``""`` when the kernel serves it. The
+    engine logs this once and counts it in
+    ``cxn_lora_fallback_total{reason=}`` (serve/engine.py)."""
+    if os.environ.get("CXN_LORA_BGMV", "1") == "0":
+        return "env_off"
+    if not use_pallas():
+        return "backend"
+    if not lora_bgmv_geometry_ok(n, d_in, r, d_out, itemsize):
+        return "geometry"
+    return ""
+
+
+def _lora_bgmv_kernel(ids_ref, x_ref, y_ref, a_ref, b_ref, o_ref):
+    """One grid step = one slot row: two MXU dots through the rank-r
+    bottleneck with f32 accumulation (``preferred_element_type``), the
+    per-adapter scale already folded into the stored B factor, and the
+    delta added to the base projection in f32 before the one cast back
+    to the compute dtype — op-for-op the ragged reference's per-row
+    contraction (serve/lora.py _delta_ref), so interpret-mode
+    bit-identity is a structural property, not a tolerance."""
+    del ids_ref                 # consumed by the index_maps
+    t = jax.lax.dot_general(
+        x_ref[0], a_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (n, r) f32
+    d = jax.lax.dot_general(
+        t, b_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (n, out) f32
+    o_ref[0] = (y_ref[0].astype(jnp.float32) + d).astype(o_ref.dtype)
+
+
+def lora_bgmv(x, y, a, b, ids):
+    """``y + (x @ a[ids]) @ b[ids]`` per row, f32-accumulated:
+    ``x`` (rows, n, d_in) activations, ``y`` (rows, n, d_out) base
+    projection, ``a`` (P, d_in, r) / ``b`` (P, r, d_out) the device
+    adapter pool's factor planes for ONE site of ONE layer (the
+    per-adapter scale is folded into ``b`` at pool build), ``ids``
+    (rows,) int32 pool slot per row — scalar-prefetched so the
+    index_map gathers each row's factor pair by id (callers pass rows
+    segment-sorted by id; consecutive equal ids reuse the resident
+    tile). Returns (rows, n, d_out) in y's dtype. Callers gate on
+    :func:`lora_bgmv_supported`."""
+    rows, n, d_in = x.shape
+    d_out = int(y.shape[-1])
+    r = int(a.shape[-1])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows,),
+        in_specs=[
+            pl.BlockSpec((1, n, d_in), lambda i, ids: (i, 0, 0)),
+            pl.BlockSpec((1, n, d_out), lambda i, ids: (i, 0, 0)),
+            pl.BlockSpec((1, d_in, r), lambda i, ids: (ids[i], 0, 0)),
+            pl.BlockSpec((1, r, d_out), lambda i, ids: (ids[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n, d_out), lambda i, ids: (i, 0, 0)),
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        _lora_bgmv_kernel, grid_spec=grid_spec,
+        out_shape=_out_struct((rows, n, d_out), y.dtype, y),
+        interpret=_INTERPRET,
+    )(ids, x, y, a, b)

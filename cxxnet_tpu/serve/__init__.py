@@ -27,6 +27,8 @@ from .resilience import (DegradationLadder, EngineFailedError,
                          FaultInjector, InjectedFault,
                          SwapCorruptionError)
 from .fleet import FleetRouter, parse_tiers
+from .lora import (AdapterPool, load_adapter, lora_delta, make_adapter,
+                   parse_lora_spec, save_adapter)
 from .router import RouterHandle, ServeRouter
 from .rpc import FrameError, RpcError, WorkerLostError
 from .scheduler import Request, SamplingParams, SlotScheduler
@@ -46,4 +48,6 @@ __all__ = ["InferenceServer", "SamplingParams", "ServeResult", "Request",
            "InjectedFault", "SwapCorruptionError", "EngineFailedError",
            "ServeRouter", "RouterHandle", "TenantPolicy",
            "TenantRegistry", "TokenBucket", "FleetRouter",
-           "parse_tiers", "FrameError", "RpcError", "WorkerLostError"]
+           "parse_tiers", "FrameError", "RpcError", "WorkerLostError",
+           "AdapterPool", "parse_lora_spec", "make_adapter",
+           "save_adapter", "load_adapter", "lora_delta"]

@@ -510,28 +510,37 @@ def serve_tp_size(mesh) -> int:
     return int(mesh.shape.get(MODEL_AXIS, 1))
 
 
-def serve_param_shardings(mesh):
+def serve_param_shardings(mesh, int4: bool = False):
     """NamedShardings for the engine's fused block dict + outer tree —
     the gather form: every matmul weight sharded on its OUTPUT dim
     (full contractions per shard — the bit-identity invariant), biases
     sharded to match their matmul's output, LN params and the
     embedding/head replicated. One table so the engine ctor, the
-    abstract (audit) engine, and tests cannot drift."""
+    abstract (audit) engine, and tests cannot drift.
+
+    ``int4``: the packed-nibble weight planes are still (L, k, n/2)
+    with the out dim last (the shard-aware packing keeps each shard's
+    bytes self-contained — models/gpt.py _pack_int4), so the col spec
+    holds; the dequant scales become 3-D (L, G, n) group planes whose
+    OUT dim is axis 2, so they take the col spec instead of the int8
+    bias-shaped vec spec."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..parallel.mesh import MODEL_AXIS
     ns = lambda *spec: NamedSharding(mesh, P(*spec))
     rep = ns()
     col = ns(None, None, MODEL_AXIS)        # (L, in, out): out sharded
     vec = ns(None, MODEL_AXIS)              # (L, out) bias
+    scale = col if int4 else vec            # int4: (L, G, out) planes
     blocks = {"w_qkv": col, "b_qkv": vec, "w_proj": col,
               "w_mlp1": col, "b_mlp1": vec, "w_mlp2": col,
               "ln1_g": rep, "ln1_b": rep, "ln2_g": rep, "ln2_b": rep,
               "b_proj": rep, "b_mlp2": rep,
-              # int8 weight streaming (serve_int8_weights): the (L, out)
-              # per-out-column dequant scales shard with their matmul's
-              # OUTPUT dim — the scale multiply is elementwise on the
-              # sharded dim, applied BEFORE the gather re-replication
-              "s_qkv": vec, "s_proj": vec, "s_mlp1": vec, "s_mlp2": vec}
+              # int8/int4 weight streaming: the dequant scales shard
+              # with their matmul's OUTPUT dim — the scale multiply is
+              # elementwise on the sharded dim, applied BEFORE the
+              # gather re-replication
+              "s_qkv": scale, "s_proj": scale, "s_mlp1": scale,
+              "s_mlp2": scale}
     outer = {k: rep for k in ("emb", "pos", "lnf_g", "lnf_b", "head")}
     return blocks, outer
 
@@ -1067,7 +1076,7 @@ def _paged_attn(q, pool_k, pool_v, table, pos, l, bs, mesh=None,
 
 @functools.lru_cache(maxsize=16)
 def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
-                   fused="", mesh=None):
+                   fused="", mesh=None, lora: bool = False):
     """Paged batched decode tick: same math as ``_tick_fn`` with the
     per-row dus replaced by a block scatter and the cache row reads by a
     table gather. Parked rows scatter into whatever their table's last
@@ -1092,15 +1101,25 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
     key — a fused and a gather engine over one config are different
     compiled programs — but deliberately NOT part of any RecompileGuard
     signature string (the guard counts traffic-driven drift, and the
-    formulation is fixed at engine construction)."""
+    formulation is fixed at engine construction).
+
+    ``lora`` arms the per-row adapter delta: the impl grows two traced
+    operands — the (b,) adapter-id vector and the device pool dict —
+    and every block matmul site routes through serve/lora.py's grouped
+    dispatch. The adapter ids are TRACED, so mixed-adapter traffic is
+    one signature; unarmed builders pass lora=None into the block core
+    and keep their exact jaxpr (the pinned structural no-op)."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     gather, pin_kv = _tp_ops(mesh)
     tp_mesh = mesh if serve_tp_size(mesh) > 1 else None
     streaming = (fused == "streaming")
+    shards = serve_tp_size(mesh)
+    if lora:
+        from .lora import lora_delta
 
     def impl(blocks, outer, pool_k, pool_v, table, tok, pos, keys, fold,
-             temp, top_k, top_p):
+             temp, top_k, top_p, *lrest):
         h = (outer["emb"][tok][:, None, :]
              + outer["pos"][jnp.minimum(pos, cfg.seq_len - 1)][:, None, :]
              ).astype(dtype)
@@ -1130,8 +1149,14 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
                                   bs)
                 return gather(_attn_cached_rows(q, ck, cv, pos)), (pk, pv)
 
+            hook = None
+            if lora:
+                aid, lpool = lrest
+                hook = lambda site, x, y, l=l: \
+                    lora_delta(lpool, aid, l, site, x, y)
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, gather)
+                p, h, cfg.n_head, attn, gather, lora=hook,
+                int4_shards=shards)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (b, V)
         keys_t = jax.vmap(jax.random.fold_in)(keys, fold)
@@ -1143,18 +1168,24 @@ def _tick_paged_fn(cfg_key: tuple, bs: int, bpr: int, donate: bool,
 
 @functools.lru_cache(maxsize=16)
 def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
-                            bpr: int, donate: bool, mesh=None):
+                            bpr: int, donate: bool, mesh=None,
+                            lora: bool = False):
     """Paged chunk-prefill step: ``_prefill_chunk_fn``'s math with the
     row dus/slice replaced by a per-position block scatter and a table
     gather. The caller (engine.reserve_window) has already allocated —
     and COW-privatized — every block covering [start, start + chunk),
-    so the scatter only ever lands in blocks this row owns alone."""
+    so the scatter only ever lands in blocks this row owns alone.
+    ``lora``: as in :func:`_tick_paged_fn`, but the adapter id is a
+    traced SCALAR (one row prefills per dispatch)."""
     cfg = GPTConfig(*cfg_key)
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     gather, pin_kv = _tp_ops(mesh)
+    shards = serve_tp_size(mesh)
+    if lora:
+        from .lora import lora_delta
 
     def impl(blocks, outer, pool_k, pool_v, table, toks, start, n_valid,
-             key, temp, top_k, top_p):
+             key, temp, top_k, top_p, *lrest):
         pidx = jnp.clip(start + jnp.arange(chunk), 0, cfg.seq_len - 1)
         h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
         # write positions clamped INTO the row: a partial-tail prefix
@@ -1180,8 +1211,14 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
                 return gather(_attn_chunk(q, row_k, row_v, start)), \
                     (pk, pv)
 
+            hook = None
+            if lora:
+                aid, lpool = lrest
+                hook = lambda site, x, y, l=l: \
+                    lora_delta(lpool, aid[None], l, site, x, y)
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, gather)
+                p, h, cfg.n_head, attn, gather, lora=hook,
+                int4_shards=shards)
         last = lax.dynamic_slice_in_dim(h, n_valid - 1, 1, axis=1)
         hl = _layernorm(last, outer["lnf_g"], outer["lnf_b"])
         logits = hl[:, 0] @ outer["head"].astype(hl.dtype)      # (1, V)
@@ -1195,7 +1232,8 @@ def _prefill_chunk_paged_fn(cfg_key: tuple, chunk: int, bs: int,
 
 @functools.lru_cache(maxsize=16)
 def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
-                     donate: bool, fused="", mesh=None):
+                     donate: bool, fused="", mesh=None,
+                     lora: bool = False):
     """Paged draft-and-verify step: ``_verify_fn``'s math over block
     scatter/gather. All K+1 candidate positions were reserved (and
     COW-privatized) before dispatch, which is exactly why a rejected
@@ -1215,9 +1253,12 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
     tp_mesh = mesh if serve_tp_size(mesh) > 1 else None
     streaming = (fused == "streaming")
     rows = spec_len + 1
+    shards = serve_tp_size(mesh)
+    if lora:
+        from .lora import lora_delta
 
     def impl(blocks, outer, pool_k, pool_v, table, toks, pos, n_draft,
-             key, fold, temp, top_k, top_p):
+             key, fold, temp, top_k, top_p, *lrest):
         pidx = jnp.clip(pos + jnp.arange(rows), 0, cfg.seq_len - 1)
         h = (outer["emb"][toks] + outer["pos"][pidx][None]).astype(dtype)
         wpos = pos + jnp.arange(rows)
@@ -1241,8 +1282,14 @@ def _verify_paged_fn(cfg_key: tuple, spec_len: int, bs: int, bpr: int,
                 return gather(_attn_verify(q, row_k, row_v, pos)), \
                     (pk, pv)
 
+            hook = None
+            if lora:
+                aid, lpool = lrest
+                hook = lambda site, x, y, l=l: \
+                    lora_delta(lpool, aid[None], l, site, x, y)
             h, (pool_k, pool_v) = _block_core_fusedqkv(
-                p, h, cfg.n_head, attn, gather)
+                p, h, cfg.n_head, attn, gather, lora=hook,
+                int4_shards=shards)
         hl = _layernorm(h, outer["lnf_g"], outer["lnf_b"])
         logits = hl[0] @ outer["head"].astype(hl.dtype)     # (K+1, V)
         folds = fold + jnp.arange(rows)
@@ -1372,7 +1419,7 @@ class DecodeEngine:
                  int8_weights: bool = False, kv_dtype: str = "",
                  int4_weights: bool = False,
                  int4_group: int = INT4_GROUP_DEFAULT,
-                 aot=None, tracer=None):
+                 aot=None, tracer=None, lora_pool=None):
         """``num_blocks`` > 0 selects the PAGED cache: a global block
         pool of that many fixed-size blocks (``block_size`` tokens each;
         0 = the prefill chunk) indexed by per-row block tables, with
@@ -1440,7 +1487,24 @@ class DecodeEngine:
         (resolution in ``self.int4_formulation``, fallbacks counted in
         ``cxn_int4_fallback_total{reason=}``). Mutually exclusive with
         ``int8_weights``; accuracy pinned by :func:`w_int4_tolerance`;
-        OFF is the same byte-for-byte no-op contract."""
+        OFF is the same byte-for-byte no-op contract. Composes with
+        ``serve_tp > 1``: the nibbles are packed PER output-dim shard
+        (pairs never straddle a shard boundary), so GSPMD splits the
+        packed plane on its halved axis and every shard unpacks a
+        self-contained weight slice — bit-identical to the
+        single-device int4 engine; the in-tile Pallas unpack assumes
+        the single-segment layout, so sharded engines stream the XLA
+        reference (``int4_formulation == ""``, reason ``"tp"``).
+
+        ``lora_pool`` (an :class:`~cxxnet_tpu.serve.lora.AdapterPool`)
+        arms batched multi-LoRA serving: every paged program grows a
+        traced per-row adapter-id operand plus the pool's device
+        factors, and applies the rank-r delta at the four block matmul
+        sites via ragged grouped dispatch (serve/lora.py) — mixed
+        adapter traffic decodes in ONE tick under ONE compiled
+        signature (the pool geometry rides ``_sig_suffix``). None (the
+        default) is a pinned STRUCTURAL no-op: the unarmed programs
+        trace the exact pre-LoRA jaxpr."""
         if slots < 1:
             raise ValueError("serve_slots must be >= 1, got %d" % slots)
         if cfg.feat % cfg.n_head:
@@ -1484,14 +1548,6 @@ class DecodeEngine:
                 "got %d" % int4_group)
         self.tp = serve_tp_size(mesh)
         self.mesh = mesh if self.tp > 1 else None
-        if self.int4_weights and self.tp > 1:
-            raise ValueError(
-                "serve_int4_weights does not compose with serve_tp>1 "
-                "yet: the (packed, group-scales) weight pair needs "
-                "per-leaf output-dim shardings (the scale plane shards "
-                "on its LAST axis, the packed nibbles on a HALVED one) "
-                "the TP constraint hooks don't carry — shard OR "
-                "int4-quantize the weights, not both")
         if self.kv_int8 and self.tp > 1:
             raise ValueError(
                 "serve_kv_dtype=int8 does not compose with serve_tp>1 "
@@ -1580,7 +1636,8 @@ class DecodeEngine:
             # dispatch routes every program's hot matmuls through
             # _qmat4 (kernel or XLA reference, resolved below)
             _q4 = functools.partial(_quantize_decode_blocks_int4,
-                                    group=self.int4_group)
+                                    group=self.int4_group,
+                                    shards=self.tp)
             self._blocks = (jax.eval_shape(_q4, self._blocks)
                             if abstract else _q4(self._blocks))
         self._outer = {k: params[k] for k in ("emb", "pos", "lnf_g",
@@ -1591,7 +1648,8 @@ class DecodeEngine:
             # abstract (audit-only) engine attaches the SAME shardings
             # to ShapeDtypeStructs, so the AOT audit lowers exactly the
             # partitioned programs a real TP engine runs.
-            bsh, osh = serve_param_shardings(self.mesh)
+            bsh, osh = serve_param_shardings(self.mesh,
+                                             int4=self.int4_weights)
             if abstract:
                 self._blocks = {
                     k: jax.ShapeDtypeStruct(v.shape, v.dtype,
@@ -1631,6 +1689,19 @@ class DecodeEngine:
             self._sig_suffix += "/w=int4/g=%d" % self.int4_group
         if self.kv_int8:
             self._sig_suffix += "/kv=int8"
+        # batched multi-LoRA (serve/lora.py): the pool geometry (rank,
+        # slot count) joins the signature — mixed adapter ids inside
+        # one pool are ONE executable (the ids are a traced operand),
+        # but a different rank/pool shape is honestly a different one
+        self.lora_pool = lora_pool
+        if lora_pool is not None:
+            if not self.paged:
+                raise ValueError(
+                    "serve_lora requires the paged engine (serve_paged=1 "
+                    "with chunked prefill): the adapter pool pages its "
+                    "factor slots alongside the KV block pool, and only "
+                    "the paged programs carry the adapter-id operand")
+            self._sig_suffix += lora_pool.sig
         hd = cfg.feat // cfg.n_head
         # int4 matmul route, resolved ONCE on the tick's hot QKV
         # geometry (m = slots decode rows, k = feat, n = 3*feat, the
@@ -1640,7 +1711,13 @@ class DecodeEngine:
         # re-gate per shape inside _qmat4 — this field is the
         # observability/audit verdict for the steady-state decode path.
         self.int4_formulation = ""
-        if self.int4_weights:
+        if self.int4_weights and self.tp > 1:
+            # sharded engines stream the XLA reference: the kernel's
+            # in-tile unpack assumes the single-segment halves layout,
+            # and pallas_call is not GSPMD-partitionable over the
+            # packed plane's halved axis — counted, not silent
+            _note_int4_fallback("tp", obs_registry)
+        elif self.int4_weights:
             from ..ops.pallas_kernels import (int4_matmul_fallback_reason,
                                               int4_matmul_supported)
             citem = 2 if cfg.dtype == "bfloat16" else 4
@@ -1939,10 +2016,18 @@ class DecodeEngine:
             # block-table inputs (the tables are traced data, so the
             # audit sees exactly the one compiled signature each holds)
             row_t = SDS((self.bpr,), i32)
+            # an armed adapter pool appends its abstract (id, factor
+            # pool) operands, so the audit/AOT lowers exactly the
+            # adapter-carrying executables the engine runs
+            lora_on = self.lora_pool is not None
+            lrow = (SDS((), i32), self.lora_pool.abstract_pool()) \
+                if lora_on else ()
+            lbat = (SDS((b,), i32), self.lora_pool.abstract_pool()) \
+                if lora_on else ()
             chunk_args = (self._blocks, self._outer, self.cache_k,
                           self.cache_v, row_t, SDS((1, self.chunk), i32),
                           SDS((), i32), SDS((), i32), key, SDS((), f32),
-                          SDS((), i32), SDS((), f32))
+                          SDS((), i32), SDS((), f32)) + lrow
             # the audited tick/verify are the engine's OWN variants —
             # fused when self.fused_attn resolved on (the Pallas call
             # AOT-lowers like any op), gather otherwise — so the audit
@@ -1952,7 +2037,7 @@ class DecodeEngine:
                 ("serve_prefill_chunk",
                  _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
                                          self.block_size, self.bpr, don,
-                                         mesh=self.mesh),
+                                         mesh=self.mesh, lora=lora_on),
                  chunk_args, nums)]
             if self.spec_len:
                 verify_args = (self._blocks, self._outer, self.cache_k,
@@ -1960,24 +2045,25 @@ class DecodeEngine:
                                SDS((1, self.spec_len + 1), i32),
                                SDS((), i32), SDS((), i32), key,
                                SDS((), i32), SDS((), f32), SDS((), i32),
-                               SDS((), f32))
+                               SDS((), f32)) + lrow
                 specs.append(
                     ("serve_verify_chunk",
                      _verify_paged_fn(self._cfg_key, self.spec_len,
                                       self.block_size, self.bpr, don,
                                       self.fused_formulation,
-                                      mesh=self.mesh),
+                                      mesh=self.mesh, lora=lora_on),
                      verify_args, nums))
             tick_args = (self._blocks, self._outer, self.cache_k,
                          self.cache_v, SDS((b, self.bpr), i32),
                          SDS((b,), i32), SDS((b,), i32),
                          SDS((b, 2), jnp.uint32), SDS((b,), i32),
-                         SDS((b,), f32), SDS((b,), i32), SDS((b,), f32))
+                         SDS((b,), f32), SDS((b,), i32),
+                         SDS((b,), f32)) + lbat
             specs.append(
                 ("serve_tick",
                  _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
                                 don, self.fused_formulation,
-                                mesh=self.mesh),
+                                mesh=self.mesh, lora=lora_on),
                  tick_args, nums))
             return specs
         tick_args = (self._blocks, self._outer, self.cache_k, self.cache_v,
@@ -2054,6 +2140,22 @@ class DecodeEngine:
         """Drop the cache buffers (the server calls this at shutdown)."""
         self.cache_k = self.cache_v = None
 
+    def _lora_args(self, aid, batched: bool) -> tuple:
+        """The appended ``(adapter-ids, device-pool)`` operand pair for
+        an armed engine's program call — empty when LoRA is off, so
+        every call site stays a pinned structural no-op. ``aid`` is the
+        (slots,) per-row id vector for the batched tick, a scalar for
+        the single-row chunk/verify programs; None means base (id 0,
+        the pool's pinned all-zero slot)."""
+        if self.lora_pool is None:
+            return ()
+        if batched:
+            ids = np.zeros(self.slots, np.int32) if aid is None \
+                else np.asarray(aid, np.int32).reshape(self.slots)
+            return (jnp.asarray(ids), self.lora_pool.device_pool())
+        return (jnp.asarray(0 if aid is None else int(aid), jnp.int32),
+                self.lora_pool.device_pool())
+
     def prefill(self, slot: int, prompt: np.ndarray, key: np.ndarray,
                 temperature: float, top_k: int, top_p: float) -> int:
         """Admit one request into ``slot``: full forward over ``prompt``
@@ -2085,7 +2187,7 @@ class DecodeEngine:
 
     def prefill_chunk(self, slot: int, toks: np.ndarray, start: int,
                       n_valid: int, key: np.ndarray, temperature: float,
-                      top_k: int, top_p: float):
+                      top_k: int, top_p: float, aid=None):
         """One chunk of prefill work for ``slot``: ``toks`` is exactly
         ``prefill_chunk`` tokens (the caller zero-pads the final chunk
         and passes ``n_valid``); ``start`` is the chunk's offset in the
@@ -2113,7 +2215,8 @@ class DecodeEngine:
                                                        self.bpr))
             fn = _prefill_chunk_paged_fn(self._cfg_key, self.chunk,
                                          self.block_size, self.bpr,
-                                         self._donate, mesh=self.mesh)
+                                         self._donate, mesh=self.mesh,
+                                         lora=self.lora_pool is not None)
             args = (jnp.asarray(m.table[slot]),)
         else:
             self._count_program("chunk=%d" % self.chunk)
@@ -2135,7 +2238,8 @@ class DecodeEngine:
                 jnp.asarray(n_valid, jnp.int32),
                 jnp.asarray(key), jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_k, jnp.int32),
-                jnp.asarray(top_p, jnp.float32))
+                jnp.asarray(top_p, jnp.float32),
+                *self._lora_args(aid, batched=False))
         if t0 is not None:
             # the one sampled call pays the sync the unsampled path
             # deliberately avoids — that IS the measurement
@@ -2145,7 +2249,8 @@ class DecodeEngine:
 
     def verify_chunk(self, slot: int, toks: np.ndarray, pos: int,
                      n_draft: int, key: np.ndarray, fold: int,
-                     temperature: float, top_k: int, top_p: float):
+                     temperature: float, top_k: int, top_p: float,
+                     aid=None):
         """One draft-and-verify step for ``slot``: ``toks`` is
         ``spec_len + 1`` tokens — the row's last emitted token followed
         by ``n_draft`` real draft tokens (rest padding); ``pos`` is the
@@ -2181,7 +2286,8 @@ class DecodeEngine:
             fn = _verify_paged_fn(self._cfg_key, k, self.block_size,
                                   self.bpr, self._donate,
                                   self.fused_formulation,
-                                  mesh=self.mesh)
+                                  mesh=self.mesh,
+                                  lora=self.lora_pool is not None)
             args = (jnp.asarray(m.table[slot]),)
         else:
             if self._vguard is not None:
@@ -2206,7 +2312,8 @@ class DecodeEngine:
                 jnp.asarray(key), jnp.asarray(fold, jnp.int32),
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_k, jnp.int32),
-                jnp.asarray(top_p, jnp.float32))
+                jnp.asarray(top_p, jnp.float32),
+                *self._lora_args(aid, batched=False))
         out = int(n_acc), int(emit)         # host fetch: the sync point
         if t0 is not None:
             self._prof.end("serve_verify_chunk", t0)
@@ -2241,7 +2348,7 @@ class DecodeEngine:
 
     def tick(self, tok: np.ndarray, pos: np.ndarray, keys: np.ndarray,
              fold: np.ndarray, temp: np.ndarray, top_k: np.ndarray,
-             top_p: np.ndarray) -> np.ndarray:
+             top_p: np.ndarray, aid=None) -> np.ndarray:
         """One batched decode step across every slot row (free and
         still-prefilling rows run too, on dummy state — the scheduler
         parks their position at row_len - 1, past every readable
@@ -2266,7 +2373,8 @@ class DecodeEngine:
                              % (self.slots, self.bpr, self._sig_suffix))
             fn = _tick_paged_fn(self._cfg_key, self.block_size, self.bpr,
                                 self._donate, self.fused_formulation,
-                                mesh=self.mesh)
+                                mesh=self.mesh,
+                                lora=self.lora_pool is not None)
             args = (jnp.asarray(self.manager.table),)
         else:
             fn = _tick_fn(self._cfg_key, self._donate, mesh=self.mesh)
@@ -2280,7 +2388,7 @@ class DecodeEngine:
                 *args,
                 jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(keys),
                 jnp.asarray(fold), jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p))
+                jnp.asarray(top_p), *self._lora_args(aid, batched=True))
         out = np.asarray(nxt)               # host fetch: the sync point —
         #                                     a sampled tick adds only
         #                                     the perf_counter pair

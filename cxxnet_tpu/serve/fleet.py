@@ -104,6 +104,7 @@ def request_to_wire(req: Request) -> dict:
             "prompt": np.asarray(req.prompt, np.int32),
             "params": dataclasses.asdict(req.params),
             "tenant": req.tenant,
+            "adapter": req.adapter,
             "tokens": list(req.tokens),
             "replay_expect": (None if req.replay_expect is None
                               else list(req.replay_expect))}
@@ -112,7 +113,8 @@ def request_to_wire(req: Request) -> dict:
 def request_from_wire(d: dict) -> Request:
     req = Request(int(d["rid"]), np.asarray(d["prompt"], np.int32),
                   SamplingParams(**d["params"]), time.perf_counter(),
-                  tenant=d.get("tenant", ""))
+                  tenant=d.get("tenant", ""),
+                  adapter=d.get("adapter", ""))
     req.tokens = list(d.get("tokens", ()))
     exp = d.get("replay_expect")
     req.replay_expect = None if exp is None else list(exp)
@@ -237,11 +239,11 @@ class FleetWorker:
 
     def verb_submit(self, rid: int, prompt, params: dict,
                     tenant: str = "", migrate: bool = False,
-                    block: bool = False):
+                    block: bool = False, adapter: str = ""):
         req = self.server.submit(np.asarray(prompt, np.int32),
                                  params=SamplingParams(**params),
                                  block=block, tenant=tenant, rid=rid,
-                                 migrate=migrate)
+                                 migrate=migrate, adapter=adapter)
         with self._lock:
             self._handles[rid] = req
         return True
@@ -540,7 +542,7 @@ class FleetRouter:
     # ----------------------------------------------------------- submit
     def submit(self, prompt, params: Optional[SamplingParams] = None,
                block: bool = False, tenant: str = "",
-               **overrides) -> Request:
+               adapter: str = "", **overrides) -> Request:
         if self._closing:
             raise AdmissionError("fleet is shutting down")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -548,7 +550,8 @@ class FleetRouter:
         if overrides:
             p = dataclasses.replace(p, **overrides)
         rid = next(self._rid)
-        req = Request(rid, prompt, p, time.perf_counter(), tenant=tenant)
+        req = Request(rid, prompt, p, time.perf_counter(), tenant=tenant,
+                      adapter=adapter)
         prefill_tier = self._live("prefill")
         migrate = bool(prefill_tier) and bool(self._live("decode"))
         tier = "prefill" if prefill_tier else "decode"
@@ -570,7 +573,7 @@ class FleetRouter:
             try:
                 w.call("submit", rid=rid, prompt=prompt,
                        params=dataclasses.asdict(p), tenant=tenant,
-                       migrate=migrate, block=block)
+                       migrate=migrate, block=block, adapter=adapter)
                 break
             except WorkerLostError as e:
                 last_err = e

@@ -1,0 +1,418 @@
+"""Batched multi-LoRA serving: a paged adapter pool plus the ragged
+grouped delta dispatch (round 20, doc/serving.md "Batched multi-LoRA").
+
+One base model, many products: every request may name a rank-r LoRA
+adapter, and ONE batched decode tick serves all of them — the adapter
+population is paged like KV. The device footprint is a fixed pool of
+``P`` adapter slots per block matmul site (qkv, proj, mlp1, mlp2):
+slot 0 is all-zeros and reserved for "base model" (adapter id 0's
+delta is an exact +0.0 in f32, so base rows ride the armed programs
+unperturbed), slots 1..P-1 hold the factor pages of whichever
+registered adapters are currently resident. Residency is refcounted by
+the scheduler's admissions, eviction is LRU over unreferenced slots,
+and swap-in re-verifies the host buffers' crc32 recorded at load —
+the PR 8 swap idiom, so a corrupted adapter fails loudly
+(:class:`~cxxnet_tpu.serve.resilience.SwapCorruptionError`) instead of
+silently serving garbage weights.
+
+The delta itself is ``(x @ A_a) @ B_a * s`` per row — the per-adapter
+scale is folded into the stored B factor at load, so the traced math
+is two dots through the rank bottleneck with f32 accumulation, added
+to the base projection in f32 and cast once. Three formulations, one
+bit-contract:
+
+- the XLA reference (:func:`lora_delta`'s ragged path): rows are
+  segment-sorted by adapter id (ops/moe.py :func:`grouped_order` — the
+  MoE dropless-dispatch machinery) and the two factor matmuls run as
+  grouped GEMMs over the ragged segments (``lax.ragged_dot``). Every
+  row's product is a full contraction regardless of its neighbours, so
+  per-row results are bit-identical across batch compositions — the
+  property the solo-oracle identity pins lean on;
+- the fused kernel (ops/pallas_kernels.py :func:`lora_bgmv`): adapter
+  ids scalar-prefetched, each row's A/B tiles gathered straight into
+  VMEM by the index_map (sorted rows make consecutive fetches hit the
+  resident tile), pinned bit-exact against the reference in interpret
+  mode and gated by ``lora_bgmv_supported``;
+- unset ``serve_lora``: no pool, no operands, a pinned STRUCTURAL
+  no-op — the lora hook is a trace-time ``None`` check in
+  models/gpt.py, so unarmed programs keep their exact jaxpr.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ops.moe import grouped_order
+
+# the four matmul sites of the fused-QKV decode block, with their
+# (in, out) dims as functions of (feat, hidden) — the single source for
+# the adapter file format, the pool page shapes, and the delta hooks
+# models/gpt.py applies (_block_core_fusedqkv / _mlp_core)
+LORA_SITES = ("qkv", "proj", "mlp1", "mlp2")
+
+
+def lora_site_dims(feat: int, hidden: int) -> Dict[str, tuple]:
+    """(in, out) of each adapted matmul site."""
+    return {"qkv": (feat, 3 * feat), "proj": (feat, feat),
+            "mlp1": (feat, hidden), "mlp2": (hidden, feat)}
+
+
+def parse_lora_spec(spec: str) -> Dict[str, str]:
+    """``serve_lora = name:path;name2:path2`` -> {name: path}. Names
+    must be unique and non-empty ("" is the reserved base-model id);
+    a bare ``name`` with no colon maps to ``name.npz`` in the cwd."""
+    reg: Dict[str, str] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, path = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError("serve_lora adapter name must be non-empty "
+                             "(the empty name is the reserved base id)")
+        if name in reg:
+            raise ValueError("serve_lora adapter %r listed twice" % name)
+        reg[name] = path.strip() or (name + ".npz")
+    return reg
+
+
+def make_adapter(cfg, rank: int, seed: int = 0,
+                 scale: Optional[float] = None) -> Dict[str, np.ndarray]:
+    """A random rank-``rank`` adapter for ``cfg``'s geometry (tests and
+    the bench cell; real adapters come out of a fine-tune). Both
+    factors are non-zero (N(0, 0.02)) so the delta is observable —
+    the classic B=0 init is a training-time choice, useless for
+    pinning serve-path identity. ``scale`` defaults to the classic
+    alpha/r with alpha = 2r, i.e. 2.0."""
+    rs = np.random.RandomState(seed)
+    L, f, hidden = cfg.n_layer, cfg.feat, cfg.mlp_ratio * cfg.feat
+    ad: Dict[str, np.ndarray] = {
+        "rank": np.int32(rank),
+        "scale": np.float32(2.0 if scale is None else scale),
+    }
+    for site, (d_in, d_out) in lora_site_dims(f, hidden).items():
+        ad["a_" + site] = rs.normal(
+            0, 0.02, (L, d_in, rank)).astype(np.float32)
+        ad["b_" + site] = rs.normal(
+            0, 0.02, (L, rank, d_out)).astype(np.float32)
+    return ad
+
+
+def save_adapter(path: str, adapter: Dict[str, np.ndarray]) -> None:
+    """Write an adapter dict (``make_adapter``'s format) as an npz."""
+    np.savez(path, **adapter)
+
+
+def load_adapter(path: str) -> Dict[str, np.ndarray]:
+    """Load an adapter npz, validating the key set."""
+    if not os.path.exists(path):
+        raise FileNotFoundError("LoRA adapter file not found: %s" % path)
+    with np.load(path) as z:
+        ad = {k: np.asarray(z[k]) for k in z.files}
+    want = {"rank", "scale"} | {p + s for p in ("a_", "b_")
+                                for s in LORA_SITES}
+    missing = want - set(ad)
+    if missing:
+        raise ValueError("LoRA adapter %s is missing arrays: %s"
+                         % (path, ", ".join(sorted(missing))))
+    return ad
+
+
+def adapter_checksum(adapter: Dict[str, np.ndarray]) -> int:
+    """crc32 chained over the factor planes in site order — recorded at
+    load, re-verified before every device swap-in (the PR 8 host-buffer
+    checksum discipline applied to adapter pages)."""
+    crc = 0
+    for site in LORA_SITES:
+        for pre in ("a_", "b_"):
+            crc = zlib.crc32(
+                np.ascontiguousarray(adapter[pre + site]), crc)
+    return crc
+
+
+def _delta_ragged(a, b, ids, x, y, n_slots: int):
+    """XLA reference delta: segment-sort tokens by adapter id, run both
+    factor matmuls as ragged grouped GEMMs, unsort, and fold into the
+    base projection in f32. Mirrors the bgmv kernel OP FOR OP (f32
+    ``preferred_element_type`` through the rank bottleneck, B cast to
+    f32 for the second dot, one final cast) so interpret-mode
+    bit-identity is structural, not a tolerance."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows, n, d_in = x.shape
+    tok_ids = jnp.repeat(ids, n)                        # (rows*n,)
+    xt = x.reshape(rows * n, d_in)
+    order, gs = grouped_order(tok_ids, n_slots)
+    t = lax.ragged_dot(xt[order], a, gs,
+                       preferred_element_type=jnp.float32)
+    d = lax.ragged_dot(t, b.astype(jnp.float32), gs,
+                       preferred_element_type=jnp.float32)
+    d = jnp.zeros_like(d).at[order].set(d)              # unsort
+    d = d.reshape(rows, n, -1)
+    return (y.astype(jnp.float32) + d).astype(y.dtype)
+
+
+def lora_delta(pool: Dict, ids, layer: int, site: str, x, y):
+    """The per-site delta hook the engine's program builders close over
+    (models/gpt.py ``lora(site, x, y)``): ``x`` (rows, n, in) the
+    matmul input, ``y`` (rows, n, out) the base projection, ``ids``
+    (rows,) int32 pool slots. Routes to the bgmv kernel when the
+    geometry gate admits it (rows pre-sorted by id so consecutive grid
+    steps reuse the resident factor tile), else the ragged XLA
+    reference — a trace-time decision, one formulation per program."""
+    import jax.numpy as jnp
+    from ..ops import pallas_kernels as _pk
+
+    a = pool["a_" + site][:, layer]                     # (P, in, r)
+    b = pool["b_" + site][:, layer]                     # (P, r, out)
+    n_slots = int(a.shape[0])
+    rows, n, d_in = x.shape
+    r, d_out = int(a.shape[-1]), int(y.shape[-1])
+    if _pk.lora_bgmv_supported(n, d_in, r, d_out,
+                               itemsize=x.dtype.itemsize):
+        order, _ = grouped_order(ids, n_slots)
+        out = _pk.lora_bgmv(x[order], y[order], a, b, ids[order])
+        return jnp.zeros_like(out).at[order].set(out)   # unsort
+    return _delta_ragged(a, b, ids, x, y, n_slots)
+
+
+class AdapterPool:
+    """Fixed device pool of LoRA factor pages, paged like KV blocks.
+
+    ``P = size`` slots per site; slot 0 is the all-zeros base page.
+    The host side keeps every registered adapter loaded exactly once
+    (with its crc32 recorded); the device side holds whichever subset
+    is resident. :meth:`acquire` is the scheduler's admission gate —
+    a non-resident adapter swaps in first (evicting the LRU
+    unreferenced slot), and a pool whose every slot is pinned by
+    active rows simply refuses, leaving the request queued exactly
+    like a full KV pool does.
+
+    The per-adapter ``scale`` is folded into the stored B pages, so
+    the traced programs never see it — mixed scales cost nothing."""
+
+    def __init__(self, cfg, registry: Dict[str, str], rank: int = 8,
+                 pool_mb: float = 0.0, dtype=None,
+                 adapters: Optional[Dict[str, Dict]] = None):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.registry = dict(registry)
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
+        f, hidden = cfg.feat, cfg.mlp_ratio * cfg.feat
+        self.site_dims = lora_site_dims(f, hidden)
+        itemsize = self.dtype.itemsize
+        self.slot_bytes = sum(
+            cfg.n_layer * (d_in * self.rank + self.rank * d_out) * itemsize
+            for d_in, d_out in self.site_dims.values())
+        if pool_mb and pool_mb > 0:
+            by_budget = int(pool_mb * 2 ** 20) // max(1, self.slot_bytes)
+            self.size = max(2, min(len(registry) + 1, by_budget))
+        else:
+            self.size = len(registry) + 1       # everything resident
+        # host pages: name -> adapter dict + crc (loaded once, verified
+        # at every swap-in); ``adapters`` lets tests/bench inject
+        # in-memory adapters without touching disk
+        self._host: Dict[str, Dict] = {}
+        self._crc: Dict[str, int] = {}
+        for name in self.registry:
+            ad = (adapters or {}).get(name)
+            if ad is None:
+                ad = load_adapter(self.registry[name])
+            if int(ad["rank"]) != self.rank:
+                raise ValueError(
+                    "adapter %r has rank %d, pool is rank %d (set "
+                    "serve_lora_rank to match)"
+                    % (name, int(ad["rank"]), self.rank))
+            self._validate_dims(name, ad)
+            self._host[name] = ad
+            self._crc[name] = adapter_checksum(ad)
+        # device pool: slot 0 zeros = base; B pages stored pre-scaled
+        L = cfg.n_layer
+        self.pool = {}
+        for site, (d_in, d_out) in self.site_dims.items():
+            self.pool["a_" + site] = jnp.zeros(
+                (self.size, L, d_in, self.rank), self.dtype)
+            self.pool["b_" + site] = jnp.zeros(
+                (self.size, L, self.rank, d_out), self.dtype)
+        self._slot_name = [""] * self.size      # "" = empty/base
+        self._refs = [0] * self.size
+        self._stamp = [0] * self.size           # LRU clock
+        self._clock = 0
+        self.hits = 0
+        self.evictions = 0
+        self.swap_ins = 0
+        self.acquire_fails = 0
+
+    def _validate_dims(self, name: str, ad: Dict) -> None:
+        L = self.cfg.n_layer
+        for site, (d_in, d_out) in self.site_dims.items():
+            wa, wb = ad["a_" + site].shape, ad["b_" + site].shape
+            if wa != (L, d_in, self.rank) or wb != (L, self.rank, d_out):
+                raise ValueError(
+                    "adapter %r site %s has shapes %s/%s, engine "
+                    "geometry wants %s/%s"
+                    % (name, site, wa, wb, (L, d_in, self.rank),
+                       (L, self.rank, d_out)))
+
+    # ------------------------------------------------------ residency
+    def slot_of(self, name: str) -> int:
+        """Resident slot of ``name`` (0 = base, -1 = not resident)."""
+        if not name:
+            return 0
+        try:
+            return self._slot_name.index(name)
+        except ValueError:
+            return -1
+
+    def _evictable(self) -> int:
+        """LRU slot that can take a new page (empty first, then the
+        least-recently-used unreferenced resident); -1 if every slot
+        is pinned."""
+        best, best_stamp = -1, None
+        for s in range(1, self.size):
+            if self._refs[s] > 0:
+                continue
+            if not self._slot_name[s]:
+                return s
+            if best_stamp is None or self._stamp[s] < best_stamp:
+                best, best_stamp = s, self._stamp[s]
+        return best
+
+    def can_acquire(self, name: str) -> bool:
+        """Would :meth:`acquire` succeed right now? (The scheduler's
+        admission check — a queued request waits, never faults.)"""
+        if not name:
+            return True
+        if name not in self._host:
+            return False
+        return self.slot_of(name) >= 0 or self._evictable() >= 0
+
+    def headroom(self) -> int:
+        """Unreferenced pool slots. The server's admission pass budgets
+        one against every distinct adapter name it pops that is not
+        already pinned: the acquires run later in pop order, and any
+        one of them may evict any unpinned slot — including one a
+        later pop in the same batch wants as a hit — so headroom >=
+        names-charged guarantees every acquire in the batch lands
+        (a clobbered hit degrades to a swap-in, never a fault)."""
+        return sum(1 for s in range(1, self.size) if self._refs[s] == 0)
+
+    def pinned(self, name: str) -> bool:
+        """Is ``name`` resident with live references? (Pinned pages
+        cost the admission pass no headroom — another request for the
+        same adapter is a free hit on the already-held slot.)"""
+        s = self.slot_of(name)
+        return s > 0 and self._refs[s] > 0
+
+    def acquire(self, name: str) -> int:
+        """Pin ``name``'s page and return its pool slot; swaps the
+        adapter in first when non-resident (crc-verified). Raises
+        ``KeyError`` for an unregistered name and ``RuntimeError``
+        when every slot is pinned (callers gate on can_acquire)."""
+        if not name:
+            return 0
+        if name not in self._host:
+            raise KeyError("unknown LoRA adapter %r" % name)
+        self._clock += 1
+        slot = self.slot_of(name)
+        if slot >= 0:
+            self.hits += 1
+            self._refs[slot] += 1
+            self._stamp[slot] = self._clock
+            return slot
+        slot = self._evictable()
+        if slot < 0:
+            self.acquire_fails += 1
+            raise RuntimeError(
+                "adapter pool exhausted: all %d slots pinned "
+                "(raise serve_lora_pool_mb)" % (self.size - 1))
+        if self._slot_name[slot]:
+            self.evictions += 1
+        self._swap_in(slot, name)
+        self._slot_name[slot] = name
+        self._refs[slot] = 1
+        self._stamp[slot] = self._clock
+        return slot
+
+    def release(self, name: str) -> None:
+        """Unpin one reference; the page stays resident until evicted
+        (the next acquire is a free hit — the whole point of paging)."""
+        if not name:
+            return
+        slot = self.slot_of(name)
+        if slot > 0 and self._refs[slot] > 0:
+            self._refs[slot] -= 1
+
+    def _swap_in(self, slot: int, name: str) -> None:
+        from .resilience import SwapCorruptionError
+        import jax.numpy as jnp
+
+        ad = self._host[name]
+        if adapter_checksum(ad) != self._crc[name]:
+            raise SwapCorruptionError(
+                "adapter %r host pages failed their load-time crc32; "
+                "swapping them in would serve corrupted weights" % name)
+        s = float(ad["scale"])
+        for site in LORA_SITES:
+            a = jnp.asarray(ad["a_" + site], self.dtype)
+            b = jnp.asarray(ad["b_" + site] * s, self.dtype)
+            self.pool["a_" + site] = \
+                self.pool["a_" + site].at[slot].set(a)
+            self.pool["b_" + site] = \
+                self.pool["b_" + site].at[slot].set(b)
+        self.swap_ins += 1
+
+    # ------------------------------------------------------- plumbing
+    def device_pool(self) -> Dict:
+        """The traced pool operand of the armed serve programs."""
+        return dict(self.pool)
+
+    def abstract_pool(self) -> Dict:
+        """ShapeDtypeStruct mirror for the abstract lint/AOT specs."""
+        import jax
+
+        return {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in self.pool.items()}
+
+    @property
+    def sig(self) -> str:
+        """RecompileGuard / AOT-key suffix: mixed-adapter traffic is
+        ONE signature — ids are traced, only (rank, pool) are static."""
+        return "/lora=r%d/pool=%d" % (self.rank, self.size)
+
+    def resident(self) -> int:
+        return sum(1 for s in range(1, self.size) if self._slot_name[s])
+
+    def refs_held(self) -> int:
+        return sum(self._refs[1:])
+
+    def check_refs(self, expected: int) -> None:
+        """Audit hook (tests, scheduler consistency checks): the pinned
+        reference count must equal the scheduler's live admissions."""
+        held = self.refs_held()
+        if held != expected:
+            raise AssertionError(
+                "adapter pool refcount audit: pool holds %d refs, "
+                "scheduler accounts %d" % (held, expected))
+
+    def metrics(self) -> Dict[str, float]:
+        return {"hits": self.hits, "evictions": self.evictions,
+                "swap_ins": self.swap_ins,
+                "acquire_fails": self.acquire_fails,
+                "resident": self.resident(),
+                "size": self.size, "rank": self.rank,
+                "slot_bytes": self.slot_bytes}
+
+
+__all__ = ["AdapterPool", "LORA_SITES", "lora_site_dims",
+           "parse_lora_spec", "make_adapter", "save_adapter",
+           "load_adapter", "adapter_checksum", "lora_delta"]

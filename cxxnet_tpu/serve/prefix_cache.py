@@ -37,6 +37,15 @@ BLOCK IDS with refcounts: hits append shared blocks to the admitting
 row's table with zero copies, donation happens at prefill completion so
 LIVE rows share too, and copy-on-write protects the shared blocks).
 
+Adapter correctness (batched multi-LoRA, serve/lora.py): LoRA deltas
+land on the QKV projection, so K/V computed under adapter A is NOT the
+K/V any other adapter (or the base model) would compute for the same
+tokens. Both tries therefore key their ROOT by the request's adapter
+NAME — one independent trie per adapter, with ``adapter=""`` (base)
+keeping the exact pre-LoRA root dict, byte-identical behavior when LoRA
+is unarmed. A cross-adapter lookup can never hit (pinned by
+tests/test_serve_lora.py).
+
 Thread-safety: all methods run on the server's single scheduler thread
 (the same discipline as serve/scheduler.py); the unit tests drive it
 directly from one thread.
@@ -63,9 +72,10 @@ class _Node:
     """One cached chunk: trie edge label = the chunk's token tuple."""
 
     __slots__ = ("tokens", "k", "v", "parent", "children", "refs",
-                 "last_used", "nbytes")
+                 "last_used", "nbytes", "adapter")
 
-    def __init__(self, tokens: tuple, k, v, parent: Optional["_Node"]):
+    def __init__(self, tokens: tuple, k, v, parent: Optional["_Node"],
+                 adapter: str = ""):
         self.tokens = tokens
         self.k = k
         self.v = v
@@ -74,6 +84,7 @@ class _Node:
         self.refs = 0               # children + in-flight borrows
         self.last_used = 0
         self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.adapter = adapter      # which per-adapter root owns it
 
 
 class PrefixCache:
@@ -93,7 +104,11 @@ class PrefixCache:
         self.node_bytes = (2 * cfg.n_layer * cfg.n_head * self.chunk
                            * (cfg.feat // cfg.n_head)
                            * _np.dtype(engine.dtype).itemsize)
-        self._children: Dict[tuple, _Node] = {}     # trie root
+        self._children: Dict[tuple, _Node] = {}     # base-adapter root
+        # one independent root per adapter name (LoRA changes K/V, so
+        # prefixes only ever match within one adapter); "" — the base
+        # model — IS self._children, the exact pre-LoRA root
+        self._roots: Dict[str, Dict[tuple, _Node]] = {"": self._children}
         # flat node index for eviction: a dict (insertion-ordered) so
         # removal is O(1) — a list's .remove() turns an eviction burst
         # quadratic on the scheduler thread
@@ -134,16 +149,23 @@ class PrefixCache:
         c = self.chunk
         return tuple(int(t) for t in prompt[i * c:(i + 1) * c])
 
+    def _root(self, adapter: str) -> Dict[tuple, _Node]:
+        """The trie root for one adapter name ("" = base model — the
+        original root dict, so unarmed servers are byte-identical)."""
+        return self._roots.setdefault(adapter, {})
+
     # ------------------------------------------------------------- match
-    def match(self, prompt) -> List[_Node]:
+    def match(self, prompt, adapter: str = "") -> List[_Node]:
         """Longest chain of cached complete chunks prefixing ``prompt``,
         capped at ``(len(prompt) - 1) // chunk`` chunks so at least the
         prompt's final token is always recomputed (the final chunk must
-        run to sample the request's first generated token)."""
+        run to sample the request's first generated token). Matching is
+        scoped to ``adapter``'s own trie — K/V differs across adapters,
+        so a cross-adapter hit would be silent corruption."""
         if not self.enabled:
             return []
         out: List[_Node] = []
-        children = self._children
+        children = self._root(adapter)
         for i in range((len(prompt) - 1) // self.chunk):
             node = children.get(self._chunk_key(prompt, i))
             if node is None:
@@ -152,7 +174,7 @@ class PrefixCache:
             children = node.children
         return out
 
-    def copy_into(self, slot: int, prompt) -> int:
+    def copy_into(self, slot: int, prompt, adapter: str = "") -> int:
         """Restore the longest cached prefix of ``prompt`` into ``slot``'s
         cache row; returns the number of tokens restored (chunked prefill
         resumes there). Matched nodes are pinned (refs) for the duration
@@ -160,7 +182,7 @@ class PrefixCache:
         if not self.enabled:
             return 0
         self.prompt_tokens += len(prompt)
-        nodes = self.match(prompt)
+        nodes = self.match(prompt, adapter)
         if not nodes:
             self.misses += 1
             return 0
@@ -184,7 +206,8 @@ class PrefixCache:
         return restored
 
     # ------------------------------------------------------------ insert
-    def insert_from_row(self, slot: int, prompt) -> int:
+    def insert_from_row(self, slot: int, prompt,
+                        adapter: str = "") -> int:
         """Offer a retired row's complete prompt chunks to the trie:
         uncached chunks are copied out of the row on device, existing
         ones are LRU-refreshed. Returns the number of chunks added. Must
@@ -204,7 +227,7 @@ class PrefixCache:
             return 0
         now = self._tick()
         keys = [self._chunk_key(prompt, i) for i in range(n_chunks)]
-        children = self._children
+        children = self._root(adapter)
         parent: Optional[_Node] = None
         i = 0
         while i < n_chunks:                 # walk the already-cached part
@@ -225,7 +248,8 @@ class PrefixCache:
                                                 n_chunks - i)
         added = n_chunks - i
         for j in range(i, n_chunks):
-            node = _Node(keys[j], ks[j - i], vs[j - i], parent)
+            node = _Node(keys[j], ks[j - i], vs[j - i], parent,
+                         adapter=adapter)
             node.last_used = now
             children[keys[j]] = node
             if parent is not None:
@@ -271,7 +295,8 @@ class PrefixCache:
 
     def _remove(self, node: _Node) -> None:
         parent = node.parent
-        siblings = parent.children if parent is not None else self._children
+        siblings = parent.children if parent is not None \
+            else self._roots[node.adapter]
         del siblings[node.tokens]
         if parent is not None:
             parent.refs -= 1
@@ -287,6 +312,7 @@ class PrefixCache:
             node.parent = None
         self._nodes = {}
         self._children = {}
+        self._roots = {"": self._children}
         self._bytes = 0
 
 
@@ -302,11 +328,11 @@ class _PagedNode:
     which is what makes sub-block sharing free (doc/serving.md)."""
 
     __slots__ = ("tokens", "blocks", "parent", "children", "refs",
-                 "last_used", "valid", "nbytes")
+                 "last_used", "valid", "nbytes", "adapter")
 
     def __init__(self, tokens: tuple, blocks: tuple,
                  parent: Optional["_PagedNode"], valid: int,
-                 nbytes: int):
+                 nbytes: int, adapter: str = ""):
         self.tokens = tokens
         self.blocks = blocks
         self.parent = parent
@@ -315,6 +341,7 @@ class _PagedNode:
         self.last_used = 0
         self.valid = int(valid)
         self.nbytes = int(nbytes)
+        self.adapter = adapter      # which per-adapter root owns it
 
 
 class PagedPrefixCache:
@@ -371,7 +398,11 @@ class PagedPrefixCache:
         self.cpb = self.chunk // engine.block_size   # blocks per chunk
         self.budget = int(budget_bytes)
         self.node_bytes = engine.block_bytes() * self.cpb
-        self._children: Dict[tuple, _PagedNode] = {}
+        self._children: Dict[tuple, _PagedNode] = {}    # base root
+        # per-adapter roots, exactly as in PrefixCache: "" (base) IS
+        # self._children, so unarmed serving is byte-identical
+        self._roots: Dict[str, Dict[tuple, _PagedNode]] = \
+            {"": self._children}
         self._nodes: Dict[_PagedNode, None] = {}
         self._clock = 0
         self._bytes = 0
@@ -409,8 +440,13 @@ class PagedPrefixCache:
         c = self.chunk
         return tuple(int(t) for t in prompt[i * c:(i + 1) * c])
 
+    def _root(self, adapter: str) -> Dict[tuple, _PagedNode]:
+        """The trie root for one adapter name ("" = base model — the
+        original root dict, so unarmed servers are byte-identical)."""
+        return self._roots.setdefault(adapter, {})
+
     # ------------------------------------------------------------- match
-    def match(self, prompt) -> List[_PagedNode]:
+    def match(self, prompt, adapter: str = "") -> List[_PagedNode]:
         """Longest cached chain prefixing ``prompt`` — complete chunk
         nodes, optionally terminated by one partial-TAIL node — capped
         strictly before the final token (the final chunk must run to
@@ -422,7 +458,7 @@ class PagedPrefixCache:
         if not self.enabled:
             return []
         out: List[_PagedNode] = []
-        children = self._children
+        children = self._root(adapter)
         matched = 0
         for i in range((len(prompt) - 1) // self.chunk):
             node = children.get(self._chunk_key(prompt, i))
@@ -459,12 +495,12 @@ class PagedPrefixCache:
                 best = node
         return best
 
-    def match_tokens(self, prompt) -> int:
+    def match_tokens(self, prompt, adapter: str = "") -> int:
         """Tokens a hit would restore (the admission gate's estimate —
         no refcounts are touched)."""
-        return sum(nd.valid for nd in self.match(prompt))
+        return sum(nd.valid for nd in self.match(prompt, adapter))
 
-    def copy_into(self, slot: int, prompt) -> int:
+    def copy_into(self, slot: int, prompt, adapter: str = "") -> int:
         """Append the longest cached prefix's shared blocks to
         ``slot``'s block table (one incref per block, NO device copy);
         returns tokens restored — NOT necessarily block- or
@@ -476,7 +512,7 @@ class PagedPrefixCache:
         if not self.enabled:
             return 0
         self.prompt_tokens += len(prompt)
-        nodes = self.match(prompt)
+        nodes = self.match(prompt, adapter)
         if not nodes:
             self.misses += 1
             return 0
@@ -493,7 +529,8 @@ class PagedPrefixCache:
         return restored
 
     # ------------------------------------------------------------ donate
-    def donate_from_row(self, slot: int, prompt) -> int:
+    def donate_from_row(self, slot: int, prompt,
+                        adapter: str = "") -> int:
         """Offer ``slot``'s prompt K/V to the trie: one ownership ref
         per block of each not-yet-cached complete chunk, PLUS a
         partial-TAIL node for the suffix beyond the last complete chunk
@@ -513,7 +550,7 @@ class PagedPrefixCache:
         n_chunks = min(total, self.budget // max(1, self.node_bytes))
         now = self._tick()
         keys = [self._chunk_key(prompt, i) for i in range(n_chunks)]
-        children = self._children
+        children = self._root(adapter)
         parent: Optional[_PagedNode] = None
         i = 0
         while i < n_chunks:
@@ -529,7 +566,7 @@ class PagedPrefixCache:
             blocks = tuple(self.engine.row_block_ids(
                 slot, j * self.cpb, (j + 1) * self.cpb))
             node = self._add_node(keys[j], blocks, parent, self.chunk,
-                                  now)
+                                  now, adapter)
             children[keys[j]] = node
             self.inserted_chunks += 1
             added += 1
@@ -553,7 +590,8 @@ class PagedPrefixCache:
             else:
                 blocks = tuple(self.engine.row_block_ids(
                     slot, total * self.cpb, total * self.cpb + nblk))
-                node = self._add_node(key, blocks, parent, tail, now)
+                node = self._add_node(key, blocks, parent, tail, now,
+                                      adapter)
                 children[key] = node
                 self.inserted_chunks += 1
                 added += 1
@@ -562,14 +600,15 @@ class PagedPrefixCache:
 
     def _add_node(self, key: tuple, blocks: tuple,
                   parent: Optional[_PagedNode], valid: int,
-                  now: int) -> _PagedNode:
+                  now: int, adapter: str = "") -> _PagedNode:
         """Ref the blocks and wire one node under ``parent`` (the
         caller links it into the right children dict)."""
         m = self.engine.manager
         for b in blocks:
             m.incref(b)
         node = _PagedNode(key, blocks, parent, valid,
-                          len(blocks) * self.engine.block_bytes())
+                          len(blocks) * self.engine.block_bytes(),
+                          adapter=adapter)
         node.last_used = now
         if parent is not None:
             parent.refs += 1
@@ -653,7 +692,8 @@ class PagedPrefixCache:
 
     def _remove(self, node: _PagedNode) -> None:
         parent = node.parent
-        siblings = parent.children if parent is not None else self._children
+        siblings = parent.children if parent is not None \
+            else self._roots[node.adapter]
         del siblings[node.tokens]
         if parent is not None:
             parent.refs -= 1
@@ -675,4 +715,5 @@ class PagedPrefixCache:
             node.parent = None
         self._nodes = {}
         self._children = {}
+        self._roots = {"": self._children}
         self._bytes = 0

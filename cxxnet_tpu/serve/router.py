@@ -80,12 +80,12 @@ __all__ = ["ServeRouter", "RouterHandle", "rewind_request"]
 def rewind_request(req: Request) -> Request:
     """A fresh Request carrying everything a bit-exact replay needs
     (serve/resilience.py): prompt, params (seed included), tenant
-    label, and the emitted-token prefix as the ``replay_expect`` pin.
-    Shared by the in-process router's failover/drain migration and the
-    cross-process fleet's worker-loss replay (serve/fleet.py) — one
-    rewind contract, not two."""
+    label, LoRA adapter name, and the emitted-token prefix as the
+    ``replay_expect`` pin. Shared by the in-process router's
+    failover/drain migration and the cross-process fleet's worker-loss
+    replay (serve/fleet.py) — one rewind contract, not two."""
     new = Request(req.rid, req.prompt, req.params, req.submit_t,
-                  tenant=req.tenant)
+                  tenant=req.tenant, adapter=req.adapter)
     new.tokens = list(req.tokens)
     new.replay_expect = req.replay_expect
     reset_for_replay(new)
@@ -119,34 +119,40 @@ class _AffinityTrie:
     tokens) this replica has seen — the router's affinity score. A
     crc collision can only inflate a score (misroute one request);
     nothing downstream trusts it, so fingerprints beat storing token
-    tuples at O(n^2) bytes per prompt."""
+    tuples at O(n^2) bytes per prompt. The running crc is SEEDED with
+    the request's LoRA adapter name: adapted K/V differs from base
+    K/V, so the replicas' prefix tries key on (adapter, prefix)
+    (serve/prefix_cache.py) and affinity must too — the same prompt
+    under two adapters is two disjoint fingerprint chains, while the
+    base-model seed (adapter "") leaves pre-LoRA fingerprints
+    untouched."""
 
     def __init__(self, chunk: int, cap: int = 4096):
         self.chunk = max(1, int(chunk))
         self.cap = int(cap)
         self._keys: "collections.OrderedDict" = collections.OrderedDict()
 
-    def _crcs(self, prompt):
+    def _crcs(self, prompt, adapter: str = ""):
         # running crc over successive chunks: crc32(p[:end]) chained as
         # crc32(chunk, prev) — identical values to hashing each prefix
         # from scratch, but O(n) bytes total instead of O(n^2) per
         # note/match call (this runs per candidate replica per submit)
         p = np.ascontiguousarray(np.asarray(prompt, np.int32))
-        crc = 0
+        crc = zlib.crc32(adapter.encode("utf-8")) if adapter else 0
         for end in range(self.chunk, p.size + 1, self.chunk):
             crc = zlib.crc32(p[end - self.chunk:end].tobytes(), crc)
             yield end, crc
 
-    def note(self, prompt) -> None:
-        for _, crc in self._crcs(prompt):
+    def note(self, prompt, adapter: str = "") -> None:
+        for _, crc in self._crcs(prompt, adapter):
             self._keys[crc] = None
             self._keys.move_to_end(crc)
         while len(self._keys) > self.cap:
             self._keys.popitem(last=False)
 
-    def match(self, prompt) -> int:
+    def match(self, prompt, adapter: str = "") -> int:
         n = 0
-        for end, crc in self._crcs(prompt):
+        for end, crc in self._crcs(prompt, adapter):
             if crc not in self._keys:
                 break
             self._keys.move_to_end(crc)
@@ -271,11 +277,14 @@ class ServeRouter:
             out.append(i)
         return out
 
-    def _route(self, prompt, exclude=()) -> Optional[int]:
+    def _route(self, prompt, exclude=(),
+               adapter: str = "") -> Optional[int]:
         """Pick a replica for ``prompt`` (None = nobody healthy).
         Policy "prefix": longest affinity match wins, load breaks ties
         (and decides for cold prompts); "rr": round-robin over the
-        healthy set. Caller holds ``_lock``."""
+        healthy set. Affinity is (adapter, prefix)-keyed — LoRA traffic
+        lands where its adapter pages (and adapted prefixes) already
+        are. Caller holds ``_lock``."""
         cands = self._candidates(exclude)
         if not cands:
             return None
@@ -283,8 +292,8 @@ class ServeRouter:
             return cands[next(self._rr) % len(cands)]
         scored = []
         for i in cands:
-            scored.append((-self._tries[i].match(prompt), self._load(i),
-                           i))
+            scored.append((-self._tries[i].match(prompt, adapter),
+                           self._load(i), i))
         scored.sort()
         best = scored[0]
         if -best[0] > 0:
@@ -306,13 +315,15 @@ class ServeRouter:
         hint may be arbitrarily pessimistic. Raises EngineFailedError
         when no healthy replica remains."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        adapter = str(overrides.get("adapter", "") or "")
         self._sweep_failed()
         tried: set = set()
         last_err: Optional[Exception] = None
         rejects = []            # (retry_after_ms, replica, error)
         while True:
             with self._lock:
-                idx = self._route(prompt, exclude=tried)
+                idx = self._route(prompt, exclude=tried,
+                                  adapter=adapter)
             if idx is None:
                 if rejects:
                     raise self._aggregate_rejection(rejects)
@@ -352,7 +363,7 @@ class ServeRouter:
                 continue
             handle = RouterHandle(req, idx)
             with self._lock:
-                self._tries[idx].note(prompt)
+                self._tries[idx].note(prompt, adapter)
                 self.routed[idx] += 1
                 self._journal.add(req)
                 self._handles[req.rid] = handle
@@ -418,7 +429,8 @@ class ServeRouter:
             if handle.replica != from_idx \
                     or handle.migrations >= len(self._servers):
                 return handle.replica != from_idx
-            target = self._route(handle.prompt, exclude={from_idx})
+            target = self._route(handle.prompt, exclude={from_idx},
+                                 adapter=handle.req.adapter)
             if target is None:
                 return False
             new = self._rewind(handle.req)
@@ -433,7 +445,7 @@ class ServeRouter:
             handle.req = new
             handle.replica = target
             handle.migrations += 1
-            self._tries[target].note(handle.prompt)
+            self._tries[target].note(handle.prompt, new.adapter)
             self.failovers += 1
             return True
 

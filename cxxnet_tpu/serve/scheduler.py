@@ -132,17 +132,25 @@ class Request:
     __slots__ = ("rid", "prompt", "params", "submit_t", "deadline",
                  "admit_t", "first_token_t", "done_t", "tokens", "status",
                  "error", "done", "slot", "traced", "replay_expect",
-                 "retry_after_ms", "tenant", "migrate")
+                 "retry_after_ms", "tenant", "migrate", "adapter")
 
     def __init__(self, rid: int, prompt: np.ndarray,
                  params: SamplingParams, submit_t: float,
-                 tenant: str = ""):
+                 tenant: str = "", adapter: str = ""):
         self.rid = rid
         # multi-tenant SLOs (serve/tenancy.py): the RESOLVED tenant
         # label ("" on an untenanted server) — keys the scheduler's
         # quota accounting, the priority ordering, and the tenant=
         # metric labels; survives recovery replay and router failover
         self.tenant = tenant
+        # batched multi-LoRA (serve/lora.py): the adapter NAME this
+        # request decodes under ("" = base model, adapter id 0). The
+        # name — not the pool slot, which can change across a
+        # preempt/resume cycle — is the identity that survives replay,
+        # failover, and fleet migration; it also keys the prefix-cache
+        # tries (LoRA changes K/V, so prefixes only match within one
+        # adapter).
+        self.adapter = adapter
         self.traced = False     # span recording on for this request
         #                         (set once at admit: tracer sampling)
         self.prompt = prompt
@@ -313,6 +321,15 @@ class SlotScheduler:
         self.tenant_slots: dict = {}
         self.tenant_blocks: dict = {}
         self._slot_charge = [0] * n
+        # batched multi-LoRA (serve/lora.py): the engine's adapter pool
+        # (None = unarmed, every branch below short-circuits) and the
+        # per-slot adapter-id row the batched tick consumes. A row's id
+        # is the POOL SLOT its adapter currently occupies — re-resolved
+        # at resume (eviction may have moved it); parked/free rows sit
+        # at 0 (base, the pinned all-zero slot), so the one-signature
+        # tick stays correct across any occupancy mix.
+        self.lora = getattr(engine, "lora_pool", None)
+        self._aid = np.zeros(n, np.int32)
 
     # ----------------------------------------------------------- tenancy
     def _rank(self, req: Request) -> int:
@@ -600,6 +617,12 @@ class SlotScheduler:
             self._req[slot] = None
         rec["spec"] = (int(self._spec_try[slot]),
                        int(self._spec_hit[slot]), self._spec_off[slot])
+        # a preempted row releases its adapter pin (the NAME rides on
+        # the request; the pool slot is re-resolved at resume — eviction
+        # may reassign it, which is invisible to the request's identity)
+        if self.lora is not None and req.adapter:
+            self.lora.release(req.adapter)
+        self._aid[slot] = 0
         # tenancy: a preempted row's slot/block charge is RETURNED (its
         # blocks leave the device pool for the host buffer); the charge
         # rides the record so the resume re-applies exactly what was
@@ -649,6 +672,11 @@ class SlotScheduler:
                         and self.prefix.evict_blocks(short) > 0:
                     continue
                 break                       # wait for retires
+            if self.lora is not None and rec["req"].adapter \
+                    and not self.lora.can_acquire(rec["req"].adapter):
+                # adapter pool exhausted (every slot pinned by active
+                # rows): wait for retires, like the block shortfall
+                break
             self._swapped.remove(rec)
             slot = self._free.pop()
             try:
@@ -676,6 +704,12 @@ class SlotScheduler:
             req = rec["req"]
             req.slot = slot
             self._tenant_charge(req, rec["charge"])
+            if self.lora is not None and req.adapter:
+                # re-acquire by NAME: the pool slot may differ from the
+                # pre-preemption one (eviction churn) — the delta math
+                # only ever indexes by the CURRENT slot, so identity
+                # is unaffected
+                self._aid[slot] = self.lora.acquire(req.adapter)
             for d in self.drafters.values():
                 d.reset(slot)
             self._spec_try[slot], self._spec_hit[slot], \
@@ -713,6 +747,12 @@ class SlotScheduler:
         p = req.params
         req.slot = slot
         req.admit_t = time.perf_counter()
+        if self.lora is not None and req.adapter:
+            # residency IS the admission gate: the server's pop loop
+            # checked can_acquire, so this swap-in (if the adapter is
+            # not already resident) succeeds; the row then pins its
+            # pool slot until retire/preempt/migrate releases it
+            self._aid[slot] = self.lora.acquire(req.adapter)
         # tenancy: charge the tenant its admission claim (slots always,
         # blocks in paged mode) — credited back wherever the row leaves
         # its slot (retire, abort, preempt)
@@ -757,7 +797,8 @@ class SlotScheduler:
                             and self._inj.fire("prefix_restore"):
                         raise InjectedFault("chaos point "
                                             "'prefix_restore'")
-                    start = self.prefix.copy_into(slot, req.prompt)
+                    start = self.prefix.copy_into(slot, req.prompt,
+                                                  adapter=req.adapter)
                 except SupersededError:
                     raise
                 except Exception as e:
@@ -815,7 +856,8 @@ class SlotScheduler:
         with self.stats.phase(profiler.PREFILL_CHUNK):
             tok = self.engine.prefill_chunk(slot, toks, start, end - start,
                                             st["key"], p.temperature,
-                                            p.top_k, p.top_p)
+                                            p.top_k, p.top_p,
+                                            aid=int(self._aid[slot]))
             if end >= n:
                 # the request's first token: only the FINAL chunk's
                 # sample is fetched — mid-prompt chunks stay async so
@@ -859,7 +901,8 @@ class SlotScheduler:
             # prefix_admission off — under pool pressure new donations
             # only pin blocks the make-room loop then has to evict.
             with self.stats.phase(profiler.PREFIX_COPY):
-                self.prefix.donate_from_row(slot, req.prompt)
+                self.prefix.donate_from_row(slot, req.prompt,
+                                            adapter=req.adapter)
             self.stats.end_step()
         if self._finished(req, tok):
             self._retire(req, "ok")
@@ -905,6 +948,10 @@ class SlotScheduler:
                         self._spec_off[slot]),
                "charge": self._slot_charge[slot]}
         self._tenant_credit(req, slot)
+        if self.lora is not None and req.adapter:
+            # the decode-tier adoptee re-acquires by name at resume
+            self.lora.release(req.adapter)
+        self._aid[slot] = 0
         swap = self.engine.swap_out_row(slot)
         rec.update(swap)
         req.slot = None
@@ -964,12 +1011,16 @@ class SlotScheduler:
             # prefix cache BEFORE the slot is recycled (the copy-out
             # reads the row). Paged rows donated at prefill completion.
             with self.stats.phase(profiler.PREFIX_COPY):
-                self.prefix.insert_from_row(slot, req.prompt)
+                self.prefix.insert_from_row(slot, req.prompt,
+                                            adapter=req.adapter)
             self.stats.end_step()
         if self.paged:
             # drop the row's block refs; blocks donated to the trie (or
             # shared with other live rows) survive through their refs
             self.engine.release_row(slot)
+        if self.lora is not None and req.adapter:
+            self.lora.release(req.adapter)
+        self._aid[slot] = 0
         self._tenant_credit(req, slot)
         self._req[slot] = None
         self._temp[slot] = 0.0
@@ -1136,7 +1187,8 @@ class SlotScheduler:
                 n_acc, emit = self.engine.verify_chunk(
                     slot, buf, int(self._pos[slot]), nd,
                     self._keys[slot], int(self._fold[slot]),
-                    p.temperature, p.top_k, p.top_p)
+                    p.temperature, p.top_k, p.top_p,
+                    aid=int(self._aid[slot]))
             if req.traced:
                 # a verify forward is a per-slot dispatch emitting up to
                 # K+1 tokens, so one span per FORWARD is O(1)/token-
@@ -1212,7 +1264,7 @@ class SlotScheduler:
         with self.stats.phase(profiler.DECODE_TICK):
             nxt = self.engine.tick(self._tok, self._pos, self._keys,
                                    self._fold, self._temp, self._topk,
-                                   self._topp)
+                                   self._topp, aid=self._aid)
         if self.tracer is not None and self.tracer.enabled:
             # ONE span per batched tick on the shared engine track —
             # per-request tick spans would be a per-token allocation in

@@ -167,7 +167,9 @@ class InferenceServer:
                  tp: int = 0, mesh=None, tenants: str = "",
                  int8_weights: bool = False, int4_weights: bool = False,
                  int4_group: int = 64, kv_dtype: str = "",
-                 aot_cache: str = ""):
+                 aot_cache: str = "", lora: str = "",
+                 lora_rank: int = 8, lora_pool_mb: float = 0.0,
+                 lora_adapters=None):
         """``prefill_chunk``: chunked-prefill unit in tokens (0 = the
         legacy whole-prompt prefill, one compiled program per prompt
         length); ``prefill_budget``: max chunk steps interleaved with
@@ -311,7 +313,24 @@ class InferenceServer:
         spans witness it) and compiled-then-persisted otherwise. A
         corrupt entry or an unwritable directory degrades to compiling
         with one logged warning. Unset (the default) is a pinned
-        no-op."""
+        no-op.
+
+        Batched multi-LoRA (serve/lora.py, doc/serving.md "Batched
+        multi-LoRA"): ``lora`` is the ``serve_lora`` adapter registry
+        spec (``name:path;...``); armed, every request may name an
+        adapter (``submit(..., adapter=...)``) and ONE batched tick
+        serves the whole mixed population — per-request adapter ids are
+        a traced operand, so mixed traffic is a single compiled
+        signature. The adapter population is paged: a fixed device pool
+        of factor slots (``lora_pool_mb`` MiB budget, 0 = size for the
+        whole registry), refcounted by admissions, LRU-evicted,
+        crc-verified at swap-in; admission defers a request whose
+        adapter cannot get a slot without blocking peers. Requires the
+        paged engine; ``lora_rank`` must match the adapter files;
+        ``lora_adapters`` optionally injects in-memory adapter dicts
+        (tests/bench) instead of loading the registry paths. Unset (the
+        default) is a pinned STRUCTURAL no-op — the serve programs
+        carry no adapter operand and their jaxprs are unchanged."""
         if queue < 1:
             raise ValueError("serve_queue must be >= 1, got %d" % queue)
         if prefill_budget < 1:
@@ -341,6 +360,14 @@ class InferenceServer:
             else obs_metrics.Registry()
         self._slow_ms = float(slow_ms)
         self._paged = bool(paged) and prefill_chunk > 0
+        if lora:
+            if not self._paged:
+                raise ValueError(
+                    "serve_lora requires the paged engine (serve_paged=1 "
+                    "with chunked prefill)")
+            if int(lora_rank) < 1:
+                raise ValueError("serve_lora_rank must be >= 1, got %d"
+                                 % lora_rank)
         # resilience state (serve/resilience.py): the chaos injector
         # (CXN_CHAOS env wins over the config spec — the operator's
         # override), the replay journal, the degradation ladder, and
@@ -365,6 +392,7 @@ class InferenceServer:
         self._restarts = 0
         self._replayed = 0              # guarded_by: self._cond
         self._reserve_stalls = 0
+        self._lora_defers = 0           # pops deferred on pool headroom
         self._failed: Optional[EngineFailedError] = None
         self._ema_req_s = 0.0           # EMA of admit->done, feeds the
         #                                 retry_after_ms / shed estimates
@@ -424,7 +452,9 @@ class InferenceServer:
             fused_attn=bool(fused_attn), mesh=mesh,
             int8_weights=bool(int8_weights),
             int4_weights=bool(int4_weights), int4_group=int(int4_group),
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, lora=str(lora), lora_rank=int(lora_rank),
+            lora_pool_mb=float(lora_pool_mb),
+            lora_adapters=lora_adapters)
         self._prefill_budget = int(prefill_budget)
         # device/compiler observatory (obs/devprof.py): compile-time
         # accounting always (this registry becomes a CompileWatch sink,
@@ -525,6 +555,16 @@ class InferenceServer:
         b = self._build
         cfg, slots, spec_mode = b["cfg"], b["slots"], b["spec_mode"]
         prefill_chunk, prefix_mb = b["prefill_chunk"], b["prefix_mb"]
+        # LoRA adapter pool (serve/lora.py): rebuilt with the stack —
+        # recovery restarts it COLD like the trie (empty device slots,
+        # host pages reloaded + re-checksummed from the registry);
+        # residency refills from the replayed admissions themselves
+        self._lora_pool = None
+        if b["lora"]:
+            from .lora import AdapterPool, parse_lora_spec
+            self._lora_pool = AdapterPool(
+                cfg, parse_lora_spec(b["lora"]), rank=b["lora_rank"],
+                pool_mb=b["lora_pool_mb"], adapters=b["lora_adapters"])
         self._engine = DecodeEngine(
             cfg, b["params"], slots, prefill_chunk=prefill_chunk,
             recompile_limit=b["recompile_limit"],
@@ -536,7 +576,7 @@ class InferenceServer:
             injector=self._inj, fused_attn=b["fused_attn"],
             mesh=b["mesh"], int8_weights=b["int8_weights"],
             int4_weights=b["int4_weights"], int4_group=b["int4_group"],
-            kv_dtype=b["kv_dtype"],
+            kv_dtype=b["kv_dtype"], lora_pool=self._lora_pool,
             aot=self._aot, tracer=self._tracer)
         self._prefix = None
         if prefill_chunk > 0 and prefix_mb > 0:
@@ -821,6 +861,33 @@ class InferenceServer:
                        lambda: mgr.cow_faults)
             cb_gauge("cxn_swap_host_bytes", "host bytes holding "
                      "swapped-out rows' K/V", lambda: sc.swap_host_bytes)
+        if self._lora_pool is not None:
+            # adapter-pool economy (serve/lora.py): the callbacks read
+            # THROUGH self._lora_pool so a recovery rebuild (fresh pool)
+            # is what gets reported
+            for key, help_ in (
+                    ("hits", "adapter acquires served by a resident "
+                             "slot"),
+                    ("evictions", "resident adapter pages LRU-evicted"),
+                    ("swap_ins", "adapter pages swapped onto the "
+                                 "device (crc-verified)"),
+                    ("acquire_fails", "acquires faulted on an "
+                                      "exhausted pool")):
+                cb_counter("cxn_lora_%s_total" % key, help_,
+                           lambda k=key: self._lora_pool.metrics()[k])
+            cb_counter("cxn_lora_admission_defers_total",
+                       "admission pops deferred waiting for "
+                       "adapter-pool headroom",
+                       lambda: self._lora_defers)
+            cb_gauge("cxn_lora_resident", "adapter pages resident on "
+                     "the device pool",
+                     lambda: self._lora_pool.resident())
+            cb_gauge("cxn_lora_refs", "pinned adapter references held "
+                     "by admitted rows",
+                     lambda: self._lora_pool.refs_held())
+            cb_gauge("cxn_lora_pool_slots", "adapter pool slots "
+                     "(base slot 0 included)",
+                     lambda: self._lora_pool.size)
         pc = self._prefix
         if pc is not None:
             for attr, help_ in (
@@ -860,6 +927,10 @@ class InferenceServer:
             self._ledger.register("swap_host",
                                   lambda: self._sched.swap_host_bytes,
                                   device=False)
+            if self._lora_pool is not None:
+                self._ledger.register(
+                    "lora_pool",
+                    lambda: devprof.tree_nbytes(self._lora_pool.pool))
         else:
             self._ledger.register("kv_slots", eng.cache_bytes)
             if pc is not None:
@@ -931,6 +1002,12 @@ class InferenceServer:
         ``serve_tenants`` is unset — the pinned no-op)."""
         return self._tenancy
 
+    @property
+    def lora_pool(self):
+        """The LoRA adapter pool (serve/lora.py; None when
+        ``serve_lora`` is unset — the pinned no-op)."""
+        return self._lora_pool
+
     def metrics_text(self) -> str:
         """Prometheus text exposition of the full serving catalog
         (serving + prefix-cache + speculative + recompile-guard
@@ -973,6 +1050,7 @@ class InferenceServer:
             # does not know); migrations bypass quotas — the request
             # already held, and lost, capacity elsewhere
             req.tenant = self._tenancy.resolve(req.tenant)
+        self._check_adoptable(req)
         with self._cond:
             if self._failed is not None:
                 raise EngineFailedError(str(self._failed))
@@ -1013,6 +1091,7 @@ class InferenceServer:
         for the scheduler thread to inject; journaled first, so a fault
         between adoption and resume replays the request here from
         scratch, bit-identically."""
+        self._check_adoptable(req)
         rec["req"] = req
         with self._cond:
             if self._failed is not None:
@@ -1023,6 +1102,21 @@ class InferenceServer:
             self._bump("submitted", req)
             self._adopted.append(rec)
             self._cond.notify_all()
+
+    def _check_adoptable(self, req: Request) -> None:
+        """Fleet/failover entry gate: a migrated request naming a LoRA
+        adapter this replica cannot serve must be refused AT ADOPTION —
+        admitted, it would silently regenerate with the base model
+        (wrong tokens, and the replay-divergence check would fire only
+        after emitting them)."""
+        if req.adapter and (self._lora_pool is None
+                            or req.adapter
+                            not in self._lora_pool.registry):
+            with self._cond:
+                self._bump("rejected", req)
+            raise AdmissionError(
+                "migrated request %d names LoRA adapter %r this "
+                "replica cannot serve" % (req.rid, req.adapter))
 
     def _reject(self, reason: str) -> None:
         """Count + raise an unservable-request rejection, so the
@@ -1039,15 +1133,19 @@ class InferenceServer:
     def submit(self, prompt, params: Optional[SamplingParams] = None,
                block: bool = False, tenant: str = "",
                rid: Optional[int] = None, migrate: bool = False,
-               **overrides) -> Request:
+               adapter: str = "", **overrides) -> Request:
         """Enqueue one generation request; returns an opaque handle for
         :meth:`result`. ``params``/keyword overrides fill a
         SamplingParams on top of the server defaults. ``tenant`` is the
         request's tenant label (serve/tenancy.py) — resolved against
         the ``serve_tenants`` registry when armed (unknown names get
-        the ``default`` policy), ignored otherwise. Raises
-        :class:`QueueFullError` when the admission queue is at capacity
-        (``block=True`` waits for space instead),
+        the ``default`` policy), ignored otherwise. ``adapter`` names
+        the request's LoRA adapter (serve/lora.py; "" = base model) —
+        requires ``serve_lora`` armed and the name registered; with
+        tenancy armed and no explicit tenant, the adapter name doubles
+        as the tenant label, so per-adapter quotas/SLOs compose for
+        free. Raises :class:`QueueFullError` when the admission queue
+        is at capacity (``block=True`` waits for space instead),
         :class:`QuotaExceededError` when the tenant is over its rate or
         queue quota (quotas are hard — they apply to blocking submits
         too), and :class:`AdmissionError` for unservable prompts."""
@@ -1074,6 +1172,24 @@ class InferenceServer:
                          "(server spec drafters: %s)"
                          % (p.spec_mode,
                             ", ".join(sorted(self._drafters)) or "none"))
+        if adapter:
+            # a request naming an adapter the server cannot serve is
+            # PERMANENTLY unservable — rejected typed at the door, never
+            # queued to stall the admission walk
+            if self._lora_pool is None:
+                self._reject("request names LoRA adapter %r but "
+                             "serve_lora is not armed on this server"
+                             % adapter)
+            if adapter not in self._lora_pool.registry:
+                self._reject(
+                    "unknown LoRA adapter %r (registered: %s)"
+                    % (adapter,
+                       ", ".join(sorted(self._lora_pool.registry))
+                       or "none"))
+            if not tenant:
+                # adapter-as-tenant composition: per-adapter quotas and
+                # SLO series fall out of the existing tenancy layer
+                tenant = adapter
         pol = None
         if self._tenancy is not None:
             pol = self._tenancy.policy_for(tenant)
@@ -1195,7 +1311,8 @@ class InferenceServer:
             # and migrate=True sends the row to a decode-tier worker at
             # prefill completion. Both default to the pre-fleet path.
             req = Request(next(self._rid) if rid is None else rid,
-                          prompt, p, time.perf_counter(), tenant=tenant)
+                          prompt, p, time.perf_counter(), tenant=tenant,
+                          adapter=adapter)
             req.migrate = migrate
             self._queue.append(req)
             self._bump("submitted", req)
@@ -1356,6 +1473,9 @@ class InferenceServer:
                 # IS the original FIFO pop.
                 claimed = 0
                 t_claims: Dict[str, tuple] = {}
+                l_names: set = set()    # distinct adapter names charged
+                #   a pool slot by pops earlier in THIS pass (their
+                #   acquires run later, outside this lock)
                 if not sched.swapped_pending and n_free > 0 \
                         and self._queue:
                     q = list(self._queue)
@@ -1378,6 +1498,26 @@ class InferenceServer:
                         if sched.tenant_blocked(req, t_claims):
                             continue        # THIS tenant waits; peers
                             #                 behind it do not
+                        lp = self._lora_pool
+                        if lp is not None and req.adapter \
+                                and req.adapter not in l_names \
+                                and not lp.pinned(req.adapter):
+                            # adapter residency is an admission gate
+                            # exactly like tenant quotas: a request
+                            # whose adapter cannot get a pool slot
+                            # WAITS without blocking peers. The budget
+                            # is one unreferenced slot per distinct
+                            # un-pinned name popped this pass — the
+                            # acquires run later in pop order and any
+                            # one may evict any unpinned slot, so
+                            # headroom >= names-charged keeps every
+                            # acquire in the batch from faulting
+                            # (lora.AdapterPool.headroom)
+                            if not lp.can_acquire(req.adapter) \
+                                    or lp.headroom() <= len(l_names):
+                                self._lora_defers += 1
+                                continue
+                            l_names.add(req.adapter)
                         # journal BEFORE any device work: from this
                         # moment until its terminal state, the request
                         # is replayed after an engine-fatal fault
@@ -1961,6 +2101,13 @@ class InferenceServer:
             **({"aot_cache": dict(self._aot.stats(),
                                   programs=self._engine.aot_status())}
                if self._aot is not None else {}),
+            # adapter-pool economy (serve/lora.py): the key is ADDED
+            # only when serve_lora is armed so the base metrics()
+            # surface stays identical
+            **({"lora": dict(self._lora_pool.metrics(),
+                             defers=self._lora_defers,
+                             refs=self._lora_pool.refs_held())}
+               if self._lora_pool is not None else {}),
             "requests": dict(self._counts),
             "ttft_ms": ms(self._ttft_s),
             "token_ms": ms(self._tok_gap_s),

@@ -202,6 +202,8 @@ class Net:
                     tenants: str = "", int8_weights: bool = False,
                     int4_weights: bool = False, int4_group: int = 64,
                     kv_dtype: str = "", aot_cache: str = "",
+                    lora: str = "", lora_rank: int = 8,
+                    lora_pool_mb: float = 0.0, lora_adapters=None,
                     fleet: str = "", aot_relabel=None, worker_env=None,
                     **defaults) -> None:
         """Start the continuous-batching inference server over this net's
@@ -300,6 +302,16 @@ class Net:
         rebuild / replica spin-up over the same key does the same.
         Empty (the default) is a pinned no-op.
 
+        Batched multi-LoRA (serve/lora.py, doc/serving.md "Batched
+        multi-LoRA"): ``lora`` is the ``serve_lora`` adapter registry
+        spec (``name:path.npz;...``) — armed, requests opt in via
+        ``serve_submit(adapter=...)`` and ONE batched tick serves the
+        mixed adapter population through a paged device pool of rank-
+        ``lora_rank`` factor pages (``lora_pool_mb`` MiB budget, 0 =
+        whole registry resident; ``lora_adapters`` injects in-memory
+        adapter dicts for tests). Paged engine only. Empty (the
+        default) is a pinned STRUCTURAL no-op.
+
         Cross-process fleet (serve/fleet.py, doc/serving.md
         "Disaggregated fleet"): ``fleet`` is a tier spec —
         ``"prefill=1,decode=2"`` (or a bare worker count for a
@@ -335,7 +347,8 @@ class Net:
             degrade=degrade, tp=tp, tenants=tenants,
             int8_weights=int8_weights, int4_weights=int4_weights,
             int4_group=int4_group, kv_dtype=kv_dtype,
-            aot_cache=aot_cache,
+            aot_cache=aot_cache, lora=lora, lora_rank=lora_rank,
+            lora_pool_mb=lora_pool_mb, lora_adapters=lora_adapters,
             defaults=SamplingParams(**defaults))
         if fleet.strip():
             # worker processes own their registries and tracers (the
@@ -387,7 +400,9 @@ class Net:
                      tenant: str = "", **params):
         """Enqueue one request -> handle (per-request ``params`` override
         the serve_start defaults; ``tenant`` labels the request when
-        ``serve_start(tenants=...)`` armed the policy registry).
+        ``serve_start(tenants=...)`` armed the policy registry;
+        ``adapter=`` names the request's LoRA adapter when
+        ``serve_start(lora=...)`` armed the pool).
         Raises serve.QueueFullError when the bounded admission queue is
         full (unless ``block=True``) and serve.QuotaExceededError when
         the tenant is over its rate or queue quota."""

@@ -122,14 +122,37 @@ def test_validation():
                    int4_weights=True, int4_group=-2)
 
 
-def test_int4_rejects_tp():
+def test_int4_composes_with_tp_bit_identical():
+    """ROADMAP 3c closed: ``serve_int4_weights=1`` with ``serve_tp=2``
+    is accepted (shard-aware packing — nibble pairs never straddle a
+    shard boundary) and the sharded int4 server's greedy stream is
+    BIT-IDENTICAL to the single-device int4 server's. The sharded
+    engine streams the XLA reference formulation (the in-tile Pallas
+    unpack assumes the single-segment layout), counted under
+    ``cxn_int4_fallback_total{reason="tp"}``."""
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 local devices for a model-axis mesh")
-    from cxxnet_tpu.parallel.mesh import make_mesh
-    mesh = make_mesh(devices=jax.devices()[:2], model_parallel=2)
-    with pytest.raises(ValueError, match="serve_tp"):
-        DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB,
-                     int4_weights=True, mesh=mesh)
+    rs = np.random.RandomState(3)
+    jobs = [(_prompt(rs, n), 8) for n in (5, 9, 14)]
+    kw = dict(slots=2, prefill_chunk=4, num_blocks=NB, paged=True,
+              int4_weights=True, int4_group=0)
+
+    def serve(tp):
+        srv = InferenceServer(CFG, PARAMS, **kw, **({"tp": tp} if tp else {}))
+        try:
+            hs = [srv.submit(p, max_tokens=m) for p, m in jobs]
+            out = [srv.result(h, timeout=300) for h in hs]
+            assert all(r.status == "ok" for r in out), \
+                [(r.status, r.error) for r in out]
+            return [r.tokens for r in out], srv.metrics()
+        finally:
+            srv.shutdown()
+
+    solo, _ = serve(0)
+    shard, m = serve(2)
+    for a, b in zip(solo, shard):
+        assert np.array_equal(a, b), (a, b)
+    assert m["int4_weights"] and m["int4_formulation"] == ""
 
 
 # ------------------------------------------------------- packing is exact

@@ -1,0 +1,388 @@
+"""Batched multi-LoRA serving end-to-end (doc/serving.md "Batched
+multi-LoRA"): the paged adapter pool, per-row ragged grouped dispatch,
+and the identity/admission contracts.
+
+The load-bearing invariants:
+
+1. **pinned structural no-op when unset** — an armed server with no
+   adapter named streams bit-identically to an unarmed server, and the
+   unarmed engine's programs carry no LoRA operand at all (no
+   ``/lora=`` signature suffix);
+2. **solo-oracle identity** — a request decoding under adapter ``a``
+   in a MIXED batch is bit-identical to the same request served alone
+   on a server registering only ``a`` — greedy AND sampled, across
+   prefix hits, speculative decoding, and preempt/swap/resume;
+3. **kernel == reference, bitwise** — ``lora_bgmv`` in interpret mode
+   is bit-identical to the ragged XLA reference (both run the same
+   f32-accumulated two-dot contraction op for op);
+4. **the pool is a real pager** — refcounted acquire/release audited
+   by ``check_refs``, LRU eviction of unreferenced slots only,
+   checksum-verified swap-in (corruption is a typed fault), and
+   admission DEFERS (never faults) when the pool is pinned;
+5. **hygiene** — mixed adapter traffic is ONE compiled signature
+   (ids are data, not structure), the adapter rides the tenant label,
+   the failover/fleet wire records, and the affinity trie keys.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.models.gpt import GPTConfig, gpt_init
+from cxxnet_tpu.ops import pallas_kernels as pk
+from cxxnet_tpu.serve import (AdapterPool, AdmissionError, DecodeEngine,
+                              InferenceServer, auto_num_blocks,
+                              make_adapter, parse_lora_spec)
+from cxxnet_tpu.serve.lora import LORA_SITES, _delta_ragged, lora_delta
+from cxxnet_tpu.serve.resilience import SwapCorruptionError
+
+CFG = GPTConfig(vocab_size=32, seq_len=48, n_layer=2, n_head=2, feat=16,
+                n_microbatch=1)
+PARAMS = gpt_init(jax.random.PRNGKey(5), CFG)
+NB = auto_num_blocks(CFG, 2, 4)
+RANK = 4
+ADS = {"a": make_adapter(CFG, RANK, seed=1),
+       "b": make_adapter(CFG, RANK, seed=2),
+       "c": make_adapter(CFG, RANK, seed=3)}
+REG = "a:a.npz;b:b.npz;c:c.npz"      # paths never touched: in-memory
+LKW = dict(lora=REG, lora_rank=RANK, lora_adapters=ADS)
+
+
+def _prompt(rs, n):
+    return rs.randint(0, CFG.vocab_size, (n,)).astype(np.int32)
+
+
+def _serve_all(srv, jobs):
+    """jobs: [(prompt, max_tokens, overrides)] -> token arrays, order
+    preserved; every request must finish ok."""
+    hs = [srv.submit(p, max_tokens=m, **ov) for p, m, ov in jobs]
+    out = []
+    for h in hs:
+        r = srv.result(h, timeout=300)
+        assert r.status == "ok", (r.status, r.error)
+        out.append(r.tokens)
+    return out
+
+
+def _solo(prompt, max_tokens, adapter="", **ov):
+    """The oracle: the request served ALONE on a server registering
+    only its adapter (or unarmed, for the base model)."""
+    kw = dict(slots=2, queue=4, prefill_chunk=4, num_blocks=NB,
+              prefix_mb=0.0)
+    if adapter:
+        kw.update(lora="%s:x.npz" % adapter, lora_rank=RANK,
+                  lora_adapters={adapter: ADS[adapter]})
+        ov = dict(ov, adapter=adapter)
+    with InferenceServer(CFG, PARAMS, **kw) as srv:
+        r = srv.result(srv.submit(prompt, max_tokens=max_tokens, **ov),
+                       timeout=300)
+        assert r.status == "ok", (r.status, r.error)
+        return r.tokens
+
+
+# ------------------------------------------------------ registry / pool
+def test_parse_spec_and_pool_geometry():
+    assert parse_lora_spec("a:x.npz;b") == {"a": "x.npz", "b": "b.npz"}
+    pool = AdapterPool(CFG, parse_lora_spec(REG), rank=RANK, adapters=ADS)
+    assert pool.size == 4               # 3 adapters + base slot 0
+    hidden = CFG.mlp_ratio * CFG.feat
+    want = sum(CFG.n_layer * (i * RANK + RANK * o) * 4
+               for i, o in ((CFG.feat, 3 * CFG.feat),
+                            (CFG.feat, CFG.feat),
+                            (CFG.feat, hidden), (hidden, CFG.feat)))
+    assert pool.slot_bytes == want
+    assert pool.sig == "/lora=r%d/pool=4" % RANK
+    for site in LORA_SITES:             # slot 0 stays all-zeros = base
+        assert not np.asarray(pool.pool["b_" + site][0]).any()
+    with pytest.raises(ValueError, match="rank"):
+        AdapterPool(CFG, {"a": "x"}, rank=8, adapters=ADS)
+
+
+def test_pool_refcount_eviction_swap_audit():
+    # pool_mb sized under 3 slots -> the 2-slot floor: base + ONE page
+    pool = AdapterPool(CFG, parse_lora_spec(REG), rank=RANK,
+                       pool_mb=1e-9, adapters=ADS)
+    assert pool.size == 2
+    assert pool.acquire("") == 0        # base: no slot, no ref
+    s = pool.acquire("a")
+    assert s == 1 and pool.pinned("a") and pool.refs_held() == 1
+    assert pool.acquire("a") == s       # resident hit, second ref
+    assert pool.hits == 1 and pool.swap_ins == 1
+    assert not pool.can_acquire("b") and pool.headroom() == 0
+    pool.release("a")
+    assert pool.pinned("a")             # one ref still pinned
+    pool.release("a")
+    pool.check_refs(0)
+    assert pool.headroom() == 1 and pool.can_acquire("b")
+    assert pool.acquire("b") == 1       # LRU-evicts a's page
+    assert pool.evictions == 1 and pool.swap_ins == 2
+    pool.release("b")
+    with pytest.raises(KeyError):
+        pool.acquire("zzz")
+    with pytest.raises(AssertionError, match="refcount"):
+        pool.check_refs(3)
+    # corrupted host pages fail their load-time crc at swap-in
+    ADS_local = dict(ADS)
+    pool2 = AdapterPool(CFG, {"a": "x", "b": "y"}, rank=RANK,
+                        pool_mb=1e-9, adapters=ADS_local)
+    pool2.acquire("a")
+    pool2.release("a")
+    pool2.acquire("b")                  # evict a
+    pool2.release("b")
+    pool2._host["a"]["a_qkv"] = pool2._host["a"]["a_qkv"] + 1.0
+    with pytest.raises(SwapCorruptionError):
+        pool2.acquire("a")
+
+
+# ------------------------------------------------- structural no-op pin
+def test_unset_is_pinned_structural_noop():
+    eng = DecodeEngine(CFG, PARAMS, 2, prefill_chunk=4, num_blocks=NB)
+    assert "/lora" not in eng._sig_suffix
+    rs = np.random.RandomState(0)
+    jobs = [(_prompt(rs, n), 6, {}) for n in (5, 9)]
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB, prefix_mb=0.0) as srv:
+        base = _serve_all(srv, jobs)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB, prefix_mb=0.0, **LKW) as srv:
+        armed = _serve_all(srv, jobs)   # armed, nothing named = id 0
+        assert "/lora=r%d/pool=4" % RANK in srv._engine._sig_suffix
+    for x, y in zip(base, armed):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_validation_and_unknown_adapter():
+    with pytest.raises(ValueError, match="paged"):
+        InferenceServer(CFG, PARAMS, slots=2, prefill_chunk=0, **LKW)
+    with pytest.raises(ValueError, match="serve_lora_rank"):
+        InferenceServer(CFG, PARAMS, slots=2, prefill_chunk=4,
+                        num_blocks=NB, lora=REG, lora_rank=0,
+                        lora_adapters=ADS)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB, **LKW) as srv:
+        with pytest.raises(AdmissionError, match="unknown LoRA"):
+            srv.submit(np.arange(4, dtype=np.int32), max_tokens=2,
+                       adapter="zzz")
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB) as srv:
+        with pytest.raises(AdmissionError, match="not armed"):
+            srv.submit(np.arange(4, dtype=np.int32), max_tokens=2,
+                       adapter="a")
+
+
+# --------------------------------------------------- solo-oracle identity
+def test_mixed_batch_matches_solo_oracle():
+    """One mixed batch over base/a/b/c, greedy AND sampled: every row
+    bit-identical to its single-adapter oracle."""
+    rs = np.random.RandomState(2)
+    names = ["", "a", "b", "c", "a", "b"]
+    jobs = []
+    for i, name in enumerate(names):
+        ov = {"adapter": name} if name else {}
+        if i % 2:
+            ov.update(temperature=0.8, top_k=8, seed=10 + i)
+        jobs.append((_prompt(rs, 5 + 2 * i), 6, ov))
+    with InferenceServer(CFG, PARAMS, slots=6, queue=8, prefill_chunk=4,
+                         prefix_mb=0.0, **LKW) as srv:
+        got = _serve_all(srv, jobs)
+        srv.lora_pool.check_refs(0)     # every admission released
+    for (p, m, ov), g in zip(jobs, got):
+        ref = _solo(p, m, **ov)
+        np.testing.assert_array_equal(g, ref)
+
+
+def test_prefix_hit_identity_and_cross_adapter_no_hit():
+    """Prefix KV cached under adapter ``a`` answers a's resubmission
+    (tokens unchanged) and NEVER answers ``b`` or the base model — the
+    trie keys carry the adapter id; id 0 keys are the pre-LoRA bytes."""
+    rs = np.random.RandomState(4)
+    p = _prompt(rs, 16)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB, prefix_mb=4.0, **LKW) as srv:
+        def run(adapter):
+            ov = {"adapter": adapter} if adapter else {}
+            r = srv.result(srv.submit(p, max_tokens=6, **ov), timeout=300)
+            assert r.status == "ok", (r.status, r.error)
+            return r.tokens
+
+        first = run("a")
+        before = srv.metrics()["prefix_cache"]["hit_tokens"]
+        again = run("a")
+        hit_a = srv.metrics()["prefix_cache"]["hit_tokens"]
+        assert hit_a > before           # a's resubmission hit a's KV
+        np.testing.assert_array_equal(first, again)
+        run("b")
+        run("")
+        assert srv.metrics()["prefix_cache"]["hit_tokens"] == hit_a
+    np.testing.assert_array_equal(first, _solo(p, 6, adapter="a"))
+
+
+def test_speculative_composes_bit_identical():
+    """ngram speculation with adapters armed: greedy output stays
+    bit-identical to the non-speculative solo oracle (the verify
+    program reads the same per-row ids), and spec forwards really ran."""
+    rs = np.random.RandomState(6)
+    # repetitive prompts so the ngram drafter actually drafts
+    base = _prompt(rs, 6)
+    p1 = np.tile(base, 3)[:16].astype(np.int32)
+    p2 = np.tile(_prompt(rs, 5), 3)[:14].astype(np.int32)
+    jobs = [(p1, 8, {"adapter": "a"}), (p2, 8, {"adapter": "b"}),
+            (p1, 8, {})]
+    with InferenceServer(CFG, PARAMS, slots=3, queue=4, prefill_chunk=4,
+                         prefix_mb=0.0, spec_mode="ngram", spec_len=2,
+                         **LKW) as srv:
+        got = _serve_all(srv, jobs)
+        assert srv.metrics()["spec_forwards"] > 0
+    for (p, m, ov), g in zip(jobs, got):
+        np.testing.assert_array_equal(g, _solo(p, m, **ov))
+
+
+def test_preempt_swap_resume_with_pool_eviction():
+    """KV pool small enough to force preemption + a 2-slot adapter pool:
+    a preempted row RELEASES its adapter ref (the page may be evicted
+    while the row sits in host swap) and resume re-acquires by NAME —
+    the resumed stream stays bit-exact through the round trip."""
+    rs = np.random.RandomState(3)
+    jobs = [(_prompt(rs, 12), 10, {"adapter": "ab"[i % 2]})
+            for i in range(4)]
+    jobs.append((_prompt(rs, 8), 6, {"adapter": "c"}))
+    # 3 pool slots (base + 2 pages): a and b run CONCURRENTLY — their 4
+    # rows overflow the 14-block KV pool, forcing preemption — while c
+    # must evict whichever page the preempted/retired rows released
+    probe = AdapterPool(CFG, parse_lora_spec(REG), rank=RANK,
+                        adapters=ADS)
+    mb = (3 * probe.slot_bytes + 1) / 2.0 ** 20
+    with InferenceServer(CFG, PARAMS, slots=4, queue=8, prefill_chunk=4,
+                         num_blocks=14, degrade=False, lora=REG,
+                         lora_rank=RANK, lora_adapters=ADS,
+                         lora_pool_mb=mb) as srv:
+        assert srv.lora_pool.size == 3
+        got = _serve_all(srv, jobs)
+        m = srv.metrics()
+        srv.lora_pool.check_refs(0)
+    assert m["paged"]["swaps_out"] > 0 and m["paged"]["swaps_in"] > 0
+    lm = m["lora"]
+    assert lm["swap_ins"] >= 3 and lm["evictions"] >= 1
+    assert lm["acquire_fails"] == 0
+    for (p, mt, ov), g in zip(jobs, got):
+        np.testing.assert_array_equal(g, _solo(p, mt, **ov))
+
+
+# ------------------------------------------- kernel == reference, bitwise
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_kernel_bit_identical_to_ragged_reference(dtype):
+    """``lora_bgmv`` (interpret mode) vs ``_delta_ragged``: both run
+    the identical f32-accumulated two-dot contraction, so equality is
+    BITWISE — any difference is structural, not rounding."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(7)
+    P, L, n = 4, 1, 3
+    for rows, d_in, r, d_out in ((6, 16, 8, 32), (5, 32, 8, 16)):
+        x = jnp.asarray(rs.randn(rows, n, d_in), dtype)
+        y = jnp.asarray(rs.randn(rows, n, d_out), dtype)
+        a = jnp.asarray(rs.randn(P, L, d_in, r), jnp.float32)
+        b = jnp.asarray(rs.randn(P, L, r, d_out), jnp.float32)
+        ids = jnp.asarray(rs.randint(0, P, (rows,)), jnp.int32)
+        pool = {"a_qkv": a, "b_qkv": b}
+        assert not pk.lora_bgmv_supported(n, d_in, r, d_out)  # CPU: ref
+        ref = np.asarray(lora_delta(pool, ids, 0, "qkv", x, y))
+        np.testing.assert_array_equal(
+            ref, np.asarray(_delta_ragged(a[:, 0], b[:, 0], ids, x, y, P)))
+        old = pk._INTERPRET
+        pk._INTERPRET = True
+        try:
+            assert pk.lora_bgmv_supported(n, d_in, r, d_out)
+            ker = np.asarray(lora_delta(pool, ids, 0, "qkv", x, y))
+        finally:
+            pk._INTERPRET = old
+        np.testing.assert_array_equal(ker, ref,
+                                      err_msg=str((rows, d_in, r, d_out)))
+    assert pk.lora_bgmv_fallback_reason(n, 16, 8, 16) == "backend"
+    assert pk.lora_bgmv_fallback_reason(n, 16, 8, 16 << 20) != ""
+
+
+# --------------------------------------------------------------- hygiene
+def test_one_signature_mixed_adapters():
+    """Any adapter mix is ONE compiled signature per program — the ids
+    are traced data; only (rank, pool slots) are static."""
+    rs = np.random.RandomState(9)
+    jobs = [(_prompt(rs, n), 4, {"adapter": a})
+            for n, a in ((5, "a"), (9, "b"), (13, "c"), (7, "a"))]
+    jobs.append((_prompt(rs, 6), 4, {}))
+    with InferenceServer(CFG, PARAMS, slots=3, queue=8, prefill_chunk=4,
+                         prefix_mb=0.0, recompile_limit=1, **LKW) as srv:
+        _serve_all(srv, jobs)
+        eng = srv._engine
+        assert len(eng.prefill_signatures) == 1
+        assert "/lora=r%d/pool=4" % RANK in str(eng.prefill_signatures[0])
+
+
+def test_adapter_rides_tenant_and_admission_defers():
+    """An adapter request with no tenant label accounts as tenant
+    <adapter>; a pinned 2-slot pool DEFERS the other adapter's
+    admission (counted, never an acquire fault) until the slot frees."""
+    rs = np.random.RandomState(8)
+    with InferenceServer(CFG, PARAMS, slots=2, queue=8, prefill_chunk=4,
+                         num_blocks=NB, prefix_mb=0.0, lora=REG,
+                         lora_rank=RANK, lora_adapters=ADS,
+                         lora_pool_mb=1e-9) as srv:
+        h1 = srv.submit(_prompt(rs, 5), max_tokens=12, adapter="a")
+        assert h1.tenant == "a" and h1.adapter == "a"
+        h2 = srv.submit(_prompt(rs, 5), max_tokens=4, adapter="b",
+                        tenant="gold")
+        assert h2.tenant == "gold"      # explicit label wins
+        h3 = srv.submit(_prompt(rs, 7), max_tokens=4, adapter="a")
+        for h in (h1, h2, h3):
+            assert srv.result(h, timeout=300).status == "ok"
+        lm = srv.metrics()["lora"]
+        assert lm["defers"] > 0 and lm["acquire_fails"] == 0
+        srv.lora_pool.check_refs(0)
+
+
+def test_wire_records_trie_keys_and_adoption_guard():
+    from cxxnet_tpu.serve.fleet import request_from_wire, request_to_wire
+    from cxxnet_tpu.serve.router import _AffinityTrie, rewind_request
+    from cxxnet_tpu.serve.scheduler import Request, SamplingParams
+
+    req = Request(7, np.arange(6, dtype=np.int32), SamplingParams(
+        max_tokens=4), 0.0, tenant="t", adapter="a")
+    back = request_from_wire(request_to_wire(req))
+    assert back.adapter == "a" and back.tenant == "t"
+    assert rewind_request(req).adapter == "a"
+    # affinity keys are per-(adapter, prefix): a's history never
+    # attracts b's or the base model's traffic; "" keeps pre-LoRA crcs
+    trie = _AffinityTrie(chunk=4)
+    p = np.arange(12, dtype=np.int32)
+    trie.note(p, "a")
+    assert trie.match(p, "a") == 12
+    assert trie.match(p, "b") == 0 and trie.match(p, "") == 0
+    # a replica that doesn't register the adapter refuses adoption
+    with InferenceServer(CFG, PARAMS, slots=2, queue=4, prefill_chunk=4,
+                         num_blocks=NB) as srv:
+        with pytest.raises(AdmissionError, match="adapter"):
+            srv._check_adoptable(req)
+
+
+def test_chaos_recovery_with_adapters():
+    """The fault-injection soak with adapters armed: every request
+    completes and the streams stay bit-identical to an undisturbed
+    armed server — replay re-acquires adapters by name through the
+    rebuilt engine (the pool survives recovery)."""
+    rs = np.random.RandomState(11)
+    names = ["", "a", "b"]
+    cases = [(_prompt(rs, int(rs.randint(5, 12))),
+              int(rs.randint(3, 6)),
+              {"adapter": names[i % 3]} if names[i % 3] else {})
+             for i in range(6)]
+    outs = {}
+    for chaos in ("", "all:0.02,seed:3,hang_ms:50"):
+        with InferenceServer(CFG, PARAMS, slots=2, queue=8,
+                             prefill_chunk=4, num_blocks=NB,
+                             prefix_mb=0.0, chaos=chaos,
+                             max_restarts=50, **LKW) as srv:
+            outs[chaos] = _serve_all(srv, cases)
+            srv.lora_pool.check_refs(0)
+    for clean, chaotic in zip(outs[""], outs["all:0.02,seed:3,hang_ms:50"]):
+        np.testing.assert_array_equal(clean, chaotic)

@@ -27,6 +27,14 @@ programs: the engine streams the uint8-packed weight planes, the
 unpacked int4 weight image in HBM (CXN211 where the fused
 dequant-matmul should be active), and CXN209 covers the i4/u8 ->
 f32 promotion variant. Under
+``serve_lora=name:path;...`` the audit arms the adapter pool (missing
+adapter files are stubbed at the registry's shapes — the audit needs
+geometry, not weights) and audits the LoRA-ARMED executables: the
+chunk-prefill / tick / verify programs carry the traced adapter-id
+operand and the factor-pool leaves, so donation aliasing (the KV pool
+still aliases through the extra operands), the CXN208 clip-fold, and
+CXN209 promotion-cleanliness are pinned for the programs a multi-LoRA
+``task=serve`` actually runs. Under
 ``serve_tp=N`` the audit builds the model-axis mesh and audits the
 PARTITIONED executables — including the shard_map-wrapped fused
 paged-attention programs (armed in Pallas interpret mode off-TPU when
@@ -157,6 +165,26 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     prefix_mb=task.serve_prefix_mb,
                     kv_mb=task.serve_kv_mb,
                     kv_dtype=task.serve_kv_dtype))
+            # serve_lora=name:path;... : audit the LoRA-ARMED programs
+            # (traced adapter-id operand + factor-pool leaves). Adapter
+            # files that don't exist at lint time are stubbed at the
+            # registry's shapes — the audit pins program structure, not
+            # adapter weights.
+            lora_pool = None
+            if getattr(task, "serve_lora", "") and nb > 0:
+                from cxxnet_tpu.serve.lora import (AdapterPool,
+                                                   make_adapter,
+                                                   parse_lora_spec)
+                lreg = parse_lora_spec(task.serve_lora)
+                lrank = int(getattr(task, "serve_lora_rank", 8))
+                stubs = {name: make_adapter(gcfg, lrank)
+                         for name, p in lreg.items()
+                         if not os.path.exists(p)}
+                lora_pool = AdapterPool(
+                    gcfg, lreg, rank=lrank,
+                    pool_mb=float(getattr(task, "serve_lora_pool_mb",
+                                          0.0)),
+                    adapters=stubs or None)
             # fused-attention audit off-TPU: the production default is
             # the fused Pallas tick/verify, but the kernel only
             # compiles on TPU backends — arm interpret mode for the
@@ -216,7 +244,8 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                                        task.serve_int4_weights),
                                    int4_group=int(
                                        task.serve_int4_group),
-                                   kv_dtype=task.serve_kv_dtype)
+                                   kv_dtype=task.serve_kv_dtype,
+                                   lora_pool=lora_pool)
                 # the serve executables ride under the same compile-time
                 # budget as the trainer steps (CXN207): pass
                 # lint_compile_budget_s=<s> to gate compile regressions
@@ -257,7 +286,7 @@ def lint_one(path, overrides, do_compile=False, verbose=True) -> int:
                     int8_weights=bool(task.serve_int8_weights),
                     int4_weights=bool(task.serve_int4_weights),
                     int4_group=int(task.serve_int4_group),
-                    kv_dtype=task.serve_kv_dtype)
+                    kv_dtype=task.serve_kv_dtype, lora_pool=lora_pool)
                 aot_report, aot_infos = audit_aot_artifacts(
                     veng, aot_dir,
                     collective_budget=(colbudget if colbudget >= 0
